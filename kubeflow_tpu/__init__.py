@@ -1,0 +1,18 @@
+"""kubeflow_tpu — a TPU-native Kubernetes notebook/workbench control plane.
+
+A from-scratch rebuild of the Kubeflow Notebooks stack (reference:
+rhoai-ide-konflux/kubeflow) with TPU as the first-class accelerator:
+
+- ``kubeflow_tpu.tpu``         — pure TPU topology library (slices, hosts, env wiring)
+- ``kubeflow_tpu.api``         — CRD types: Notebook, Profile, PodDefault, Tensorboard, PVCViewer
+- ``kubeflow_tpu.runtime``     — controller runtime (client, informers, workqueue, manager)
+- ``kubeflow_tpu.controllers`` — reconcilers (notebook, culling, profile, tensorboard, pvcviewer)
+- ``kubeflow_tpu.webhooks``    — admission layer (PodDefault mutator, notebook mutator, defaulters)
+- ``kubeflow_tpu.apps``        — CRUD web-app backends (jupyter, tensorboards, volumes), KFAM, dashboard
+- ``kubeflow_tpu.models``      — slice-validation workloads (sharded transformer burn-in)
+- ``kubeflow_tpu.ops``         — TPU compute ops (collectives probes, pallas kernels)
+- ``kubeflow_tpu.parallel``    — mesh/sharding helpers for multi-host slices
+- ``kubeflow_tpu.testing``     — fake kube-apiserver (envtest equivalent) + fake TPU runtime
+"""
+
+__version__ = "0.1.0"

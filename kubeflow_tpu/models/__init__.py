@@ -1,0 +1,18 @@
+"""Slice-validation workloads.
+
+The reference stack ships no models (it is a control plane); what a TPU-native
+notebook stack needs instead is a *burn-in / validation workload* the platform
+runs against a freshly spawned slice: a small sharded transformer whose step
+time, MXU utilisation and collective bandwidth score the slice healthy
+(BASELINE.md north-star: ≥90 % ICI bandwidth on an 8-way all-reduce).
+"""
+
+from kubeflow_tpu.models.burnin import (
+    BurninConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+)
+
+__all__ = ["BurninConfig", "forward", "init_params", "loss_fn", "make_train_step"]

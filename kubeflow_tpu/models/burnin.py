@@ -1,0 +1,157 @@
+"""Burn-in transformer: the slice-validation workload.
+
+A deliberately small decoder-only transformer written in pure JAX (pytree
+params, functional transforms) whose training step exercises exactly what a
+healthy TPU slice must deliver: large bf16 matmuls on the MXU, fused
+elementwise chains, and cross-chip collectives (data-parallel grad psum +
+tensor-parallel activation collectives) inserted by GSPMD from sharding
+annotations. No torch-style modules, no dynamic shapes, no Python control
+flow under jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class BurninConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    seq_len: int = 128
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: BurninConfig) -> dict:
+    """Pytree of parameters; plain dicts so sharding rules stay transparent."""
+
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / shape[0]) ** 0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    keys = iter(jax.random.split(rng, 4 + 6 * cfg.n_layers))
+    params = {
+        "embed": dense(next(keys), (cfg.vocab, cfg.d_model), scale=0.02),
+        "pos": dense(next(keys), (cfg.seq_len, cfg.d_model), scale=0.02),
+        "out_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append(
+            {
+                "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+                "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+                "qkv": dense(next(keys), (cfg.d_model, 3 * cfg.d_model)),
+                "attn_out": dense(next(keys), (cfg.d_model, cfg.d_model)),
+                "ff1": dense(next(keys), (cfg.d_model, cfg.d_ff)),
+                "ff2": dense(next(keys), (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return params
+
+
+def param_sharding_rules(cfg: BurninConfig) -> dict:
+    """PartitionSpecs for tensor parallelism over the "model" mesh axis.
+
+    Megatron-style: qkv/ff1 column-parallel, attn_out/ff2 row-parallel —
+    GSPMD inserts the reduce on the model axis automatically.
+    """
+    layer = {
+        "ln1": P(),
+        "ln2": P(),
+        "qkv": P(None, "model"),
+        "attn_out": P("model", None),
+        "ff1": P(None, "model"),
+        "ff2": P("model", None),
+    }
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "out_norm": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def _rmsnorm(x, gamma):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * scale * gamma).astype(x.dtype)
+
+
+def _attention(x, layer, cfg: BurninConfig):
+    b, s, d = x.shape
+    qkv = x @ layer["qkv"].astype(x.dtype)            # [b, s, 3d] — MXU
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (cfg.head_dim ** 0.5)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
+    return ctx @ layer["attn_out"].astype(x.dtype)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: BurninConfig) -> jax.Array:
+    """Token ids [batch, seq] → logits [batch, seq, vocab] in bf16 compute."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"][tokens].astype(dtype) + params["pos"][: tokens.shape[1]].astype(dtype)
+    for layer in params["layers"]:
+        x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, cfg)
+        h = _rmsnorm(x, layer["ln2"])
+        h = jax.nn.gelu(h @ layer["ff1"].astype(dtype))
+        x = x + h @ layer["ff2"].astype(dtype)
+    x = _rmsnorm(x, params["out_norm"])
+    return (x @ params["embed"].T.astype(dtype)).astype(jnp.float32)
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: BurninConfig) -> jax.Array:
+    """Next-token cross entropy (shift-by-one on the same sequence)."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return nll.mean()
+
+
+def make_train_step(cfg: BurninConfig, lr: float = 1e-3):
+    """SGD train step as a pure function (params, tokens) → (params, loss).
+
+    Kept optimizer-minimal on purpose: the workload's job is to light up the
+    MXU and the ICI, not to converge.
+    """
+
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return params, loss
+
+    return step
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: BurninConfig) -> dict:
+    """Place params on the mesh per the tensor-parallel rules."""
+    rules = param_sharding_rules(cfg)
+    return jax.tree.map(
+        lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
+        params,
+        rules,
+        is_leaf=lambda x: isinstance(x, P),
+    )

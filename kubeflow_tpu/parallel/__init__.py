@@ -1,0 +1,15 @@
+"""Mesh / sharding helpers for multi-host TPU slices.
+
+The control plane wires ``TPU_WORKER_*`` + the jax.distributed coordinator
+(see ``kubeflow_tpu.tpu.topology``); this package is the in-notebook half:
+building a ``jax.sharding.Mesh`` over the slice and sharding the validation
+workloads (and user models) across it.
+"""
+
+from kubeflow_tpu.parallel.mesh import (
+    MeshPlan,
+    make_mesh,
+    plan_mesh,
+)
+
+__all__ = ["MeshPlan", "make_mesh", "plan_mesh"]

@@ -1,0 +1,58 @@
+"""Device-mesh planning for TPU slices.
+
+TPU-first design note: rather than translating any NCCL/MPI-style process
+groups, parallelism is expressed as a named ``jax.sharding.Mesh`` whose axes
+XLA lowers to ICI collectives. ``plan_mesh`` picks a (data, model) factoring
+of the available devices; callers annotate shardings and let GSPMD insert
+``all-reduce``/``all-gather`` on the right axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A chosen factoring of devices into named parallelism axes."""
+
+    data: int
+    model: int
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.model
+
+
+def plan_mesh(n_devices: int, max_model: int = 8) -> MeshPlan:
+    """Factor ``n_devices`` into (data, model) with the largest model axis
+    that divides the device count and stays ≤ ``max_model``.
+
+    Model (tensor) parallelism rides the fastest ICI links, so we prefer a
+    wider model axis up to one host's chips; the rest becomes data parallel.
+    """
+    if n_devices < 1:
+        raise ValueError("need at least one device")
+    model = 1
+    for cand in range(min(max_model, n_devices), 0, -1):
+        if n_devices % cand == 0:
+            model = cand
+            break
+    return MeshPlan(data=n_devices // model, model=model)
+
+
+def make_mesh(devices=None, plan: MeshPlan | None = None) -> Mesh:
+    """Build a ("data", "model") mesh over ``devices`` (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if plan is None:
+        plan = plan_mesh(len(devices))
+    if plan.n_devices != len(devices):
+        raise ValueError(f"plan {plan} does not cover {len(devices)} devices")
+    grid = np.asarray(devices).reshape(plan.data, plan.model)
+    return Mesh(grid, axis_names=("data", "model"))

@@ -1,0 +1,24 @@
+"""TPU topology library — the single source of truth for accelerator decisions.
+
+Where the reference scatters GPU knowledge across a spawner YAML
+(``crud-web-apps/jupyter/backend/apps/common/yaml/spawner_ui_config.yaml:120-141``,
+vendor limitsKeys like ``nvidia.com/gpu``) and env-var plumbing, this package
+centralises every TPU-specific mapping: accelerator generation + topology →
+(#hosts, chips/host, GKE node selectors, ``TPU_WORKER_*`` env, resource requests).
+"""
+
+from kubeflow_tpu.tpu.topology import (
+    ACCELERATORS,
+    TpuAccelerator,
+    TpuSlice,
+    TopologyError,
+    parse_topology,
+)
+
+__all__ = [
+    "ACCELERATORS",
+    "TpuAccelerator",
+    "TpuSlice",
+    "TopologyError",
+    "parse_topology",
+]

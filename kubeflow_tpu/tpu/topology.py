@@ -1,0 +1,298 @@
+"""Pure TPU slice topology math.
+
+This is the TPU-native replacement for the reference's accelerator plumbing
+(GPU vendor limitsKeys in ``spawner_ui_config.yaml:120-141`` and the gpu form
+setter in ``crud-web-apps/jupyter/backend/apps/common/form.py``): a single pure
+library that maps ``(accelerator, topology)`` to everything the control plane
+needs — host count (StatefulSet replicas), chips per host (``google.com/tpu``
+requests), GKE node selectors, ``TPU_WORKER_*`` environment, and stable worker
+hostnames for ``jax.distributed.initialize``.
+
+Everything here is pure and unit-testable; no Kubernetes imports.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+# GKE well-known labels/resources for TPU scheduling.
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+# Port our controllers wire for jax.distributed coordinator (DCN bootstrap).
+JAX_COORDINATOR_PORT = 8476
+
+
+class TopologyError(ValueError):
+    """Invalid accelerator/topology combination."""
+
+
+@dataclass(frozen=True)
+class TpuAccelerator:
+    """Static facts about one TPU generation.
+
+    Peak numbers are approximate public figures used only for bandwidth /
+    utilisation *estimates* in diagnostics (never for scheduling decisions).
+    """
+
+    name: str                      # short name used in our CRD: "v4", "v5e", "v5p", "v6e"
+    gke_accelerator: str           # value for cloud.google.com/gke-tpu-accelerator
+    host_bounds: tuple[int, ...]   # chip grid of one host, e.g. (2, 4) or (2, 2, 1)
+    cores_per_chip: int            # TensorCores per chip (accelerator_type counts cores)
+    hbm_gib_per_chip: int
+    peak_bf16_tflops_per_chip: float
+    hbm_gbps_per_chip: float       # HBM bandwidth, GB/s
+    ici_gbps_per_link: float       # one-way ICI bandwidth per link, GB/s (approx)
+    topologies: tuple[str, ...]    # GKE-documented topology strings
+    accelerator_type_prefix: str = ""  # e.g. "v5litepod" -> accelerator_type "v5litepod-16"
+
+    @property
+    def ndim(self) -> int:
+        return len(self.host_bounds)
+
+    @property
+    def chips_per_full_host(self) -> int:
+        return math.prod(self.host_bounds)
+
+    def accelerator_type(self, num_chips: int) -> str:
+        """GCE-style accelerator type string, which counts *cores*: v4-8 = 4 chips."""
+        prefix = self.accelerator_type_prefix or self.name
+        return f"{prefix}-{num_chips * self.cores_per_chip}"
+
+
+ACCELERATORS: dict[str, TpuAccelerator] = {
+    acc.name: acc
+    for acc in (
+        TpuAccelerator(
+            name="v4",
+            gke_accelerator="tpu-v4-podslice",
+            host_bounds=(2, 2, 1),
+            cores_per_chip=2,
+            hbm_gib_per_chip=32,
+            peak_bf16_tflops_per_chip=275.0,
+            hbm_gbps_per_chip=1228.0,
+            ici_gbps_per_link=50.0,
+            topologies=(
+                "2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8",
+                "4x8x8", "8x8x8", "8x8x12", "8x8x16", "8x16x16",
+            ),
+        ),
+        TpuAccelerator(
+            name="v5e",
+            gke_accelerator="tpu-v5-lite-podslice",
+            host_bounds=(2, 4),
+            cores_per_chip=1,
+            hbm_gib_per_chip=16,
+            peak_bf16_tflops_per_chip=197.0,
+            hbm_gbps_per_chip=819.0,
+            ici_gbps_per_link=50.0,
+            topologies=("1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"),
+            accelerator_type_prefix="v5litepod",
+        ),
+        TpuAccelerator(
+            name="v5p",
+            gke_accelerator="tpu-v5p-slice",
+            host_bounds=(2, 2, 1),
+            cores_per_chip=2,
+            hbm_gib_per_chip=95,
+            peak_bf16_tflops_per_chip=459.0,
+            hbm_gbps_per_chip=2765.0,
+            ici_gbps_per_link=100.0,
+            topologies=(
+                "2x2x1", "2x2x2", "2x4x4", "4x4x4", "4x4x8", "4x8x8",
+                "8x8x8", "8x8x16", "8x16x16", "16x16x16", "16x16x24",
+            ),
+        ),
+        TpuAccelerator(
+            name="v6e",
+            gke_accelerator="tpu-v6e-slice",
+            host_bounds=(2, 4),
+            cores_per_chip=1,
+            hbm_gib_per_chip=32,
+            peak_bf16_tflops_per_chip=918.0,
+            hbm_gbps_per_chip=1640.0,
+            ici_gbps_per_link=100.0,
+            topologies=("1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"),
+        ),
+    )
+}
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """Parse "4x4" / "2x2x2" into an int tuple."""
+    try:
+        dims = tuple(int(part) for part in topology.lower().split("x"))
+    except ValueError:
+        raise TopologyError(f"malformed topology {topology!r}") from None
+    if not dims or any(d < 1 for d in dims):
+        raise TopologyError(f"malformed topology {topology!r}")
+    return dims
+
+
+@dataclass(frozen=True)
+class TpuSlice:
+    """A resolved (accelerator, topology) pair with all derived scheduling facts.
+
+    The controller uses this to size the StatefulSet (``num_hosts``), the
+    webhook uses it to inject worker env, and the web apps use it to render
+    accelerator pickers — one shared source of truth.
+    """
+
+    accelerator: TpuAccelerator
+    topology: tuple[int, ...]
+    topology_str: str = field(default="", compare=False)
+
+    @classmethod
+    def parse(cls, accelerator: str, topology: str, *, strict: bool = False) -> "TpuSlice":
+        """Resolve an accelerator name + topology string.
+
+        With ``strict=True`` only GKE-documented topologies are accepted;
+        otherwise any grid that tiles into full hosts (or fits in one host)
+        validates, which keeps the library future-proof for new slice shapes.
+        """
+        acc = ACCELERATORS.get(accelerator.lower())
+        if acc is None:
+            raise TopologyError(
+                f"unknown accelerator {accelerator!r}; known: {sorted(ACCELERATORS)}"
+            )
+        dims = parse_topology(topology)
+        if len(dims) != acc.ndim:
+            raise TopologyError(
+                f"{acc.name} topologies are {acc.ndim}-D, got {topology!r}"
+            )
+        if strict and topology.lower() not in acc.topologies:
+            raise TopologyError(
+                f"{topology!r} is not a documented {acc.name} topology; "
+                f"known: {acc.topologies}"
+            )
+        slice_ = cls(accelerator=acc, topology=dims, topology_str=topology.lower())
+        slice_._validate()
+        return slice_
+
+    def _validate(self) -> None:
+        chips = self.num_chips
+        if chips <= self.accelerator.chips_per_full_host:
+            # Sub-host (or exactly one host) slice: must fit the host grid.
+            if any(
+                d > b for d, b in zip(sorted(self.topology), sorted(self.accelerator.host_bounds))
+            ):
+                raise TopologyError(
+                    f"topology {self.topology_str} does not fit one "
+                    f"{self.accelerator.name} host {self.accelerator.host_bounds}"
+                )
+        else:
+            # Multi-host slice: every axis must tile into full hosts.
+            for d, b in zip(self.topology, self.accelerator.host_bounds):
+                if d % b != 0:
+                    raise TopologyError(
+                        f"multi-host topology {self.topology_str} must be a multiple of "
+                        f"the host grid {self.accelerator.host_bounds} on every axis"
+                    )
+
+    # ---- derived scheduling facts -------------------------------------------------
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.topology)
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.accelerator.chips_per_full_host)
+
+    @property
+    def chips_per_host(self) -> int:
+        return min(self.num_chips, self.accelerator.chips_per_full_host)
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def accelerator_type(self) -> str:
+        return self.accelerator.accelerator_type(self.num_chips)
+
+    def host_grid(self) -> tuple[int, ...]:
+        """How hosts tile the chip grid, per axis (all 1s for single-host)."""
+        if not self.multi_host:
+            return tuple(1 for _ in self.topology)
+        return tuple(d // b for d, b in zip(self.topology, self.accelerator.host_bounds))
+
+    def chips_per_host_bounds(self) -> tuple[int, ...]:
+        """Per-axis chip grid of one host's share of the slice."""
+        if not self.multi_host:
+            return self.topology
+        return self.accelerator.host_bounds
+
+    # ---- Kubernetes-facing outputs ------------------------------------------------
+
+    def node_selectors(self) -> dict[str, str]:
+        return {
+            GKE_TPU_ACCELERATOR_LABEL: self.accelerator.gke_accelerator,
+            GKE_TPU_TOPOLOGY_LABEL: self.topology_str,
+        }
+
+    def resource_requests(self) -> dict[str, str]:
+        """Per-pod resources: each worker pod takes its host's whole chip share."""
+        return {TPU_RESOURCE: str(self.chips_per_host)}
+
+    def worker_hostnames(
+        self, name: str, headless_service: str, namespace: str,
+        cluster_domain: str = "cluster.local",
+    ) -> list[str]:
+        """Stable per-worker DNS names via the headless Service.
+
+        StatefulSet pods ``<name>-<i>`` get
+        ``<name>-<i>.<headless-svc>.<ns>.svc.<domain>`` — this is the
+        TPU_WORKER_HOSTNAMES / jax.distributed bootstrap contract.
+        """
+        return [
+            f"{name}-{i}.{headless_service}.{namespace}.svc.{cluster_domain}"
+            for i in range(self.num_hosts)
+        ]
+
+    def worker_env(self, worker_id: int, hostnames: list[str]) -> dict[str, str]:
+        """libtpu + JAX environment for worker ``worker_id`` of the slice.
+
+        TPU-native replacement for the CUDA env the reference's images inherit
+        from their base layers: everything libtpu needs to wire ICI from
+        topology, plus the DCN coordinator for jax.distributed.
+        """
+        if not 0 <= worker_id < self.num_hosts:
+            raise TopologyError(
+                f"worker_id {worker_id} out of range for {self.num_hosts}-host slice"
+            )
+        env = {
+            "TPU_WORKER_ID": str(worker_id),
+            "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+            "TPU_CHIPS_PER_HOST_BOUNDS": ",".join(str(d) for d in self.chips_per_host_bounds()),
+            "TPU_HOST_BOUNDS": ",".join(str(d) for d in self.host_grid()),
+            "TPU_ACCELERATOR_TYPE": self.accelerator_type,
+            "TPU_SKIP_MDS_QUERY": "true",  # pods have no GCE metadata server
+            "TPU_TOPOLOGY": self.topology_str,
+        }
+        if hostnames:
+            env["JAX_COORDINATOR_ADDRESS"] = f"{hostnames[0]}:{JAX_COORDINATOR_PORT}"
+            env["JAX_NUM_PROCESSES"] = str(self.num_hosts)
+            env["JAX_PROCESS_ID"] = str(worker_id)
+        return env
+
+    # ---- diagnostics estimates ----------------------------------------------------
+
+    def peak_bf16_tflops(self) -> float:
+        return self.num_chips * self.accelerator.peak_bf16_tflops_per_chip
+
+    def allreduce_algo_bandwidth_gbps(self) -> float:
+        """Approximate achievable all-reduce algorithm bandwidth over ICI.
+
+        Ring all-reduce moves ``2*(k-1)/k`` bytes per byte reduced; on a torus
+        each chip drives one link per ring direction. Used by the ICI probe to
+        score "fraction of peak" (north-star metric, BASELINE.md).
+        """
+        k = self.num_chips
+        if k <= 1:
+            return float("inf")
+        link = self.accelerator.ici_gbps_per_link
+        # Bidirectional ring over the largest torus dimension as a floor estimate.
+        return link * 2 * k / (2 * (k - 1))

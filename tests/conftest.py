@@ -1,0 +1,41 @@
+"""Test harness configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): control-plane tests run
+against the in-memory fake apiserver (our envtest), and TPU-path tests run on a
+virtual 8-device CPU mesh so multi-chip sharding is exercised without TPUs.
+"""
+
+import asyncio
+import inspect
+import os
+
+# Must be set before jax initialises its backends. The image's sitecustomize
+# registers the TPU plugin regardless of JAX_PLATFORMS, so we also override
+# via jax.config below.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests natively (no pytest-asyncio in this image)."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        sig = inspect.signature(func)
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in sig.parameters
+            if name in pyfuncitem.funcargs
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None

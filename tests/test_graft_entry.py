@@ -1,0 +1,19 @@
+"""Smoke tests for the driver entry points on the virtual 8-device CPU mesh."""
+
+import jax
+
+from __graft_entry__ import dryrun_multichip, entry
+
+
+def test_entry_compiles_and_runs():
+    fn, (params, tokens) = entry()
+    out = jax.jit(fn)(params, tokens)
+    assert out.shape == (tokens.shape[0], tokens.shape[1], 256)
+
+
+def test_dryrun_multichip_8():
+    dryrun_multichip(8)
+
+
+def test_dryrun_multichip_4():
+    dryrun_multichip(4)

@@ -1,0 +1,135 @@
+"""Unit tests for the pure TPU topology library (SURVEY.md §7 step 1)."""
+
+import pytest
+
+from kubeflow_tpu.tpu import ACCELERATORS, TopologyError, TpuSlice, parse_topology
+
+
+def test_parse_topology():
+    assert parse_topology("4x4") == (4, 4)
+    assert parse_topology("2x2x2") == (2, 2, 2)
+    assert parse_topology("1x1") == (1, 1)
+    with pytest.raises(TopologyError):
+        parse_topology("4x")
+    with pytest.raises(TopologyError):
+        parse_topology("0x4")
+    with pytest.raises(TopologyError):
+        parse_topology("abc")
+
+
+def test_unknown_accelerator():
+    with pytest.raises(TopologyError, match="unknown accelerator"):
+        TpuSlice.parse("h100", "4x4")
+
+
+def test_dim_mismatch():
+    with pytest.raises(TopologyError, match="2-D"):
+        TpuSlice.parse("v5e", "2x2x2")
+    with pytest.raises(TopologyError, match="3-D"):
+        TpuSlice.parse("v5p", "4x4")
+
+
+@pytest.mark.parametrize(
+    "acc,topo,chips,hosts,chips_per_host",
+    [
+        ("v5e", "1x1", 1, 1, 1),
+        ("v5e", "2x2", 4, 1, 4),
+        ("v5e", "2x4", 8, 1, 8),
+        ("v5e", "4x4", 16, 2, 8),
+        ("v5e", "4x8", 32, 4, 8),
+        ("v5e", "16x16", 256, 32, 8),
+        ("v5p", "2x2x1", 4, 1, 4),
+        ("v5p", "2x2x2", 8, 2, 4),
+        ("v5p", "2x4x4", 32, 8, 4),
+        ("v5p", "4x4x4", 64, 16, 4),
+        ("v4", "2x2x1", 4, 1, 4),
+        ("v4", "2x2x4", 16, 4, 4),
+        ("v6e", "2x4", 8, 1, 8),
+        ("v6e", "8x8", 64, 8, 8),
+    ],
+)
+def test_slice_math(acc, topo, chips, hosts, chips_per_host):
+    s = TpuSlice.parse(acc, topo)
+    assert s.num_chips == chips
+    assert s.num_hosts == hosts
+    assert s.chips_per_host == chips_per_host
+    assert s.multi_host == (hosts > 1)
+
+
+def test_invalid_multihost_tiling():
+    # 3x4 is not a multiple of the (2,4) v5e host grid on axis 0.
+    with pytest.raises(TopologyError):
+        TpuSlice.parse("v5e", "3x4")
+    # 2x3x4 breaks the (2,2,1) v5p host grid on axis 1.
+    with pytest.raises(TopologyError):
+        TpuSlice.parse("v5p", "2x3x4")
+    # 2x2x3 tiles legally (3 full hosts along z) even though undocumented.
+    assert TpuSlice.parse("v5p", "2x2x3").num_hosts == 3
+
+
+def test_subhost_must_fit():
+    with pytest.raises(TopologyError):
+        TpuSlice.parse("v5e", "1x5")  # 5 chips won't fit a 2x4 host on one axis
+
+
+def test_strict_mode():
+    TpuSlice.parse("v5e", "4x4", strict=True)
+    with pytest.raises(TopologyError, match="documented"):
+        TpuSlice.parse("v5e", "2x8", strict=True)
+
+
+def test_accelerator_type_counts_cores():
+    assert TpuSlice.parse("v5e", "4x4").accelerator_type == "v5litepod-16"
+    assert TpuSlice.parse("v5p", "2x2x2").accelerator_type == "v5p-16"  # 8 chips x 2 cores
+    assert TpuSlice.parse("v4", "2x2x1").accelerator_type == "v4-8"
+    assert TpuSlice.parse("v6e", "2x4").accelerator_type == "v6e-8"
+
+
+def test_node_selectors_and_resources():
+    s = TpuSlice.parse("v5e", "4x4")
+    assert s.node_selectors() == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "4x4",
+    }
+    assert s.resource_requests() == {"google.com/tpu": "8"}
+
+
+def test_worker_hostnames_and_env():
+    s = TpuSlice.parse("v5p", "2x2x2")  # 2 hosts
+    names = s.worker_hostnames("nb", "nb-workers", "team-a")
+    assert names == [
+        "nb-0.nb-workers.team-a.svc.cluster.local",
+        "nb-1.nb-workers.team-a.svc.cluster.local",
+    ]
+    env = s.worker_env(1, names)
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == ",".join(names)
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert env["TPU_HOST_BOUNDS"] == "1,1,2"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+    assert env["JAX_COORDINATOR_ADDRESS"].startswith("nb-0.nb-workers.team-a.svc")
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "1"
+    with pytest.raises(TopologyError):
+        s.worker_env(2, names)
+
+
+def test_subhost_bounds_are_own_topology():
+    s = TpuSlice.parse("v5e", "2x2")
+    env = s.worker_env(0, s.worker_hostnames("nb", "svc", "ns"))
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2"
+    assert env["TPU_HOST_BOUNDS"] == "1,1"
+
+
+def test_all_documented_topologies_validate():
+    for acc in ACCELERATORS.values():
+        for topo in acc.topologies:
+            s = TpuSlice.parse(acc.name, topo, strict=True)
+            assert s.num_chips >= 1
+            assert s.num_hosts * s.chips_per_host == s.num_chips
+
+
+def test_diagnostics_estimates():
+    s = TpuSlice.parse("v5e", "2x4")
+    assert s.peak_bf16_tflops() == pytest.approx(8 * 197.0)
+    assert s.allreduce_algo_bandwidth_gbps() > 0
