@@ -1,0 +1,35 @@
+"""Controller runtime, built from scratch for this stack.
+
+The reference leans on controller-runtime (Go); this package is its
+asyncio-native equivalent: a typed scheme, list/watch informers with local
+caches, rate-limited workqueues, reconciler workers, create-or-update apply
+helpers with drift detection, an event recorder, and Prometheus-text metrics.
+Controllers talk to any object implementing the ``KubeApi`` protocol — the
+real apiserver over HTTPS (``httpclient.HttpKube``) or the in-memory fake
+(``kubeflow_tpu.testing.fakekube.FakeKube``, our envtest).
+"""
+
+from kubeflow_tpu.runtime.errors import ApiError, Conflict, Forbidden, NotFound
+from kubeflow_tpu.runtime.objects import (
+    controller_owner,
+    get_meta,
+    new_object,
+    owned_by,
+    set_controller_owner,
+)
+from kubeflow_tpu.runtime.scheme import Scheme, GVK, DEFAULT_SCHEME
+
+__all__ = [
+    "ApiError",
+    "Conflict",
+    "Forbidden",
+    "NotFound",
+    "Scheme",
+    "GVK",
+    "DEFAULT_SCHEME",
+    "controller_owner",
+    "get_meta",
+    "new_object",
+    "owned_by",
+    "set_controller_owner",
+]

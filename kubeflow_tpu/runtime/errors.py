@@ -1,0 +1,57 @@
+"""API error taxonomy mirroring Kubernetes StatusReasons."""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    """Base error for apiserver interactions."""
+
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+
+class NotFound(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExists(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class Conflict(ApiError):
+    """resourceVersion conflict — caller should re-read and retry."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class Invalid(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class Forbidden(ApiError):
+    code = 403
+    reason = "Forbidden"
+
+
+class Unauthorized(ApiError):
+    code = 401
+    reason = "Unauthorized"
+
+
+def error_for_code(code: int, message: str = "") -> ApiError:
+    for cls in (NotFound, AlreadyExists, Invalid, Forbidden, Unauthorized):
+        if cls.code == code:
+            return cls(message)
+    if code == 409:
+        return Conflict(message)
+    err = ApiError(message)
+    err.code = code
+    return err

@@ -1,0 +1,72 @@
+"""Event recorder: create/aggregate v1 Events on objects.
+
+The reference re-emits pod/STS events onto Notebook CRs so the UI can surface
+them (``notebook_controller.go:94-123``); this recorder provides the emit
+side, with count aggregation like client-go's EventRecorder.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+from kubeflow_tpu.runtime.errors import ApiError, NotFound
+from kubeflow_tpu.runtime.objects import name_of, namespace_of, uid_of
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class EventRecorder:
+    def __init__(self, kube, component: str):
+        self.kube = kube
+        self.component = component
+
+    async def event(
+        self, obj: dict, event_type: str, reason: str, message: str
+    ) -> None:
+        namespace = namespace_of(obj) or "default"
+        ref = {
+            "apiVersion": obj.get("apiVersion"),
+            "kind": obj.get("kind"),
+            "name": name_of(obj),
+            "namespace": namespace_of(obj),
+            "uid": uid_of(obj),
+        }
+        digest = hashlib.sha1(
+            f"{ref['kind']}/{ref['namespace']}/{ref['name']}/{reason}/{message}".encode()
+        ).hexdigest()[:10]
+        name = f"{name_of(obj)}.{digest}"
+        try:
+            existing = await self.kube.get("Event", name, namespace)
+        except NotFound:
+            existing = None
+        if existing:
+            try:
+                await self.kube.patch(
+                    "Event",
+                    name,
+                    {"count": existing.get("count", 1) + 1, "lastTimestamp": _now()},
+                    namespace,
+                )
+                return
+            except ApiError:
+                return
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": name, "namespace": namespace},
+            "involvedObject": ref,
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "source": {"component": self.component},
+            "firstTimestamp": _now(),
+            "lastTimestamp": _now(),
+            "count": 1,
+        }
+        try:
+            await self.kube.create("Event", event)
+        except ApiError:
+            pass  # events are best-effort

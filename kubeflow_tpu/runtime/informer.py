@@ -1,0 +1,106 @@
+"""List/watch informer with a local cache.
+
+Mirrors client-go's shared informer: an initial list primes the cache, a watch
+streams deltas, and registered handlers receive (event, obj). On watch failure
+the informer relists (resync-on-error), which is all the reference stack needs
+(controller-runtime does the same under the hood).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from kubeflow_tpu.runtime.objects import key_of, name_of, namespace_of
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[str, dict], None]
+
+
+class Informer:
+    def __init__(
+        self,
+        kube,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: str | dict | None = None,
+        resync_backoff: float = 1.0,
+    ):
+        self.kube = kube
+        self.kind = kind
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.resync_backoff = resync_backoff
+        self.cache: dict[tuple[str | None, str], dict] = {}
+        self._handlers: list[Handler] = []
+        self._task: asyncio.Task | None = None
+        self._synced = asyncio.Event()
+
+    def add_handler(self, fn: Handler) -> None:
+        self._handlers.append(fn)
+
+    def get(self, name: str, namespace: str | None = None) -> dict | None:
+        return self.cache.get((namespace, name))
+
+    def items(self) -> list[dict]:
+        return list(self.cache.values())
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name=f"informer-{self.kind}")
+        await self._synced.wait()
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    def _dispatch(self, event: str, obj: dict) -> None:
+        for fn in self._handlers:
+            try:
+                fn(event, obj)
+            except Exception:
+                log.exception("informer handler failed for %s %s", self.kind, key_of(obj))
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                objs, rv = await self.kube.list_with_rv(
+                    self.kind, self.namespace, self.label_selector
+                )
+                fresh = {key_of(o): o for o in objs}
+                for key, obj in list(self.cache.items()):
+                    if key not in fresh:
+                        del self.cache[key]
+                        self._dispatch("DELETED", obj)
+                for key, obj in fresh.items():
+                    existed = key in self.cache
+                    self.cache[key] = obj
+                    self._dispatch("MODIFIED" if existed else "ADDED", obj)
+                self._synced.set()
+                # resource_version threads the list's snapshot into the watch
+                # so deletes between list and watch are never missed; a 410
+                # Gone (or any error) falls through to a relist.
+                async for event, obj in self.kube.watch(
+                    self.kind,
+                    self.namespace,
+                    self.label_selector,
+                    send_initial=False,
+                    resource_version=rv,
+                ):
+                    key = (namespace_of(obj), name_of(obj))
+                    if event == "DELETED":
+                        self.cache.pop(key, None)
+                    else:
+                        self.cache[key] = obj
+                    self._dispatch(event, obj)
+                # watch closed cleanly → relist
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("informer %s list/watch failed; relisting", self.kind)
+            await asyncio.sleep(self.resync_backoff)

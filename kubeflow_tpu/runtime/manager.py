@@ -1,0 +1,174 @@
+"""Controller manager: informers + workqueues + reconciler workers.
+
+The asyncio equivalent of controller-runtime's Manager/Builder:
+
+    mgr = Manager(kube)
+    mgr.add_controller(
+        Controller("notebook", "Notebook", reconciler.reconcile,
+                   owns=["StatefulSet", "Service"],
+                   watches=[Watch("Pod", map_fn=pod_to_notebook)]))
+    await mgr.start()
+
+``owns=`` maps child events to the controller owner (the reference's
+``Owns(&appsv1.StatefulSet{})``); ``watches=`` takes an explicit mapping fn
+(the reference's ``handler.EnqueueRequestsFromMapFunc``, e.g. pod events by
+``notebook-name`` label, ``notebook_controller.go:739-787``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from kubeflow_tpu.runtime.informer import Informer
+from kubeflow_tpu.runtime.metrics import Registry, global_registry
+from kubeflow_tpu.runtime.objects import controller_of, name_of, namespace_of
+from kubeflow_tpu.runtime.queue import RateLimitedQueue
+
+log = logging.getLogger(__name__)
+
+Key = tuple  # (namespace | None, name)
+ReconcileFn = Callable[[Key], Awaitable["Result | None"]]
+MapFn = Callable[[dict], list[Key]]
+
+
+@dataclass(frozen=True)
+class Result:
+    requeue_after: float | None = None
+
+
+@dataclass
+class Watch:
+    kind: str
+    map_fn: MapFn
+    label_selector: str | dict | None = None
+
+
+@dataclass
+class Controller:
+    name: str
+    kind: str
+    reconcile: ReconcileFn
+    owns: list[str] = field(default_factory=list)
+    watches: list[Watch] = field(default_factory=list)
+    workers: int = 2
+    label_selector: str | dict | None = None
+
+
+class Manager:
+    def __init__(self, kube, *, registry: Registry | None = None, namespace: str | None = None):
+        self.kube = kube
+        self.namespace = namespace
+        self.registry = registry or global_registry
+        self.controllers: list[Controller] = []
+        self.informers: dict[tuple[str, str | None], Informer] = {}
+        self._queues: dict[str, RateLimitedQueue] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._reconcile_total = self.registry.counter(
+            "controller_reconcile_total", "Reconciles per controller", ["controller", "result"]
+        )
+        self._queue_depth = self.registry.gauge(
+            "controller_queue_depth", "Workqueue depth", ["controller"]
+        )
+
+    def informer_for(
+        self, kind: str, label_selector: str | dict | None = None
+    ) -> Informer:
+        key = (kind, str(label_selector) if label_selector else None)
+        if key not in self.informers:
+            self.informers[key] = Informer(
+                self.kube, kind, namespace=self.namespace, label_selector=label_selector
+            )
+        return self.informers[key]
+
+    def add_controller(self, ctrl: Controller) -> None:
+        self.controllers.append(ctrl)
+        queue = RateLimitedQueue()
+        self._queues[ctrl.name] = queue
+
+        primary = self.informer_for(ctrl.kind, ctrl.label_selector)
+        primary.add_handler(lambda _e, obj: queue.add((namespace_of(obj), name_of(obj))))
+
+        def owner_handler(_event: str, obj: dict) -> None:
+            ref = controller_of(obj)
+            if ref and ref.get("kind") == ctrl.kind:
+                queue.add((namespace_of(obj), ref["name"]))
+
+        for child_kind in ctrl.owns:
+            self.informer_for(child_kind).add_handler(owner_handler)
+
+        for watch in ctrl.watches:
+            inf = self.informer_for(watch.kind, watch.label_selector)
+
+            def mapped_handler(_event: str, obj: dict, _map=watch.map_fn) -> None:
+                for key in _map(obj) or []:
+                    queue.add(tuple(key))
+
+            inf.add_handler(mapped_handler)
+
+    async def start(self) -> None:
+        for informer in self.informers.values():
+            await informer.start()
+        for ctrl in self.controllers:
+            for i in range(ctrl.workers):
+                self._tasks.append(
+                    asyncio.create_task(
+                        self._worker(ctrl, self._queues[ctrl.name]),
+                        name=f"{ctrl.name}-worker-{i}",
+                    )
+                )
+
+    async def stop(self) -> None:
+        for queue in self._queues.values():
+            queue.shutdown()
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for informer in self.informers.values():
+            await informer.stop()
+
+    async def wait_idle(self, timeout: float = 10.0, settle: float = 0.05) -> None:
+        """Test helper: wait until all queues drain and stay drained."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if all(len(q) == 0 and not q._in_flight for q in self._queues.values()):
+                await asyncio.sleep(settle)
+                if all(len(q) == 0 and not q._in_flight for q in self._queues.values()):
+                    return
+            await asyncio.sleep(0.01)
+        raise TimeoutError("manager queues did not drain")
+
+    async def _worker(self, ctrl: Controller, queue: RateLimitedQueue) -> None:
+        while True:
+            key = await queue.get()
+            if key is None:
+                return
+            self._queue_depth.labels(controller=ctrl.name).set(len(queue))
+            try:
+                result = await ctrl.reconcile(key)
+            except Exception:
+                log.exception("reconcile %s %s failed", ctrl.name, key)
+                self._reconcile_total.labels(controller=ctrl.name, result="error").inc()
+                # Record the failure BEFORE done(): if the key went dirty in
+                # flight, done() re-queues it with this failure's backoff.
+                queue.note_failure(key)
+                queue.done(key)
+                queue.add(key, queue.backoff_delay(key))
+            else:
+                queue.forget(key)
+                self._reconcile_total.labels(controller=ctrl.name, result="success").inc()
+                # done() BEFORE the delayed re-add: adding while the key is
+                # still in flight would mark it dirty and done() would then
+                # re-add it with no delay — a hot requeue loop.
+                queue.done(key)
+                if result and result.requeue_after:
+                    queue.add(key, result.requeue_after)
+            # Fairness: FakeKube awaits are often non-blocking, so guarantee
+            # the event loop runs between reconciles even in a hot loop.
+            await asyncio.sleep(0)

@@ -1,0 +1,150 @@
+"""Minimal Prometheus client (text exposition format).
+
+The reference registers custom collectors with controller-runtime's registry
+(``notebook-controller/pkg/metrics/metrics.go:14-99``). No prometheus client
+ships in this image, so this is a from-scratch implementation of the 20% we
+use: counters, gauges, histograms, labels, and text-format exposition.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Child:
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+
+class _Metric:
+    type_name = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: list[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._children: dict[tuple, _Child] = defaultdict(_Child)
+
+    def labels(self, **labels: str) -> _Child:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        return self._children[key]
+
+    # convenience for label-less metrics
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def collect(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.type_name}",
+        ]
+        children = self._children or {(): _Child()}
+        for key, child in sorted(children.items()):
+            labels = dict(zip(self.label_names, key))
+            lines.append(f"{self.name}{_fmt_labels(labels)} {child.value}")
+        return lines
+
+
+class Counter(_Metric):
+    type_name = "counter"
+
+
+class Gauge(_Metric):
+    type_name = "gauge"
+
+
+class Histogram(_Metric):
+    type_name = "histogram"
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
+
+    def __init__(self, name, help_, label_names, buckets=None):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._data: dict[tuple, dict] = defaultdict(
+            lambda: {"counts": [0] * len(self.buckets), "sum": 0.0, "count": 0}
+        )
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            data = self._data[key]
+            data["sum"] += value
+            data["count"] += 1
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    data["counts"][i] += 1
+                    break  # collect() cumulates; counting once keeps buckets monotone
+
+    def collect(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        for key, data in sorted(self._data.items()):
+            labels = dict(zip(self.label_names, key))
+            cumulative = 0
+            for bound, count in zip(self.buckets, data["counts"]):
+                cumulative += count
+                lines.append(
+                    f'{self.name}_bucket{_fmt_labels({**labels, "le": str(bound)})} {cumulative}'
+                )
+            lines.append(f'{self.name}_bucket{_fmt_labels({**labels, "le": "+Inf"})} {data["count"]}')
+            lines.append(f"{self.name}_sum{_fmt_labels(labels)} {data['sum']}")
+            lines.append(f"{self.name}_count{_fmt_labels(labels)} {data['count']}")
+        return lines
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, cls, name, help_, label_names, **kw):
+        with self._lock:
+            if name in self._metrics:
+                return self._metrics[name]
+            metric = cls(name, help_, label_names or [], **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help_: str = "", label_names: list[str] | None = None) -> Counter:
+        return self._register(Counter, name, help_, label_names)
+
+    def gauge(self, name: str, help_: str = "", label_names: list[str] | None = None) -> Gauge:
+        return self._register(Gauge, name, help_, label_names)
+
+    def histogram(
+        self, name: str, help_: str = "", label_names: list[str] | None = None, buckets=None
+    ) -> Histogram:
+        return self._register(Histogram, name, help_, label_names, buckets=buckets)
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        for metric in self._metrics.values():
+            lines.extend(metric.collect())
+        return "\n".join(lines) + "\n"
+
+
+global_registry = Registry()

@@ -1,0 +1,92 @@
+"""Rate-limited workqueue (client-go semantics, asyncio-native).
+
+Deduplicates keys while queued, tracks in-flight keys so a key re-added during
+processing is re-queued afterwards, and applies per-item exponential backoff —
+the behaviors the reference's hot loop depends on (every pod event maps back
+to a Notebook reconcile, SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from typing import Hashable
+
+
+class RateLimitedQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._queue: list[tuple[float, int, Hashable]] = []  # (ready_at, seq, key)
+        self._seq = 0
+        self._queued: set[Hashable] = set()
+        self._in_flight: set[Hashable] = set()
+        self._dirty: set[Hashable] = set()  # re-added while in flight
+        self._failures: dict[Hashable, int] = {}
+        self._event = asyncio.Event()
+        self._closed = False
+
+    def __len__(self) -> int:
+        return len(self._queued)
+
+    def add(self, key: Hashable, delay: float = 0.0) -> None:
+        if self._closed:
+            return
+        if key in self._in_flight:
+            self._dirty.add(key)
+            return
+        if key in self._queued:
+            return
+        self._queued.add(key)
+        self._seq += 1
+        heapq.heappush(self._queue, (time.monotonic() + delay, self._seq, key))
+        self._event.set()
+
+    def note_failure(self, key: Hashable) -> None:
+        self._failures[key] = self._failures.get(key, 0) + 1
+
+    def backoff_delay(self, key: Hashable) -> float:
+        failures = self._failures.get(key, 0)
+        if failures == 0:
+            return 0.0
+        return min(self.base_delay * (2 ** (failures - 1)), self.max_delay)
+
+    def add_rate_limited(self, key: Hashable) -> None:
+        """Re-queue after a failure with exponential backoff."""
+        self.note_failure(key)
+        self.add(key, self.backoff_delay(key))
+
+    def forget(self, key: Hashable) -> None:
+        self._failures.pop(key, None)
+
+    async def get(self) -> Hashable | None:
+        """Next ready key, or None when the queue is shut down."""
+        while True:
+            if self._closed and not self._queue:
+                return None
+            now = time.monotonic()
+            if self._queue and self._queue[0][0] <= now:
+                _, _, key = heapq.heappop(self._queue)
+                self._queued.discard(key)
+                self._in_flight.add(key)
+                return key
+            timeout = (self._queue[0][0] - now) if self._queue else None
+            self._event.clear()
+            try:
+                await asyncio.wait_for(self._event.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+
+    def done(self, key: Hashable) -> None:
+        self._in_flight.discard(key)
+        if key in self._dirty:
+            self._dirty.discard(key)
+            # A dirty key that has recorded failures re-queues with its
+            # backoff, not immediately — otherwise a failing reconciler that
+            # touches its own children retries in a hot loop.
+            self.add(key, self.backoff_delay(key))
+
+    def shutdown(self) -> None:
+        self._closed = True
+        self._event.set()

@@ -1,0 +1,15 @@
+"""Test infrastructure: in-memory fake kube-apiserver + simulators.
+
+The reference tests controllers against envtest (a real etcd+apiserver with no
+kubelet; ``notebook-controller/controllers/suite_test.go:50-100``). This
+package is our equivalent, plus what envtest never had: an optional kubelet
+simulator (``podsim``) that materialises StatefulSet/Deployment pods so
+e2e-style flows (spawn → Running → probe) run entirely in-process, and a fake
+TPU runtime harness for multi-host wiring tests (SURVEY.md §4 "fake TPU
+runtime").
+"""
+
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+
+__all__ = ["FakeKube", "PodSimulator"]

@@ -1,0 +1,384 @@
+"""In-memory Kubernetes apiserver with real API semantics.
+
+Implements the ``KubeApi`` surface the controllers use, with the semantics
+that matter for correctness testing:
+
+- resourceVersion on every write + Conflict on stale full updates
+- metadata.generation bumped on spec changes, mirrored nowhere (status is a
+  subresource, like the real server)
+- watches with ADDED/MODIFIED/DELETED events fanned out per watcher
+- finalizers + deletionTimestamp two-phase delete
+- ownerReference cascade deletion (background propagation)
+- admission plugin chain (mutating then validating) so webhook logic is
+  exercised through the same path the real apiserver would drive it
+- namespace existence is NOT enforced (matches envtest looseness) but
+  namespace-scoped listing/selectors are
+
+This is our envtest (reference: suite_test.go boots envtest with CRDs;
+here CRDs are just registered kinds in the scheme).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import fnmatch
+import time
+import uuid
+from collections import defaultdict
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from kubeflow_tpu.runtime.errors import (
+    AlreadyExists,
+    Conflict,
+    Invalid,
+    NotFound,
+)
+from kubeflow_tpu.runtime.objects import (
+    deepcopy,
+    get_meta,
+    matches_selector,
+    name_of,
+    namespace_of,
+    parse_label_selector,
+)
+from kubeflow_tpu.runtime.scheme import DEFAULT_SCHEME, Scheme
+
+Mutator = Callable[[dict, dict], Awaitable[None] | None]  # (obj, request-info)
+Validator = Callable[[dict, dict], Awaitable[None] | None]
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class _Watch:
+    def __init__(self, kind: str, namespace: str | None, selector: dict | None):
+        self.kind = kind
+        self.namespace = namespace
+        self.selector = selector
+        self.queue: asyncio.Queue[tuple[str, dict] | None] = asyncio.Queue()
+
+    def wants(self, obj: dict) -> bool:
+        if self.namespace and namespace_of(obj) != self.namespace:
+            return False
+        return matches_selector(get_meta(obj).get("labels"), self.selector)
+
+
+class FakeKube:
+    """The in-memory apiserver. All methods are async and deep-copy at the boundary."""
+
+    def __init__(self, scheme: Scheme | None = None):
+        self.scheme = scheme or DEFAULT_SCHEME
+        self._store: dict[str, dict[tuple[str | None, str], dict]] = defaultdict(dict)
+        self._rv = 0
+        self._watches: list[_Watch] = []
+        self._mutators: list[tuple[str, Mutator]] = []      # (kind-glob, fn)
+        self._validators: list[tuple[str, Validator]] = []
+        self._lock = asyncio.Lock()
+
+    # ---- admission plugin registration ---------------------------------------
+
+    def add_mutator(self, kind_glob: str, fn: Mutator) -> None:
+        self._mutators.append((kind_glob, fn))
+
+    def add_validator(self, kind_glob: str, fn: Validator) -> None:
+        self._validators.append((kind_glob, fn))
+
+    # ---- internals -----------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _bucket(self, kind: str) -> dict[tuple[str | None, str], dict]:
+        gvk = self.scheme.by_kind(kind)  # raises for unknown kinds
+        return self._store[gvk.key]
+
+    def _key(self, kind: str, obj_or_name, namespace: str | None) -> tuple[str | None, str]:
+        gvk = self.scheme.by_kind(kind)
+        if isinstance(obj_or_name, dict):
+            name, namespace = name_of(obj_or_name), namespace_of(obj_or_name)
+        else:
+            name = obj_or_name
+        return (namespace if gvk.namespaced else None, name)
+
+    async def _run_admission(self, obj: dict, op: str) -> None:
+        info = {"operation": op}
+        for glob, fn in self._mutators:
+            if fnmatch.fnmatch(obj.get("kind", ""), glob):
+                res = fn(obj, info)
+                if asyncio.iscoroutine(res):
+                    await res
+        for glob, fn in self._validators:
+            if fnmatch.fnmatch(obj.get("kind", ""), glob):
+                res = fn(obj, info)
+                if asyncio.iscoroutine(res):
+                    await res
+
+    def _notify(self, event: str, obj: dict) -> None:
+        for w in self._watches:
+            if w.kind == obj.get("kind") and w.wants(obj):
+                w.queue.put_nowait((event, deepcopy(obj)))
+
+    async def _cascade_delete(self, parent: dict) -> None:
+        """Background GC: delete dependents whose ownerReference points here."""
+        uid = get_meta(parent).get("uid")
+        if not uid:
+            return
+        for bucket in list(self._store.values()):
+            for key, obj in list(bucket.items()):
+                refs = get_meta(obj).get("ownerReferences", [])
+                if any(r.get("uid") == uid for r in refs):
+                    await self._delete_obj(obj["kind"], key)
+
+    # ---- KubeApi surface -----------------------------------------------------
+
+    async def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
+        bucket = self._bucket(kind)
+        key = self._key(kind, name, namespace)
+        obj = bucket.get(key)
+        if obj is None:
+            raise NotFound(f"{kind} {key[0]}/{key[1]} not found")
+        return deepcopy(obj)
+
+    async def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: str | dict | None = None,
+        field_selector: Callable[[dict], bool] | None = None,
+    ) -> list[dict]:
+        selector = (
+            parse_label_selector(label_selector)
+            if isinstance(label_selector, str)
+            else label_selector
+        )
+        out = []
+        for obj in self._bucket(kind).values():
+            if namespace and namespace_of(obj) != namespace:
+                continue
+            if not matches_selector(get_meta(obj).get("labels"), selector):
+                continue
+            if field_selector and not field_selector(obj):
+                continue
+            out.append(deepcopy(obj))
+        out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
+        return out
+
+    async def list_with_rv(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: str | dict | None = None,
+        field_selector: Callable[[dict], bool] | None = None,
+    ) -> tuple[list[dict], str | None]:
+        items = await self.list(kind, namespace, label_selector, field_selector)
+        return items, str(self._rv)
+
+    async def create(self, kind: str, obj: dict, namespace: str | None = None) -> dict:
+        async with self._lock:
+            obj = deepcopy(obj)
+            obj.setdefault("kind", kind)
+            obj.setdefault("apiVersion", self.scheme.by_kind(kind).api_version)
+            meta = get_meta(obj)
+            if namespace and self.scheme.by_kind(kind).namespaced:
+                meta.setdefault("namespace", namespace)
+            if not meta.get("name"):
+                if meta.get("generateName"):
+                    meta["name"] = meta["generateName"] + uuid.uuid4().hex[:6]
+                else:
+                    raise Invalid(f"{kind}: metadata.name required")
+            bucket = self._bucket(kind)
+            key = self._key(kind, obj, None)
+            if key in bucket:
+                raise AlreadyExists(f"{kind} {key} already exists")
+            await self._run_admission(obj, "CREATE")
+            meta["uid"] = str(uuid.uuid4())
+            meta["resourceVersion"] = self._next_rv()
+            meta["generation"] = 1
+            meta.setdefault("creationTimestamp", _now())
+            bucket[self._key(kind, obj, None)] = deepcopy(obj)
+            self._notify("ADDED", obj)
+            return deepcopy(obj)
+
+    async def update(self, kind: str, obj: dict) -> dict:
+        async with self._lock:
+            obj = deepcopy(obj)
+            bucket = self._bucket(kind)
+            key = self._key(kind, obj, None)
+            current = bucket.get(key)
+            if current is None:
+                raise NotFound(f"{kind} {key} not found")
+            meta, cur_meta = get_meta(obj), get_meta(current)
+            if meta.get("resourceVersion") and meta["resourceVersion"] != cur_meta["resourceVersion"]:
+                raise Conflict(
+                    f"{kind} {key}: resourceVersion {meta['resourceVersion']} != "
+                    f"{cur_meta['resourceVersion']}"
+                )
+            await self._run_admission(obj, "UPDATE")
+            # status is a subresource: full updates never change it
+            if "status" in current:
+                obj["status"] = deepcopy(current["status"])
+            else:
+                obj.pop("status", None)
+            meta["uid"] = cur_meta["uid"]
+            meta["creationTimestamp"] = cur_meta["creationTimestamp"]
+            meta["resourceVersion"] = cur_meta["resourceVersion"]
+            meta["generation"] = cur_meta.get("generation", 1)
+            if obj == current and not cur_meta.get("deletionTimestamp"):
+                return deepcopy(current)  # no-op update: no rv bump, no event
+            meta["resourceVersion"] = self._next_rv()
+            spec_changed = obj.get("spec") != current.get("spec")
+            meta["generation"] = cur_meta.get("generation", 1) + (1 if spec_changed else 0)
+            # deleting objects: removing the last finalizer completes deletion
+            if cur_meta.get("deletionTimestamp"):
+                meta["deletionTimestamp"] = cur_meta["deletionTimestamp"]
+                if not meta.get("finalizers"):
+                    del bucket[key]
+                    self._notify("DELETED", obj)
+                    await self._cascade_delete(obj)
+                    return deepcopy(obj)
+            bucket[key] = deepcopy(obj)
+            self._notify("MODIFIED", obj)
+            return deepcopy(obj)
+
+    async def update_status(self, kind: str, obj: dict) -> dict:
+        async with self._lock:
+            bucket = self._bucket(kind)
+            key = self._key(kind, obj, None)
+            current = bucket.get(key)
+            if current is None:
+                raise NotFound(f"{kind} {key} not found")
+            new = deepcopy(current)
+            if "status" in obj:
+                new["status"] = deepcopy(obj["status"])
+            if new == current:  # no-op writes don't bump rv (real-apiserver semantics)
+                return deepcopy(current)
+            get_meta(new)["resourceVersion"] = self._next_rv()
+            bucket[key] = deepcopy(new)
+            self._notify("MODIFIED", new)
+            return deepcopy(new)
+
+    async def patch(
+        self,
+        kind: str,
+        name: str,
+        patch: dict,
+        namespace: str | None = None,
+        subresource: str | None = None,
+    ) -> dict:
+        """Strategic-ish merge patch: dicts merge recursively, None deletes,
+        lists replace (the k8s merge-patch rule)."""
+        async with self._lock:
+            bucket = self._bucket(kind)
+            key = self._key(kind, name, namespace)
+            current = bucket.get(key)
+            if current is None:
+                raise NotFound(f"{kind} {key} not found")
+            new = deepcopy(current)
+
+            def merge(dst: dict, src: dict) -> None:
+                for k, v in src.items():
+                    if v is None:
+                        dst.pop(k, None)
+                    elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                        merge(dst[k], v)
+                    else:
+                        dst[k] = copy.deepcopy(v)
+
+            if subresource == "status":
+                merge(new.setdefault("status", {}), patch.get("status", patch))
+            else:
+                merge(new, patch)
+                await self._run_admission(new, "UPDATE")
+                if "status" in current:
+                    new["status"] = deepcopy(current["status"])
+            if new == current:  # no-op patch: no rv bump, no event (apiserver semantics)
+                return deepcopy(current)
+            meta = get_meta(new)
+            meta["resourceVersion"] = self._next_rv()
+            if new.get("spec") != current.get("spec"):
+                meta["generation"] = get_meta(current).get("generation", 1) + 1
+            bucket[key] = deepcopy(new)
+            self._notify("MODIFIED", new)
+            return deepcopy(new)
+
+    async def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
+        async with self._lock:
+            key = self._key(kind, name, namespace)
+            await self._delete_obj(kind, key)
+
+    async def _delete_obj(self, kind: str, key: tuple[str | None, str]) -> None:
+        bucket = self._bucket(kind)
+        obj = bucket.get(key)
+        if obj is None:
+            raise NotFound(f"{kind} {key} not found")
+        meta = get_meta(obj)
+        if meta.get("finalizers"):
+            if not meta.get("deletionTimestamp"):
+                meta["deletionTimestamp"] = _now()
+                meta["resourceVersion"] = self._next_rv()
+                self._notify("MODIFIED", obj)
+            return
+        del bucket[key]
+        self._notify("DELETED", obj)
+        await self._cascade_delete(obj)
+
+    def watch(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: str | dict | None = None,
+        *,
+        send_initial: bool = True,
+        resource_version: str | None = None,
+    ) -> AsyncIterator[tuple[str, dict]]:
+        """Watch registration is EAGER (at call time, not first iteration) so a
+        synchronous list→watch sequence observes every event — the in-memory
+        equivalent of resourceVersion continuity (``resource_version`` is
+        accepted and ignored)."""
+        selector = (
+            parse_label_selector(label_selector)
+            if isinstance(label_selector, str)
+            else label_selector
+        )
+        w = _Watch(kind, namespace, selector)
+        if send_initial:
+            for obj in self._bucket(kind).values():
+                if namespace and namespace_of(obj) != namespace:
+                    continue
+                if matches_selector(get_meta(obj).get("labels"), selector):
+                    w.queue.put_nowait(("ADDED", deepcopy(obj)))
+        self._watches.append(w)
+        return self._drain_watch(w)
+
+    async def _drain_watch(self, w: _Watch) -> AsyncIterator[tuple[str, dict]]:
+        try:
+            while True:
+                item = await w.queue.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def close_watches(self) -> None:
+        for w in self._watches:
+            w.queue.put_nowait(None)
+
+    # ---- test conveniences ---------------------------------------------------
+
+    async def get_or_none(self, kind: str, name: str, namespace: str | None = None) -> dict | None:
+        try:
+            return await self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def dump(self) -> dict[str, list[str]]:
+        return {
+            key: [f"{ns or '-'}/{n}" for (ns, n) in sorted(bucket, key=lambda t: (t[0] or "", t[1]))]
+            for key, bucket in self._store.items()
+            if bucket
+        }

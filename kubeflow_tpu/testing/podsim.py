@@ -1,0 +1,171 @@
+"""Kubelet/controller-manager simulator for the fake apiserver.
+
+envtest famously has no kubelet — pods never materialise, so the reference's
+tests assert only on generated objects. For e2e-style flows (and the bench's
+cold-start measurement) we go one step further: this simulator watches
+StatefulSets and Deployments and plays the role of the statefulset/deployment
+controllers + kubelet — creating pods through the admission chain (so
+PodDefault injection really runs), marking them Running/Ready after a
+configurable latency, and mirroring readiness into workload status.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from kubeflow_tpu.runtime.errors import AlreadyExists, ApiError, NotFound
+from kubeflow_tpu.runtime.objects import (
+    deep_get,
+    deepcopy,
+    get_meta,
+    name_of,
+    namespace_of,
+    set_controller_owner,
+)
+from kubeflow_tpu.testing.fakekube import FakeKube
+
+
+class PodSimulator:
+    def __init__(self, kube: FakeKube, *, start_latency: float = 0.0):
+        self.kube = kube
+        self.start_latency = start_latency
+        self._tasks: list[asyncio.Task] = []
+        self._running = False
+
+    async def start(self) -> None:
+        self._running = True
+        self._tasks = [
+            asyncio.create_task(self._watch_workloads("StatefulSet")),
+            asyncio.create_task(self._watch_workloads("Deployment")),
+        ]
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _watch_workloads(self, kind: str) -> None:
+        async for _event, obj in self.kube.watch(kind):
+            if not self._running:
+                return
+            try:
+                await self._reconcile_workload(kind, obj)
+            except ApiError:
+                pass
+
+    async def _reconcile_workload(self, kind: str, obj: dict) -> None:
+        ns, name = namespace_of(obj), name_of(obj)
+        # Re-fetch: the event may be stale (workload deleted since it was
+        # queued) — acting on it would resurrect pods for a dead workload.
+        obj = await self.kube.get_or_none(kind, name, ns)
+        if obj is None or get_meta(obj).get("deletionTimestamp"):
+            return
+        replicas = deep_get(obj, "spec", "replicas", default=1)
+        template = deep_get(obj, "spec", "template", default={})
+        want: dict[str, dict] = {}
+        for i in range(replicas):
+            pod_name = f"{name}-{i}" if kind == "StatefulSet" else f"{name}-rs-{i}"
+            want[pod_name] = self._pod_from_template(pod_name, ns, template, obj)
+
+        existing = {
+            name_of(p): p
+            for p in await self.kube.list("Pod", ns)
+            if any(
+                r.get("uid") == get_meta(obj).get("uid")
+                for r in get_meta(p).get("ownerReferences", [])
+            )
+        }
+        for pod_name, pod in want.items():
+            if pod_name not in existing:
+                try:
+                    created = await self.kube.create("Pod", pod)
+                except AlreadyExists:
+                    continue
+                asyncio.create_task(self._run_pod(created))
+        for pod_name in existing:
+            if pod_name not in want:
+                try:
+                    await self.kube.delete("Pod", pod_name, ns)
+                except NotFound:
+                    pass
+        await self._mirror_status(kind, obj, len(want))
+
+    def _pod_from_template(self, pod_name: str, ns: str, template: dict, owner: dict) -> dict:
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_name,
+                "namespace": ns,
+                "labels": dict(deep_get(template, "metadata", "labels", default={})),
+                "annotations": dict(deep_get(template, "metadata", "annotations", default={})),
+            },
+            "spec": deepcopy(template.get("spec", {})),
+        }
+        set_controller_owner(pod, owner)
+        return pod
+
+    async def _run_pod(self, pod: dict) -> None:
+        if self.start_latency:
+            await asyncio.sleep(self.start_latency)
+        ns, name = namespace_of(pod), name_of(pod)
+        try:
+            await self.kube.patch(
+                "Pod",
+                name,
+                {
+                    "status": {
+                        "phase": "Running",
+                        "conditions": [{"type": "Ready", "status": "True"}],
+                        "containerStatuses": [
+                            {
+                                "name": c.get("name", "main"),
+                                "ready": True,
+                                "restartCount": 0,
+                                "state": {"running": {"startedAt": "now"}},
+                            }
+                            for c in deep_get(pod, "spec", "containers", default=[])
+                        ],
+                    }
+                },
+                ns,
+                subresource="status",
+            )
+        except NotFound:
+            return
+        owner_uid = next(
+            (r["uid"] for r in get_meta(pod).get("ownerReferences", []) if r.get("controller")),
+            None,
+        )
+        if owner_uid:
+            for kind in ("StatefulSet", "Deployment"):
+                for wl in await self.kube.list(kind, ns):
+                    if get_meta(wl).get("uid") == owner_uid:
+                        await self._mirror_status(kind, wl, deep_get(wl, "spec", "replicas", default=1))
+
+    async def _mirror_status(self, kind: str, obj: dict, replicas: int) -> None:
+        ns = namespace_of(obj)
+        ready = 0
+        for p in await self.kube.list("Pod", ns):
+            if any(
+                r.get("uid") == get_meta(obj).get("uid")
+                for r in get_meta(p).get("ownerReferences", [])
+            ) and deep_get(p, "status", "phase") == "Running":
+                ready += 1
+        status = {"replicas": replicas, "readyReplicas": ready}
+        if kind == "Deployment":
+            status["availableReplicas"] = ready
+        current = {
+            k: deep_get(obj, "status", k) for k in status
+        }
+        if current == status:
+            return  # avoid self-amplifying MODIFIED loops on our own watch
+        try:
+            await self.kube.patch(kind, name_of(obj), {"status": status}, ns, subresource="status")
+        except NotFound:
+            pass

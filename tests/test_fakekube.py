@@ -1,0 +1,152 @@
+"""Fake apiserver semantics: the envtest contract our controller tests rely on."""
+
+import asyncio
+
+import pytest
+
+from kubeflow_tpu.runtime.errors import AlreadyExists, Conflict, NotFound
+from kubeflow_tpu.runtime.objects import new_object
+from kubeflow_tpu.testing import FakeKube
+
+
+async def test_create_get_defaults():
+    kube = FakeKube()
+    nb = new_object("Notebook", "nb1", "team-a", spec={"template": {"spec": {"containers": []}}})
+    created = await kube.create("Notebook", nb)
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"] == "1"
+    assert created["metadata"]["generation"] == 1
+    got = await kube.get("Notebook", "nb1", "team-a")
+    assert got["spec"] == nb["spec"]
+    with pytest.raises(NotFound):
+        await kube.get("Notebook", "nb1", "other-ns")
+    with pytest.raises(AlreadyExists):
+        await kube.create("Notebook", nb)
+
+
+async def test_update_conflict_and_generation():
+    kube = FakeKube()
+    await kube.create("ConfigMap", new_object("ConfigMap", "cm", "ns"))
+    a = await kube.get("ConfigMap", "cm", "ns")
+    b = await kube.get("ConfigMap", "cm", "ns")
+    a["data"] = {"k": "1"}
+    await kube.update("ConfigMap", a)
+    b["data"] = {"k": "2"}
+    with pytest.raises(Conflict):
+        await kube.update("ConfigMap", b)
+    # spec change bumps generation; metadata-only doesn't
+    nb = await kube.create(
+        "Notebook", new_object("Notebook", "nb", "ns", spec={"template": {"spec": {}}})
+    )
+    nb["metadata"].setdefault("labels", {})["x"] = "y"
+    nb = await kube.update("Notebook", nb)
+    assert nb["metadata"]["generation"] == 1
+    nb["spec"]["template"]["spec"]["hostname"] = "h"
+    nb = await kube.update("Notebook", nb)
+    assert nb["metadata"]["generation"] == 2
+
+
+async def test_status_subresource_isolation():
+    kube = FakeKube()
+    nb = await kube.create("Notebook", new_object("Notebook", "nb", "ns", spec={"a": 1}))
+    nb["status"] = {"readyReplicas": 3}
+    updated = await kube.update("Notebook", nb)  # full update must NOT write status
+    assert "status" not in updated
+    nb["status"] = {"readyReplicas": 3}
+    updated = await kube.update_status("Notebook", nb)
+    assert updated["status"] == {"readyReplicas": 3}
+    # and a later full update preserves status
+    updated["spec"] = {"a": 2}
+    after = await kube.update("Notebook", updated)
+    assert after["status"] == {"readyReplicas": 3}
+
+
+async def test_merge_patch_semantics():
+    kube = FakeKube()
+    await kube.create(
+        "ConfigMap",
+        new_object("ConfigMap", "cm", "ns") | {"data": {"a": "1", "b": "2"}},
+    )
+    patched = await kube.patch("ConfigMap", "cm", {"data": {"b": None, "c": "3"}}, "ns")
+    assert patched["data"] == {"a": "1", "c": "3"}
+
+
+async def test_label_selector_listing():
+    kube = FakeKube()
+    for i, labels in enumerate([{"app": "nb", "env": "dev"}, {"app": "nb"}, {"app": "tb"}]):
+        await kube.create("Pod", new_object("Pod", f"p{i}", "ns", labels=labels, spec={}))
+    assert len(await kube.list("Pod", "ns", "app=nb")) == 2
+    assert len(await kube.list("Pod", "ns", "app=nb,env=dev")) == 1
+    assert len(await kube.list("Pod", "ns", "app!=nb")) == 1
+    assert len(await kube.list("Pod", "ns", "env")) == 1
+
+
+async def test_watch_stream():
+    kube = FakeKube()
+    await kube.create("Pod", new_object("Pod", "pre", "ns", spec={}))
+    events = []
+
+    async def consume():
+        async for event, obj in kube.watch("Pod", "ns"):
+            events.append((event, obj["metadata"]["name"]))
+            if len(events) >= 4:
+                return
+
+    task = asyncio.create_task(consume())
+    await asyncio.sleep(0.01)
+    await kube.create("Pod", new_object("Pod", "p1", "ns", spec={}))
+    await kube.patch("Pod", "p1", {"metadata": {"labels": {"x": "y"}}}, "ns")
+    await kube.delete("Pod", "p1", "ns")
+    await asyncio.wait_for(task, 2)
+    assert events == [
+        ("ADDED", "pre"),
+        ("ADDED", "p1"),
+        ("MODIFIED", "p1"),
+        ("DELETED", "p1"),
+    ]
+
+
+async def test_finalizers_two_phase_delete():
+    kube = FakeKube()
+    obj = new_object("Profile", "team-a")
+    obj["metadata"]["finalizers"] = ["profile-controller/cleanup"]
+    await kube.create("Profile", obj)
+    await kube.delete("Profile", "team-a")
+    live = await kube.get("Profile", "team-a")  # still there, marked deleting
+    assert live["metadata"]["deletionTimestamp"]
+    live["metadata"]["finalizers"] = []
+    await kube.update("Profile", live)
+    with pytest.raises(NotFound):
+        await kube.get("Profile", "team-a")
+
+
+async def test_owner_cascade_gc():
+    kube = FakeKube()
+    from kubeflow_tpu.runtime.objects import set_controller_owner
+
+    nb = await kube.create("Notebook", new_object("Notebook", "nb", "ns", spec={}))
+    sts = new_object("StatefulSet", "nb", "ns", spec={})
+    set_controller_owner(sts, nb)
+    await kube.create("StatefulSet", sts)
+    pod = new_object("Pod", "nb-0", "ns", spec={})
+    sts_live = await kube.get("StatefulSet", "nb", "ns")
+    set_controller_owner(pod, sts_live)
+    await kube.create("Pod", pod)
+
+    await kube.delete("Notebook", "nb", "ns")
+    assert await kube.get_or_none("StatefulSet", "nb", "ns") is None
+    assert await kube.get_or_none("Pod", "nb-0", "ns") is None
+
+
+async def test_admission_chain():
+    kube = FakeKube()
+    seen = []
+
+    def mutator(obj, info):
+        seen.append(info["operation"])
+        obj["metadata"].setdefault("labels", {})["mutated"] = "yes"
+
+    kube.add_mutator("Pod", mutator)
+    pod = await kube.create("Pod", new_object("Pod", "p", "ns", spec={}))
+    assert pod["metadata"]["labels"]["mutated"] == "yes"
+    assert seen == ["CREATE"]
