@@ -1,0 +1,19 @@
+"""API types for the TPU-native notebook stack.
+
+Objects are plain dicts shaped like their Kubernetes wire form (the runtime
+is dict-native end to end); this package holds the *semantics*: constants,
+defaulting, validation, and typed accessors for each CRD.
+
+CRDs (all in the ``kubeflow.org`` family, registered in
+``kubeflow_tpu.runtime.scheme``):
+
+- ``Notebook``      — reference: notebook-controller/api/v1/notebook_types.go
+- ``Profile``       — reference: profile-controller/api/v1/profile_types.go
+- ``PodDefault``    — reference: admission-webhook/pkg/apis/settings/v1alpha1/
+- ``Tensorboard``   — reference: tensorboard-controller/api/v1alpha1/
+- ``PVCViewer``     — reference: pvcviewer-controller/api/v1alpha1/
+"""
+
+from kubeflow_tpu.api import notebook, poddefault, profile, pvcviewer, tensorboard
+
+__all__ = ["notebook", "poddefault", "profile", "tensorboard", "pvcviewer"]

@@ -1,0 +1,134 @@
+"""Notebook CRD semantics.
+
+Reference shape: ``notebook-controller/api/v1/notebook_types.go:27-76`` — the
+spec wraps a literal ``corev1.PodSpec`` (``spec.template.spec``), which is the
+cross-layer contract every other component composes against (SURVEY.md §1).
+
+TPU-native addition: a first-class ``spec.tpu`` block::
+
+    spec:
+      tpu:
+        accelerator: v5e        # v4 | v5e | v5p | v6e
+        topology: "2x4"         # chip grid; drives hosts/chips/selectors
+      template:
+        spec: {containers: [...]}   # literal PodSpec
+
+Everything accelerator-specific is derived from (accelerator, topology) via
+``kubeflow_tpu.tpu.topology.TpuSlice`` — no scattered env vars (the
+reference's GPU story is a vendors list in ``spawner_ui_config.yaml:120-141``;
+ours is one typed block).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
+from kubeflow_tpu.tpu.topology import TopologyError, TpuSlice
+
+GROUP = "kubeflow.org"
+KIND = "Notebook"
+API_VERSION = "kubeflow.org/v1"
+
+# Annotation/label contract — kept wire-compatible with the reference so
+# existing tooling (and muscle memory) carries over:
+STOP_ANNOTATION = "kubeflow-resource-stopped"          # notebook_controller.go:410
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = (
+    "notebooks.kubeflow.org/last_activity_check_timestamp"
+)
+NOTEBOOK_NAME_LABEL = "notebook-name"                  # notebook_controller.go:430
+ANNOTATION_REWRITE_URI = "notebooks.kubeflow.org/http-rewrite-uri"
+ANNOTATION_HEADERS_REQUEST_SET = "notebooks.kubeflow.org/http-headers-request-set"
+SERVER_TYPE_ANNOTATION = "notebooks.kubeflow.org/server-type"
+CREATOR_ANNOTATION = "notebooks.kubeflow.org/creator"
+
+# Restart protocol (reference: culler pkg + odh webhook "update-pending"):
+RESTART_ANNOTATION = "notebooks.kubeflow.org/restart"
+
+PREFIX_ENV_VAR = "NB_PREFIX"                           # notebook_controller.go:56
+DEFAULT_CONTAINER_PORT = 8888
+SERVICE_PORT = 80
+
+
+def new(
+    name: str,
+    namespace: str,
+    *,
+    image: str = "kubeflow-tpu/jupyter-jax:latest",
+    accelerator: str | None = None,
+    topology: str | None = None,
+    pod_spec: dict | None = None,
+) -> dict:
+    """Convenience constructor used by tests, the web app, and the load test."""
+    spec: dict = {"template": {"spec": pod_spec or {
+        "containers": [{"name": name, "image": image}],
+    }}}
+    if accelerator:
+        spec["tpu"] = {"accelerator": accelerator, "topology": topology or "1x1"}
+    return {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+def pod_spec_of(notebook: dict) -> dict:
+    """The literal PodSpec the whole stack composes against."""
+    return deep_get(notebook, "spec", "template", "spec", default={}) or {}
+
+
+def tpu_spec_of(notebook: dict) -> dict | None:
+    return deep_get(notebook, "spec", "tpu")
+
+
+def tpu_slice_of(notebook: dict) -> TpuSlice | None:
+    """Resolve spec.tpu → TpuSlice; None when the notebook is CPU-only.
+
+    Raises Invalid for a malformed tpu block (surface at admission time).
+    """
+    tpu = tpu_spec_of(notebook)
+    if not tpu:
+        return None
+    try:
+        return TpuSlice.parse(
+            str(tpu.get("accelerator", "")), str(tpu.get("topology", ""))
+        )
+    except TopologyError as e:
+        raise Invalid(f"Notebook {name_of(notebook)}: invalid spec.tpu: {e}") from e
+
+
+def is_stopped(notebook: dict) -> bool:
+    return STOP_ANNOTATION in (get_meta(notebook).get("annotations") or {})
+
+
+def default(notebook: dict) -> None:
+    """Defaulting (webhook ``Default()`` equivalent): ensure a container
+    exists and the first container is named after the notebook, matching the
+    reference's assumption that container[0] is *the* notebook server
+    (``notebook_controller.go:418-462``)."""
+    spec = notebook.setdefault("spec", {})
+    template = spec.setdefault("template", {})
+    pod_spec = template.setdefault("spec", {})
+    containers = pod_spec.setdefault("containers", [])
+    if containers and not containers[0].get("name"):
+        containers[0]["name"] = name_of(notebook)
+    tpu = spec.get("tpu")
+    if tpu is not None:
+        tpu.setdefault("topology", "1x1")
+
+
+def validate(notebook: dict) -> None:
+    """Validation (webhook ``ValidateCreate/Update`` equivalent)."""
+    name = name_of(notebook)
+    if not name:
+        raise Invalid("Notebook: metadata.name is required")
+    if len(name) > 52:
+        # StatefulSet appends "-<ordinal>" and pod hostnames must stay <63.
+        raise Invalid(f"Notebook {name}: name longer than 52 characters")
+    containers = deep_get(
+        notebook, "spec", "template", "spec", "containers", default=[]
+    )
+    if not containers:
+        raise Invalid(f"Notebook {name}: spec.template.spec.containers required")
+    tpu_slice_of(notebook)  # raises Invalid on a malformed tpu block

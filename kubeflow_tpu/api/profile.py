@@ -1,0 +1,81 @@
+"""Profile CRD semantics (multi-tenancy).
+
+Reference: ``profile-controller/api/v1/profile_types.go:36-69`` — a
+cluster-scoped Profile owns one namespace; spec carries the owner subject,
+an optional ResourceQuotaSpec, and a list of cloud plugins.
+
+TPU-native addition: ``spec.tpuQuota`` — a simple chip-count ceiling that the
+controller materialises as ``requests.google.com/tpu`` in the namespace's
+ResourceQuota (SURVEY.md §2.4: quota on TPU chips replaces GPU quota).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.objects import deep_get, name_of
+from kubeflow_tpu.tpu.topology import TPU_RESOURCE
+
+KIND = "Profile"
+API_VERSION = "kubeflow.org/v1"
+
+# Condition types (profile_types.go:47-51)
+SUCCEED = "Successful"
+FAILED = "Failed"
+UNKNOWN = "Unknown"
+
+OWNER_ANNOTATION = "owner"
+QUOTA_NAME = "kf-resource-quota"  # profile_controller.go:253-280
+TPU_QUOTA_KEY = f"requests.{TPU_RESOURCE}"
+
+
+def new(
+    name: str,
+    owner: str,
+    *,
+    owner_kind: str = "User",
+    tpu_quota: int | None = None,
+    resource_quota: dict | None = None,
+    plugins: list[dict] | None = None,
+) -> dict:
+    spec: dict = {"owner": {"kind": owner_kind, "name": owner}}
+    if tpu_quota is not None:
+        spec["tpuQuota"] = tpu_quota
+    if resource_quota:
+        spec["resourceQuotaSpec"] = resource_quota
+    if plugins:
+        spec["plugins"] = plugins
+    return {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def owner_of(profile: dict) -> dict:
+    return deep_get(profile, "spec", "owner", default={}) or {}
+
+
+def quota_spec_of(profile: dict) -> dict | None:
+    """Effective ResourceQuotaSpec: explicit spec merged with tpuQuota."""
+    quota = deep_get(profile, "spec", "resourceQuotaSpec")
+    tpu_quota = deep_get(profile, "spec", "tpuQuota")
+    if tpu_quota is None:
+        return quota
+    quota = dict(quota or {})
+    hard = dict(quota.get("hard") or {})
+    hard[TPU_QUOTA_KEY] = str(tpu_quota)
+    quota["hard"] = hard
+    return quota
+
+
+def validate(profile: dict) -> None:
+    name = name_of(profile)
+    if not name:
+        raise Invalid("Profile: metadata.name is required")
+    owner = owner_of(profile)
+    if not owner.get("name"):
+        raise Invalid(f"Profile {name}: spec.owner.name is required")
+    tpu_quota = deep_get(profile, "spec", "tpuQuota")
+    if tpu_quota is not None and (not isinstance(tpu_quota, int) or tpu_quota < 0):
+        raise Invalid(f"Profile {name}: spec.tpuQuota must be a non-negative integer")

@@ -1,0 +1,12 @@
+"""Reconcilers for the TPU-native notebook stack.
+
+One module per controller, mirroring the reference's component split
+(SURVEY.md §2.1) but collapsed to a single manager process — the reference's
+two-controller lock dance (notebook-controller + odh-notebook-controller) is
+deliberately absent (SURVEY.md §7 hard-part (c): one controller + one webhook
+deletes that entire class of races).
+"""
+
+from kubeflow_tpu.controllers.notebook import NotebookReconciler, setup_notebook_controller
+
+__all__ = ["NotebookReconciler", "setup_notebook_controller"]

@@ -1,0 +1,544 @@
+"""Notebook reconciler: Notebook CR → StatefulSet + Services (+ VirtualService).
+
+Reference behavior being matched (``notebook-controller/controllers/
+notebook_controller.go``):
+
+- ``Reconcile`` (:90-272): create/patch StatefulSet, Service, VirtualService;
+  mirror pod status into the CR; re-emit pod events onto the CR.
+- ``generateStatefulSet`` (:408-484): stop-annotation → replicas 0 (:410-412),
+  ``NB_PREFIX`` env (:392-406), fsGroup 100 (:471-482), ``notebook-name``
+  label (:430).
+- ``generateService`` (:486-513): ClusterIP, port 80 → named port
+  ``http-<name>``.
+- ``generateVirtualService`` (:519-619): `/notebook/<ns>/<name>/` prefix with
+  optional rewrite/headers from annotations.
+
+TPU-native redesign (not in the reference, SURVEY.md §2.4):
+
+- ``spec.tpu`` resolves through :class:`kubeflow_tpu.tpu.topology.TpuSlice`;
+  the StatefulSet gets ``replicas = num_hosts`` (one worker pod per TPU
+  host), ``podManagementPolicy: Parallel`` (slice workers must start
+  together), GKE node selectors, and ``google.com/tpu`` chip requests.
+- A **headless Service** (``<name>-workers``) gives every worker a stable DNS
+  name for ``TPU_WORKER_HOSTNAMES`` / ``jax.distributed.initialize`` (DCN
+  bootstrap; ICI is wired by libtpu from topology).
+  ``publishNotReadyAddresses: true`` so bootstrap DNS resolves before
+  readiness.
+- Slice-wide static TPU env goes into the pod template; the *per-worker*
+  ``TPU_WORKER_ID`` / ``JAX_PROCESS_ID`` is injected at pod admission from
+  the pod ordinal (see ``kubeflow_tpu.webhooks.tpu``) because a StatefulSet
+  template cannot vary env per ordinal.
+- **Slice-atomic restart**: a multi-host slice is an all-or-nothing unit —
+  one failed worker leaves the other hosts wedged in a broken ICI ring, so
+  the reconciler deletes *all* worker pods when any of them enters a
+  terminal failure state and lets the StatefulSet rebuild the slice.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.apply import reconcile_child
+from kubeflow_tpu.runtime.errors import ApiError, Invalid, NotFound
+from kubeflow_tpu.runtime.events import EventRecorder
+from kubeflow_tpu.runtime.manager import Controller, Manager, Result, Watch
+from kubeflow_tpu.runtime.metrics import Registry, global_registry
+from kubeflow_tpu.runtime.objects import (
+    deep_get,
+    get_meta,
+    name_of,
+    namespace_of,
+    set_controller_owner,
+)
+from kubeflow_tpu.tpu.topology import JAX_COORDINATOR_PORT, TpuSlice
+
+log = logging.getLogger(__name__)
+
+# Annotations the controller stamps on worker pods so pod-level admission can
+# compute per-worker env without fetching the Notebook (pure function of the
+# pod): see kubeflow_tpu/webhooks/tpu.py.
+TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"
+TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
+
+STS_LABEL = "statefulset"  # reference labels pods with statefulset=<name> (:429)
+POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"  # set by the STS controller
+
+
+@dataclass
+class NotebookOptions:
+    """The reference's env-var sprawl (USE_ISTIO, ISTIO_GATEWAY, CLUSTER_DOMAIN,
+    ADD_FSGROUP — notebook_controller.go:213,475,537-560) as one typed block."""
+
+    use_istio: bool = False
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    cluster_domain: str = "cluster.local"
+    add_fsgroup: bool = True
+    fsgroup: int = 100
+    workers_service_suffix: str = "-workers"
+    default_serving_port: int = nbapi.DEFAULT_CONTAINER_PORT
+
+
+class NotebookReconciler:
+    def __init__(
+        self,
+        kube,
+        options: NotebookOptions | None = None,
+        *,
+        registry: Registry | None = None,
+    ):
+        self.kube = kube
+        self.opts = options or NotebookOptions()
+        self.recorder = EventRecorder(kube, "notebook-controller")
+        # (ns, name) → {pod-event-name: count} — events already mirrored, so
+        # each reconcile re-emits only NEW occurrences (a plain list-driven
+        # re-emit would bump the mirrored count once per reconcile, turning
+        # it into a reconcile-frequency counter).
+        self._mirrored: dict[tuple, dict[str, int]] = {}
+        registry = registry or global_registry
+        # Metric names match the reference (pkg/metrics/metrics.go:14-62) so
+        # dashboards/alerts carry over.
+        self.m_create = registry.counter(
+            "notebook_create_total", "Total times of creating notebooks"
+        )
+        self.m_running = registry.gauge(
+            "notebook_running", "Running notebooks in the cluster", ["namespace"]
+        )
+
+    # ---- reconcile --------------------------------------------------------------
+
+    async def reconcile(self, key) -> Result | None:
+        namespace, name = key
+        nb = await self.kube.get_or_none("Notebook", name, namespace)
+        if nb is None or get_meta(nb).get("deletionTimestamp"):
+            return None  # children die by ownerReference cascade
+
+        try:
+            tpu = nbapi.tpu_slice_of(nb)
+        except Invalid as e:
+            await self.recorder.event(nb, "Warning", "InvalidSpec", str(e))
+            return None
+
+        sts = self.generate_statefulset(nb, tpu)
+        created = await self._ensure(nb, sts)
+        if created:
+            self.m_create.inc()
+            await self.recorder.event(
+                nb, "Normal", "CreatedStatefulSet", f"Created StatefulSet {name}"
+            )
+
+        await self._ensure(nb, self.generate_service(nb))
+        if tpu and tpu.multi_host:
+            await self._ensure(nb, self.generate_headless_service(nb))
+        if self.opts.use_istio:
+            await self._ensure(nb, self.generate_virtual_service(nb))
+
+        await self._restart_broken_slice(nb, tpu)
+        await self._mirror_events(nb)
+        await self._update_status(nb, tpu)
+        return None
+
+    async def _ensure(self, nb: dict, desired: dict) -> bool:
+        """reconcile_child with ownership; returns True when newly created."""
+        set_controller_owner(desired, nb)
+        kind, name, ns = desired["kind"], name_of(desired), namespace_of(desired)
+        existed = await self.kube.get_or_none(kind, name, ns) is not None
+        await reconcile_child(self.kube, desired)
+        return not existed
+
+    # ---- object generation ------------------------------------------------------
+
+    def generate_statefulset(self, nb: dict, tpu: TpuSlice | None) -> dict:
+        """Reference: generateStatefulSet (notebook_controller.go:408-484)."""
+        name, ns = name_of(nb), namespace_of(nb)
+        replicas = 0 if nbapi.is_stopped(nb) else (tpu.num_hosts if tpu else 1)
+
+        pod_spec = deep_get(nb, "spec", "template", "spec", default={})
+        pod_spec = {**pod_spec}  # shallow copy; containers replaced below
+        containers = [dict(c) for c in pod_spec.get("containers", [])]
+        if not containers:
+            containers = [{"name": name, "image": "kubeflow-tpu/jupyter-jax:latest"}]
+        main = containers[0]
+        main.setdefault("name", name)
+        main.setdefault(
+            "ports",
+            [{"containerPort": self.opts.default_serving_port, "name": "notebook-port",
+              "protocol": "TCP"}],
+        )
+        self._set_prefix_env(main, ns, name)
+
+        template_annotations: dict[str, str] = {}
+        if tpu:
+            self._apply_tpu(main, pod_spec, template_annotations, nb, tpu)
+        containers[0] = main
+        pod_spec["containers"] = containers
+
+        if self.opts.add_fsgroup:
+            sc = dict(pod_spec.get("securityContext") or {})
+            sc.setdefault("fsGroup", self.opts.fsgroup)
+            pod_spec["securityContext"] = sc
+
+        sts = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "replicas": replicas,
+                "serviceName": name + self.opts.workers_service_suffix,
+                "selector": {"matchLabels": {STS_LABEL: name}},
+                # Slice workers must come up together: sequential (OrderedReady)
+                # start would serialise libtpu mesh bootstrap across hosts.
+                "podManagementPolicy": "Parallel",
+                "template": {
+                    "metadata": {
+                        "labels": {
+                            STS_LABEL: name,
+                            nbapi.NOTEBOOK_NAME_LABEL: name,
+                            "app": name,
+                        },
+                        "annotations": template_annotations,
+                    },
+                    "spec": pod_spec,
+                },
+            },
+        }
+        return sts
+
+    def _set_prefix_env(self, container: dict, ns: str, name: str) -> None:
+        """NB_PREFIX tells the server its URL base (notebook_controller.go:392-406)."""
+        env = [dict(e) for e in container.get("env", [])]
+        prefix = f"/notebook/{ns}/{name}"
+        for e in env:
+            if e.get("name") == nbapi.PREFIX_ENV_VAR:
+                e["value"] = prefix
+                break
+        else:
+            env.append({"name": nbapi.PREFIX_ENV_VAR, "value": prefix})
+        container["env"] = env
+
+    def _apply_tpu(
+        self,
+        main: dict,
+        pod_spec: dict,
+        template_annotations: dict,
+        nb: dict,
+        tpu: TpuSlice,
+    ) -> None:
+        """Wire the slice: selectors, chip requests, slice-static env, webhook
+        annotations. Per-worker env (TPU_WORKER_ID) is the pod webhook's job."""
+        name, ns = name_of(nb), namespace_of(nb)
+        selectors = dict(pod_spec.get("nodeSelector") or {})
+        selectors.update(tpu.node_selectors())
+        pod_spec["nodeSelector"] = selectors
+
+        resources = dict(main.get("resources") or {})
+        for kind in ("requests", "limits"):
+            bucket = dict(resources.get(kind) or {})
+            bucket.update(tpu.resource_requests())
+            resources[kind] = bucket
+        main["resources"] = resources
+
+        headless = name + self.opts.workers_service_suffix
+        hostnames = tpu.worker_hostnames(
+            name, headless, ns, self.opts.cluster_domain
+        )
+        static_env = tpu.worker_env(0, hostnames)
+        # Per-worker keys are the webhook's job; don't bake worker 0's values
+        # into every pod of a multi-host slice.
+        for per_worker in ("TPU_WORKER_ID", "JAX_PROCESS_ID"):
+            static_env.pop(per_worker, None)
+        env = [dict(e) for e in main.get("env", [])]
+        have = {e.get("name") for e in env}
+        for k, v in static_env.items():
+            if k not in have:
+                env.append({"name": k, "value": v})
+        main["env"] = env
+
+        ports = list(main.get("ports", []))
+        if not any(p.get("containerPort") == JAX_COORDINATOR_PORT for p in ports):
+            ports.append(
+                {"containerPort": JAX_COORDINATOR_PORT, "name": "jax-coord",
+                 "protocol": "TCP"}
+            )
+        main["ports"] = ports
+
+        template_annotations[TPU_ACCELERATOR_ANNOTATION] = tpu.accelerator.name
+        template_annotations[TPU_TOPOLOGY_ANNOTATION] = tpu.topology_str
+
+    def generate_service(self, nb: dict) -> dict:
+        """HTTP entrypoint. Reference: generateService (:486-513) — port 80 →
+        named port ``http-<name>``. Multi-host twist: route to worker 0 only
+        (the Jupyter server runs on worker 0; other workers are compute
+        peers), via the stable STS pod-name label."""
+        name, ns = name_of(nb), namespace_of(nb)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {STS_LABEL: name, POD_NAME_LABEL: f"{name}-0"},
+                "ports": [
+                    {
+                        "name": f"http-{name}"[:63],
+                        "port": nbapi.SERVICE_PORT,
+                        "targetPort": self.opts.default_serving_port,
+                        "protocol": "TCP",
+                    }
+                ],
+            },
+        }
+
+    def generate_headless_service(self, nb: dict) -> dict:
+        """Worker discovery for multi-host slices — the DNS backing
+        ``TPU_WORKER_HOSTNAMES`` (SURVEY.md §2.4 row 2)."""
+        name, ns = name_of(nb), namespace_of(nb)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name + self.opts.workers_service_suffix,
+                         "namespace": ns},
+            "spec": {
+                "clusterIP": "None",
+                "publishNotReadyAddresses": True,
+                "selector": {STS_LABEL: name},
+                "ports": [
+                    {"name": "jax-coord", "port": JAX_COORDINATOR_PORT,
+                     "protocol": "TCP"}
+                ],
+            },
+        }
+
+    def generate_virtual_service(self, nb: dict) -> dict:
+        """Reference: generateVirtualService (:519-619) — URL contract
+        ``/notebook/<ns>/<name>/``, honoring the rewrite/header annotations
+        the vscode-like and rstudio-like images rely on."""
+        name, ns = name_of(nb), namespace_of(nb)
+        annotations = get_meta(nb).get("annotations") or {}
+        prefix = f"/notebook/{ns}/{name}/"
+        http: dict = {
+            "match": [{"uri": {"prefix": prefix}}],
+            "route": [
+                {
+                    "destination": {
+                        "host": f"{name}.{ns}.svc.{self.opts.cluster_domain}",
+                        "port": {"number": nbapi.SERVICE_PORT},
+                    }
+                }
+            ],
+            "timeout": "300s",
+        }
+        rewrite = annotations.get(nbapi.ANNOTATION_REWRITE_URI)
+        if rewrite:
+            http["rewrite"] = {"uri": rewrite}
+        headers = annotations.get(nbapi.ANNOTATION_HEADERS_REQUEST_SET)
+        if headers:
+            import json
+
+            try:
+                http["headers"] = {"request": {"set": json.loads(headers)}}
+            except ValueError:
+                log.warning("notebook %s/%s: bad %s annotation", ns, name,
+                            nbapi.ANNOTATION_HEADERS_REQUEST_SET)
+        return {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {"name": f"notebook-{ns}-{name}", "namespace": ns},
+            "spec": {
+                "hosts": [self.opts.istio_host],
+                "gateways": [self.opts.istio_gateway],
+                "http": [http],
+            },
+        }
+
+    # ---- failure semantics ------------------------------------------------------
+
+    async def _worker_pods(self, nb: dict) -> list[dict]:
+        return await self.kube.list(
+            "Pod",
+            namespace_of(nb),
+            label_selector={"matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name_of(nb)}},
+        )
+
+    async def _restart_broken_slice(self, nb: dict, tpu: TpuSlice | None) -> None:
+        """All-or-nothing slice recovery (the hard part the reference never
+        faced with single-pod notebooks, SURVEY.md §7.5): one dead worker
+        breaks the whole ICI mesh, so every worker restarts together."""
+        if not (tpu and tpu.multi_host) or nbapi.is_stopped(nb):
+            return
+        pods = await self._worker_pods(nb)
+        broken = [
+            p for p in pods
+            if deep_get(p, "status", "phase") == "Failed"
+            or any(
+                deep_get(cs, "state", "terminated", "exitCode") not in (None, 0)
+                for cs in deep_get(p, "status", "containerStatuses", default=[])
+            )
+        ]
+        if not broken:
+            return
+        names = ", ".join(sorted(name_of(p) for p in broken))
+        await self.recorder.event(
+            nb,
+            "Warning",
+            "SliceRestart",
+            f"Worker(s) {names} failed; restarting all {tpu.num_hosts} workers "
+            f"(TPU slices restart atomically)",
+        )
+        for p in pods:
+            try:
+                await self.kube.delete("Pod", name_of(p), namespace_of(p))
+            except NotFound:
+                pass
+
+    # ---- status ----------------------------------------------------------------
+
+    async def _mirror_events(self, nb: dict) -> None:
+        """Re-emit worker pod events onto the CR so the UI can surface them
+        (reference: notebook_controller.go:94-123 event mapping)."""
+        ns, name = namespace_of(nb), name_of(nb)
+        pods = {name_of(p) for p in await self._worker_pods(nb)}
+        try:
+            events = await self.kube.list("Event", ns)
+        except ApiError:
+            return
+        seen = self._mirrored.setdefault((ns, name), {})
+        for ev in events:
+            involved = ev.get("involvedObject") or {}
+            if involved.get("kind") != "Pod" or involved.get("name") not in pods:
+                continue
+            ev_name, count = name_of(ev), ev.get("count", 1)
+            if seen.get(ev_name) == count:
+                continue
+            seen[ev_name] = count
+            await self.recorder.event(
+                nb,
+                ev.get("type", "Normal"),
+                ev.get("reason", ""),
+                f"[pod {involved['name']}] {ev.get('message', '')}",
+            )
+
+    async def _update_status(self, nb: dict, tpu: TpuSlice | None) -> None:
+        """Mirror STS/pod state into the CR (reference :228-349): readyReplicas,
+        containerState of worker 0's server container, condition history."""
+        ns, name = namespace_of(nb), name_of(nb)
+        sts = await self.kube.get_or_none("StatefulSet", name, ns)
+        ready = deep_get(sts or {}, "status", "readyReplicas", default=0) or 0
+
+        container_state: dict = {}
+        pod0 = await self.kube.get_or_none("Pod", f"{name}-0", ns)
+        if pod0:
+            containers = deep_get(
+                nb, "spec", "template", "spec", "containers", default=[]
+            )
+            main_name = (containers[0].get("name") if containers else None) or name
+            statuses = deep_get(pod0, "status", "containerStatuses", default=[])
+            for cs in statuses:
+                if cs.get("name") == main_name:
+                    container_state = cs.get("state", {}) or {}
+                    break
+            else:
+                if statuses:
+                    container_state = statuses[0].get("state", {}) or {}
+
+        conditions = list(deep_get(nb, "status", "conditions", default=[]))
+        new_cond = _condition_from_state(container_state)
+        if new_cond and (not conditions or conditions[0].get("type") != new_cond["type"]):
+            conditions.insert(0, new_cond)
+            conditions = conditions[:8]
+
+        want_hosts = 0 if nbapi.is_stopped(nb) else (tpu.num_hosts if tpu else 1)
+        status = {
+            "readyReplicas": ready,
+            "containerState": container_state,
+            "conditions": conditions,
+            # TPU-native extras (not in the reference): slice rollup for the UI.
+            "tpu": {
+                "hosts": want_hosts,
+                "readyHosts": ready,
+                "chips": tpu.num_chips if tpu else 0,
+            },
+        }
+        if deep_get(nb, "status") != status:
+            try:
+                await self.kube.patch(
+                    "Notebook", name, {"status": status}, ns, subresource="status"
+                )
+            except ApiError:
+                pass
+        self.m_running.labels(namespace=ns or "").set(
+            1 if ready and ready == want_hosts else 0
+        )
+
+
+def _condition_from_state(state: dict) -> dict | None:
+    """ContainerState → NotebookCondition (Running|Waiting|Terminated),
+    reference notebook_types.go:46-63 + status mirroring."""
+    import time
+
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    if "running" in state:
+        return {"type": "Running", "status": "True", "lastProbeTime": now}
+    if "waiting" in state:
+        w = state["waiting"] or {}
+        return {
+            "type": "Waiting",
+            "status": "True",
+            "lastProbeTime": now,
+            "reason": w.get("reason", ""),
+            "message": w.get("message", ""),
+        }
+    if "terminated" in state:
+        t = state["terminated"] or {}
+        return {
+            "type": "Terminated",
+            "status": "True",
+            "lastProbeTime": now,
+            "reason": t.get("reason", ""),
+            "message": t.get("message", ""),
+        }
+    return None
+
+
+def pod_to_notebook(pod: dict) -> list[tuple]:
+    """Map pod events to their Notebook (reference SetupWithManager watch by
+    ``notebook-name`` label, notebook_controller.go:739-787)."""
+    name = (get_meta(pod).get("labels") or {}).get(nbapi.NOTEBOOK_NAME_LABEL)
+    if not name:
+        return []
+    return [(namespace_of(pod), name)]
+
+
+def event_to_notebook(event: dict) -> list[tuple]:
+    """Map pod Events to Notebooks by the pod-name → notebook-name convention
+    (reference :685-700 strips the trailing ordinal)."""
+    involved = event.get("involvedObject") or {}
+    if involved.get("kind") != "Pod":
+        return []
+    pod_name = involved.get("name", "")
+    base, _, ordinal = pod_name.rpartition("-")
+    if not base or not ordinal.isdigit():
+        return []
+    return [(event.get("metadata", {}).get("namespace"), base)]
+
+
+def setup_notebook_controller(
+    mgr: Manager, options: NotebookOptions | None = None
+) -> NotebookReconciler:
+    rec = NotebookReconciler(mgr.kube, options, registry=mgr.registry)
+    mgr.add_controller(
+        Controller(
+            name="notebook",
+            kind="Notebook",
+            reconcile=rec.reconcile,
+            owns=["StatefulSet", "Service"]
+            + (["VirtualService"] if rec.opts.use_istio else []),
+            watches=[
+                Watch("Pod", pod_to_notebook),
+                Watch("Event", event_to_notebook),
+            ],
+        )
+    )
+    return rec

@@ -23,6 +23,24 @@ from kubeflow_tpu.runtime.objects import (
 log = logging.getLogger(__name__)
 
 
+def subset_equal(want, have) -> bool:
+    """True when every field the controller sets is already present in the
+    live object. The live object legitimately has MORE fields (apiserver
+    defaulting: Service ipFamilies/sessionAffinity, pod restartPolicy/
+    dnsPolicy, ...); comparing whole subtrees with ``==`` would see permanent
+    drift and update in a hot loop against a real cluster. Trade-off: a field
+    the controller *removes* from its desired state is not reverted — owned
+    objects are regenerated wholesale on spec changes, so this doesn't bite.
+    Lists compare element-wise (k8s list order is semantic)."""
+    if isinstance(want, dict) and isinstance(have, dict):
+        return all(k in have and subset_equal(v, have[k]) for k, v in want.items())
+    if isinstance(want, list) and isinstance(have, list):
+        return len(want) == len(have) and all(
+            subset_equal(w, h) for w, h in zip(want, have)
+        )
+    return want == have
+
+
 def copy_statefulset_fields(desired: dict, live: dict) -> bool:
     """Reference: CopyStatefulSetFields (util.go:57-86) — labels, annotations,
     replicas, template; returns True when an update is required."""
@@ -32,11 +50,7 @@ def copy_statefulset_fields(desired: dict, live: dict) -> bool:
     return changed
 
 
-def copy_deployment_fields(desired: dict, live: dict) -> bool:
-    changed = _copy_meta(desired, live)
-    for path in (("spec", "replicas"), ("spec", "template")):
-        changed |= _copy_path(desired, live, path)
-    return changed
+copy_deployment_fields = copy_statefulset_fields  # identical owned-field set
 
 
 def copy_service_fields(desired: dict, live: dict) -> bool:
@@ -50,7 +64,7 @@ def copy_service_fields(desired: dict, live: dict) -> bool:
     cluster_ip = deep_get(live, "spec", "clusterIP")
     if cluster_ip is not None and "clusterIP" not in want:
         want["clusterIP"] = cluster_ip
-    if deep_get(live, "spec") != want:
+    if not subset_equal(want, deep_get(live, "spec") or {}):
         live["spec"] = want
         changed = True
     return changed
@@ -69,11 +83,14 @@ def copy_spec(desired: dict, live: dict) -> bool:
 
 
 def _copy_meta(desired: dict, live: dict) -> bool:
+    """Fold desired labels/annotations into the live ones (other actors may
+    legitimately add their own; only ours must be present and equal)."""
     changed = False
     for field in ("labels", "annotations"):
         want = get_meta(desired).get(field)
-        if want is not None and get_meta(live).get(field) != want:
-            get_meta(live)[field] = deepcopy(want)
+        have = get_meta(live).get(field) or {}
+        if want is not None and not subset_equal(want, have):
+            get_meta(live)[field] = {**have, **deepcopy(want)}
             changed = True
     return changed
 
@@ -81,7 +98,7 @@ def _copy_meta(desired: dict, live: dict) -> bool:
 def _copy_path(desired: dict, live: dict, path: tuple[str, ...]) -> bool:
     want = deep_get(desired, *path)
     have = deep_get(live, *path)
-    if want is None or want == have:
+    if want is None or subset_equal(want, have):
         return False
     cur = live
     for part in path[:-1]:

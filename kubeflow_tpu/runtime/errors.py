@@ -47,11 +47,17 @@ class Unauthorized(ApiError):
 
 
 def error_for_code(code: int, message: str = "") -> ApiError:
-    for cls in (NotFound, AlreadyExists, Invalid, Forbidden, Unauthorized):
+    if code == 409:
+        # Both AlreadyExists and Conflict are 409s; the apiserver's Status
+        # body carries the distinguishing reason. Default to Conflict — the
+        # stale-resourceVersion case — since create paths that care catch
+        # AlreadyExists by its reason text.
+        if "AlreadyExists" in message or "already exists" in message:
+            return AlreadyExists(message)
+        return Conflict(message)
+    for cls in (NotFound, Invalid, Forbidden, Unauthorized):
         if cls.code == code:
             return cls(message)
-    if code == 409:
-        return Conflict(message)
     err = ApiError(message)
     err.code = code
     return err

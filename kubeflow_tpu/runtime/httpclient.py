@@ -182,15 +182,29 @@ class HttpKube:
         ) as resp:
             if resp.status >= 400:
                 raise error_for_code(resp.status, await resp.text())
-            async for line in resp.content:
-                line = line.strip()
-                if not line:
-                    continue
-                evt = json.loads(line)
-                obj = evt.get("object", {})
-                obj.setdefault("kind", kind)
-                obj.setdefault("apiVersion", gvk.api_version)
-                yield (evt.get("type", "MODIFIED"), obj)
+            # Manual line buffering: aiohttp's line iterator raises on JSON
+            # lines beyond its 64 KiB readline limit, which real objects
+            # (managedFields, big ConfigMaps) exceed routinely.
+            buf = b""
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    evt = json.loads(line)
+                    obj = evt.get("object", {})
+                    if evt.get("type") == "ERROR":
+                        # e.g. 410 Gone on an expired resourceVersion — the
+                        # Status object is not a resource; surface as an
+                        # ApiError so the informer relists.
+                        raise error_for_code(
+                            obj.get("code", 500), obj.get("message", "watch error")
+                        )
+                    obj.setdefault("kind", kind)
+                    obj.setdefault("apiVersion", gvk.api_version)
+                    yield (evt.get("type", "MODIFIED"), obj)
 
     async def get_or_none(self, kind: str, name: str, namespace: str | None = None):
         from kubeflow_tpu.runtime.errors import NotFound

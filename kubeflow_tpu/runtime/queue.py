@@ -21,6 +21,7 @@ class RateLimitedQueue:
         self._queue: list[tuple[float, int, Hashable]] = []  # (ready_at, seq, key)
         self._seq = 0
         self._queued: set[Hashable] = set()
+        self._earliest: dict[Hashable, float] = {}  # earliest ready_at per key
         self._in_flight: set[Hashable] = set()
         self._dirty: set[Hashable] = set()  # re-added while in flight
         self._failures: dict[Hashable, int] = {}
@@ -36,11 +37,19 @@ class RateLimitedQueue:
         if key in self._in_flight:
             self._dirty.add(key)
             return
+        ready_at = time.monotonic() + delay
         if key in self._queued:
-            return
-        self._queued.add(key)
+            # Already queued: a NEW add may only move the key *earlier*
+            # (client-go semantics — an immediate change event must not wait
+            # behind a long requeue_after/backoff entry). Push a second heap
+            # entry; get() takes the earliest and drops stale duplicates.
+            if ready_at >= self._earliest.get(key, float("inf")):
+                return
+        else:
+            self._queued.add(key)
+        self._earliest[key] = min(ready_at, self._earliest.get(key, float("inf")))
         self._seq += 1
-        heapq.heappush(self._queue, (time.monotonic() + delay, self._seq, key))
+        heapq.heappush(self._queue, (ready_at, self._seq, key))
         self._event.set()
 
     def note_failure(self, key: Hashable) -> None:
@@ -68,7 +77,10 @@ class RateLimitedQueue:
             now = time.monotonic()
             if self._queue and self._queue[0][0] <= now:
                 _, _, key = heapq.heappop(self._queue)
+                if key not in self._queued:
+                    continue  # stale duplicate from an earlier-delay re-add
                 self._queued.discard(key)
+                self._earliest.pop(key, None)
                 self._in_flight.add(key)
                 return key
             timeout = (self._queue[0][0] - now) if self._queue else None

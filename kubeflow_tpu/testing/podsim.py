@@ -30,6 +30,9 @@ class PodSimulator:
         self.kube = kube
         self.start_latency = start_latency
         self._tasks: list[asyncio.Task] = []
+        # Strong refs: asyncio holds tasks weakly; un-referenced _run_pod
+        # tasks can be GC'd mid-flight (pods stuck Pending, flaky tests).
+        self._pod_tasks: set[asyncio.Task] = set()
         self._running = False
 
     async def start(self) -> None:
@@ -37,17 +40,19 @@ class PodSimulator:
         self._tasks = [
             asyncio.create_task(self._watch_workloads("StatefulSet")),
             asyncio.create_task(self._watch_workloads("Deployment")),
+            asyncio.create_task(self._watch_pods()),
         ]
 
     async def stop(self) -> None:
         self._running = False
-        for t in self._tasks:
+        for t in [*self._tasks, *self._pod_tasks]:
             t.cancel()
-        for t in self._tasks:
+        for t in [*self._tasks, *self._pod_tasks]:
             try:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        self._pod_tasks.clear()
 
     async def _watch_workloads(self, kind: str) -> None:
         async for _event, obj in self.kube.watch(kind):
@@ -57,6 +62,30 @@ class PodSimulator:
                 await self._reconcile_workload(kind, obj)
             except ApiError:
                 pass
+
+    async def _watch_pods(self) -> None:
+        """The real STS/Deployment controllers watch pods: an out-of-band pod
+        delete must trigger recreation from the owning workload."""
+        async for event, pod in self.kube.watch("Pod"):
+            if not self._running:
+                return
+            if event != "DELETED":
+                continue
+            owner = next(
+                (r for r in get_meta(pod).get("ownerReferences", [])
+                 if r.get("controller")),
+                None,
+            )
+            if not owner or owner.get("kind") not in ("StatefulSet", "Deployment"):
+                continue
+            wl = await self.kube.get_or_none(
+                owner["kind"], owner["name"], namespace_of(pod)
+            )
+            if wl is not None:
+                try:
+                    await self._reconcile_workload(owner["kind"], wl)
+                except ApiError:
+                    pass
 
     async def _reconcile_workload(self, kind: str, obj: dict) -> None:
         ns, name = namespace_of(obj), name_of(obj)
@@ -86,7 +115,9 @@ class PodSimulator:
                     created = await self.kube.create("Pod", pod)
                 except AlreadyExists:
                     continue
-                asyncio.create_task(self._run_pod(created))
+                task = asyncio.create_task(self._run_pod(created))
+                self._pod_tasks.add(task)
+                task.add_done_callback(self._pod_tasks.discard)
         for pod_name in existing:
             if pod_name not in want:
                 try:
@@ -96,13 +127,19 @@ class PodSimulator:
         await self._mirror_status(kind, obj, len(want))
 
     def _pod_from_template(self, pod_name: str, ns: str, template: dict, owner: dict) -> dict:
+        labels = dict(deep_get(template, "metadata", "labels", default={}))
+        if owner.get("kind") == "StatefulSet":
+            # The real STS controller stamps the stable pod identity label
+            # (and, ≥1.28, the ordinal index) — controllers select on these.
+            labels["statefulset.kubernetes.io/pod-name"] = pod_name
+            labels["apps.kubernetes.io/pod-index"] = pod_name.rsplit("-", 1)[-1]
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
             "metadata": {
                 "name": pod_name,
                 "namespace": ns,
-                "labels": dict(deep_get(template, "metadata", "labels", default={})),
+                "labels": labels,
                 "annotations": dict(deep_get(template, "metadata", "annotations", default={})),
             },
             "spec": deepcopy(template.get("spec", {})),

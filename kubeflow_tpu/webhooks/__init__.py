@@ -1,0 +1,22 @@
+"""Admission layer: pure mutation/validation engines + their registrations.
+
+The reference runs three separate admission servers (PodDefault webhook,
+odh notebook webhook, pvcviewer defaulter). Here each engine is a pure
+function over dict-shaped objects, registered on the apiserver's admission
+chain (FakeKube in tests, the real webhook server in deployment) — one
+admission layer, no cross-webhook races (SURVEY.md §7 hard-part (c)).
+"""
+
+from kubeflow_tpu.webhooks.poddefault import (
+    apply_poddefaults,
+    filter_poddefaults,
+    safe_to_apply,
+)
+from kubeflow_tpu.webhooks.register import register_all
+
+__all__ = [
+    "apply_poddefaults",
+    "filter_poddefaults",
+    "safe_to_apply",
+    "register_all",
+]

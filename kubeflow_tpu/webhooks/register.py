@@ -1,0 +1,48 @@
+"""Wire every admission engine onto an apiserver admission chain.
+
+Order matters and is fixed here (the reference's implicit multi-webhook
+ordering made explicit): CR defaulting/validation first, then pod-level
+PodDefault injection, then per-worker TPU env (which must see the final pod
+name and the template annotations, and must win over anything a PodDefault
+set for TPU_WORKER_ID).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.api import poddefault as pdapi
+from kubeflow_tpu.api import profile as profileapi
+from kubeflow_tpu.api import pvcviewer as pvcapi
+from kubeflow_tpu.api import tensorboard as tbapi
+from kubeflow_tpu.webhooks import poddefault as pd_webhook
+from kubeflow_tpu.webhooks import tpu as tpu_webhook
+
+
+def register_all(kube) -> None:
+    """Register mutators/validators on a FakeKube-compatible admission chain.
+
+    ``kube.add_mutator(kind_glob, fn)`` / ``add_validator`` — fns may be sync
+    or async, called with (obj, request_info).
+    """
+    # CR defaulting (mutators run before validators).
+    kube.add_mutator("Notebook", lambda nb, _i: nbapi.default(nb))
+    kube.add_mutator("PVCViewer", lambda v, _i: pvcapi.default(v))
+
+    # CR validation.
+    kube.add_validator("Notebook", lambda nb, _i: nbapi.validate(nb))
+    kube.add_validator("PodDefault", lambda pd, _i: pdapi.validate(pd))
+    kube.add_validator("Profile", lambda p, _i: profileapi.validate(p))
+    kube.add_validator("Tensorboard", lambda tb, _i: tbapi.validate(tb))
+    kube.add_validator("PVCViewer", lambda v, _i: pvcapi.validate(v))
+
+    # Pod mutation: PodDefault injection, then per-worker TPU env.
+    async def poddefault_mutator(pod: dict, info: dict) -> None:
+        if info.get("operation") == "CREATE":
+            await pd_webhook.mutate_pod(kube, pod)
+
+    def tpu_mutator(pod: dict, info: dict) -> None:
+        if info.get("operation") == "CREATE":
+            tpu_webhook.mutate_pod(pod)
+
+    kube.add_mutator("Pod", poddefault_mutator)
+    kube.add_mutator("Pod", tpu_mutator)

@@ -1,0 +1,71 @@
+"""Per-worker TPU env injection at pod admission.
+
+A StatefulSet template cannot vary env by ordinal, but at *pod* admission the
+pod already has its final name ``<notebook>-<ordinal>`` — so this mutator is
+a pure function of the pod: it reads the slice annotations the notebook
+controller stamped on the template (``tpu.kubeflow.org/accelerator`` /
+``tpu.kubeflow.org/topology``), parses the ordinal, and injects
+``TPU_WORKER_ID`` / ``JAX_PROCESS_ID``.
+
+This replaces the reference pattern of a PodDefault carrying static env
+(SURVEY.md §2.4 row 4: "PodDefault injecting TPU_WORKER_ID…") with something
+a PodDefault *cannot* express — per-ordinal values.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.controllers.notebook import (
+    TPU_ACCELERATOR_ANNOTATION,
+    TPU_TOPOLOGY_ANNOTATION,
+)
+from kubeflow_tpu.runtime.objects import get_meta, name_of
+from kubeflow_tpu.tpu.topology import TopologyError, TpuSlice
+
+log = logging.getLogger(__name__)
+
+
+def ordinal_of(pod_name: str) -> int | None:
+    base, _, ordinal = pod_name.rpartition("-")
+    if base and ordinal.isdigit():
+        return int(ordinal)
+    return None
+
+
+def mutate_pod(pod: dict) -> None:
+    """Inject per-worker env into every container of an annotated TPU pod."""
+    annotations = get_meta(pod).get("annotations") or {}
+    accelerator = annotations.get(TPU_ACCELERATOR_ANNOTATION)
+    topology = annotations.get(TPU_TOPOLOGY_ANNOTATION)
+    if not accelerator or not topology:
+        return
+    ordinal = ordinal_of(name_of(pod))
+    if ordinal is None:
+        return
+    try:
+        tpu = TpuSlice.parse(accelerator, topology)
+    except TopologyError as e:
+        log.warning("pod %s: bad TPU annotations: %s", name_of(pod), e)
+        return
+    worker_env = {
+        "TPU_WORKER_ID": str(ordinal),
+        "JAX_PROCESS_ID": str(ordinal),
+    }
+    if ordinal >= tpu.num_hosts:
+        log.warning(
+            "pod %s: ordinal %d outside %d-host slice", name_of(pod), ordinal,
+            tpu.num_hosts,
+        )
+        return
+    for ctr in pod.get("spec", {}).get("containers", []):
+        env = list(ctr.get("env", []) or [])
+        have = {e.get("name") for e in env}
+        for k, v in worker_env.items():
+            if k not in have:
+                env.append({"name": k, "value": v})
+            else:
+                for e in env:
+                    if e.get("name") == k:
+                        e["value"] = v
+        ctr["env"] = env

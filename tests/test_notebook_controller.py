@@ -1,0 +1,306 @@
+"""Notebook controller suite — the envtest-equivalent of the reference's
+``notebook-controller/controllers/notebook_controller_test.go`` (STS/Service
+shape, status mirroring) plus the TPU-native behaviors the reference never
+had: multi-host slice spawning, per-worker env injection, slice-atomic
+restart.
+"""
+
+import asyncio
+
+import pytest
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get, get_meta
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+
+
+class Harness:
+    def __init__(self, kube, mgr, sim):
+        self.kube = kube
+        self.mgr = mgr
+        self.sim = sim
+
+    async def settle(self):
+        # Let podsim + controller exchange a few rounds of events.
+        for _ in range(6):
+            await self.mgr.wait_idle()
+            await asyncio.sleep(0.02)
+        await self.mgr.wait_idle()
+
+
+async def make_harness(**opts):
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr, NotebookOptions(**opts))
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    return Harness(kube, mgr, sim)
+
+
+async def stop_harness(h):
+    await h.sim.stop()
+    await h.mgr.stop()
+    h.kube.close_watches()
+
+
+async def test_single_host_notebook_spawns_sts_service_and_runs():
+    h = await make_harness()
+    try:
+        nb = nbapi.new("nb1", "user-ns", image="img:1")
+        await h.kube.create("Notebook", nb)
+        await h.settle()
+
+        sts = await h.kube.get("StatefulSet", "nb1", "user-ns")
+        assert deep_get(sts, "spec", "replicas") == 1
+        assert deep_get(sts, "spec", "podManagementPolicy") == "Parallel"
+        tmpl = deep_get(sts, "spec", "template")
+        assert deep_get(tmpl, "metadata", "labels")["notebook-name"] == "nb1"
+        main = deep_get(tmpl, "spec", "containers")[0]
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        assert env["NB_PREFIX"] == "/notebook/user-ns/nb1"
+        assert deep_get(tmpl, "spec", "securityContext", "fsGroup") == 100
+
+        svc = await h.kube.get("Service", "nb1", "user-ns")
+        port = deep_get(svc, "spec", "ports")[0]
+        assert port["port"] == 80 and port["targetPort"] == 8888
+        # HTTP routes to worker 0 only.
+        assert deep_get(svc, "spec", "selector")[
+            "statefulset.kubernetes.io/pod-name"
+        ] == "nb1-0"
+
+        pod = await h.kube.get("Pod", "nb1-0", "user-ns")
+        assert deep_get(pod, "status", "phase") == "Running"
+
+        nb = await h.kube.get("Notebook", "nb1", "user-ns")
+        assert deep_get(nb, "status", "readyReplicas") == 1
+        assert "running" in deep_get(nb, "status", "containerState", default={})
+        conds = deep_get(nb, "status", "conditions", default=[])
+        assert conds and conds[0]["type"] == "Running"
+    finally:
+        await stop_harness(h)
+
+
+async def test_tpu_single_host_resources_and_selectors():
+    h = await make_harness()
+    try:
+        nb = nbapi.new("tpu1", "ns", accelerator="v5e", topology="2x2")
+        await h.kube.create("Notebook", nb)
+        await h.settle()
+
+        sts = await h.kube.get("StatefulSet", "tpu1", "ns")
+        assert deep_get(sts, "spec", "replicas") == 1
+        tmpl_spec = deep_get(sts, "spec", "template", "spec")
+        assert tmpl_spec["nodeSelector"] == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "2x2",
+        }
+        main = tmpl_spec["containers"][0]
+        assert main["resources"]["requests"]["google.com/tpu"] == "4"
+        assert main["resources"]["limits"]["google.com/tpu"] == "4"
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5litepod-4"
+        # Single-host slice: no headless service needed.
+        assert await h.kube.get_or_none("Service", "tpu1-workers", "ns") is None
+        # Worker env injected at admission: worker id 0.
+        pod = await h.kube.get("Pod", "tpu1-0", "ns")
+        pod_env = {
+            e["name"]: e.get("value")
+            for e in deep_get(pod, "spec", "containers")[0]["env"]
+        }
+        assert pod_env["TPU_WORKER_ID"] == "0"
+    finally:
+        await stop_harness(h)
+
+
+async def test_tpu_multi_host_slice_spawns_workers_with_distinct_ids():
+    h = await make_harness()
+    try:
+        nb = nbapi.new("big", "ns", accelerator="v5e", topology="4x4")
+        await h.kube.create("Notebook", nb)
+        await h.settle()
+
+        sts = await h.kube.get("StatefulSet", "big", "ns")
+        assert deep_get(sts, "spec", "replicas") == 2  # 16 chips / 8 per host
+        assert deep_get(sts, "spec", "serviceName") == "big-workers"
+
+        headless = await h.kube.get("Service", "big-workers", "ns")
+        assert deep_get(headless, "spec", "clusterIP") == "None"
+        assert deep_get(headless, "spec", "publishNotReadyAddresses") is True
+
+        envs = {}
+        for i in range(2):
+            pod = await h.kube.get("Pod", f"big-{i}", "ns")
+            envs[i] = {
+                e["name"]: e.get("value")
+                for e in deep_get(pod, "spec", "containers")[0]["env"]
+            }
+        assert envs[0]["TPU_WORKER_ID"] == "0"
+        assert envs[1]["TPU_WORKER_ID"] == "1"
+        assert envs[1]["JAX_PROCESS_ID"] == "1"
+        hosts = envs[0]["TPU_WORKER_HOSTNAMES"].split(",")
+        assert hosts == [
+            "big-0.big-workers.ns.svc.cluster.local",
+            "big-1.big-workers.ns.svc.cluster.local",
+        ]
+        assert envs[0]["JAX_COORDINATOR_ADDRESS"] == hosts[0] + ":8476"
+        assert envs[0]["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,4"
+        assert envs[0]["TPU_HOST_BOUNDS"] == "2,1"
+
+        nb = await h.kube.get("Notebook", "big", "ns")
+        assert deep_get(nb, "status", "tpu") == {
+            "hosts": 2, "readyHosts": 2, "chips": 16,
+        }
+    finally:
+        await stop_harness(h)
+
+
+async def test_stop_annotation_scales_to_zero_and_restart_restores():
+    h = await make_harness()
+    try:
+        await h.kube.create("Notebook", nbapi.new("nb", "ns"))
+        await h.settle()
+        assert await h.kube.get_or_none("Pod", "nb-0", "ns") is not None
+
+        await h.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: "2026-07-29"}}},
+            "ns",
+        )
+        await h.settle()
+        sts = await h.kube.get("StatefulSet", "nb", "ns")
+        assert deep_get(sts, "spec", "replicas") == 0
+        assert await h.kube.get_or_none("Pod", "nb-0", "ns") is None
+
+        await h.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: None}}},
+            "ns",
+        )
+        await h.settle()
+        sts = await h.kube.get("StatefulSet", "nb", "ns")
+        assert deep_get(sts, "spec", "replicas") == 1
+        assert await h.kube.get_or_none("Pod", "nb-0", "ns") is not None
+    finally:
+        await stop_harness(h)
+
+
+async def test_slice_atomic_restart_on_worker_failure():
+    h = await make_harness()
+    try:
+        await h.kube.create(
+            "Notebook", nbapi.new("frag", "ns", accelerator="v5e", topology="4x4")
+        )
+        await h.settle()
+        uid_before = get_meta(await h.kube.get("Pod", "frag-1", "ns"))["uid"]
+
+        # Worker 0 dies (e.g. host OOM): whole slice must restart.
+        await h.kube.patch(
+            "Pod", "frag-0", {"status": {"phase": "Failed"}}, "ns",
+            subresource="status",
+        )
+        await h.settle()
+
+        pod1 = await h.kube.get("Pod", "frag-1", "ns")
+        assert get_meta(pod1)["uid"] != uid_before  # healthy worker restarted too
+        events = await h.kube.list("Event", "ns")
+        assert any(e.get("reason") == "SliceRestart" for e in events)
+    finally:
+        await stop_harness(h)
+
+
+async def test_pod_events_are_mirrored_onto_notebook():
+    h = await make_harness()
+    try:
+        await h.kube.create("Notebook", nbapi.new("evt", "ns"))
+        await h.settle()
+        await h.kube.create(
+            "Event",
+            {
+                "metadata": {"name": "evt-0.pull", "namespace": "ns"},
+                "involvedObject": {"kind": "Pod", "name": "evt-0", "namespace": "ns"},
+                "reason": "Pulled",
+                "message": "Successfully pulled image",
+                "type": "Normal",
+            },
+        )
+        await h.settle()
+        events = await h.kube.list("Event", "ns")
+        mirrored = [
+            e for e in events
+            if e.get("involvedObject", {}).get("kind") == "Notebook"
+            and e.get("reason") == "Pulled"
+        ]
+        assert mirrored and "[pod evt-0]" in mirrored[0]["message"]
+    finally:
+        await stop_harness(h)
+
+
+async def test_istio_virtualservice_generated_with_rewrite():
+    h = await make_harness(use_istio=True)
+    try:
+        nb = nbapi.new("code", "ns")
+        get_meta(nb)["annotations"] = {nbapi.ANNOTATION_REWRITE_URI: "/"}
+        await h.kube.create("Notebook", nb)
+        await h.settle()
+        vs = await h.kube.get("VirtualService", "notebook-ns-code", "ns")
+        http = deep_get(vs, "spec", "http")[0]
+        assert http["match"][0]["uri"]["prefix"] == "/notebook/ns/code/"
+        assert http["rewrite"] == {"uri": "/"}
+        assert deep_get(vs, "spec", "gateways") == ["kubeflow/kubeflow-gateway"]
+    finally:
+        await stop_harness(h)
+
+
+async def test_invalid_tpu_spec_rejected_at_admission():
+    kube = FakeKube()
+    register_all(kube)
+    with pytest.raises(Invalid):
+        await kube.create(
+            "Notebook", nbapi.new("bad", "ns", accelerator="v99", topology="2x2")
+        )
+    with pytest.raises(Invalid):
+        await kube.create(
+            "Notebook", nbapi.new("bad2", "ns", accelerator="v5e", topology="3x5")
+        )
+
+
+async def test_poddefault_injected_into_notebook_pod():
+    h = await make_harness()
+    try:
+        await h.kube.create(
+            "PodDefault",
+            {
+                "metadata": {"name": "add-gcs", "namespace": "ns"},
+                "spec": {
+                    "selector": {"matchLabels": {"notebook-name": "pd-nb"}},
+                    "env": [{"name": "GOOGLE_CLOUD_PROJECT", "value": "proj"}],
+                    "volumes": [{"name": "dshm", "emptyDir": {"medium": "Memory"}}],
+                    "volumeMounts": [{"name": "dshm", "mountPath": "/dev/shm"}],
+                },
+            },
+        )
+        await h.kube.create("Notebook", nbapi.new("pd-nb", "ns"))
+        await h.settle()
+        pod = await h.kube.get("Pod", "pd-nb-0", "ns")
+        env = {
+            e["name"]: e.get("value")
+            for e in deep_get(pod, "spec", "containers")[0]["env"]
+        }
+        assert env["GOOGLE_CLOUD_PROJECT"] == "proj"
+        assert any(
+            v["name"] == "dshm" for v in deep_get(pod, "spec", "volumes", default=[])
+        )
+        annotations = get_meta(pod).get("annotations", {})
+        assert "poddefault.admission.kubeflow.org/poddefault-add-gcs" in annotations
+    finally:
+        await stop_harness(h)

@@ -1,0 +1,209 @@
+"""Pure-function tests of the PodDefault merge engine — the exhaustively
+unit-testable core the reference also tests first
+(``admission-webhook/main_test.go``): conflict-as-error semantics per field
+family.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import poddefault as pdapi
+from kubeflow_tpu.webhooks.poddefault import (
+    MergeConflict,
+    apply_poddefaults,
+    filter_poddefaults,
+    is_excluded,
+    safe_to_apply,
+)
+
+
+def pod(**overrides):
+    base = {
+        "metadata": {"name": "p", "namespace": "ns", "labels": {"app": "x"}},
+        "spec": {"containers": [{"name": "main", "image": "img"}]},
+    }
+    base["spec"].update(overrides.pop("spec", {}))
+    base["metadata"].update(overrides.pop("metadata", {}))
+    return base
+
+
+def pd(name="pd1", selector=None, **spec):
+    return {
+        "metadata": {"name": name, "namespace": "ns", "resourceVersion": "7"},
+        "spec": {"selector": selector or {}, **spec},
+    }
+
+
+def test_env_appended_and_identical_tolerated():
+    p = pod(spec={"containers": [
+        {"name": "main", "env": [{"name": "A", "value": "1"}]}
+    ]})
+    out = apply_poddefaults(p, [pd(env=[{"name": "A", "value": "1"},
+                                        {"name": "B", "value": "2"}])])
+    env = {e["name"]: e["value"] for e in out["spec"]["containers"][0]["env"]}
+    assert env == {"A": "1", "B": "2"}
+
+
+def test_env_conflict_raises():
+    p = pod(spec={"containers": [
+        {"name": "main", "env": [{"name": "A", "value": "1"}]}
+    ]})
+    with pytest.raises(MergeConflict):
+        apply_poddefaults(p, [pd(env=[{"name": "A", "value": "other"}])])
+
+
+def test_safe_to_apply_does_not_mutate():
+    p = pod()
+    safe_to_apply(p, [pd(env=[{"name": "X", "value": "1"}])])
+    assert "env" not in p["spec"]["containers"][0]
+
+
+def test_volume_mount_path_conflict():
+    p = pod(spec={"containers": [
+        {"name": "main",
+         "volumeMounts": [{"name": "a", "mountPath": "/data"}]}
+    ]})
+    # Different volume name, same mountPath → conflict (main.go:266-311).
+    with pytest.raises(MergeConflict):
+        apply_poddefaults(
+            p, [pd(volumeMounts=[{"name": "b", "mountPath": "/data"}])]
+        )
+
+
+def test_volumes_merge_and_conflict():
+    p = pod(spec={"volumes": [{"name": "v", "emptyDir": {}}]})
+    out = apply_poddefaults(p, [pd(volumes=[{"name": "v", "emptyDir": {}},
+                                            {"name": "w", "emptyDir": {}}])])
+    assert [v["name"] for v in out["spec"]["volumes"]] == ["v", "w"]
+    p2 = pod(spec={"volumes": [{"name": "v", "emptyDir": {}}]})
+    with pytest.raises(MergeConflict):
+        apply_poddefaults(
+            p2, [pd(volumes=[{"name": "v", "hostPath": {"path": "/x"}}])]
+        )
+
+
+def test_sidecars_and_init_containers_appended():
+    p = pod()
+    out = apply_poddefaults(
+        p,
+        [pd(sidecars=[{"name": "proxy", "image": "proxy:1"}],
+            initContainers=[{"name": "seed", "image": "busybox"}])],
+    )
+    assert [c["name"] for c in out["spec"]["containers"]] == ["main", "proxy"]
+    assert [c["name"] for c in out["spec"]["initContainers"]] == ["seed"]
+
+
+def test_sidecar_does_not_receive_env_injection():
+    p = pod()
+    out = apply_poddefaults(
+        p,
+        [pd(sidecars=[{"name": "proxy", "image": "proxy:1"}],
+            env=[{"name": "ONLY_MAIN", "value": "1"}])],
+    )
+    main, proxy = out["spec"]["containers"]
+    assert {e["name"] for e in main["env"]} == {"ONLY_MAIN"}
+    assert "env" not in proxy
+
+
+def test_command_and_args_fill_if_absent_only():
+    p = pod(spec={"containers": [
+        {"name": "main", "command": ["keep"]},
+    ]})
+    out = apply_poddefaults(
+        p, [pd(command=["override"], args=["--flag"])]
+    )
+    main = out["spec"]["containers"][0]
+    assert main["command"] == ["keep"]      # never overwritten
+    assert main["args"] == ["--flag"]       # filled because absent
+
+
+def test_labels_annotations_and_stamp():
+    p = pod()
+    out = apply_poddefaults(p, [pd(labels={"team": "ml"},
+                                   annotations={"note": "hi"})])
+    assert out["metadata"]["labels"]["team"] == "ml"
+    assert out["metadata"]["annotations"]["note"] == "hi"
+    assert (
+        out["metadata"]["annotations"][
+            "poddefault.admission.kubeflow.org/poddefault-pd1"
+        ] == "7"
+    )
+
+
+def test_label_conflict_raises():
+    p = pod(metadata={"labels": {"team": "a"}})
+    with pytest.raises(MergeConflict):
+        apply_poddefaults(p, [pd(labels={"team": "b"})])
+
+
+def test_service_account_last_wins():
+    p = pod()
+    out = apply_poddefaults(
+        p,
+        [pd("one", serviceAccountName="sa-1"),
+         pd("two", serviceAccountName="sa-2")],
+    )
+    assert out["spec"]["serviceAccountName"] == "sa-2"
+
+
+def test_tolerations_by_key():
+    p = pod(spec={"tolerations": [{"key": "tpu", "operator": "Exists"}]})
+    out = apply_poddefaults(
+        p,
+        [pd(tolerations=[{"key": "tpu", "operator": "Exists"},
+                         {"key": "spot", "operator": "Exists"}])],
+    )
+    assert [t["key"] for t in out["spec"]["tolerations"]] == ["tpu", "spot"]
+
+
+def test_env_from_plain_append():
+    p = pod(spec={"containers": [
+        {"name": "main", "envFrom": [{"configMapRef": {"name": "a"}}]}
+    ]})
+    out = apply_poddefaults(
+        p, [pd(envFrom=[{"secretRef": {"name": "s"}}])]
+    )
+    assert len(out["spec"]["containers"][0]["envFrom"]) == 2
+
+
+def test_filter_by_selector_and_exclusion():
+    pds = [
+        pd("match", selector={"matchLabels": {"app": "x"}}),
+        pd("nomatch", selector={"matchLabels": {"app": "y"}}),
+        pd("exprs", selector={"matchExpressions": [
+            {"key": "app", "operator": "In", "values": ["x", "z"]}
+        ]}),
+    ]
+    matched = filter_poddefaults(pds, pod())
+    assert [m["metadata"]["name"] for m in matched] == ["exprs", "match"]
+
+    excluded = pod(metadata={"annotations": {
+        "poddefault.admission.kubeflow.org/exclude": "true"}})
+    assert is_excluded(excluded)
+
+
+def test_two_poddefaults_same_new_item_is_fine():
+    p = pod()
+    out = apply_poddefaults(
+        p,
+        [pd("a", env=[{"name": "K", "value": "v"}]),
+         pd("b", env=[{"name": "K", "value": "v"}])],
+    )
+    assert [e["name"] for e in out["spec"]["containers"][0]["env"]] == ["K"]
+
+
+def test_two_poddefaults_conflicting_item_raises():
+    p = pod()
+    with pytest.raises(MergeConflict):
+        apply_poddefaults(
+            p,
+            [pd("a", env=[{"name": "K", "value": "v1"}]),
+             pd("b", env=[{"name": "K", "value": "v2"}])],
+        )
+
+
+def test_poddefault_validation():
+    from kubeflow_tpu.runtime.errors import Invalid
+
+    with pytest.raises(Invalid):
+        pdapi.validate({"metadata": {"name": "x"}, "spec": {}})
+    pdapi.validate(pd())  # selector present → ok
