@@ -45,6 +45,11 @@ CREATOR_ANNOTATION = "notebooks.kubeflow.org/creator"
 # Restart protocol (reference: culler pkg + odh webhook "update-pending"):
 RESTART_ANNOTATION = "notebooks.kubeflow.org/restart"
 
+# Pod-template annotations the controller stamps so pod-level admission can
+# compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
+TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"
+TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
+
 PREFIX_ENV_VAR = "NB_PREFIX"                           # notebook_controller.go:56
 DEFAULT_CONTAINER_PORT = 8888
 SERVICE_PORT = 80

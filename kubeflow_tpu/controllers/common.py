@@ -1,0 +1,40 @@
+"""Shared reconciler helpers."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.runtime.objects import deep_get
+
+
+async def rwo_affinity(kube, ns: str, claim: str) -> dict | None:
+    """Node affinity pinning to the node of the pod already mounting an RWO
+    claim, so a second mount succeeds (reference
+    ``tensorboard_controller.go:428-471``; same logic in the pvcviewer
+    controller). Returns None when the claim is not RWO or not mounted."""
+    pvc = await kube.get_or_none("PersistentVolumeClaim", claim, ns)
+    modes = deep_get(pvc or {}, "spec", "accessModes", default=[])
+    if "ReadWriteOnce" not in modes:
+        return None
+    for pod in await kube.list("Pod", ns):
+        node = deep_get(pod, "spec", "nodeName")
+        if not node or deep_get(pod, "status", "phase") not in ("Running", "Pending"):
+            continue
+        for vol in deep_get(pod, "spec", "volumes", default=[]):
+            if deep_get(vol, "persistentVolumeClaim", "claimName") == claim:
+                return {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchFields": [
+                                        {
+                                            "key": "metadata.name",
+                                            "operator": "In",
+                                            "values": [node],
+                                        }
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                }
+    return None

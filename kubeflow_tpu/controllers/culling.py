@@ -1,0 +1,224 @@
+"""Culling reconciler: probe Jupyter activity, stop idle notebooks.
+
+Reference: ``notebook-controller/controllers/culling_controller.go``:
+
+- periodic requeue every IDLENESS_CHECK_PERIOD (default 1 min, :31)
+- probes ``http://<nb>.<ns>.svc.<domain>/notebook/<ns>/<nb>/api/kernels``
+  and ``/api/terminals`` (:209-279) with a 10 s timeout (:210-212)
+- a notebook is busy if any kernel's ``execution_state`` != idle; last
+  activity folds the max of kernel/terminal ``last_activity`` (:281-315)
+- tracks ``notebooks.kubeflow.org/last-activity`` + check-timestamp
+  annotations (:156-167); idle > CULL_IDLE_TIME (default 1440 min, :30)
+  → sets the ``kubeflow-resource-stopped`` annotation, which the notebook
+  reconciler turns into replicas=0 (notebook_controller.go:410-412)
+
+TPU-native slice semantics (SURVEY.md §2.4 last row): the Jupyter server —
+and therefore kernel activity — lives on worker 0; culling one worker of a
+slice is meaningless, so the stop annotation always parks the *whole* slice
+(the notebook reconciler scales every worker to zero together). Chips are
+the scarce resource: default idle window is kept but the controller exposes
+``tpu_chips_idle_culled_total`` so operators can see reclaimed capacity.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.errors import ApiError
+from kubeflow_tpu.runtime.events import EventRecorder
+from kubeflow_tpu.runtime.manager import Controller, Manager, Result
+from kubeflow_tpu.runtime.metrics import Registry, global_registry
+from kubeflow_tpu.runtime.objects import deep_get, get_meta
+
+log = logging.getLogger(__name__)
+
+# Prober contract: GET url → parsed JSON (list) or None on any error.
+Prober = Callable[[str], Awaitable[list | None]]
+
+TIME_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def _parse_time(value: str) -> float | None:
+    for fmt in (TIME_FORMAT, "%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%S.%fz"):
+        try:
+            import calendar
+
+            return calendar.timegm(time.strptime(value, fmt))
+        except ValueError:
+            continue
+    return None
+
+
+def _fmt_time(ts: float) -> str:
+    return time.strftime(TIME_FORMAT, time.gmtime(ts))
+
+
+async def http_prober(url: str) -> list | None:
+    """Production prober over aiohttp (10 s budget like the reference)."""
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=10)
+        ) as sess:
+            async with sess.get(url) as resp:
+                if resp.status != 200:
+                    return None
+                data = await resp.json()
+                return data if isinstance(data, list) else None
+    except Exception:
+        return None
+
+
+@dataclass
+class CullingOptions:
+    """Reference env contract (culling_controller.go:511-544) as one block."""
+
+    enable_culling: bool = True
+    cull_idle_seconds: float = 1440 * 60.0     # CULL_IDLE_TIME (minutes) default
+    check_period_seconds: float = 60.0         # IDLENESS_CHECK_PERIOD
+    cluster_domain: str = "cluster.local"
+    dev_url: str | None = None                 # DEV mode: probe localhost instead
+
+
+class CullingReconciler:
+    def __init__(
+        self,
+        kube,
+        prober: Prober | None = None,
+        options: CullingOptions | None = None,
+        *,
+        clock: Callable[[], float] = time.time,
+        registry: Registry | None = None,
+    ):
+        self.kube = kube
+        self.prober = prober or http_prober
+        self.opts = options or CullingOptions()
+        self.clock = clock
+        self.recorder = EventRecorder(kube, "culling-controller")
+        registry = registry or global_registry
+        self.m_culled = registry.counter(
+            "notebook_culling_total", "Total times of culling notebooks"
+        )
+        self.m_last_cull = registry.gauge(
+            "last_notebook_culling_timestamp_seconds",
+            "Timestamp of the last notebook culling",
+            ["namespace", "notebook"],
+        )
+        self.m_chips_culled = registry.counter(
+            "tpu_chips_idle_culled_total",
+            "TPU chips reclaimed by culling idle notebooks",
+        )
+
+    def probe_url(self, name: str, ns: str, api: str) -> str:
+        if self.opts.dev_url:
+            return f"{self.opts.dev_url}/notebook/{ns}/{name}/api/{api}"
+        return (
+            f"http://{name}.{ns}.svc.{self.opts.cluster_domain}"
+            f"/notebook/{ns}/{name}/api/{api}"
+        )
+
+    async def reconcile(self, key) -> Result | None:
+        ns, name = key
+        requeue = Result(requeue_after=self.opts.check_period_seconds)
+        if not self.opts.enable_culling:
+            return None
+        nb = await self.kube.get_or_none("Notebook", name, ns)
+        if nb is None or get_meta(nb).get("deletionTimestamp"):
+            return None
+        if nbapi.is_stopped(nb):
+            return None  # already parked; notebook reconciler owns restart
+
+        now = self.clock()
+        kernels = await self.prober(self.probe_url(name, ns, "kernels"))
+        terminals = await self.prober(self.probe_url(name, ns, "terminals"))
+
+        annotations = dict(get_meta(nb).get("annotations") or {})
+        last_activity = _parse_time(
+            annotations.get(nbapi.LAST_ACTIVITY_ANNOTATION, "")
+        )
+
+        if kernels is None and terminals is None:
+            # Server unreachable (starting, crashed, or mid-restart): the
+            # reference skips the update and retries next period (:226-239).
+            return requeue
+
+        busy, probe_activity = _fold_activity(kernels or [], terminals or [])
+        if busy:
+            last_activity = now
+        elif probe_activity is not None:
+            last_activity = max(last_activity or 0, probe_activity)
+        elif last_activity is None:
+            # Fresh server, no kernels yet: start the idle clock now.
+            last_activity = now
+
+        patch_annotations = {
+            nbapi.LAST_ACTIVITY_ANNOTATION: _fmt_time(last_activity),
+            nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION: _fmt_time(now),
+        }
+
+        if not busy and now - last_activity > self.opts.cull_idle_seconds:
+            patch_annotations[nbapi.STOP_ANNOTATION] = _fmt_time(now)
+            try:
+                await self.kube.patch(
+                    "Notebook", name,
+                    {"metadata": {"annotations": patch_annotations}}, ns,
+                )
+            except ApiError:
+                return requeue
+            idle_min = (now - last_activity) / 60
+            await self.recorder.event(
+                nb, "Normal", "NotebookCulled",
+                f"Notebook idle for {idle_min:.0f} min; scaled to zero",
+            )
+            self.m_culled.inc()
+            self.m_last_cull.labels(namespace=ns or "", notebook=name).set(now)
+            chips = deep_get(nb, "status", "tpu", "chips", default=0) or 0
+            if chips:
+                self.m_chips_culled.inc(chips)
+            return None  # parked; nothing to poll until restarted
+        if any(annotations.get(k) != v for k, v in patch_annotations.items()):
+            try:
+                await self.kube.patch(
+                    "Notebook", name,
+                    {"metadata": {"annotations": patch_annotations}}, ns,
+                )
+            except ApiError:
+                pass
+        return requeue
+
+
+def _fold_activity(kernels: list, terminals: list) -> tuple[bool, float | None]:
+    """→ (busy, latest_activity_ts). A kernel not idle ⇒ busy
+    (culling_controller.go:281-315)."""
+    busy = any(
+        isinstance(k, dict) and k.get("execution_state") not in (None, "idle")
+        for k in kernels
+    )
+    times = []
+    for item in [*kernels, *terminals]:
+        if isinstance(item, dict) and item.get("last_activity"):
+            ts = _parse_time(str(item["last_activity"]))
+            if ts is not None:
+                times.append(ts)
+    return busy, (max(times) if times else None)
+
+
+def setup_culling_controller(
+    mgr: Manager,
+    prober: Prober | None = None,
+    options: CullingOptions | None = None,
+    *,
+    clock: Callable[[], float] = time.time,
+) -> CullingReconciler:
+    rec = CullingReconciler(
+        mgr.kube, prober, options, clock=clock, registry=mgr.registry
+    )
+    mgr.add_controller(
+        Controller(name="culling", kind="Notebook", reconcile=rec.reconcile)
+    )
+    return rec

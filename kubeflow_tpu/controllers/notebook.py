@@ -56,11 +56,8 @@ from kubeflow_tpu.tpu.topology import JAX_COORDINATOR_PORT, TpuSlice
 
 log = logging.getLogger(__name__)
 
-# Annotations the controller stamps on worker pods so pod-level admission can
-# compute per-worker env without fetching the Notebook (pure function of the
-# pod): see kubeflow_tpu/webhooks/tpu.py.
-TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"
-TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
+TPU_ACCELERATOR_ANNOTATION = nbapi.TPU_ACCELERATOR_ANNOTATION
+TPU_TOPOLOGY_ANNOTATION = nbapi.TPU_TOPOLOGY_ANNOTATION
 
 STS_LABEL = "statefulset"  # reference labels pods with statefulset=<name> (:429)
 POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"  # set by the STS controller
@@ -113,6 +110,7 @@ class NotebookReconciler:
         namespace, name = key
         nb = await self.kube.get_or_none("Notebook", name, namespace)
         if nb is None or get_meta(nb).get("deletionTimestamp"):
+            self._mirrored.pop((namespace, name), None)
             return None  # children die by ownerReference cascade
 
         try:
@@ -143,10 +141,8 @@ class NotebookReconciler:
     async def _ensure(self, nb: dict, desired: dict) -> bool:
         """reconcile_child with ownership; returns True when newly created."""
         set_controller_owner(desired, nb)
-        kind, name, ns = desired["kind"], name_of(desired), namespace_of(desired)
-        existed = await self.kube.get_or_none(kind, name, ns) is not None
-        await reconcile_child(self.kube, desired)
-        return not existed
+        _, created = await reconcile_child(self.kube, desired)
+        return created
 
     # ---- object generation ------------------------------------------------------
 
@@ -369,14 +365,7 @@ class NotebookReconciler:
         if not (tpu and tpu.multi_host) or nbapi.is_stopped(nb):
             return
         pods = await self._worker_pods(nb)
-        broken = [
-            p for p in pods
-            if deep_get(p, "status", "phase") == "Failed"
-            or any(
-                deep_get(cs, "state", "terminated", "exitCode") not in (None, 0)
-                for cs in deep_get(p, "status", "containerStatuses", default=[])
-            )
-        ]
+        broken = [p for p in pods if _worker_is_broken(p)]
         if not broken:
             return
         names = ", ".join(sorted(name_of(p) for p in broken))
@@ -471,6 +460,31 @@ class NotebookReconciler:
         self.m_running.labels(namespace=ns or "").set(
             1 if ready and ready == want_hosts else 0
         )
+
+
+def _worker_is_broken(pod: dict) -> bool:
+    """A worker whose container died — even once, even if kubelet already
+    restarted it in place — has broken the slice's ICI mesh: the restarted
+    process cannot rejoin (libtpu wires the mesh once at init), so the
+    healthy-looking peers are wedged. With restartPolicy Always the pod
+    rarely shows phase=Failed or a current terminated state; the durable
+    signals are restartCount > 0, a lastState.terminated, or
+    CrashLoopBackOff. Slice-atomic deletion resets restartCount to 0 on the
+    replacement pods, so this self-clears."""
+    if deep_get(pod, "status", "phase") == "Failed":
+        return True
+    for cs in deep_get(pod, "status", "containerStatuses", default=[]):
+        if cs.get("restartCount", 0) > 0:
+            return True
+        if deep_get(cs, "state", "terminated", "exitCode") not in (None, 0):
+            return True
+        if deep_get(cs, "lastState", "terminated") is not None:
+            return True
+        if deep_get(cs, "state", "waiting", "reason") in (
+            "CrashLoopBackOff", "Error",
+        ):
+            return True
+    return False
 
 
 def _condition_from_state(state: dict) -> dict | None:

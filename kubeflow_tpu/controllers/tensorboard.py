@@ -1,0 +1,216 @@
+"""Tensorboard reconciler: CR → Deployment + Service (+ VirtualService).
+
+Reference: ``tensorboard-controller/controllers/tensorboard_controller.go``:
+
+- ``Reconcile`` (:67-157), ``generateDeployment`` (:167-299) with gs://
+  creds mount (:232-247), scheme parsing (:380-410), RWO-PVC co-scheduling
+  via node affinity with the pod currently mounting the claim (:428-471,
+  gated by ``RWO_PVC_SCHEDULING``), image from env (:172), Service 80→6006,
+  VirtualService ``/tensorboard/<ns>/<name>/`` with 300 s timeout (:370).
+
+TPU-native: ``spec.profilerPlugin`` starts TensorBoard with the profile
+plugin so XLA/TPU traces written by ``jax.profiler.trace`` to the logdir
+(typically ``gs://``) are browsable — the TPU profiling story of BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from kubeflow_tpu.api import tensorboard as tbapi
+from kubeflow_tpu.controllers.common import rwo_affinity
+from kubeflow_tpu.runtime.apply import reconcile_child
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.manager import Controller, Manager, Result, Watch
+from kubeflow_tpu.runtime.objects import (
+    deep_get,
+    get_meta,
+    name_of,
+    namespace_of,
+    set_controller_owner,
+)
+
+log = logging.getLogger(__name__)
+
+TB_PORT = 6006
+
+
+@dataclass
+class TensorboardOptions:
+    image: str = "tensorflow/tensorflow:latest"      # TENSORBOARD_IMAGE
+    use_istio: bool = False
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    cluster_domain: str = "cluster.local"
+    rwo_pvc_scheduling: bool = True                   # RWO_PVC_SCHEDULING
+    gcp_creds_secret: str | None = None               # mounted for gs:// when set
+
+
+class TensorboardReconciler:
+    def __init__(self, kube, options: TensorboardOptions | None = None):
+        self.kube = kube
+        self.opts = options or TensorboardOptions()
+
+    async def reconcile(self, key) -> Result | None:
+        ns, name = key
+        tb = await self.kube.get_or_none("Tensorboard", name, ns)
+        if tb is None or get_meta(tb).get("deletionTimestamp"):
+            return None
+        try:
+            deployment = await self.generate_deployment(tb)
+        except Invalid as e:
+            log.warning("tensorboard %s/%s: %s", ns, name, e)
+            return None
+        for desired in [deployment, self.generate_service(tb)] + (
+            [self.generate_virtual_service(tb)] if self.opts.use_istio else []
+        ):
+            set_controller_owner(desired, tb)
+            await reconcile_child(self.kube, desired)
+        await self._update_status(tb)
+        return None
+
+    async def generate_deployment(self, tb: dict) -> dict:
+        name, ns = name_of(tb), namespace_of(tb)
+        logspath = str(deep_get(tb, "spec", "logspath", default=""))
+        scheme, claim, logdir = tbapi.parse_logspath(logspath)
+
+        command = [
+            "/usr/local/bin/tensorboard",
+            f"--logdir={logdir}",
+            "--bind_all",
+            f"--port={TB_PORT}",
+        ]
+        if deep_get(tb, "spec", "profilerPlugin"):
+            # XLA profiler traces refresh as training runs; poll the logdir.
+            command.append("--reload_multifile=true")
+
+        container: dict = {
+            "name": "tensorboard",
+            "image": self.opts.image,
+            "command": command,
+            "ports": [{"containerPort": TB_PORT, "name": "http", "protocol": "TCP"}],
+        }
+        volumes: list[dict] = []
+        pod_spec: dict = {"containers": [container], "volumes": volumes}
+
+        if scheme == tbapi.SCHEME_PVC:
+            volumes.append(
+                {"name": "logs", "persistentVolumeClaim": {"claimName": claim}}
+            )
+            container["volumeMounts"] = [
+                {"name": "logs", "mountPath": "/tensorboard_logs", "readOnly": True}
+            ]
+            if self.opts.rwo_pvc_scheduling:
+                affinity = await rwo_affinity(self.kube, ns, claim)
+                if affinity:
+                    pod_spec["affinity"] = affinity
+        elif scheme == tbapi.SCHEME_GCS and self.opts.gcp_creds_secret:
+            # Reference mounts user-gcp-sa creds (:232-247); on GKE prefer
+            # Workload Identity (profile plugin) — secret is the fallback.
+            volumes.append(
+                {
+                    "name": "gcp-creds",
+                    "secret": {"secretName": self.opts.gcp_creds_secret},
+                }
+            )
+            container["volumeMounts"] = [
+                {"name": "gcp-creds", "mountPath": "/secret/gcp", "readOnly": True}
+            ]
+            container["env"] = [
+                {
+                    "name": "GOOGLE_APPLICATION_CREDENTIALS",
+                    "value": "/secret/gcp/user-gcp-sa.json",
+                }
+            ]
+
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": pod_spec,
+                },
+            },
+        }
+
+    def generate_service(self, tb: dict) -> dict:
+        name, ns = name_of(tb), namespace_of(tb)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"app": name},
+                "ports": [
+                    {"name": "http", "port": 80, "targetPort": TB_PORT,
+                     "protocol": "TCP"}
+                ],
+            },
+        }
+
+    def generate_virtual_service(self, tb: dict) -> dict:
+        name, ns = name_of(tb), namespace_of(tb)
+        prefix = f"/tensorboard/{ns}/{name}/"
+        return {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {"name": f"tensorboard-{ns}-{name}", "namespace": ns},
+            "spec": {
+                "hosts": [self.opts.istio_host],
+                "gateways": [self.opts.istio_gateway],
+                "http": [
+                    {
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [
+                            {
+                                "destination": {
+                                    "host": f"{name}.{ns}.svc."
+                                    f"{self.opts.cluster_domain}",
+                                    "port": {"number": 80},
+                                }
+                            }
+                        ],
+                        "timeout": "300s",
+                    }
+                ],
+            },
+        }
+
+    async def _update_status(self, tb: dict) -> None:
+        name, ns = name_of(tb), namespace_of(tb)
+        deployment = await self.kube.get_or_none("Deployment", name, ns)
+        ready = deep_get(deployment or {}, "status", "readyReplicas", default=0) or 0
+        conditions = deep_get(deployment or {}, "status", "conditions", default=[])
+        status = {
+            "readyReplicas": ready,
+            "conditions": [
+                {"deploymentState": c.get("type", "")} for c in conditions
+            ] or ([{"deploymentState": "Available"}] if ready else []),
+        }
+        if deep_get(tb, "status") != status:
+            await self.kube.patch(
+                "Tensorboard", name, {"status": status}, ns, subresource="status"
+            )
+
+
+def setup_tensorboard_controller(
+    mgr: Manager, options: TensorboardOptions | None = None
+) -> TensorboardReconciler:
+    rec = TensorboardReconciler(mgr.kube, options)
+    mgr.add_controller(
+        Controller(
+            name="tensorboard",
+            kind="Tensorboard",
+            reconcile=rec.reconcile,
+            owns=["Deployment", "Service"]
+            + (["VirtualService"] if rec.opts.use_istio else []),
+        )
+    )
+    return rec

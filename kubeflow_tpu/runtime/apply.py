@@ -115,12 +115,14 @@ COPIERS = {
 }
 
 
-async def reconcile_child(kube, desired: dict, *, copier=None) -> dict:
-    """Ensure ``desired`` exists and owned fields match; returns the live object.
+async def reconcile_child(kube, desired: dict, *, copier=None) -> tuple[dict, bool]:
+    """Ensure ``desired`` exists and owned fields match.
 
-    The per-kind copier defaults from COPIERS; unknown kinds copy the whole
-    spec. Conflict → raise (the workqueue retries with backoff, matching the
-    reference's requeue-on-conflict behavior).
+    Returns ``(live_object, created)`` — callers that count creations (e.g.
+    the notebook_create_total metric) use the flag instead of a second
+    read-before-write. The per-kind copier defaults from COPIERS; unknown
+    kinds copy the whole spec. Conflict → raise (the workqueue retries with
+    backoff, matching the reference's requeue-on-conflict behavior).
     """
     kind = desired["kind"]
     copier = copier or COPIERS.get(kind, copy_spec)
@@ -129,10 +131,10 @@ async def reconcile_child(kube, desired: dict, *, copier=None) -> dict:
         live = await kube.get(kind, name, namespace)
     except NotFound:
         try:
-            return await kube.create(kind, desired)
+            return await kube.create(kind, desired), True
         except AlreadyExists:
             live = await kube.get(kind, name, namespace)
     if copier(desired, live):
         log.debug("updating %s %s/%s (drift)", kind, namespace, name)
-        return await kube.update(kind, live)
-    return live
+        return await kube.update(kind, live), False
+    return live, False

@@ -135,11 +135,17 @@ class Manager:
 
     async def wait_idle(self, timeout: float = 10.0, settle: float = 0.05) -> None:
         """Test helper: wait until all queues drain and stay drained."""
+        def drained() -> bool:
+            return all(
+                q.ready_count() == 0 and not q._in_flight
+                for q in self._queues.values()
+            )
+
         deadline = asyncio.get_event_loop().time() + timeout
         while asyncio.get_event_loop().time() < deadline:
-            if all(len(q) == 0 and not q._in_flight for q in self._queues.values()):
+            if drained():
                 await asyncio.sleep(settle)
-                if all(len(q) == 0 and not q._in_flight for q in self._queues.values()):
+                if drained():
                     return
             await asyncio.sleep(0.01)
         raise TimeoutError("manager queues did not drain")

@@ -31,6 +31,13 @@ class RateLimitedQueue:
     def __len__(self) -> int:
         return len(self._queued)
 
+    def ready_count(self) -> int:
+        """Keys ready to be processed now (excludes future-delayed entries —
+        a controller that perpetually requeues itself would otherwise never
+        look 'idle' to Manager.wait_idle)."""
+        now = time.monotonic()
+        return sum(1 for t in self._earliest.values() if t <= now)
+
     def add(self, key: Hashable, delay: float = 0.0) -> None:
         if self._closed:
             return
@@ -76,9 +83,12 @@ class RateLimitedQueue:
                 return None
             now = time.monotonic()
             if self._queue and self._queue[0][0] <= now:
-                _, _, key = heapq.heappop(self._queue)
-                if key not in self._queued:
-                    continue  # stale duplicate from an earlier-delay re-add
+                ready_at, _, key = heapq.heappop(self._queue)
+                # Drop stale entries: from a previous queued lifetime of the
+                # key (not queued now, or queued again with a DIFFERENT
+                # ready_at — honoring backoff set after the stale push).
+                if key not in self._queued or ready_at != self._earliest.get(key):
+                    continue
                 self._queued.discard(key)
                 self._earliest.pop(key, None)
                 self._in_flight.add(key)

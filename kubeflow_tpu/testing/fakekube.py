@@ -300,6 +300,13 @@ class FakeKube:
             meta["resourceVersion"] = self._next_rv()
             if new.get("spec") != current.get("spec"):
                 meta["generation"] = get_meta(current).get("generation", 1) + 1
+            # Removing the last finalizer from a deleting object completes the
+            # delete (same two-phase semantics as update()).
+            if get_meta(current).get("deletionTimestamp") and not meta.get("finalizers"):
+                del bucket[key]
+                self._notify("DELETED", new)
+                await self._cascade_delete(new)
+                return deepcopy(new)
             bucket[key] = deepcopy(new)
             self._notify("MODIFIED", new)
             return deepcopy(new)

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import logging
 
-from kubeflow_tpu.controllers.notebook import (
+from kubeflow_tpu.api.notebook import (
     TPU_ACCELERATOR_ANNOTATION,
     TPU_TOPOLOGY_ANNOTATION,
 )

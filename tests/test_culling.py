@@ -1,0 +1,185 @@
+"""Culling suite — fake clocks and fake probers like the reference's
+``culling_controller_test.go`` (annotation logic with stubbed URLs).
+"""
+
+import asyncio
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.culling import (
+    CullingOptions,
+    CullingReconciler,
+    _fold_activity,
+    _fmt_time,
+    setup_culling_controller,
+)
+from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get, get_meta
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_prober(responses):
+    """responses: dict url-suffix → payload; records requested URLs."""
+    calls = []
+
+    async def prober(url):
+        calls.append(url)
+        for suffix, payload in responses.items():
+            if url.endswith(suffix):
+                return payload
+        return None
+
+    prober.calls = calls
+    return prober
+
+
+def idle_kernels(ts):
+    return [{"execution_state": "idle", "last_activity": _fmt_time(ts)}]
+
+
+def busy_kernels(ts):
+    return [{"execution_state": "busy", "last_activity": _fmt_time(ts)}]
+
+
+async def test_fresh_idle_notebook_gets_activity_annotations():
+    kube = FakeKube()
+    clock = FakeClock()
+    prober = make_prober({"kernels": idle_kernels(clock.t - 50), "terminals": []})
+    rec = CullingReconciler(kube, prober, CullingOptions(), clock=clock)
+    await kube.create("Notebook", nbapi.new("nb", "ns"))
+    result = await rec.reconcile(("ns", "nb"))
+    assert result and result.requeue_after == 60.0
+    nb = await kube.get("Notebook", "nb", "ns")
+    anns = get_meta(nb)["annotations"]
+    assert anns[nbapi.LAST_ACTIVITY_ANNOTATION] == _fmt_time(clock.t - 50)
+    assert anns[nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION] == _fmt_time(clock.t)
+    assert nbapi.STOP_ANNOTATION not in anns
+    assert "http://nb.ns.svc.cluster.local/notebook/ns/nb/api/kernels" in prober.calls
+
+
+async def test_busy_kernel_resets_idle_clock():
+    kube = FakeKube()
+    clock = FakeClock()
+    opts = CullingOptions(cull_idle_seconds=100)
+    prober = make_prober({"kernels": busy_kernels(clock.t - 900), "terminals": []})
+    rec = CullingReconciler(kube, prober, opts, clock=clock)
+    await kube.create("Notebook", nbapi.new("nb", "ns"))
+    await rec.reconcile(("ns", "nb"))
+    nb = await kube.get("Notebook", "nb", "ns")
+    anns = get_meta(nb)["annotations"]
+    # Busy now ⇒ last activity is "now", regardless of stale kernel timestamps.
+    assert anns[nbapi.LAST_ACTIVITY_ANNOTATION] == _fmt_time(clock.t)
+    assert nbapi.STOP_ANNOTATION not in anns
+
+
+async def test_idle_past_threshold_sets_stop_annotation():
+    kube = FakeKube()
+    clock = FakeClock()
+    opts = CullingOptions(cull_idle_seconds=600)
+    prober = make_prober({"kernels": idle_kernels(clock.t), "terminals": []})
+    rec = CullingReconciler(kube, prober, opts, clock=clock)
+    await kube.create("Notebook", nbapi.new("nb", "ns"))
+    await rec.reconcile(("ns", "nb"))  # seeds last-activity = now
+
+    clock.t += 601
+    prober2 = make_prober({"kernels": [], "terminals": []})
+    rec.prober = prober2
+    result = await rec.reconcile(("ns", "nb"))
+    assert result is None  # parked: no more polling until restart
+    nb = await kube.get("Notebook", "nb", "ns")
+    anns = get_meta(nb)["annotations"]
+    assert nbapi.STOP_ANNOTATION in anns
+    events = await kube.list("Event", "ns")
+    assert any(e.get("reason") == "NotebookCulled" for e in events)
+
+
+async def test_unreachable_server_does_not_cull():
+    kube = FakeKube()
+    clock = FakeClock()
+    opts = CullingOptions(cull_idle_seconds=1)
+    prober = make_prober({})  # everything unreachable
+    rec = CullingReconciler(kube, prober, opts, clock=clock)
+    nb = nbapi.new("nb", "ns")
+    get_meta(nb)["annotations"] = {
+        nbapi.LAST_ACTIVITY_ANNOTATION: _fmt_time(clock.t - 10_000)
+    }
+    await kube.create("Notebook", nb)
+    result = await rec.reconcile(("ns", "nb"))
+    assert result and result.requeue_after == 60.0
+    nb = await kube.get("Notebook", "nb", "ns")
+    assert nbapi.STOP_ANNOTATION not in get_meta(nb)["annotations"]
+
+
+async def test_stopped_notebook_is_skipped():
+    kube = FakeKube()
+    prober = make_prober({"kernels": [], "terminals": []})
+    rec = CullingReconciler(kube, prober, CullingOptions(), clock=FakeClock())
+    nb = nbapi.new("nb", "ns")
+    get_meta(nb)["annotations"] = {nbapi.STOP_ANNOTATION: "t"}
+    await kube.create("Notebook", nb)
+    assert await rec.reconcile(("ns", "nb")) is None
+    assert prober.calls == []
+
+
+def test_fold_activity_semantics():
+    busy, ts = _fold_activity(
+        [{"execution_state": "busy", "last_activity": "2026-01-01T00:00:00Z"}],
+        [{"last_activity": "2026-01-02T00:00:00Z"}],
+    )
+    assert busy and ts is not None
+    busy, ts = _fold_activity([], [])
+    assert not busy and ts is None
+    # Malformed entries are ignored, not fatal.
+    busy, ts = _fold_activity(["garbage"], [{"last_activity": "not-a-time"}])
+    assert not busy and ts is None
+
+
+async def test_culled_slice_scales_to_zero_end_to_end():
+    """Culler + notebook reconciler together: idle v5e-4x4 slice → all
+    worker pods deleted, chips metric incremented."""
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr)
+    clock = FakeClock()
+    prober = make_prober({"kernels": [], "terminals": []})
+    culler = setup_culling_controller(
+        mgr, prober, CullingOptions(cull_idle_seconds=300), clock=clock
+    )
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    try:
+        await kube.create(
+            "Notebook", nbapi.new("slice", "ns", accelerator="v5e", topology="4x4")
+        )
+        for _ in range(6):
+            await mgr.wait_idle()
+            await asyncio.sleep(0.02)
+        assert await kube.get_or_none("Pod", "slice-1", "ns") is not None
+
+        clock.t += 10_000  # idle clock was seeded on the first culling pass
+        await culler.reconcile(("ns", "slice"))
+        for _ in range(6):
+            await mgr.wait_idle()
+            await asyncio.sleep(0.02)
+
+        sts = await kube.get("StatefulSet", "slice", "ns")
+        assert deep_get(sts, "spec", "replicas") == 0
+        assert await kube.get_or_none("Pod", "slice-0", "ns") is None
+        assert await kube.get_or_none("Pod", "slice-1", "ns") is None
+        assert culler.m_chips_culled.labels().value == 16.0
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        kube.close_watches()

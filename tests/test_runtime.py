@@ -123,7 +123,8 @@ async def test_reconcile_child_create_then_drift_converge():
         "ns",
         spec={"ports": [{"port": 80, "targetPort": 8888}], "selector": {"app": "nb"}},
     )
-    live = await reconcile_child(kube, desired)
+    live, created = await reconcile_child(kube, desired)
+    assert created
     # cluster assigns clusterIP out-of-band; our update must preserve it
     await kube.patch("Service", "svc", {"spec": {"clusterIP": "10.0.0.7"}}, "ns")
     desired2 = new_object(
@@ -132,12 +133,13 @@ async def test_reconcile_child_create_then_drift_converge():
         "ns",
         spec={"ports": [{"port": 80, "targetPort": 9999}], "selector": {"app": "nb"}},
     )
-    live = await reconcile_child(kube, desired2)
+    live, created = await reconcile_child(kube, desired2)
+    assert not created
     assert live["spec"]["ports"][0]["targetPort"] == 9999
     assert live["spec"]["clusterIP"] == "10.0.0.7"
     # converged: a third pass makes no update (resourceVersion stable)
     rv = live["metadata"]["resourceVersion"]
-    live = await reconcile_child(kube, desired2)
+    live, _ = await reconcile_child(kube, desired2)
     assert live["metadata"]["resourceVersion"] == rv
 
 

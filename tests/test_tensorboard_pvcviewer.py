@@ -1,0 +1,185 @@
+"""Tensorboard + PVCViewer controller suites (reference:
+tensorboard_controller.go / pvcviewer_controller.go envtest specs).
+"""
+
+import asyncio
+
+import pytest
+
+from kubeflow_tpu.api import tensorboard as tbapi
+from kubeflow_tpu.api import pvcviewer as pvcapi
+from kubeflow_tpu.controllers.pvcviewer import (
+    PVCViewerOptions,
+    setup_pvcviewer_controller,
+)
+from kubeflow_tpu.controllers.tensorboard import (
+    TensorboardOptions,
+    setup_tensorboard_controller,
+)
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+
+
+async def make_harness(tb_opts=None, pvc_opts=None):
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_tensorboard_controller(mgr, tb_opts or TensorboardOptions())
+    setup_pvcviewer_controller(mgr, pvc_opts or PVCViewerOptions())
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    return kube, mgr, sim
+
+
+async def settle(mgr):
+    for _ in range(6):
+        await mgr.wait_idle()
+        await asyncio.sleep(0.02)
+
+
+async def stop(kube, mgr, sim):
+    await sim.stop()
+    await mgr.stop()
+    kube.close_watches()
+
+
+def test_logspath_parsing():
+    assert tbapi.parse_logspath("pvc://claim/sub/dir") == (
+        "pvc", "claim", "/tensorboard_logs/sub/dir",
+    )
+    assert tbapi.parse_logspath("pvc://claim") == ("pvc", "claim", "/tensorboard_logs")
+    assert tbapi.parse_logspath("gs://bucket/run1") == ("gs", "", "gs://bucket/run1")
+    assert tbapi.parse_logspath("s3://bucket/x") == ("s3", "", "s3://bucket/x")
+    assert tbapi.parse_logspath("/local/path") == ("", "", "/local/path")
+    with pytest.raises(Invalid):
+        tbapi.parse_logspath("pvc://")
+
+
+async def test_tensorboard_pvc_deployment_and_status():
+    kube, mgr, sim = await make_harness()
+    try:
+        await kube.create(
+            "PersistentVolumeClaim",
+            {
+                "metadata": {"name": "logs", "namespace": "ns"},
+                "spec": {"accessModes": ["ReadWriteOnce"]},
+            },
+        )
+        await kube.create("Tensorboard", tbapi.new("tb", "ns", "pvc://logs/run1"))
+        await settle(mgr)
+
+        dep = await kube.get("Deployment", "tb", "ns")
+        ctr = deep_get(dep, "spec", "template", "spec", "containers")[0]
+        assert "--logdir=/tensorboard_logs/run1" in ctr["command"]
+        mounts = ctr["volumeMounts"]
+        assert mounts[0]["mountPath"] == "/tensorboard_logs" and mounts[0]["readOnly"]
+
+        svc = await kube.get("Service", "tb", "ns")
+        assert deep_get(svc, "spec", "ports")[0]["targetPort"] == 6006
+
+        tb = await kube.get("Tensorboard", "tb", "ns")
+        assert deep_get(tb, "status", "readyReplicas") == 1
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_tensorboard_gcs_with_profiler_plugin():
+    kube, mgr, sim = await make_harness(
+        tb_opts=TensorboardOptions(gcp_creds_secret="user-gcp-sa")
+    )
+    try:
+        await kube.create(
+            "Tensorboard", tbapi.new("xla", "ns", "gs://bkt/traces", profiler=True)
+        )
+        await settle(mgr)
+        dep = await kube.get("Deployment", "xla", "ns")
+        ctr = deep_get(dep, "spec", "template", "spec", "containers")[0]
+        assert "--logdir=gs://bkt/traces" in ctr["command"]
+        assert "--reload_multifile=true" in ctr["command"]
+        env = {e["name"]: e["value"] for e in ctr["env"]}
+        assert env["GOOGLE_APPLICATION_CREDENTIALS"].endswith("user-gcp-sa.json")
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_tensorboard_rwo_coscheduling_pins_node():
+    kube, mgr, sim = await make_harness()
+    try:
+        await kube.create(
+            "PersistentVolumeClaim",
+            {
+                "metadata": {"name": "rwo", "namespace": "ns"},
+                "spec": {"accessModes": ["ReadWriteOnce"]},
+            },
+        )
+        # A running pod already mounts the claim on node-7.
+        await kube.create(
+            "Pod",
+            {
+                "metadata": {"name": "user-nb-0", "namespace": "ns"},
+                "spec": {
+                    "nodeName": "node-7",
+                    "containers": [{"name": "x", "image": "i"}],
+                    "volumes": [
+                        {"name": "w",
+                         "persistentVolumeClaim": {"claimName": "rwo"}}
+                    ],
+                },
+                "status": {"phase": "Running"},
+            },
+        )
+        await kube.patch("Pod", "user-nb-0", {"status": {"phase": "Running"}},
+                         "ns", subresource="status")
+        await kube.create("Tensorboard", tbapi.new("tb2", "ns", "pvc://rwo"))
+        await settle(mgr)
+        dep = await kube.get("Deployment", "tb2", "ns")
+        terms = deep_get(
+            dep, "spec", "template", "spec", "affinity", "nodeAffinity",
+            "requiredDuringSchedulingIgnoredDuringExecution", "nodeSelectorTerms",
+        )
+        assert terms[0]["matchFields"][0]["values"] == ["node-7"]
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_invalid_logspath_rejected_at_admission():
+    kube = FakeKube()
+    register_all(kube)
+    with pytest.raises(Invalid):
+        await kube.create("Tensorboard", tbapi.new("bad", "ns", ""))
+
+
+async def test_pvcviewer_defaulting_and_children():
+    kube, mgr, sim = await make_harness(
+        pvc_opts=PVCViewerOptions(use_istio=True)
+    )
+    try:
+        await kube.create("PVCViewer", pvcapi.new("view", "ns", "data-pvc"))
+        await settle(mgr)
+
+        viewer = await kube.get("PVCViewer", "view", "ns")
+        # Admission defaulting filled the pod spec + volume.
+        pod_spec = deep_get(viewer, "spec", "podSpec")
+        assert pod_spec["containers"][0]["name"] == "pvcviewer"
+        vols = pod_spec["volumes"]
+        assert vols[0]["persistentVolumeClaim"]["claimName"] == "data-pvc"
+
+        dep = await kube.get("Deployment", "view-pvcviewer", "ns")
+        assert deep_get(dep, "spec", "replicas") == 1
+        svc = await kube.get("Service", "view-pvcviewer", "ns")
+        assert deep_get(svc, "spec", "ports")[0]["targetPort"] == 8080
+        vs = await kube.get("VirtualService", "pvcviewer-ns-view", "ns")
+        assert deep_get(vs, "spec", "http")[0]["match"][0]["uri"]["prefix"] == (
+            "/pvcviewer/ns/view/"
+        )
+
+        viewer = await kube.get("PVCViewer", "view", "ns")
+        assert deep_get(viewer, "status", "ready") is True
+        assert deep_get(viewer, "status", "url") == "/pvcviewer/ns/view/"
+    finally:
+        await stop(kube, mgr, sim)
