@@ -33,28 +33,13 @@ from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result
 from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.runtime.objects import deep_get, get_meta
+from kubeflow_tpu.runtime.objects import fmt_iso as _fmt_time
+from kubeflow_tpu.runtime.objects import parse_iso as _parse_time
 
 log = logging.getLogger(__name__)
 
 # Prober contract: GET url → parsed JSON (list) or None on any error.
 Prober = Callable[[str], Awaitable[list | None]]
-
-TIME_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
-
-
-def _parse_time(value: str) -> float | None:
-    for fmt in (TIME_FORMAT, "%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%S.%fz"):
-        try:
-            import calendar
-
-            return calendar.timegm(time.strptime(value, fmt))
-        except ValueError:
-            continue
-    return None
-
-
-def _fmt_time(ts: float) -> str:
-    return time.strftime(TIME_FORMAT, time.gmtime(ts))
 
 
 async def http_prober(url: str) -> list | None:
@@ -135,17 +120,18 @@ class CullingReconciler:
 
         now = self.clock()
         kernels = await self.prober(self.probe_url(name, ns, "kernels"))
+        if kernels is None:
+            # Kernels probe unreachable/invalid (server starting, crashed, or
+            # mid-restart): without it a busy kernel is indistinguishable
+            # from idle — never make a cull decision on a failed probe
+            # (reference skips and retries, :226-239).
+            return requeue
         terminals = await self.prober(self.probe_url(name, ns, "terminals"))
 
         annotations = dict(get_meta(nb).get("annotations") or {})
         last_activity = _parse_time(
             annotations.get(nbapi.LAST_ACTIVITY_ANNOTATION, "")
         )
-
-        if kernels is None and terminals is None:
-            # Server unreachable (starting, crashed, or mid-restart): the
-            # reference skips the update and retries next period (:226-239).
-            return requeue
 
         busy, probe_activity = _fold_activity(kernels or [], terminals or [])
         if busy:

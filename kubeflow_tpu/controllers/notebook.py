@@ -50,6 +50,7 @@ from kubeflow_tpu.runtime.objects import (
     get_meta,
     name_of,
     namespace_of,
+    now_iso,
     set_controller_owner,
 )
 from kubeflow_tpu.tpu.topology import JAX_COORDINATOR_PORT, TpuSlice
@@ -490,9 +491,7 @@ def _worker_is_broken(pod: dict) -> bool:
 def _condition_from_state(state: dict) -> dict | None:
     """ContainerState → NotebookCondition (Running|Waiting|Terminated),
     reference notebook_types.go:46-63 + status mirroring."""
-    import time
-
-    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    now = now_iso()
     if "running" in state:
         return {"type": "Running", "status": "True", "lastProbeTime": now}
     if "waiting" in state:

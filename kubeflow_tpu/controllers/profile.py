@@ -20,13 +20,12 @@ files).
 from __future__ import annotations
 
 import logging
-import time
 from dataclasses import dataclass, field
 from typing import Protocol
 
 from kubeflow_tpu.api import profile as profileapi
 from kubeflow_tpu.runtime.apply import reconcile_child
-from kubeflow_tpu.runtime.errors import ApiError, NotFound
+from kubeflow_tpu.runtime.errors import AlreadyExists, ApiError, NotFound
 from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result
 from kubeflow_tpu.runtime.metrics import Registry, global_registry
@@ -34,6 +33,7 @@ from kubeflow_tpu.runtime.objects import (
     deep_get,
     get_meta,
     name_of,
+    now_iso,
     set_controller_owner,
 )
 
@@ -215,8 +215,8 @@ class ProfileReconciler:
             set_controller_owner(sa, profile)
             try:
                 await self.kube.create("ServiceAccount", sa)
-            except ApiError:
-                pass  # exists — plugin annotations are patched separately
+            except AlreadyExists:
+                pass  # plugin annotations are patched separately
 
     def _role_bindings(self, profile: dict) -> list[dict]:
         ns = name_of(profile)
@@ -334,7 +334,7 @@ class ProfileReconciler:
             pass
 
     async def _set_condition(self, profile: dict, ctype: str, message: str) -> None:
-        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        now = now_iso()
         conditions = [{"type": ctype, "status": "True", "message": message,
                        "lastTransitionTime": now}]
         current = deep_get(profile, "status", "conditions", default=[])

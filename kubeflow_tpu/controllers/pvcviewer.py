@@ -51,10 +51,13 @@ class PVCViewerReconciler:
         children = [deployment, self.generate_service(viewer)]
         if self.opts.use_istio:
             children.append(self.generate_virtual_service(viewer))
+        live_deployment = None
         for desired in children:
             set_controller_owner(desired, viewer)
-            await reconcile_child(self.kube, desired)
-        await self._update_status(viewer)
+            live, _ = await reconcile_child(self.kube, desired)
+            if desired["kind"] == "Deployment":
+                live_deployment = live
+        await self._update_status(viewer, live_deployment)
         return None
 
     async def generate_deployment(self, viewer: dict) -> dict:
@@ -139,9 +142,8 @@ class PVCViewerReconciler:
             },
         }
 
-    async def _update_status(self, viewer: dict) -> None:
+    async def _update_status(self, viewer: dict, deployment: dict | None) -> None:
         name, ns = name_of(viewer), namespace_of(viewer)
-        deployment = await self.kube.get_or_none("Deployment", f"{name}-pvcviewer", ns)
         ready = deep_get(deployment or {}, "status", "readyReplicas", default=0) or 0
         replicas = deep_get(deployment or {}, "spec", "replicas", default=1)
         status = {

@@ -62,12 +62,15 @@ class TensorboardReconciler:
         except Invalid as e:
             log.warning("tensorboard %s/%s: %s", ns, name, e)
             return None
+        live_deployment = None
         for desired in [deployment, self.generate_service(tb)] + (
             [self.generate_virtual_service(tb)] if self.opts.use_istio else []
         ):
             set_controller_owner(desired, tb)
-            await reconcile_child(self.kube, desired)
-        await self._update_status(tb)
+            live, _ = await reconcile_child(self.kube, desired)
+            if desired["kind"] == "Deployment":
+                live_deployment = live
+        await self._update_status(tb, live_deployment)
         return None
 
     async def generate_deployment(self, tb: dict) -> dict:
@@ -183,9 +186,8 @@ class TensorboardReconciler:
             },
         }
 
-    async def _update_status(self, tb: dict) -> None:
+    async def _update_status(self, tb: dict, deployment: dict | None) -> None:
         name, ns = name_of(tb), namespace_of(tb)
-        deployment = await self.kube.get_or_none("Deployment", name, ns)
         ready = deep_get(deployment or {}, "status", "readyReplicas", default=0) or 0
         conditions = deep_get(deployment or {}, "status", "conditions", default=[])
         status = {

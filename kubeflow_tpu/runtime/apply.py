@@ -107,11 +107,26 @@ def _copy_path(desired: dict, live: dict, path: tuple[str, ...]) -> bool:
     return True
 
 
+def copy_rolebinding_fields(desired: dict, live: dict) -> bool:
+    """RoleBindings have no spec: the owned payload is subjects (+ roleRef).
+    Note roleRef is immutable on a real apiserver — our bindings derive the
+    role from the binding *name*, so a roleRef change implies a new name
+    (delete + create), never an in-place update."""
+    changed = _copy_meta(desired, live)
+    for field in ("subjects", "roleRef"):
+        want = desired.get(field)
+        if want is not None and not subset_equal(want, live.get(field)):
+            live[field] = deepcopy(want)
+            changed = True
+    return changed
+
+
 COPIERS = {
     "StatefulSet": copy_statefulset_fields,
     "Deployment": copy_deployment_fields,
     "Service": copy_service_fields,
     "VirtualService": copy_virtual_service,
+    "RoleBinding": copy_rolebinding_fields,
 }
 
 

@@ -8,14 +8,10 @@ side, with count aggregation like client-go's EventRecorder.
 from __future__ import annotations
 
 import hashlib
-import time
 
 from kubeflow_tpu.runtime.errors import ApiError, NotFound
 from kubeflow_tpu.runtime.objects import name_of, namespace_of, uid_of
-
-
-def _now() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+from kubeflow_tpu.runtime.objects import now_iso as _now
 
 
 class EventRecorder:

@@ -8,8 +8,29 @@ is a *literal PodSpec* (``notebook-controller/api/v1/notebook_types.go:27-34``)
 
 from __future__ import annotations
 
+import calendar
 import copy
+import time
 from typing import Any
+
+ISO_FORMAT = "%Y-%m-%dT%H:%M:%SZ"  # k8s RFC3339 second precision
+
+
+def fmt_iso(ts: float) -> str:
+    return time.strftime(ISO_FORMAT, time.gmtime(ts))
+
+
+def now_iso() -> str:
+    return fmt_iso(time.time())
+
+
+def parse_iso(value: str) -> float | None:
+    for fmt in (ISO_FORMAT, "%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%S.%fz"):
+        try:
+            return calendar.timegm(time.strptime(value, fmt))
+        except ValueError:
+            continue
+    return None
 
 
 def new_object(

@@ -23,7 +23,6 @@ from __future__ import annotations
 import asyncio
 import copy
 import fnmatch
-import time
 import uuid
 from collections import defaultdict
 from typing import Any, AsyncIterator, Awaitable, Callable
@@ -42,14 +41,11 @@ from kubeflow_tpu.runtime.objects import (
     namespace_of,
     parse_label_selector,
 )
+from kubeflow_tpu.runtime.objects import now_iso as _now
 from kubeflow_tpu.runtime.scheme import DEFAULT_SCHEME, Scheme
 
 Mutator = Callable[[dict, dict], Awaitable[None] | None]  # (obj, request-info)
 Validator = Callable[[dict, dict], Awaitable[None] | None]
-
-
-def _now() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
 class _Watch:

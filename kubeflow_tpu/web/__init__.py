@@ -1,0 +1,10 @@
+"""Web-app backends (the reference's ``crud-web-apps`` layer, SURVEY.md §2.2).
+
+Reference stack: Flask blueprints over the kubernetes python client, one
+backend per app (jupyter/volumes/tensorboards) sharing the
+``kubeflow.kubeflow.crud_backend`` pip package. Here the backends are
+aiohttp applications sharing ``kubeflow_tpu.web.common`` — async end to end,
+talking to the same ``KubeApi`` surface the controllers use (FakeKube in
+tests, HttpKube in deployment), so the whole stack runs in one process when
+embedding and scales out as separate deployments in production.
+"""

@@ -1,0 +1,121 @@
+"""aiohttp app factory with the cross-cutting middleware stack.
+
+Reference: ``crud_backend/__init__.py:16-35`` (create_app) with:
+
+- authn: trusted userid header (authn.py:34-67) — 401 when absent unless a
+  dev default user is configured
+- CSRF double-submit cookie (csrf.py:59-113): safe methods set/refresh the
+  ``XSRF-TOKEN`` cookie; mutating methods must echo it in ``X-XSRF-TOKEN``
+- error mapping: ApiError subclasses → JSON envelope with the right HTTP
+  status (the reference's ``{success, status, log}`` envelope)
+- liveness/readiness blueprint (probes.py)
+- /metrics in Prometheus text format (the reference exposes metrics from
+  controllers only; here every backend serves its registry)
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets
+
+from aiohttp import web
+
+from kubeflow_tpu.runtime.errors import ApiError, Unauthorized
+from kubeflow_tpu.runtime.metrics import Registry, global_registry
+from kubeflow_tpu.web.common.auth import USERID_HEADER, AllowAll, Authorizer
+
+log = logging.getLogger(__name__)
+
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "X-XSRF-TOKEN"
+SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+
+
+def json_success(payload: dict | None = None, status: int = 200) -> web.Response:
+    return web.json_response({"success": True, "status": status, **(payload or {})},
+                             status=status)
+
+
+def json_error(message: str, status: int = 500) -> web.Response:
+    return web.json_response(
+        {"success": False, "status": status, "log": message}, status=status
+    )
+
+
+def create_base_app(
+    kube,
+    *,
+    authorizer: Authorizer | None = None,
+    userid_header: str = USERID_HEADER,
+    userid_prefix: str = "",
+    dev_default_user: str | None = None,
+    csrf_protect: bool = True,
+    registry: Registry | None = None,
+) -> web.Application:
+    registry = registry or global_registry
+    m_requests = registry.counter(
+        "web_app_requests_total", "Backend HTTP requests", ["method", "status"]
+    )
+
+    @web.middleware
+    async def error_middleware(request: web.Request, handler):
+        try:
+            resp = await handler(request)
+        except web.HTTPException:
+            raise
+        except ApiError as e:
+            log.info("%s %s -> %s", request.method, request.path, e.reason)
+            resp = json_error(e.message, e.code)
+        except Exception:
+            log.exception("%s %s failed", request.method, request.path)
+            resp = json_error("internal error", 500)
+        m_requests.labels(method=request.method, status=str(resp.status)).inc()
+        return resp
+
+    @web.middleware
+    async def authn_middleware(request: web.Request, handler):
+        if request.path in ("/healthz", "/readyz", "/metrics"):
+            return await handler(request)
+        user = request.headers.get(userid_header)
+        if user is None:
+            if dev_default_user is None:
+                raise Unauthorized(f"missing {userid_header} header")
+            user = dev_default_user
+        if userid_prefix and user.startswith(userid_prefix):
+            user = user[len(userid_prefix):]
+        request["user"] = user
+        return await handler(request)
+
+    @web.middleware
+    async def csrf_middleware(request: web.Request, handler):
+        if not csrf_protect or request.path in ("/healthz", "/readyz", "/metrics"):
+            return await handler(request)
+        cookie = request.cookies.get(CSRF_COOKIE)
+        if request.method not in SAFE_METHODS:
+            header = request.headers.get(CSRF_HEADER)
+            if not cookie or not header or not secrets.compare_digest(cookie, header):
+                return json_error("CSRF token missing or invalid", 403)
+        resp = await handler(request)
+        if request.method in SAFE_METHODS and not cookie:
+            resp.set_cookie(
+                CSRF_COOKIE, secrets.token_urlsafe(32),
+                samesite="Strict", secure=False, httponly=False,
+            )
+        return resp
+
+    app = web.Application(
+        middlewares=[error_middleware, authn_middleware, csrf_middleware]
+    )
+    app["kube"] = kube
+    app["authorizer"] = authorizer or AllowAll()
+
+    async def healthz(_request):
+        return web.json_response({"status": "ok"})
+
+    async def metrics(_request):
+        return web.Response(text=registry.expose(), content_type="text/plain")
+
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", healthz)
+    app.router.add_get("/metrics", metrics)
+    return app

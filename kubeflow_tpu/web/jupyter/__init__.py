@@ -1,0 +1,5 @@
+"""Jupyter web app (JWA) backend — notebook CRUD for the spawner UI."""
+
+from kubeflow_tpu.web.jupyter.app import create_app
+
+__all__ = ["create_app"]

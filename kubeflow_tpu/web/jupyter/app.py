@@ -1,0 +1,212 @@
+"""JWA routes.
+
+Reference: ``crud-web-apps/jupyter/backend/apps/common/routes/get.py:13-126``
+(config/pvcs/poddefaults/notebooks/pod/logs/events/gpu-vendors),
+``apps/default/routes/post.py:12-77`` (dry-run-first create),
+``apps/common/routes/patch.py`` (stop/start), DELETE foreground.
+
+REST contract kept wire-compatible:
+``/api/namespaces/<ns>/notebooks[...]``, plus ``/api/tpus`` replacing
+``/api/gpus`` (accelerator+topology options instead of vendor limitsKeys).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.errors import Invalid, NotFound
+from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
+from kubeflow_tpu.web.common.app import create_base_app, json_success
+from kubeflow_tpu.web.common.auth import ensure
+from kubeflow_tpu.web.common.status import process_status
+from kubeflow_tpu.web.jupyter.form import notebook_from_form
+from kubeflow_tpu.web.jupyter.spawner_config import load_config, tpu_options
+
+
+def create_app(kube, *, config: dict | None = None, config_path: str | None = None,
+               **kwargs) -> web.Application:
+    app = create_base_app(kube, **kwargs)
+    app["config"] = config or load_config(config_path)
+    app.add_routes(routes)
+    return app
+
+
+routes = web.RouteTableDef()
+
+
+def _ctx(request: web.Request):
+    return (
+        request.app["kube"],
+        request.app["authorizer"],
+        request.get("user", ""),
+        request.match_info.get("namespace"),
+    )
+
+
+async def _notebook_events(kube, ns: str, name: str) -> list[dict]:
+    out = []
+    for ev in await kube.list("Event", ns):
+        involved = ev.get("involvedObject") or {}
+        if involved.get("kind") == "Notebook" and involved.get("name") == name:
+            out.append(ev)
+    return out
+
+
+@routes.get("/api/config")
+async def get_config(request):
+    return json_success({"config": request.app["config"]})
+
+
+@routes.get("/api/tpus")
+async def get_tpus(request):
+    """Replaces the reference's /api/namespaces/<ns>/gpus vendor scan
+    (get.py:101-126): TPU options are static facts of the fleet, served
+    from the topology library."""
+    return json_success({"tpus": tpu_options()})
+
+
+@routes.get("/api/namespaces/{namespace}/notebooks")
+async def list_notebooks(request):
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "list", "Notebook", ns)
+    notebooks = []
+    for nb in await kube.list("Notebook", ns):
+        events = await _notebook_events(kube, ns, name_of(nb))
+        status = process_status(nb, events)
+        notebooks.append(_summarize(nb, status))
+    return json_success({"notebooks": notebooks})
+
+
+def _summarize(nb: dict, status) -> dict:
+    meta = get_meta(nb)
+    containers = deep_get(nb, "spec", "template", "spec", "containers", default=[{}])
+    tpu = deep_get(nb, "spec", "tpu")
+    return {
+        "name": meta.get("name"),
+        "namespace": meta.get("namespace"),
+        "serverType": (meta.get("annotations") or {}).get(
+            nbapi.SERVER_TYPE_ANNOTATION, "jupyter"
+        ),
+        "age": meta.get("creationTimestamp"),
+        "image": containers[0].get("image", ""),
+        "cpu": deep_get(containers[0], "resources", "requests", "cpu"),
+        "memory": deep_get(containers[0], "resources", "requests", "memory"),
+        "tpu": tpu,
+        "tpuStatus": deep_get(nb, "status", "tpu"),
+        "status": {"phase": status.phase, "message": status.message},
+        "labels": meta.get("labels") or {},
+        "annotations": meta.get("annotations") or {},
+    }
+
+
+@routes.get("/api/namespaces/{namespace}/notebooks/{name}")
+async def get_notebook(request):
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "get", "Notebook", ns)
+    nb = await kube.get("Notebook", name, ns)
+    events = await _notebook_events(kube, ns, name)
+    return json_success(
+        {"notebook": nb,
+         "status": process_status(nb, events).__dict__}
+    )
+
+
+@routes.get("/api/namespaces/{namespace}/notebooks/{name}/pod")
+async def get_notebook_pod(request):
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "get", "Pod", ns)
+    pods = await kube.list(
+        "Pod", ns, label_selector={"matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name}}
+    )
+    if not pods:
+        raise NotFound(f"no pods for notebook {name}")
+    return json_success({"pod": pods[0], "pods": pods})
+
+
+@routes.get("/api/namespaces/{namespace}/notebooks/{name}/events")
+async def get_notebook_events(request):
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "list", "Event", ns)
+    return json_success({"events": await _notebook_events(kube, ns, name)})
+
+
+@routes.get("/api/namespaces/{namespace}/pvcs")
+async def list_pvcs(request):
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "list", "PersistentVolumeClaim", ns)
+    return json_success({"pvcs": await kube.list("PersistentVolumeClaim", ns)})
+
+
+@routes.get("/api/namespaces/{namespace}/poddefaults")
+async def list_poddefaults(request):
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "list", "PodDefault", ns)
+    pds = await kube.list("PodDefault", ns)
+    # The UI shows label + description pairs (get.py:36-50).
+    contents = [
+        {
+            "label": _pd_label(pd),
+            "desc": deep_get(pd, "spec", "desc", default=name_of(pd)),
+        }
+        for pd in pds
+    ]
+    return json_success({"poddefaults": contents})
+
+
+def _pd_label(pd: dict) -> str:
+    match_labels = deep_get(pd, "spec", "selector", "matchLabels", default={})
+    return next(iter(match_labels), name_of(pd))
+
+
+@routes.post("/api/namespaces/{namespace}/notebooks")
+async def post_notebook(request):
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "create", "Notebook", ns)
+    body = await request.json()
+    nb, pvcs = notebook_from_form(request.app["config"], body, ns, user)
+    for pvc in pvcs:
+        await ensure(authz, user, "create", "PersistentVolumeClaim", ns)
+        existing = await kube.get_or_none(
+            "PersistentVolumeClaim", name_of(pvc), ns
+        )
+        if existing is None:
+            await kube.create("PersistentVolumeClaim", pvc)
+    await kube.create("Notebook", nb)
+    return json_success({"message": f"Notebook {name_of(nb)} created"}, status=200)
+
+
+@routes.patch("/api/namespaces/{namespace}/notebooks/{name}")
+async def patch_notebook(request):
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "patch", "Notebook", ns)
+    body = await request.json()
+    if "stopped" not in body:
+        raise Invalid("PATCH body must contain 'stopped'")
+    if body["stopped"]:
+        import time
+
+        annotations = {
+            nbapi.STOP_ANNOTATION: time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            )
+        }
+    else:
+        annotations = {nbapi.STOP_ANNOTATION: None}
+    await kube.patch(
+        "Notebook", name, {"metadata": {"annotations": annotations}}, ns
+    )
+    return json_success({"message": f"Notebook {name} updated"})
+
+
+@routes.delete("/api/namespaces/{namespace}/notebooks/{name}")
+async def delete_notebook(request):
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "delete", "Notebook", ns)
+    await kube.delete("Notebook", name, ns)
+    return json_success({"message": f"Notebook {name} deleted"})

@@ -1,0 +1,5 @@
+"""Kubeflow Access Management (KFAM) service."""
+
+from kubeflow_tpu.web.kfam.app import create_app
+
+__all__ = ["create_app"]

@@ -1,0 +1,5 @@
+"""Tensorboards web app (TWA) backend."""
+
+from kubeflow_tpu.web.tensorboards.app import create_app
+
+__all__ = ["create_app"]

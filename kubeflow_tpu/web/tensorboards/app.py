@@ -1,0 +1,71 @@
+"""TWA routes: Tensorboard CRUD.
+
+Reference: ``crud-web-apps/tensorboards/backend/app/routes/{get,post,delete}.py``.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.api import tensorboard as tbapi
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
+from kubeflow_tpu.web.common.app import create_base_app, json_success
+from kubeflow_tpu.web.common.auth import ensure
+
+
+def create_app(kube, **kwargs) -> web.Application:
+    app = create_base_app(kube, **kwargs)
+    app.add_routes(routes)
+    return app
+
+
+routes = web.RouteTableDef()
+
+
+def _ctx(request: web.Request):
+    return (
+        request.app["kube"],
+        request.app["authorizer"],
+        request.get("user", ""),
+        request.match_info.get("namespace"),
+    )
+
+
+@routes.get("/api/namespaces/{namespace}/tensorboards")
+async def list_tensorboards(request):
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "list", "Tensorboard", ns)
+    tensorboards = [
+        {
+            "name": name_of(tb),
+            "namespace": ns,
+            "logspath": deep_get(tb, "spec", "logspath"),
+            "ready": bool(deep_get(tb, "status", "readyReplicas", default=0)),
+            "age": get_meta(tb).get("creationTimestamp"),
+        }
+        for tb in await kube.list("Tensorboard", ns)
+    ]
+    return json_success({"tensorboards": tensorboards})
+
+
+@routes.post("/api/namespaces/{namespace}/tensorboards")
+async def post_tensorboard(request):
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "create", "Tensorboard", ns)
+    body = await request.json()
+    name, logspath = body.get("name", ""), body.get("logspath", "")
+    if not name or not logspath:
+        raise Invalid("tensorboard form: name and logspath are required")
+    tb = tbapi.new(name, ns, logspath, profiler=bool(body.get("profilerPlugin")))
+    await kube.create("Tensorboard", tb)
+    return json_success({"message": f"Tensorboard {name} created"})
+
+
+@routes.delete("/api/namespaces/{namespace}/tensorboards/{name}")
+async def delete_tensorboard(request):
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "delete", "Tensorboard", ns)
+    await kube.delete("Tensorboard", name, ns)
+    return json_success({"message": f"Tensorboard {name} deleted"})

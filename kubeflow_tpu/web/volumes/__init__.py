@@ -1,0 +1,5 @@
+"""Volumes web app (VWA) backend."""
+
+from kubeflow_tpu.web.volumes.app import create_app
+
+__all__ = ["create_app"]

@@ -23,6 +23,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import warnings  # noqa: E402
+
+warnings.filterwarnings("ignore", message=".*web.AppKey.*")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,197 @@
+"""KFAM + dashboard BFF suites (reference: api_workgroup_test.ts 473 LoC,
+kfam handler behaviors)."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.api import profile as profileapi
+from kubeflow_tpu.controllers.profile import setup_profile_controller
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.web.dashboard import create_app as create_dashboard
+from kubeflow_tpu.web.kfam import create_app as create_kfam
+from kubeflow_tpu.webhooks import register_all
+
+ALICE = {"kubeflow-userid": "alice@example.com"}
+BOB = {"kubeflow-userid": "bob@example.com"}
+
+
+async def start_client(app, clients):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    clients.append(client)
+    return client
+
+
+async def csrf(client, path, headers):
+    resp = await client.get(path, headers=headers)
+    await resp.release()
+    token = client.session.cookie_jar.filter_cookies(
+        client.make_url("/")).get("XSRF-TOKEN")
+    return {**headers, "X-XSRF-TOKEN": token.value if token else ""}
+
+
+async def test_kfam_profile_and_binding_lifecycle():
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_profile_controller(mgr)
+    await mgr.start()
+    clients = []
+    try:
+        kfam = await start_client(
+            create_kfam(kube, cluster_admins={"root@example.com"}), clients
+        )
+        headers = await csrf(kfam, "/kfam/v1/bindings", ALICE)
+
+        resp = await kfam.post(
+            "/kfam/v1/profiles",
+            json={"name": "team-alpha", "user": "alice@example.com"},
+            headers=headers,
+        )
+        assert resp.status == 200
+        for _ in range(5):
+            await mgr.wait_idle()
+            await asyncio.sleep(0.02)
+
+        # Owner invites bob as contributor.
+        resp = await kfam.post(
+            "/kfam/v1/bindings",
+            json={
+                "user": {"kind": "User", "name": "bob@example.com"},
+                "referredNamespace": "team-alpha",
+                "roleRef": {"kind": "ClusterRole", "name": "edit"},
+            },
+            headers=headers,
+        )
+        assert resp.status == 200, await resp.text()
+        rb = await kube.get(
+            "RoleBinding", "user-bob-example-com-clusterrole-edit", "team-alpha"
+        )
+        assert rb["roleRef"]["name"] == "kubeflow-edit"
+
+        resp = await kfam.get(
+            "/kfam/v1/bindings?namespace=team-alpha", headers=headers
+        )
+        bindings = (await resp.json())["bindings"]
+        assert {
+            "user": {"kind": "User", "name": "bob@example.com"},
+            "referredNamespace": "team-alpha",
+            "roleRef": {"kind": "ClusterRole", "name": "edit"},
+        } in bindings
+
+        # Non-owner cannot bind.
+        bob_headers = await csrf(kfam, "/kfam/v1/bindings", BOB)
+        resp = await kfam.post(
+            "/kfam/v1/bindings",
+            json={
+                "user": {"kind": "User", "name": "eve@example.com"},
+                "referredNamespace": "team-alpha",
+                "roleRef": {"kind": "ClusterRole", "name": "admin"},
+            },
+            headers=bob_headers,
+        )
+        assert resp.status == 403
+
+        # Owner removes the binding.
+        resp = await kfam.delete(
+            "/kfam/v1/bindings",
+            json={
+                "user": {"kind": "User", "name": "bob@example.com"},
+                "referredNamespace": "team-alpha",
+                "roleRef": {"kind": "ClusterRole", "name": "edit"},
+            },
+            headers=headers,
+        )
+        assert resp.status == 200
+        assert (
+            await kube.get_or_none(
+                "RoleBinding", "user-bob-example-com-clusterrole-edit",
+                "team-alpha",
+            )
+            is None
+        )
+    finally:
+        for c in clients:
+            await c.close()
+        await mgr.stop()
+        kube.close_watches()
+
+
+async def test_dashboard_workgroup_and_tpu_usage():
+    kube = FakeKube()
+    register_all(kube)
+    clients = []
+    try:
+        dash = await start_client(create_dashboard(kube), clients)
+        headers = await csrf(dash, "/api/dashboard-links", ALICE)
+
+        # No profile yet → no workgroup, registration offered.
+        resp = await dash.get("/api/workgroup/exists", headers=headers)
+        body = await resp.json()
+        assert body["hasWorkgroup"] is False and body["registrationFlowAllowed"]
+
+        # Self-serve registration creates the profile.
+        resp = await dash.post("/api/workgroup/create", json={}, headers=headers)
+        assert resp.status == 200
+        profile = await kube.get("Profile", "alice")
+        assert profileapi.owner_of(profile)["name"] == "alice@example.com"
+
+        resp = await dash.get("/api/workgroup/exists", headers=headers)
+        assert (await resp.json())["hasWorkgroup"] is True
+
+        resp = await dash.get("/api/workgroup/env-info", headers=headers)
+        namespaces = (await resp.json())["namespaces"]
+        assert namespaces == [
+            {"namespace": "alice", "role": "owner", "user": "alice@example.com"}
+        ]
+
+        # Contributor via KFAM-style rolebinding annotations shows up for bob.
+        await kube.create(
+            "RoleBinding",
+            {
+                "metadata": {
+                    "name": "user-bob-example-com-clusterrole-edit",
+                    "namespace": "alice",
+                    "annotations": {"user": "bob@example.com",
+                                    "role": "kubeflow-edit"},
+                },
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+                "subjects": [],
+            },
+        )
+        bob_headers = await csrf(dash, "/api/dashboard-links", BOB)
+        resp = await dash.get("/api/workgroup/env-info", headers=bob_headers)
+        namespaces = (await resp.json())["namespaces"]
+        assert namespaces[0]["role"] == "edit"
+
+        # TPU usage panel aggregates chip requests vs quota.
+        await kube.create(
+            "ResourceQuota",
+            {
+                "metadata": {"name": "kf-resource-quota", "namespace": "alice"},
+                "spec": {"hard": {"requests.google.com/tpu": "32"}},
+            },
+        )
+        await kube.create(
+            "Pod",
+            {
+                "metadata": {"name": "nb-0", "namespace": "alice"},
+                "spec": {
+                    "containers": [
+                        {"name": "x",
+                         "resources": {"requests": {"google.com/tpu": "8"}}}
+                    ]
+                },
+            },
+        )
+        resp = await dash.get("/api/namespaces/alice/tpu-usage", headers=headers)
+        usage = await resp.json()
+        assert usage["chipsRequested"] == 8
+        assert usage["chipsQuota"] == 32
+        assert usage["pods"] == [{"pod": "nb-0", "chips": 8}]
+    finally:
+        for c in clients:
+            await c.close()
+        kube.close_watches()
