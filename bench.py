@@ -1,23 +1,59 @@
 #!/usr/bin/env python3
 """Framework benchmark — prints ONE JSON line.
 
-Metric: end-to-end notebook cold-start on the in-process control plane —
-time from `Notebook` CR creation to the slice-validation workload's first
-completed training step (the "first psum" moment of BASELINE.md), using the
-fake cluster (kubelet simulated) and REAL accelerator compute for the
-workload. The reference publishes no comparable number (SURVEY.md §6:
-`published: {}`); `vs_baseline` is measured against our own BASELINE target
-of 60 s (the reference CI's notebook-Ready gate is 100 s, BASELINE.md).
+End-to-end notebook cold-start: `Notebook` CR created → control plane
+reconciles (admission webhooks, StatefulSet, Services, kubelet-simulated
+pod start, status mirroring) → slice Ready → the burn-in workload's first
+completed training step on the REAL accelerator (the "first psum" moment of
+BASELINE.md).
 
-Until the controller slice lands, this measures the workload path only
-(compile + first step); the control-plane spawn is added in front as the
-controller matures.
+The reference publishes no comparable number (SURVEY.md §6: published {});
+`vs_baseline` is measured against our BASELINE target of 60 s (the
+reference CI's notebook-Ready gate is 100 s, BASELINE.md).
 """
 
+import asyncio
 import json
 import time
 
 BASELINE_TARGET_SEC = 60.0
+
+
+async def spawn_notebook() -> dict:
+    """CR create → Ready on the in-process control plane; returns timings."""
+    from kubeflow_tpu.api import notebook as nbapi
+    from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.objects import deep_get
+    from kubeflow_tpu.testing.fakekube import FakeKube
+    from kubeflow_tpu.testing.podsim import PodSimulator
+    from kubeflow_tpu.webhooks import register_all
+
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr)
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    t0 = time.perf_counter()
+    await kube.create(
+        "Notebook", nbapi.new("bench", "bench", accelerator="v5e", topology="2x2")
+    )
+    ready = None
+    deadline = time.perf_counter() + 30
+    while time.perf_counter() < deadline:
+        nb = await kube.get("Notebook", "bench", "bench")
+        if deep_get(nb, "status", "readyReplicas", default=0):
+            ready = time.perf_counter() - t0
+            break
+        await asyncio.sleep(0.005)
+    await sim.stop()
+    await mgr.stop()
+    kube.close_watches()
+    if ready is None:
+        raise RuntimeError("notebook never became Ready")
+    return {"spawn_sec": ready}
 
 
 def bench() -> dict:
@@ -25,13 +61,15 @@ def bench() -> dict:
 
     from __graft_entry__ import entry
 
-    t0 = time.perf_counter()
+    t_start = time.perf_counter()
+    spawn = asyncio.run(spawn_notebook())
+
     fn, (params, tokens) = entry()
     step = jax.jit(fn)
     jax.block_until_ready(step(params, tokens))  # compile + first step
-    first = time.perf_counter() - t0
+    total = time.perf_counter() - t_start
 
-    # Steady-state step time (10 iters) as a sanity check of chip health.
+    # Steady-state step time as a chip-health sanity check.
     t1 = time.perf_counter()
     for _ in range(10):
         out = step(params, tokens)
@@ -40,9 +78,10 @@ def bench() -> dict:
 
     return {
         "metric": "coldstart_to_first_step_sec",
-        "value": round(first, 4),
+        "value": round(total, 4),
         "unit": "s",
-        "vs_baseline": round(BASELINE_TARGET_SEC / max(first, 1e-9), 2),
+        "vs_baseline": round(BASELINE_TARGET_SEC / max(total, 1e-9), 2),
+        "control_plane_spawn_sec": round(spawn["spawn_sec"], 4),
         "steady_step_sec": round(steady, 6),
         "backend": jax.default_backend(),
     }

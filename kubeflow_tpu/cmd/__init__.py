@@ -1,0 +1,11 @@
+"""Deployment entrypoints.
+
+One process per deployment unit, matching the manifests:
+
+- ``python -m kubeflow_tpu.cmd.controller_manager`` — all reconcilers
+- ``python -m kubeflow_tpu.cmd.webhook``            — admission server
+- ``python -m kubeflow_tpu.cmd.webapp``             — JWA/VWA/TWA/KFAM/dashboard
+
+Configuration is env-var based like the reference (GetEnvDefault pattern,
+``culling_controller.go:491-544``), unified here through ``envconfig``.
+"""

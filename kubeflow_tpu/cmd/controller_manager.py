@@ -1,0 +1,85 @@
+"""Controller-manager entrypoint: all reconcilers in one process.
+
+Deliberately one process where the reference ran five (notebook, odh
+notebook, profile, tensorboard, pvcviewer) — the two-controller lock
+protocol and its race class disappear (SURVEY.md §7 hard-part (c)).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from aiohttp import web
+
+from kubeflow_tpu.cmd import envconfig
+from kubeflow_tpu.controllers.culling import setup_culling_controller
+from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+from kubeflow_tpu.controllers.profile import setup_profile_controller
+from kubeflow_tpu.controllers.pvcviewer import setup_pvcviewer_controller
+from kubeflow_tpu.controllers.tensorboard import setup_tensorboard_controller
+from kubeflow_tpu.runtime.httpclient import HttpKube
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import global_registry
+
+log = logging.getLogger(__name__)
+
+
+async def serve_health_and_metrics(port: int) -> web.AppRunner:
+    """/healthz /readyz /metrics like the reference manager
+    (notebook-controller/main.go:65-66,125-133)."""
+    app = web.Application()
+
+    async def ok(_request):
+        return web.json_response({"status": "ok"})
+
+    async def metrics(_request):
+        return web.Response(
+            text=global_registry.expose(), content_type="text/plain"
+        )
+
+    app.router.add_get("/healthz", ok)
+    app.router.add_get("/readyz", ok)
+    app.router.add_get("/metrics", metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    return runner
+
+
+async def amain() -> None:
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    kube = HttpKube()
+    mgr = Manager(kube, namespace=os.environ.get("WATCH_NAMESPACE") or None)
+    setup_notebook_controller(mgr, envconfig.notebook_options())
+    culling = envconfig.culling_options()
+    if culling.enable_culling:
+        setup_culling_controller(mgr, options=culling)
+    setup_profile_controller(mgr, envconfig.profile_options())
+    setup_tensorboard_controller(mgr, envconfig.tensorboard_options())
+    setup_pvcviewer_controller(mgr, envconfig.pvcviewer_options())
+
+    health = await serve_health_and_metrics(
+        int(os.environ.get("METRICS_PORT", "8080"))
+    )
+    await mgr.start()
+    log.info("controller manager started (%d controllers)", len(mgr.controllers))
+    try:
+        await asyncio.Event().wait()  # run forever
+    finally:
+        await mgr.stop()
+        await health.cleanup()
+        await kube.close()
+
+
+def main() -> None:
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

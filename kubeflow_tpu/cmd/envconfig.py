@@ -1,0 +1,84 @@
+"""Env-var configuration shared by the entrypoints.
+
+The reference scatters four config mechanisms (SURVEY.md §5 "Config/flag
+system"); this is the unified one: every knob is an env var with a default,
+mapped onto the typed Options dataclasses the controllers take.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw is not None else default
+    except ValueError:
+        return default
+
+
+def notebook_options():
+    from kubeflow_tpu.controllers.notebook import NotebookOptions
+
+    return NotebookOptions(
+        use_istio=env_bool("USE_ISTIO", False),
+        istio_gateway=env_str("ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"),
+        istio_host=env_str("ISTIO_HOST", "*"),
+        cluster_domain=env_str("CLUSTER_DOMAIN", "cluster.local"),
+        add_fsgroup=env_bool("ADD_FSGROUP", True),
+    )
+
+
+def culling_options():
+    from kubeflow_tpu.controllers.culling import CullingOptions
+
+    return CullingOptions(
+        enable_culling=env_bool("ENABLE_CULLING", False),
+        cull_idle_seconds=env_float("CULL_IDLE_TIME", 1440.0) * 60.0,
+        check_period_seconds=env_float("IDLENESS_CHECK_PERIOD", 1.0) * 60.0,
+        cluster_domain=env_str("CLUSTER_DOMAIN", "cluster.local"),
+        dev_url=os.environ.get("CULLER_DEV_URL"),
+    )
+
+
+def profile_options():
+    from kubeflow_tpu.controllers.profile import ProfileOptions
+
+    return ProfileOptions(
+        use_istio=env_bool("USE_ISTIO", False),
+        userid_header=env_str("USERID_HEADER", "kubeflow-userid"),
+        userid_prefix=env_str("USERID_PREFIX", ""),
+    )
+
+
+def tensorboard_options():
+    from kubeflow_tpu.controllers.tensorboard import TensorboardOptions
+
+    return TensorboardOptions(
+        image=env_str("TENSORBOARD_IMAGE", "tensorflow/tensorflow:latest"),
+        use_istio=env_bool("USE_ISTIO", False),
+        cluster_domain=env_str("CLUSTER_DOMAIN", "cluster.local"),
+        rwo_pvc_scheduling=env_bool("RWO_PVC_SCHEDULING", True),
+        gcp_creds_secret=os.environ.get("TENSORBOARD_GCP_CREDS_SECRET"),
+    )
+
+
+def pvcviewer_options():
+    from kubeflow_tpu.controllers.pvcviewer import PVCViewerOptions
+
+    return PVCViewerOptions(
+        use_istio=env_bool("USE_ISTIO", False),
+        cluster_domain=env_str("CLUSTER_DOMAIN", "cluster.local"),
+    )

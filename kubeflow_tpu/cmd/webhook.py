@@ -1,0 +1,53 @@
+"""Admission webhook server entrypoint (HTTPS, AdmissionReview v1)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from aiohttp import web
+
+from kubeflow_tpu.runtime.httpclient import HttpKube
+from kubeflow_tpu.webhooks.server import create_webhook_app, ssl_context
+
+
+async def amain() -> None:
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    kube = HttpKube()
+    app = create_webhook_app(kube)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    cert = os.environ.get("TLS_CERT_FILE", "/etc/webhook/certs/tls.crt")
+    key = os.environ.get("TLS_KEY_FILE", "/etc/webhook/certs/tls.key")
+    if os.path.exists(cert):
+        ctx = ssl_context(cert, key)
+    elif os.environ.get("ALLOW_INSECURE_HTTP") == "true":
+        ctx = None  # local development only
+    else:
+        # The apiserver only speaks HTTPS to webhooks; serving plaintext
+        # here would "work" while every admission call fails its TLS
+        # handshake (and failurePolicy:Fail then blocks Notebook creates
+        # cluster-wide). Fail fast instead.
+        raise SystemExit(
+            f"TLS cert not found at {cert}; refusing to serve the admission "
+            "webhook over plaintext (set ALLOW_INSECURE_HTTP=true for local dev)"
+        )
+    site = web.TCPSite(
+        runner, "0.0.0.0", int(os.environ.get("WEBHOOK_PORT", "8443")),
+        ssl_context=ctx,
+    )
+    await site.start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await runner.cleanup()
+        await kube.close()
+
+
+def main() -> None:
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -126,6 +126,9 @@ class CullingReconciler:
             # from idle — never make a cull decision on a failed probe
             # (reference skips and retries, :226-239).
             return requeue
+        # Terminals are tolerated missing (servers run with terminals
+        # disabled → 404 forever; hard-requiring it would block culling
+        # permanently). Kernels above are the authoritative busy signal.
         terminals = await self.prober(self.probe_url(name, ns, "terminals"))
 
         annotations = dict(get_meta(nb).get("annotations") or {})

@@ -1,0 +1,18 @@
+"""Slice burn-in probes.
+
+Two probes validate a freshly spawned slice (the framework's e2e health
+check and the BASELINE.md north-star metric):
+
+- :mod:`kubeflow_tpu.probe.ici` — JAX all-reduce bandwidth over ICI,
+  scored as a fraction of the topology's theoretical peak
+  (``TpuSlice.allreduce_algo_bandwidth_gbps``).
+- :mod:`kubeflow_tpu.probe.dcn` — TCP ring bandwidth over the DCN/pod
+  network between workers (native C++ engine in ``native/``), validating
+  the headless-Service path ``jax.distributed.initialize`` bootstraps over.
+
+Run in-notebook or as a Job: ``python -m kubeflow_tpu.probe``.
+"""
+
+from kubeflow_tpu.probe.ici import IciReport, run_ici_probe
+
+__all__ = ["run_ici_probe", "IciReport"]

@@ -1,0 +1,49 @@
+"""CLI: ``python -m kubeflow_tpu.probe`` — run the slice burn-in.
+
+Prints one JSON document: ICI all-reduce report (+ DCN ring report when
+running inside a multi-worker slice).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="TPU slice burn-in probe")
+    parser.add_argument("--mbytes", type=float, default=64.0)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--accelerator", default=os.environ.get("KFTPU_ACCELERATOR"))
+    parser.add_argument("--topology", default=os.environ.get("TPU_TOPOLOGY"))
+    parser.add_argument("--skip-dcn", action="store_true")
+    args = parser.parse_args()
+
+    from kubeflow_tpu.probe.ici import run_ici_probe
+
+    report: dict = {
+        "ici": run_ici_probe(
+            mbytes=args.mbytes,
+            iters=args.iters,
+            accelerator=args.accelerator,
+            topology=args.topology,
+        ).to_dict()
+    }
+
+    if not args.skip_dcn:
+        from kubeflow_tpu.probe.dcn import run_rank, worker_env_config
+
+        config = worker_env_config()
+        if config is not None:
+            rank, world, peers = config
+            try:
+                report["dcn"] = run_rank(rank, world, peers, mbytes=args.mbytes)
+            except Exception as e:  # burn-in keeps going; DCN result is advisory
+                report["dcn"] = {"error": str(e)}
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Python driver for the native DCN ring probe (``native/dcn_probe.cpp``).
+
+Locates (or builds) the ``dcn_probe`` binary and runs one rank per worker.
+In-notebook the rank/peers come from the ``TPU_WORKER_*`` env the controller
+injected; in tests all ranks run as local subprocesses over loopback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+
+
+class DcnProbeError(RuntimeError):
+    pass
+
+
+def find_or_build_binary() -> Path:
+    """PATH → native/dcn_probe → build from source with g++."""
+    on_path = shutil.which("dcn_probe")
+    if on_path:
+        return Path(on_path)
+    binary = NATIVE_DIR / "dcn_probe"
+    source = NATIVE_DIR / "dcn_probe.cpp"
+    if not source.exists():
+        if binary.exists():
+            return binary  # binary-only install (trimmed image layer)
+        raise DcnProbeError(f"dcn_probe source not found at {source}")
+    if binary.exists() and binary.stat().st_mtime >= source.stat().st_mtime:
+        return binary
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        raise DcnProbeError("no C++ compiler available to build dcn_probe")
+    subprocess.run(
+        [gxx, "-O2", "-std=c++17", "-pthread", "-o", str(binary), str(source)],
+        check=True,
+        capture_output=True,
+    )
+    return binary
+
+
+def run_rank(
+    rank: int,
+    world: int,
+    peers: list[str],
+    *,
+    base_port: int = 19000,
+    mbytes: float = 64.0,
+    iters: int = 8,
+    timeout: float = 120.0,
+) -> dict:
+    """Run this worker's rank; blocks until the ring completes."""
+    binary = find_or_build_binary()
+    proc = subprocess.run(
+        [
+            str(binary),
+            "--rank", str(rank),
+            "--world", str(world),
+            "--peers", ",".join(peers),
+            "--base-port", str(base_port),
+            "--mbytes", str(mbytes),
+            "--iters", str(iters),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    if proc.returncode != 0:
+        raise DcnProbeError(f"rank {rank} failed: {proc.stderr.strip()}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_local_ring(
+    world: int = 2, *, mbytes: float = 32.0, iters: int = 4,
+    base_port: int = 19000,
+) -> list[dict]:
+    """All ranks as local subprocesses (tests / single-host sanity)."""
+    binary = find_or_build_binary()
+    peers = ["127.0.0.1"] * world
+    procs = [
+        subprocess.Popen(
+            [
+                str(binary),
+                "--rank", str(rank),
+                "--world", str(world),
+                "--peers", ",".join(peers),
+                "--base-port", str(base_port),
+                "--mbytes", str(mbytes),
+                "--iters", str(iters),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for rank in range(world)
+    ]
+    reports = []
+    errors = []
+    for rank, proc in enumerate(procs):
+        out, err = proc.communicate(timeout=120)
+        if proc.returncode != 0:
+            errors.append(f"rank {rank}: {err.strip()}")
+        else:
+            reports.append(json.loads(out.strip().splitlines()[-1]))
+    if errors:
+        raise DcnProbeError("; ".join(errors))
+    return reports
+
+
+def worker_env_config() -> tuple[int, int, list[str]] | None:
+    """(rank, world, peers) from the TPU_WORKER_* env, or None off-slice."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    worker_id = os.environ.get("TPU_WORKER_ID", "")
+    if not hostnames or not worker_id.isdigit():
+        return None
+    peers = hostnames.split(",")
+    return int(worker_id), len(peers), peers

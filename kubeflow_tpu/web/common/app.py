@@ -31,6 +31,13 @@ CSRF_HEADER = "X-XSRF-TOKEN"
 SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
 
 
+def _is_probe_path(path: str) -> bool:
+    """Probe/scrape endpoints bypass authn/CSRF. Matched by last segment so
+    the exemption holds under path-prefixed subapp mounting (WEBAPP=all
+    serves /jupyter/healthz etc.)."""
+    return path.rstrip("/").rsplit("/", 1)[-1] in ("healthz", "readyz", "metrics")
+
+
 def json_success(payload: dict | None = None, status: int = 200) -> web.Response:
     return web.json_response({"success": True, "status": status, **(payload or {})},
                              status=status)
@@ -74,7 +81,7 @@ def create_base_app(
 
     @web.middleware
     async def authn_middleware(request: web.Request, handler):
-        if request.path in ("/healthz", "/readyz", "/metrics"):
+        if _is_probe_path(request.path):
             return await handler(request)
         user = request.headers.get(userid_header)
         if user is None:
@@ -88,7 +95,7 @@ def create_base_app(
 
     @web.middleware
     async def csrf_middleware(request: web.Request, handler):
-        if not csrf_protect or request.path in ("/healthz", "/readyz", "/metrics"):
+        if not csrf_protect or _is_probe_path(request.path):
             return await handler(request)
         cookie = request.cookies.get(CSRF_COOKIE)
         if request.method not in SAFE_METHODS:

@@ -25,6 +25,7 @@ from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
 from kubeflow_tpu.tpu.topology import TPU_RESOURCE
 from kubeflow_tpu.web.common.app import create_base_app, json_success
+from kubeflow_tpu.web.common.auth import ensure
 
 DEFAULT_LINKS = [
     {"type": "item", "link": "/jupyter/", "text": "Notebooks", "icon": "book"},
@@ -54,23 +55,26 @@ routes = web.RouteTableDef()
 
 async def _namespaces_for(kube, user: str) -> list[dict]:
     """Namespaces the user owns or contributes to (api_workgroup.ts
-    getWorkgroupInfo): owner annotation or KFAM binding annotations."""
-    out = []
-    for profile in await kube.list("Profile"):
+    getWorkgroupInfo): owner annotation or KFAM binding annotations.
+    Contributor lookups across namespaces run concurrently — this backs the
+    dashboard landing page, so no serial per-profile round-trips."""
+    import asyncio
+
+    profiles = await kube.list("Profile")
+
+    async def role_in(profile: dict) -> dict | None:
         ns = name_of(profile)
-        owner = profileapi.owner_of(profile).get("name")
-        role = None
-        if owner == user:
-            role = "owner"
-        else:
-            for rb in await kube.list("RoleBinding", ns):
-                annotations = get_meta(rb).get("annotations") or {}
-                if annotations.get("user") == user and "role" in annotations:
-                    role = annotations["role"].removeprefix("kubeflow-")
-                    break
-        if role:
-            out.append({"namespace": ns, "role": role, "user": user})
-    return out
+        if profileapi.owner_of(profile).get("name") == user:
+            return {"namespace": ns, "role": "owner", "user": user}
+        for rb in await kube.list("RoleBinding", ns):
+            annotations = get_meta(rb).get("annotations") or {}
+            if annotations.get("user") == user and "role" in annotations:
+                role = annotations["role"].removeprefix("kubeflow-")
+                return {"namespace": ns, "role": role, "user": user}
+        return None
+
+    results = await asyncio.gather(*(role_in(p) for p in profiles))
+    return [r for r in results if r]
 
 
 @routes.get("/api/workgroup/exists")
@@ -108,8 +112,10 @@ async def create_workgroup(request):
     kube, user = request.app["kube"], request.get("user", "")
     if not request.app["registration_flow"]:
         raise Invalid("registration flow is disabled")
-    body = await request.json() if request.can_read_body else {}
-    name = body.get("namespace") or user.split("@")[0].replace(".", "-").lower()
+    # The namespace name is DERIVED from the authenticated identity, never
+    # taken from the body — a body override would let any user claim any
+    # unregistered namespace name (e.g. kube-system) as their profile.
+    name = user.split("@")[0].replace(".", "-").lower()
     await kube.create("Profile", profileapi.new(name, user))
     return json_success({"message": f"Created namespace {name}"})
 
@@ -124,6 +130,9 @@ async def tpu_usage(request):
     """TPU chip demand in a namespace, from pod resource requests."""
     kube = request.app["kube"]
     ns = request.match_info["namespace"]
+    await ensure(
+        request.app["authorizer"], request.get("user", ""), "list", "Pod", ns
+    )
     chips_requested = 0
     pods = []
     for pod in await kube.list("Pod", ns):

@@ -16,7 +16,7 @@ from aiohttp import web
 
 from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.runtime.errors import Invalid, NotFound
-from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
+from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of, now_iso
 from kubeflow_tpu.web.common.app import create_base_app, json_success
 from kubeflow_tpu.web.common.auth import ensure
 from kubeflow_tpu.web.common.status import process_status
@@ -44,13 +44,19 @@ def _ctx(request: web.Request):
     )
 
 
-async def _notebook_events(kube, ns: str, name: str) -> list[dict]:
-    out = []
-    for ev in await kube.list("Event", ns):
+def _events_by_notebook(events: list[dict]) -> dict[str, list[dict]]:
+    """Bucket one Event list by notebook name (one list call per request,
+    not per notebook)."""
+    out: dict[str, list[dict]] = {}
+    for ev in events:
         involved = ev.get("involvedObject") or {}
-        if involved.get("kind") == "Notebook" and involved.get("name") == name:
-            out.append(ev)
+        if involved.get("kind") == "Notebook" and involved.get("name"):
+            out.setdefault(involved["name"], []).append(ev)
     return out
+
+
+async def _notebook_events(kube, ns: str, name: str) -> list[dict]:
+    return _events_by_notebook(await kube.list("Event", ns)).get(name, [])
 
 
 @routes.get("/api/config")
@@ -70,10 +76,10 @@ async def get_tpus(request):
 async def list_notebooks(request):
     kube, authz, user, ns = _ctx(request)
     await ensure(authz, user, "list", "Notebook", ns)
+    events = _events_by_notebook(await kube.list("Event", ns))
     notebooks = []
     for nb in await kube.list("Notebook", ns):
-        events = await _notebook_events(kube, ns, name_of(nb))
-        status = process_status(nb, events)
+        status = process_status(nb, events.get(name_of(nb), []))
         notebooks.append(_summarize(nb, status))
     return json_success({"notebooks": notebooks})
 
@@ -107,9 +113,11 @@ async def get_notebook(request):
     await ensure(authz, user, "get", "Notebook", ns)
     nb = await kube.get("Notebook", name, ns)
     events = await _notebook_events(kube, ns, name)
+    # NB: key must not be "status" — that would clobber the envelope's
+    # numeric status field in json_success.
     return json_success(
         {"notebook": nb,
-         "status": process_status(nb, events).__dict__}
+         "processedStatus": process_status(nb, events).__dict__}
     )
 
 
@@ -168,14 +176,16 @@ async def post_notebook(request):
     await ensure(authz, user, "create", "Notebook", ns)
     body = await request.json()
     nb, pvcs = notebook_from_form(request.app["config"], body, ns, user)
-    for pvc in pvcs:
+    if pvcs:
         await ensure(authz, user, "create", "PersistentVolumeClaim", ns)
-        existing = await kube.get_or_none(
-            "PersistentVolumeClaim", name_of(pvc), ns
-        )
-        if existing is None:
-            await kube.create("PersistentVolumeClaim", pvc)
+    # Notebook FIRST: if its create fails (name taken, webhook rejection)
+    # no PVCs are orphaned; pods just stay Pending until the claims land a
+    # moment later (the reference gets the same guarantee via dry-runs,
+    # post.py:51-58).
     await kube.create("Notebook", nb)
+    for pvc in pvcs:
+        if await kube.get_or_none("PersistentVolumeClaim", name_of(pvc), ns) is None:
+            await kube.create("PersistentVolumeClaim", pvc)
     return json_success({"message": f"Notebook {name_of(nb)} created"}, status=200)
 
 
@@ -188,13 +198,7 @@ async def patch_notebook(request):
     if "stopped" not in body:
         raise Invalid("PATCH body must contain 'stopped'")
     if body["stopped"]:
-        import time
-
-        annotations = {
-            nbapi.STOP_ANNOTATION: time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-            )
-        }
+        annotations = {nbapi.STOP_ANNOTATION: now_iso()}
     else:
         annotations = {nbapi.STOP_ANNOTATION: None}
     await kube.patch(

@@ -65,20 +65,30 @@ async def _is_owner_or_admin(request, namespace: str) -> bool:
 
 @routes.get("/kfam/v1/role-clusteradmin")
 async def get_cluster_admin(request):
-    user = request.query.get("user", request.get("user", ""))
+    caller = request.get("user", "")
+    user = request.query.get("user", caller)
+    # Only admins may query someone else's role.
+    if user != caller and caller not in request.app["cluster_admins"]:
+        return json_error("forbidden: cannot query another user's role", 403)
     return json_success({"clusterAdmin": user in request.app["cluster_admins"]})
 
 
 @routes.post("/kfam/v1/profiles")
 async def post_profile(request):
     kube = request.app["kube"]
+    caller = request.get("user", "")
     body = await request.json()
     name = body.get("name") or deep_get(body, "metadata", "name")
-    owner = deep_get(body, "spec", "owner", "name") or body.get(
-        "user", request.get("user", "")
-    )
+    owner = deep_get(body, "spec", "owner", "name") or body.get("user", caller)
     if not name:
         raise Invalid("profile: name required")
+    # A non-admin may only create a profile owned by THEMSELF — otherwise
+    # any user could claim any unregistered namespace name for (or as)
+    # someone else (same invariant as the dashboard registration flow).
+    if owner != caller and caller not in request.app["cluster_admins"]:
+        return json_error(
+            "forbidden: only cluster admins may create profiles for others", 403
+        )
     profile = profileapi.new(name, owner)
     if deep_get(body, "spec", "resourceQuotaSpec"):
         profile["spec"]["resourceQuotaSpec"] = body["spec"]["resourceQuotaSpec"]
@@ -101,15 +111,29 @@ async def delete_profile(request):
 @routes.get("/kfam/v1/bindings")
 async def list_bindings(request):
     kube = request.app["kube"]
+    caller = request.get("user", "")
     namespace = request.query.get("namespace")
     role_filter = request.query.get("role")
     user_filter = request.query.get("user")
     bindings = []
-    namespaces = (
-        [namespace]
-        if namespace
-        else [name_of(p) for p in await kube.list("Profile")]
-    )
+    if namespace:
+        # Owner, cluster admin, or an existing contributor of the namespace.
+        if not await _is_owner_or_admin(request, namespace):
+            member = any(
+                (get_meta(rb).get("annotations") or {}).get("user") == caller
+                for rb in await kube.list("RoleBinding", namespace)
+            )
+            if not member:
+                return json_error(
+                    "forbidden: not a member of this namespace", 403
+                )
+        namespaces = [namespace]
+    elif caller in request.app["cluster_admins"]:
+        namespaces = [name_of(p) for p in await kube.list("Profile")]
+    else:
+        return json_error(
+            "forbidden: cluster-wide binding listing requires cluster admin", 403
+        )
     for ns in namespaces:
         for rb in await kube.list("RoleBinding", ns):
             annotations = get_meta(rb).get("annotations") or {}

@@ -34,12 +34,21 @@ def _ctx(request: web.Request):
     )
 
 
-async def _pods_using(kube, ns: str, claim: str) -> list[str]:
-    out = []
-    for pod in await kube.list("Pod", ns):
+def _claims_to_pods(pods: list[dict], *, exclude_viewers: bool = False) -> dict:
+    """claim name → [pod names] from one Pod list (avoids an N+1 list per
+    PVC). ``exclude_viewers`` drops pods that exist only to *view* a claim
+    (labelled ``pvcviewer`` by the pvcviewer controller) — they must not
+    block deleting it."""
+    out: dict[str, list[str]] = {}
+    for pod in pods:
+        if exclude_viewers and "pvcviewer" in (
+            deep_get(pod, "metadata", "labels", default={}) or {}
+        ):
+            continue
         for vol in deep_get(pod, "spec", "volumes", default=[]):
-            if deep_get(vol, "persistentVolumeClaim", "claimName") == claim:
-                out.append(name_of(pod))
+            claim = deep_get(vol, "persistentVolumeClaim", "claimName")
+            if claim:
+                out.setdefault(claim, []).append(name_of(pod))
     return out
 
 
@@ -50,10 +59,11 @@ async def list_pvcs(request):
     viewers = {
         deep_get(v, "spec", "pvc"): v for v in await kube.list("PVCViewer", ns)
     }
+    claims_to_pods = _claims_to_pods(await kube.list("Pod", ns))
     pvcs = []
     for pvc in await kube.list("PersistentVolumeClaim", ns):
         claim = name_of(pvc)
-        used_by = await _pods_using(kube, ns, claim)
+        used_by = claims_to_pods.get(claim, [])
         viewer = viewers.get(claim)
         pvcs.append(
             {
@@ -110,7 +120,9 @@ async def delete_pvc(request):
     kube, authz, user, ns = _ctx(request)
     name = request.match_info["name"]
     await ensure(authz, user, "delete", "PersistentVolumeClaim", ns)
-    used_by = await _pods_using(kube, ns, name)
+    used_by = _claims_to_pods(
+        await kube.list("Pod", ns), exclude_viewers=True
+    ).get(name, [])
     if used_by:
         raise Invalid(f"PVC {name} is in use by pods: {', '.join(used_by)}")
     # Delete the viewer first like the reference (delete.py:24-40).

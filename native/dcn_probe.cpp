@@ -1,0 +1,202 @@
+// DCN ring-bandwidth probe.
+//
+// Validates the pod-network path between TPU slice workers — the path
+// jax.distributed.initialize() bootstraps over (headless-Service DNS) and
+// the path DCN collectives ride for multi-slice training. The reference
+// stack has no native code (SURVEY.md §2: zero .cc/.cu in the repo); this
+// probe is the one justified native artifact of the TPU rebuild
+// (SURVEY.md §7): a dependency-free C++ tool baked into jupyter-jax so a
+// notebook can measure worker-to-worker bandwidth before committing a
+// long run to a slice.
+//
+// Protocol: W ranks form a ring. Rank i listens on base_port+i, connects
+// to rank (i+1)%W, then pushes `bytes` around the ring `iters` times
+// (send to next while receiving from prev — both directions active, like
+// a ring all-gather step). Prints one JSON line per rank.
+//
+// Usage:
+//   dcn_probe --rank 0 --world 2 --peers host0,host1 --base-port 19000 \
+//             --mbytes 64 --iters 8
+//
+// Build: g++ -O2 -std=c++17 -pthread -o dcn_probe dcn_probe.cpp
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Options {
+  int rank = 0;
+  int world = 1;
+  std::vector<std::string> peers;
+  int base_port = 19000;
+  double mbytes = 64.0;
+  int iters = 8;
+  int connect_timeout_sec = 30;
+};
+
+[[noreturn]] void die(const std::string& msg) {
+  std::cerr << "dcn_probe: " << msg << " (" << std::strerror(errno) << ")\n";
+  std::exit(1);
+}
+
+std::vector<std::string> split(const std::string& s, char sep) {
+  std::vector<std::string> out;
+  std::stringstream ss(s);
+  std::string part;
+  while (std::getline(ss, part, sep)) {
+    if (!part.empty()) out.push_back(part);
+  }
+  return out;
+}
+
+Options parse_args(int argc, char** argv) {
+  Options opt;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) die("missing value for " + arg);
+      return argv[++i];
+    };
+    if (arg == "--rank") opt.rank = std::stoi(next());
+    else if (arg == "--world") opt.world = std::stoi(next());
+    else if (arg == "--peers") opt.peers = split(next(), ',');
+    else if (arg == "--base-port") opt.base_port = std::stoi(next());
+    else if (arg == "--mbytes") opt.mbytes = std::stod(next());
+    else if (arg == "--iters") opt.iters = std::stoi(next());
+    else if (arg == "--connect-timeout") opt.connect_timeout_sec = std::stoi(next());
+    else die("unknown flag " + arg);
+  }
+  if (opt.peers.empty()) {
+    for (int r = 0; r < opt.world; ++r) opt.peers.push_back("127.0.0.1");
+  }
+  if ((int)opt.peers.size() != opt.world) die("need one peer per rank");
+  return opt;
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+int listen_on(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) die("socket");
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) < 0) die("bind");
+  if (listen(fd, 1) < 0) die("listen");
+  return fd;
+}
+
+int connect_to(const std::string& host, int port, int timeout_sec) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_s = std::to_string(port);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(timeout_sec);
+  // Workers of a slice start in parallel; retry until the peer is up
+  // (the same tolerance jax.distributed has for the coordinator).
+  while (true) {
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) == 0) {
+      int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        freeaddrinfo(res);
+        set_nodelay(fd);
+        return fd;
+      }
+      if (fd >= 0) close(fd);
+      freeaddrinfo(res);
+      res = nullptr;
+    }
+    if (std::chrono::steady_clock::now() > deadline)
+      die("connect to " + host + ":" + port_s + " timed out");
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+}
+
+void send_all(int fd, const char* buf, size_t n) {
+  while (n > 0) {
+    ssize_t sent = send(fd, buf, n, 0);
+    if (sent <= 0) die("send");
+    buf += sent;
+    n -= (size_t)sent;
+  }
+}
+
+void recv_all(int fd, char* buf, size_t n) {
+  while (n > 0) {
+    ssize_t got = recv(fd, buf, n, 0);
+    if (got <= 0) die("recv");
+    buf += got;
+    n -= (size_t)got;
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt = parse_args(argc, argv);
+  size_t bytes = (size_t)(opt.mbytes * 1e6);
+
+  if (opt.world == 1) {
+    std::cout << "{\"rank\":0,\"world\":1,\"gbps\":null,"
+              << "\"note\":\"single rank, nothing to measure\"}\n";
+    return 0;
+  }
+
+  int next_rank = (opt.rank + 1) % opt.world;
+  int listen_fd = listen_on(opt.base_port + opt.rank);
+  int send_fd = connect_to(opt.peers[next_rank], opt.base_port + next_rank,
+                           opt.connect_timeout_sec);
+  sockaddr_in peer{};
+  socklen_t len = sizeof(peer);
+  int recv_fd = accept(listen_fd, (sockaddr*)&peer, &len);
+  if (recv_fd < 0) die("accept");
+  set_nodelay(recv_fd);
+
+  std::vector<char> out_buf(bytes, 0x5a), in_buf(bytes);
+
+  // Warmup pass wires both directions before timing.
+  std::thread w([&] { send_all(send_fd, out_buf.data(), bytes); });
+  recv_all(recv_fd, in_buf.data(), bytes);
+  w.join();
+
+  auto t0 = std::chrono::steady_clock::now();
+  for (int it = 0; it < opt.iters; ++it) {
+    std::thread sender([&] { send_all(send_fd, out_buf.data(), bytes); });
+    recv_all(recv_fd, in_buf.data(), bytes);
+    sender.join();
+  }
+  double secs = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0).count();
+
+  // Each iteration moves `bytes` out and `bytes` in concurrently; ring
+  // bandwidth is the per-direction rate.
+  double gbps = (double)bytes * opt.iters / secs / 1e9;
+  std::cout << "{\"rank\":" << opt.rank << ",\"world\":" << opt.world
+            << ",\"mbytes\":" << opt.mbytes << ",\"iters\":" << opt.iters
+            << ",\"seconds\":" << secs << ",\"gbps\":" << gbps << "}\n";
+
+  close(send_fd);
+  close(recv_fd);
+  close(listen_fd);
+  return 0;
+}

@@ -1,0 +1,95 @@
+"""KFAM authorization regressions: profile-creation impersonation,
+cluster-wide binding disclosure, and role queries."""
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.api import profile as profileapi
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.web.kfam import create_app as create_kfam
+from kubeflow_tpu.webhooks import register_all
+
+ALICE = {"kubeflow-userid": "alice@example.com"}
+ROOT = {"kubeflow-userid": "root@example.com"}
+
+
+async def harness():
+    kube = FakeKube()
+    register_all(kube)
+    client = TestClient(
+        TestServer(create_kfam(kube, cluster_admins={"root@example.com"}))
+    )
+    await client.start_server()
+    return kube, client
+
+
+async def csrf(client, headers):
+    resp = await client.get("/kfam/v1/role-clusteradmin", headers=headers)
+    await resp.release()
+    token = client.session.cookie_jar.filter_cookies(
+        client.make_url("/")).get("XSRF-TOKEN")
+    return {**headers, "X-XSRF-TOKEN": token.value if token else ""}
+
+
+async def test_profile_creation_cannot_impersonate():
+    kube, client = await harness()
+    try:
+        headers = await csrf(client, ALICE)
+        # Alice cannot create a profile owned by someone else.
+        resp = await client.post(
+            "/kfam/v1/profiles",
+            json={"name": "stolen", "user": "victim@example.com"},
+            headers=headers,
+        )
+        assert resp.status == 403
+        assert await kube.get_or_none("Profile", "stolen") is None
+
+        # But may create her own, and an admin may create for anyone.
+        resp = await client.post(
+            "/kfam/v1/profiles", json={"name": "mine"}, headers=headers
+        )
+        assert resp.status == 200
+        admin_headers = await csrf(client, ROOT)
+        resp = await client.post(
+            "/kfam/v1/profiles",
+            json={"name": "granted", "user": "bob@example.com"},
+            headers=admin_headers,
+        )
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_binding_listing_scoped_to_membership():
+    kube, client = await harness()
+    try:
+        await kube.create("Profile", profileapi.new("team", "owner@example.com"))
+        headers = await csrf(client, ALICE)
+        # Cluster-wide listing requires admin.
+        resp = await client.get("/kfam/v1/bindings", headers=headers)
+        assert resp.status == 403
+        # Namespace-scoped listing requires membership.
+        resp = await client.get(
+            "/kfam/v1/bindings?namespace=team", headers=headers
+        )
+        assert resp.status == 403
+        # An admin sees everything.
+        admin_headers = await csrf(client, ROOT)
+        resp = await client.get("/kfam/v1/bindings", headers=admin_headers)
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_role_query_restricted_to_self():
+    _, client = await harness()
+    try:
+        resp = await client.get(
+            "/kfam/v1/role-clusteradmin?user=root@example.com", headers=ALICE
+        )
+        assert resp.status == 403
+        resp = await client.get("/kfam/v1/role-clusteradmin", headers=ALICE)
+        assert (await resp.json())["clusterAdmin"] is False
+        resp = await client.get("/kfam/v1/role-clusteradmin", headers=ROOT)
+        assert (await resp.json())["clusterAdmin"] is True
+    finally:
+        await client.close()

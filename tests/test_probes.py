@@ -1,0 +1,56 @@
+"""Probe suites: ICI psum on the virtual CPU mesh; native DCN ring over
+loopback (builds the C++ binary with the baked-in toolchain)."""
+
+import jax
+import pytest
+
+from kubeflow_tpu.probe.ici import run_ici_probe
+
+
+def test_ici_probe_runs_on_virtual_mesh():
+    report = run_ici_probe(mbytes=1.0, iters=2, warmup=1)
+    assert report.devices == len(jax.devices())
+    assert report.mean_seconds > 0
+    assert report.algo_bandwidth_gbps > 0
+    assert report.backend == "cpu"
+    assert report.fraction_of_peak is None  # no accelerator context given
+
+
+def test_ici_probe_scores_against_topology():
+    report = run_ici_probe(
+        mbytes=1.0, iters=2, warmup=1, accelerator="v5e", topology="2x4"
+    )
+    assert report.peak_estimate_gbps is not None
+    assert report.fraction_of_peak is not None
+    # CPU "bandwidth" vs the real v5e ICI peak: any positive number is fine;
+    # the scoring plumbing is what's under test.
+    assert report.fraction_of_peak > 0
+
+
+def test_dcn_ring_two_ranks_loopback():
+    pytest.importorskip("subprocess")
+    from kubeflow_tpu.probe.dcn import find_or_build_binary, run_local_ring
+
+    find_or_build_binary()  # exercises the g++ build path
+    reports = run_local_ring(world=2, mbytes=8.0, iters=3, base_port=19750)
+    assert len(reports) == 2
+    for r in reports:
+        assert r["world"] == 2
+        assert r["gbps"] > 0.1  # loopback is far faster than this floor
+        assert r["iters"] == 3
+
+
+def test_dcn_ring_three_ranks():
+    from kubeflow_tpu.probe.dcn import run_local_ring
+
+    reports = run_local_ring(world=3, mbytes=4.0, iters=2, base_port=19760)
+    assert sorted(r["rank"] for r in reports) == [0, 1, 2]
+
+
+def test_worker_env_config(monkeypatch):
+    from kubeflow_tpu.probe.dcn import worker_env_config
+
+    assert worker_env_config() is None
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a.svc,b.svc")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    assert worker_env_config() == (1, 2, ["a.svc", "b.svc"])

@@ -210,12 +210,37 @@ async def test_vwa_pvc_lifecycle_and_viewer():
         assert pvcs[0]["capacity"] == "10Gi"
         assert pvcs[0]["viewer"]["ready"] is True
 
-        # In-use PVC cannot be deleted.
+        # A PVC mounted by a real workload cannot be deleted...
+        await h.kube.create(
+            "Pod",
+            {
+                "metadata": {"name": "consumer", "namespace": "ns"},
+                "spec": {
+                    "containers": [{"name": "c", "image": "i"}],
+                    "volumes": [
+                        {"name": "d",
+                         "persistentVolumeClaim": {"claimName": "datasets"}}
+                    ],
+                },
+            },
+        )
         resp = await vwa.delete("/api/namespaces/ns/pvcs/datasets",
                                 headers=headers)
-        assert resp.status == 422  # viewer pod mounts it
-        body = await resp.json()
-        assert "in use" in body["log"]
+        assert resp.status == 422
+        assert "in use" in (await resp.json())["log"]
+        await h.kube.delete("Pod", "consumer", "ns")
+
+        # ...but the viewer's own pod doesn't block deletion: the viewer is
+        # torn down first, then the claim (reference delete.py:24-40).
+        resp = await vwa.delete("/api/namespaces/ns/pvcs/datasets",
+                                headers=headers)
+        assert resp.status == 200
+        await h.settle()
+        assert await h.kube.get_or_none("PVCViewer", "datasets", "ns") is None
+        assert (
+            await h.kube.get_or_none("PersistentVolumeClaim", "datasets", "ns")
+            is None
+        )
     finally:
         await h.stop()
 

@@ -1,0 +1,145 @@
+"""JSONPatch engine + AdmissionReview wire-protocol suites."""
+
+import base64
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.webhooks.jsonpatch import apply, diff
+from kubeflow_tpu.webhooks.server import create_webhook_app
+
+
+def roundtrip(old, new):
+    patch = diff(old, new)
+    assert apply(old, patch) == new
+    return patch
+
+
+def test_jsonpatch_roundtrips():
+    roundtrip({"a": 1}, {"a": 2})
+    roundtrip({"a": 1}, {"a": 1, "b": {"c": [1, 2]}})
+    roundtrip({"a": 1, "b": 2}, {"b": 2})
+    roundtrip({"xs": [1, 2, 3]}, {"xs": [1, 9, 3, 4]})
+    roundtrip({"xs": [1, 2, 3]}, {"xs": [1]})
+    roundtrip({"xs": []}, {"xs": [{"deep": {"er": 1}}]})
+    roundtrip(
+        {"spec": {"containers": [{"name": "a", "env": []}]}},
+        {"spec": {"containers": [{"name": "a", "env": [{"name": "X", "value": "1"}]},
+                                 {"name": "sidecar"}]}},
+    )
+    # Escaping: keys with / and ~.
+    roundtrip({"a/b": 1, "c~d": 2}, {"a/b": 9, "c~d": 2, "e": 3})
+
+
+def admission_review(obj, *, uid="u1", operation="CREATE", namespace=None):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": uid,
+            "operation": operation,
+            "namespace": namespace,
+            "object": obj,
+        },
+    }
+
+
+def decode_patch(body):
+    return json.loads(base64.b64decode(body["response"]["patch"]))
+
+
+async def test_admission_server_injects_poddefault_and_tpu_env():
+    kube = FakeKube()
+    await kube.create(
+        "PodDefault",
+        {
+            "metadata": {"name": "proxy", "namespace": "ns", "resourceVersion": "1"},
+            "spec": {
+                "selector": {"matchLabels": {"notebook-name": "nb"}},
+                "env": [{"name": "HTTPS_PROXY", "value": "http://proxy:3128"}],
+            },
+        },
+    )
+    client = TestClient(TestServer(create_webhook_app(kube)))
+    await client.start_server()
+    try:
+        pod = {
+            "kind": "Pod",
+            "metadata": {
+                "name": "nb-1",
+                "labels": {"notebook-name": "nb"},
+                "annotations": {
+                    "tpu.kubeflow.org/accelerator": "v5e",
+                    "tpu.kubeflow.org/topology": "4x4",
+                },
+            },
+            "spec": {"containers": [{"name": "nb", "env": []}]},
+        }
+        resp = await client.post(
+            "/apply-poddefault",
+            json=admission_review(pod, namespace="ns"),
+        )
+        body = await resp.json()
+        assert body["response"]["allowed"] is True
+        patched = apply(
+            {**pod, "metadata": {**pod["metadata"], "namespace": "ns"}},
+            decode_patch(body),
+        )
+        env = {e["name"]: e["value"] for e in patched["spec"]["containers"][0]["env"]}
+        assert env["HTTPS_PROXY"] == "http://proxy:3128"   # PodDefault applied
+        assert env["TPU_WORKER_ID"] == "1"                 # ordinal from pod name
+        assert (
+            "poddefault.admission.kubeflow.org/poddefault-proxy"
+            in patched["metadata"]["annotations"]
+        )
+    finally:
+        await client.close()
+
+
+async def test_admission_server_rejects_conflicts_and_bad_specs():
+    kube = FakeKube()
+    await kube.create(
+        "PodDefault",
+        {
+            "metadata": {"name": "clash", "namespace": "ns"},
+            "spec": {
+                "selector": {},
+                "env": [{"name": "A", "value": "pd-value"}],
+            },
+        },
+    )
+    client = TestClient(TestServer(create_webhook_app(kube)))
+    await client.start_server()
+    try:
+        pod = {
+            "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "ns"},
+            "spec": {"containers": [{"name": "c",
+                                     "env": [{"name": "A", "value": "mine"}]}]},
+        }
+        resp = await client.post("/apply-poddefault", json=admission_review(pod))
+        body = await resp.json()
+        assert body["response"]["allowed"] is False
+        assert "conflict" in body["response"]["status"]["message"].lower()
+
+        # Notebook defaulting + validation endpoint.
+        nb = {
+            "kind": "Notebook",
+            "metadata": {"name": "n", "namespace": "ns"},
+            "spec": {"tpu": {"accelerator": "nope", "topology": "2x2"},
+                     "template": {"spec": {"containers": [{"image": "i"}]}}},
+        }
+        resp = await client.post("/mutate-notebooks", json=admission_review(nb))
+        body = await resp.json()
+        assert body["response"]["allowed"] is False
+
+        nb["spec"]["tpu"] = {"accelerator": "v5e", "topology": "2x2"}
+        resp = await client.post("/mutate-notebooks", json=admission_review(nb))
+        body = await resp.json()
+        assert body["response"]["allowed"] is True
+        patched = apply(nb, decode_patch(body))
+        # Defaulter named container[0] after the notebook.
+        assert patched["spec"]["template"]["spec"]["containers"][0]["name"] == "n"
+    finally:
+        await client.close()
