@@ -67,12 +67,31 @@ async def amain() -> None:
     health = await serve_health_and_metrics(
         int(os.environ.get("METRICS_PORT", "8080"))
     )
+    elector = None
+    if envconfig.env_bool("LEADER_ELECT", False):
+        from kubeflow_tpu.runtime.leaderelection import LeaderElector
+
+        elector = LeaderElector(
+            kube,
+            namespace=os.environ.get("POD_NAMESPACE", "kubeflow-tpu"),
+            identity=os.environ.get("POD_NAME") or None,
+        )
+        log.info("waiting for leader election as %s", elector.identity)
+        await elector.acquire()
     await mgr.start()
     log.info("controller manager started (%d controllers)", len(mgr.controllers))
     try:
+        if elector is not None:
+            # Reconciling without the lease risks split-brain: exit when
+            # leadership is lost and let the pod restart as a standby.
+            while elector.is_leader:
+                await asyncio.sleep(1.0)
+            raise SystemExit("lost leader election lease")
         await asyncio.Event().wait()  # run forever
     finally:
         await mgr.stop()
+        if elector is not None:
+            await elector.release()
         await health.cleanup()
         await kube.close()
 

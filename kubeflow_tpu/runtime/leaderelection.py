@@ -1,0 +1,141 @@
+"""Lease-based leader election.
+
+controller-runtime equivalent (the reference managers pass
+``--leader-elect``; e.g. ``notebook-controller/main.go``): one replica holds
+a ``coordination.k8s.io/v1`` Lease and runs the controllers; standbys renew-
+watch and take over when the lease expires. The same object/protocol as
+client-go's leaderelection package, asyncio-native.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+
+from kubeflow_tpu.runtime.errors import ApiError, NotFound
+from kubeflow_tpu.runtime.objects import deep_get, fmt_iso, parse_iso
+
+log = logging.getLogger(__name__)
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        kube,
+        *,
+        lease_name: str = "kubeflow-tpu-controller-manager",
+        namespace: str = "kubeflow-tpu",
+        identity: str | None = None,
+        lease_seconds: float = 15.0,
+        renew_seconds: float = 5.0,
+        retry_seconds: float = 2.0,
+        clock=None,
+    ):
+        self.kube = kube
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or f"manager-{uuid.uuid4().hex[:8]}"
+        self.lease_seconds = lease_seconds
+        self.renew_seconds = renew_seconds
+        self.retry_seconds = retry_seconds
+        import time as _time
+
+        self.clock = clock or _time.time
+        self.is_leader = False
+        self._renew_task: asyncio.Task | None = None
+
+    def _lease_body(self) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.lease_name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_seconds),
+                "renewTime": fmt_iso(self.clock()),
+            },
+        }
+
+    def _expired(self, lease: dict) -> bool:
+        renew = parse_iso(deep_get(lease, "spec", "renewTime", default="") or "")
+        duration = deep_get(
+            lease, "spec", "leaseDurationSeconds", default=self.lease_seconds
+        )
+        if renew is None:
+            return True
+        return self.clock() - renew > duration
+
+    async def try_acquire(self) -> bool:
+        """One acquisition attempt; True when this identity holds the lease.
+        Any apiserver error is a failed attempt, never an exception — a
+        transient blip must not crash acquire() nor kill the renew loop."""
+        try:
+            lease = await self.kube.get("Lease", self.lease_name, self.namespace)
+        except NotFound:
+            try:
+                await self.kube.create("Lease", self._lease_body())
+                return True
+            except ApiError:
+                return False
+        except ApiError:
+            return False
+        holder = deep_get(lease, "spec", "holderIdentity")
+        if holder == self.identity or self._expired(lease):
+            lease["spec"] = self._lease_body()["spec"]
+            try:
+                await self.kube.update("Lease", lease)
+                return True
+            except ApiError:
+                return False
+        return False
+
+    async def acquire(self) -> None:
+        """Block until leadership is held, then keep renewing in background."""
+        while not await self.try_acquire():
+            await asyncio.sleep(self.retry_seconds)
+        self.is_leader = True
+        log.info("leader election: %s acquired %s", self.identity, self.lease_name)
+        self._renew_task = asyncio.create_task(self._renew_loop())
+
+    async def _renew_loop(self) -> None:
+        try:
+            failures = 0
+            while True:
+                await asyncio.sleep(self.renew_seconds)
+                if await self.try_acquire():
+                    failures = 0
+                    continue
+                # Tolerate transient renew failures while the lease we hold
+                # is still fresh; give up once it could have expired.
+                failures += 1
+                if failures * self.renew_seconds >= self.lease_seconds:
+                    break
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("leader election: renew loop crashed")
+        # Lost (or possibly lost) the lease: a split-brain manager must
+        # stop reconciling immediately.
+        self.is_leader = False
+        log.error("leader election: %s LOST %s", self.identity, self.lease_name)
+
+    async def release(self) -> None:
+        if self._renew_task:
+            self._renew_task.cancel()
+            try:
+                await self._renew_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.is_leader:
+            try:
+                lease = await self.kube.get(
+                    "Lease", self.lease_name, self.namespace
+                )
+                if deep_get(lease, "spec", "holderIdentity") == self.identity:
+                    lease["spec"]["holderIdentity"] = ""
+                    lease["spec"]["renewTime"] = None
+                    await self.kube.update("Lease", lease)
+            except ApiError:
+                pass
+        self.is_leader = False

@@ -1,0 +1,94 @@
+"""Notebook load test.
+
+Reference: ``notebook-controller/loadtest/start_notebooks.py`` — spawn N
+Notebook CRs from a template, wait, tear down. Ours runs against any
+KubeApi (FakeKube for control-plane-only measurement, HttpKube for a real
+cluster) and reports spawn latency percentiles — the number the reference
+harness never recorded (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.objects import deep_get
+
+
+@dataclass
+class LoadTestReport:
+    notebooks: int
+    ready: int
+    wall_seconds: float
+    p50_ready_seconds: float | None
+    p95_ready_seconds: float | None
+    failures: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+
+async def run_load_test(
+    kube,
+    *,
+    count: int = 50,
+    namespace: str = "loadtest",
+    accelerator: str | None = None,
+    topology: str | None = None,
+    timeout: float = 120.0,
+    cleanup: bool = True,
+    poll_interval: float = 0.05,
+) -> LoadTestReport:
+    t0 = time.perf_counter()
+    names = [f"load-{i}" for i in range(count)]
+    for name in names:
+        await kube.create(
+            "Notebook",
+            nbapi.new(name, namespace, accelerator=accelerator, topology=topology),
+        )
+
+    ready_at: dict[str, float] = {}
+    failed: dict[str, str] = {}
+    deadline = t0 + timeout
+    while len(ready_at) + len(failed) < count and time.perf_counter() < deadline:
+        for name in names:
+            if name in ready_at or name in failed:
+                continue
+            nb = await kube.get_or_none("Notebook", name, namespace)
+            if nb is None:
+                failed[name] = f"{name}: disappeared"
+                continue
+            want = deep_get(nb, "status", "tpu", "hosts", default=1) or 1
+            if (deep_get(nb, "status", "readyReplicas", default=0) or 0) >= want:
+                ready_at[name] = time.perf_counter() - t0
+        await asyncio.sleep(poll_interval)
+
+    wall = time.perf_counter() - t0
+    failures = list(failed.values())
+    latencies = sorted(ready_at.values())
+
+    def pct(p: float) -> float | None:
+        """Nearest-rank percentile: ceil(p*n)-th smallest."""
+        if not latencies:
+            return None
+        rank = max(1, math.ceil(p * len(latencies)))
+        return latencies[rank - 1]
+
+    if cleanup:
+        for name in names:
+            try:
+                await kube.delete("Notebook", name, namespace)
+            except Exception:
+                pass
+
+    return LoadTestReport(
+        notebooks=count,
+        ready=len(ready_at),
+        wall_seconds=round(wall, 3),
+        p50_ready_seconds=round(pct(0.50), 4) if latencies else None,
+        p95_ready_seconds=round(pct(0.95), 4) if latencies else None,
+        failures=failures,
+    )

@@ -1,0 +1,96 @@
+/* Shared frontend helpers: CSRF-aware fetch, table rendering, namespace
+   state (the reference's kubeflow-common-lib backend service + polling
+   modules, distilled). */
+
+function getCookie(name) {
+  const m = document.cookie.match(new RegExp("(?:^|; )" + name + "=([^;]*)"));
+  return m ? decodeURIComponent(m[1]) : null;
+}
+
+async function api(path, options = {}) {
+  const headers = Object.assign(
+    { "Content-Type": "application/json" },
+    options.headers || {}
+  );
+  const method = (options.method || "GET").toUpperCase();
+  if (method !== "GET" && method !== "HEAD") {
+    const token = getCookie("XSRF-TOKEN");
+    if (token) headers["X-XSRF-TOKEN"] = token;
+  }
+  const resp = await fetch(path, Object.assign({}, options, { headers }));
+  const body = await resp.json().catch(() => ({}));
+  if (!resp.ok || body.success === false) {
+    throw new Error(body.log || resp.status + " " + resp.statusText);
+  }
+  return body;
+}
+
+function el(tag, attrs = {}, ...children) {
+  const node = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs)) {
+    if (k === "onclick") node.addEventListener("click", v);
+    else if (k === "class") node.className = v;
+    else node.setAttribute(k, v);
+  }
+  for (const child of children.flat()) {
+    node.append(child instanceof Node ? child : document.createTextNode(child));
+  }
+  return node;
+}
+
+function statusDot(phase, message) {
+  return el(
+    "span",
+    { class: "status", title: message || "" },
+    el("span", { class: "dot " + phase }),
+    phase
+  );
+}
+
+function renderTable(container, columns, rows) {
+  container.replaceChildren(
+    el(
+      "table",
+      {},
+      el("thead", {}, el("tr", {}, columns.map((c) => el("th", {}, c.title)))),
+      el(
+        "tbody",
+        {},
+        rows.map((row) =>
+          el("tr", {}, columns.map((c) => el("td", {}, c.render(row))))
+        )
+      )
+    )
+  );
+}
+
+const ns = {
+  get() {
+    return localStorage.getItem("kubeflow.namespace") || "kubeflow-user";
+  },
+  set(value) {
+    localStorage.setItem("kubeflow.namespace", value);
+  },
+};
+
+function namespacePicker(onChange) {
+  const input = el("input", { value: ns.get(), style: "width:180px" });
+  input.addEventListener("change", () => {
+    ns.set(input.value);
+    onChange(input.value);
+  });
+  return input;
+}
+
+function showError(err) {
+  const banner = document.getElementById("error-banner");
+  if (!banner) return alert(err.message || err);
+  banner.textContent = String(err.message || err);
+  banner.style.display = "block";
+  setTimeout(() => (banner.style.display = "none"), 8000);
+}
+
+function poll(fn, intervalMs = 4000) {
+  fn().catch(showError);
+  return setInterval(() => fn().catch(() => {}), intervalMs);
+}

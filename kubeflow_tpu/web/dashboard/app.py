@@ -25,6 +25,7 @@ from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
 from kubeflow_tpu.tpu.topology import TPU_RESOURCE
 from kubeflow_tpu.web.common.app import create_base_app, json_success
+from kubeflow_tpu.web.common.serving import add_spa
 from kubeflow_tpu.web.common.auth import ensure
 
 DEFAULT_LINKS = [
@@ -47,6 +48,7 @@ def create_app(
     app["links"] = links or DEFAULT_LINKS
     app["registration_flow"] = registration_flow
     app.add_routes(routes)
+    add_spa(app, __file__)
     return app
 
 

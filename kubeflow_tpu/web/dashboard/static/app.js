@@ -1,0 +1,67 @@
+/* Dashboard frontend: workgroup bootstrap, app links, namespaces, TPU usage. */
+
+async function loadLinks() {
+  const body = await api("api/dashboard-links");
+  document
+    .getElementById("links")
+    .replaceChildren(
+      body.menuLinks.map((link) =>
+        el("a", { href: link.link, style: "margin-right:24px" }, link.text)
+      )
+    );
+}
+
+async function loadTpuUsage(namespace) {
+  const body = await api(`api/namespaces/${namespace}/tpu-usage`);
+  const target = document.getElementById("tpu-table");
+  const quota = body.chipsQuota == null ? "no quota" : `quota ${body.chipsQuota}`;
+  target.classList.remove("muted");
+  target.replaceChildren(
+    el("p", {}, `${body.chipsRequested} chips requested in ${namespace} (${quota})`),
+    body.pods.length
+      ? el(
+          "div",
+          {},
+          body.pods.map((p) =>
+            el("span", { class: "chip" }, `${p.pod}: ${p.chips}`)
+          )
+        )
+      : el("p", { class: "muted" }, "No TPU pods running.")
+  );
+}
+
+async function refresh() {
+  const info = await api("api/workgroup/env-info");
+  document.getElementById("user-slot").textContent = info.user;
+  const exists = await api("api/workgroup/exists");
+  document.getElementById("register-card").style.display =
+    exists.hasWorkgroup || !exists.registrationFlowAllowed ? "none" : "block";
+  renderTable(
+    document.getElementById("ns-table"),
+    [
+      {
+        title: "Namespace",
+        render: (n) =>
+          el("a", { href: "#", onclick: (ev) => {
+            ev.preventDefault();
+            loadTpuUsage(n.namespace).catch(showError);
+          } }, n.namespace),
+      },
+      { title: "Role", render: (n) => n.role },
+    ],
+    info.namespaces
+  );
+  if (info.namespaces.length) {
+    loadTpuUsage(info.namespaces[0].namespace).catch(() => {});
+  }
+}
+
+document.getElementById("register-btn").addEventListener("click", () => {
+  api("api/workgroup/create", { method: "POST", body: "{}" }).then(
+    refresh,
+    showError
+  );
+});
+
+loadLinks().catch(showError);
+poll(refresh, 10000);

@@ -18,6 +18,7 @@ from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.runtime.errors import Invalid, NotFound
 from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of, now_iso
 from kubeflow_tpu.web.common.app import create_base_app, json_success
+from kubeflow_tpu.web.common.serving import add_spa
 from kubeflow_tpu.web.common.auth import ensure
 from kubeflow_tpu.web.common.status import process_status
 from kubeflow_tpu.web.jupyter.form import notebook_from_form
@@ -29,6 +30,7 @@ def create_app(kube, *, config: dict | None = None, config_path: str | None = No
     app = create_base_app(kube, **kwargs)
     app["config"] = config or load_config(config_path)
     app.add_routes(routes)
+    add_spa(app, __file__)
     return app
 
 

@@ -1,0 +1,148 @@
+/* JWA frontend: table + spawner form (the reference's Angular app distilled;
+   TPU accelerator/topology pickers come from /api/tpus). */
+
+let tpuCatalog = [];
+
+async function loadCatalogs() {
+  const [tpus, config] = await Promise.all([
+    api("api/tpus"),
+    api("api/config"),
+  ]);
+  tpuCatalog = tpus.tpus;
+
+  const accSelect = document.getElementById("tpu-acc");
+  accSelect.replaceChildren(
+    el("option", { value: "" }, "none (CPU)"),
+    tpuCatalog.map((t) =>
+      el("option", { value: t.accelerator }, t.accelerator)
+    )
+  );
+  accSelect.addEventListener("change", renderTopologies);
+  renderTopologies();
+
+  const imageSelect = document.getElementById("image-select");
+  const images = (config.config.image && config.config.image.options) || [];
+  imageSelect.replaceChildren(
+    images.map((img) => el("option", { value: img }, img))
+  );
+}
+
+function renderTopologies() {
+  const acc = document.getElementById("tpu-acc").value;
+  const topoSelect = document.getElementById("tpu-topo");
+  const entry = tpuCatalog.find((t) => t.accelerator === acc);
+  topoSelect.replaceChildren(
+    (entry ? entry.topologies : []).map((t) =>
+      el(
+        "option",
+        { value: t.topology },
+        `${t.topology} — ${t.chips} chips, ${t.hosts} host${t.hosts > 1 ? "s" : ""}`
+      )
+    )
+  );
+}
+
+async function refresh() {
+  const body = await api(`api/namespaces/${ns.get()}/notebooks`);
+  const columns = [
+    {
+      title: "Status",
+      render: (nb) => statusDot(nb.status.phase, nb.status.message),
+    },
+    { title: "Name", render: (nb) => nb.name },
+    { title: "Image", render: (nb) => nb.image.split("/").pop() },
+    { title: "CPU", render: (nb) => nb.cpu || "-" },
+    { title: "Memory", render: (nb) => nb.memory || "-" },
+    {
+      title: "TPU",
+      render: (nb) =>
+        nb.tpu
+          ? el(
+              "span",
+              {},
+              el("span", { class: "chip" }, `${nb.tpu.accelerator} ${nb.tpu.topology}`),
+              nb.tpuStatus
+                ? `${nb.tpuStatus.readyHosts}/${nb.tpuStatus.hosts} hosts`
+                : ""
+            )
+          : "—",
+    },
+    {
+      title: "Actions",
+      render: (nb) => {
+        const stopped = nb.status.phase === "stopped";
+        return el(
+          "span",
+          {},
+          el(
+            "button",
+            {
+              onclick: () =>
+                api(`api/namespaces/${ns.get()}/notebooks/${nb.name}`, {
+                  method: "PATCH",
+                  body: JSON.stringify({ stopped: !stopped }),
+                }).then(refresh, showError),
+            },
+            stopped ? "Start" : "Stop"
+          ),
+          " ",
+          el(
+            "button",
+            { class: "danger",
+              onclick: () =>
+                confirm(`Delete notebook ${nb.name}?`) &&
+                api(`api/namespaces/${ns.get()}/notebooks/${nb.name}`, {
+                  method: "DELETE",
+                }).then(refresh, showError),
+            },
+            "Delete"
+          ),
+          " ",
+          el(
+            "a",
+            { href: `/notebook/${ns.get()}/${nb.name}/`, target: "_blank" },
+            "Connect"
+          )
+        );
+      },
+    },
+  ];
+  renderTable(document.getElementById("notebook-table"), columns, body.notebooks);
+}
+
+document.getElementById("new-btn").addEventListener("click", () => {
+  document.getElementById("new-form-card").style.display = "block";
+});
+document.getElementById("cancel-btn").addEventListener("click", () => {
+  document.getElementById("new-form-card").style.display = "none";
+});
+document.getElementById("new-form").addEventListener("submit", (ev) => {
+  ev.preventDefault();
+  const form = new FormData(ev.target);
+  const payload = {
+    name: form.get("name"),
+    cpu: form.get("cpu"),
+    memory: form.get("memory"),
+  };
+  if (form.get("customImage")) payload.customImage = form.get("customImage");
+  else payload.image = form.get("image");
+  if (form.get("tpu-acc")) {
+    payload.tpu = {
+      accelerator: form.get("tpu-acc"),
+      topology: form.get("tpu-topo"),
+    };
+  }
+  api(`api/namespaces/${ns.get()}/notebooks`, {
+    method: "POST",
+    body: JSON.stringify(payload),
+  }).then(() => {
+    document.getElementById("new-form-card").style.display = "none";
+    refresh();
+  }, showError);
+});
+
+document
+  .getElementById("ns-slot")
+  .append(namespacePicker(() => refresh().catch(showError)));
+loadCatalogs().catch(showError);
+poll(refresh);

@@ -1,0 +1,66 @@
+/* TWA frontend. */
+
+async function refresh() {
+  const body = await api(`api/namespaces/${ns.get()}/tensorboards`);
+  const columns = [
+    {
+      title: "Status",
+      render: (tb) => statusDot(tb.ready ? "ready" : "waiting", ""),
+    },
+    { title: "Name", render: (tb) => tb.name },
+    { title: "Logs path", render: (tb) => tb.logspath },
+    {
+      title: "Actions",
+      render: (tb) =>
+        el(
+          "span",
+          {},
+          el(
+            "a",
+            { href: `/tensorboard/${ns.get()}/${tb.name}/`, target: "_blank" },
+            "Open"
+          ),
+          " ",
+          el(
+            "button",
+            { class: "danger",
+              onclick: () =>
+                confirm(`Delete ${tb.name}?`) &&
+                api(`api/namespaces/${ns.get()}/tensorboards/${tb.name}`, {
+                  method: "DELETE",
+                }).then(refresh, showError),
+            },
+            "Delete"
+          )
+        ),
+    },
+  ];
+  renderTable(document.getElementById("tb-table"), columns, body.tensorboards);
+}
+
+document.getElementById("new-btn").addEventListener("click", () => {
+  document.getElementById("new-form-card").style.display = "block";
+});
+document.getElementById("cancel-btn").addEventListener("click", () => {
+  document.getElementById("new-form-card").style.display = "none";
+});
+document.getElementById("new-form").addEventListener("submit", (ev) => {
+  ev.preventDefault();
+  const form = new FormData(ev.target);
+  api(`api/namespaces/${ns.get()}/tensorboards`, {
+    method: "POST",
+    body: JSON.stringify({
+      name: form.get("name"),
+      logspath: form.get("logspath"),
+      profilerPlugin: form.get("profiler") === "on",
+    }),
+  }).then(() => {
+    document.getElementById("new-form-card").style.display = "none";
+    refresh();
+  }, showError);
+});
+
+document
+  .getElementById("ns-slot")
+  .append(namespacePicker(() => refresh().catch(showError)));
+poll(refresh);

@@ -13,12 +13,14 @@ from kubeflow_tpu.api import pvcviewer as pvcapi
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, name_of
 from kubeflow_tpu.web.common.app import create_base_app, json_success
+from kubeflow_tpu.web.common.serving import add_spa
 from kubeflow_tpu.web.common.auth import ensure
 
 
 def create_app(kube, **kwargs) -> web.Application:
     app = create_base_app(kube, **kwargs)
     app.add_routes(routes)
+    add_spa(app, __file__)
     return app
 
 

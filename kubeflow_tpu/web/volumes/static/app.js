@@ -1,0 +1,79 @@
+/* VWA frontend: PVC table with viewer lifecycle. */
+
+async function refresh() {
+  const body = await api(`api/namespaces/${ns.get()}/pvcs`);
+  const columns = [
+    { title: "Name", render: (p) => p.name },
+    { title: "Size", render: (p) => p.capacity || "-" },
+    { title: "Modes", render: (p) => (p.modes || []).join(", ") },
+    { title: "Status", render: (p) => p.status },
+    {
+      title: "Used by",
+      render: (p) =>
+        (p.usedBy || []).length
+          ? p.usedBy.map((name) => el("span", { class: "chip" }, name))
+          : "—",
+    },
+    {
+      title: "Actions",
+      render: (p) =>
+        el(
+          "span",
+          {},
+          p.viewer && p.viewer.ready && p.viewer.url
+            ? el("a", { href: p.viewer.url, target: "_blank" }, "Browse")
+            : el(
+                "button",
+                {
+                  onclick: () =>
+                    api(`api/namespaces/${ns.get()}/viewers`, {
+                      method: "POST",
+                      body: JSON.stringify({ pvc: p.name }),
+                    }).then(refresh, showError),
+                },
+                p.viewer ? "Viewer starting…" : "Open viewer"
+              ),
+          " ",
+          el(
+            "button",
+            { class: "danger",
+              onclick: () =>
+                confirm(`Delete volume ${p.name}?`) &&
+                api(`api/namespaces/${ns.get()}/pvcs/${p.name}`, {
+                  method: "DELETE",
+                }).then(refresh, showError),
+            },
+            "Delete"
+          )
+        ),
+    },
+  ];
+  renderTable(document.getElementById("pvc-table"), columns, body.pvcs);
+}
+
+document.getElementById("new-btn").addEventListener("click", () => {
+  document.getElementById("new-form-card").style.display = "block";
+});
+document.getElementById("cancel-btn").addEventListener("click", () => {
+  document.getElementById("new-form-card").style.display = "none";
+});
+document.getElementById("new-form").addEventListener("submit", (ev) => {
+  ev.preventDefault();
+  const form = new FormData(ev.target);
+  api(`api/namespaces/${ns.get()}/pvcs`, {
+    method: "POST",
+    body: JSON.stringify({
+      name: form.get("name"),
+      size: form.get("size"),
+      mode: form.get("mode"),
+    }),
+  }).then(() => {
+    document.getElementById("new-form-card").style.display = "none";
+    refresh();
+  }, showError);
+});
+
+document
+  .getElementById("ns-slot")
+  .append(namespacePicker(() => refresh().catch(showError)));
+poll(refresh);

@@ -1,0 +1,77 @@
+"""Leader election protocol + load-test harness suites."""
+
+import asyncio
+
+from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+from kubeflow_tpu.runtime.leaderelection import LeaderElector
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.loadtest import run_load_test
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+async def test_leader_election_single_winner_and_takeover():
+    kube = FakeKube()
+    clock = FakeClock()
+    a = LeaderElector(kube, identity="a", clock=clock, lease_seconds=10)
+    b = LeaderElector(kube, identity="b", clock=clock, lease_seconds=10)
+
+    assert await a.try_acquire() is True
+    assert await b.try_acquire() is False      # lease held and fresh
+    assert await a.try_acquire() is True       # holder renews freely
+
+    clock.t += 11                              # lease expires
+    assert await b.try_acquire() is True       # standby takes over
+    assert await a.try_acquire() is False      # old leader locked out
+
+
+async def test_leader_election_acquire_renew_release():
+    kube = FakeKube()
+    elector = LeaderElector(
+        kube, identity="solo", renew_seconds=0.01, retry_seconds=0.01
+    )
+    await elector.acquire()
+    assert elector.is_leader
+    await asyncio.sleep(0.05)                  # a few renew cycles
+    assert elector.is_leader
+    await elector.release()
+    assert not elector.is_leader
+    lease = await kube.get("Lease", elector.lease_name, elector.namespace)
+    assert lease["spec"]["holderIdentity"] == ""
+
+    # A successor can acquire immediately after release.
+    other = LeaderElector(kube, identity="next")
+    assert await other.try_acquire() is True
+
+
+async def test_load_test_spawns_and_reports_percentiles():
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr)
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    try:
+        report = await run_load_test(
+            kube, count=20, accelerator="v5e", topology="2x2", timeout=30
+        )
+        assert report.ready == 20
+        assert report.failures == []
+        assert report.p50_ready_seconds is not None
+        assert report.p95_ready_seconds >= report.p50_ready_seconds
+        # Cleanup removed the CRs.
+        assert await kube.list("Notebook", "loadtest") == []
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        kube.close_watches()

@@ -304,3 +304,27 @@ def test_status_state_machine_pure():
               "lastTimestamp": "2026-01-01T00:00:00Z"}]
     )
     assert s.phase == "warning" and "nodes available" in s.message
+
+
+async def test_spa_served_with_csrf_cookie():
+    from kubeflow_tpu.web.dashboard import create_app as create_dash
+
+    h = await WebHarness().start()
+    try:
+        for factory in (create_jwa, create_vwa, create_twa, create_dash):
+            app_client = await h.client(factory(h.kube))
+            resp = await app_client.get("/", headers=USER)
+            assert resp.status == 200
+            text = await resp.text()
+            assert "<html" in text and "kubeflow.js" in text
+            cookies = app_client.session.cookie_jar.filter_cookies(
+                app_client.make_url("/")
+            )
+            assert "XSRF-TOKEN" in cookies  # double-submit seed on index load
+            resp = await app_client.get(
+                "/static/common/kubeflow.js", headers=USER
+            )
+            assert resp.status == 200
+            assert "X-XSRF-TOKEN" in await resp.text()
+    finally:
+        await h.stop()
