@@ -1,0 +1,21 @@
+#!/usr/bin/env python3
+"""CI helper: create a CPU test Notebook against the live apiserver
+(reference analogue: testing/gh-actions/resources/test-notebook.yaml)."""
+
+import asyncio
+import sys
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.httpclient import HttpKube
+
+
+async def main(namespace: str) -> None:
+    kube = HttpKube()
+    nb = nbapi.new("test-notebook", namespace, image="kubeflow-tpu/jupyter-scipy:latest")
+    await kube.create("Notebook", nb)
+    print(f"created Notebook {namespace}/test-notebook")
+    await kube.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main(sys.argv[1] if len(sys.argv) > 1 else "default"))

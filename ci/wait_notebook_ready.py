@@ -1,0 +1,34 @@
+#!/usr/bin/env python3
+"""CI helper: wait for a Notebook's StatefulSet to exist and its pod to be
+Ready within a budget (reference CI gate: pods Ready ≤ 100 s on KinD)."""
+
+import asyncio
+import sys
+import time
+
+from kubeflow_tpu.runtime.httpclient import HttpKube
+from kubeflow_tpu.runtime.objects import deep_get
+
+
+async def main(namespace: str, name: str, budget: float) -> int:
+    kube = HttpKube()
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        sts = await kube.get_or_none("StatefulSet", name, namespace)
+        nb = await kube.get_or_none("Notebook", name, namespace)
+        ready = deep_get(nb or {}, "status", "readyReplicas", default=0)
+        if sts is not None and ready:
+            print(f"notebook {namespace}/{name} Ready "
+                  f"({budget - (deadline - time.monotonic()):.1f}s)")
+            await kube.close()
+            return 0
+        await asyncio.sleep(2)
+    print(f"FAIL: notebook {namespace}/{name} not Ready within {budget}s")
+    await kube.close()
+    return 1
+
+
+if __name__ == "__main__":
+    ns, name = sys.argv[1], sys.argv[2]
+    budget = float(sys.argv[3]) if len(sys.argv) > 3 else 100.0
+    sys.exit(asyncio.run(main(ns, name, budget)))

@@ -1,0 +1,272 @@
+#!/usr/bin/env python3
+"""Conformance runner: 8 checks, one JSON line each + a summary line.
+
+Hermetic by default (in-process fake cluster + controllers); ``--live``
+targets the current kubeconfig/proxy endpoint instead and skips the checks
+that need the simulator (pod Ready states, fault injection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.api import profile as profileapi
+from kubeflow_tpu.api import pvcviewer as pvcapi
+from kubeflow_tpu.api import tensorboard as tbapi
+from kubeflow_tpu.controllers.culling import CullingOptions, setup_culling_controller
+from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+from kubeflow_tpu.controllers.profile import setup_profile_controller
+from kubeflow_tpu.controllers.pvcviewer import setup_pvcviewer_controller
+from kubeflow_tpu.controllers.tensorboard import setup_tensorboard_controller
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get, get_meta
+from kubeflow_tpu.runtime.scheme import DEFAULT_SCHEME
+
+NS = "conformance"
+
+
+class Conformance:
+    def __init__(self, kube, mgr=None, sim=None, culler=None):
+        self.kube = kube
+        self.mgr = mgr
+        self.sim = sim
+        self.culler = culler
+        self.results: list[dict] = []
+
+    async def settle(self):
+        if self.mgr is None:
+            await asyncio.sleep(2.0)
+            return
+        for _ in range(10):
+            await self.mgr.wait_idle(timeout=30)
+            await asyncio.sleep(0.02)
+
+    async def check(self, name, fn):
+        start = time.perf_counter()
+        try:
+            await fn()
+            result = {"check": name, "pass": True}
+        except Exception as e:  # noqa: BLE001 — report, don't abort the suite
+            result = {"check": name, "pass": False, "error": f"{type(e).__name__}: {e}"}
+        result["seconds"] = round(time.perf_counter() - start, 3)
+        self.results.append(result)
+        print(json.dumps(result), flush=True)
+
+    # ---- checks ---------------------------------------------------------------
+
+    async def check_crds(self):
+        for kind in ("Notebook", "Profile", "PodDefault", "Tensorboard", "PVCViewer"):
+            DEFAULT_SCHEME.by_kind(kind)
+
+    async def check_notebook_lifecycle(self):
+        await self.kube.create("Notebook", nbapi.new("conf-nb", NS))
+        await self.settle()
+        nb = await self.kube.get("Notebook", "conf-nb", NS)
+        assert deep_get(nb, "status", "readyReplicas") == 1, "not Ready"
+        await self.kube.patch(
+            "Notebook", "conf-nb",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: "t"}}}, NS)
+        await self.settle()
+        sts = await self.kube.get("StatefulSet", "conf-nb", NS)
+        assert deep_get(sts, "spec", "replicas") == 0, "stop did not park"
+        await self.kube.patch(
+            "Notebook", "conf-nb",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: None}}}, NS)
+        await self.settle()
+        await self.kube.delete("Notebook", "conf-nb", NS)
+        await self.settle()
+        assert await self.kube.get_or_none("StatefulSet", "conf-nb", NS) is None, (
+            "cascade delete failed")
+
+    async def check_multi_host_slice(self):
+        await self.kube.create(
+            "Notebook", nbapi.new("conf-slice", NS, accelerator="v5e", topology="4x4"))
+        await self.settle()
+        sts = await self.kube.get("StatefulSet", "conf-slice", NS)
+        assert deep_get(sts, "spec", "replicas") == 2
+        headless = await self.kube.get("Service", "conf-slice-workers", NS)
+        assert deep_get(headless, "spec", "clusterIP") == "None"
+        ids = set()
+        for i in range(2):
+            pod = await self.kube.get_or_none("Pod", f"conf-slice-{i}", NS)
+            if pod:
+                env = {e["name"]: e.get("value")
+                       for e in deep_get(pod, "spec", "containers")[0]["env"]}
+                ids.add(env.get("TPU_WORKER_ID"))
+                assert "conf-slice-workers" in env["TPU_WORKER_HOSTNAMES"]
+        if self.sim is not None:
+            assert ids == {"0", "1"}, f"worker ids {ids}"
+
+    async def check_poddefault(self):
+        await self.kube.create(
+            "PodDefault",
+            {"metadata": {"name": "conf-pd", "namespace": NS},
+             "spec": {"selector": {"matchLabels": {"notebook-name": "conf-pd-nb"}},
+                      "env": [{"name": "CONF", "value": "1"}]}})
+        await self.kube.create("Notebook", nbapi.new("conf-pd-nb", NS))
+        await self.settle()
+        if self.sim is not None:
+            pod = await self.kube.get("Pod", "conf-pd-nb-0", NS)
+            env = {e["name"]: e.get("value")
+                   for e in deep_get(pod, "spec", "containers")[0]["env"]}
+            assert env.get("CONF") == "1", "PodDefault not injected"
+        try:
+            await self.kube.create(
+                "PodDefault",
+                {"metadata": {"name": "bad", "namespace": NS}, "spec": {}})
+            raise AssertionError("selector-less PodDefault accepted")
+        except Invalid:
+            pass
+
+    async def check_profile(self):
+        await self.kube.create(
+            "Profile", profileapi.new("conf-tenant", "conf@example.com", tpu_quota=8))
+        await self.settle()
+        assert await self.kube.get_or_none("Namespace", "conf-tenant")
+        quota = await self.kube.get("ResourceQuota", "kf-resource-quota", "conf-tenant")
+        assert quota["spec"]["hard"]["requests.google.com/tpu"] == "8"
+        for sa in ("default-editor", "default-viewer"):
+            assert await self.kube.get_or_none("ServiceAccount", sa, "conf-tenant")
+
+    async def check_tensorboard_pvcviewer(self):
+        await self.kube.create("Tensorboard", tbapi.new("conf-tb", NS, "gs://b/l"))
+        await self.kube.create(
+            "PersistentVolumeClaim",
+            {"metadata": {"name": "conf-data", "namespace": NS},
+             "spec": {"accessModes": ["ReadWriteMany"]}})
+        await self.kube.create("PVCViewer", pvcapi.new("conf-view", NS, "conf-data"))
+        await self.settle()
+        assert await self.kube.get_or_none("Deployment", "conf-tb", NS)
+        assert await self.kube.get_or_none("Deployment", "conf-view-pvcviewer", NS)
+        if self.sim is not None:
+            tb = await self.kube.get("Tensorboard", "conf-tb", NS)
+            assert deep_get(tb, "status", "readyReplicas") == 1
+
+    async def check_culling(self):
+        if self.culler is None:
+            raise AssertionError("skipped (no in-process culler)")
+        await self.kube.create("Notebook", nbapi.new("conf-cull", NS))
+        await self.settle()
+        await self.culler.reconcile((NS, "conf-cull"))  # seeds idle clock
+        self.culler.clock_offset += 10_000
+        await self.culler.reconcile((NS, "conf-cull"))
+        await self.settle()
+        sts = await self.kube.get("StatefulSet", "conf-cull", NS)
+        assert deep_get(sts, "spec", "replicas") == 0, "idle notebook not parked"
+
+    async def check_slice_restart(self):
+        if self.sim is None:
+            raise AssertionError("skipped (needs fault injection)")
+        crashed = {"done": False}
+
+        def injector(pod):
+            if get_meta(pod)["name"] == "conf-frag-1" and not crashed["done"]:
+                crashed["done"] = True
+                return "crash"
+            return None
+
+        self.sim.failure_injector = injector
+        await self.kube.create(
+            "Notebook", nbapi.new("conf-frag", NS, accelerator="v5e", topology="4x4"))
+        await self.settle()
+        await self.settle()
+        events = await self.kube.list("Event", NS)
+        assert any(e.get("reason") == "SliceRestart" for e in events)
+        self.sim.failure_injector = None
+
+
+async def run(live: bool) -> int:
+    if live:
+        from kubeflow_tpu.runtime.httpclient import HttpKube
+
+        kube = HttpKube()
+        conf = Conformance(kube)
+    else:
+        from kubeflow_tpu.testing.fakekube import FakeKube
+        from kubeflow_tpu.testing.podsim import PodSimulator
+        from kubeflow_tpu.webhooks import register_all
+
+        kube = FakeKube()
+        register_all(kube)
+        mgr = Manager(kube)
+        setup_notebook_controller(mgr)
+
+        class OffsetClock:
+            def __init__(self):
+                self.offset = 0.0
+
+            def __call__(self):
+                return time.time() + self.offset
+
+        clock = OffsetClock()
+
+        async def idle_prober(_url):
+            return []
+
+        culler = setup_culling_controller(
+            mgr, idle_prober, CullingOptions(cull_idle_seconds=300,
+                                             enable_culling=True),
+            clock=clock)
+        culler.clock_offset = 0.0
+
+        # Patch: expose clock offset through the reconciler for check_culling.
+        class CullerProxy:
+            def __init__(self, rec, clock):
+                self._rec = rec
+                self._clock = clock
+
+            @property
+            def clock_offset(self):
+                return self._clock.offset
+
+            @clock_offset.setter
+            def clock_offset(self, value):
+                self._clock.offset = value
+
+            async def reconcile(self, key):
+                return await self._rec.reconcile(key)
+
+        setup_profile_controller(mgr)
+        setup_tensorboard_controller(mgr)
+        setup_pvcviewer_controller(mgr)
+        sim = PodSimulator(kube)
+        await mgr.start()
+        await sim.start()
+        conf = Conformance(kube, mgr, sim, CullerProxy(culler, clock))
+
+    await conf.check("crds-registered", conf.check_crds)
+    await conf.check("notebook-lifecycle", conf.check_notebook_lifecycle)
+    await conf.check("multi-host-slice", conf.check_multi_host_slice)
+    await conf.check("poddefault-injection", conf.check_poddefault)
+    await conf.check("profile-tenancy", conf.check_profile)
+    await conf.check("tensorboard-pvcviewer", conf.check_tensorboard_pvcviewer)
+    await conf.check("culling", conf.check_culling)
+    await conf.check("slice-atomic-restart", conf.check_slice_restart)
+
+    passed = sum(1 for r in conf.results if r["pass"])
+    print(json.dumps({"summary": f"{passed}/{len(conf.results)} checks passed"}))
+
+    if conf.mgr is not None:
+        await conf.sim.stop()
+        await conf.mgr.stop()
+        conf.kube.close_watches()
+    elif hasattr(conf.kube, "close"):
+        await conf.kube.close()
+    return 0 if passed == len(conf.results) else 1
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--live", action="store_true")
+    args = parser.parse_args()
+    sys.exit(asyncio.run(run(args.live)))
+
+
+if __name__ == "__main__":
+    main()

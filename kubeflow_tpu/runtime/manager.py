@@ -66,6 +66,9 @@ class Manager:
         self.informers: dict[tuple[str, str | None], Informer] = {}
         self._queues: dict[str, RateLimitedQueue] = {}
         self._tasks: list[asyncio.Task] = []
+        from kubeflow_tpu.runtime.tracing import Tracer
+
+        self.tracer = Tracer(self.registry)
         self._reconcile_total = self.registry.counter(
             "controller_reconcile_total", "Reconciles per controller", ["controller", "result"]
         )
@@ -157,7 +160,10 @@ class Manager:
                 return
             self._queue_depth.labels(controller=ctrl.name).set(len(queue))
             try:
-                result = await ctrl.reconcile(key)
+                with self.tracer.span(
+                    "reconcile", controller=ctrl.name, key=str(key)
+                ):
+                    result = await ctrl.reconcile(key)
             except Exception:
                 log.exception("reconcile %s %s failed", ctrl.name, key)
                 self._reconcile_total.labels(controller=ctrl.name, result="error").inc()

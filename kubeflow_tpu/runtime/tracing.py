@@ -1,0 +1,61 @@
+"""Lightweight tracing for reconcile loops.
+
+The reference has no distributed tracing (SURVEY.md §5: "No OpenTelemetry
+anywhere"); the rebuild adds optional spans: when the ``opentelemetry`` SDK
+is importable AND tracing is enabled, real OTel spans are emitted; otherwise
+spans degrade to structured debug logs + a per-controller latency histogram
+(always on — this is where reconcile-duration metrics come from).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+
+from kubeflow_tpu.runtime.metrics import Registry, global_registry
+
+log = logging.getLogger("kubeflow_tpu.trace")
+
+_otel_tracer = None
+if os.environ.get("ENABLE_TRACING") == "true":  # pragma: no cover
+    try:
+        from opentelemetry import trace as _otel_trace
+
+        _otel_tracer = _otel_trace.get_tracer("kubeflow_tpu")
+    except ImportError:
+        _otel_tracer = None
+
+
+class Tracer:
+    def __init__(self, registry: Registry | None = None):
+        registry = registry or global_registry
+        self.h_duration = registry.histogram(
+            "controller_reconcile_duration_seconds",
+            "Reconcile latency per controller",
+            ["controller"],
+        )
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        start = time.perf_counter()
+        otel_cm = (
+            _otel_tracer.start_as_current_span(name)
+            if _otel_tracer is not None
+            else contextlib.nullcontext()
+        )
+        with otel_cm as otel_span:
+            if otel_span is not None and hasattr(otel_span, "set_attribute"):
+                for key, value in attrs.items():
+                    otel_span.set_attribute(key, str(value))
+            try:
+                yield
+            finally:
+                elapsed = time.perf_counter() - start
+                controller = attrs.get("controller", name)
+                self.h_duration.observe(elapsed, controller=str(controller))
+                log.debug("span %s %s took %.4fs", name, attrs, elapsed)
+
+
+global_tracer = Tracer()

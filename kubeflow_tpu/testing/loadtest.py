@@ -52,12 +52,21 @@ async def run_load_test(
 
     ready_at: dict[str, float] = {}
     failed: dict[str, str] = {}
+    wanted = set(names)
     deadline = t0 + timeout
     while len(ready_at) + len(failed) < count and time.perf_counter() < deadline:
+        # One list per poll pass (NOT a GET per notebook: against a real
+        # apiserver the serialized round-trips would skew the very spawn
+        # latencies being measured).
+        listed = {
+            name: nb
+            for nb in await kube.list("Notebook", namespace)
+            if (name := nb["metadata"]["name"]) in wanted
+        }
         for name in names:
             if name in ready_at or name in failed:
                 continue
-            nb = await kube.get_or_none("Notebook", name, namespace)
+            nb = listed.get(name)
             if nb is None:
                 failed[name] = f"{name}: disappeared"
                 continue
@@ -67,6 +76,9 @@ async def run_load_test(
         await asyncio.sleep(poll_interval)
 
     wall = time.perf_counter() - t0
+    for name in names:  # pending-at-deadline notebooks are failures too
+        if name not in ready_at and name not in failed:
+            failed[name] = f"{name}: not ready within {timeout}s"
     failures = list(failed.values())
     latencies = sorted(ready_at.values())
 

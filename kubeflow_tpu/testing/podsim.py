@@ -26,9 +26,21 @@ from kubeflow_tpu.testing.fakekube import FakeKube
 
 
 class PodSimulator:
-    def __init__(self, kube: FakeKube, *, start_latency: float = 0.0):
+    def __init__(
+        self,
+        kube: FakeKube,
+        *,
+        start_latency: float = 0.0,
+        failure_injector=None,
+    ):
+        """``failure_injector(pod) -> None | "fail" | "crash"`` — fault
+        injection the reference never had (SURVEY.md §5 "No fault injection
+        framework"): "fail" leaves the pod phase=Failed (scheduling/image
+        errors); "crash" marks one in-place container restart (the signal
+        the slice-atomic restart logic keys on)."""
         self.kube = kube
         self.start_latency = start_latency
+        self.failure_injector = failure_injector
         self._tasks: list[asyncio.Task] = []
         # Strong refs: asyncio holds tasks weakly; un-referenced _run_pod
         # tasks can be GC'd mid-flight (pods stuck Pending, flaky tests).
@@ -151,6 +163,49 @@ class PodSimulator:
         if self.start_latency:
             await asyncio.sleep(self.start_latency)
         ns, name = namespace_of(pod), name_of(pod)
+        fault = self.failure_injector(pod) if self.failure_injector else None
+        if fault == "fail":
+            try:
+                await self.kube.patch(
+                    "Pod", name,
+                    {"status": {"phase": "Failed",
+                                "reason": "Injected",
+                                "conditions": []}},
+                    ns, subresource="status",
+                )
+            except NotFound:
+                pass
+            return
+        if fault == "crash":
+            try:
+                await self.kube.patch(
+                    "Pod", name,
+                    {
+                        "status": {
+                            "phase": "Running",
+                            "conditions": [{"type": "Ready", "status": "False"}],
+                            "containerStatuses": [
+                                {
+                                    "name": c.get("name", "main"),
+                                    "ready": False,
+                                    "restartCount": 1,
+                                    "state": {"running": {"startedAt": "now"}},
+                                    "lastState": {
+                                        "terminated": {"exitCode": 137,
+                                                       "reason": "OOMKilled"}
+                                    },
+                                }
+                                for c in deep_get(
+                                    pod, "spec", "containers", default=[]
+                                )
+                            ],
+                        }
+                    },
+                    ns, subresource="status",
+                )
+            except NotFound:
+                pass
+            return
         try:
             await self.kube.patch(
                 "Pod",

@@ -5,7 +5,7 @@ async function loadLinks() {
   document
     .getElementById("links")
     .replaceChildren(
-      body.menuLinks.map((link) =>
+      ...body.menuLinks.map((link) =>
         el("a", { href: link.link, style: "margin-right:24px" }, link.text)
       )
     );

@@ -11,9 +11,10 @@ async function loadCatalogs() {
   tpuCatalog = tpus.tpus;
 
   const accSelect = document.getElementById("tpu-acc");
+  // NB: replaceChildren stringifies arrays — always spread node lists.
   accSelect.replaceChildren(
     el("option", { value: "" }, "none (CPU)"),
-    tpuCatalog.map((t) =>
+    ...tpuCatalog.map((t) =>
       el("option", { value: t.accelerator }, t.accelerator)
     )
   );
@@ -23,7 +24,7 @@ async function loadCatalogs() {
   const imageSelect = document.getElementById("image-select");
   const images = (config.config.image && config.config.image.options) || [];
   imageSelect.replaceChildren(
-    images.map((img) => el("option", { value: img }, img))
+    ...images.map((img) => el("option", { value: img }, img))
   );
 }
 
@@ -32,7 +33,7 @@ function renderTopologies() {
   const topoSelect = document.getElementById("tpu-topo");
   const entry = tpuCatalog.find((t) => t.accelerator === acc);
   topoSelect.replaceChildren(
-    (entry ? entry.topologies : []).map((t) =>
+    ...(entry ? entry.topologies : []).map((t) =>
       el(
         "option",
         { value: t.topology },

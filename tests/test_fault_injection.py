@@ -1,0 +1,69 @@
+"""Fault-injection suites: the failure paths the reference never exercised
+(SURVEY.md §5), driven through the simulator's injector."""
+
+import asyncio
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get, name_of
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.web.common.status import process_status
+from kubeflow_tpu.webhooks import register_all
+
+
+async def run_with_injector(injector, notebook, settle_rounds=8):
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr)
+    sim = PodSimulator(kube, failure_injector=injector)
+    await mgr.start()
+    await sim.start()
+    try:
+        await kube.create("Notebook", notebook)
+        for _ in range(settle_rounds):
+            await mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.02)
+        return kube, await kube.get(
+            "Notebook", notebook["metadata"]["name"],
+            notebook["metadata"]["namespace"],
+        )
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        kube.close_watches()
+
+
+async def test_failed_pod_surfaces_in_status():
+    kube, nb = await run_with_injector(
+        lambda pod: "fail", nbapi.new("doomed", "ns")
+    )
+    assert deep_get(nb, "status", "readyReplicas") == 0
+    status = process_status(nb)
+    assert status.phase in ("waiting", "warning")
+
+
+async def test_crash_of_one_worker_restarts_whole_slice():
+    crashed = {"done": False}
+
+    def injector(pod):
+        # Crash worker 1 exactly once; replacements run clean.
+        if name_of(pod) == "slice-1" and not crashed["done"]:
+            crashed["done"] = True
+            return "crash"
+        return None
+
+    kube, nb = await run_with_injector(
+        injector, nbapi.new("slice", "ns", accelerator="v5e", topology="4x4"),
+        settle_rounds=12,
+    )
+    events = await kube.list("Event", "ns")
+    assert any(e.get("reason") == "SliceRestart" for e in events)
+    # After the atomic restart, replacement workers are clean and ready.
+    for i in range(2):
+        pod = await kube.get("Pod", f"slice-{i}", "ns")
+        statuses = deep_get(pod, "status", "containerStatuses", default=[])
+        assert all(cs.get("restartCount", 0) == 0 for cs in statuses)
+    assert deep_get(nb, "status", "readyReplicas") == 2
