@@ -11,7 +11,21 @@ from kubeflow_tpu.runtime.httpclient import HttpKube
 
 async def main(namespace: str) -> None:
     kube = HttpKube()
-    nb = nbapi.new("test-notebook", namespace, image="kubeflow-tpu/jupyter-scipy:latest")
+    # A public slim image KinD can pull (the kubeflow-tpu/* images aren't
+    # published/kind-loaded in CI); Ready == Running since no probes are set.
+    nb = nbapi.new(
+        "test-notebook",
+        namespace,
+        pod_spec={
+            "containers": [
+                {
+                    "name": "test-notebook",
+                    "image": "python:3.12-slim",
+                    "command": ["python", "-m", "http.server", "8888"],
+                }
+            ]
+        },
+    )
     await kube.create("Notebook", nb)
     print(f"created Notebook {namespace}/test-notebook")
     await kube.close()

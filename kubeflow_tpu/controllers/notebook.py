@@ -67,7 +67,10 @@ POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"  # set by the STS controll
 @dataclass
 class NotebookOptions:
     """The reference's env-var sprawl (USE_ISTIO, ISTIO_GATEWAY, CLUSTER_DOMAIN,
-    ADD_FSGROUP — notebook_controller.go:213,475,537-560) as one typed block."""
+    ADD_FSGROUP — notebook_controller.go:213,475,537-560) as one typed block.
+    The odh-controller features fold in here too (SURVEY.md §2.1):
+    NetworkPolicies (notebook_network.go), trusted-CA aggregation
+    (notebook_controller.go:253-353), auth-proxy sidecar (notebook_oauth.go)."""
 
     use_istio: bool = False
     istio_gateway: str = "kubeflow/kubeflow-gateway"
@@ -77,6 +80,25 @@ class NotebookOptions:
     fsgroup: int = 100
     workers_service_suffix: str = "-workers"
     default_serving_port: int = nbapi.DEFAULT_CONTAINER_PORT
+    # NetworkPolicy per notebook: HTTP only from gateway namespaces; slice
+    # workers may talk to each other (DCN bootstrap).
+    create_network_policies: bool = False
+    gateway_namespaces: tuple = ("istio-system", "kubeflow-tpu")
+    # Trusted-CA bundle: ConfigMap <trusted_ca_configmap> in
+    # <controller_namespace> is mirrored into the notebook namespace and
+    # mounted into every container.
+    trusted_ca_configmap: str | None = None
+    controller_namespace: str = "kubeflow-tpu"
+    ca_bundle_mount_path: str = "/etc/pki/tls/certs/custom-ca-bundle.crt"
+    # Auth-proxy sidecar (odh oauth-proxy equivalent) for meshless clusters:
+    # injected when the notebook has the inject-auth-proxy annotation.
+    auth_proxy_image: str | None = None
+    auth_proxy_port: int = 3000
+
+
+AUTH_PROXY_ANNOTATION = "notebooks.kubeflow.org/inject-auth-proxy"
+CA_BUNDLE_CONFIGMAP = "kubeflow-tpu-ca-bundle"
+CA_BUNDLE_KEY = "ca-bundle.crt"
 
 
 class NotebookReconciler:
@@ -120,6 +142,9 @@ class NotebookReconciler:
             await self.recorder.event(nb, "Warning", "InvalidSpec", str(e))
             return None
 
+        if self.opts.trusted_ca_configmap:
+            await self._mirror_ca_bundle(nb)
+
         sts = self.generate_statefulset(nb, tpu)
         created = await self._ensure(nb, sts)
         if created:
@@ -133,6 +158,8 @@ class NotebookReconciler:
             await self._ensure(nb, self.generate_headless_service(nb))
         if self.opts.use_istio:
             await self._ensure(nb, self.generate_virtual_service(nb))
+        if self.opts.create_network_policies:
+            await self._ensure(nb, self.generate_network_policy(nb, tpu))
 
         await self._restart_broken_slice(nb, tpu)
         await self._mirror_events(nb)
@@ -176,6 +203,16 @@ class NotebookReconciler:
             sc = dict(pod_spec.get("securityContext") or {})
             sc.setdefault("fsGroup", self.opts.fsgroup)
             pod_spec["securityContext"] = sc
+
+        if self.opts.trusted_ca_configmap:
+            self._mount_ca_bundle(pod_spec, containers)
+
+        annotations = get_meta(nb).get("annotations") or {}
+        if (
+            self.opts.auth_proxy_image
+            and annotations.get(AUTH_PROXY_ANNOTATION) == "true"
+        ):
+            containers.append(self._auth_proxy_container(name, ns))
 
         sts = {
             "apiVersion": "apps/v1",
@@ -264,6 +301,138 @@ class NotebookReconciler:
         template_annotations[TPU_ACCELERATOR_ANNOTATION] = tpu.accelerator.name
         template_annotations[TPU_TOPOLOGY_ANNOTATION] = tpu.topology_str
 
+    def _mount_ca_bundle(self, pod_spec: dict, containers: list[dict]) -> None:
+        """Mount the mirrored CA ConfigMap into every container (reference:
+        CheckAndMountCACertBundle, notebook_webhook.go:371-417)."""
+        volumes = list(pod_spec.get("volumes") or [])
+        if not any(v.get("name") == "trusted-ca" for v in volumes):
+            volumes.append(
+                {
+                    "name": "trusted-ca",
+                    "configMap": {
+                        "name": CA_BUNDLE_CONFIGMAP,
+                        "items": [
+                            {"key": CA_BUNDLE_KEY, "path": CA_BUNDLE_KEY}
+                        ],
+                    },
+                }
+            )
+        pod_spec["volumes"] = volumes
+        for ctr in containers:
+            mounts = list(ctr.get("volumeMounts") or [])
+            if not any(m.get("name") == "trusted-ca" for m in mounts):
+                mounts.append(
+                    {
+                        "name": "trusted-ca",
+                        "mountPath": self.opts.ca_bundle_mount_path,
+                        "subPath": CA_BUNDLE_KEY,
+                        "readOnly": True,
+                    }
+                )
+            ctr["volumeMounts"] = mounts
+
+    async def _mirror_ca_bundle(self, nb: dict) -> None:
+        """Copy the controller-namespace CA ConfigMap into the notebook's
+        namespace (reference aggregates odh-trusted-ca-bundle,
+        notebook_controller.go:253-353)."""
+        source = await self.kube.get_or_none(
+            "ConfigMap",
+            self.opts.trusted_ca_configmap,
+            self.opts.controller_namespace,
+        )
+        if source is None:
+            return
+        mirror = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": CA_BUNDLE_CONFIGMAP,
+                "namespace": namespace_of(nb),
+            },
+            "data": {
+                CA_BUNDLE_KEY: (source.get("data") or {}).get(CA_BUNDLE_KEY, "")
+                or "\n".join((source.get("data") or {}).values()),
+            },
+        }
+        await reconcile_child(self.kube, mirror, copier=_copy_configmap_data)
+
+    def _auth_proxy_container(self, name: str, ns: str) -> dict:
+        """Auth sidecar for meshless clusters (reference oauth-proxy,
+        notebook_oauth.go:49-300): proxies the serving port and enforces
+        the gateway's identity header."""
+        return {
+            "name": "auth-proxy",
+            "image": self.opts.auth_proxy_image,
+            "args": [
+                f"--upstream=http://localhost:{self.opts.default_serving_port}",
+                f"--http-address=0.0.0.0:{self.opts.auth_proxy_port}",
+                f"--prefix=/notebook/{ns}/{name}/",
+            ],
+            "ports": [
+                {"containerPort": self.opts.auth_proxy_port, "name": "auth-proxy",
+                 "protocol": "TCP"}
+            ],
+            "resources": {
+                "requests": {"cpu": "100m", "memory": "64Mi"},
+                "limits": {"cpu": "100m", "memory": "64Mi"},
+            },
+        }
+
+    def generate_network_policy(self, nb: dict, tpu: TpuSlice | None) -> dict:
+        """Per-notebook NetworkPolicy (reference ReconcileAllNetworkPolicies,
+        notebook_network.go:42-211: controller-namespace-only ingress).
+        TPU-native addition: slice workers must reach each other for the
+        jax.distributed/DCN bootstrap, so intra-slice traffic is allowed."""
+        name, ns = name_of(nb), namespace_of(nb)
+        ingress: list[dict] = [
+            {
+                "from": [
+                    {
+                        "namespaceSelector": {
+                            "matchLabels": {"kubernetes.io/metadata.name": gw}
+                        }
+                    }
+                    for gw in self.opts.gateway_namespaces
+                ],
+                "ports": [
+                    {"port": self._serving_target_port(nb), "protocol": "TCP"}
+                ],
+            }
+        ]
+        if tpu and tpu.multi_host:
+            ingress.append(
+                {
+                    "from": [
+                        {
+                            "podSelector": {
+                                "matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name}
+                            }
+                        }
+                    ]
+                }
+            )
+        return {
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "NetworkPolicy",
+            "metadata": {"name": f"notebook-{name}", "namespace": ns},
+            "spec": {
+                "podSelector": {
+                    "matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name}
+                },
+                "policyTypes": ["Ingress"],
+                "ingress": ingress,
+            },
+        }
+
+    def _serving_target_port(self, nb: dict) -> int:
+        annotations = get_meta(nb).get("annotations") or {}
+        if (
+            self.opts.auth_proxy_image
+            and annotations.get(AUTH_PROXY_ANNOTATION) == "true"
+        ):
+            return self.opts.auth_proxy_port
+        return self.opts.default_serving_port
+
     def generate_service(self, nb: dict) -> dict:
         """HTTP entrypoint. Reference: generateService (:486-513) — port 80 →
         named port ``http-<name>``. Multi-host twist: route to worker 0 only
@@ -281,7 +450,7 @@ class NotebookReconciler:
                     {
                         "name": f"http-{name}"[:63],
                         "port": nbapi.SERVICE_PORT,
-                        "targetPort": self.opts.default_serving_port,
+                        "targetPort": self._serving_target_port(nb),
                         "protocol": "TCP",
                     }
                 ],
@@ -485,6 +654,13 @@ def _worker_is_broken(pod: dict) -> bool:
             "CrashLoopBackOff", "Error",
         ):
             return True
+    return False
+
+
+def _copy_configmap_data(desired: dict, live: dict) -> bool:
+    if live.get("data") != desired.get("data"):
+        live["data"] = desired.get("data", {})
+        return True
     return False
 
 

@@ -206,6 +206,23 @@ class HttpKube:
                     obj.setdefault("apiVersion", gvk.api_version)
                     yield (evt.get("type", "MODIFIED"), obj)
 
+    async def pod_logs(
+        self, name: str, namespace: str, container: str | None = None,
+        tail_lines: int | None = None,
+    ) -> str:
+        url = self._url("Pod", namespace, name) + "/log"
+        params: dict = {}
+        if container:
+            params["container"] = container
+        if tail_lines is not None:
+            params["tailLines"] = str(tail_lines)
+        sess = await self._sess()
+        async with sess.get(url, params=params, ssl=self._ssl) as resp:
+            body = await resp.text()
+            if resp.status >= 400:
+                raise error_for_code(resp.status, body[:500])
+            return body
+
     async def get_or_none(self, kind: str, name: str, namespace: str | None = None):
         from kubeflow_tpu.runtime.errors import NotFound
 

@@ -56,6 +56,3 @@ class Tracer:
                 controller = attrs.get("controller", name)
                 self.h_duration.observe(elapsed, controller=str(controller))
                 log.debug("span %s %s took %.4fs", name, attrs, elapsed)
-
-
-global_tracer = Tracer()

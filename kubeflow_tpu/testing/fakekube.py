@@ -34,6 +34,7 @@ from kubeflow_tpu.runtime.errors import (
     NotFound,
 )
 from kubeflow_tpu.runtime.objects import (
+    deep_get,
     deepcopy,
     get_meta,
     matches_selector,
@@ -71,6 +72,7 @@ class FakeKube:
         self._watches: list[_Watch] = []
         self._mutators: list[tuple[str, Mutator]] = []      # (kind-glob, fn)
         self._validators: list[tuple[str, Validator]] = []
+        self._pod_logs: dict[tuple[str | None, str], str] = {}
         self._lock = asyncio.Lock()
 
     # ---- admission plugin registration ---------------------------------------
@@ -99,8 +101,8 @@ class FakeKube:
             name = obj_or_name
         return (namespace if gvk.namespaced else None, name)
 
-    async def _run_admission(self, obj: dict, op: str) -> None:
-        info = {"operation": op}
+    async def _run_admission(self, obj: dict, op: str, old: dict | None = None) -> None:
+        info = {"operation": op, "old": deepcopy(old) if old else None}
         for glob, fn in self._mutators:
             if fnmatch.fnmatch(obj.get("kind", ""), glob):
                 res = fn(obj, info)
@@ -212,7 +214,7 @@ class FakeKube:
                     f"{kind} {key}: resourceVersion {meta['resourceVersion']} != "
                     f"{cur_meta['resourceVersion']}"
                 )
-            await self._run_admission(obj, "UPDATE")
+            await self._run_admission(obj, "UPDATE", old=current)
             # status is a subresource: full updates never change it
             if "status" in current:
                 obj["status"] = deepcopy(current["status"])
@@ -287,7 +289,7 @@ class FakeKube:
                 merge(new.setdefault("status", {}), patch.get("status", patch))
             else:
                 merge(new, patch)
-                await self._run_admission(new, "UPDATE")
+                await self._run_admission(new, "UPDATE", old=current)
                 if "status" in current:
                     new["status"] = deepcopy(current["status"])
             if new == current:  # no-op patch: no rv bump, no event (apiserver semantics)
@@ -370,6 +372,32 @@ class FakeKube:
     def close_watches(self) -> None:
         for w in self._watches:
             w.queue.put_nowait(None)
+
+    # ---- pod logs (kubelet surface) ------------------------------------------
+
+    def set_pod_logs(self, namespace: str, name: str, text: str) -> None:
+        self._pod_logs[(namespace, name)] = text
+
+    async def pod_logs(
+        self, name: str, namespace: str, container: str | None = None,
+        tail_lines: int | None = None,
+    ) -> str:
+        """Kubelet log read. Tests seed with set_pod_logs; unseeded running
+        pods synthesize a plausible startup log."""
+        if (namespace, name) not in self._pod_logs:
+            pod = await self.get("Pod", name, namespace)  # NotFound propagates
+            phase = deep_get(pod, "status", "phase", default="Pending")
+            self._pod_logs[(namespace, name)] = (
+                f"[s6-init] making user provided files available\n"
+                f"[{name}] phase={phase}\n"
+            )
+        text = self._pod_logs[(namespace, name)]
+        if tail_lines is not None:
+            if tail_lines <= 0:
+                return ""  # kubelet semantics: tailLines=0 → nothing
+            lines = text.splitlines()[-tail_lines:]
+            text = "\n".join(lines) + ("\n" if lines else "")
+        return text
 
     # ---- test conveniences ---------------------------------------------------
 

@@ -136,6 +136,16 @@ async def get_notebook_pod(request):
     return json_success({"pod": pods[0], "pods": pods})
 
 
+@routes.get("/api/namespaces/{namespace}/notebooks/{name}/pod/{pod}/logs")
+async def get_pod_logs(request):
+    """Reference: get.py logs route — worker pod logs for the details UI."""
+    kube, authz, user, ns = _ctx(request)
+    pod = request.match_info["pod"]
+    await ensure(authz, user, "get", "Pod", ns)
+    logs = await kube.pod_logs(pod, ns, tail_lines=500)
+    return json_success({"logs": logs.splitlines()})
+
+
 @routes.get("/api/namespaces/{namespace}/notebooks/{name}/events")
 async def get_notebook_events(request):
     kube, authz, user, ns = _ctx(request)

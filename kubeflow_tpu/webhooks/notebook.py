@@ -1,0 +1,52 @@
+"""Notebook admission: defaulting + validation + restart blocking.
+
+Restart blocking is the odh webhook's ``maybeRestartRunningNotebook``
+protocol (``odh-notebook-controller/controllers/notebook_webhook.go:
+312-368``): a spec edit that would restart a RUNNING notebook's pods is not
+applied live — the pod-affecting fields are reverted to their current
+values and the CR is annotated ``update-pending`` so the UI can show
+"restart required". Edits to a *stopped* notebook apply directly (and clear
+the annotation); the user's stop→start cycle is the restart consent.
+
+On a TPU slice this matters more than it did in the reference: an
+accidental restart doesn't bounce one pod, it bounces N workers and
+re-queues the whole slice through the scheduler.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.objects import annotations_of, deep_get, deepcopy
+
+UPDATE_PENDING_ANNOTATION = "notebooks.kubeflow.org/update-pending"
+
+# Spec paths whose change forces a pod restart (the template IS the pod;
+# the tpu block changes replicas/selectors/env).
+_POD_AFFECTING = (("spec", "template"), ("spec", "tpu"))
+
+
+def _pod_affecting_changed(nb: dict, old: dict) -> bool:
+    return any(
+        deep_get(nb, *path) != deep_get(old, *path) for path in _POD_AFFECTING
+    )
+
+
+def mutate(nb: dict, info: dict) -> None:
+    """Full Notebook mutator: block live restarts, default, validate."""
+    old = info.get("old")
+    if info.get("operation") == "UPDATE" and old is not None:
+        if nbapi.is_stopped(old) or nbapi.is_stopped(nb):
+            # Stopped (or stopping) notebooks accept edits; they apply on
+            # the next start.
+            annotations_of(nb).pop(UPDATE_PENDING_ANNOTATION, None)
+        elif _pod_affecting_changed(nb, old):
+            for path in _POD_AFFECTING:
+                current = deep_get(old, *path)
+                parent = nb.setdefault(path[0], {})
+                if current is None:
+                    parent.pop(path[1], None)
+                else:
+                    parent[path[1]] = deepcopy(current)
+            annotations_of(nb)[UPDATE_PENDING_ANNOTATION] = "true"
+    nbapi.default(nb)
+    nbapi.validate(nb)

@@ -14,6 +14,7 @@ from kubeflow_tpu.api import poddefault as pdapi
 from kubeflow_tpu.api import profile as profileapi
 from kubeflow_tpu.api import pvcviewer as pvcapi
 from kubeflow_tpu.api import tensorboard as tbapi
+from kubeflow_tpu.webhooks import notebook as nb_webhook
 from kubeflow_tpu.webhooks import poddefault as pd_webhook
 from kubeflow_tpu.webhooks import tpu as tpu_webhook
 
@@ -24,8 +25,9 @@ def register_all(kube) -> None:
     ``kube.add_mutator(kind_glob, fn)`` / ``add_validator`` — fns may be sync
     or async, called with (obj, request_info).
     """
-    # CR defaulting (mutators run before validators).
-    kube.add_mutator("Notebook", lambda nb, _i: nbapi.default(nb))
+    # CR defaulting (mutators run before validators). The Notebook mutator
+    # also enforces restart blocking (webhooks/notebook.py).
+    kube.add_mutator("Notebook", nb_webhook.mutate)
     kube.add_mutator("PVCViewer", lambda v, _i: pvcapi.default(v))
 
     # CR validation.

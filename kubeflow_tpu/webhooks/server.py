@@ -19,7 +19,6 @@ import ssl
 
 from aiohttp import web
 
-from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.api import poddefault as pdapi
 from kubeflow_tpu.api import profile as profileapi
 from kubeflow_tpu.api import pvcviewer as pvcapi
@@ -27,6 +26,7 @@ from kubeflow_tpu.api import tensorboard as tbapi
 from kubeflow_tpu.runtime.errors import ApiError
 from kubeflow_tpu.runtime.objects import deepcopy
 from kubeflow_tpu.webhooks import jsonpatch
+from kubeflow_tpu.webhooks import notebook as nb_webhook
 from kubeflow_tpu.webhooks import poddefault as pd_webhook
 from kubeflow_tpu.webhooks import tpu as tpu_webhook
 
@@ -74,12 +74,13 @@ def create_webhook_app(kube) -> web.Application:
         uid = req.get("uid", "")
         obj = req.get("object") or {}
         operation = req.get("operation", "CREATE")
+        old = req.get("oldObject") or None
         # Namespace fallback (main.go:616-619).
         if not obj.get("metadata", {}).get("namespace") and req.get("namespace"):
             obj.setdefault("metadata", {})["namespace"] = req["namespace"]
         original = deepcopy(obj)
         try:
-            await mutator(request.app["kube"], obj, operation)
+            await mutator(request.app["kube"], obj, operation, old)
         except ApiError as e:
             return web.json_response(_deny(uid, e.message, e.code))
         except Exception:
@@ -88,17 +89,16 @@ def create_webhook_app(kube) -> web.Application:
         return web.json_response(_allow(uid, jsonpatch.diff(original, obj)))
 
     # -- Pod mutation: PodDefault injection + per-worker TPU env ------------
-    async def mutate_pod(kube, pod, operation):
+    async def mutate_pod(kube, pod, operation, _old):
         if operation == "CREATE":
             await pd_webhook.mutate_pod(kube, pod)
             tpu_webhook.mutate_pod(pod)
 
-    # -- CR defaulting/validation ------------------------------------------
-    async def mutate_notebook(_kube, nb, _op):
-        nbapi.default(nb)
-        nbapi.validate(nb)
+    # -- CR defaulting/validation (+ restart blocking for Notebooks) --------
+    async def mutate_notebook(_kube, nb, operation, old):
+        nb_webhook.mutate(nb, {"operation": operation, "old": old})
 
-    async def mutate_pvcviewer(_kube, viewer, _op):
+    async def mutate_pvcviewer(_kube, viewer, _op, _old):
         pvcapi.default(viewer)
         pvcapi.validate(viewer)
 
@@ -121,7 +121,7 @@ def create_webhook_app(kube) -> web.Application:
         ("/validate-tensorboards", tbapi.validate),
     ):
         async def validate_handler(request, _v=validator):
-            async def fn(_kube, obj, _op):
+            async def fn(_kube, obj, _op, _old):
                 _v(obj)
 
             return await handle(request, fn)

@@ -1,0 +1,192 @@
+"""Suites for the odh-derived features folded into the single controller:
+restart blocking, NetworkPolicies, trusted-CA mounting, auth-proxy sidecar,
+and the pod-logs surface (SURVEY.md §2.1 odh-notebook-controller rows).
+"""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import (
+    AUTH_PROXY_ANNOTATION,
+    CA_BUNDLE_CONFIGMAP,
+    NotebookOptions,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get, get_meta
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+from kubeflow_tpu.webhooks.notebook import UPDATE_PENDING_ANNOTATION
+
+USER = {"kubeflow-userid": "alice@example.com"}
+
+
+async def make_harness(**opts):
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr, NotebookOptions(**opts))
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    return kube, mgr, sim
+
+
+async def settle(mgr):
+    for _ in range(6):
+        await mgr.wait_idle()
+        await asyncio.sleep(0.02)
+
+
+async def stop(kube, mgr, sim):
+    await sim.stop()
+    await mgr.stop()
+    kube.close_watches()
+
+
+async def test_restart_blocking_on_running_notebook():
+    kube, mgr, sim = await make_harness()
+    try:
+        await kube.create("Notebook", nbapi.new("run", "ns", image="img:v1"))
+        await settle(mgr)
+
+        # Live image edit: reverted + flagged, pods untouched.
+        nb = await kube.get("Notebook", "run", "ns")
+        nb["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+        await kube.update("Notebook", nb)
+        await settle(mgr)
+        nb = await kube.get("Notebook", "run", "ns")
+        ctr = deep_get(nb, "spec", "template", "spec", "containers")[0]
+        assert ctr["image"] == "img:v1"  # pod-affecting change reverted
+        assert get_meta(nb)["annotations"][UPDATE_PENDING_ANNOTATION] == "true"
+        sts = await kube.get("StatefulSet", "run", "ns")
+        assert deep_get(
+            sts, "spec", "template", "spec", "containers"
+        )[0]["image"] == "img:v1"
+
+        # Stop, then edit: applies and clears the flag; start runs v2.
+        await kube.patch(
+            "Notebook", "run",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: "t"}}}, "ns",
+        )
+        await settle(mgr)
+        nb = await kube.get("Notebook", "run", "ns")
+        nb["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+        await kube.update("Notebook", nb)
+        nb = await kube.get("Notebook", "run", "ns")
+        assert deep_get(
+            nb, "spec", "template", "spec", "containers"
+        )[0]["image"] == "img:v2"
+        assert UPDATE_PENDING_ANNOTATION not in get_meta(nb).get("annotations", {})
+
+        await kube.patch(
+            "Notebook", "run",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: None}}}, "ns",
+        )
+        await settle(mgr)
+        pod = await kube.get("Pod", "run-0", "ns")
+        assert deep_get(pod, "spec", "containers")[0]["image"] == "img:v2"
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_annotation_only_updates_pass_through():
+    kube, mgr, sim = await make_harness()
+    try:
+        await kube.create("Notebook", nbapi.new("ann", "ns"))
+        await settle(mgr)
+        await kube.patch(
+            "Notebook", "ann", {"metadata": {"annotations": {"note": "hi"}}}, "ns"
+        )
+        nb = await kube.get("Notebook", "ann", "ns")
+        assert get_meta(nb)["annotations"]["note"] == "hi"
+        assert UPDATE_PENDING_ANNOTATION not in get_meta(nb)["annotations"]
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_network_policy_generated_with_slice_peering():
+    kube, mgr, sim = await make_harness(create_network_policies=True)
+    try:
+        await kube.create(
+            "Notebook", nbapi.new("np", "ns", accelerator="v5e", topology="4x4")
+        )
+        await settle(mgr)
+        np = await kube.get("NetworkPolicy", "notebook-np", "ns")
+        assert deep_get(np, "spec", "podSelector", "matchLabels") == {
+            "notebook-name": "np"
+        }
+        ingress = deep_get(np, "spec", "ingress")
+        # Gateway rule restricts HTTP; peer rule lets slice workers talk.
+        assert ingress[0]["ports"][0]["port"] == 8888
+        assert ingress[1]["from"][0]["podSelector"]["matchLabels"] == {
+            "notebook-name": "np"
+        }
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_ca_bundle_mirrored_and_mounted():
+    kube, mgr, sim = await make_harness(trusted_ca_configmap="corp-ca")
+    try:
+        await kube.create(
+            "ConfigMap",
+            {
+                "metadata": {"name": "corp-ca", "namespace": "kubeflow-tpu"},
+                "data": {"ca-bundle.crt": "---CERT---"},
+            },
+        )
+        await kube.create("Notebook", nbapi.new("ca", "user-ns"))
+        await settle(mgr)
+
+        mirror = await kube.get("ConfigMap", CA_BUNDLE_CONFIGMAP, "user-ns")
+        assert mirror["data"]["ca-bundle.crt"] == "---CERT---"
+        pod = await kube.get("Pod", "ca-0", "user-ns")
+        mounts = deep_get(pod, "spec", "containers")[0]["volumeMounts"]
+        ca_mount = next(m for m in mounts if m["name"] == "trusted-ca")
+        assert ca_mount["mountPath"].endswith("custom-ca-bundle.crt")
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_auth_proxy_sidecar_injected_and_service_retargeted():
+    kube, mgr, sim = await make_harness(auth_proxy_image="authproxy:1")
+    try:
+        nb = nbapi.new("guarded", "ns")
+        get_meta(nb)["annotations"] = {AUTH_PROXY_ANNOTATION: "true"}
+        await kube.create("Notebook", nb)
+        await settle(mgr)
+        pod = await kube.get("Pod", "guarded-0", "ns")
+        names = [c["name"] for c in deep_get(pod, "spec", "containers")]
+        assert names == ["guarded", "auth-proxy"]
+        svc = await kube.get("Service", "guarded", "ns")
+        assert deep_get(svc, "spec", "ports")[0]["targetPort"] == 3000
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_pod_logs_endpoint():
+    from kubeflow_tpu.web.jupyter import create_app as create_jwa
+
+    kube, mgr, sim = await make_harness()
+    client = None
+    try:
+        await kube.create("Notebook", nbapi.new("logged", "ns"))
+        await settle(mgr)
+        kube.set_pod_logs("ns", "logged-0", "line1\nline2\njupyter up\n")
+        client = TestClient(TestServer(create_jwa(kube)))
+        await client.start_server()
+        resp = await client.get(
+            "/api/namespaces/ns/notebooks/logged/pod/logged-0/logs",
+            headers=USER,
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["logs"] == ["line1", "line2", "jupyter up"]
+    finally:
+        if client:
+            await client.close()
+        await stop(kube, mgr, sim)
