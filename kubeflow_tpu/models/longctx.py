@@ -1,0 +1,128 @@
+"""Long-context burn-in: the sequence-parallel variant of the workload.
+
+Same decoder architecture as :mod:`kubeflow_tpu.models.burnin`, but the
+sequence dimension is sharded over a mesh axis and attention runs as ring
+attention (``kubeflow_tpu.parallel.ring``) — activations for a context of
+length S occupy S/P per chip, so context scales linearly with the slice.
+Everything outside attention (norms, FF, embed) is elementwise or contracts
+over d_model, so GSPMD keeps it local to the sequence shard with zero
+collectives; the only cross-chip traffic is the K/V ring and the loss psum.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.burnin import _rmsnorm
+from kubeflow_tpu.parallel.ring import ring_attention
+
+
+@dataclass(frozen=True)
+class LongContextConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    seq_len: int = 1024          # the point: long S, sharded S/P per chip
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: LongContextConfig) -> dict:
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / shape[0]) ** 0.5
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    keys = iter(jax.random.split(rng, 3 + 6 * cfg.n_layers))
+    params = {
+        "embed": dense(next(keys), (cfg.vocab, cfg.d_model), scale=0.02),
+        "pos": dense(next(keys), (cfg.seq_len, cfg.d_model), scale=0.02),
+        "out_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "layers": [
+            {
+                "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+                "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+                "qkv": dense(next(keys), (cfg.d_model, 3 * cfg.d_model)),
+                "attn_out": dense(next(keys), (cfg.d_model, cfg.d_model)),
+                "ff1": dense(next(keys), (cfg.d_model, cfg.d_ff)),
+                "ff2": dense(next(keys), (cfg.d_ff, cfg.d_model)),
+            }
+            for _ in range(cfg.n_layers)
+        ],
+    }
+    return params
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LongContextConfig,
+            mesh: Mesh, seq_axis: str = "seq") -> jax.Array:
+    """[batch, S] token ids (S sharded on ``seq_axis``) → [batch, S, vocab]."""
+    dtype = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    x = params["embed"][tokens].astype(dtype) + params["pos"][:s].astype(dtype)
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["ln1"])
+        qkv = h @ layer["qkv"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, s, cfg.n_heads, cfg.head_dim)
+
+        ctx = ring_attention(heads(q), heads(k), heads(v), mesh, seq_axis)
+        ctx = ctx.reshape(b, s, cfg.d_model)
+        x = x + ctx @ layer["attn_out"].astype(dtype)
+        h = _rmsnorm(x, layer["ln2"])
+        h = jax.nn.gelu(h @ layer["ff1"].astype(dtype))
+        x = x + h @ layer["ff2"].astype(dtype)
+    x = _rmsnorm(x, params["out_norm"])
+    return (x @ params["embed"].T.astype(dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg, mesh, seq_axis="seq"):
+    """Next-token loss with circular shift — ``roll`` keeps the target
+    array's sharding identical to the input's (a [:, 1:] slice would force
+    a reshard of the sequence axis)."""
+    logits = forward(params, tokens, cfg, mesh, seq_axis)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return nll.mean()
+
+
+def make_train_step(cfg: LongContextConfig, mesh: Mesh, lr: float = 1e-3,
+                    seq_axis: str = "seq"):
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh,
+                                                  seq_axis)
+        params = jax.tree.map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, grads
+        )
+        return params, loss
+
+    return step
+
+
+def shard_inputs(tokens, params, mesh: Mesh, seq_axis: str = "seq",
+                 data_axis: str = "data"):
+    """Place tokens [b, S] seq-sharded (+ data-sharded batch) and params
+    replicated except pos, which shards with the sequence."""
+    data = data_axis if data_axis in mesh.axis_names else None
+    tokens = jax.device_put(
+        tokens, NamedSharding(mesh, P(data, seq_axis))
+    )
+    def place(path_leaf):
+        return jax.device_put(path_leaf, NamedSharding(mesh, P()))
+
+    params = jax.tree.map(place, params)
+    params["pos"] = jax.device_put(
+        params["pos"], NamedSharding(mesh, P(seq_axis, None))
+    )
+    return tokens, params

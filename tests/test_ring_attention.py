@@ -1,0 +1,101 @@
+"""Ring attention + long-context model suites on the 8-device virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models import longctx
+from kubeflow_tpu.parallel.ring import (
+    reference_causal_attention,
+    ring_attention,
+)
+
+
+def seq_mesh(n=8, name="seq"):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def rand_qkv(rng, b, s, h, d, dtype=jnp.float32):
+    ks = jax.random.split(rng, 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in ks)
+
+
+def test_ring_matches_reference_causal_attention():
+    mesh = seq_mesh(8)
+    q, k, v = rand_qkv(jax.random.key(0), 2, 64, 2, 16)
+    spec = NamedSharding(mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+
+    out_ring = ring_attention(qs, ks, vs, mesh)
+    out_ref = reference_causal_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_with_data_and_seq_axes():
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("data", "seq"))
+    q, k, v = rand_qkv(jax.random.key(1), 4, 32, 2, 8)
+    spec = NamedSharding(mesh, P("data", "seq", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh)
+    ref = reference_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_is_causal():
+    """Changing a future token must not change earlier outputs."""
+    mesh = seq_mesh(4)
+    q, k, v = rand_qkv(jax.random.key(2), 1, 32, 1, 8)
+    spec = NamedSharding(mesh, P(None, "seq", None, None))
+
+    out1 = ring_attention(*(jax.device_put(t, spec) for t in (q, k, v)), mesh)
+    k2 = k.at[:, -1].add(100.0)
+    v2 = v.at[:, -1].add(100.0)
+    out2 = ring_attention(*(jax.device_put(t, spec) for t in (q, k2, v2)), mesh)
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), rtol=1e-5, atol=1e-5
+    )
+    assert not np.allclose(np.asarray(out1[:, -1]), np.asarray(out2[:, -1]))
+
+
+def test_longctx_train_step_runs_sharded():
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("data", "seq"))
+    cfg = longctx.LongContextConfig(
+        seq_len=64, d_model=64, n_layers=2, d_ff=128, n_heads=4
+    )
+    params = longctx.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, cfg.seq_len), 0, cfg.vocab)
+    tokens, params = longctx.shard_inputs(tokens, params, mesh)
+
+    step = jax.jit(longctx.make_train_step(cfg, mesh))
+    params2, loss1 = step(params, tokens)
+    _, loss2 = step(params2, tokens)
+    assert jnp.isfinite(loss1) and jnp.isfinite(loss2)
+    assert float(loss2) < float(loss1)  # it learns (a bit)
+    # Activations stayed sequence-sharded: pos param shards over seq.
+    assert params["pos"].sharding.spec == P("seq", None)
+
+
+def test_longctx_matches_dense_forward_numerics():
+    """Seq-parallel forward == single-device forward (same math)."""
+    mesh_s = seq_mesh(4)
+    cfg = longctx.LongContextConfig(
+        seq_len=32, d_model=32, n_layers=1, d_ff=64, n_heads=2, dtype="float32"
+    )
+    params = longctx.init_params(jax.random.key(3), cfg)
+    tokens = jax.random.randint(jax.random.key(4), (2, cfg.seq_len), 0, cfg.vocab)
+
+    sharded_tokens, sharded_params = longctx.shard_inputs(tokens, params, mesh_s)
+    out_sharded = longctx.forward(sharded_params, sharded_tokens, cfg, mesh_s)
+
+    mesh_1 = Mesh(np.array(jax.devices()[:1]), ("seq",))
+    out_dense = longctx.forward(params, tokens, cfg, mesh_1)
+    np.testing.assert_allclose(
+        np.asarray(out_sharded), np.asarray(out_dense), rtol=2e-4, atol=2e-4
+    )
