@@ -31,11 +31,16 @@ def _block_causal_mask(q_block: jax.Array, k_block: jax.Array, s_local: int):
     return k_pos <= q_pos
 
 
-def ring_attention_local(q, k, v, axis_name: str):
+def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None):
     """Per-shard causal ring attention. Call inside ``shard_map``.
 
     Args: q/k/v ``[batch, s_local, heads, head_dim]`` — this device's
-    sequence block. Returns the attention output with the same shape.
+    sequence block. ``mesh_axes`` is every manual axis of the enclosing
+    shard_map (defaults to just ``axis_name``); the online-softmax carries
+    must be marked varying over all of them, because the loop body's
+    outputs inherit the q/k/v varying set (e.g. a ``data`` batch axis),
+    and ``fori_loop`` requires carry types to be loop-invariant.
+    Returns the attention output with the same shape.
     """
     n_shards = jax.lax.psum(1, axis_name)
     my_block = jax.lax.axis_index(axis_name)
@@ -49,8 +54,11 @@ def ring_attention_local(q, k, v, axis_name: str):
     m = jnp.full((b, h, s_local), _NEG_BIG, jnp.float32)
     l = jnp.zeros((b, h, s_local), jnp.float32)
     o = jnp.zeros((b, s_local, h, d), jnp.float32)
-    if hasattr(jax.lax, "pvary"):
-        m, l, o = (jax.lax.pvary(t, (axis_name,)) for t in (m, l, o))
+    vary_axes = tuple(mesh_axes) if mesh_axes else (axis_name,)
+    if hasattr(jax.lax, "pcast"):
+        m, l, o = (jax.lax.pcast(t, vary_axes, to="varying") for t in (m, l, o))
+    elif hasattr(jax.lax, "pvary"):
+        m, l, o = (jax.lax.pvary(t, vary_axes) for t in (m, l, o))
 
     def body(t, carry):
         k_t, v_t, m, l, o = carry
@@ -95,7 +103,8 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq"):
     batch_spec = data_axes[0] if len(data_axes) == 1 else (data_axes or None)
     spec = P(batch_spec if data_axes else None, axis_name, None, None)
     return shard_map(
-        partial(ring_attention_local, axis_name=axis_name),
+        partial(ring_attention_local, axis_name=axis_name,
+                mesh_axes=tuple(mesh.axis_names)),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
