@@ -49,6 +49,10 @@ RESTART_ANNOTATION = "notebooks.kubeflow.org/restart"
 # compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
 TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"
 TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
+# Pod-template label marking slice workers; the admission registration keys
+# a failurePolicy:Fail objectSelector on it (labels, not annotations, are
+# what objectSelector can match).
+TPU_SLICE_LABEL = "tpu.kubeflow.org/slice"
 
 PREFIX_ENV_VAR = "NB_PREFIX"                           # notebook_controller.go:56
 DEFAULT_CONTAINER_PORT = 8888

@@ -30,6 +30,9 @@ def build_app(kube, which: str) -> web.Application:
         userid_prefix=os.environ.get("USERID_PREFIX", ""),
         dev_default_user=os.environ.get("DEV_DEFAULT_USER"),
         csrf_protect=os.environ.get("CSRF_PROTECT", "true").lower() != "false",
+        secure_cookies=(
+            os.environ.get("APP_SECURE_COOKIES", "true").lower() != "false"
+        ),
     )
     factories = {
         "jupyter": lambda: jupyter(

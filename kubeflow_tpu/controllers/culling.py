@@ -68,6 +68,7 @@ class CullingOptions:
     check_period_seconds: float = 60.0         # IDLENESS_CHECK_PERIOD
     cluster_domain: str = "cluster.local"
     dev_url: str | None = None                 # DEV mode: probe localhost instead
+    notebook_port: int = nbapi.DEFAULT_CONTAINER_PORT  # direct pod probes
 
 
 class CullingReconciler:
@@ -107,6 +108,34 @@ class CullingReconciler:
             f"/notebook/{ns}/{name}/api/{api}"
         )
 
+    async def _probe_urls(self, nb: dict, name: str, ns: str) -> dict | None:
+        """Resolve the probe endpoints for this notebook.
+
+        When the auth-proxy sidecar is injected, the Service targetPort is
+        the proxy (controllers/notebook.py _serving_target_port) and an
+        unauthenticated probe through it gets a non-200 — the notebook
+        would never be culled and idle TPU chips never reclaimed. Probe
+        worker-0's pod IP on the notebook port directly instead, bypassing
+        the proxied Service. Returns None if the pod IP isn't known yet
+        (probe later rather than mis-deciding)."""
+        from kubeflow_tpu.controllers.notebook import AUTH_PROXY_ANNOTATION
+
+        annotations = get_meta(nb).get("annotations") or {}
+        if self.opts.dev_url or annotations.get(AUTH_PROXY_ANNOTATION) != "true":
+            return {
+                api: self.probe_url(name, ns, api)
+                for api in ("kernels", "terminals")
+            }
+        pod = await self.kube.get_or_none("Pod", f"{name}-0", ns)
+        pod_ip = deep_get(pod or {}, "status", "podIP")
+        if not pod_ip:
+            return None
+        base = (
+            f"http://{pod_ip}:{self.opts.notebook_port}"
+            f"/notebook/{ns}/{name}/api"
+        )
+        return {api: f"{base}/{api}" for api in ("kernels", "terminals")}
+
     async def reconcile(self, key) -> Result | None:
         ns, name = key
         requeue = Result(requeue_after=self.opts.check_period_seconds)
@@ -119,7 +148,10 @@ class CullingReconciler:
             return None  # already parked; notebook reconciler owns restart
 
         now = self.clock()
-        kernels = await self.prober(self.probe_url(name, ns, "kernels"))
+        urls = await self._probe_urls(nb, name, ns)
+        if urls is None:
+            return requeue  # auth-proxied pod IP not known yet
+        kernels = await self.prober(urls["kernels"])
         if kernels is None:
             # Kernels probe unreachable/invalid (server starting, crashed, or
             # mid-restart): without it a busy kernel is indistinguishable
@@ -129,7 +161,7 @@ class CullingReconciler:
         # Terminals are tolerated missing (servers run with terminals
         # disabled → 404 forever; hard-requiring it would block culling
         # permanently). Kernels above are the authoritative busy signal.
-        terminals = await self.prober(self.probe_url(name, ns, "terminals"))
+        terminals = await self.prober(urls["terminals"])
 
         annotations = dict(get_meta(nb).get("annotations") or {})
         last_activity = _parse_time(

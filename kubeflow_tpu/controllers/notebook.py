@@ -194,8 +194,15 @@ class NotebookReconciler:
         self._set_prefix_env(main, ns, name)
 
         template_annotations: dict[str, str] = {}
+        template_labels: dict[str, str] = {
+            STS_LABEL: name,
+            nbapi.NOTEBOOK_NAME_LABEL: name,
+            "app": name,
+        }
         if tpu:
-            self._apply_tpu(main, pod_spec, template_annotations, nb, tpu)
+            self._apply_tpu(
+                main, pod_spec, template_annotations, template_labels, nb, tpu
+            )
         containers[0] = main
         pod_spec["containers"] = containers
 
@@ -227,11 +234,7 @@ class NotebookReconciler:
                 "podManagementPolicy": "Parallel",
                 "template": {
                     "metadata": {
-                        "labels": {
-                            STS_LABEL: name,
-                            nbapi.NOTEBOOK_NAME_LABEL: name,
-                            "app": name,
-                        },
+                        "labels": template_labels,
                         "annotations": template_annotations,
                     },
                     "spec": pod_spec,
@@ -257,6 +260,7 @@ class NotebookReconciler:
         main: dict,
         pod_spec: dict,
         template_annotations: dict,
+        template_labels: dict,
         nb: dict,
         tpu: TpuSlice,
     ) -> None:
@@ -288,6 +292,20 @@ class NotebookReconciler:
         for k, v in static_env.items():
             if k not in have:
                 env.append({"name": k, "value": v})
+        # Downward-API fallback for the per-worker keys: the STS controller
+        # (≥1.28) stamps the ordinal on the pod-index label, so even if the
+        # admission webhook is unavailable the workers still get correct
+        # ids and the slice can bootstrap its mesh (the webhook, when up,
+        # overrides these with plain values).
+        for per_worker in ("TPU_WORKER_ID", "JAX_PROCESS_ID"):
+            if per_worker not in have:
+                env.append({
+                    "name": per_worker,
+                    "valueFrom": {"fieldRef": {
+                        "fieldPath":
+                            "metadata.labels['apps.kubernetes.io/pod-index']"
+                    }},
+                })
         main["env"] = env
 
         ports = list(main.get("ports", []))
@@ -300,6 +318,11 @@ class NotebookReconciler:
 
         template_annotations[TPU_ACCELERATOR_ANNOTATION] = tpu.accelerator.name
         template_annotations[TPU_TOPOLOGY_ANNOTATION] = tpu.topology_str
+        # Label (not annotation) so the per-worker env webhook registration
+        # can scope a failurePolicy:Fail entry with an objectSelector —
+        # admission must hard-fail for slice pods, stay best-effort for the
+        # convenience PodDefault path (manifests/base/webhook.yaml).
+        template_labels[nbapi.TPU_SLICE_LABEL] = "true"
 
     def _mount_ca_bundle(self, pod_spec: dict, containers: list[dict]) -> None:
         """Mount the mirrored CA ConfigMap into every container (reference:
@@ -535,7 +558,8 @@ class NotebookReconciler:
         if not (tpu and tpu.multi_host) or nbapi.is_stopped(nb):
             return
         pods = await self._worker_pods(nb)
-        broken = [p for p in pods if _worker_is_broken(p)]
+        main_name = _main_container_name(nb)
+        broken = [p for p in pods if _worker_is_broken(p, main_name)]
         if not broken:
             return
         names = ", ".join(sorted(name_of(p) for p in broken))
@@ -589,10 +613,7 @@ class NotebookReconciler:
         container_state: dict = {}
         pod0 = await self.kube.get_or_none("Pod", f"{name}-0", ns)
         if pod0:
-            containers = deep_get(
-                nb, "spec", "template", "spec", "containers", default=[]
-            )
-            main_name = (containers[0].get("name") if containers else None) or name
+            main_name = _main_container_name(nb)
             statuses = deep_get(pod0, "status", "containerStatuses", default=[])
             for cs in statuses:
                 if cs.get("name") == main_name:
@@ -632,18 +653,32 @@ class NotebookReconciler:
         )
 
 
-def _worker_is_broken(pod: dict) -> bool:
-    """A worker whose container died — even once, even if kubelet already
+def _main_container_name(nb: dict) -> str:
+    """Name of the TPU worker (server) container — containers[0] of the CR's
+    PodSpec by the reference contract, falling back to the CR name."""
+    containers = deep_get(nb, "spec", "template", "spec", "containers", default=[])
+    return (containers[0].get("name") if containers else None) or name_of(nb)
+
+
+def _worker_is_broken(pod: dict, main_container: str) -> bool:
+    """A worker whose TPU container died — even once, even if kubelet already
     restarted it in place — has broken the slice's ICI mesh: the restarted
     process cannot rejoin (libtpu wires the mesh once at init), so the
     healthy-looking peers are wedged. With restartPolicy Always the pod
     rarely shows phase=Failed or a current terminated state; the durable
     signals are restartCount > 0, a lastState.terminated, or
     CrashLoopBackOff. Slice-atomic deletion resets restartCount to 0 on the
-    replacement pods, so this self-clears."""
+    replacement pods, so this self-clears.
+
+    Scoped to the *main* (TPU worker) container only: a sidecar restart
+    (auth-proxy OOM, say) does not break the ICI mesh, and counting it
+    would wedge the slice in a permanent restart loop — the main
+    container's statuses never clear the sidecar's restartCount."""
     if deep_get(pod, "status", "phase") == "Failed":
         return True
     for cs in deep_get(pod, "status", "containerStatuses", default=[]):
+        if cs.get("name") != main_container:
+            continue
         if cs.get("restartCount", 0) > 0:
             return True
         if deep_get(cs, "state", "terminated", "exitCode") not in (None, 0):

@@ -46,12 +46,18 @@ class Unauthorized(ApiError):
     reason = "Unauthorized"
 
 
-def error_for_code(code: int, message: str = "") -> ApiError:
+def error_for_code(code: int, message: str = "", reason: str | None = None) -> ApiError:
     if code == 409:
         # Both AlreadyExists and Conflict are 409s; the apiserver's Status
-        # body carries the distinguishing reason. Default to Conflict — the
-        # stale-resourceVersion case — since create paths that care catch
-        # AlreadyExists by its reason text.
+        # body carries the distinguishing ``reason`` field — prefer it when
+        # the caller parsed one (free-text matching misclassifies a Conflict
+        # whose message happens to contain "already exists", or a non-English
+        # AlreadyExists body). Default to Conflict — the stale-resourceVersion
+        # case — as the safer retry behavior.
+        if reason == "AlreadyExists":
+            return AlreadyExists(message)
+        if reason == "Conflict":
+            return Conflict(message)
         if "AlreadyExists" in message or "already exists" in message:
             return AlreadyExists(message)
         return Conflict(message)

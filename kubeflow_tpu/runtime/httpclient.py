@@ -74,7 +74,17 @@ class HttpKube:
         async with sess.request(method, url, ssl=self._ssl, **kw) as resp:
             body = await resp.text()
             if resp.status >= 400:
-                raise error_for_code(resp.status, f"{method} {url}: {body[:500]}")
+                # The apiserver returns a Status object; its ``reason`` is
+                # the authoritative error discriminator (409 AlreadyExists
+                # vs Conflict), not the free-text message.
+                reason = None
+                try:
+                    reason = json.loads(body).get("reason")
+                except (ValueError, AttributeError):
+                    pass
+                raise error_for_code(
+                    resp.status, f"{method} {url}: {body[:500]}", reason=reason
+                )
             return json.loads(body) if body else {}
 
     # ---- KubeApi surface -----------------------------------------------------

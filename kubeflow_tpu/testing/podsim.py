@@ -25,6 +25,13 @@ from kubeflow_tpu.runtime.objects import (
 from kubeflow_tpu.testing.fakekube import FakeKube
 
 
+def _fake_pod_ip(name: str) -> str:
+    """Deterministic cluster-range IP per pod name (kubelet assigns these;
+    controllers that probe pods directly need one to exist)."""
+    h = sum(name.encode()) % 254 + 1
+    return f"10.244.0.{h}"
+
+
 class PodSimulator:
     def __init__(
         self,
@@ -33,11 +40,13 @@ class PodSimulator:
         start_latency: float = 0.0,
         failure_injector=None,
     ):
-        """``failure_injector(pod) -> None | "fail" | "crash"`` — fault
-        injection the reference never had (SURVEY.md §5 "No fault injection
-        framework"): "fail" leaves the pod phase=Failed (scheduling/image
-        errors); "crash" marks one in-place container restart (the signal
-        the slice-atomic restart logic keys on)."""
+        """``failure_injector(pod) -> None | "fail" | "crash" | "crash:<ctr>"``
+        — fault injection the reference never had (SURVEY.md §5 "No fault
+        injection framework"): "fail" leaves the pod phase=Failed
+        (scheduling/image errors); "crash" marks one in-place restart of
+        every container (the signal the slice-atomic restart logic keys
+        on); "crash:<name>" restarts only the named container (e.g. a
+        sidecar), leaving the rest healthy."""
         self.kube = kube
         self.start_latency = start_latency
         self.failure_injector = failure_injector
@@ -176,25 +185,37 @@ class PodSimulator:
             except NotFound:
                 pass
             return
-        if fault == "crash":
+        if fault == "crash" or (isinstance(fault, str) and fault.startswith("crash:")):
+            only = fault.split(":", 1)[1] if ":" in fault else None
+
+            def ctr_status(c):
+                cname = c.get("name", "main")
+                crashed = only is None or cname == only
+                st = {
+                    "name": cname,
+                    "ready": not crashed,
+                    "restartCount": 1 if crashed else 0,
+                    "state": {"running": {"startedAt": "now"}},
+                }
+                if crashed:
+                    st["lastState"] = {
+                        "terminated": {"exitCode": 137, "reason": "OOMKilled"}
+                    }
+                return st
+
+            # A single crashed sidecar leaves the pod Running and (after
+            # kubelet restarts it in place) Ready; a whole-pod crash flips
+            # the Ready condition.
+            pod_ready = "True" if only is not None else "False"
             try:
                 await self.kube.patch(
                     "Pod", name,
                     {
                         "status": {
                             "phase": "Running",
-                            "conditions": [{"type": "Ready", "status": "False"}],
+                            "conditions": [{"type": "Ready", "status": pod_ready}],
                             "containerStatuses": [
-                                {
-                                    "name": c.get("name", "main"),
-                                    "ready": False,
-                                    "restartCount": 1,
-                                    "state": {"running": {"startedAt": "now"}},
-                                    "lastState": {
-                                        "terminated": {"exitCode": 137,
-                                                       "reason": "OOMKilled"}
-                                    },
-                                }
+                                ctr_status(c)
                                 for c in deep_get(
                                     pod, "spec", "containers", default=[]
                                 )
@@ -213,6 +234,7 @@ class PodSimulator:
                 {
                     "status": {
                         "phase": "Running",
+                        "podIP": _fake_pod_ip(name),
                         "conditions": [{"type": "Ready", "status": "True"}],
                         "containerStatuses": [
                             {

@@ -57,8 +57,19 @@ def create_base_app(
     userid_prefix: str = "",
     dev_default_user: str | None = None,
     csrf_protect: bool = True,
+    secure_cookies: bool | None = None,
     registry: Registry | None = None,
 ) -> web.Application:
+    # Secure cookies default on like the reference (APP_SECURE_COOKIES,
+    # crud_backend/config.py): HTTPS deployments must not send the CSRF
+    # double-submit cookie cleartext. Dev/test over plain http sets the
+    # env var (or the kwarg) to false.
+    if secure_cookies is None:
+        import os
+
+        secure_cookies = (
+            os.environ.get("APP_SECURE_COOKIES", "true").lower() != "false"
+        )
     registry = registry or global_registry
     m_requests = registry.counter(
         "web_app_requests_total", "Backend HTTP requests", ["method", "status"]
@@ -104,9 +115,12 @@ def create_base_app(
                 return json_error("CSRF token missing or invalid", 403)
         resp = await handler(request)
         if request.method in SAFE_METHODS and not cookie:
+            # Secure by default like the reference (APP_SECURE_COOKIES,
+            # csrf.py) — double-submit cookies must not travel cleartext
+            # on HTTPS deployments. Dev mode (plain http) turns it off.
             resp.set_cookie(
                 CSRF_COOKIE, secrets.token_urlsafe(32),
-                samesite="Strict", secure=False, httponly=False,
+                samesite="Strict", secure=secure_cookies, httponly=False,
             )
         return resp
 

@@ -38,6 +38,26 @@ def _age_seconds(notebook: dict) -> float:
     return max(0.0, time.time() - ts)
 
 
+def filter_events(notebook: dict, events: list[dict]) -> list[dict]:
+    """Drop events that predate the CR — a recreated server with the same
+    name must not surface the previous incarnation's errors (reference
+    ``crud-web-apps/jupyter/backend/apps/common/status.py``
+    get_notebook_events creationTimestamp filter)."""
+    created = get_meta(notebook).get("creationTimestamp")
+    created_ts = parse_iso(created) if created else None
+    if created_ts is None:
+        return list(events)
+    out = []
+    for ev in events:
+        stamp = ev.get("lastTimestamp") or ev.get("eventTime") or deep_get(
+            ev, "metadata", "creationTimestamp"
+        )
+        ts = parse_iso(stamp) if stamp else None
+        if ts is None or ts >= created_ts:
+            out.append(ev)
+    return out
+
+
 def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     meta = get_meta(notebook)
     annotations = meta.get("annotations") or {}
@@ -82,7 +102,9 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
         return Status(WAITING, f"Waiting for TPU workers ({ready}/{want_hosts} ready)")
 
     for ev in sorted(
-        events or [], key=lambda e: e.get("lastTimestamp", ""), reverse=True
+        filter_events(notebook, events or []),
+        key=lambda e: e.get("lastTimestamp", ""),
+        reverse=True,
     ):
         if ev.get("type") == "Warning":
             return Status(WARNING, ev.get("message", ""))

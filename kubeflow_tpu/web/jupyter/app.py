@@ -20,7 +20,7 @@ from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of, now_iso
 from kubeflow_tpu.web.common.app import create_base_app, json_success
 from kubeflow_tpu.web.common.serving import add_spa
 from kubeflow_tpu.web.common.auth import ensure
-from kubeflow_tpu.web.common.status import process_status
+from kubeflow_tpu.web.common.status import filter_events, process_status
 from kubeflow_tpu.web.jupyter.form import notebook_from_form
 from kubeflow_tpu.web.jupyter.spawner_config import load_config, tpu_options
 
@@ -151,7 +151,13 @@ async def get_notebook_events(request):
     kube, authz, user, ns = _ctx(request)
     name = request.match_info["name"]
     await ensure(authz, user, "list", "Event", ns)
-    return json_success({"events": await _notebook_events(kube, ns, name)})
+    events = await _notebook_events(kube, ns, name)
+    # Recreated server with the same name: hide the prior incarnation's
+    # events (reference get_notebook_events creationTimestamp filter).
+    nb = await kube.get_or_none("Notebook", name, ns)
+    if nb is not None:
+        events = filter_events(nb, events)
+    return json_success({"events": events})
 
 
 @routes.get("/api/namespaces/{namespace}/pvcs")

@@ -65,7 +65,11 @@ def mutate_pod(pod: dict) -> None:
             if k not in have:
                 env.append({"name": k, "value": v})
             else:
-                for e in env:
-                    if e.get("name") == k:
-                        e["value"] = v
+                # Replace the whole entry: the controller bakes a downward
+                # API valueFrom fallback into the template, and an entry
+                # with both value and valueFrom is invalid.
+                env = [
+                    {"name": k, "value": v} if e.get("name") == k else e
+                    for e in env
+                ]
         ctr["env"] = env

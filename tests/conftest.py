@@ -19,6 +19,10 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Web-app tests run over plain http (aiohttp TestClient), where a Secure
+# CSRF cookie would never be echoed back. Production default is true.
+os.environ.setdefault("APP_SECURE_COOKIES", "false")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -67,6 +67,37 @@ async def test_fresh_idle_notebook_gets_activity_annotations():
     assert "http://nb.ns.svc.cluster.local/notebook/ns/nb/api/kernels" in prober.calls
 
 
+async def test_auth_proxied_notebook_probed_via_pod_ip():
+    """With the auth-proxy sidecar injected the Service targetPort is the
+    proxy, so the unauthenticated culler probe must bypass it and hit
+    worker-0's pod IP on the notebook port — otherwise auth-proxied
+    notebooks are never culled and idle chips never reclaimed."""
+    from kubeflow_tpu.controllers.notebook import AUTH_PROXY_ANNOTATION
+
+    kube = FakeKube()
+    clock = FakeClock()
+    prober = make_prober({"kernels": idle_kernels(clock.t - 50), "terminals": []})
+    rec = CullingReconciler(kube, prober, CullingOptions(), clock=clock)
+    nb = nbapi.new("nb", "ns")
+    nb["metadata"].setdefault("annotations", {})[AUTH_PROXY_ANNOTATION] = "true"
+    await kube.create("Notebook", nb)
+
+    # Pod IP not known yet: no probe, no decision — just requeue.
+    result = await rec.reconcile(("ns", "nb"))
+    assert result and result.requeue_after == 60.0
+    assert prober.calls == []
+
+    await kube.create("Pod", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "nb-0", "namespace": "ns"},
+        "spec": {}, "status": {"podIP": "10.244.0.7"},
+    })
+    await rec.reconcile(("ns", "nb"))
+    assert prober.calls[0] == "http://10.244.0.7:8888/notebook/ns/nb/api/kernels"
+    anns = get_meta(await kube.get("Notebook", "nb", "ns"))["annotations"]
+    assert anns[nbapi.LAST_ACTIVITY_ANNOTATION] == _fmt_time(clock.t - 50)
+
+
 async def test_busy_kernel_resets_idle_clock():
     kube = FakeKube()
     clock = FakeClock()

@@ -13,11 +13,11 @@ from kubeflow_tpu.web.common.status import process_status
 from kubeflow_tpu.webhooks import register_all
 
 
-async def run_with_injector(injector, notebook, settle_rounds=8):
+async def run_with_injector(injector, notebook, settle_rounds=8, options=None):
     kube = FakeKube()
     register_all(kube)
     mgr = Manager(kube)
-    setup_notebook_controller(mgr)
+    setup_notebook_controller(mgr, options)
     sim = PodSimulator(kube, failure_injector=injector)
     await mgr.start()
     await sim.start()
@@ -43,6 +43,41 @@ async def test_failed_pod_surfaces_in_status():
     assert deep_get(nb, "status", "readyReplicas") == 0
     status = process_status(nb)
     assert status.phase in ("waiting", "warning")
+
+
+async def test_sidecar_crash_does_not_restart_slice():
+    """A restarted auth-proxy sidecar does not break the ICI mesh, so the
+    slice-atomic restart must NOT trigger — a sidecar OOM would otherwise
+    wedge the slice in a permanent restart loop (the worker container's
+    statuses never clear the sidecar's restartCount)."""
+    from kubeflow_tpu.controllers.notebook import (
+        AUTH_PROXY_ANNOTATION,
+        NotebookOptions,
+    )
+
+    def injector(pod):
+        if name_of(pod) == "proxied-1":
+            return "crash:auth-proxy"
+        return None
+
+    nb = nbapi.new("proxied", "ns", accelerator="v5e", topology="4x4")
+    nb["metadata"].setdefault("annotations", {})[AUTH_PROXY_ANNOTATION] = "true"
+    kube, nb = await run_with_injector(
+        injector, nb, settle_rounds=12,
+        options=NotebookOptions(auth_proxy_image="authproxy:1"),
+    )
+
+    events = await kube.list("Event", "ns")
+    assert not any(e.get("reason") == "SliceRestart" for e in events)
+    # The sidecar's restartCount persists (kubelet restarted it in place) —
+    # proof the controller saw the signal and correctly ignored it.
+    pod = await kube.get("Pod", "proxied-1", "ns")
+    counts = {
+        cs["name"]: cs.get("restartCount", 0)
+        for cs in deep_get(pod, "status", "containerStatuses", default=[])
+    }
+    assert counts.get("auth-proxy") == 1
+    assert deep_get(nb, "status", "readyReplicas") == 2
 
 
 async def test_crash_of_one_worker_restarts_whole_slice():

@@ -147,6 +147,22 @@ async def test_tpu_multi_host_slice_spawns_workers_with_distinct_ids():
         assert envs[0]["TPU_WORKER_ID"] == "0"
         assert envs[1]["TPU_WORKER_ID"] == "1"
         assert envs[1]["JAX_PROCESS_ID"] == "1"
+        # Webhook replaced the template's downward-API fallback with a plain
+        # value — an env entry carrying both value and valueFrom is invalid.
+        for pod_i in range(2):
+            pod = await h.kube.get("Pod", f"big-{pod_i}", "ns")
+            for e in deep_get(pod, "spec", "containers")[0]["env"]:
+                if e["name"] in ("TPU_WORKER_ID", "JAX_PROCESS_ID"):
+                    assert "valueFrom" not in e, e
+        # The STS template itself carries the fallback (webhook-down safety)
+        # and the slice label the Fail-policy registration selects on.
+        tmpl = deep_get(sts, "spec", "template")
+        tmpl_env = {
+            e["name"]: e for e in deep_get(tmpl, "spec", "containers")[0]["env"]
+        }
+        assert "valueFrom" in tmpl_env["TPU_WORKER_ID"]
+        assert deep_get(tmpl, "metadata", "labels")[
+            "tpu.kubeflow.org/slice"] == "true"
         hosts = envs[0]["TPU_WORKER_HOSTNAMES"].split(",")
         assert hosts == [
             "big-0.big-workers.ns.svc.cluster.local",

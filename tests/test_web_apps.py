@@ -304,6 +304,16 @@ def test_status_state_machine_pure():
               "lastTimestamp": "2026-01-01T00:00:00Z"}]
     )
     assert s.phase == "warning" and "nodes available" in s.message
+    # Events that predate the CR are invisible (recreated server must not
+    # show the previous incarnation's errors).
+    stale = [{"type": "Warning", "message": "old incarnation crashed",
+              "lastTimestamp": "2019-12-31T23:59:00Z"}]
+    s = process_status(nb, stale)
+    assert "old incarnation" not in s.message
+    from kubeflow_tpu.web.common.status import filter_events
+    assert filter_events(nb, stale) == []
+    fresh = stale[0] | {"lastTimestamp": "2020-01-02T00:00:00Z"}
+    assert filter_events(nb, [fresh]) == [fresh]
 
 
 async def test_spa_served_with_csrf_cookie():
