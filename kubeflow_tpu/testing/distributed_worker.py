@@ -1,0 +1,69 @@
+"""One worker process of the multi-process jax.distributed harness.
+
+SURVEY.md §4's "fake TPU runtime": the reference never needed to fake
+multi-node at the network level, but the TPU build must prove that the env
+the notebook controller injects into each worker
+(``TpuSlice.worker_env`` — JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID over the headless-Service hostnames) actually bootstraps
+``jax.distributed`` and carries a cross-process collective. Run as
+``python -m kubeflow_tpu.testing.distributed_worker`` with that env set;
+prints one ``PSUM_RESULT <value> NPROC <n>`` line on success.
+
+The e2e analogue in the reference probes a live spawned notebook over HTTP
+(odh-notebook-controller/e2e/helper_test.go:23-100); here the "probe" is
+the collective itself.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+
+def main() -> None:
+    import jax
+
+    # CPU backend regardless of what the host image registers (same trick
+    # as tests/conftest.py) — each process contributes its one CPU device.
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    # The exact bootstrap incantation documented for in-notebook use: every
+    # argument comes from the env the controller injected.
+    jax.distributed.initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]),
+    )
+
+    n_devices = jax.device_count()
+    pid = jax.process_index()
+    mesh = Mesh(np.asarray(jax.devices()), ("x",))
+    sharding = NamedSharding(mesh, P("x"))
+    # Each process contributes (process_id + 1) so the psum result encodes
+    # that every process really participated: with P processes of one
+    # device each the reduction is 1 + 2 + ... + P.
+    x = jax.make_array_from_callback(
+        (n_devices,), sharding, lambda _idx: np.array([float(pid + 1)])
+    )
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+    def allreduce(v):
+        return jax.lax.psum(v, "x")
+
+    out = allreduce(x)
+    local = np.asarray(out.addressable_shards[0].data)
+    print(f"PSUM_RESULT {float(local[0])} NPROC {jax.process_count()}", flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
