@@ -42,13 +42,26 @@ def create_app(
     *,
     links: list[dict] | None = None,
     registration_flow: bool = True,
+    metrics_service=None,
     **kwargs,
 ) -> web.Application:
+    import os
+
+    from kubeflow_tpu.web.dashboard.metrics import metrics_service_from_env
+
     app = create_base_app(kube, **kwargs)
     app["links"] = links or DEFAULT_LINKS
     app["registration_flow"] = registration_flow
+    app["metrics_service"] = metrics_service or metrics_service_from_env(
+        dict(os.environ)
+    )
     app.add_routes(routes)
     add_spa(app, __file__)
+
+    async def _close_metrics(app):
+        await app["metrics_service"].close()
+
+    app.on_cleanup.append(_close_metrics)
     return app
 
 
@@ -125,6 +138,30 @@ async def create_workgroup(request):
 @routes.get("/api/dashboard-links")
 async def dashboard_links(request):
     return json_success({"menuLinks": request.app["links"]})
+
+
+@routes.get("/api/metrics")
+async def cluster_metrics(request):
+    """Time-series metrics via the configured driver (reference
+    ``server.ts`` /api/metrics + resource-chart.js consumption): query
+    params ``type`` (node_cpu|pod_cpu|pod_mem|tpu_duty) and ``interval``
+    (Last5m..Last180m)."""
+    from kubeflow_tpu.web.dashboard.metrics import INTERVALS_MIN, QUERIES
+
+    svc = request.app["metrics_service"]
+    series = request.query.get("type", "node_cpu")
+    interval = request.query.get("interval", "Last15m")
+    if series not in QUERIES or interval not in INTERVALS_MIN:
+        raise Invalid(f"unknown metrics type/interval {series!r}/{interval!r}")
+    points = await svc.query(series, interval)
+    return json_success(
+        {
+            "type": series,
+            "interval": interval,
+            "points": [p.to_dict() for p in points],
+            **svc.charts_link(),
+        }
+    )
 
 
 @routes.get("/api/namespaces/{namespace}/tpu-usage")

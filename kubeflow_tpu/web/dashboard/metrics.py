@@ -1,0 +1,170 @@
+"""Pluggable time-series metrics drivers for the dashboard.
+
+Reference: ``components/centraldashboard/app/metrics_service.ts:1-53``
+(driver interface + Interval/TimeSeriesPoint contract),
+``prometheus_metrics_service.ts:1-90`` (PromQL range queries),
+``metrics_service_factory.ts`` (env-driven driver selection). The
+Stackdriver driver of the reference is GCP-console-specific; its slot here
+is the charts-link passthrough.
+
+TPU-first addition: a ``tpu_duty_cycle`` series (the GKE TPU device plugin
+exports per-chip duty cycle; `avg by (node)` of it is the fleet-health
+panel the reference's CPU charts play for GPUs — idle chips show up
+immediately).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Protocol
+
+INTERVALS_MIN = {
+    "Last5m": 5,
+    "Last15m": 15,
+    "Last30m": 30,
+    "Last60m": 60,
+    "Last180m": 180,
+}
+
+# PromQL per series type — node/pod CPU + pod memory mirror the reference's
+# queries; tpu_duty is ours.
+QUERIES = {
+    "node_cpu": "sum(rate(node_cpu_seconds_total[5m])) by (instance)",
+    "pod_cpu": "sum(rate(container_cpu_usage_seconds_total[5m]))",
+    "pod_mem": "sum(container_memory_usage_bytes)",
+    "tpu_duty": "avg(tpu_duty_cycle_percent) by (node)",
+}
+
+
+@dataclass(frozen=True)
+class TimeSeriesPoint:
+    timestamp: float   # seconds since epoch
+    label: str
+    value: float
+
+    def to_dict(self) -> dict:
+        return {"timestamp": self.timestamp, "label": self.label,
+                "value": self.value}
+
+
+class MetricsService(Protocol):
+    async def query(self, series: str, interval: str) -> list[TimeSeriesPoint]:
+        """Return the named series over the interval."""
+        ...
+
+    def charts_link(self) -> dict:
+        """{resourceChartsLink, resourceChartsLinkText} for the UI button."""
+        ...
+
+    async def close(self) -> None: ...
+
+
+class PrometheusMetricsService:
+    """Range queries against a Prometheus-compatible HTTP API.
+
+    ``fetch_json`` is injectable for tests; the default drives aiohttp at
+    ``<url>/api/v1/query_range``.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        *,
+        dashboard_url: str | None = None,
+        step_seconds: int = 10,
+        queries: dict[str, str] | None = None,
+        fetch_json=None,
+        clock=time.time,
+    ):
+        self.url = url.rstrip("/")
+        self.dashboard_url = dashboard_url
+        self.step_seconds = step_seconds
+        self.queries = queries or QUERIES
+        self._fetch_json = fetch_json or self._http_fetch
+        self._clock = clock
+        self._session = None
+
+    async def _http_fetch(self, params: dict) -> dict:
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=15)
+            )
+        async with self._session.get(
+            f"{self.url}/api/v1/query_range", params=params
+        ) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def query(self, series: str, interval: str) -> list[TimeSeriesPoint]:
+        if series not in self.queries:
+            raise KeyError(f"unknown series {series!r}")
+        minutes = INTERVALS_MIN.get(interval)
+        if minutes is None:
+            raise KeyError(f"unknown interval {interval!r}")
+        end = self._clock()
+        payload = await self._fetch_json(
+            {
+                "query": self.queries[series],
+                "start": f"{end - minutes * 60:.3f}",
+                "end": f"{end:.3f}",
+                "step": str(self.step_seconds),
+            }
+        )
+        return self._parse_matrix(payload)
+
+    @staticmethod
+    def _parse_matrix(payload: dict) -> list[TimeSeriesPoint]:
+        """Prometheus ``matrix`` result → flat point list (the reference's
+        convertToTimeSeriesPoints, label = joined metric labels)."""
+        data = (payload or {}).get("data") or {}
+        if data.get("resultType") != "matrix":
+            return []
+        points: list[TimeSeriesPoint] = []
+        for series in data.get("result", []):
+            labels = series.get("metric") or {}
+            label = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            for ts, value in series.get("values", []):
+                try:
+                    points.append(TimeSeriesPoint(float(ts), label, float(value)))
+                except (TypeError, ValueError):
+                    continue
+        return points
+
+    def charts_link(self) -> dict:
+        return {
+            "resourceChartsLink": self.dashboard_url,
+            "resourceChartsLinkText": "View in dashboard",
+        }
+
+
+class NullMetricsService:
+    """No metrics backend configured — the factory default, like the
+    reference dashboard without PROMETHEUS_URL."""
+
+    async def query(self, series: str, interval: str) -> list[TimeSeriesPoint]:
+        return []
+
+    def charts_link(self) -> dict:
+        return {"resourceChartsLink": None, "resourceChartsLinkText": ""}
+
+    async def close(self) -> None:
+        return None
+
+
+def metrics_service_from_env(env: dict) -> MetricsService:
+    """Driver selection (reference metrics_service_factory.ts): the
+    PROMETHEUS_URL env turns the Prometheus driver on."""
+    url = env.get("PROMETHEUS_URL")
+    if url:
+        return PrometheusMetricsService(
+            url, dashboard_url=env.get("METRICS_DASHBOARD")
+        )
+    return NullMetricsService()

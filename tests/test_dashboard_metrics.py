@@ -1,0 +1,115 @@
+"""Dashboard time-series metrics drivers (reference:
+prometheus_metrics_service.ts + metrics_service_factory.ts), backed by a
+fixture Prometheus API server."""
+
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.web.dashboard import create_app as create_dashboard
+from kubeflow_tpu.web.dashboard.metrics import (
+    NullMetricsService,
+    PrometheusMetricsService,
+    metrics_service_from_env,
+)
+
+ALICE = {"kubeflow-userid": "alice@example.com"}
+
+# Canned /api/v1/query_range answer: two nodes, two samples each.
+MATRIX_FIXTURE = {
+    "status": "success",
+    "data": {
+        "resultType": "matrix",
+        "result": [
+            {
+                "metric": {"node": "tpu-node-a"},
+                "values": [[1700000000, "0.75"], [1700000010, "0.80"]],
+            },
+            {
+                "metric": {"node": "tpu-node-b"},
+                "values": [[1700000000, "0.10"], [1700000010, "bogus"]],
+            },
+        ],
+    },
+}
+
+
+async def make_prometheus_fixture(clients, seen):
+    async def query_range(request):
+        seen.append(dict(request.query))
+        return web.json_response(MATRIX_FIXTURE)
+
+    app = web.Application()
+    app.router.add_get("/api/v1/query_range", query_range)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    clients.append(client)
+    return client
+
+
+async def test_prometheus_driver_parses_range_matrix():
+    clients, seen = [], []
+    try:
+        prom = await make_prometheus_fixture(clients, seen)
+        svc = PrometheusMetricsService(
+            str(prom.make_url("")), clock=lambda: 1700000100.0
+        )
+        points = await svc.query("tpu_duty", "Last15m")
+        await svc.close()
+
+        # The bogus sample is dropped; labels join k=v.
+        assert [
+            (p.label, p.value) for p in points
+        ] == [
+            ("node=tpu-node-a", 0.75),
+            ("node=tpu-node-a", 0.80),
+            ("node=tpu-node-b", 0.10),
+        ]
+        assert points[0].timestamp == 1700000000
+        # The range matches the interval and the PromQL is ours.
+        q = seen[0]
+        assert q["query"] == "avg(tpu_duty_cycle_percent) by (node)"
+        assert float(q["end"]) - float(q["start"]) == 15 * 60
+    finally:
+        for c in clients:
+            await c.close()
+
+
+async def test_dashboard_metrics_route_and_factory():
+    clients, seen = [], []
+    kube = FakeKube()
+    try:
+        prom = await make_prometheus_fixture(clients, seen)
+        svc = PrometheusMetricsService(
+            str(prom.make_url("")),
+            dashboard_url="https://grafana.example/tpu",
+            clock=lambda: 1700000100.0,
+        )
+        dash = TestClient(TestServer(create_dashboard(kube, metrics_service=svc)))
+        await dash.start_server()
+        clients.append(dash)
+
+        resp = await dash.get(
+            "/api/metrics?type=node_cpu&interval=Last5m", headers=ALICE
+        )
+        body = json.loads(await resp.text())
+        assert resp.status == 200, body
+        assert body["type"] == "node_cpu"
+        assert len(body["points"]) == 3
+        assert body["resourceChartsLink"] == "https://grafana.example/tpu"
+        assert seen[-1]["query"].startswith("sum(rate(node_cpu_seconds_total")
+
+        # Unknown series rejected (Invalid → 422 in this stack).
+        resp = await dash.get("/api/metrics?type=gpu_cpu", headers=ALICE)
+        assert resp.status == 422
+
+        # Factory: no PROMETHEUS_URL → Null driver; with it → Prometheus.
+        assert isinstance(metrics_service_from_env({}), NullMetricsService)
+        svc2 = metrics_service_from_env({"PROMETHEUS_URL": "http://prom:9090"})
+        assert isinstance(svc2, PrometheusMetricsService)
+        await svc2.close()
+    finally:
+        for c in clients:
+            await c.close()
