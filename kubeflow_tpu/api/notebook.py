@@ -29,6 +29,42 @@ GROUP = "kubeflow.org"
 KIND = "Notebook"
 API_VERSION = "kubeflow.org/v1"
 
+# Version lineage, mirroring the reference which serves v1 (storage),
+# v1beta1, and v1alpha1 with structurally identical schemas
+# (notebook-controller/api/{v1,v1beta1,v1alpha1}/notebook_types.go — the
+# only diffs are package names and kubebuilder markers; conversion is the
+# hub/spoke no-op of api/v1beta1/notebook_conversion.go). Keeping the old
+# versions served makes ``kubectl apply`` of existing kubeflow manifests
+# work unchanged (docs/migration.md's wire-compat claim).
+STORAGE_API_VERSION = API_VERSION
+SERVED_API_VERSIONS = (
+    "kubeflow.org/v1",
+    "kubeflow.org/v1beta1",
+    "kubeflow.org/v1alpha1",
+)
+
+
+def convert(notebook: dict, to_api_version: str) -> dict:
+    """Convert a Notebook between served versions.
+
+    The schemas are identical across versions (see SERVED_API_VERSIONS
+    note), so conversion is the apiVersion rewrite a ``strategy: None``
+    CRD conversion performs — expressed here as an explicit function so
+    the /convert webhook and the admission normalizer share one place
+    that would hold real field mappings if a future version diverges.
+    """
+    if to_api_version not in SERVED_API_VERSIONS:
+        raise Invalid(
+            f"unknown Notebook apiVersion {to_api_version!r}; "
+            f"served: {', '.join(SERVED_API_VERSIONS)}"
+        )
+    have = notebook.get("apiVersion", STORAGE_API_VERSION)
+    if have not in SERVED_API_VERSIONS:
+        raise Invalid(f"cannot convert from unknown apiVersion {have!r}")
+    out = dict(notebook)
+    out["apiVersion"] = to_api_version
+    return out
+
 # Annotation/label contract — kept wire-compatible with the reference so
 # existing tooling (and muscle memory) carries over:
 STOP_ANNOTATION = "kubeflow-resource-stopped"          # notebook_controller.go:410

@@ -33,6 +33,12 @@ def _pod_affecting_changed(nb: dict, old: dict) -> bool:
 
 def mutate(nb: dict, info: dict) -> None:
     """Full Notebook mutator: block live restarts, default, validate."""
+    # Old served versions (v1beta1/v1alpha1) are schema-identical; normalize
+    # to the storage version so the rest of the stack sees one apiVersion
+    # (the real apiserver does this rewrite itself for strategy:None CRD
+    # conversion; the in-process fake goes through admission instead).
+    if nb.get("apiVersion") in nbapi.SERVED_API_VERSIONS:
+        nb["apiVersion"] = nbapi.STORAGE_API_VERSION
     old = info.get("old")
     if info.get("operation") == "UPDATE" and old is not None:
         if nbapi.is_stopped(old) or nbapi.is_stopped(nb):

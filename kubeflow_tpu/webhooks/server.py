@@ -128,6 +128,58 @@ def create_webhook_app(kube) -> web.Application:
 
         app.router.add_post(path, validate_handler)
 
+    # -- CRD version conversion (apiextensions.k8s.io/v1 ConversionReview) --
+    # Reference: notebook-controller serves v1/v1beta1/v1alpha1 with the
+    # hub/spoke no-op conversion (api/v1beta1/notebook_conversion.go) wired
+    # via config/crd/patches/webhook_in_notebooks.yaml's /convert path.
+    async def convert(request: web.Request) -> web.Response:
+        from kubeflow_tpu.api import notebook as nbapi
+
+        try:
+            review = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"error": "could not decode ConversionReview"}, status=400
+            )
+        req = review.get("request") or {}
+        uid = req.get("uid", "")
+        desired = req.get("desiredAPIVersion", "")
+        converted, failed = [], None
+        for obj in req.get("objects") or []:
+            try:
+                if obj.get("kind") == nbapi.KIND:
+                    converted.append(nbapi.convert(obj, desired))
+                else:
+                    # Other CRDs are single-version today; identity-convert
+                    # anything already at the desired version.
+                    if obj.get("apiVersion") != desired:
+                        raise ApiError(
+                            f"no conversion for {obj.get('kind')} "
+                            f"{obj.get('apiVersion')} -> {desired}"
+                        )
+                    converted.append(obj)
+            except ApiError as e:
+                failed = e.message
+                break
+        result = (
+            {"status": "Failed", "message": failed}
+            if failed
+            else {"status": "Success"}
+        )
+        return web.json_response(
+            {
+                "apiVersion": "apiextensions.k8s.io/v1",
+                "kind": "ConversionReview",
+                "response": {
+                    "uid": uid,
+                    "result": result,
+                    **({} if failed else {"convertedObjects": converted}),
+                },
+            }
+        )
+
+    app.router.add_post("/convert", convert)
+
     async def healthz(_request):
         return web.json_response({"status": "ok"})
 
