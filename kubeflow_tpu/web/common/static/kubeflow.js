@@ -1,20 +1,36 @@
-/* Shared frontend helpers: CSRF-aware fetch, table rendering, namespace
-   state (the reference's kubeflow-common-lib backend service + polling
-   modules, distilled). */
+/* Shared frontend library for the kubeflow-tpu web apps.
+ *
+ * Buildless equivalent of the reference's kubeflow-common-lib
+ * (crud-web-apps/common/frontend/kubeflow-common-lib/projects/kubeflow/src/lib):
+ * backend service w/ CSRF header injection, exponential-backoff poller,
+ * resource-table (dynamic columns, status icons, sorting, row actions),
+ * logs-viewer, conditions-table, events-table, details-list,
+ * confirm-dialog, snack-bar, namespace selector, form validators,
+ * date-time utils, tabs, a YAML view, a details drawer, a TPU slice
+ * rollup panel and a dependency-free sparkline — one namespace (KF), no
+ * framework, no bundler.
+ *
+ * Backward-compatible globals (api, el, ns, renderTable, statusDot,
+ * namespacePicker, showError, poll) are kept as aliases at the bottom.
+ */
 
-function getCookie(name) {
+const KF = {};
+
+/* ---------------- backend service (lib/services/backend) ---------------- */
+
+KF.getCookie = function (name) {
   const m = document.cookie.match(new RegExp("(?:^|; )" + name + "=([^;]*)"));
   return m ? decodeURIComponent(m[1]) : null;
-}
+};
 
-async function api(path, options = {}) {
+KF.api = async function (path, options = {}) {
   const headers = Object.assign(
     { "Content-Type": "application/json" },
     options.headers || {}
   );
   const method = (options.method || "GET").toUpperCase();
   if (method !== "GET" && method !== "HEAD") {
-    const token = getCookie("XSRF-TOKEN");
+    const token = KF.getCookie("XSRF-TOKEN");
     if (token) headers["X-XSRF-TOKEN"] = token;
   }
   const resp = await fetch(path, Object.assign({}, options, { headers }));
@@ -23,74 +39,619 @@ async function api(path, options = {}) {
     throw new Error(body.log || resp.status + " " + resp.statusText);
   }
   return body;
-}
+};
 
-function el(tag, attrs = {}, ...children) {
+/* ---------------- DOM helper ------------------------------------------- */
+
+KF.el = function (tag, attrs = {}, ...children) {
   const node = document.createElement(tag);
   for (const [k, v] of Object.entries(attrs)) {
-    if (k === "onclick") node.addEventListener("click", v);
-    else if (k === "class") node.className = v;
+    if (k.startsWith("on") && typeof v === "function") {
+      node.addEventListener(k.slice(2), v);
+    } else if (k === "class") node.className = v;
+    else if (k === "style" && typeof v === "object") Object.assign(node.style, v);
     else node.setAttribute(k, v);
   }
-  for (const child of children.flat()) {
+  for (const child of children.flat(Infinity)) {
+    if (child == null) continue;
     node.append(child instanceof Node ? child : document.createTextNode(child));
   }
   return node;
-}
+};
 
-function statusDot(phase, message) {
-  return el(
+/* ---------------- poller (lib/polling) --------------------------------- */
+
+/* Exponential-backoff poller like the reference's Poller: on success the
+ * period resets to `base`; on failure it doubles up to `max`. stop() ends
+ * it; the returned handle exposes refresh() for user-triggered reloads. */
+KF.poller = function (fn, { base = 4000, max = 60000 } = {}) {
+  let period = base;
+  let timer = null;
+  let stopped = false;
+  async function tick(showErrors) {
+    try {
+      await fn();
+      period = base;
+    } catch (err) {
+      period = Math.min(period * 2, max);
+      if (showErrors) KF.showError(err);
+    }
+    if (!stopped) timer = setTimeout(() => tick(false), period);
+  }
+  tick(true);
+  return {
+    stop() {
+      stopped = true;
+      clearTimeout(timer);
+    },
+    refresh() {
+      clearTimeout(timer);
+      return tick(true);
+    },
+  };
+};
+
+/* ---------------- status icon (lib/resource-table/status) --------------- */
+
+KF.STATUS_TITLES = {
+  ready: "Running",
+  waiting: "Starting",
+  warning: "Error",
+  terminating: "Deleting",
+  stopped: "Stopped",
+};
+
+KF.statusDot = function (phase, message) {
+  return KF.el(
     "span",
     { class: "status", title: message || "" },
-    el("span", { class: "dot " + phase }),
-    phase
+    KF.el("span", { class: "dot " + phase }),
+    KF.STATUS_TITLES[phase] || phase
   );
-}
+};
 
-function renderTable(container, columns, rows) {
-  container.replaceChildren(
-    el(
-      "table",
-      {},
-      el("thead", {}, el("tr", {}, columns.map((c) => el("th", {}, c.title)))),
-      el(
-        "tbody",
-        {},
-        rows.map((row) =>
-          el("tr", {}, columns.map((c) => el("td", {}, c.render(row))))
-        )
+/* ---------------- date-time (lib/date-time) ----------------------------- */
+
+KF.age = function (timestamp) {
+  if (!timestamp) return "—";
+  const sec = Math.max(0, (Date.now() - Date.parse(timestamp)) / 1000);
+  if (sec < 120) return Math.floor(sec) + "s";
+  if (sec < 7200) return Math.floor(sec / 60) + "m";
+  if (sec < 172800) return Math.floor(sec / 3600) + "h";
+  return Math.floor(sec / 86400) + "d";
+};
+
+/* ---------------- resource table (lib/resource-table) ------------------- */
+
+/* columns: [{title, render(row) -> Node|string, sortKey?(row) -> any}]
+ * opts: {onRowClick(row), emptyText} — rows get a click affordance when
+ * onRowClick is provided (the reference's details navigation). */
+KF.renderTable = function (container, columns, rows, opts = {}) {
+  const state = (container._kfSort = container._kfSort || { idx: -1, dir: 1 });
+  const sorted = rows.slice();
+  if (state.idx >= 0 && columns[state.idx] && columns[state.idx].sortKey) {
+    const key = columns[state.idx].sortKey;
+    sorted.sort((a, b) => {
+      const [ka, kb] = [key(a), key(b)];
+      return (ka > kb ? 1 : ka < kb ? -1 : 0) * state.dir;
+    });
+  }
+  const head = KF.el(
+    "tr",
+    {},
+    columns.map((c, idx) =>
+      KF.el(
+        "th",
+        c.sortKey
+          ? {
+              class: "sortable" + (state.idx === idx ? " sorted" : ""),
+              onclick: () => {
+                state.dir = state.idx === idx ? -state.dir : 1;
+                state.idx = idx;
+                KF.renderTable(container, columns, rows, opts);
+              },
+            }
+          : {},
+        c.title,
+        state.idx === idx ? (state.dir > 0 ? " ▲" : " ▼") : ""
       )
     )
   );
-}
+  const body = sorted.length
+    ? sorted.map((row) =>
+        KF.el(
+          "tr",
+          opts.onRowClick
+            ? { class: "clickable", onclick: () => opts.onRowClick(row) }
+            : {},
+          columns.map((c) => KF.el("td", {}, c.render(row)))
+        )
+      )
+    : [
+        KF.el(
+          "tr",
+          {},
+          KF.el(
+            "td",
+            { colspan: String(columns.length), class: "muted" },
+            opts.emptyText || "Nothing here yet."
+          )
+        ),
+      ];
+  container.replaceChildren(
+    KF.el("table", {}, KF.el("thead", {}, head), KF.el("tbody", {}, body))
+  );
+};
 
-const ns = {
+/* Action buttons that stop row-click propagation (so a Delete click never
+ * opens the details drawer underneath it). */
+KF.actionButton = function (label, onclick, opts = {}) {
+  return KF.el(
+    "button",
+    {
+      class: opts.class || "",
+      title: opts.title || "",
+      onclick: (ev) => {
+        ev.stopPropagation();
+        onclick(ev);
+      },
+    },
+    label
+  );
+};
+
+/* ---------------- details list (lib/details-list) ----------------------- */
+
+KF.detailsList = function (pairs) {
+  return KF.el(
+    "dl",
+    { class: "details-list" },
+    pairs
+      .filter(([, v]) => v !== undefined && v !== null && v !== "")
+      .map(([k, v]) => [
+        KF.el("dt", {}, k),
+        KF.el("dd", {}, v instanceof Node ? v : String(v)),
+      ])
+  );
+};
+
+/* ---------------- conditions table (lib/conditions-table) --------------- */
+
+KF.conditionsTable = function (container, conditions) {
+  KF.renderTable(
+    container,
+    [
+      { title: "Type", render: (c) => c.type || "—" },
+      { title: "Status", render: (c) => c.status || "—" },
+      { title: "Reason", render: (c) => c.reason || "—" },
+      { title: "Message", render: (c) => c.message || "—" },
+      {
+        title: "Last probe",
+        render: (c) => KF.age(c.lastProbeTime || c.lastTransitionTime),
+      },
+    ],
+    conditions || [],
+    { emptyText: "No conditions reported." }
+  );
+};
+
+/* ---------------- events table ------------------------------------------ */
+
+KF.eventsTable = function (container, events) {
+  const rows = (events || [])
+    .slice()
+    .sort((a, b) => (b.lastTimestamp || "").localeCompare(a.lastTimestamp || ""));
+  KF.renderTable(
+    container,
+    [
+      {
+        title: "Type",
+        render: (e) =>
+          KF.el(
+            "span",
+            { class: e.type === "Warning" ? "event-warning" : "" },
+            e.type || "Normal"
+          ),
+      },
+      { title: "Reason", render: (e) => e.reason || "—" },
+      { title: "Message", render: (e) => e.message || "—" },
+      { title: "Count", render: (e) => String(e.count || 1) },
+      { title: "Last seen", render: (e) => KF.age(e.lastTimestamp) },
+    ],
+    rows,
+    { emptyText: "No events." }
+  );
+};
+
+/* ---------------- logs viewer (lib/logs-viewer) ------------------------- */
+
+/* fetchLogs(podName) -> Promise<string[]>; pods: [{name}] for the worker
+ * picker (multi-host slices have one log stream per worker). */
+KF.logsViewer = function (container, pods, fetchLogs) {
+  const pre = KF.el("pre", { class: "logs" }, "Loading…");
+  const picker = KF.el(
+    "select",
+    { style: { width: "auto" } },
+    (pods || []).map((p) => KF.el("option", { value: p.name }, p.name))
+  );
+  let timer = null;
+  let follow = true;
+  async function load() {
+    if (!picker.value) {
+      pre.textContent = "No pods.";
+      return;
+    }
+    try {
+      const lines = await fetchLogs(picker.value);
+      pre.textContent = lines.length ? lines.join("\n") : "(no output yet)";
+      if (follow) pre.scrollTop = pre.scrollHeight;
+    } catch (err) {
+      pre.textContent = "Could not fetch logs: " + (err.message || err);
+    }
+  }
+  const followBtn = KF.el(
+    "button",
+    {
+      onclick: () => {
+        follow = !follow;
+        followBtn.textContent = follow ? "Following ✓" : "Follow";
+      },
+    },
+    "Following ✓"
+  );
+  const downloadBtn = KF.el(
+    "button",
+    {
+      onclick: () => {
+        const blob = new Blob([pre.textContent], { type: "text/plain" });
+        const a = KF.el("a", {
+          href: URL.createObjectURL(blob),
+          download: (picker.value || "pod") + ".log",
+        });
+        a.click();
+        URL.revokeObjectURL(a.href);
+      },
+    },
+    "Download"
+  );
+  picker.addEventListener("change", load);
+  container.replaceChildren(
+    KF.el(
+      "div",
+      { class: "logs-toolbar" },
+      KF.el("span", { class: "muted" }, "worker"),
+      picker,
+      followBtn,
+      downloadBtn
+    ),
+    pre
+  );
+  load();
+  timer = setInterval(load, 5000);
+  return {
+    stop() {
+      clearInterval(timer);
+    },
+  };
+};
+
+/* ---------------- confirm dialog (lib/confirm-dialog) ------------------- */
+
+KF.confirmDialog = function ({ title, message, confirmText = "Delete" }) {
+  return new Promise((resolve) => {
+    const overlay = KF.el("div", { class: "kf-overlay" });
+    function close(result) {
+      overlay.remove();
+      document.removeEventListener("keydown", onKey);
+      resolve(result);
+    }
+    function onKey(ev) {
+      if (ev.key === "Escape") close(false);
+    }
+    document.addEventListener("keydown", onKey);
+    overlay.append(
+      KF.el(
+        "div",
+        { class: "kf-dialog", role: "dialog", "aria-modal": "true" },
+        KF.el("h3", {}, title),
+        KF.el("p", {}, message),
+        KF.el(
+          "div",
+          { class: "kf-dialog-actions" },
+          KF.el("button", { onclick: () => close(false) }, "Cancel"),
+          KF.el(
+            "button",
+            { class: "danger", onclick: () => close(true) },
+            confirmText
+          )
+        )
+      )
+    );
+    overlay.addEventListener("click", (ev) => {
+      if (ev.target === overlay) close(false);
+    });
+    document.body.append(overlay);
+  });
+};
+
+/* ---------------- snackbar (lib/snack-bar) ------------------------------ */
+
+KF.snackbar = function (message, kind = "info") {
+  let host = document.getElementById("kf-snackbar-host");
+  if (!host) {
+    host = KF.el("div", { id: "kf-snackbar-host" });
+    document.body.append(host);
+  }
+  const bar = KF.el("div", { class: "kf-snackbar " + kind }, message);
+  host.append(bar);
+  setTimeout(() => bar.classList.add("visible"), 10);
+  setTimeout(() => {
+    bar.classList.remove("visible");
+    setTimeout(() => bar.remove(), 300);
+  }, 4000);
+};
+
+KF.showError = function (err) {
+  const banner = document.getElementById("error-banner");
+  const text = String((err && err.message) || err);
+  if (!banner) return KF.snackbar(text, "error");
+  banner.textContent = text;
+  banner.style.display = "block";
+  setTimeout(() => (banner.style.display = "none"), 8000);
+};
+
+/* ---------------- namespace state (lib/namespace-select) ---------------- */
+
+/* localStorage-backed like the reference's central-dashboard namespace
+ * sharing; a `storage` listener keeps iframed sub-apps in sync. */
+KF.ns = {
+  KEY: "kubeflow.namespace",
   get() {
-    return localStorage.getItem("kubeflow.namespace") || "kubeflow-user";
+    return localStorage.getItem(KF.ns.KEY) || "kubeflow-user";
   },
   set(value) {
-    localStorage.setItem("kubeflow.namespace", value);
+    localStorage.setItem(KF.ns.KEY, value);
+  },
+  onChange(fn) {
+    window.addEventListener("storage", (ev) => {
+      if (ev.key === KF.ns.KEY) fn(ev.newValue);
+    });
   },
 };
 
-function namespacePicker(onChange) {
-  const input = el("input", { value: ns.get(), style: "width:180px" });
+KF.namespacePicker = function (onChange) {
+  const input = KF.el("input", {
+    value: KF.ns.get(),
+    style: { width: "180px" },
+    list: "kf-ns-options",
+  });
   input.addEventListener("change", () => {
-    ns.set(input.value);
+    KF.ns.set(input.value);
     onChange(input.value);
   });
+  KF.ns.onChange((value) => {
+    input.value = value;
+    onChange(value);
+  });
   return input;
-}
+};
 
-function showError(err) {
-  const banner = document.getElementById("error-banner");
-  if (!banner) return alert(err.message || err);
-  banner.textContent = String(err.message || err);
-  banner.style.display = "block";
-  setTimeout(() => (banner.style.display = "none"), 8000);
-}
+/* ---------------- form validators (lib/form) ---------------------------- */
 
+KF.validators = {
+  /* DNS-1123 label — the reference's resource-name validator. */
+  dns1123: (value) =>
+    /^[a-z0-9]([-a-z0-9]*[a-z0-9])?$/.test(value) && value.length <= 63
+      ? null
+      : "Use lowercase letters, digits and dashes (max 63 chars).",
+  positiveNumber: (value) =>
+    Number(value) > 0 ? null : "Must be a positive number.",
+  memoryQuantity: (value) =>
+    /^[0-9]+(\.[0-9]+)?(Ei|Pi|Ti|Gi|Mi|Ki|E|P|T|G|M|k)?$/.test(value)
+      ? null
+      : "Use a Kubernetes quantity, e.g. 1.5Gi.",
+};
+
+/* Attach a validator to an input: red border + title on invalid. Returns
+ * () => boolean for submit-time checks. */
+KF.validate = function (input, validator) {
+  function check() {
+    const err = validator(input.value);
+    input.classList.toggle("invalid", !!err);
+    input.title = err || "";
+    return !err;
+  }
+  input.addEventListener("input", check);
+  return check;
+};
+
+/* ---------------- tabs ------------------------------------------------- */
+
+/* tabs: [{label, render(pane) (may return cleanup.stop)}] */
+KF.tabs = function (container, tabs) {
+  const bar = KF.el("div", { class: "kf-tabs" });
+  const pane = KF.el("div", { class: "kf-tab-pane" });
+  let cleanup = null;
+  function select(idx) {
+    if (cleanup && cleanup.stop) cleanup.stop();
+    cleanup = null;
+    [...bar.children].forEach((b, i) => b.classList.toggle("active", i === idx));
+    pane.replaceChildren();
+    cleanup = tabs[idx].render(pane) || null;
+  }
+  tabs.forEach((tab, idx) =>
+    bar.append(
+      KF.el("button", { class: "kf-tab", onclick: () => select(idx) }, tab.label)
+    )
+  );
+  container.replaceChildren(bar, pane);
+  select(0);
+  return {
+    stop() {
+      if (cleanup && cleanup.stop) cleanup.stop();
+    },
+  };
+};
+
+/* ---------------- YAML view (lib/editor, read-only) --------------------- */
+
+KF.toYaml = function (value, indent = 0) {
+  const pad = "  ".repeat(indent);
+  if (value === null || value === undefined) return "null";
+  if (typeof value !== "object") {
+    const s = String(value);
+    return typeof value === "string" &&
+      (s === "" || /[:#{}\[\],&*>|%@`"']|^\s|\s$|^[\d.-]/.test(s))
+      ? JSON.stringify(s)
+      : s;
+  }
+  if (Array.isArray(value)) {
+    if (!value.length) return "[]";
+    return value
+      .map((item) => {
+        if (item !== null && typeof item === "object") {
+          const body = KF.toYaml(item, indent + 1);
+          return pad + "-\n" + body;
+        }
+        return pad + "- " + KF.toYaml(item, 0);
+      })
+      .join("\n");
+  }
+  const keys = Object.keys(value);
+  if (!keys.length) return "{}";
+  return keys
+    .map((k) => {
+      const v = value[k];
+      if (v !== null && typeof v === "object" && Object.keys(v).length) {
+        return pad + k + ":\n" + KF.toYaml(v, indent + 1);
+      }
+      return pad + k + ": " + KF.toYaml(v, 0);
+    })
+    .join("\n");
+};
+
+KF.yamlView = function (container, obj) {
+  container.replaceChildren(KF.el("pre", { class: "yaml" }, KF.toYaml(obj)));
+};
+
+/* ---------------- details drawer --------------------------------------- */
+
+/* Slide-in panel hosting a details page (the reference's per-resource
+ * details route, drawer-style so the table stays live behind it). */
+KF.drawer = function (title) {
+  const content = KF.el("div", { class: "kf-drawer-content" });
+  let onClose = null;
+  const overlay = KF.el("div", { class: "kf-overlay kf-drawer-overlay" });
+  function close() {
+    overlay.remove();
+    if (onClose) onClose();
+  }
+  const panel = KF.el(
+    "div",
+    { class: "kf-drawer" },
+    KF.el(
+      "div",
+      { class: "kf-drawer-head" },
+      KF.el("h2", {}, title),
+      KF.el("button", { onclick: close }, "✕")
+    ),
+    content
+  );
+  overlay.addEventListener("click", (ev) => {
+    if (ev.target === overlay) close();
+  });
+  overlay.append(panel);
+  document.body.append(overlay);
+  return {
+    content,
+    close,
+    set onclose(fn) {
+      onClose = fn;
+    },
+  };
+};
+
+/* ---------------- TPU slice rollup -------------------------------------- */
+
+/* The panel the reference never needed: worker-by-worker slice health.
+ * tpu: spec.tpu {accelerator, topology}; tpuStatus: status.tpu
+ * {hosts, readyHosts, chips}; pods: [{name, ready}] worker pod list. */
+KF.sliceRollup = function (container, tpu, tpuStatus, pods) {
+  if (!tpu) {
+    container.replaceChildren(
+      KF.el("p", { class: "muted" }, "CPU-only notebook (no TPU slice).")
+    );
+    return;
+  }
+  const hosts = (tpuStatus && tpuStatus.hosts) || 1;
+  const ready = (tpuStatus && tpuStatus.readyHosts) || 0;
+  const chips = (tpuStatus && tpuStatus.chips) || "?";
+  const workers = KF.el(
+    "div",
+    { class: "slice-grid" },
+    Array.from({ length: hosts }, (_, i) => {
+      const pod = (pods || []).find((p) => p.name && p.name.endsWith("-" + i));
+      const phase = pod ? (pod.ready ? "ready" : "waiting") : "stopped";
+      return KF.el(
+        "div",
+        { class: "slice-worker " + phase, title: pod ? pod.name : "no pod" },
+        KF.el("span", { class: "dot " + phase }),
+        "worker-" + i
+      );
+    })
+  );
+  container.replaceChildren(
+    KF.detailsList([
+      ["Accelerator", tpu.accelerator],
+      ["Topology", tpu.topology],
+      ["Chips", String(chips)],
+      ["Hosts ready", ready + " / " + hosts],
+    ]),
+    workers
+  );
+};
+
+/* ---------------- sparkline (dashboard metrics) ------------------------- */
+
+/* Dependency-free time-series mini chart; points: [{timestamp, value}]. */
+KF.sparkline = function (canvas, points, { stroke = "#1a73e8" } = {}) {
+  const ctx = canvas.getContext("2d");
+  const w = (canvas.width = canvas.clientWidth * 2 || 600);
+  const h = (canvas.height = canvas.clientHeight * 2 || 120);
+  ctx.clearRect(0, 0, w, h);
+  if (!points || points.length < 2) {
+    ctx.fillStyle = "#5f6368";
+    ctx.font = "24px system-ui";
+    ctx.fillText("no data", 12, h / 2);
+    return;
+  }
+  const xs = points.map((p) => p.timestamp);
+  const ys = points.map((p) => p.value);
+  const [x0, x1] = [Math.min(...xs), Math.max(...xs)];
+  const [y0, y1] = [Math.min(...ys), Math.max(...ys)];
+  const sx = (x) => ((x - x0) / (x1 - x0 || 1)) * (w - 16) + 8;
+  const sy = (y) => h - 8 - ((y - y0) / (y1 - y0 || 1)) * (h - 16);
+  ctx.beginPath();
+  ctx.strokeStyle = stroke;
+  ctx.lineWidth = 3;
+  points.forEach((p, i) =>
+    i
+      ? ctx.lineTo(sx(p.timestamp), sy(p.value))
+      : ctx.moveTo(sx(p.timestamp), sy(p.value))
+  );
+  ctx.stroke();
+};
+
+/* ---------------- legacy global aliases --------------------------------- */
+
+const getCookie = KF.getCookie;
+const api = KF.api;
+const el = KF.el;
+const statusDot = KF.statusDot;
+const renderTable = KF.renderTable;
+const ns = KF.ns;
+const namespacePicker = KF.namespacePicker;
+const showError = KF.showError;
 function poll(fn, intervalMs = 4000) {
-  fn().catch(showError);
-  return setInterval(() => fn().catch(() => {}), intervalMs);
+  return KF.poller(fn, { base: intervalMs });
 }

@@ -1,4 +1,12 @@
-/* Dashboard frontend: workgroup bootstrap, app links, namespaces, TPU usage. */
+/* Dashboard frontend: workgroup bootstrap, app links, namespaces, TPU
+ * usage, and time-series metrics panels (sparklines over /api/metrics —
+ * the reference's resource-chart.js over the pluggable metrics service). */
+
+const METRIC_PANELS = [
+  { type: "tpu_duty", label: "TPU duty cycle" },
+  { type: "node_cpu", label: "Node CPU" },
+  { type: "pod_mem", label: "Pod memory" },
+];
 
 async function loadLinks() {
   const body = await api("api/dashboard-links");
@@ -30,6 +38,42 @@ async function loadTpuUsage(namespace) {
   );
 }
 
+async function loadMetrics() {
+  const host = document.getElementById("metrics-panels");
+  if (!host) return;
+  for (const panel of METRIC_PANELS) {
+    let slot = document.getElementById("metric-" + panel.type);
+    if (!slot) {
+      slot = el(
+        "div",
+        { id: "metric-" + panel.type, class: "card" },
+        el("h4", {}, panel.label),
+        el("canvas", { class: "spark" }),
+        el("p", { class: "muted metric-note" }, "loading…")
+      );
+      host.append(slot);
+    }
+    try {
+      const body = await api(
+        `api/metrics?type=${panel.type}&interval=Last15m`
+      );
+      KF.sparkline(slot.querySelector("canvas"), body.points);
+      const note = slot.querySelector(".metric-note");
+      if (!body.points.length) {
+        note.textContent = body.resourceChartsLink
+          ? "no data in range"
+          : "no metrics backend configured (set PROMETHEUS_URL)";
+      } else {
+        const last = body.points[body.points.length - 1];
+        note.textContent = `latest: ${last.value.toFixed(3)} (${last.label || panel.type})`;
+      }
+    } catch (err) {
+      slot.querySelector(".metric-note").textContent =
+        "metrics unavailable: " + err.message;
+    }
+  }
+}
+
 async function refresh() {
   const info = await api("api/workgroup/env-info");
   document.getElementById("user-slot").textContent = info.user;
@@ -42,23 +86,37 @@ async function refresh() {
       {
         title: "Namespace",
         render: (n) =>
-          el("a", { href: "#", onclick: (ev) => {
-            ev.preventDefault();
-            loadTpuUsage(n.namespace).catch(showError);
-          } }, n.namespace),
+          el(
+            "a",
+            {
+              href: "#",
+              onclick: (ev) => {
+                ev.preventDefault();
+                KF.ns.set(n.namespace);
+                loadTpuUsage(n.namespace).catch(showError);
+              },
+            },
+            n.namespace
+          ),
+        sortKey: (n) => n.namespace,
       },
       { title: "Role", render: (n) => n.role },
     ],
-    info.namespaces
+    info.namespaces,
+    { emptyText: "No namespaces yet — register a workgroup below." }
   );
   if (info.namespaces.length) {
     loadTpuUsage(info.namespaces[0].namespace).catch(() => {});
   }
+  await loadMetrics();
 }
 
 document.getElementById("register-btn").addEventListener("click", () => {
   api("api/workgroup/create", { method: "POST", body: "{}" }).then(
-    refresh,
+    () => {
+      KF.snackbar("Workgroup created");
+      refresh().catch(showError);
+    },
     showError
   );
 });

@@ -1,22 +1,24 @@
-/* JWA frontend: table + spawner form (the reference's Angular app distilled;
-   TPU accelerator/topology pickers come from /api/tpus). */
+/* JWA frontend: resource table + spawner form + details drawer.
+ *
+ * The reference's Angular jupyter app distilled onto the shared KF lib:
+ * sortable resource table with status icons and polling, TPU
+ * accelerator/topology pickers from /api/tpus, confirm dialogs, and a
+ * details drawer with Overview / TPU slice / Conditions / Events / Logs /
+ * YAML tabs wired to the backend's pod, events and logs routes.
+ */
 
 let tpuCatalog = [];
+let tablePoller = null;
 
 async function loadCatalogs() {
-  const [tpus, config] = await Promise.all([
-    api("api/tpus"),
-    api("api/config"),
-  ]);
+  const [tpus, config] = await Promise.all([api("api/tpus"), api("api/config")]);
   tpuCatalog = tpus.tpus;
 
   const accSelect = document.getElementById("tpu-acc");
   // NB: replaceChildren stringifies arrays — always spread node lists.
   accSelect.replaceChildren(
     el("option", { value: "" }, "none (CPU)"),
-    ...tpuCatalog.map((t) =>
-      el("option", { value: t.accelerator }, t.accelerator)
-    )
+    ...tpuCatalog.map((t) => el("option", { value: t.accelerator }, t.accelerator))
   );
   accSelect.addEventListener("change", renderTopologies);
   renderTopologies();
@@ -43,17 +45,155 @@ function renderTopologies() {
   );
 }
 
+/* ---------------- details drawer ---------------------------------------- */
+
+function openDetails(nb) {
+  const name = nb.name;
+  const drawer = KF.drawer(`Notebook ${name}`);
+  const tabHost = el("div", {});
+  drawer.content.append(tabHost);
+
+  const podsFor = () =>
+    api(`api/namespaces/${ns.get()}/notebooks/${name}/pod`).then((body) =>
+      body.pods.map((p) => ({
+        name: p.metadata.name,
+        ready: (p.status && p.status.phase) === "Running",
+      }))
+    );
+
+  const tabs = KF.tabs(tabHost, [
+    {
+      label: "Overview",
+      render: (pane) => {
+        const status = el("div", {});
+        const slice = el("div", {});
+        pane.append(
+          el("h4", {}, "Status"),
+          status,
+          el("h4", {}, "TPU slice"),
+          slice
+        );
+        async function load() {
+          const body = await api(
+            `api/namespaces/${ns.get()}/notebooks/${name}`
+          );
+          const meta = body.notebook.metadata || {};
+          const ps = body.processedStatus || {};
+          status.replaceChildren(
+            KF.detailsList([
+              ["Status", KF.statusDot(ps.phase, ps.message)],
+              ["Message", ps.message],
+              ["Image", nb.image],
+              ["CPU / Memory", `${nb.cpu || "—"} / ${nb.memory || "—"}`],
+              ["Created", meta.creationTimestamp],
+              [
+                "Connect",
+                el(
+                  "a",
+                  { href: `/notebook/${ns.get()}/${name}/`, target: "_blank" },
+                  `/notebook/${ns.get()}/${name}/`
+                ),
+              ],
+            ])
+          );
+          const pods = await podsFor().catch(() => []);
+          KF.sliceRollup(
+            slice,
+            body.notebook.spec && body.notebook.spec.tpu,
+            body.notebook.status && body.notebook.status.tpu,
+            pods
+          );
+        }
+        load().catch(KF.showError);
+        const t = setInterval(() => load().catch(() => {}), 5000);
+        return { stop: () => clearInterval(t) };
+      },
+    },
+    {
+      label: "Conditions",
+      render: (pane) => {
+        const host = el("div", {});
+        pane.append(host);
+        api(`api/namespaces/${ns.get()}/notebooks/${name}`)
+          .then((body) =>
+            KF.conditionsTable(
+              host,
+              (body.notebook.status && body.notebook.status.conditions) || []
+            )
+          )
+          .catch(KF.showError);
+      },
+    },
+    {
+      label: "Events",
+      render: (pane) => {
+        const host = el("div", {});
+        pane.append(host);
+        async function load() {
+          const body = await api(
+            `api/namespaces/${ns.get()}/notebooks/${name}/events`
+          );
+          KF.eventsTable(host, body.events);
+        }
+        load().catch(KF.showError);
+        const t = setInterval(() => load().catch(() => {}), 5000);
+        return { stop: () => clearInterval(t) };
+      },
+    },
+    {
+      label: "Logs",
+      render: (pane) => {
+        const host = el("div", {});
+        pane.append(host);
+        let viewer = null;
+        podsFor()
+          .then((pods) => {
+            viewer = KF.logsViewer(host, pods, (pod) =>
+              api(
+                `api/namespaces/${ns.get()}/notebooks/${name}/pod/${pod}/logs`
+              ).then((body) => body.logs)
+            );
+          })
+          .catch((err) => {
+            host.replaceChildren(
+              el("p", { class: "muted" }, "No pods yet: " + err.message)
+            );
+          });
+        return { stop: () => viewer && viewer.stop() };
+      },
+    },
+    {
+      label: "YAML",
+      render: (pane) => {
+        const host = el("div", {});
+        pane.append(host);
+        api(`api/namespaces/${ns.get()}/notebooks/${name}`)
+          .then((body) => KF.yamlView(host, body.notebook))
+          .catch(KF.showError);
+      },
+    },
+  ]);
+  drawer.onclose = () => tabs.stop();
+}
+
+/* ---------------- list table -------------------------------------------- */
+
 async function refresh() {
   const body = await api(`api/namespaces/${ns.get()}/notebooks`);
   const columns = [
     {
       title: "Status",
       render: (nb) => statusDot(nb.status.phase, nb.status.message),
+      sortKey: (nb) => nb.status.phase,
     },
-    { title: "Name", render: (nb) => nb.name },
-    { title: "Image", render: (nb) => nb.image.split("/").pop() },
-    { title: "CPU", render: (nb) => nb.cpu || "-" },
-    { title: "Memory", render: (nb) => nb.memory || "-" },
+    { title: "Name", render: (nb) => nb.name, sortKey: (nb) => nb.name },
+    {
+      title: "Image",
+      render: (nb) => nb.image.split("/").pop(),
+      sortKey: (nb) => nb.image,
+    },
+    { title: "CPU", render: (nb) => nb.cpu || "—" },
+    { title: "Memory", render: (nb) => nb.memory || "—" },
     {
       title: "TPU",
       render: (nb) =>
@@ -61,12 +201,22 @@ async function refresh() {
           ? el(
               "span",
               {},
-              el("span", { class: "chip" }, `${nb.tpu.accelerator} ${nb.tpu.topology}`),
+              el(
+                "span",
+                { class: "chip" },
+                `${nb.tpu.accelerator} ${nb.tpu.topology}`
+              ),
               nb.tpuStatus
                 ? `${nb.tpuStatus.readyHosts}/${nb.tpuStatus.hosts} hosts`
                 : ""
             )
           : "—",
+      sortKey: (nb) => (nb.tpu ? nb.tpu.accelerator : ""),
+    },
+    {
+      title: "Age",
+      render: (nb) => KF.age(nb.age),
+      sortKey: (nb) => nb.age || "",
     },
     {
       title: "Actions",
@@ -75,41 +225,67 @@ async function refresh() {
         return el(
           "span",
           {},
-          el(
-            "button",
-            {
-              onclick: () =>
-                api(`api/namespaces/${ns.get()}/notebooks/${nb.name}`, {
-                  method: "PATCH",
-                  body: JSON.stringify({ stopped: !stopped }),
-                }).then(refresh, showError),
-            },
-            stopped ? "Start" : "Stop"
+          KF.actionButton(stopped ? "Start" : "Stop", () =>
+            api(`api/namespaces/${ns.get()}/notebooks/${nb.name}`, {
+              method: "PATCH",
+              body: JSON.stringify({ stopped: !stopped }),
+            }).then(() => {
+              KF.snackbar(
+                (stopped ? "Starting " : "Stopping ") + nb.name
+              );
+              tablePoller.refresh();
+            }, showError)
           ),
           " ",
-          el(
-            "button",
-            { class: "danger",
-              onclick: () =>
-                confirm(`Delete notebook ${nb.name}?`) &&
-                api(`api/namespaces/${ns.get()}/notebooks/${nb.name}`, {
-                  method: "DELETE",
-                }).then(refresh, showError),
-            },
-            "Delete"
+          KF.actionButton(
+            "Delete",
+            () =>
+              KF.confirmDialog({
+                title: `Delete notebook ${nb.name}?`,
+                message:
+                  "The notebook's pods are deleted; workspace volumes are kept.",
+              }).then(
+                (ok) =>
+                  ok &&
+                  api(`api/namespaces/${ns.get()}/notebooks/${nb.name}`, {
+                    method: "DELETE",
+                  }).then(() => {
+                    KF.snackbar("Deleting " + nb.name);
+                    tablePoller.refresh();
+                  }, showError)
+              ),
+            { class: "danger" }
           ),
           " ",
           el(
             "a",
-            { href: `/notebook/${ns.get()}/${nb.name}/`, target: "_blank" },
+            {
+              href: `/notebook/${ns.get()}/${nb.name}/`,
+              target: "_blank",
+              onclick: (ev) => ev.stopPropagation(),
+            },
             "Connect"
           )
         );
       },
     },
   ];
-  renderTable(document.getElementById("notebook-table"), columns, body.notebooks);
+  renderTable(document.getElementById("notebook-table"), columns, body.notebooks, {
+    onRowClick: openDetails,
+    emptyText: "No notebook servers in this namespace.",
+  });
 }
+
+/* ---------------- spawner form ------------------------------------------ */
+
+const nameInput = document.querySelector('#new-form input[name="name"]');
+const cpuInput = document.querySelector('#new-form input[name="cpu"]');
+const memInput = document.querySelector('#new-form input[name="memory"]');
+const checks = [
+  KF.validate(nameInput, KF.validators.dns1123),
+  KF.validate(cpuInput, KF.validators.positiveNumber),
+  KF.validate(memInput, KF.validators.memoryQuantity),
+];
 
 document.getElementById("new-btn").addEventListener("click", () => {
   document.getElementById("new-form-card").style.display = "block";
@@ -119,6 +295,10 @@ document.getElementById("cancel-btn").addEventListener("click", () => {
 });
 document.getElementById("new-form").addEventListener("submit", (ev) => {
   ev.preventDefault();
+  if (!checks.every((check) => check())) {
+    KF.snackbar("Fix the highlighted fields first.", "error");
+    return;
+  }
   const form = new FormData(ev.target);
   const payload = {
     name: form.get("name"),
@@ -138,12 +318,13 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
     body: JSON.stringify(payload),
   }).then(() => {
     document.getElementById("new-form-card").style.display = "none";
-    refresh();
+    KF.snackbar("Creating notebook " + payload.name);
+    tablePoller.refresh();
   }, showError);
 });
 
 document
   .getElementById("ns-slot")
-  .append(namespacePicker(() => refresh().catch(showError)));
+  .append(namespacePicker(() => tablePoller.refresh()));
 loadCatalogs().catch(showError);
-poll(refresh);
+tablePoller = poll(refresh);

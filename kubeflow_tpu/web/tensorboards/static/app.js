@@ -1,4 +1,42 @@
-/* TWA frontend. */
+/* TWA frontend on the shared KF lib: sortable table, confirm dialogs,
+ * snackbars, details drawer with the logspath scheme explained. */
+
+let tablePoller = null;
+
+function schemeOf(logspath) {
+  if (!logspath) return "unknown";
+  if (logspath.startsWith("pvc://")) return "PVC subpath";
+  if (logspath.startsWith("gs://")) return "GCS bucket (XLA profiler traces)";
+  if (logspath.startsWith("s3://")) return "S3 bucket";
+  return "path";
+}
+
+function openDetails(tb) {
+  const drawer = KF.drawer(`TensorBoard ${tb.name}`);
+  drawer.content.append(
+    KF.detailsList([
+      ["Name", tb.name],
+      ["Status", KF.statusDot(tb.ready ? "ready" : "waiting", "")],
+      ["Logs path", tb.logspath],
+      ["Source", schemeOf(tb.logspath)],
+      [
+        "Open",
+        el(
+          "a",
+          { href: `/tensorboard/${ns.get()}/${tb.name}/`, target: "_blank" },
+          `/tensorboard/${ns.get()}/${tb.name}/`
+        ),
+      ],
+    ]),
+    el(
+      "p",
+      { class: "muted" },
+      "gs:// paths serve XLA/TPU profiler traces captured with ",
+      el("code", {}, "jax.profiler"),
+      " — open the Profile tab inside TensorBoard."
+    )
+  );
+}
 
 async function refresh() {
   const body = await api(`api/namespaces/${ns.get()}/tensorboards`);
@@ -6,9 +44,15 @@ async function refresh() {
     {
       title: "Status",
       render: (tb) => statusDot(tb.ready ? "ready" : "waiting", ""),
+      sortKey: (tb) => (tb.ready ? 0 : 1),
     },
-    { title: "Name", render: (tb) => tb.name },
-    { title: "Logs path", render: (tb) => tb.logspath },
+    { title: "Name", render: (tb) => tb.name, sortKey: (tb) => tb.name },
+    {
+      title: "Logs path",
+      render: (tb) => tb.logspath,
+      sortKey: (tb) => tb.logspath || "",
+    },
+    { title: "Source", render: (tb) => schemeOf(tb.logspath) },
     {
       title: "Actions",
       render: (tb) =>
@@ -17,26 +61,45 @@ async function refresh() {
           {},
           el(
             "a",
-            { href: `/tensorboard/${ns.get()}/${tb.name}/`, target: "_blank" },
+            {
+              href: `/tensorboard/${ns.get()}/${tb.name}/`,
+              target: "_blank",
+              onclick: (ev) => ev.stopPropagation(),
+            },
             "Open"
           ),
           " ",
-          el(
-            "button",
-            { class: "danger",
-              onclick: () =>
-                confirm(`Delete ${tb.name}?`) &&
-                api(`api/namespaces/${ns.get()}/tensorboards/${tb.name}`, {
-                  method: "DELETE",
-                }).then(refresh, showError),
-            },
-            "Delete"
+          KF.actionButton(
+            "Delete",
+            () =>
+              KF.confirmDialog({
+                title: `Delete TensorBoard ${tb.name}?`,
+                message: "The server is removed; the logs themselves are kept.",
+              }).then(
+                (ok) =>
+                  ok &&
+                  api(`api/namespaces/${ns.get()}/tensorboards/${tb.name}`, {
+                    method: "DELETE",
+                  }).then(() => {
+                    KF.snackbar("Deleting " + tb.name);
+                    tablePoller.refresh();
+                  }, showError)
+              ),
+            { class: "danger" }
           )
         ),
     },
   ];
-  renderTable(document.getElementById("tb-table"), columns, body.tensorboards);
+  renderTable(document.getElementById("tb-table"), columns, body.tensorboards, {
+    onRowClick: openDetails,
+    emptyText: "No TensorBoards in this namespace.",
+  });
 }
+
+const nameInput = document.querySelector('#new-form input[name="name"]');
+const nameCheck = nameInput
+  ? KF.validate(nameInput, KF.validators.dns1123)
+  : () => true;
 
 document.getElementById("new-btn").addEventListener("click", () => {
   document.getElementById("new-form-card").style.display = "block";
@@ -46,6 +109,7 @@ document.getElementById("cancel-btn").addEventListener("click", () => {
 });
 document.getElementById("new-form").addEventListener("submit", (ev) => {
   ev.preventDefault();
+  if (!nameCheck()) return KF.snackbar("Fix the name first.", "error");
   const form = new FormData(ev.target);
   api(`api/namespaces/${ns.get()}/tensorboards`, {
     method: "POST",
@@ -56,11 +120,12 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
     }),
   }).then(() => {
     document.getElementById("new-form-card").style.display = "none";
-    refresh();
+    KF.snackbar("Creating TensorBoard " + form.get("name"));
+    tablePoller.refresh();
   }, showError);
 });
 
 document
   .getElementById("ns-slot")
-  .append(namespacePicker(() => refresh().catch(showError)));
-poll(refresh);
+  .append(namespacePicker(() => tablePoller.refresh()));
+tablePoller = poll(refresh);

@@ -1,12 +1,80 @@
-/* VWA frontend: PVC table with viewer lifecycle. */
+/* VWA frontend: PVC table with viewer lifecycle + details drawer.
+ *
+ * The reference's Angular volumes app on the shared KF lib: sortable
+ * table, confirm dialogs, snackbars, and a per-PVC drawer with details,
+ * live events (backend /pvcs/{name}/events) and YAML.
+ */
+
+let tablePoller = null;
+
+function openDetails(p) {
+  const drawer = KF.drawer(`Volume ${p.name}`);
+  const tabHost = el("div", {});
+  drawer.content.append(tabHost);
+  const tabs = KF.tabs(tabHost, [
+    {
+      label: "Overview",
+      render: (pane) => {
+        pane.append(
+          KF.detailsList([
+            ["Name", p.name],
+            ["Capacity", p.capacity || "—"],
+            ["Access modes", (p.modes || []).join(", ")],
+            ["Storage class", p.class || "default"],
+            ["Status", p.status],
+            [
+              "Used by",
+              (p.usedBy || []).length
+                ? el(
+                    "span",
+                    {},
+                    p.usedBy.map((name) => el("span", { class: "chip" }, name))
+                  )
+                : "nothing",
+            ],
+            [
+              "Viewer",
+              p.viewer
+                ? p.viewer.ready && p.viewer.url
+                  ? el("a", { href: p.viewer.url, target: "_blank" }, "open")
+                  : "starting…"
+                : "none",
+            ],
+          ])
+        );
+      },
+    },
+    {
+      label: "Events",
+      render: (pane) => {
+        const host = el("div", {});
+        pane.append(host);
+        async function load() {
+          const body = await api(
+            `api/namespaces/${ns.get()}/pvcs/${p.name}/events`
+          );
+          KF.eventsTable(host, body.events);
+        }
+        load().catch(KF.showError);
+        const t = setInterval(() => load().catch(() => {}), 5000);
+        return { stop: () => clearInterval(t) };
+      },
+    },
+  ]);
+  drawer.onclose = () => tabs.stop();
+}
 
 async function refresh() {
   const body = await api(`api/namespaces/${ns.get()}/pvcs`);
   const columns = [
-    { title: "Name", render: (p) => p.name },
-    { title: "Size", render: (p) => p.capacity || "-" },
+    { title: "Name", render: (p) => p.name, sortKey: (p) => p.name },
+    {
+      title: "Size",
+      render: (p) => p.capacity || "—",
+      sortKey: (p) => p.capacity || "",
+    },
     { title: "Modes", render: (p) => (p.modes || []).join(", ") },
-    { title: "Status", render: (p) => p.status },
+    { title: "Status", render: (p) => p.status, sortKey: (p) => p.status },
     {
       title: "Used by",
       render: (p) =>
@@ -21,35 +89,66 @@ async function refresh() {
           "span",
           {},
           p.viewer && p.viewer.ready && p.viewer.url
-            ? el("a", { href: p.viewer.url, target: "_blank" }, "Browse")
-            : el(
-                "button",
+            ? el(
+                "a",
                 {
-                  onclick: () =>
-                    api(`api/namespaces/${ns.get()}/viewers`, {
-                      method: "POST",
-                      body: JSON.stringify({ pvc: p.name }),
-                    }).then(refresh, showError),
+                  href: p.viewer.url,
+                  target: "_blank",
+                  onclick: (ev) => ev.stopPropagation(),
                 },
-                p.viewer ? "Viewer starting…" : "Open viewer"
+                "Browse"
+              )
+            : KF.actionButton(
+                p.viewer ? "Viewer starting…" : "Open viewer",
+                () =>
+                  api(`api/namespaces/${ns.get()}/viewers`, {
+                    method: "POST",
+                    body: JSON.stringify({ pvc: p.name }),
+                  }).then(() => {
+                    KF.snackbar("Starting viewer for " + p.name);
+                    tablePoller.refresh();
+                  }, showError)
               ),
           " ",
-          el(
-            "button",
-            { class: "danger",
-              onclick: () =>
-                confirm(`Delete volume ${p.name}?`) &&
-                api(`api/namespaces/${ns.get()}/pvcs/${p.name}`, {
+          p.viewer
+            ? KF.actionButton("Close viewer", () =>
+                api(`api/namespaces/${ns.get()}/viewers/${p.viewer.name}`, {
                   method: "DELETE",
-                }).then(refresh, showError),
-            },
-            "Delete"
+                }).then(() => tablePoller.refresh(), showError)
+              )
+            : "",
+          " ",
+          KF.actionButton(
+            "Delete",
+            () =>
+              KF.confirmDialog({
+                title: `Delete volume ${p.name}?`,
+                message: "All data on the volume is permanently removed.",
+              }).then(
+                (ok) =>
+                  ok &&
+                  api(`api/namespaces/${ns.get()}/pvcs/${p.name}`, {
+                    method: "DELETE",
+                  }).then(() => {
+                    KF.snackbar("Deleting " + p.name);
+                    tablePoller.refresh();
+                  }, showError)
+              ),
+            { class: "danger" }
           )
         ),
     },
   ];
-  renderTable(document.getElementById("pvc-table"), columns, body.pvcs);
+  renderTable(document.getElementById("pvc-table"), columns, body.pvcs, {
+    onRowClick: openDetails,
+    emptyText: "No volumes in this namespace.",
+  });
 }
+
+const nameInput = document.querySelector('#new-form input[name="name"]');
+const nameCheck = nameInput
+  ? KF.validate(nameInput, KF.validators.dns1123)
+  : () => true;
 
 document.getElementById("new-btn").addEventListener("click", () => {
   document.getElementById("new-form-card").style.display = "block";
@@ -59,6 +158,7 @@ document.getElementById("cancel-btn").addEventListener("click", () => {
 });
 document.getElementById("new-form").addEventListener("submit", (ev) => {
   ev.preventDefault();
+  if (!nameCheck()) return KF.snackbar("Fix the volume name first.", "error");
   const form = new FormData(ev.target);
   api(`api/namespaces/${ns.get()}/pvcs`, {
     method: "POST",
@@ -69,11 +169,12 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
     }),
   }).then(() => {
     document.getElementById("new-form-card").style.display = "none";
-    refresh();
+    KF.snackbar("Creating volume " + form.get("name"));
+    tablePoller.refresh();
   }, showError);
 });
 
 document
   .getElementById("ns-slot")
-  .append(namespacePicker(() => refresh().catch(showError)));
-poll(refresh);
+  .append(namespacePicker(() => tablePoller.refresh()));
+tablePoller = poll(refresh);
