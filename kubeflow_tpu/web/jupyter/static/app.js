@@ -10,6 +10,44 @@
 let tpuCatalog = [];
 let tablePoller = null;
 
+async function loadNamespaceCatalogs() {
+  /* PVCs for the data-volume picker + PodDefaults for configurations —
+   * refetched on namespace change. */
+  const [pvcs, pds] = await Promise.all([
+    api(`api/namespaces/${ns.get()}/pvcs`).catch(() => ({ pvcs: [] })),
+    api(`api/namespaces/${ns.get()}/poddefaults`).catch(() => ({
+      poddefaults: [],
+    })),
+  ]);
+  const dataVolume = document.getElementById("data-volume");
+  dataVolume.replaceChildren(
+    el("option", { value: "" }, "none"),
+    ...(pvcs.pvcs || []).map((p) =>
+      el("option", { value: p.name }, `${p.name} (${p.capacity || "?"})`)
+    )
+  );
+  const slot = document.getElementById("configurations-slot");
+  const options = pds.poddefaults || [];
+  slot.classList.toggle("muted", !options.length);
+  slot.replaceChildren(
+    options.length
+      ? options.map((pd) =>
+          el(
+            "label",
+            { style: { display: "inline-flex", gap: "6px", marginRight: "14px" } },
+            el("input", {
+              type: "checkbox",
+              name: "configuration",
+              value: pd.label,
+              style: { width: "auto" },
+            }),
+            pd.desc || pd.label
+          )
+        )
+      : "none available"
+  );
+}
+
 async function loadCatalogs() {
   const [tpus, config] = await Promise.all([api("api/tpus"), api("api/config")]);
   tpuCatalog = tpus.tpus;
@@ -313,6 +351,21 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
       topology: form.get("tpu-topo"),
     };
   }
+  if (!form.get("workspace")) payload.workspaceVolume = null;
+  if (form.get("dataVolume")) {
+    payload.dataVolumes = [
+      {
+        existingSource: {
+          persistentVolumeClaim: { claimName: form.get("dataVolume") },
+        },
+      },
+    ];
+  }
+  payload.shm = !!form.get("shm");
+  const configurations = [
+    ...ev.target.querySelectorAll('input[name="configuration"]:checked'),
+  ].map((box) => box.value);
+  if (configurations.length) payload.configurations = configurations;
   api(`api/namespaces/${ns.get()}/notebooks`, {
     method: "POST",
     body: JSON.stringify(payload),
@@ -323,8 +376,12 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
   }, showError);
 });
 
-document
-  .getElementById("ns-slot")
-  .append(namespacePicker(() => tablePoller.refresh()));
+document.getElementById("ns-slot").append(
+  namespacePicker(() => {
+    tablePoller.refresh();
+    loadNamespaceCatalogs().catch(() => {});
+  })
+);
 loadCatalogs().catch(showError);
+loadNamespaceCatalogs().catch(() => {});
 tablePoller = poll(refresh);
