@@ -43,10 +43,13 @@ def create_app(
     links: list[dict] | None = None,
     registration_flow: bool = True,
     metrics_service=None,
+    kfam_client=None,
+    cluster_admins: set[str] | None = None,
     **kwargs,
 ) -> web.Application:
     import os
 
+    from kubeflow_tpu.web.dashboard.kfam import HttpKfam, InProcessKfam
     from kubeflow_tpu.web.dashboard.metrics import metrics_service_from_env
 
     app = create_base_app(kube, **kwargs)
@@ -55,13 +58,22 @@ def create_app(
     app["metrics_service"] = metrics_service or metrics_service_from_env(
         dict(os.environ)
     )
+    # KFAM boundary (reference PROFILES_KFAM_SERVICE_HOST): HTTP hop when a
+    # split KFAM deployment is configured, in-process otherwise.
+    kfam_url = os.environ.get("KFAM_URL")
+    app["kfam"] = kfam_client or (
+        HttpKfam(kfam_url) if kfam_url
+        else InProcessKfam(kube, cluster_admins=cluster_admins)
+    )
     app.add_routes(routes)
     add_spa(app, __file__)
 
-    async def _close_metrics(app):
+    async def _close_clients(app):
         await app["metrics_service"].close()
+        if hasattr(app["kfam"], "close"):
+            await app["kfam"].close()
 
-    app.on_cleanup.append(_close_metrics)
+    app.on_cleanup.append(_close_clients)
     return app
 
 
@@ -133,6 +145,39 @@ async def create_workgroup(request):
     name = user.split("@")[0].replace(".", "-").lower()
     await kube.create("Profile", profileapi.new(name, user))
     return json_success({"message": f"Created namespace {name}"})
+
+
+@routes.get("/api/workgroup/get-contributors/{namespace}")
+async def get_contributors(request):
+    """Reference api_workgroup.ts get-contributors/:namespace."""
+    kfam, user = request.app["kfam"], request.get("user", "")
+    namespace = request.match_info["namespace"]
+    users = await kfam.list_contributors(user, namespace)
+    return json_success({"contributors": users})
+
+
+@routes.post("/api/workgroup/add-contributor/{namespace}")
+async def add_contributor(request):
+    """Reference api_workgroup.ts add-contributor/:namespace."""
+    kfam, user = request.app["kfam"], request.get("user", "")
+    namespace = request.match_info["namespace"]
+    body = await request.json()
+    await kfam.add_contributor(user, namespace, body.get("contributor", ""))
+    return json_success(
+        {"contributors": await kfam.list_contributors(user, namespace)}
+    )
+
+
+@routes.delete("/api/workgroup/remove-contributor/{namespace}")
+async def remove_contributor(request):
+    """Reference api_workgroup.ts remove-contributor/:namespace."""
+    kfam, user = request.app["kfam"], request.get("user", "")
+    namespace = request.match_info["namespace"]
+    body = await request.json()
+    await kfam.remove_contributor(user, namespace, body.get("contributor", ""))
+    return json_success(
+        {"contributors": await kfam.list_contributors(user, namespace)}
+    )
 
 
 @routes.get("/api/dashboard-links")

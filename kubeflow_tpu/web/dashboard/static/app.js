@@ -74,6 +74,78 @@ async function loadMetrics() {
   }
 }
 
+function openContributors(n) {
+  /* Manage-contributors drawer (the reference dashboard's manage-users
+   * view over KFAM bindings). Only owners can mutate; others see a 403
+   * surfaced in the list area. */
+  const drawer = KF.drawer(`Contributors — ${n.namespace}`);
+  const list = el("div", {}, "Loading…");
+  const emailInput = el("input", {
+    placeholder: "someone@example.com",
+    style: { width: "260px" },
+  });
+  async function load() {
+    try {
+      const body = await api(
+        `api/workgroup/get-contributors/${n.namespace}`
+      );
+      list.replaceChildren(
+        body.contributors.length
+          ? el(
+              "ul",
+              {},
+              body.contributors.map((email) =>
+                el(
+                  "li",
+                  { style: { marginBottom: "6px" } },
+                  email + " ",
+                  KF.actionButton("Remove", () =>
+                    api(
+                      `api/workgroup/remove-contributor/${n.namespace}`,
+                      {
+                        method: "DELETE",
+                        body: JSON.stringify({ contributor: email }),
+                      }
+                    ).then(load, KF.showError)
+                  , { class: "danger" })
+                )
+              )
+            )
+          : el("p", { class: "muted" }, "No contributors yet.")
+      );
+    } catch (err) {
+      list.replaceChildren(el("p", { class: "muted" }, err.message));
+    }
+  }
+  drawer.content.append(
+    el("p", { class: "muted" },
+      "Contributors get edit access to every app in this namespace."),
+    list,
+    el(
+      "div",
+      { style: { display: "flex", gap: "8px", marginTop: "12px" } },
+      emailInput,
+      el(
+        "button",
+        {
+          class: "primary",
+          onclick: () =>
+            api(`api/workgroup/add-contributor/${n.namespace}`, {
+              method: "POST",
+              body: JSON.stringify({ contributor: emailInput.value }),
+            }).then(() => {
+              emailInput.value = "";
+              KF.snackbar("Contributor added");
+              load();
+            }, KF.showError),
+        },
+        "Add"
+      )
+    )
+  );
+  load();
+}
+
 async function refresh() {
   const info = await api("api/workgroup/env-info");
   document.getElementById("user-slot").textContent = info.user;
@@ -101,6 +173,13 @@ async function refresh() {
         sortKey: (n) => n.namespace,
       },
       { title: "Role", render: (n) => n.role },
+      {
+        title: "Contributors",
+        render: (n) =>
+          n.role === "owner"
+            ? KF.actionButton("Manage", () => openContributors(n))
+            : "—",
+      },
     ],
     info.namespaces,
     { emptyText: "No namespaces yet — register a workgroup below." }

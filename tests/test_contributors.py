@@ -1,0 +1,124 @@
+"""Dashboard contributor management over the KFAM boundary.
+
+Reference: centraldashboard api_workgroup.ts get-contributors /
+add-contributor / remove-contributor, which the Angular manage-users view
+drives. Covers both drivers: in-process (single controller-manager shape)
+and the HTTP hop against a live KFAM app (split deployment shape).
+"""
+
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.api import profile as profileapi
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.web.dashboard import create_app as create_dashboard
+from kubeflow_tpu.web.dashboard.kfam import HttpKfam
+from kubeflow_tpu.web.kfam import create_app as create_kfam
+
+ALICE = {"kubeflow-userid": "alice@example.com"}
+BOB = {"kubeflow-userid": "bob@example.com"}
+
+
+async def start(app, clients):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    clients.append(client)
+    return client
+
+
+async def csrf(client, headers):
+    resp = await client.get("/api/dashboard-links", headers=headers)
+    await resp.release()
+    token = client.session.cookie_jar.filter_cookies(
+        client.make_url("/")).get("XSRF-TOKEN")
+    return {**headers, "X-XSRF-TOKEN": token.value if token else ""}
+
+
+async def test_contributor_lifecycle_in_process():
+    kube = FakeKube()
+    await kube.create("Profile", profileapi.new("team", "alice@example.com"))
+    clients = []
+    try:
+        dash = await start(create_dashboard(kube), clients)
+        headers = await csrf(dash, ALICE)
+
+        resp = await dash.post(
+            "/api/workgroup/add-contributor/team",
+            json={"contributor": "bob@example.com"},
+            headers=headers,
+        )
+        body = json.loads(await resp.text())
+        assert resp.status == 200, body
+        assert body["contributors"] == ["bob@example.com"]
+
+        # The binding is a real RoleBinding KFAM/web authz understand.
+        rbs = await kube.list("RoleBinding", "team")
+        assert any(
+            rb["metadata"]["annotations"]["user"] == "bob@example.com"
+            for rb in rbs
+        )
+
+        # Non-owner cannot manage (403), and bad emails are rejected (422).
+        bob_headers = await csrf(dash, BOB)
+        resp = await dash.post(
+            "/api/workgroup/add-contributor/team",
+            json={"contributor": "eve@example.com"},
+            headers=bob_headers,
+        )
+        assert resp.status == 403
+        resp = await dash.post(
+            "/api/workgroup/add-contributor/team",
+            json={"contributor": "not-an-email"},
+            headers=headers,
+        )
+        assert resp.status == 422
+
+        resp = await dash.delete(
+            "/api/workgroup/remove-contributor/team",
+            json={"contributor": "bob@example.com"},
+            headers=headers,
+        )
+        body = json.loads(await resp.text())
+        assert resp.status == 200 and body["contributors"] == []
+    finally:
+        for c in clients:
+            await c.close()
+
+
+async def test_contributor_lifecycle_over_http_kfam():
+    """Split deployment: the dashboard drives KFAM over HTTP with the
+    caller identity forwarded, so KFAM's own authz applies."""
+    kube = FakeKube()
+    await kube.create("Profile", profileapi.new("team", "alice@example.com"))
+    clients = []
+    try:
+        kfam_app = create_kfam(kube, csrf_protect=False)
+        kfam = await start(kfam_app, clients)
+        kfam_url = str(kfam.make_url("")).rstrip("/")
+
+        dash = await start(
+            create_dashboard(kube, kfam_client=HttpKfam(kfam_url)), clients
+        )
+        headers = await csrf(dash, ALICE)
+
+        resp = await dash.post(
+            "/api/workgroup/add-contributor/team",
+            json={"contributor": "bob@example.com"},
+            headers=headers,
+        )
+        body = json.loads(await resp.text())
+        assert resp.status == 200, body
+        assert body["contributors"] == ["bob@example.com"]
+
+        # KFAM's authz (not the dashboard's) rejects the non-owner.
+        bob_headers = await csrf(dash, BOB)
+        resp = await dash.post(
+            "/api/workgroup/add-contributor/team",
+            json={"contributor": "eve@example.com"},
+            headers=bob_headers,
+        )
+        assert resp.status in (403, 422, 500) and resp.status != 200
+    finally:
+        for c in clients:
+            await c.close()
