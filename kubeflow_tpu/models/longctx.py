@@ -19,6 +19,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models.burnin import _rmsnorm
 from kubeflow_tpu.parallel.ring import ring_attention
+from kubeflow_tpu.parallel.ulysses import ulysses_attention
+
+# Sequence-parallel attention strategies (SURVEY.md: "ring attention or
+# all-to-all sequence/context parallelism" are both first-class). Ring
+# bounds memory at O((S/P)^2) with P neighbor hops; ulysses does two
+# all-to-alls and exact full-sequence softmax per H/P heads. Pick per
+# config: extreme contexts -> ring, enough heads + mid contexts -> ulysses.
+ATTENTION_STRATEGIES = {
+    "ring": ring_attention,
+    "ulysses": ulysses_attention,
+}
 
 
 @dataclass(frozen=True)
@@ -30,6 +41,7 @@ class LongContextConfig:
     d_ff: int = 512
     seq_len: int = 1024          # the point: long S, sharded S/P per chip
     dtype: str = "bfloat16"
+    attention: str = "ring"      # "ring" | "ulysses" (ATTENTION_STRATEGIES)
 
     @property
     def head_dim(self) -> int:
@@ -76,7 +88,8 @@ def forward(params: dict, tokens: jax.Array, cfg: LongContextConfig,
         def heads(t):
             return t.reshape(b, s, cfg.n_heads, cfg.head_dim)
 
-        ctx = ring_attention(heads(q), heads(k), heads(v), mesh, seq_axis)
+        attn = ATTENTION_STRATEGIES[cfg.attention]
+        ctx = attn(heads(q), heads(k), heads(v), mesh, seq_axis)
         ctx = ctx.reshape(b, s, cfg.d_model)
         x = x + ctx @ layer["attn_out"].astype(dtype)
         h = _rmsnorm(x, layer["ln2"])

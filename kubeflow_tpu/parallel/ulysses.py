@@ -1,0 +1,92 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second long-context strategy next to ring attention
+(``parallel/ring.py``): instead of rotating K/V blocks around the ICI ring,
+attention *heads* are exchanged for *sequence* shards with two all-to-alls
+(public DeepSpeed-Ulysses pattern, PAPERS.md):
+
+    [b, S/P, H, d]  --a2a-->  [b, S, H/P, d]      (heads scatter, seq gather)
+    full-sequence attention on H/P local heads    (exact softmax, no ring)
+    [b, S, H/P, d]  --a2a-->  [b, S/P, H, d]      (seq scatter, heads gather)
+
+Trade-offs vs ring, honestly reflected in when each is the right default:
+Ulysses does O(1) collective rounds (two all-to-alls) and computes exact
+attention with plain XLA-fused matmuls, but requires heads % P == 0 and
+materializes full-sequence attention scores per device — peak activation
+O(S²·H/P). Ring keeps memory at O((S/P)²) with P neighbor hops. Short/mid
+contexts with enough heads → Ulysses; extreme contexts → ring. Both run on
+the same mesh axes, so callers can switch per layer.
+
+Implementation is original; ``jax.lax.all_to_all`` lowers onto ICI
+all-to-all (a first-class collective on TPU tori).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _a2a(x, axis_name: str, scatter_dim: int, gather_dim: int):
+    """all_to_all with the manual-mode convention used inside shard_map:
+    scatter ``scatter_dim`` across the axis, concatenate ``gather_dim``."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=scatter_dim, concat_axis=gather_dim,
+        tiled=True,
+    )
+
+
+def ulysses_attention_local(q, k, v, axis_name: str):
+    """Per-shard exact causal attention via two all-to-alls.
+
+    Args: q/k/v ``[batch, s_local, heads, head_dim]`` with heads divisible
+    by the axis size. Call inside ``shard_map``; returns the same shape.
+    """
+    p = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    if h % p:
+        raise ValueError(
+            f"ulysses needs heads % shards == 0, got {h} heads / {p} shards"
+        )
+
+    # [b, S/P, H, d] -> [b, S, H/P, d]: scatter heads (dim 2), gather seq
+    # (dim 1). After this every device holds the FULL sequence for its
+    # H/P heads, so causal attention is exact with a plain mask.
+    q, k, v = (_a2a(t, axis_name, 2, 1) for t in (q, k, v))
+
+    s_full = s_local * p
+    scale = 1.0 / (d ** 0.5)
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    )
+    mask = jnp.tril(jnp.ones((s_full, s_full), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    # [b, S, H/P, d] -> [b, S/P, H, d]: scatter seq back, gather heads.
+    return _a2a(out, axis_name, 1, 2)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "seq"):
+    """GSPMD entrypoint mirroring ``ring_attention``'s signature: q/k/v
+    ``[batch, seq, heads, head_dim]`` sequence-sharded over ``axis_name``;
+    other mesh axes shard batch."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    data_axes = tuple(n for n in mesh.axis_names if n != axis_name)
+    batch_spec = data_axes[0] if len(data_axes) == 1 else (data_axes or None)
+    spec = P(batch_spec if data_axes else None, axis_name, None, None)
+    return shard_map(
+        partial(ulysses_attention_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
