@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Conformance runner: 8 checks, one JSON line each + a summary line.
+"""Conformance runner: 12 checks, one JSON line each + a summary line.
 
 Hermetic by default (in-process fake cluster + controllers); ``--live``
 targets the current kubeconfig/proxy endpoint instead and skips the checks
@@ -192,6 +192,82 @@ class Conformance:
         self.sim.failure_injector = None
 
 
+    async def check_version_conversion(self):
+        """Old served apiVersions reconcile like v1 (VERDICT r1 gap #4)."""
+        nb = nbapi.new("conf-beta", NS)
+        nb["apiVersion"] = "kubeflow.org/v1beta1"
+        await self.kube.create("Notebook", nb)
+        await self.settle()
+        stored = await self.kube.get("Notebook", "conf-beta", NS)
+        assert stored["apiVersion"] == nbapi.STORAGE_API_VERSION, (
+            f"not normalized: {stored['apiVersion']}")
+        assert await self.kube.get_or_none("StatefulSet", "conf-beta", NS), (
+            "v1beta1 CR did not reconcile")
+
+    async def check_event_hygiene(self):
+        """Events predating the CR are invisible to the status machine."""
+        from kubeflow_tpu.web.common.status import filter_events, process_status
+
+        nb = nbapi.new("conf-ev", NS)
+        nb["metadata"]["creationTimestamp"] = "2026-01-02T00:00:00Z"
+        stale = [{"type": "Warning", "message": "old crash",
+                  "lastTimestamp": "2026-01-01T00:00:00Z"}]
+        assert filter_events(nb, stale) == []
+        assert "old crash" not in process_status(nb, stale).message
+
+    async def check_contributor_authz(self):
+        """KFAM binding grants access through SAR; strangers are denied."""
+        if self.mgr is None:
+            raise Skip("live clusters bring their own RBAC")
+        from kubeflow_tpu.testing.rbac import register_sar_evaluator
+        from kubeflow_tpu.web.common.auth import SarAuthorizer
+        from kubeflow_tpu.web.dashboard.kfam import InProcessKfam
+
+        register_sar_evaluator(self.kube)
+        await self.kube.create(
+            "Profile", profileapi.new("conf-authz", "owner@example.com"))
+        await self.settle()
+        kfam = InProcessKfam(self.kube)
+        await kfam.add_contributor(
+            "owner@example.com", "conf-authz", "friend@example.com")
+        authz = SarAuthorizer(self.kube)
+        assert await authz.check(
+            "friend@example.com", "list", "Notebook", "conf-authz")
+        assert not await authz.check(
+            "stranger@example.com", "list", "Notebook", "conf-authz")
+        await kfam.remove_contributor(
+            "owner@example.com", "conf-authz", "friend@example.com")
+        assert not await authz.check(
+            "friend@example.com", "list", "Notebook", "conf-authz")
+
+    async def check_sidecar_isolation(self):
+        """A sidecar crash must NOT trigger the slice-atomic restart."""
+        if self.sim is None:
+            raise Skip("needs the simulator's fault injection")
+        from kubeflow_tpu.controllers.notebook import AUTH_PROXY_ANNOTATION
+
+        def injector(pod):
+            if get_meta(pod)["name"] == "conf-side-1":
+                return "crash:auth-proxy"
+            return None
+
+        self.sim.failure_injector = injector
+        nb = nbapi.new("conf-side", NS, accelerator="v5e", topology="4x4")
+        nb["metadata"].setdefault("annotations", {})[
+            AUTH_PROXY_ANNOTATION] = "true"
+        await self.kube.create("Notebook", nb)
+        await self.settle()
+        await self.settle()
+        events = await self.kube.list("Event", NS)
+        slice_restarts = [
+            e for e in events
+            if e.get("reason") == "SliceRestart"
+            and "conf-side" in str(e.get("involvedObject", {}).get("name"))
+        ]
+        assert not slice_restarts, "sidecar crash restarted the slice"
+        self.sim.failure_injector = None
+
+
 async def run(live: bool) -> int:
     if live:
         from kubeflow_tpu.runtime.httpclient import HttpKube
@@ -203,10 +279,16 @@ async def run(live: bool) -> int:
         from kubeflow_tpu.testing.podsim import PodSimulator
         from kubeflow_tpu.webhooks import register_all
 
+        from kubeflow_tpu.controllers.notebook import NotebookOptions
+
         kube = FakeKube()
         register_all(kube)
         mgr = Manager(kube)
-        setup_notebook_controller(mgr)
+        # auth_proxy_image on so the sidecar-isolation check exercises a
+        # really-injected sidecar, not a no-op.
+        setup_notebook_controller(
+            mgr, NotebookOptions(auth_proxy_image="authproxy:conformance")
+        )
 
         class OffsetClock:
             def __init__(self):
@@ -240,6 +322,10 @@ async def run(live: bool) -> int:
     await conf.check("tensorboard-pvcviewer", conf.check_tensorboard_pvcviewer)
     await conf.check("culling", conf.check_culling)
     await conf.check("slice-atomic-restart", conf.check_slice_restart)
+    await conf.check("version-conversion", conf.check_version_conversion)
+    await conf.check("event-hygiene", conf.check_event_hygiene)
+    await conf.check("contributor-authz", conf.check_contributor_authz)
+    await conf.check("sidecar-restart-isolation", conf.check_sidecar_isolation)
 
     passed = sum(1 for r in conf.results if r["pass"])
     print(json.dumps({"summary": f"{passed}/{len(conf.results)} checks passed"}))
