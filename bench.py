@@ -27,10 +27,13 @@ MFU_TARGET = 0.40
 COLDSTART_TARGET_SEC = 60.0
 
 # Scaled so the steady-state step is MXU-bound, not overhead-bound.
+# seq_len 1025: the loss trains on tokens[:, :-1], and the flash kernel
+# wants the trained length (1024) divisible by its 128-row blocks.
 BENCH_BATCH = 8
 BENCH_STEPS = 100
 BENCH_MODEL = dict(
-    vocab=8192, d_model=2048, n_heads=16, n_layers=4, d_ff=8192, seq_len=1024
+    vocab=8192, d_model=2048, n_heads=16, n_layers=4, d_ff=8192,
+    seq_len=1025, attention="flash",
 )
 
 
@@ -85,7 +88,10 @@ def train_step_flops(cfg, batch: int) -> float:
         + 2 * d * ff        # ff1
         + 2 * ff * d        # ff2
     )
-    per_layer_attn = 4 * batch * s * s * d  # scores + context einsums
+    # Causal convention: the model needs s²/2 of the score/context
+    # matmuls, so credit 2·b·s²·d per layer (the flash kernel computes
+    # exactly this; the dense XLA path computes 2× and gets no credit).
+    per_layer_attn = 2 * batch * s * s * d
     fwd = (
         batch * s * (cfg.n_layers * per_token_layer + 2 * d * v)  # + lm head
         + cfg.n_layers * per_layer_attn

@@ -28,6 +28,10 @@ class BurninConfig:
     d_ff: int = 512
     seq_len: int = 128
     dtype: str = "bfloat16"
+    # "xla": plain einsum attention (GSPMD-shardable, any shape).
+    # "flash": the pallas fused kernel (kubeflow_tpu.ops.flash_attention) —
+    # no [S, S] logits in HBM; needs seq % 128 == 0 and head_dim % 128 == 0.
+    attention: str = "xla"
 
     @property
     def head_dim(self) -> int:
@@ -95,6 +99,16 @@ def _attention(x, layer, cfg: BurninConfig):
     b, s, d = x.shape
     qkv = x @ layer["qkv"].astype(x.dtype)            # [b, s, 3d] — MXU
     q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    if cfg.attention == "flash":
+        from kubeflow_tpu.ops import flash_attention
+
+        def heads_bshd(t):
+            return t.reshape(b, s, cfg.n_heads, cfg.head_dim)
+
+        ctx = flash_attention(heads_bshd(q), heads_bshd(k), heads_bshd(v))
+        ctx = ctx.reshape(b, s, d)
+        return ctx @ layer["attn_out"].astype(x.dtype)
 
     def heads(t):
         return t.reshape(b, s, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)

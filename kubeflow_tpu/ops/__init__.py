@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The compute path is jax/XLA; these kernels cover the ops where XLA's
+default lowering leaves HBM bandwidth on the table (SURVEY.md's "pallas
+for the rest"). Today: fused causal flash attention (fwd + bwd).
+"""
+
+from kubeflow_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,311 @@
+"""Fused causal flash attention — pallas TPU kernels, fwd + bwd.
+
+Why: XLA's default attention materializes the [S, S] logits in HBM
+(f32 scores + probabilities read and written per layer — the dominant
+bandwidth term of the burn-in transformer at S ≥ 1k). The flash schedule
+streams K/V blocks through VMEM with an online softmax, so HBM traffic
+drops from O(S²) to O(S·d) per head, which is what the MXU needs to stay
+fed (pallas_guide.md: HBM→VMEM→MXU).
+
+Original implementation of the public flash-attention-2 algorithm
+(PAPERS.md): forward saves per-row logsumexp; backward recomputes block
+scores and accumulates dq over K blocks and dk/dv over Q blocks in two
+kernels, with the standard delta = rowsum(do·o) trick.
+
+Layout contract: q/k/v are ``[batch*heads, seq, head_dim]`` inside the
+kernels; the public wrapper takes the model's ``[batch, seq, heads, dim]``
+and folds. Row/column blocks are 128 (MXU-shaped); seq must divide by the
+block size (the burn-in/longctx configs do; pad upstream otherwise).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30
+# Swept on a real v5e chip (sync via value fetch — block_until_ready is
+# unreliable through remote relays): 1024/1024 (capped at seq) beat XLA's
+# attention 1.6x at S=1024 and 3x at S=4096 for the fused fwd+bwd step;
+# the f32 p block [1024, 1024] (4 MB) + acc still fit VMEM comfortably.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
+def _dot(a, b, trans_b=False):
+    """MXU matmul with f32 accumulation."""
+    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+
+
+def _dot_ta(a, b):
+    """aᵀ @ b without materializing the transpose (contract dim 0 of both
+    operands — the MXU takes either orientation; an explicit .T costs a
+    VPU shuffle per tile)."""
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _causal_mask(q_start, k_start, bq, bk):
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return cols <= rows
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, bq, bk, nk, causal):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    q_start, k_start = qi * bq, ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Blocks strictly above the diagonal are fully masked — skip their
+    # compute entirely (half the work for causal attention).
+    live = (k_start <= q_start + bq - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0]
+        s = _dot(q, k_ref[0], trans_b=True) * scale          # [bq, bk] f32
+        if causal:
+            s = jnp.where(_causal_mask(q_start, k_start, bq, bk), s, _NEG_BIG)
+        m_prev = m_scr[:, :1]                                # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                               # [bq, bk] f32
+        corr = jnp.exp(m_prev - m_new)                       # [bq, 1]
+        l_scr[:, :1] = l_scr[:, :1] * corr + p.sum(axis=1, keepdims=True)
+        m_scr[:, :1] = m_new
+        acc_scr[:] = acc_scr[:] * corr + _dot(
+            p.astype(v_ref.dtype), v_ref[0]
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # lse kept in an (8, bq) sublane-replicated layout: TPU block specs
+        # need the trailing dims tiled (8, 128); column vectors are not.
+        lse_ref[0] = jnp.broadcast_to((m_scr[:, :1] + jnp.log(l)).T, (8, lse_ref.shape[2]))
+
+
+def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    bq, bk = min(block_q, s), min(block_k, s)
+    nq, nk = s // bq, s // bk
+    grid = (bh, nq, nk)
+    out, lse = pl.pallas_call(
+        partial(_fwd_kernel, scale=scale, bq=bq, bk=bk, nk=nk, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, bq, bk, nk, causal):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    q_start, k_start = qi * bq, ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (k_start <= q_start + bq - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _body():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = _dot(q, k, trans_b=True) * scale
+        if causal:
+            s = jnp.where(_causal_mask(q_start, k_start, bq, bk), s, _NEG_BIG)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])               # [bq, bk]
+        dp = _dot(do, v, trans_b=True)                        # [bq, bk] f32
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dq_scr[:] = dq_scr[:] + _dot(ds.astype(k.dtype), k) * scale
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, bq, bk, nq, causal):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    q_start, k_start = qi * bq, ki * bk
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = (q_start + bq - 1 >= k_start) if causal else (qi >= 0)
+
+    @pl.when(live)
+    def _body():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = _dot(q, k, trans_b=True) * scale                  # [bq, bk]
+        if causal:
+            s = jnp.where(_causal_mask(q_start, k_start, bq, bk), s, _NEG_BIG)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        dv_scr[:] = dv_scr[:] + _dot_ta(p.astype(do.dtype), do)
+        dp = _dot(do, v, trans_b=True)
+        ds = (p * (dp - delta_ref[0, 0][:, None])).astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + _dot_ta(ds, q) * scale
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+    q, k, v, out, lse = res
+    bh, s, d = q.shape
+    bq, bk = min(block_q, s), min(block_k, s)
+    nq, nk = s // bq, s // bk
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # Same sublane-replicated (8, s) layout as lse (tiling constraint).
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, s))
+
+    common_in = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),      # q by qi
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),      # k by ki
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),      # v by ki
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),      # do by qi
+        pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),      # lse by qi
+        pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),      # delta by qi
+    ]
+    dq = pl.pallas_call(
+        partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk,
+                causal=causal),
+        grid=(bh, nq, nk),
+        in_specs=common_in,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dk/dv: grid walks (bh, ki, qi) — K block resident, Q blocks stream.
+    dkv_in = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, 8, bq), lambda b, j, i: (b, 0, i)),
+        pl.BlockSpec((1, 8, bq), lambda b, j, i: (b, 0, i)),
+    ]
+    dk, dv = pl.pallas_call(
+        partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq,
+                causal=causal),
+        grid=(bh, nk, nq),
+        in_specs=dkv_in,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool | None = None):
+    """Fused causal attention. q/k/v ``[batch, seq, heads, head_dim]``.
+
+    ``interpret=None`` auto-selects pallas interpreter mode off-TPU so the
+    same model code runs in CPU tests and on chips.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    block_q, block_k = min(block_q, s), min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq {s} must divide by blocks {block_q}/{block_k}")
+
+    def fold(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash(fold(q), fold(k), fold(v), scale, causal, block_q, block_k,
+                 interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
