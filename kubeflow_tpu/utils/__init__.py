@@ -1,0 +1,8 @@
+"""In-notebook utilities that complete the product story around the
+control plane: checkpoint/resume (Orbax) for the workloads the notebooks
+run. The controllers stay unchanged — persistence is PVCs + object
+storage (SURVEY.md §5 checkpoint/resume)."""
+
+from kubeflow_tpu.utils.checkpoint import CheckpointManager
+
+__all__ = ["CheckpointManager"]

@@ -1,0 +1,58 @@
+"""Checkpoint/resume helper over Orbax (SURVEY.md §5): save/restore
+round-trip, latest-step discovery, retention, and sharded restore on the
+virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.utils import CheckpointManager
+
+
+def params():
+    return {
+        "w": jnp.arange(16.0).reshape(4, 4),
+        "layers": [{"b": jnp.ones((8,))}],
+        "step_scale": jnp.float32(0.5),
+    }
+
+
+def test_save_restore_roundtrip(tmp_path):
+    p = params()
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        assert mgr.latest_step() is None
+        mgr.save(0, p)
+        mgr.save(5, jax.tree.map(lambda x: x + 1, p))
+        mgr.wait()
+        assert mgr.latest_step() == 5
+        back = mgr.restore()  # latest
+        np.testing.assert_array_equal(np.asarray(back["w"]),
+                                      np.asarray(p["w"]) + 1)
+        back0 = mgr.restore(0)
+        np.testing.assert_array_equal(np.asarray(back0["w"]),
+                                      np.asarray(p["w"]))
+
+
+def test_retention_keeps_last_n(tmp_path):
+    with CheckpointManager(str(tmp_path / "ckpt"), keep=2) as mgr:
+        for step in range(5):
+            mgr.save(step, params())
+        mgr.wait()
+        steps = mgr.manager.all_steps()
+        assert max(steps) == 4 and len(steps) <= 2
+
+
+def test_sharded_restore_places_on_mesh(tmp_path):
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    x = jax.device_put(jnp.arange(32.0), sharding)
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        mgr.save(1, {"x": x})
+        mgr.wait()
+        abstract = {
+            "x": jax.ShapeDtypeStruct((32,), jnp.float32, sharding=sharding)
+        }
+        back = mgr.restore(1, abstract=abstract)
+    assert back["x"].sharding == sharding
+    np.testing.assert_array_equal(np.asarray(back["x"]), np.arange(32.0))
