@@ -136,7 +136,17 @@ def create_base_app(
     async def metrics(_request):
         return web.Response(text=registry.expose(), content_type="text/plain")
 
+    async def namespaces(_request):
+        """Common to every app (reference crud_backend/routes/get.py:10-15):
+        namespace names for the UI's picker."""
+        names = sorted(
+            (ns.get("metadata") or {}).get("name", "")
+            for ns in await kube.list("Namespace")
+        )
+        return json_success({"namespaces": [n for n in names if n]})
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", healthz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/api/namespaces", namespaces)
     return app

@@ -425,6 +425,19 @@ KF.namespacePicker = function (onChange) {
     style: { width: "180px" },
     list: "kf-ns-options",
   });
+  // Datalist fed from the common /api/namespaces route: free text still
+  // works (multi-tenant users may lack list-namespace rights).
+  if (!document.getElementById("kf-ns-options")) {
+    const datalist = KF.el("datalist", { id: "kf-ns-options" });
+    document.body.append(datalist);
+    KF.api("api/namespaces")
+      .then((body) =>
+        datalist.replaceChildren(
+          ...body.namespaces.map((name) => KF.el("option", { value: name }))
+        )
+      )
+      .catch(() => {});
+  }
   input.addEventListener("change", () => {
     KF.ns.set(input.value);
     onChange(input.value);

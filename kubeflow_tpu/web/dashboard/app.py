@@ -147,6 +147,23 @@ async def create_workgroup(request):
     return json_success({"message": f"Created namespace {name}"})
 
 
+@routes.delete("/api/workgroup/nuke-self")
+async def nuke_self(request):
+    """Self-serve deregistration (reference api_workgroup.ts nuke-self):
+    delete every profile the caller owns; cascade removes the namespaces."""
+    kube, user = request.app["kube"], request.get("user", "")
+    from kubeflow_tpu.api import profile as papi
+
+    deleted = []
+    for profile in await kube.list("Profile"):
+        if papi.owner_of(profile).get("name") == user:
+            await kube.delete("Profile", name_of(profile))
+            deleted.append(name_of(profile))
+    if not deleted:
+        raise Invalid(f"user {user!r} owns no profiles")
+    return json_success({"message": f"Deleted profiles: {', '.join(deleted)}"})
+
+
 @routes.get("/api/workgroup/get-contributors/{namespace}")
 async def get_contributors(request):
     """Reference api_workgroup.ts get-contributors/:namespace."""

@@ -86,6 +86,34 @@ async def test_contributor_lifecycle_in_process():
             await c.close()
 
 
+async def test_namespaces_route_and_nuke_self():
+    kube = FakeKube()
+    await kube.create("Profile", profileapi.new("team", "alice@example.com"))
+    await kube.create(
+        "Namespace",
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "team"}},
+    )
+    clients = []
+    try:
+        dash = await start(create_dashboard(kube), clients)
+        # Common /api/namespaces route (reference crud_backend get.py:10-15).
+        resp = await dash.get("/api/namespaces", headers=ALICE)
+        body = json.loads(await resp.text())
+        assert resp.status == 200 and "team" in body["namespaces"]
+
+        headers = await csrf(dash, ALICE)
+        resp = await dash.delete("/api/workgroup/nuke-self", headers=headers)
+        assert resp.status == 200, await resp.text()
+        assert await kube.get_or_none("Profile", "team") is None
+
+        # Nothing left to delete → 422, not silent success.
+        resp = await dash.delete("/api/workgroup/nuke-self", headers=headers)
+        assert resp.status == 422
+    finally:
+        for c in clients:
+            await c.close()
+
+
 async def test_contributor_lifecycle_over_http_kfam():
     """Split deployment: the dashboard drives KFAM over HTTP with the
     caller identity forwarded, so KFAM's own authz applies."""
