@@ -309,3 +309,120 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     out = _flash(fold(q), fold(k), fold(v), scale, causal, block_q, block_k,
                  interpret)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+# --------------------------------------------------- ring partial attention
+
+
+def _partial_kernel(offsets_ref, q_ref, k_ref, v_ref,
+                    o_ref, m_ref, l_ref,
+                    m_scr, l_scr, acc_scr, *, scale, bq, bk, nk):
+    """Block-partial attention for ring steps: global causal mask from the
+    scalar-prefetched (q_offset, k_offset); emits UN-normalized acc plus
+    the (m, l) softmax stats the ring carry folds across hops."""
+    ki = pl.program_id(2)
+    q_start = offsets_ref[0] + pl.program_id(1) * bq
+    k_start = offsets_ref[1] + ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # A fully-masked block (k entirely in this q's future) contributes
+    # nothing; skip its matmuls.
+    live = k_start <= q_start + bq - 1
+
+    @pl.when(live)
+    def _body():
+        s = _dot(q_ref[0], k_ref[0], trans_b=True) * scale
+        s = jnp.where(_causal_mask(q_start, k_start, bq, bk), s, _NEG_BIG)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = l_scr[:, :1] * corr + p.sum(axis=1, keepdims=True)
+        m_scr[:, :1] = m_new
+        acc_scr[:] = acc_scr[:] * corr + _dot(p.astype(v_ref.dtype), v_ref[0])
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[0] = acc_scr[:]
+        m_ref[0] = jnp.broadcast_to(m_scr[:, :1].T, (8, m_ref.shape[2]))
+        l_ref[0] = jnp.broadcast_to(l_scr[:, :1].T, (8, l_ref.shape[2]))
+
+
+def flash_attention_partial(q, k, v, q_offset, k_offset, *,
+                            scale: float | None = None,
+                            block_q: int = DEFAULT_BLOCK_Q,
+                            block_k: int = DEFAULT_BLOCK_K,
+                            vma=None,
+                            interpret: bool | None = None):
+    """One ring hop's attention block, flash-style (forward only).
+
+    q/k/v ``[batch, s_block, heads, head_dim]``; ``q_offset``/``k_offset``
+    are the blocks' global sequence starts (traced scalars are fine).
+    Returns ``(o_unnorm [b, s, h, d] f32, m [b, h, s] f32, l [b, h, s]
+    f32)`` — the exact online-softmax carry terms ring attention folds,
+    so the [s_block, s_block] logits never touch HBM. Not differentiable
+    (pallas has no autodiff); the training path keeps the einsum block.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    bq, bk = min(block_q, s), min(block_k, s)
+    if s % bq or s % bk:
+        raise ValueError(f"seq {s} must divide by blocks {bq}/{bk}")
+    nq, nk = s // bq, s // bk
+    bh = b * h
+
+    def fold(t):
+        return t.transpose(0, 2, 1, 3).reshape(bh, s, d)
+
+    offsets = jnp.asarray(
+        jnp.stack([jnp.int32(q_offset), jnp.int32(k_offset)]), jnp.int32
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j, offs: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, offs: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, offs: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j, offs: (b, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j, offs: (b, 0, i)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j, offs: (b, 0, i)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    o, m, l = pl.pallas_call(
+        partial(_partial_kernel, scale=scale, bq=bq, bk=bk, nk=nk),
+        grid_spec=grid_spec,
+        # Inside shard_map, outputs must declare their varying mesh axes
+        # (vma) for jax's manual-mode type checking.
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32,
+                                 vma=frozenset(vma or ())),
+            jax.ShapeDtypeStruct((bh, 8, s), jnp.float32,
+                                 vma=frozenset(vma or ())),
+            jax.ShapeDtypeStruct((bh, 8, s), jnp.float32,
+                                 vma=frozenset(vma or ())),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offsets, fold(q), fold(k), fold(v))
+    o = o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    m = m[:, 0, :].reshape(b, h, s)
+    l = l[:, 0, :].reshape(b, h, s)
+    return o, m, l

@@ -31,7 +31,8 @@ def _block_causal_mask(q_block: jax.Array, k_block: jax.Array, s_local: int):
     return k_pos <= q_pos
 
 
-def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None):
+def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None,
+                         block_impl: str = "xla"):
     """Per-shard causal ring attention. Call inside ``shard_map``.
 
     Args: q/k/v ``[batch, s_local, heads, head_dim]`` — this device's
@@ -63,19 +64,42 @@ def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None):
     def body(t, carry):
         k_t, v_t, m, l, o = carry
         src_block = (my_block - t) % n_shards
-        logits = (
-            jnp.einsum("bqhd,bkhd->bhqk", q, k_t).astype(jnp.float32) * scale
-        )
-        mask = _block_causal_mask(my_block, src_block, s_local)
-        logits = jnp.where(mask[None, None, :, :], logits, _NEG_BIG)
 
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        correction = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new[..., None])
-        l = l * correction + p.sum(axis=-1)
-        o = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(v_t.dtype), v_t
-        ).astype(jnp.float32)
+        if block_impl == "flash":
+            # Pallas partial-attention kernel: the [s_local, s_local]
+            # logits stay in VMEM (ops/flash_attention.py). Forward-only —
+            # pallas has no autodiff, so training uses the einsum path.
+            from kubeflow_tpu.ops.flash_attention import flash_attention_partial
+
+            o_blk, m_blk, l_blk = flash_attention_partial(
+                q, k_t, v_t, my_block * s_local, src_block * s_local,
+                scale=scale, vma=vary_axes,
+            )
+            m_blk = m_blk  # [b, h, s_local] f32
+            m_new = jnp.maximum(m, m_blk)
+            corr = jnp.exp(m - m_new)
+            corr_blk = jnp.exp(m_blk - m_new)
+            l = l * corr + l_blk * corr_blk
+            o = (
+                o * corr.transpose(0, 2, 1)[..., None]
+                + o_blk.astype(jnp.float32)
+                * corr_blk.transpose(0, 2, 1)[..., None]
+            )
+        else:
+            logits = (
+                jnp.einsum("bqhd,bkhd->bhqk", q, k_t).astype(jnp.float32)
+                * scale
+            )
+            mask = _block_causal_mask(my_block, src_block, s_local)
+            logits = jnp.where(mask[None, None, :, :], logits, _NEG_BIG)
+
+            m_new = jnp.maximum(m, logits.max(axis=-1))
+            correction = jnp.exp(m - m_new)
+            p = jnp.exp(logits - m_new[..., None])
+            l = l * correction + p.sum(axis=-1)
+            o = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                "bhqk,bkhd->bqhd", p.astype(v_t.dtype), v_t
+            ).astype(jnp.float32)
 
         # Rotate K/V to the next device; AFTER the matmul so XLA can overlap
         # the collective-permute with the next iteration's compute.
@@ -88,10 +112,13 @@ def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None):
     return (o / denom).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name: str = "seq"):
+def ring_attention(q, k, v, mesh, axis_name: str = "seq",
+                   block_impl: str = "xla"):
     """GSPMD entrypoint: q/k/v ``[batch, seq, heads, head_dim]`` with the
     seq dimension sharded over ``axis_name``; other mesh axes (data) shard
-    batch transparently."""
+    batch transparently. ``block_impl="flash"`` runs each hop's block
+    attention as the pallas partial kernel (forward-only; see
+    ring_attention_local)."""
     from jax.sharding import PartitionSpec as P
 
     try:
@@ -102,12 +129,18 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq"):
     data_axes = tuple(n for n in mesh.axis_names if n != axis_name)
     batch_spec = data_axes[0] if len(data_axes) == 1 else (data_axes or None)
     spec = P(batch_spec if data_axes else None, axis_name, None, None)
+    # check_vma off for the flash hop: the pallas kernel's scalar-prefetch
+    # offsets are device-varying, which jax's manual-mode varying-axes
+    # analysis can't express through interpret-mode slicing yet (the error
+    # message itself prescribes this workaround; numerics are unaffected).
+    kwargs = {"check_vma": False} if block_impl == "flash" else {}
     return shard_map(
         partial(ring_attention_local, axis_name=axis_name,
-                mesh_axes=tuple(mesh.axis_names)),
+                mesh_axes=tuple(mesh.axis_names), block_impl=block_impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **kwargs,
     )(q, k, v)
 
 

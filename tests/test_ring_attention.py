@@ -47,6 +47,19 @@ def test_ring_with_data_and_seq_axes():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_flash_blocks_match_reference():
+    """Ring with the pallas partial-attention hop (forward-only path)."""
+    mesh = seq_mesh(4)
+    q, k, v = rand_qkv(jax.random.key(7), 2, 512, 2, 128)
+    spec = NamedSharding(mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, block_impl="flash")
+    ref = reference_causal_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_ring_is_causal():
     """Changing a future token must not change earlier outputs."""
     mesh = seq_mesh(4)
