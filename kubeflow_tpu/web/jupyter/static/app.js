@@ -48,9 +48,33 @@ async function loadNamespaceCatalogs() {
   );
 }
 
+let spawnerConfig = {};
+
+// Reference spawner_ui_config: image options per server type (jupyter-like
+// NB_PREFIX images, vscode-like group-one, rstudio-like group-two).
+const IMAGE_KEY_BY_TYPE = {
+  jupyter: "image",
+  "group-one": "imageGroupOne",
+  "group-two": "imageGroupTwo",
+};
+
+function selectedServerType() {
+  const checked = document.querySelector('input[name="serverType"]:checked');
+  return checked ? checked.value : "jupyter";
+}
+
+function renderImageOptions() {
+  const key = IMAGE_KEY_BY_TYPE[selectedServerType()];
+  const images = (spawnerConfig[key] && spawnerConfig[key].options) || [];
+  document
+    .getElementById("image-select")
+    .replaceChildren(...images.map((img) => el("option", { value: img }, img)));
+}
+
 async function loadCatalogs() {
   const [tpus, config] = await Promise.all([api("api/tpus"), api("api/config")]);
   tpuCatalog = tpus.tpus;
+  spawnerConfig = config.config;
 
   const accSelect = document.getElementById("tpu-acc");
   // NB: replaceChildren stringifies arrays — always spread node lists.
@@ -61,11 +85,10 @@ async function loadCatalogs() {
   accSelect.addEventListener("change", renderTopologies);
   renderTopologies();
 
-  const imageSelect = document.getElementById("image-select");
-  const images = (config.config.image && config.config.image.options) || [];
-  imageSelect.replaceChildren(
-    ...images.map((img) => el("option", { value: img }, img))
-  );
+  for (const radio of document.querySelectorAll('input[name="serverType"]')) {
+    radio.addEventListener("change", renderImageOptions);
+  }
+  renderImageOptions();
 }
 
 function renderTopologies() {
@@ -85,8 +108,16 @@ function renderTopologies() {
 
 /* ---------------- details drawer ---------------------------------------- */
 
+let openDrawerFor = null;
+
 function openDetails(nb) {
   const name = nb.name;
+  if (openDrawerFor === name) return;
+  openDrawerFor = name;
+  // Deep-linkable (the reference's per-resource details route).
+  if (location.hash !== `#/notebook/${name}`) {
+    history.replaceState(null, "", `#/notebook/${name}`);
+  }
   const drawer = KF.drawer(`Notebook ${name}`);
   const tabHost = el("div", {});
   drawer.content.append(tabHost);
@@ -211,7 +242,31 @@ function openDetails(nb) {
       },
     },
   ]);
-  drawer.onclose = () => tabs.stop();
+  drawer.onclose = () => {
+    tabs.stop();
+    openDrawerFor = null;
+    if (location.hash.startsWith("#/notebook/")) {
+      history.replaceState(null, "", location.pathname);
+    }
+  };
+}
+
+function openDetailsFromHash() {
+  const match = location.hash.match(/^#\/notebook\/([a-z0-9-]+)$/);
+  if (!match) return;
+  api(`api/namespaces/${ns.get()}/notebooks/${match[1]}`)
+    .then((body) => {
+      const nb = body.notebook;
+      const containers =
+        (((nb.spec || {}).template || {}).spec || {}).containers || [{}];
+      openDetails({
+        name: match[1],
+        image: containers[0].image || "",
+        cpu: null,
+        memory: null,
+      });
+    })
+    .catch(() => {});
 }
 
 /* ---------------- list table -------------------------------------------- */
@@ -340,6 +395,7 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
   const form = new FormData(ev.target);
   const payload = {
     name: form.get("name"),
+    serverType: form.get("serverType") || "jupyter",
     cpu: form.get("cpu"),
     memory: form.get("memory"),
   };
@@ -385,3 +441,5 @@ document.getElementById("ns-slot").append(
 loadCatalogs().catch(showError);
 loadNamespaceCatalogs().catch(() => {});
 tablePoller = poll(refresh);
+openDetailsFromHash();
+window.addEventListener("hashchange", openDetailsFromHash);
