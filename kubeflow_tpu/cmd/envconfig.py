@@ -60,6 +60,9 @@ def profile_options():
         use_istio=env_bool("USE_ISTIO", False),
         userid_header=env_str("USERID_HEADER", "kubeflow-userid"),
         userid_prefix=env_str("USERID_PREFIX", ""),
+        # Reference: the ConfigMap-mounted, hot-reloaded labels file
+        # (profile_controller.go DefaultNamespaceLabelsPath).
+        namespace_labels_file=os.environ.get("NAMESPACE_LABELS_PATH"),
     )
 
 

@@ -109,6 +109,11 @@ class ProfileOptions:
             "app.kubernetes.io/part-of": "kubeflow-profile",
         }
     )
+    # Mounted-file override, hot-reloaded (reference: fsnotify on the
+    # ConfigMap-mounted labels file, profile_controller.go:368-399 +
+    # readDefaultLabelsFromFile :775-790). A flat YAML map; when set it
+    # REPLACES namespace_labels, and edits re-reconcile every Profile.
+    namespace_labels_file: str | None = None
     use_istio: bool = False
     userid_header: str = "kubeflow-userid"
     userid_prefix: str = ""
@@ -186,6 +191,32 @@ class ProfileReconciler:
                 {"metadata": {"finalizers": finalizers + [PROFILE_FINALIZER]}},
             )
 
+    def current_namespace_labels(self) -> dict:
+        """Static option, or the hot-reloaded mounted file when configured
+        (mtime-cached read; the setup-time watcher re-enqueues Profiles on
+        change, so edits converge without a restart)."""
+        path = self.opts.namespace_labels_file
+        if not path:
+            return dict(self.opts.namespace_labels)
+        import os
+
+        import yaml
+
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            return dict(self.opts.namespace_labels)
+        cached = getattr(self, "_labels_cache", None)
+        if cached and cached[0] == mtime:
+            return dict(cached[1])
+        with open(path) as fh:
+            labels = yaml.safe_load(fh) or {}
+        if not isinstance(labels, dict):
+            raise ValueError(f"{path}: namespace labels must be a flat map")
+        labels = {str(k): str(v) for k, v in labels.items()}
+        self._labels_cache = (mtime, labels)
+        return dict(labels)
+
     async def _reconcile_namespace(self, profile: dict) -> None:
         name = name_of(profile)
         owner = profileapi.owner_of(profile).get("name", "")
@@ -194,7 +225,7 @@ class ProfileReconciler:
             "kind": "Namespace",
             "metadata": {
                 "name": name,
-                "labels": dict(self.opts.namespace_labels),
+                "labels": self.current_namespace_labels(),
                 "annotations": {
                     profileapi.OWNER_ANNOTATION: owner,
                     "profile-name": name,
@@ -375,4 +406,26 @@ def setup_profile_controller(
     mgr.add_controller(
         Controller(name="profile", kind="Profile", reconcile=rec.reconcile)
     )
+    if rec.opts.namespace_labels_file:
+        # Reference parity: fsnotify on the mounted labels file triggers a
+        # reconcile of ALL profiles (profile_controller.go:368-399). Here a
+        # small mtime poller (ConfigMap symlink swaps change mtime too).
+        async def watch_labels_file():
+            import asyncio
+            import os
+
+            path = rec.opts.namespace_labels_file
+            last = None
+            while True:
+                try:
+                    mtime = os.stat(path).st_mtime_ns
+                except OSError:
+                    mtime = None
+                if last is not None and mtime != last:
+                    for profile in await mgr.kube.list("Profile"):
+                        mgr.enqueue("profile", (None, name_of(profile)))
+                last = mtime
+                await asyncio.sleep(2.0)
+
+        mgr.add_background(watch_labels_file)
     return rec

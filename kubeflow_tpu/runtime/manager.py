@@ -111,9 +111,21 @@ class Manager:
 
             inf.add_handler(mapped_handler)
 
+    def enqueue(self, controller_name: str, key) -> None:
+        """Externally enqueue a reconcile key (config watchers, tests)."""
+        self._queues[controller_name].add(tuple(key))
+
+    def add_background(self, coro_fn) -> None:
+        """Register an async task started with the manager (e.g. a mounted
+        config-file watcher that re-enqueues objects on change)."""
+        self._background_fns = getattr(self, "_background_fns", [])
+        self._background_fns.append(coro_fn)
+
     async def start(self) -> None:
         for informer in self.informers.values():
             await informer.start()
+        for fn in getattr(self, "_background_fns", []):
+            self._tasks.append(asyncio.create_task(fn(), name="background"))
         for ctrl in self.controllers:
             for i in range(ctrl.workers):
                 self._tasks.append(

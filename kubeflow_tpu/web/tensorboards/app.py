@@ -64,6 +64,40 @@ async def post_tensorboard(request):
     return json_success({"message": f"Tensorboard {name} created"})
 
 
+@routes.get("/api/namespaces/{namespace}/pvcs")
+async def list_pvcs(request):
+    """PVC names for the pvc:// logspath picker (the reference TWA serves
+    pvcs + poddefaults alongside tensorboards for its form)."""
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "list", "PersistentVolumeClaim", ns)
+    pvcs = [
+        {
+            "name": name_of(pvc),
+            "capacity": deep_get(pvc, "spec", "resources", "requests", "storage"),
+            "modes": deep_get(pvc, "spec", "accessModes", default=[]),
+        }
+        for pvc in await kube.list("PersistentVolumeClaim", ns)
+    ]
+    return json_success({"pvcs": pvcs})
+
+
+@routes.get("/api/namespaces/{namespace}/poddefaults")
+async def list_poddefaults(request):
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "list", "PodDefault", ns)
+    contents = [
+        {
+            "label": next(
+                iter(deep_get(pd, "spec", "selector", "matchLabels", default={})),
+                name_of(pd),
+            ),
+            "desc": deep_get(pd, "spec", "desc", default=name_of(pd)),
+        }
+        for pd in await kube.list("PodDefault", ns)
+    ]
+    return json_success({"poddefaults": contents})
+
+
 @routes.delete("/api/namespaces/{namespace}/tensorboards/{name}")
 async def delete_tensorboard(request):
     kube, authz, user, ns = _ctx(request)

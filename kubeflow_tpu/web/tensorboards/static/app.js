@@ -125,7 +125,31 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
   }, showError);
 });
 
-document
-  .getElementById("ns-slot")
-  .append(namespacePicker(() => tablePoller.refresh()));
+async function loadLogspathSuggestions() {
+  /* pvc:// + gs:// templates for the logspath field, fed by the backend's
+   * pvcs route (reference TWA form). */
+  const input = document.querySelector('input[name="logspath"]');
+  if (!input) return;
+  let datalist = document.getElementById("logspath-options");
+  if (!datalist) {
+    datalist = el("datalist", { id: "logspath-options" });
+    document.body.append(datalist);
+    input.setAttribute("list", "logspath-options");
+  }
+  const body = await api(`api/namespaces/${ns.get()}/pvcs`).catch(() => ({
+    pvcs: [],
+  }));
+  datalist.replaceChildren(
+    ...body.pvcs.map((p) => el("option", { value: `pvc://${p.name}/logs` })),
+    el("option", { value: "gs://your-bucket/tensorboard" })
+  );
+}
+
+document.getElementById("ns-slot").append(
+  namespacePicker(() => {
+    tablePoller.refresh();
+    loadLogspathSuggestions();
+  })
+);
 tablePoller = poll(refresh);
+loadLogspathSuggestions();

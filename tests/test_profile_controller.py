@@ -144,3 +144,32 @@ async def test_istio_authorization_policy():
     finally:
         await mgr.stop()
         kube.close_watches()
+
+
+async def test_namespace_labels_file_hot_reload(tmp_path):
+    """Mounted labels file replaces the static labels and edits converge
+    without a controller restart (reference fsnotify hot reload,
+    profile_controller.go:368-399)."""
+    labels_file = tmp_path / "labels.yaml"
+    labels_file.write_text("istio-injection: enabled\ntier: bronze\n")
+    kube, mgr, rec = await make_harness(
+        namespace_labels_file=str(labels_file)
+    )
+    try:
+        await kube.create("Profile", profileapi.new("team", "a@example.com"))
+        await settle(mgr)
+        ns = await kube.get("Namespace", "team")
+        assert get_meta(ns)["labels"]["tier"] == "bronze"
+
+        # Edit the file: the watcher re-enqueues, the reconcile re-reads.
+        labels_file.write_text("istio-injection: enabled\ntier: gold\n")
+        for _ in range(40):  # watcher polls every 2 s
+            await asyncio.sleep(0.2)
+            await mgr.wait_idle()
+            ns = await kube.get("Namespace", "team")
+            if get_meta(ns)["labels"].get("tier") == "gold":
+                break
+        assert get_meta(ns)["labels"]["tier"] == "gold"
+    finally:
+        await mgr.stop()
+        kube.close_watches()
