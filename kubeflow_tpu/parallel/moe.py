@@ -1,0 +1,134 @@
+"""Expert parallelism: switch-style top-1 MoE over a mesh "expert" axis.
+
+The GShard/Switch pattern, TPU-first (public pattern per PAPERS.md;
+implementation original):
+
+- tokens are data-sharded over every mesh axis (data and expert axes both
+  carry batch); **experts** shard over the ``expert`` axis;
+- routing builds a one-hot dispatch tensor (einsum with one-hots is the
+  MXU-friendly formulation — no gather/scatter in the hot path);
+- two ``all_to_all``s move token slots expert-shard→expert-shard over ICI
+  (dims: ``[E, C, d] → [E/P, P·C, d]`` and back);
+- capacity truncation keeps every shape static for XLA.
+
+An auxiliary load-balancing loss (Switch §2.2 form) is returned so
+training can keep routing uniform.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def router_dispatch(logits, n_experts: int, capacity: int):
+    """Top-1 routing → (dispatch [T, E, C] one-hot, probs [T], idx [T]).
+
+    Tokens beyond an expert's capacity are dropped (their dispatch row is
+    zero and the combine step passes the residual stream through — the
+    standard switch overflow behavior, static shapes throughout).
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+    idx = jnp.argmax(probs, axis=-1)                             # [T]
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.int32)     # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1                # [T, E]
+    pos_in_expert = pos.max(axis=-1)                             # [T]
+    keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
+    dispatch = (
+        jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)[:, :, None]
+        * jax.nn.one_hot(
+            jnp.where(keep, pos_in_expert, capacity), capacity + 1,
+            dtype=jnp.float32,
+        )[:, None, :capacity]
+    )
+    return dispatch, gate, probs, idx
+
+
+def load_balancing_loss(probs, idx, n_experts: int):
+    """Switch aux loss: E · Σ_e f_e · P_e (uniform routing → 1.0)."""
+    f = jnp.mean(jax.nn.one_hot(idx, n_experts, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(f * p)
+
+
+def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
+                  capacity_factor: float = 1.25):
+    """Per-shard switch FF layer. Call inside ``shard_map``.
+
+    Args:
+      x: ``[T, d]`` this shard's tokens.
+      router_w: ``[d, E_global]`` replicated router.
+      expert_w1: ``[E_local, d, ff]`` this shard's experts.
+      expert_w2: ``[E_local, ff, d]``.
+    Returns ``(y [T, d], aux_loss scalar)``.
+    """
+    p_e = jax.lax.psum(1, axis_name)
+    e_local = expert_w1.shape[0]
+    n_experts = e_local * p_e
+    t, d = x.shape
+    capacity = max(1, int(capacity_factor * t / n_experts))
+
+    logits = (x @ router_w.astype(x.dtype)).astype(jnp.float32)  # [T, E]
+    dispatch, gate, probs, idx = router_dispatch(logits, n_experts, capacity)
+    aux = load_balancing_loss(probs, idx, n_experts)
+
+    # [T, E, C] × [T, d] → [E, C, d]: token slots grouped by global expert.
+    slots = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    # a2a #1: scatter the E dim across expert shards, gather slots — each
+    # shard now holds every data-peer's tokens for ITS experts:
+    # [E, C, d] → [E_local, P·C, d].
+    slots = jax.lax.all_to_all(
+        slots, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+
+    h = jnp.einsum("ecd,edf->ecf", slots, expert_w1.astype(x.dtype))
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("ecf,efd->ecd", h, expert_w2.astype(x.dtype))
+
+    # a2a #2: route results back to their data shards.
+    out = jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+    # Combine: [T, E, C] × [E, C, d] → [T, d], scaled by the gate; dropped
+    # tokens get zeros (residual connection upstream carries them).
+    y = jnp.einsum("tec,ecd->td", dispatch.astype(out.dtype), out)
+    return y * gate[:, None].astype(y.dtype), aux
+
+
+def moe_ffn(x, router_w, expert_w1, expert_w2, mesh,
+            expert_axis: str = "expert", capacity_factor: float = 1.25):
+    """GSPMD entrypoint. ``x [batch, seq, d]`` batch-sharded over all mesh
+    axes; experts sharded over ``expert_axis``. Returns ``(y, aux)``."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    batch_axes = tuple(mesh.axis_names)
+
+    def local(x, rw, w1, w2):
+        b, s, d = x.shape
+        y, aux = moe_ffn_local(
+            x.reshape(b * s, d), rw, w1, w2, expert_axis,
+            capacity_factor=capacity_factor,
+        )
+        return y.reshape(b, s, d), jax.lax.pmean(
+            aux, tuple(mesh.axis_names)
+        )
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(batch_axes, None, None),
+            P(),                           # router replicated
+            P(expert_axis, None, None),    # experts sharded
+            P(expert_axis, None, None),
+        ),
+        out_specs=(P(batch_axes, None, None), P()),
+    )(x, router_w, expert_w1, expert_w2)

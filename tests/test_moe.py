@@ -1,0 +1,112 @@
+"""Expert-parallel MoE layer on the 8-device virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.moe import (
+    load_balancing_loss,
+    moe_ffn,
+    router_dispatch,
+)
+
+
+def dense_moe_reference(x, router_w, w1, w2, capacity):
+    """Unsharded top-1 MoE with the same capacity semantics."""
+    b, s, d = x.shape
+    t = b * s
+    xt = x.reshape(t, d)
+    logits = xt @ router_w
+    dispatch, gate, _, _ = router_dispatch(logits, w1.shape[0], capacity)
+    slots = jnp.einsum("tec,td->ecd", dispatch, xt)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, w1))
+    out = jnp.einsum("ecf,efd->ecd", h, w2)
+    y = jnp.einsum("tec,ecd->td", dispatch, out) * gate[:, None]
+    return y.reshape(b, s, d)
+
+
+def test_router_dispatch_capacity_and_positions():
+    logits = jnp.array([[9.0, 0.0], [9.0, 0.0], [9.0, 0.0], [0.0, 9.0]])
+    dispatch, gate, probs, idx = router_dispatch(logits, 2, capacity=2)
+    assert idx.tolist() == [0, 0, 0, 1]
+    # Tokens 0,1 fill expert 0's two slots; token 2 overflows (dropped).
+    assert float(dispatch[0].sum()) == 1 and float(dispatch[1].sum()) == 1
+    assert float(dispatch[2].sum()) == 0
+    assert float(dispatch[3, 1, 0]) == 1
+    assert float(load_balancing_loss(probs, idx, 2)) > 0
+
+
+def test_expert_parallel_matches_dense_reference():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+    d, ff, n_exp = 16, 32, 4
+    rng = jax.random.split(jax.random.key(0), 4)
+    x = jax.random.normal(rng[0], (4, 16, d))  # one batch row per shard
+    router_w = jax.random.normal(rng[1], (d, n_exp)) * 0.5
+    w1 = jax.random.normal(rng[2], (n_exp, d, ff)) * 0.1
+    w2 = jax.random.normal(rng[3], (n_exp, ff, d)) * 0.1
+
+    espec = NamedSharding(mesh, P("expert", None, None))
+    xs = jax.device_put(x, espec)
+    w1s, w2s = jax.device_put(w1, espec), jax.device_put(w2, espec)
+    rs = jax.device_put(router_w, NamedSharding(mesh, P()))
+
+    y, aux = jax.jit(
+        lambda x, r, a, b: moe_ffn(x, r, a, b, mesh)
+    )(xs, rs, w1s, w2s)
+    assert jnp.isfinite(aux)
+
+    # Capacity is computed from each shard's local token count; the dense
+    # reference reproduces it per batch-row shard.
+    t_local = 16
+    capacity = max(1, int(1.25 * t_local / n_exp))
+    expected = jnp.concatenate(
+        [
+            dense_moe_reference(x[i : i + 1], router_w, w1, w2, capacity)
+            for i in range(4)
+        ],
+        axis=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(expected), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_moe_trains_on_data_x_expert_mesh():
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("data", "expert"))
+    d, ff, n_exp = 8, 16, 8
+    rng = jax.random.split(jax.random.key(1), 5)
+    params = {
+        "router": jax.random.normal(rng[0], (d, n_exp)) * 0.5,
+        "w1": jax.random.normal(rng[1], (n_exp, d, ff)) * 0.1,
+        "w2": jax.random.normal(rng[2], (n_exp, ff, d)) * 0.1,
+    }
+    x = jax.random.normal(rng[3], (8, 16, d))
+    target = jax.random.normal(rng[4], (8, 16, d))
+
+    espec = NamedSharding(mesh, P("expert", None, None))
+    params = {
+        "router": jax.device_put(params["router"], NamedSharding(mesh, P())),
+        "w1": jax.device_put(params["w1"], espec),
+        "w2": jax.device_put(params["w2"], espec),
+    }
+    x = jax.device_put(x, NamedSharding(mesh, P(("data", "expert"), None, None)))
+    target = jax.device_put(
+        target, NamedSharding(mesh, P(("data", "expert"), None, None))
+    )
+
+    def loss_fn(p, x, target):
+        y, aux = moe_ffn(x, p["router"], p["w1"], p["w2"], mesh)
+        return ((y - target) ** 2).mean() + 0.01 * aux
+
+    @jax.jit
+    def step(p, x, target):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, target)
+        return jax.tree.map(lambda a, g: a - 0.1 * g, p, grads), loss
+
+    p1, loss1 = step(params, x, target)
+    _, loss2 = step(p1, x, target)
+    assert jnp.isfinite(loss1) and float(loss2) < float(loss1)
+    # Experts stayed expert-sharded (spec may normalize trailing Nones).
+    assert p1["w1"].sharding.spec[0] == "expert"
