@@ -1,0 +1,163 @@
+"""Mixture-of-experts burn-in: the expert-parallel variant of the workload.
+
+Same decoder skeleton as :mod:`kubeflow_tpu.models.burnin`, but every FF
+block is a switch-style top-1 MoE (:mod:`kubeflow_tpu.parallel.moe`) whose
+experts shard over a mesh ``expert`` axis. The cross-chip traffic pattern
+this validates is the two ``all_to_all`` dispatch/combine hops per layer —
+the third ICI pattern a healthy slice must deliver after all-reduce
+(data/tensor parallel) and neighbor ppermute (ring attention).
+
+Sharding story: tokens are batch-sharded over (data × expert) — the expert
+axis carries batch *between* MoE blocks and token-slots *inside* them —
+while attention/router/embed params stay replicated and expert FF weights
+live one-shard-per-expert-group on the expert axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.burnin import _rmsnorm
+from kubeflow_tpu.parallel.moe import moe_ffn
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    seq_len: int = 128
+    n_experts: int = 4            # must be divisible by the expert-axis size
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01      # Switch §2.2 load-balancing loss weight
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> dict:
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / shape[0]) ** 0.5
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    keys = iter(jax.random.split(rng, 3 + 5 * cfg.n_layers))
+    return {
+        "embed": dense(next(keys), (cfg.vocab, cfg.d_model), scale=0.02),
+        "pos": dense(next(keys), (cfg.seq_len, cfg.d_model), scale=0.02),
+        "out_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "layers": [
+            {
+                "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+                "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+                "qkv": dense(next(keys), (cfg.d_model, 3 * cfg.d_model)),
+                "attn_out": dense(next(keys), (cfg.d_model, cfg.d_model)),
+                "router": dense(next(keys), (cfg.d_model, cfg.n_experts),
+                                scale=0.02),
+                "expert_w1": dense(next(keys),
+                                   (cfg.n_experts, cfg.d_model, cfg.d_ff)),
+                "expert_w2": dense(next(keys),
+                                   (cfg.n_experts, cfg.d_ff, cfg.d_model)),
+            }
+            for _ in range(cfg.n_layers)
+        ],
+    }
+
+
+def param_sharding_rules(cfg: MoEConfig, expert_axis: str = "expert") -> dict:
+    """Experts shard over the expert axis; everything else replicates."""
+    layer = {
+        "ln1": P(),
+        "ln2": P(),
+        "qkv": P(),
+        "attn_out": P(),
+        "router": P(),
+        "expert_w1": P(expert_axis, None, None),
+        "expert_w2": P(expert_axis, None, None),
+    }
+    return {
+        "embed": P(),
+        "pos": P(),
+        "out_norm": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def _attention(x, layer, cfg: MoEConfig):
+    """Plain causal einsum attention (GSPMD shards batch transparently)."""
+    b, s, d = x.shape
+    qkv = x @ layer["qkv"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (cfg.head_dim ** 0.5)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, s, d) @ layer["attn_out"].astype(x.dtype)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: MoEConfig, mesh: Mesh,
+            expert_axis: str = "expert"):
+    """[batch, seq] ids → (logits [batch, seq, vocab], mean aux loss)."""
+    dtype = jnp.dtype(cfg.dtype)
+    s = tokens.shape[1]
+    x = params["embed"][tokens].astype(dtype) + params["pos"][:s].astype(dtype)
+    aux_total = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, cfg)
+        h = _rmsnorm(x, layer["ln2"])
+        y, aux = moe_ffn(
+            h, layer["router"], layer["expert_w1"], layer["expert_w2"],
+            mesh, expert_axis=expert_axis,
+            capacity_factor=cfg.capacity_factor,
+        )
+        x = x + y
+        aux_total = aux_total + aux
+    x = _rmsnorm(x, params["out_norm"])
+    logits = (x @ params["embed"].T.astype(dtype)).astype(jnp.float32)
+    return logits, aux_total / cfg.n_layers
+
+
+def loss_fn(params, tokens, cfg: MoEConfig, mesh, expert_axis="expert"):
+    logits, aux = forward(params, tokens[:, :-1], cfg, mesh, expert_axis)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return nll.mean() + cfg.aux_weight * aux
+
+
+def make_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-3,
+                    expert_axis: str = "expert"):
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh,
+                                                  expert_axis)
+        params = jax.tree.map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, grads
+        )
+        return params, loss
+
+    return step
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: MoEConfig,
+                 expert_axis: str = "expert") -> dict:
+    rules = param_sharding_rules(cfg, expert_axis)
+    return jax.tree.map(
+        lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
+        params,
+        rules,
+        is_leaf=lambda x: isinstance(x, P),
+    )
