@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_tpu.models.burnin import _rmsnorm
+from kubeflow_tpu.models.burnin import _attention, _rmsnorm
 from kubeflow_tpu.parallel.moe import moe_ffn
 
 
@@ -37,6 +37,7 @@ class MoEConfig:
     capacity_factor: float = 1.25
     aux_weight: float = 0.01      # Switch §2.2 load-balancing loss weight
     dtype: str = "bfloat16"
+    attention: str = "xla"        # burnin._attention duck-types on this
 
     @property
     def head_dim(self) -> int:
@@ -46,7 +47,9 @@ class MoEConfig:
 
 def init_params(rng: jax.Array, cfg: MoEConfig) -> dict:
     def dense(key, shape, scale=None):
-        scale = scale if scale is not None else (1.0 / shape[0]) ** 0.5
+        # fan-in is the penultimate dim: expert tensors [E, fan_in, fan_out]
+        # must not scale by E.
+        scale = scale if scale is not None else (1.0 / shape[-2]) ** 0.5
         return jax.random.normal(key, shape, jnp.float32) * scale
 
     keys = iter(jax.random.split(rng, 3 + 5 * cfg.n_layers))
@@ -89,24 +92,6 @@ def param_sharding_rules(cfg: MoEConfig, expert_axis: str = "expert") -> dict:
         "out_norm": P(),
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
-
-
-def _attention(x, layer, cfg: MoEConfig):
-    """Plain causal einsum attention (GSPMD shards batch transparently)."""
-    b, s, d = x.shape
-    qkv = x @ layer["qkv"].astype(x.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-
-    def heads(t):
-        return t.reshape(b, s, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-
-    q, k, v = heads(q), heads(k), heads(v)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (cfg.head_dim ** 0.5)
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-    return ctx.transpose(0, 2, 1, 3).reshape(b, s, d) @ layer["attn_out"].astype(x.dtype)
 
 
 def forward(params: dict, tokens: jax.Array, cfg: MoEConfig, mesh: Mesh,

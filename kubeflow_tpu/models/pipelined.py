@@ -1,0 +1,214 @@
+"""Pipelined burn-in: the pipeline-parallel variant of the workload.
+
+Same decoder architecture as :mod:`kubeflow_tpu.models.burnin`, but the
+layer stack is split into contiguous stages over a "stage" mesh axis and
+microbatches flow through a GPipe schedule
+(:mod:`kubeflow_tpu.parallel.pipeline`). Per-chip parameter memory is
+O(n_layers / n_stages); cross-chip traffic is one activation block per
+schedule tick on neighbour ICI links plus the loss/grad reductions.
+
+Layer parameters are *stacked* — every leaf gets a leading ``n_layers``
+dimension sharded ``P("stage", ...)`` — so the whole stack is one array per
+weight kind and each device's shard is exactly its stage's slice. Inside a
+stage the local layers run under ``lax.scan`` (one compiled layer body, no
+unrolling).
+
+Reference parity: the reference has no pipeline-parallel code anywhere
+(SURVEY.md §2.4); this model is part of the slice-validation suite
+(burnin = dp+tp, longctx = dp+sp, moe = dp+ep, pipelined = dp+pp).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.burnin import _attention, _rmsnorm
+from kubeflow_tpu.parallel.pipeline import pipeline_apply
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+@dataclass(frozen=True)
+class PipelinedConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 4            # must divide by n_stages
+    d_ff: int = 512
+    seq_len: int = 128
+    n_micro: int = 4             # microbatches per global batch
+    dtype: str = "bfloat16"
+    attention: str = "xla"       # burnin._attention duck-types on this
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: PipelinedConfig) -> dict:
+    """Layer-stacked pytree: layers["qkv"] is [n_layers, d_model, 3d] etc."""
+
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / shape[-2]) ** 0.5
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    keys = iter(jax.random.split(rng, 6))
+    return {
+        "embed": dense(next(keys), (cfg.vocab, D), scale=0.02),
+        "pos": dense(next(keys), (cfg.seq_len, D), scale=0.02),
+        "out_norm": jnp.ones((D,), jnp.float32),
+        "layers": {
+            "ln1": jnp.ones((L, D), jnp.float32),
+            "ln2": jnp.ones((L, D), jnp.float32),
+            "qkv": dense(next(keys), (L, D, 3 * D)),
+            "attn_out": dense(next(keys), (L, D, D)),
+            "ff1": dense(next(keys), (L, D, F)),
+            "ff2": dense(next(keys), (L, F, D), scale=(1.0 / F) ** 0.5),
+        },
+    }
+
+
+def param_sharding_rules(cfg: PipelinedConfig) -> dict:
+    """Stage-sharded layer stack; small embeddings/norms replicated."""
+    return {
+        "embed": P(),
+        "pos": P(),
+        "out_norm": P(),
+        "layers": {
+            "ln1": P("stage", None),
+            "ln2": P("stage", None),
+            "qkv": P("stage", None, None),
+            "attn_out": P("stage", None, None),
+            "ff1": P("stage", None, None),
+            "ff2": P("stage", None, None),
+        },
+    }
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: PipelinedConfig) -> dict:
+    rules = param_sharding_rules(cfg)
+    return jax.tree.map(
+        lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
+        params, rules, is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _stage_fn(cfg: PipelinedConfig):
+    """One stage = lax.scan of the transformer layer over the local slice."""
+
+    def layer_body(h, layer):
+        h = h + _attention(_rmsnorm(h, layer["ln1"]), layer, cfg)
+        g = _rmsnorm(h, layer["ln2"])
+        g = jax.nn.gelu(g @ layer["ff1"].astype(h.dtype))
+        return h + g @ layer["ff2"].astype(h.dtype), None
+
+    def run(local_layers, h):
+        h, _ = jax.lax.scan(layer_body, h, local_layers)
+        return h
+
+    return run
+
+
+def reference_loss(params: dict, tokens: jax.Array, cfg: PipelinedConfig):
+    """Unpipelined single-device loss on the same stacked params — the
+    correctness oracle for the schedule (tests assert allclose)."""
+    dtype = jnp.dtype(cfg.dtype)
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    s = inp.shape[1]
+    x = params["embed"][inp].astype(dtype) + params["pos"][:s].astype(dtype)
+    x = _stage_fn(cfg)(params["layers"], x)
+    x = _rmsnorm(x, params["out_norm"])
+    logits = (x @ params["embed"].T.astype(dtype)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
+
+
+def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
+                    data_axis: str = "data", stage_axis: str = "stage"):
+    """(params, tokens) -> (params, loss) over a (data, stage) mesh.
+
+    Grad bookkeeping: none by hand. Replicated leaves (embed/pos/out_norm)
+    get contributions from stage 0 (input path — the ``where(idx==0)``
+    inject confines it there) and the last stage (output projection), and
+    shard_map's varying-axes machinery reduces them across the mesh in the
+    transpose (see the comment in ``local_loss``), keeping replicas in
+    lockstep without explicit psums.
+    """
+    n_stages = mesh.shape[stage_axis]
+    has_data = data_axis in mesh.axis_names
+    stage_run = _stage_fn(cfg)
+    mesh_axes = tuple(mesh.axis_names)
+
+    def local_loss(params, tokens):
+        dtype = jnp.dtype(cfg.dtype)
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        b, s = inp.shape
+        if b % cfg.n_micro:
+            raise ValueError(f"local batch {b} not divisible by n_micro={cfg.n_micro}")
+        mb = b // cfg.n_micro
+        x = params["embed"][inp].astype(dtype) + params["pos"][:s].astype(dtype)
+        x_micro = x.reshape(cfg.n_micro, mb, s, cfg.d_model)
+        outs = pipeline_apply(
+            stage_run, params["layers"], x_micro,
+            n_stages=n_stages, axis_name=stage_axis, mesh_axes=mesh_axes,
+        )
+        x = outs.reshape(b, s, cfg.d_model)
+        x = _rmsnorm(x, params["out_norm"])
+        logits = (x @ params["embed"].T.astype(dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
+        idx = jax.lax.axis_index(stage_axis)
+        # Per-device masked loss with NO collectives: under shard_map's
+        # varying-axes (vma) tracking, differentiating this per-device
+        # scalar already yields fully-reduced gradients — params enter
+        # less-varying than the activations they meet, jax auto-inserts
+        # ``pvary`` casts, and a pvary's transpose is a psum over the added
+        # axes. Any manual grad psum here would double-count (measured:
+        # exactly n_stages× on the replicated embed table). The where()
+        # zeroes bubble-stage gradients; the 1/n_data prescale turns the
+        # implicit data-axis grad psum into the data-parallel mean.
+        local = jnp.where(idx == n_stages - 1, nll, 0.0)
+        if has_data:
+            local = local / mesh.shape[data_axis]
+        return local
+
+    def local_step(params, tokens):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens)
+        # Only the loss *value* still needs reducing (it is per-device:
+        # nonzero on the last stage's shards only).
+        loss = jax.lax.psum(loss, stage_axis)
+        if has_data:
+            loss = jax.lax.psum(loss, data_axis)
+        new = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return new, loss
+
+    rules = param_sharding_rules(cfg)
+    tok_spec = P(data_axis if has_data else None, None)
+    return shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(rules, tok_spec),
+        out_specs=(rules, P()),
+    )
+
+
+def make_pp_mesh(devices=None, n_stages: int = 2,
+                 data_axis: str = "data", stage_axis: str = "stage") -> Mesh:
+    """(data, stage) mesh; stage rides the fastest (innermost) links."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if len(devices) % n_stages:
+        raise ValueError(f"{len(devices)} devices not divisible into {n_stages} stages")
+    grid = np.asarray(devices).reshape(len(devices) // n_stages, n_stages)
+    return Mesh(grid, (data_axis, stage_axis))

@@ -11,5 +11,17 @@ from kubeflow_tpu.parallel.mesh import (
     make_mesh,
     plan_mesh,
 )
+from kubeflow_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_spans,
+    stage_ring_perm,
+)
 
-__all__ = ["MeshPlan", "make_mesh", "plan_mesh"]
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "plan_mesh",
+    "pipeline_apply",
+    "pipeline_spans",
+    "stage_ring_perm",
+]

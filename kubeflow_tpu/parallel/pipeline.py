@@ -1,0 +1,116 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+The TPU way to pipeline: stages are a named mesh axis ("stage"); each device
+holds a contiguous slice of the layer stack (leaves stacked on a leading
+layer dimension and sharded over the axis), and inter-stage activation
+transfer is one ``jax.lax.ppermute`` neighbour hop per schedule tick —
+exactly the point-to-point pattern ICI torus links are built for. The
+schedule is a static-trip-count ``lax.scan`` of length
+``n_micro + n_stages - 1`` (the GPipe bubble); no data-dependent control
+flow, so XLA traces a single program and overlaps the collective-permute
+with the next tick's compute.
+
+No hand-written backward schedule is needed: ``ppermute`` is linear, so
+``jax.grad`` transposes the forward scan into the reverse-order backward
+pipeline automatically (activations rematerialized per scan default or via
+``jax.checkpoint`` policies chosen by the caller).
+
+Reference parity note: the reference control plane has no DP/TP/PP code
+(SURVEY.md §2.4 — grep-verified absent); pipeline parallelism is part of
+the TPU-native data-plane substrate (dp/tp/sp/ep/pp) this framework
+validates on slices, alongside ring/ulysses sequence parallelism and the
+MoE expert-parallel path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _mark_varying(t, axes):
+    """Mark ``t`` device-varying over ``axes`` (skipping any it already
+    varies on — e.g. ``zeros_like`` of a data-varying input inherits
+    ``{V:data}`` and pvary/pcast reject re-adding it)."""
+    try:
+        have = set(jax.typeof(t).vma)
+    except AttributeError:  # pragma: no cover - older jax
+        have = set()
+    need = tuple(a for a in axes if a not in have)
+    if not need:
+        return t
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(t, need, to="varying")
+    return jax.lax.pvary(t, need)  # pragma: no cover - pre-pcast jax
+
+
+def stage_ring_perm(n_stages: int) -> list[tuple[int, int]]:
+    """Stage i forwards its activations to stage i+1 (circular; the wrap
+    link only ever carries bubble garbage that stage 0 discards)."""
+    return [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+
+def pipeline_spans(n_layers: int, n_stages: int) -> list[tuple[int, int]]:
+    """Even [start, stop) layer spans per stage; n_layers % n_stages == 0."""
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by {n_stages} stages")
+    per = n_layers // n_stages
+    return [(i * per, (i + 1) * per) for i in range(n_stages)]
+
+
+def pipeline_apply(stage_fn, stage_params, x_micro, *, n_stages: int,
+                   axis_name: str = "stage", mesh_axes=None):
+    """Run microbatches through the stage ring. Call inside ``shard_map``.
+
+    Args:
+      stage_fn: ``(stage_params, h) -> h`` applying this device's slice of
+        the layer stack to one microbatch activation ``h [mb, ...]``.
+      stage_params: pytree of this device's local layer slice (leaves are
+        the per-stage shard of layer-stacked arrays).
+      x_micro: ``[n_micro, mb, ...]`` embedded input microbatches. Present
+        on every stage (cheap relative to the layer stack); only stage 0's
+        copy is consumed, which also confines input-path gradients to
+        stage 0.
+      n_stages: static size of the stage axis (shard_map callers know it
+        from ``mesh.shape``; ``psum(1, axis)`` would be traced, not static,
+        and the scan needs a static trip count).
+      mesh_axes: every manual axis of the enclosing shard_map — the scan
+        carries must be marked varying over all of them (same rule as
+        ring_attention_local's online-softmax carries).
+
+    Returns ``[n_micro, mb, ...]`` outputs — valid on the LAST stage only;
+    other stages hold zeros/garbage (reduce with a ``where(idx==last)`` +
+    ``psum`` as models/pipelined.py does for the loss).
+    """
+    n_micro = x_micro.shape[0]
+    idx = jax.lax.axis_index(axis_name)
+    last = n_stages - 1
+    perm = stage_ring_perm(n_stages)
+
+    state = jnp.zeros(x_micro.shape[1:], x_micro.dtype)
+    outputs = jnp.zeros_like(x_micro)
+    vary = tuple(mesh_axes) if mesh_axes else (axis_name,)
+    state, outputs = (_mark_varying(t, vary) for t in (state, outputs))
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (clamped during the drain bubble —
+        # those ticks' outputs never reach a valid write slot below).
+        inject = x_micro[jnp.minimum(t, n_micro - 1)]
+        h = jnp.where(idx == 0, inject, state)
+        out = stage_fn(stage_params, h)
+        # The last stage has finished microbatch (t - last) at tick t.
+        out_idx = t - last
+        written = jax.lax.dynamic_update_index_in_dim(
+            outputs, out, jnp.clip(out_idx, 0, n_micro - 1), 0
+        )
+        outputs = jnp.where((idx == last) & (out_idx >= 0), written, outputs)
+        # Hop AFTER the compute so XLA overlaps the collective-permute with
+        # the next tick's stage_fn.
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state, outputs), jnp.arange(n_micro + n_stages - 1)
+    )
+    return outputs
