@@ -45,25 +45,13 @@ SERVED_API_VERSIONS = (
 
 
 def convert(notebook: dict, to_api_version: str) -> dict:
-    """Convert a Notebook between served versions.
+    """Convert a Notebook between served versions (identity rewrite — see
+    kubeflow_tpu.api.convert for why)."""
+    from kubeflow_tpu.api.convert import identity_convert
 
-    The schemas are identical across versions (see SERVED_API_VERSIONS
-    note), so conversion is the apiVersion rewrite a ``strategy: None``
-    CRD conversion performs — expressed here as an explicit function so
-    the /convert webhook and the admission normalizer share one place
-    that would hold real field mappings if a future version diverges.
-    """
-    if to_api_version not in SERVED_API_VERSIONS:
-        raise Invalid(
-            f"unknown Notebook apiVersion {to_api_version!r}; "
-            f"served: {', '.join(SERVED_API_VERSIONS)}"
-        )
-    have = notebook.get("apiVersion", STORAGE_API_VERSION)
-    if have not in SERVED_API_VERSIONS:
-        raise Invalid(f"cannot convert from unknown apiVersion {have!r}")
-    out = dict(notebook)
-    out["apiVersion"] = to_api_version
-    return out
+    return identity_convert(notebook, to_api_version,
+                            served=SERVED_API_VERSIONS,
+                            storage=STORAGE_API_VERSION, kind=KIND)
 
 # Annotation/label contract — kept wire-compatible with the reference so
 # existing tooling (and muscle memory) carries over:

@@ -18,6 +18,26 @@ from kubeflow_tpu.tpu.topology import TPU_RESOURCE
 KIND = "Profile"
 API_VERSION = "kubeflow.org/v1"
 
+# Version lineage, mirroring the reference which serves Profile at v1
+# (storage) and v1beta1 with structurally identical schemas
+# (profile-controller/api/{v1,v1beta1}/profile_types.go differ only in
+# package name and kubebuilder markers).
+STORAGE_API_VERSION = API_VERSION
+SERVED_API_VERSIONS = (
+    "kubeflow.org/v1",
+    "kubeflow.org/v1beta1",
+)
+
+
+def convert(profile: dict, to_api_version: str) -> dict:
+    """Convert a Profile between served versions (identity rewrite — see
+    kubeflow_tpu.api.convert for why)."""
+    from kubeflow_tpu.api.convert import identity_convert
+
+    return identity_convert(profile, to_api_version,
+                            served=SERVED_API_VERSIONS,
+                            storage=STORAGE_API_VERSION, kind=KIND)
+
 # Condition types (profile_types.go:47-51)
 SUCCEED = "Successful"
 FAILED = "Failed"

@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models.burnin import _attention, _rmsnorm
-from kubeflow_tpu.parallel.pipeline import pipeline_apply
+from kubeflow_tpu.parallel.pipeline import pipeline_apply, pipeline_spans
 
 try:
     from jax import shard_map
@@ -95,7 +95,9 @@ def param_sharding_rules(cfg: PipelinedConfig) -> dict:
     }
 
 
-def shard_params(params: dict, mesh: Mesh, cfg: PipelinedConfig) -> dict:
+def shard_params(params: dict, mesh: Mesh, cfg: PipelinedConfig,
+                 stage_axis: str = "stage") -> dict:
+    pipeline_spans(cfg.n_layers, mesh.shape[stage_axis])  # clear divisibility error
     rules = param_sharding_rules(cfg)
     return jax.tree.map(
         lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
@@ -145,6 +147,7 @@ def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
     lockstep without explicit psums.
     """
     n_stages = mesh.shape[stage_axis]
+    pipeline_spans(cfg.n_layers, n_stages)  # clear divisibility error up front
     has_data = data_axis in mesh.axis_names
     stage_run = _stage_fn(cfg)
     mesh_axes = tuple(mesh.axis_names)

@@ -30,6 +30,14 @@ def register_all(kube) -> None:
     kube.add_mutator("Notebook", nb_webhook.mutate)
     kube.add_mutator("PVCViewer", lambda v, _i: pvcapi.default(v))
 
+    # Profiles applied at an old served version are normalized to storage at
+    # admission (same contract as the Notebook mutator's normalization).
+    def profile_normalizer(p: dict, _info: dict) -> None:
+        if p.get("apiVersion") in profileapi.SERVED_API_VERSIONS:
+            p["apiVersion"] = profileapi.STORAGE_API_VERSION
+
+    kube.add_mutator("Profile", profile_normalizer)
+
     # CR validation.
     kube.add_validator("Notebook", lambda nb, _i: nbapi.validate(nb))
     kube.add_validator("PodDefault", lambda pd, _i: pdapi.validate(pd))

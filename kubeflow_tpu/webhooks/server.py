@@ -134,6 +134,12 @@ def create_webhook_app(kube) -> web.Application:
     # via config/crd/patches/webhook_in_notebooks.yaml's /convert path.
     async def convert(request: web.Request) -> web.Response:
         from kubeflow_tpu.api import notebook as nbapi
+        from kubeflow_tpu.api import profile as profile_api
+
+        converters = {
+            nbapi.KIND: nbapi.convert,
+            profile_api.KIND: profile_api.convert,
+        }
 
         try:
             review = await request.json()
@@ -147,8 +153,9 @@ def create_webhook_app(kube) -> web.Application:
         converted, failed = [], None
         for obj in req.get("objects") or []:
             try:
-                if obj.get("kind") == nbapi.KIND:
-                    converted.append(nbapi.convert(obj, desired))
+                fn = converters.get(obj.get("kind"))
+                if fn is not None:
+                    converted.append(fn(obj, desired))
                 else:
                     # Other CRDs are single-version today; identity-convert
                     # anything already at the desired version.

@@ -13,6 +13,7 @@ import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
 from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.api import profile as profileapi
 from kubeflow_tpu.controllers.notebook import setup_notebook_controller
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.manager import Manager
@@ -36,25 +37,50 @@ def test_convert_between_served_versions():
         nbapi.convert({**nb, "apiVersion": "example.com/v9"}, "kubeflow.org/v1")
 
 
+def test_profile_convert_between_served_versions():
+    p = profileapi.new("team-a", "alice@example.com", tpu_quota=8)
+    beta = profileapi.convert(p, "kubeflow.org/v1beta1")
+    assert beta["apiVersion"] == "kubeflow.org/v1beta1"
+    assert beta["spec"] == p["spec"]
+    back = profileapi.convert(beta, "kubeflow.org/v1")
+    assert back["apiVersion"] == profileapi.STORAGE_API_VERSION
+    with pytest.raises(Invalid):
+        profileapi.convert(p, "kubeflow.org/v1alpha1")  # never served
+
+
+async def test_profile_v1beta1_normalized_at_admission():
+    """A Profile applied at v1beta1 is stored at the storage version."""
+    kube = FakeKube()
+    register_all(kube)
+    p = profileapi.new("legacy-team", "bob@example.com")
+    p["apiVersion"] = "kubeflow.org/v1beta1"
+    await kube.create("Profile", p)
+    stored = await kube.get("Profile", "legacy-team")
+    assert stored["apiVersion"] == profileapi.STORAGE_API_VERSION
+
+
 async def test_convert_webhook_speaks_conversionreview():
     client = TestClient(TestServer(create_webhook_app(FakeKube())))
     await client.start_server()
     try:
         nb = nbapi.new("x", "ns")
         nb["apiVersion"] = "kubeflow.org/v1beta1"
+        prof = profileapi.new("team", "alice@example.com")
+        prof["apiVersion"] = "kubeflow.org/v1beta1"
         resp = await client.post("/convert", json={
             "apiVersion": "apiextensions.k8s.io/v1",
             "kind": "ConversionReview",
             "request": {
                 "uid": "u1",
                 "desiredAPIVersion": "kubeflow.org/v1",
-                "objects": [nb],
+                "objects": [nb, prof],
             },
         })
         body = json.loads(await resp.text())
         assert body["response"]["result"]["status"] == "Success"
-        (obj,) = body["response"]["convertedObjects"]
+        obj, pobj = body["response"]["convertedObjects"]
         assert obj["apiVersion"] == "kubeflow.org/v1"
+        assert pobj["apiVersion"] == "kubeflow.org/v1"
         assert body["response"]["uid"] == "u1"
 
         # Unknown desired version fails the review, not the server.
