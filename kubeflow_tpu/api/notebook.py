@@ -65,6 +65,9 @@ ANNOTATION_REWRITE_URI = "notebooks.kubeflow.org/http-rewrite-uri"
 ANNOTATION_HEADERS_REQUEST_SET = "notebooks.kubeflow.org/http-headers-request-set"
 SERVER_TYPE_ANNOTATION = "notebooks.kubeflow.org/server-type"
 CREATOR_ANNOTATION = "notebooks.kubeflow.org/creator"
+# Spawner's image pick, resolved to a pinned reference at admission by the
+# catalog ConfigMap (odh's last-image-selection, notebook_webhook.go:556).
+IMAGE_SELECTION_ANNOTATION = "notebooks.kubeflow.org/last-image-selection"
 
 # Restart protocol (reference: culler pkg + odh webhook "update-pending"):
 RESTART_ANNOTATION = "notebooks.kubeflow.org/restart"

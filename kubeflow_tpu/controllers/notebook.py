@@ -37,6 +37,7 @@ TPU-native redesign (not in the reference, SURVEY.md §2.4):
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 
 from kubeflow_tpu.api import notebook as nbapi
@@ -94,6 +95,12 @@ class NotebookOptions:
     # injected when the notebook has the inject-auth-proxy annotation.
     auth_proxy_image: str | None = None
     auth_proxy_port: int = 3000
+    # Pipeline-access RBAC (odh's ReconcileRoleBindings, notebook_rbac.go:
+    # 36-154): when a Role with this name exists in the notebook namespace
+    # (created by a pipelines deployment), bind the notebook's
+    # ServiceAccount to it so in-notebook pipeline clients (elyra-style)
+    # can submit runs. None disables the probe entirely.
+    pipeline_access_role: str | None = "pipeline-user-access"
 
 
 AUTH_PROXY_ANNOTATION = "notebooks.kubeflow.org/inject-auth-proxy"
@@ -117,6 +124,9 @@ class NotebookReconciler:
         # re-emit would bump the mirrored count once per reconcile, turning
         # it into a reconcile-frequency counter).
         self._mirrored: dict[tuple, dict[str, int]] = {}
+        # ns → (role exists, checked-at); see _namespace_has_role.
+        self._role_probe_cache: dict[str, tuple[bool, float]] = {}
+        self._role_probe_ttl = 60.0
         registry = registry or global_registry
         # Metric names match the reference (pkg/metrics/metrics.go:14-62) so
         # dashboards/alerts carry over.
@@ -161,10 +171,61 @@ class NotebookReconciler:
         if self.opts.create_network_policies:
             await self._ensure(nb, self.generate_network_policy(nb, tpu))
 
+        await self._ensure_pipeline_rbac(nb)
         await self._restart_broken_slice(nb, tpu)
         await self._mirror_events(nb)
         await self._update_status(nb, tpu)
         return None
+
+    async def _ensure_pipeline_rbac(self, nb: dict) -> None:
+        """odh notebook_rbac.go:36-154 analogue: if the pipelines Role
+        exists in the notebook's namespace, bind the notebook's
+        ServiceAccount (pod spec's serviceAccountName, else the profile's
+        default-editor) to it via an owned RoleBinding. Skipped silently
+        when no pipelines deployment put the Role there."""
+        role_name = self.opts.pipeline_access_role
+        if not role_name:
+            return
+        name, ns = name_of(nb), namespace_of(nb)
+        if not await self._namespace_has_role(ns, role_name):
+            return
+        sa = deep_get(nb, "spec", "template", "spec", "serviceAccountName") \
+            or "default-editor"
+        binding = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {
+                # roleRef is immutable on a real apiserver, so the binding
+                # name derives from the role (apply.py's documented
+                # copy_rolebinding_fields invariant): a role-name config
+                # change creates a fresh binding; the stale one is
+                # garbage-collected with the notebook.
+                "name": f"pipelines-{role_name}-{name}",
+                "namespace": ns,
+                "labels": {nbapi.NOTEBOOK_NAME_LABEL: name},
+            },
+            "subjects": [
+                {"kind": "ServiceAccount", "name": sa, "namespace": ns}
+            ],
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "Role",
+                "name": role_name,
+            },
+        }
+        await self._ensure(nb, binding)
+
+    async def _namespace_has_role(self, ns: str, role_name: str) -> bool:
+        """Role-existence probe with a short negative/positive cache — one
+        extra GET per notebook reconcile would otherwise hit the apiserver
+        on every pod-status event cluster-wide."""
+        now = time.monotonic()
+        cached = self._role_probe_cache.get(ns)
+        if cached and now - cached[1] < self._role_probe_ttl:
+            return cached[0]
+        exists = await self.kube.get_or_none("Role", role_name, ns) is not None
+        self._role_probe_cache[ns] = (exists, now)
+        return exists
 
     async def _ensure(self, nb: dict, desired: dict) -> bool:
         """reconcile_child with ownership; returns True when newly created."""

@@ -75,6 +75,12 @@ def notebook_from_form(config: dict, body: dict, namespace: str, user: str) -> t
         },
         "spec": {"template": {"spec": pod_spec}},
     }
+    # Record the spawner's image pick so the admission catalog can pin it
+    # (odh's last-image-selection contract, notebook_webhook.go:556). Any
+    # tagged, non-digest image qualifies — the catalog key is the full
+    # repository path (e.g. "kubeflow-tpu/jupyter-jax").
+    if ":" in image.rsplit("/", 1)[-1] and "@sha256:" not in image:
+        nb["metadata"]["annotations"][nbapi.IMAGE_SELECTION_ANNOTATION] = image
     if server_type == SERVER_TYPE_GROUP_ONE:
         nb["metadata"]["annotations"][nbapi.ANNOTATION_REWRITE_URI] = "/"
     elif server_type == SERVER_TYPE_GROUP_TWO:

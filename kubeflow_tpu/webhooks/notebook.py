@@ -56,3 +56,71 @@ def mutate(nb: dict, info: dict) -> None:
             annotations_of(nb)[UPDATE_PENDING_ANNOTATION] = "true"
     nbapi.default(nb)
     nbapi.validate(nb)
+
+
+# ---- image-alias resolution --------------------------------------------------
+#
+# odh's SetContainerImageFromRegistry (notebook_webhook.go:539-645) resolves
+# the spawner's "<stream>:<tag>" selection annotation to a pinned image
+# reference from OpenShift ImageStreams. The k8s-native equivalent is an
+# admin-curated ConfigMap catalog: data["images.yaml"] maps
+# ``<stream>: {<tag>: <pinned reference>}``; the webhook rewrites the main
+# container's image (and JUPYTER_IMAGE env) unless it is already
+# digest-pinned (the analogue of the internal-registry short-circuit).
+
+IMAGE_SELECTION_ANNOTATION = nbapi.IMAGE_SELECTION_ANNOTATION
+IMAGE_CATALOG_CONFIGMAP = "notebook-images"
+IMAGE_CATALOG_KEY = "images.yaml"
+
+
+def _catalog_lookup(catalog: dict, stream: str, tag: str) -> str | None:
+    entry = catalog.get(stream)
+    if isinstance(entry, dict):
+        ref = entry.get(tag)
+        if isinstance(ref, str) and ref:
+            return ref
+    return None
+
+
+async def resolve_image_from_catalog(
+    kube,
+    nb: dict,
+    *,
+    namespace: str = "kubeflow-tpu",
+    configmap: str = IMAGE_CATALOG_CONFIGMAP,
+) -> bool:
+    """Rewrite the main container's image from the catalog ConfigMap.
+
+    Returns True when a rewrite happened. Missing catalog / unknown
+    selection are soft no-ops (the reference logs and admits unchanged —
+    the image may be directly pullable without a catalog entry).
+    """
+    selection = annotations_of(nb).get(IMAGE_SELECTION_ANNOTATION)
+    if not selection or ":" not in selection:
+        return False
+    stream, _, tag = selection.rpartition(":")
+    name = deep_get(nb, "metadata", "name")
+    containers = deep_get(nb, "spec", "template", "spec", "containers") or []
+    container = next((c for c in containers if c.get("name") == name), None)
+    if container is None:
+        return False
+    if "@sha256:" in (container.get("image") or ""):
+        return False  # already pinned; nothing to resolve
+    cm = await kube.get_or_none("ConfigMap", configmap, namespace)
+    if cm is None:
+        return False
+    try:
+        import yaml
+
+        catalog = yaml.safe_load((cm.get("data") or {}).get(IMAGE_CATALOG_KEY) or "") or {}
+    except Exception:
+        return False
+    ref = _catalog_lookup(catalog, stream, tag)
+    if ref is None or ref == container.get("image"):
+        return False
+    container["image"] = ref
+    for env in container.get("env") or []:
+        if env.get("name") == "JUPYTER_IMAGE":
+            env["value"] = selection
+            break
+    return True

@@ -28,6 +28,14 @@ def register_all(kube) -> None:
     # CR defaulting (mutators run before validators). The Notebook mutator
     # also enforces restart blocking (webhooks/notebook.py).
     kube.add_mutator("Notebook", nb_webhook.mutate)
+
+    # Image-alias resolution from the catalog ConfigMap (odh's ImageStream
+    # resolution, notebook_webhook.go:539-645, without OpenShift).
+    async def image_resolver(nb: dict, info: dict) -> None:
+        if info.get("operation") in (None, "CREATE", "UPDATE"):
+            await nb_webhook.resolve_image_from_catalog(kube, nb)
+
+    kube.add_mutator("Notebook", image_resolver)
     kube.add_mutator("PVCViewer", lambda v, _i: pvcapi.default(v))
 
     # Profiles applied at an old served version are normalized to storage at

@@ -95,8 +95,11 @@ def create_webhook_app(kube) -> web.Application:
             tpu_webhook.mutate_pod(pod)
 
     # -- CR defaulting/validation (+ restart blocking for Notebooks) --------
-    async def mutate_notebook(_kube, nb, operation, old):
+    async def mutate_notebook(kube, nb, operation, old):
         nb_webhook.mutate(nb, {"operation": operation, "old": old})
+        # Image-alias pinning from the catalog ConfigMap (same engine the
+        # in-process chain registers; see webhooks/notebook.py).
+        await nb_webhook.resolve_image_from_catalog(kube, nb)
 
     async def mutate_pvcviewer(_kube, viewer, _op, _old):
         pvcapi.default(viewer)

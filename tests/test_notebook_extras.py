@@ -190,3 +190,83 @@ async def test_pod_logs_endpoint():
         if client:
             await client.close()
         await stop(kube, mgr, sim)
+
+
+async def test_pipeline_rbac_binding_created_when_role_exists():
+    """odh notebook_rbac.go analogue: a pipelines Role in the namespace gets
+    a notebook-owned RoleBinding for the notebook's ServiceAccount; without
+    the Role, nothing is created."""
+    kube, mgr, sim = await make_harness()
+    try:
+        await kube.create("Role", {
+            "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+            "metadata": {"name": "pipeline-user-access", "namespace": "ns"},
+            "rules": [],
+        })
+        nb = nbapi.new("piped", "ns")
+        nb["spec"]["template"]["spec"]["serviceAccountName"] = "my-sa"
+        await kube.create("Notebook", nb)
+        await settle(mgr)
+        rb = await kube.get("RoleBinding", "pipelines-pipeline-user-access-piped", "ns")
+        assert rb["subjects"] == [
+            {"kind": "ServiceAccount", "name": "my-sa", "namespace": "ns"}
+        ]
+        assert rb["roleRef"]["name"] == "pipeline-user-access"
+        assert get_meta(rb)["ownerReferences"][0]["name"] == "piped"
+
+        # No Role in another namespace -> no binding.
+        await kube.create("Notebook", nbapi.new("plain", "other"))
+        await settle(mgr)
+        assert await kube.get_or_none(
+            "RoleBinding", "pipelines-pipeline-user-access-plain", "other") is None
+    finally:
+        await stop(kube, mgr, sim)
+
+
+async def test_image_alias_resolved_from_catalog():
+    """odh SetContainerImageFromRegistry analogue: the selection annotation
+    resolves through the notebook-images ConfigMap catalog; digest-pinned
+    images are left alone."""
+    kube, mgr, sim = await make_harness()
+    try:
+        await kube.create("ConfigMap", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "notebook-images", "namespace": "kubeflow-tpu"},
+            "data": {"images.yaml": (
+                "jupyter-jax:\n"
+                "  latest: registry.example/jupyter-jax@sha256:abc123\n"
+                "  v2: registry.example/jupyter-jax@sha256:def456\n"
+            )},
+        })
+        nb = nbapi.new("cat", "ns", image="jupyter-jax:latest")
+        get_meta(nb).setdefault("annotations", {})[
+            "notebooks.kubeflow.org/last-image-selection"] = "jupyter-jax:latest"
+        nb["spec"]["template"]["spec"]["containers"][0]["env"] = [
+            {"name": "JUPYTER_IMAGE", "value": "placeholder"}
+        ]
+        await kube.create("Notebook", nb)
+        stored = await kube.get("Notebook", "cat", "ns")
+        c = deep_get(stored, "spec", "template", "spec", "containers")[0]
+        assert c["image"] == "registry.example/jupyter-jax@sha256:abc123"
+        assert c["env"][0]["value"] == "jupyter-jax:latest"
+
+        # Already digest-pinned: admitted unchanged.
+        nb2 = nbapi.new("pinned", "ns",
+                        image="registry.example/x@sha256:feed01")
+        get_meta(nb2).setdefault("annotations", {})[
+            "notebooks.kubeflow.org/last-image-selection"] = "jupyter-jax:v2"
+        await kube.create("Notebook", nb2)
+        stored2 = await kube.get("Notebook", "pinned", "ns")
+        c2 = deep_get(stored2, "spec", "template", "spec", "containers")[0]
+        assert c2["image"] == "registry.example/x@sha256:feed01"
+
+        # Unknown selection: soft no-op.
+        nb3 = nbapi.new("missing", "ns", image="jupyter-jax:v9")
+        get_meta(nb3).setdefault("annotations", {})[
+            "notebooks.kubeflow.org/last-image-selection"] = "jupyter-jax:v9"
+        await kube.create("Notebook", nb3)
+        stored3 = await kube.get("Notebook", "missing", "ns")
+        assert deep_get(stored3, "spec", "template", "spec",
+                        "containers")[0]["image"] == "jupyter-jax:v9"
+    finally:
+        await stop(kube, mgr, sim)

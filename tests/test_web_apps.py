@@ -93,6 +93,14 @@ async def test_jwa_full_lifecycle():
         assert resp.status == 200, await resp.text()
         await h.settle()
 
+        # The form recorded the image selection for the admission catalog
+        # (stock images are tagged, so the annotation must be present).
+        stored_nb = await h.kube.get("Notebook", "my-nb", "team")
+        from kubeflow_tpu.api import notebook as _nbapi
+        sel = deep_get(stored_nb, "metadata", "annotations",
+                       _nbapi.IMAGE_SELECTION_ANNOTATION)
+        assert sel and ":" in sel
+
         # Workspace PVC was created from the config default.
         pvc = await h.kube.get(
             "PersistentVolumeClaim", "my-nb-workspace", "team"

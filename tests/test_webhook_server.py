@@ -143,3 +143,33 @@ async def test_admission_server_rejects_conflicts_and_bad_specs():
         assert patched["spec"]["template"]["spec"]["containers"][0]["name"] == "n"
     finally:
         await client.close()
+
+
+async def test_admission_server_resolves_image_catalog():
+    """/mutate-notebooks pins the spawner's image selection from the
+    notebook-images ConfigMap (the in-process chain and the wire server
+    must share the engine)."""
+    from kubeflow_tpu.api import notebook as nbapi
+
+    kube = FakeKube()
+    await kube.create("ConfigMap", {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "notebook-images", "namespace": "kubeflow-tpu"},
+        "data": {"images.yaml":
+                 "kubeflow-tpu/jupyter-jax:\n  latest: reg.io/jax@sha256:aa\n"},
+    })
+    client = TestClient(TestServer(create_webhook_app(kube)))
+    await client.start_server()
+    try:
+        nb = nbapi.new("wired", "ns", image="kubeflow-tpu/jupyter-jax:latest")
+        nb["metadata"]["annotations"] = {
+            nbapi.IMAGE_SELECTION_ANNOTATION: "kubeflow-tpu/jupyter-jax:latest"}
+        resp = await client.post("/mutate-notebooks", json=admission_review(nb))
+        body = json.loads(await resp.text())
+        assert body["response"]["allowed"]
+        patch = json.loads(base64.b64decode(body["response"]["patch"]))
+        patched = apply(nb, patch)
+        image = patched["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert image == "reg.io/jax@sha256:aa"
+    finally:
+        await client.close()
