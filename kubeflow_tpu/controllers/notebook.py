@@ -826,4 +826,22 @@ def setup_notebook_controller(
             ],
         )
     )
+    if rec.opts.pipeline_access_role:
+        # A pipelines Role appearing AFTER notebooks exist must still get
+        # bindings (the probe cache alone would leave idle notebooks
+        # unbound until some unrelated event): watch Roles, bust the probe
+        # cache, and re-enqueue that namespace's notebooks from the
+        # informer cache.
+        nb_informer = mgr.informer_for("Notebook")
+
+        def role_handler(_event: str, role: dict) -> None:
+            if name_of(role) != rec.opts.pipeline_access_role:
+                return
+            ns = namespace_of(role)
+            rec._role_probe_cache.pop(ns, None)
+            for key in list(nb_informer.cache):
+                if key[0] == ns:
+                    mgr.enqueue("notebook", key)
+
+        mgr.informer_for("Role").add_handler(role_handler)
     return rec

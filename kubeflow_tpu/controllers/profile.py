@@ -408,24 +408,21 @@ def setup_profile_controller(
     )
     if rec.opts.namespace_labels_file:
         # Reference parity: fsnotify on the mounted labels file triggers a
-        # reconcile of ALL profiles (profile_controller.go:368-399). Here a
-        # small mtime poller (ConfigMap symlink swaps change mtime too).
+        # reconcile of ALL profiles (profile_controller.go:368-399). The
+        # watcher is the native inotify library when available (event-driven
+        # wakeups for ConfigMap symlink swaps) and degrades to 2 s mtime
+        # polling with the same interface (utils/fswatch.py).
         async def watch_labels_file():
-            import asyncio
-            import os
+            from kubeflow_tpu.utils.fswatch import FileWatcher
 
-            path = rec.opts.namespace_labels_file
-            last = None
-            while True:
-                try:
-                    mtime = os.stat(path).st_mtime_ns
-                except OSError:
-                    mtime = None
-                if last is not None and mtime != last:
-                    for profile in await mgr.kube.list("Profile"):
-                        mgr.enqueue("profile", (None, name_of(profile)))
-                last = mtime
-                await asyncio.sleep(2.0)
+            watcher = FileWatcher(rec.opts.namespace_labels_file)
+            try:
+                while True:
+                    if await watcher.wait(timeout=2.0):
+                        for profile in await mgr.kube.list("Profile"):
+                            mgr.enqueue("profile", (None, name_of(profile)))
+            finally:
+                watcher.close()
 
         mgr.add_background(watch_labels_file)
     return rec

@@ -73,6 +73,13 @@ IMAGE_CATALOG_CONFIGMAP = "notebook-images"
 IMAGE_CATALOG_KEY = "images.yaml"
 
 
+def _controller_namespace() -> str:
+    """Same installed-namespace contract as cmd/controller_manager.py."""
+    import os
+
+    return os.environ.get("POD_NAMESPACE", "kubeflow-tpu")
+
+
 def _catalog_lookup(catalog: dict, stream: str, tag: str) -> str | None:
     entry = catalog.get(stream)
     if isinstance(entry, dict):
@@ -86,7 +93,7 @@ async def resolve_image_from_catalog(
     kube,
     nb: dict,
     *,
-    namespace: str = "kubeflow-tpu",
+    namespace: str | None = None,
     configmap: str = IMAGE_CATALOG_CONFIGMAP,
 ) -> bool:
     """Rewrite the main container's image from the catalog ConfigMap.
@@ -106,7 +113,9 @@ async def resolve_image_from_catalog(
         return False
     if "@sha256:" in (container.get("image") or ""):
         return False  # already pinned; nothing to resolve
-    cm = await kube.get_or_none("ConfigMap", configmap, namespace)
+    cm = await kube.get_or_none(
+        "ConfigMap", configmap, namespace or _controller_namespace()
+    )
     if cm is None:
         return False
     try:

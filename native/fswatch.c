@@ -1,0 +1,61 @@
+/* Inotify-based directory watcher for config hot-reload.
+ *
+ * Native parity note: the reference's profile-controller hot-reloads its
+ * mounted namespace-labels file through fsnotify (profile_controller.go:
+ * 368-399), a native inotify binding. This is the same primitive for the
+ * TPU rebuild's runtime: watch the *directory* containing a mounted config
+ * file — Kubernetes ConfigMap updates are atomic symlink swaps of the
+ * ..data directory, which surface as IN_CREATE/IN_MOVED_TO/IN_DELETE on
+ * the mount dir, not IN_MODIFY on the file — and wake the caller, who then
+ * re-stats the file of interest.
+ *
+ * Built as libkfswatch.so (native/Makefile) and loaded via ctypes from
+ * kubeflow_tpu/utils/fswatch.py, which falls back to mtime polling when
+ * the library is unavailable (non-Linux, no compiler).
+ *
+ * API (all errors return -1, errno left set):
+ *   kfs_watch_open(dir)          -> inotify fd watching dir
+ *   kfs_watch_wait(fd, timeout)  -> 1 events drained, 0 timeout, -1 error
+ *   kfs_watch_close(fd)
+ */
+
+#include <errno.h>
+#include <poll.h>
+#include <sys/inotify.h>
+#include <unistd.h>
+
+#define KFS_EVENTS                                                         \
+    (IN_CLOSE_WRITE | IN_MOVED_TO | IN_MOVED_FROM | IN_CREATE | IN_DELETE | \
+     IN_ATTRIB | IN_MODIFY | IN_DELETE_SELF | IN_MOVE_SELF)
+
+int kfs_watch_open(const char *dir) {
+    int fd = inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+    if (fd < 0) return -1;
+    if (inotify_add_watch(fd, dir, KFS_EVENTS) < 0) {
+        int saved = errno;
+        close(fd);
+        errno = saved;
+        return -1;
+    }
+    return fd;
+}
+
+int kfs_watch_wait(int fd, int timeout_ms) {
+    struct pollfd pfd = {.fd = fd, .events = POLLIN};
+    int rc;
+    do {
+        rc = poll(&pfd, 1, timeout_ms);
+    } while (rc < 0 && errno == EINTR);
+    if (rc <= 0) return rc; /* 0 timeout, -1 error */
+
+    /* Drain everything queued so the next wait blocks afresh. */
+    char buf[4096];
+    ssize_t n;
+    do {
+        n = read(fd, buf, sizeof buf);
+    } while (n > 0);
+    if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) return -1;
+    return 1;
+}
+
+void kfs_watch_close(int fd) { close(fd); }

@@ -270,3 +270,26 @@ async def test_image_alias_resolved_from_catalog():
                         "containers")[0]["image"] == "jupyter-jax:v9"
     finally:
         await stop(kube, mgr, sim)
+
+
+async def test_pipeline_role_created_after_notebook_triggers_binding():
+    """Installing pipelines AFTER notebooks exist must still bind them: the
+    Role watch busts the probe cache and re-enqueues the namespace."""
+    kube, mgr, sim = await make_harness()
+    try:
+        await kube.create("Notebook", nbapi.new("early", "ns"))
+        await settle(mgr)
+        assert await kube.get_or_none(
+            "RoleBinding", "pipelines-pipeline-user-access-early", "ns") is None
+
+        await kube.create("Role", {
+            "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+            "metadata": {"name": "pipeline-user-access", "namespace": "ns"},
+            "rules": [],
+        })
+        await settle(mgr)
+        rb = await kube.get(
+            "RoleBinding", "pipelines-pipeline-user-access-early", "ns")
+        assert rb["roleRef"]["name"] == "pipeline-user-access"
+    finally:
+        await stop(kube, mgr, sim)
