@@ -37,13 +37,23 @@ BENCH_MODEL = dict(
 )
 
 
+SCALE_NOTEBOOKS = 200
+
+
 async def spawn_notebook() -> dict:
-    """CR create → Ready on the in-process control plane; returns timings."""
+    """CR create → Ready on the in-process control plane; returns timings.
+
+    Also runs the N-notebook load test (testing/loadtest.py, the harness
+    the reference ships without ever recording numbers — SURVEY.md §6) and
+    folds reconcile throughput + ready-latency percentiles into the bench
+    line, so control-plane scale regressions show up next to MFU.
+    """
     from kubeflow_tpu.api import notebook as nbapi
     from kubeflow_tpu.controllers.notebook import setup_notebook_controller
     from kubeflow_tpu.runtime.manager import Manager
     from kubeflow_tpu.runtime.objects import deep_get
     from kubeflow_tpu.testing.fakekube import FakeKube
+    from kubeflow_tpu.testing.loadtest import run_load_test
     from kubeflow_tpu.testing.podsim import PodSimulator
     from kubeflow_tpu.webhooks import register_all
 
@@ -66,12 +76,32 @@ async def spawn_notebook() -> dict:
             ready = time.perf_counter() - t0
             break
         await asyncio.sleep(0.005)
+
+    report = await run_load_test(
+        kube, count=SCALE_NOTEBOOKS, accelerator="v5e", topology="2x2",
+        timeout=120,
+    )
+
     await sim.stop()
     await mgr.stop()
     kube.close_watches()
     if ready is None:
         raise RuntimeError("notebook never became Ready")
-    return {"spawn_sec": ready}
+    if report.ready != SCALE_NOTEBOOKS:
+        raise RuntimeError(
+            f"load test: only {report.ready}/{SCALE_NOTEBOOKS} ready "
+            f"(failures: {report.failures[:3]})"
+        )
+    return {
+        "spawn_sec": ready,
+        "scale": {
+            "notebooks": report.notebooks,
+            "wall_sec": round(report.wall_seconds, 3),
+            "notebooks_per_sec": round(report.notebooks / report.wall_seconds, 1),
+            "p50_ready_sec": round(report.p50_ready_seconds, 4),
+            "p95_ready_sec": round(report.p95_ready_seconds, 4),
+        },
+    }
 
 
 def train_step_flops(cfg, batch: int) -> float:
@@ -183,6 +213,7 @@ def bench() -> dict:
         "step_flops": flops,
         "coldstart_to_first_step_sec": round(coldstart_sec, 3),
         "control_plane_spawn_sec": round(spawn["spawn_sec"], 4),
+        "control_plane_scale": spawn["scale"],
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "n_devices": len(devices),
         "backend": jax.default_backend(),

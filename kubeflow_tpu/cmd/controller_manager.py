@@ -73,7 +73,7 @@ async def amain() -> None:
 
         elector = LeaderElector(
             kube,
-            namespace=os.environ.get("POD_NAMESPACE", "kubeflow-tpu"),
+            namespace=envconfig.controller_namespace(),
             identity=os.environ.get("POD_NAME") or None,
         )
         log.info("waiting for leader election as %s", elector.identity)

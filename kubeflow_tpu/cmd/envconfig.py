@@ -29,6 +29,13 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def controller_namespace() -> str:
+    """The namespace this stack is installed in (downward-API POD_NAMESPACE)
+    — the single definition of the default; webhook catalog lookups, leader
+    election, and CA-bundle mirroring must all agree on it."""
+    return os.environ.get("POD_NAMESPACE", "kubeflow-tpu")
+
+
 def notebook_options():
     from kubeflow_tpu.controllers.notebook import NotebookOptions
 

@@ -124,8 +124,12 @@ class NotebookReconciler:
         # re-emit would bump the mirrored count once per reconcile, turning
         # it into a reconcile-frequency counter).
         self._mirrored: dict[tuple, dict[str, int]] = {}
-        # ns → (role exists, checked-at); see _namespace_has_role.
+        # ns → (role exists, checked-at); see _namespace_has_role. The
+        # generation counter closes the TOCTOU between an in-flight probe
+        # and the Role watch busting the cache: a probe only writes its
+        # result back if no Role event landed while it was awaiting.
         self._role_probe_cache: dict[str, tuple[bool, float]] = {}
+        self._role_probe_gen: dict[str, int] = {}
         self._role_probe_ttl = 60.0
         registry = registry or global_registry
         # Metric names match the reference (pkg/metrics/metrics.go:14-62) so
@@ -223,8 +227,10 @@ class NotebookReconciler:
         cached = self._role_probe_cache.get(ns)
         if cached and now - cached[1] < self._role_probe_ttl:
             return cached[0]
+        gen = self._role_probe_gen.get(ns, 0)
         exists = await self.kube.get_or_none("Role", role_name, ns) is not None
-        self._role_probe_cache[ns] = (exists, now)
+        if self._role_probe_gen.get(ns, 0) == gen:
+            self._role_probe_cache[ns] = (exists, now)
         return exists
 
     async def _ensure(self, nb: dict, desired: dict) -> bool:
@@ -838,6 +844,7 @@ def setup_notebook_controller(
             if name_of(role) != rec.opts.pipeline_access_role:
                 return
             ns = namespace_of(role)
+            rec._role_probe_gen[ns] = rec._role_probe_gen.get(ns, 0) + 1
             rec._role_probe_cache.pop(ns, None)
             for key in list(nb_informer.cache):
                 if key[0] == ns:

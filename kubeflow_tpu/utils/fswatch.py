@@ -61,6 +61,13 @@ def _load_library():
                 check=True, capture_output=True, timeout=60,
             )
             _lib = _bind(ctypes.CDLL(target))
+            # Linux keeps the mapping alive after unlink — clean the temp
+            # dir now so crash-looping processes don't accumulate them.
+            try:
+                os.unlink(target)
+                os.rmdir(build_dir)
+            except OSError:
+                pass
         except (OSError, subprocess.SubprocessError) as e:
             log.debug("native fswatch unavailable (%s); falling back to polling", e)
             _lib = None
@@ -93,11 +100,15 @@ class FileWatcher:
         self._last = self._mtime()
         self._fd: int | None = None
         self._setup_done = False
-        # Serializes kfs_watch_wait against close(): closing the inotify fd
-        # while an executor thread is blocked in poll()/read() would leave
-        # that thread draining whatever descriptor the kernel reassigns
-        # the number to.
+        # Close/wait/setup coordination: the fd may only be closed when no
+        # executor thread is inside kfs_watch_wait (the kernel could
+        # reassign the number under a blocked poll), and close() must not
+        # block the event loop waiting for that poll — so the closing
+        # thread hands the actual close() off to whichever side holds the
+        # fd last (_closing flag).
         self._io_lock = threading.Lock()
+        self._in_wait = False
+        self._closing = False
 
     @property
     def native(self) -> bool:
@@ -109,10 +120,14 @@ class FileWatcher:
         if lib is None:
             return
         fd = lib.kfs_watch_open(os.path.dirname(self.path).encode() or b".")
-        if fd >= 0:
-            self._fd = fd
-        else:
+        if fd < 0:
             log.debug("inotify watch failed for %s; polling", self.path)
+            return
+        with self._io_lock:
+            if self._closing:
+                lib.kfs_watch_close(fd)  # close() raced the lazy setup
+            else:
+                self._fd = fd
 
     def _mtime(self):
         try:
@@ -129,9 +144,18 @@ class FileWatcher:
 
     def _wait_native(self, timeout_ms: int) -> int:
         with self._io_lock:
-            if self._fd is None:
+            if self._fd is None or self._closing:
                 return 0
-            return _load_library().kfs_watch_wait(self._fd, timeout_ms)
+            self._in_wait = True
+            fd = self._fd
+        try:
+            return _load_library().kfs_watch_wait(fd, timeout_ms)
+        finally:
+            with self._io_lock:
+                self._in_wait = False
+                if self._closing and self._fd is not None:
+                    _load_library().kfs_watch_close(self._fd)
+                    self._fd = None
 
     async def wait(self, timeout: float = 2.0) -> bool:
         """Wait up to ``timeout`` seconds for a change to ``path``."""
@@ -148,7 +172,10 @@ class FileWatcher:
         return self._changed()
 
     def close(self) -> None:
+        """Non-blocking: if a wait is in flight on an executor thread, that
+        thread performs the actual fd close when its poll returns."""
         with self._io_lock:
-            if self._fd is not None:
+            self._closing = True
+            if not self._in_wait and self._fd is not None:
                 _load_library().kfs_watch_close(self._fd)
                 self._fd = None

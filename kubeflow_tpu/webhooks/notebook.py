@@ -74,10 +74,9 @@ IMAGE_CATALOG_KEY = "images.yaml"
 
 
 def _controller_namespace() -> str:
-    """Same installed-namespace contract as cmd/controller_manager.py."""
-    import os
+    from kubeflow_tpu.cmd.envconfig import controller_namespace
 
-    return os.environ.get("POD_NAMESPACE", "kubeflow-tpu")
+    return controller_namespace()
 
 
 def _catalog_lookup(catalog: dict, stream: str, tag: str) -> str | None:
