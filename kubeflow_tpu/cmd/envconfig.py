@@ -45,6 +45,12 @@ def notebook_options():
         istio_host=env_str("ISTIO_HOST", "*"),
         cluster_domain=env_str("CLUSTER_DOMAIN", "cluster.local"),
         add_fsgroup=env_bool("ADD_FSGROUP", True),
+        controller_namespace=controller_namespace(),
+        create_network_policies=env_bool("CREATE_NETWORK_POLICIES", False),
+        trusted_ca_configmap=os.environ.get("TRUSTED_CA_BUNDLE_CONFIGMAP"),
+        auth_proxy_image=os.environ.get("AUTH_PROXY_IMAGE"),
+        pipeline_access_role=env_str("PIPELINE_ACCESS_ROLE",
+                                     "pipeline-user-access") or None,
     )
 
 

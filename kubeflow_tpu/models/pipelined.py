@@ -1,21 +1,28 @@
-"""Pipelined burn-in: the pipeline-parallel variant of the workload.
+"""Pipelined burn-in: pipeline-parallel (optionally ×tensor-parallel).
 
 Same decoder architecture as :mod:`kubeflow_tpu.models.burnin`, but the
 layer stack is split into contiguous stages over a "stage" mesh axis and
 microbatches flow through a GPipe schedule
-(:mod:`kubeflow_tpu.parallel.pipeline`). Per-chip parameter memory is
-O(n_layers / n_stages); cross-chip traffic is one activation block per
-schedule tick on neighbour ICI links plus the loss/grad reductions.
+(:mod:`kubeflow_tpu.parallel.pipeline`). When the mesh also carries a
+"model" axis, each stage's matmuls are Megatron-style tensor-parallel —
+qkv/ff1 column-sharded, attn_out/ff2 row-sharded with one psum each — so a
+single train step composes **dp × pp × tp** (the 3D parallelism recipe of
+the scaling literature, PAPERS.md) with:
+
+- neighbour ``ppermute`` hops on the stage axis (activations),
+- ``psum`` all-reduces on the model axis (two per layer),
+- gradient reduction on the data axis via shard_map's varying-axes
+  transpose (no hand-written collectives).
 
 Layer parameters are *stacked* — every leaf gets a leading ``n_layers``
-dimension sharded ``P("stage", ...)`` — so the whole stack is one array per
-weight kind and each device's shard is exactly its stage's slice. Inside a
-stage the local layers run under ``lax.scan`` (one compiled layer body, no
-unrolling).
+dimension sharded ``P("stage", ...)`` — and attention weights use the
+head-split layout (``qkv [L, d, 3, heads, head_dim]``) so the tp shard
+boundary falls on whole heads.
 
-Reference parity: the reference has no pipeline-parallel code anywhere
-(SURVEY.md §2.4); this model is part of the slice-validation suite
-(burnin = dp+tp, longctx = dp+sp, moe = dp+ep, pipelined = dp+pp).
+Reference parity: the reference has no parallelism code anywhere
+(SURVEY.md §2.4); this model completes the slice-validation suite
+(burnin = dp+tp via GSPMD, longctx = dp+sp, moe = dp+ep,
+pipelined = dp+pp[+tp] via shard_map).
 """
 
 from __future__ import annotations
@@ -27,8 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_tpu.models.burnin import _attention, _rmsnorm
+from kubeflow_tpu.models.burnin import _rmsnorm
 from kubeflow_tpu.parallel.pipeline import pipeline_apply, pipeline_spans
+from kubeflow_tpu.parallel.ring import reference_causal_attention
 
 try:
     from jax import shard_map
@@ -40,13 +48,12 @@ except ImportError:  # pragma: no cover
 class PipelinedConfig:
     vocab: int = 256
     d_model: int = 128
-    n_heads: int = 4
+    n_heads: int = 4             # must divide by the model-axis size
     n_layers: int = 4            # must divide by n_stages
-    d_ff: int = 512
+    d_ff: int = 512              # must divide by the model-axis size
     seq_len: int = 128
     n_micro: int = 4             # microbatches per global batch
     dtype: str = "bfloat16"
-    attention: str = "xla"       # burnin._attention duck-types on this
 
     @property
     def head_dim(self) -> int:
@@ -55,31 +62,36 @@ class PipelinedConfig:
 
 
 def init_params(rng: jax.Array, cfg: PipelinedConfig) -> dict:
-    """Layer-stacked pytree: layers["qkv"] is [n_layers, d_model, 3d] etc."""
+    """Layer-stacked pytree, attention in head-split layout:
+    qkv [L, d, 3, H, hd], attn_out [L, H, hd, d]."""
 
-    def dense(key, shape, scale=None):
-        scale = scale if scale is not None else (1.0 / shape[-2]) ** 0.5
+    def dense(key, shape, fan_in, scale=None):
+        scale = scale if scale is not None else (1.0 / fan_in) ** 0.5
         return jax.random.normal(key, shape, jnp.float32) * scale
 
-    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    L, D, F, H, hd = (cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_heads,
+                      cfg.head_dim)
     keys = iter(jax.random.split(rng, 6))
     return {
-        "embed": dense(next(keys), (cfg.vocab, D), scale=0.02),
-        "pos": dense(next(keys), (cfg.seq_len, D), scale=0.02),
+        "embed": dense(next(keys), (cfg.vocab, D), D, scale=0.02),
+        "pos": dense(next(keys), (cfg.seq_len, D), D, scale=0.02),
         "out_norm": jnp.ones((D,), jnp.float32),
         "layers": {
             "ln1": jnp.ones((L, D), jnp.float32),
             "ln2": jnp.ones((L, D), jnp.float32),
-            "qkv": dense(next(keys), (L, D, 3 * D)),
-            "attn_out": dense(next(keys), (L, D, D)),
-            "ff1": dense(next(keys), (L, D, F)),
-            "ff2": dense(next(keys), (L, F, D), scale=(1.0 / F) ** 0.5),
+            "qkv": dense(next(keys), (L, D, 3, H, hd), D),
+            "attn_out": dense(next(keys), (L, H, hd, D), D),
+            "ff1": dense(next(keys), (L, D, F), D),
+            "ff2": dense(next(keys), (L, F, D), F),
         },
     }
 
 
-def param_sharding_rules(cfg: PipelinedConfig) -> dict:
-    """Stage-sharded layer stack; small embeddings/norms replicated."""
+def param_sharding_rules(cfg: PipelinedConfig,
+                         model_axis: str | None = None) -> dict:
+    """Stage-sharded layer stack; tp shards heads / ff width when the mesh
+    has a model axis; small embeddings/norms replicated."""
+    m = model_axis
     return {
         "embed": P(),
         "pos": P(),
@@ -87,32 +99,62 @@ def param_sharding_rules(cfg: PipelinedConfig) -> dict:
         "layers": {
             "ln1": P("stage", None),
             "ln2": P("stage", None),
-            "qkv": P("stage", None, None),
-            "attn_out": P("stage", None, None),
-            "ff1": P("stage", None, None),
-            "ff2": P("stage", None, None),
+            "qkv": P("stage", None, None, m, None),       # shard heads
+            "attn_out": P("stage", m, None, None),        # row-parallel
+            "ff1": P("stage", None, m),                   # column-parallel
+            "ff2": P("stage", m, None),                   # row-parallel
         },
     }
 
 
+def _mesh_model_axis(mesh: Mesh, model_axis: str = "model") -> str | None:
+    return model_axis if model_axis in mesh.axis_names else None
+
+
 def shard_params(params: dict, mesh: Mesh, cfg: PipelinedConfig,
-                 stage_axis: str = "stage") -> dict:
+                 stage_axis: str = "stage",
+                 model_axis: str = "model") -> dict:
     pipeline_spans(cfg.n_layers, mesh.shape[stage_axis])  # clear divisibility error
-    rules = param_sharding_rules(cfg)
+    m = _mesh_model_axis(mesh, model_axis)
+    if m is not None:
+        if cfg.n_heads % mesh.shape[m] or cfg.d_ff % mesh.shape[m]:
+            raise ValueError(
+                f"n_heads={cfg.n_heads} and d_ff={cfg.d_ff} must divide by "
+                f"model-axis size {mesh.shape[m]}"
+            )
+    rules = param_sharding_rules(cfg, m)
     return jax.tree.map(
         lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
         params, rules, is_leaf=lambda x: isinstance(x, P),
     )
 
 
-def _stage_fn(cfg: PipelinedConfig):
-    """One stage = lax.scan of the transformer layer over the local slice."""
+def _stage_fn(cfg: PipelinedConfig, model_axis: str | None = None):
+    """One stage = lax.scan of the transformer layer over the local slice.
+
+    With a model axis, runs the Megatron pattern per layer: local heads /
+    local ff columns, then one psum for each row-parallel projection.
+    Activations stay replicated across the model axis.
+    """
 
     def layer_body(h, layer):
-        h = h + _attention(_rmsnorm(h, layer["ln1"]), layer, cfg)
+        dtype = h.dtype
+        # Attention over this shard's heads.
+        x = _rmsnorm(h, layer["ln1"])
+        qkv = jnp.einsum("bsd,dthc->bsthc", x, layer["qkv"].astype(dtype))
+        q, k, v = (qkv[:, :, i] for i in range(3))        # [mb, s, Hloc, hd]
+        ctx = reference_causal_attention(q, k, v)          # causal softmax
+        attn = jnp.einsum("bshc,hcd->bsd", ctx, layer["attn_out"].astype(dtype))
+        if model_axis is not None:
+            attn = jax.lax.psum(attn, model_axis)
+        h = h + attn
+        # FF over this shard's columns.
         g = _rmsnorm(h, layer["ln2"])
-        g = jax.nn.gelu(g @ layer["ff1"].astype(h.dtype))
-        return h + g @ layer["ff2"].astype(h.dtype), None
+        g = jax.nn.gelu(g @ layer["ff1"].astype(dtype))
+        out = g @ layer["ff2"].astype(dtype)
+        if model_axis is not None:
+            out = jax.lax.psum(out, model_axis)
+        return h + out, None
 
     def run(local_layers, h):
         h, _ = jax.lax.scan(layer_body, h, local_layers)
@@ -123,7 +165,7 @@ def _stage_fn(cfg: PipelinedConfig):
 
 def reference_loss(params: dict, tokens: jax.Array, cfg: PipelinedConfig):
     """Unpipelined single-device loss on the same stacked params — the
-    correctness oracle for the schedule (tests assert allclose)."""
+    correctness oracle for the schedule and the tp psums."""
     dtype = jnp.dtype(cfg.dtype)
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
     s = inp.shape[1]
@@ -136,21 +178,26 @@ def reference_loss(params: dict, tokens: jax.Array, cfg: PipelinedConfig):
 
 
 def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
-                    data_axis: str = "data", stage_axis: str = "stage"):
-    """(params, tokens) -> (params, loss) over a (data, stage) mesh.
+                    data_axis: str = "data", stage_axis: str = "stage",
+                    model_axis: str = "model"):
+    """(params, tokens) -> (params, loss) over a (data, stage[, model]) mesh.
 
-    Grad bookkeeping: none by hand. Replicated leaves (embed/pos/out_norm)
-    get contributions from stage 0 (input path — the ``where(idx==0)``
-    inject confines it there) and the last stage (output projection), and
-    shard_map's varying-axes machinery reduces them across the mesh in the
-    transpose (see the comment in ``local_loss``), keeping replicas in
-    lockstep without explicit psums.
+    Grad bookkeeping: none by hand. Params enter less-varying than the
+    activations they meet; shard_map's varying-axes machinery inserts
+    ``pvary`` casts whose transpose psums the cotangents over exactly the
+    axes each leaf is replicated on (measured: a manual psum on top
+    double-counts by the axis size). The only explicit collectives are the
+    forward ones: stage ppermute, model psum.
     """
     n_stages = mesh.shape[stage_axis]
     pipeline_spans(cfg.n_layers, n_stages)  # clear divisibility error up front
     has_data = data_axis in mesh.axis_names
-    stage_run = _stage_fn(cfg)
+    m = _mesh_model_axis(mesh, model_axis)
+    stage_run = _stage_fn(cfg, m)
     mesh_axes = tuple(mesh.axis_names)
+    # Every device computes the full (replicated-over-model) loss; scale so
+    # the global sum over devices equals the data-parallel mean.
+    dup = (mesh.shape[data_axis] if has_data else 1) * (mesh.shape[m] if m else 1)
 
     def local_loss(params, tokens):
         dtype = jnp.dtype(cfg.dtype)
@@ -171,31 +218,21 @@ def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
         idx = jax.lax.axis_index(stage_axis)
-        # Per-device masked loss with NO collectives: under shard_map's
-        # varying-axes (vma) tracking, differentiating this per-device
-        # scalar already yields fully-reduced gradients — params enter
-        # less-varying than the activations they meet, jax auto-inserts
-        # ``pvary`` casts, and a pvary's transpose is a psum over the added
-        # axes. Any manual grad psum here would double-count (measured:
-        # exactly n_stages× on the replicated embed table). The where()
-        # zeroes bubble-stage gradients; the 1/n_data prescale turns the
-        # implicit data-axis grad psum into the data-parallel mean.
-        local = jnp.where(idx == n_stages - 1, nll, 0.0)
-        if has_data:
-            local = local / mesh.shape[data_axis]
-        return local
+        return jnp.where(idx == n_stages - 1, nll, 0.0) / dup
 
     def local_step(params, tokens):
         loss, grads = jax.value_and_grad(local_loss)(params, tokens)
+        new = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
         # Only the loss *value* still needs reducing (it is per-device:
-        # nonzero on the last stage's shards only).
+        # nonzero on the last stage's shards only, prescaled by 1/dup).
         loss = jax.lax.psum(loss, stage_axis)
         if has_data:
             loss = jax.lax.psum(loss, data_axis)
-        new = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        if m is not None:
+            loss = jax.lax.psum(loss, m)
         return new, loss
 
-    rules = param_sharding_rules(cfg)
+    rules = param_sharding_rules(cfg, m)
     tok_spec = P(data_axis if has_data else None, None)
     return shard_map(
         local_step,
@@ -205,13 +242,23 @@ def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
     )
 
 
-def make_pp_mesh(devices=None, n_stages: int = 2,
-                 data_axis: str = "data", stage_axis: str = "stage") -> Mesh:
-    """(data, stage) mesh; stage rides the fastest (innermost) links."""
+def make_pp_mesh(devices=None, n_stages: int = 2, n_model: int = 1,
+                 data_axis: str = "data", stage_axis: str = "stage",
+                 model_axis: str = "model") -> Mesh:
+    """(data, stage[, model]) mesh; model rides the innermost (fastest)
+    links, stage next — matching collective intensity (psum per layer vs
+    one ppermute per schedule tick)."""
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
-    if len(devices) % n_stages:
-        raise ValueError(f"{len(devices)} devices not divisible into {n_stages} stages")
-    grid = np.asarray(devices).reshape(len(devices) // n_stages, n_stages)
+    if len(devices) % (n_stages * n_model):
+        raise ValueError(
+            f"{len(devices)} devices not divisible into "
+            f"{n_stages} stages x {n_model} model shards"
+        )
+    data = len(devices) // (n_stages * n_model)
+    if n_model > 1:
+        grid = np.asarray(devices).reshape(data, n_stages, n_model)
+        return Mesh(grid, (data_axis, stage_axis, model_axis))
+    grid = np.asarray(devices).reshape(data, n_stages)
     return Mesh(grid, (data_axis, stage_axis))

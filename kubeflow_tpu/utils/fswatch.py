@@ -107,7 +107,7 @@ class FileWatcher:
         # thread hands the actual close() off to whichever side holds the
         # fd last (_closing flag).
         self._io_lock = threading.Lock()
-        self._in_wait = False
+        self._in_wait = 0  # count of executor threads inside kfs_watch_wait
         self._closing = False
 
     @property
@@ -146,14 +146,14 @@ class FileWatcher:
         with self._io_lock:
             if self._fd is None or self._closing:
                 return 0
-            self._in_wait = True
+            self._in_wait += 1
             fd = self._fd
         try:
             return _load_library().kfs_watch_wait(fd, timeout_ms)
         finally:
             with self._io_lock:
-                self._in_wait = False
-                if self._closing and self._fd is not None:
+                self._in_wait -= 1
+                if self._closing and self._in_wait == 0 and self._fd is not None:
                     _load_library().kfs_watch_close(self._fd)
                     self._fd = None
 
@@ -176,6 +176,6 @@ class FileWatcher:
         thread performs the actual fd close when its poll returns."""
         with self._io_lock:
             self._closing = True
-            if not self._in_wait and self._fd is not None:
+            if self._in_wait == 0 and self._fd is not None:
                 _load_library().kfs_watch_close(self._fd)
                 self._fd = None

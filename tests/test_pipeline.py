@@ -10,15 +10,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh
 
 from kubeflow_tpu.models import pipelined
 from kubeflow_tpu.parallel.pipeline import pipeline_spans, stage_ring_perm
 
 
-def _mesh(data: int, stage: int) -> Mesh:
-    devs = jax.devices()[: data * stage]
-    return Mesh(np.asarray(devs).reshape(data, stage), ("data", "stage"))
+def _mesh(data: int, stage: int, model: int = 1):
+    # Production mesh builder — tests must validate the same axis layout
+    # the framework constructs.
+    return pipelined.make_pp_mesh(
+        jax.devices()[: data * stage * model], n_stages=stage, n_model=model
+    )
 
 
 def test_spans_and_perm():
@@ -28,13 +30,18 @@ def test_spans_and_perm():
         pipeline_spans(7, 2)
 
 
-@pytest.mark.parametrize("data,stage", [(1, 2), (2, 2), (1, 4), (2, 4)])
-def test_pipelined_loss_matches_oracle(data, stage):
+@pytest.mark.parametrize("data,stage,model", [
+    (1, 2, 1), (2, 2, 1), (1, 4, 1), (2, 4, 1),
+    (1, 2, 2),   # pp × tp
+    (2, 2, 2),   # dp × pp × tp — full 3D
+    (1, 2, 4),   # wide tp
+])
+def test_pipelined_loss_matches_oracle(data, stage, model):
     cfg = pipelined.PipelinedConfig(
         vocab=64, d_model=32, n_heads=4, n_layers=stage * 2, d_ff=64,
         seq_len=17, n_micro=2, dtype="float32",
     )
-    mesh = _mesh(data, stage)
+    mesh = _mesh(data, stage, model)
     params = pipelined.init_params(jax.random.key(0), cfg)
     tokens = jax.random.randint(
         jax.random.key(1), (4 * data, cfg.seq_len), 0, cfg.vocab
@@ -49,16 +56,18 @@ def test_pipelined_loss_matches_oracle(data, stage):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_pipelined_grads_match_oracle():
-    """One SGD step pipelined == one SGD step on the oracle (all leaves)."""
-    stage = 2
+@pytest.mark.parametrize("data,stage,model", [(2, 2, 1), (2, 2, 2)])
+def test_pipelined_grads_match_oracle(data, stage, model):
+    """One SGD step pipelined == one SGD step on the oracle (all leaves),
+    with and without the tensor-parallel model axis."""
     cfg = pipelined.PipelinedConfig(
         vocab=32, d_model=16, n_heads=2, n_layers=4, d_ff=32,
         seq_len=9, n_micro=2, dtype="float32",
     )
-    mesh = _mesh(2, stage)
+    mesh = _mesh(data, stage, model)
     params = pipelined.init_params(jax.random.key(2), cfg)
-    tokens = jax.random.randint(jax.random.key(3), (8, cfg.seq_len), 0, cfg.vocab)
+    tokens = jax.random.randint(jax.random.key(3), (4 * data, cfg.seq_len),
+                                0, cfg.vocab)
 
     lr = 1e-2
     loss_o, grads_o = jax.value_and_grad(pipelined.reference_loss)(
