@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Conformance runner: 12 checks, one JSON line each + a summary line.
+"""Conformance runner: 16 checks, one JSON line each + a summary line.
 
 Hermetic by default (in-process fake cluster + controllers); ``--live``
 targets the current kubeconfig/proxy endpoint instead and skips the checks
@@ -240,6 +240,96 @@ class Conformance:
         assert not await authz.check(
             "friend@example.com", "list", "Notebook", "conf-authz")
 
+    async def check_profile_v1beta1(self):
+        """Profile served at v1beta1 normalizes to storage v1 (round 3)."""
+        p = profileapi.new("conf-beta", "beta@example.com")
+        p["apiVersion"] = "kubeflow.org/v1beta1"
+        await self.kube.create("Profile", p)
+        await self.settle()
+        stored = await self.kube.get("Profile", "conf-beta")
+        assert stored["apiVersion"] == profileapi.STORAGE_API_VERSION, (
+            stored["apiVersion"])
+        back = profileapi.convert(stored, "kubeflow.org/v1beta1")
+        assert back["apiVersion"] == "kubeflow.org/v1beta1"
+        await self.kube.delete("Profile", "conf-beta")
+
+    async def check_image_catalog(self):
+        """The spawner's image selection pins from the catalog ConfigMap at
+        admission (odh ImageStream resolution, rebuilt k8s-native)."""
+        from kubeflow_tpu.cmd.envconfig import controller_namespace
+
+        ns = controller_namespace()
+        if await self.kube.get_or_none("ConfigMap", "notebook-images", ns):
+            raise Skip("cluster already has a notebook-images catalog; "
+                       "not overwriting the admin's")
+        await self.kube.create("ConfigMap", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "notebook-images", "namespace": ns},
+            "data": {"images.yaml":
+                     "conf/jax:\n  latest: conf.io/jax@sha256:c0ffee\n"},
+        })
+        try:
+            nb = nbapi.new("conf-cat", NS, image="conf/jax:latest")
+            get_meta(nb).setdefault("annotations", {})[
+                nbapi.IMAGE_SELECTION_ANNOTATION] = "conf/jax:latest"
+            await self.kube.create("Notebook", nb)
+            stored = await self.kube.get("Notebook", "conf-cat", NS)
+            image = deep_get(stored, "spec", "template", "spec",
+                             "containers")[0]["image"]
+            assert image == "conf.io/jax@sha256:c0ffee", image
+            await self.kube.delete("Notebook", "conf-cat", NS)
+        finally:
+            await self.kube.delete("ConfigMap", "notebook-images", ns)
+
+    async def check_pipeline_rbac(self):
+        """A pipelines Role in the namespace earns the notebook's SA an
+        owned RoleBinding (odh notebook_rbac.go analogue)."""
+        created_role = await self.kube.get_or_none(
+            "Role", "pipeline-user-access", NS) is None
+        if created_role:
+            await self.kube.create("Role", {
+                "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+                "metadata": {"name": "pipeline-user-access", "namespace": NS},
+                "rules": [],
+            })
+        try:
+            await self.kube.create("Notebook", nbapi.new("conf-rbac", NS))
+            await self.settle()
+            rb = await self.kube.get_or_none(
+                "RoleBinding", "pipelines-pipeline-user-access-conf-rbac", NS)
+            assert rb is not None, "pipeline RoleBinding not created"
+            assert rb["subjects"][0]["kind"] == "ServiceAccount"
+            await self.kube.delete("Notebook", "conf-rbac", NS)
+        finally:
+            if created_role:
+                await self.kube.delete("Role", "pipeline-user-access", NS)
+
+    async def check_pipeline_parallel_step(self):
+        """The dp×pp(×tp) train step compiles and runs on this host's
+        devices (needs ≥2; CI provides the virtual 8-device CPU mesh)."""
+        import jax
+
+        if len(jax.devices()) < 2:
+            raise Skip("needs >=2 jax devices (CI forces an 8-device CPU mesh)")
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import pipelined
+
+        n = min(len(jax.devices()), 8)
+        n_model = 2 if n >= 8 else 1
+        if n % (2 * n_model):
+            n = n - (n % (2 * n_model))  # largest usable subset (odd counts)
+        mesh = pipelined.make_pp_mesh(jax.devices()[:n], n_stages=2,
+                                      n_model=n_model)
+        cfg = pipelined.PipelinedConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+            seq_len=12, n_micro=2)
+        params = pipelined.shard_params(
+            pipelined.init_params(jax.random.key(0), cfg), mesh, cfg)
+        tokens = jnp.zeros((2 * mesh.shape["data"], cfg.seq_len), jnp.int32)
+        _, loss = jax.jit(pipelined.make_train_step(cfg, mesh))(params, tokens)
+        assert jnp.isfinite(loss), f"non-finite pipelined loss {loss}"
+
     async def check_sidecar_isolation(self):
         """A sidecar crash must NOT trigger the slice-atomic restart."""
         if self.sim is None:
@@ -326,6 +416,10 @@ async def run(live: bool) -> int:
     await conf.check("event-hygiene", conf.check_event_hygiene)
     await conf.check("contributor-authz", conf.check_contributor_authz)
     await conf.check("sidecar-restart-isolation", conf.check_sidecar_isolation)
+    await conf.check("profile-v1beta1", conf.check_profile_v1beta1)
+    await conf.check("image-catalog-pinning", conf.check_image_catalog)
+    await conf.check("pipeline-rbac", conf.check_pipeline_rbac)
+    await conf.check("pipeline-parallel-step", conf.check_pipeline_parallel_step)
 
     passed = sum(1 for r in conf.results if r["pass"])
     print(json.dumps({"summary": f"{passed}/{len(conf.results)} checks passed"}))
