@@ -374,6 +374,81 @@ KF.confirmDialog = function ({ title, message, confirmText = "Delete" }) {
   });
 };
 
+/* ---------------- YAML editor dialog (lib/editor) ----------------------- */
+
+/* Textarea-based manifest editor (the reference bundles monaco; a
+ * dependency-free editor keeps the buildless-SPA property). onSubmit
+ * receives the raw YAML text and may throw/reject — the error renders
+ * inline and the dialog stays open for another attempt. */
+KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSubmit }) {
+  return new Promise((resolve) => {
+    const overlay = KF.el("div", { class: "kf-overlay" });
+    const errorBox = KF.el("pre", {
+      class: "kf-yaml-error",
+      style: { color: "#c5221f", whiteSpace: "pre-wrap", display: "none" },
+    });
+    const textarea = KF.el("textarea", {
+      class: "kf-yaml-editor",
+      spellcheck: "false",
+      style: {
+        width: "100%",
+        minHeight: "320px",
+        fontFamily: "monospace",
+        fontSize: "13px",
+      },
+    });
+    textarea.value = initial;
+    function close(result) {
+      overlay.remove();
+      document.removeEventListener("keydown", onKey);
+      resolve(result);
+    }
+    function onKey(ev) {
+      if (ev.key === "Escape") close(false);
+    }
+    let pending = false;
+    async function submit() {
+      if (pending) return; // double-click guard while onSubmit is in flight
+      pending = true;
+      submitBtn.disabled = true;
+      try {
+        await onSubmit(textarea.value);
+        close(true);
+      } catch (err) {
+        errorBox.textContent = String((err && err.message) || err);
+        errorBox.style.display = "block";
+      } finally {
+        pending = false;
+        submitBtn.disabled = false;
+      }
+    }
+    document.addEventListener("keydown", onKey);
+    const submitBtn = KF.el(
+      "button", { class: "primary", onclick: submit }, submitText
+    );
+    overlay.append(
+      KF.el(
+        "div",
+        { class: "kf-dialog kf-dialog-wide", role: "dialog", "aria-modal": "true" },
+        KF.el("h3", {}, title),
+        textarea,
+        errorBox,
+        KF.el(
+          "div",
+          { class: "kf-dialog-actions" },
+          KF.el("button", { onclick: () => close(false) }, "Cancel"),
+          submitBtn
+        )
+      )
+    );
+    overlay.addEventListener("click", (ev) => {
+      if (ev.target === overlay) close(false);
+    });
+    document.body.append(overlay);
+    textarea.focus();
+  });
+};
+
 /* ---------------- snackbar (lib/snack-bar) ------------------------------ */
 
 KF.snackbar = function (message, kind = "info") {

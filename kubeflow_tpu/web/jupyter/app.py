@@ -207,6 +207,42 @@ async def post_notebook(request):
     return json_success({"message": f"Notebook {name_of(nb)} created"}, status=200)
 
 
+@routes.post("/api/namespaces/{namespace}/notebooks/yaml")
+async def post_notebook_yaml(request):
+    """Create a Notebook from raw YAML (the shared lib's editor dialog —
+    reference parity with kubeflow-common-lib's monaco editor module).
+    Kind and namespace are enforced server-side; everything else goes
+    through the normal admission chain (defaulting, validation, catalog)."""
+    import yaml
+
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "create", "Notebook", ns)
+    raw = await request.text()
+    try:
+        nb = yaml.safe_load(raw)
+    except yaml.YAMLError as e:
+        raise Invalid(f"could not parse YAML: {e}")
+    if not isinstance(nb, dict) or nb.get("kind") != nbapi.KIND:
+        raise Invalid("YAML must be a single Notebook manifest")
+    meta = nb.setdefault("metadata", {})
+    if not isinstance(meta, dict) or not isinstance(
+        meta.get("annotations", {}), dict
+    ):
+        raise Invalid("metadata (and metadata.annotations) must be mappings")
+    if meta.get("namespace") not in (None, ns):
+        raise Invalid(
+            f"metadata.namespace {meta.get('namespace')!r} does not match "
+            f"the request namespace {ns!r}"
+        )
+    meta["namespace"] = ns
+    # Creator is the authenticated user, never the manifest's claim (the
+    # form path stamps it the same way — an audit field must not be
+    # spoofable through the YAML door).
+    meta.setdefault("annotations", {})[nbapi.CREATOR_ANNOTATION] = user
+    await kube.create("Notebook", nb)
+    return json_success({"message": f"Notebook {name_of(nb)} created"})
+
+
 @routes.patch("/api/namespaces/{namespace}/notebooks/{name}")
 async def patch_notebook(request):
     kube, authz, user, ns = _ctx(request)

@@ -383,6 +383,40 @@ const checks = [
 document.getElementById("new-btn").addEventListener("click", () => {
   document.getElementById("new-form-card").style.display = "block";
 });
+document.getElementById("yaml-btn").addEventListener("click", () => {
+  const template = [
+    "apiVersion: kubeflow.org/v1",
+    "kind: Notebook",
+    "metadata:",
+    "  name: my-notebook",
+    "spec:",
+    "  tpu:",
+    "    accelerator: v5e",
+    '    topology: "2x2"',
+    "  template:",
+    "    spec:",
+    "      containers:",
+    "        - name: my-notebook",
+    "          image: kubeflow-tpu/jupyter-jax:latest",
+    "",
+  ].join("\n");
+  KF.yamlEditDialog({
+    title: "Create Notebook from YAML",
+    initial: template,
+    submitText: "Create",
+    onSubmit: (text) =>
+      api(`api/namespaces/${ns.get()}/notebooks/yaml`, {
+        method: "POST",
+        headers: { "Content-Type": "application/yaml" },
+        body: text,
+      }),
+  }).then((created) => {
+    if (created) {
+      KF.snackbar("Notebook created");
+      tablePoller.refresh();
+    }
+  });
+});
 document.getElementById("cancel-btn").addEventListener("click", () => {
   document.getElementById("new-form-card").style.display = "none";
 });

@@ -98,6 +98,28 @@ async def list_poddefaults(request):
     return json_success({"poddefaults": contents})
 
 
+@routes.get("/api/namespaces/{namespace}/tensorboards/{name}/events")
+async def tensorboard_events(request):
+    """Events involving the Tensorboard CR or its Deployment (the details
+    drawer's events table — VWA's pvc_events twin). Filtered to the
+    current incarnation like the JWA events route."""
+    from kubeflow_tpu.web.common.status import filter_events
+
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "list", "Event", ns)
+    events = [
+        ev for ev in await kube.list("Event", ns)
+        if (ev.get("involvedObject") or {}).get("name") == name
+        and (ev.get("involvedObject") or {}).get("kind")
+        in ("Tensorboard", "Deployment")
+    ]
+    tb = await kube.get_or_none("Tensorboard", name, ns)
+    if tb is not None:
+        events = filter_events(tb, events)
+    return json_success({"events": events})
+
+
 @routes.delete("/api/namespaces/{namespace}/tensorboards/{name}")
 async def delete_tensorboard(request):
     kube, authz, user, ns = _ctx(request)

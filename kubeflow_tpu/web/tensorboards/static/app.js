@@ -13,6 +13,7 @@ function schemeOf(logspath) {
 
 function openDetails(tb) {
   const drawer = KF.drawer(`TensorBoard ${tb.name}`);
+  const eventsHost = el("div", {});
   drawer.content.append(
     KF.detailsList([
       ["Name", tb.name],
@@ -34,7 +35,13 @@ function openDetails(tb) {
       "gs:// paths serve XLA/TPU profiler traces captured with ",
       el("code", {}, "jax.profiler"),
       " — open the Profile tab inside TensorBoard."
-    )
+    ),
+    el("h4", {}, "Events"),
+    eventsHost
+  );
+  api(`api/namespaces/${ns.get()}/tensorboards/${tb.name}/events`).then(
+    (body) => KF.eventsTable(eventsHost, body.events),
+    () => eventsHost.append(el("p", { class: "muted" }, "No events."))
   );
 }
 

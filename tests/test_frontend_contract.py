@@ -94,6 +94,7 @@ def test_shared_lib_exports_component_set():
         "KF.detailsList", "KF.confirmDialog", "KF.snackbar",
         "KF.namespacePicker", "KF.validators", "KF.tabs", "KF.toYaml",
         "KF.drawer", "KF.sliceRollup", "KF.sparkline", "KF.age",
+        "KF.yamlEditDialog",
     ]:
         assert re.search(re.escape(component) + r"\s*=", src), (
             f"shared lib lost {component}"

@@ -346,3 +346,97 @@ async def test_spa_served_with_csrf_cookie():
             assert "X-XSRF-TOKEN" in await resp.text()
     finally:
         await h.stop()
+
+
+async def test_jwa_create_from_yaml():
+    """The editor dialog's backend: raw YAML → admission → stored CR, with
+    kind/namespace enforced server-side."""
+    h = await WebHarness().start()
+    try:
+        jwa = await h.client(create_jwa(h.kube))
+        headers = await csrf(jwa, "/api/config")
+        yaml_text = (
+            "apiVersion: kubeflow.org/v1\n"
+            "kind: Notebook\n"
+            "metadata:\n  name: from-yaml\n"
+            "spec:\n  template:\n    spec:\n      containers:\n"
+            "        - name: from-yaml\n          image: img:v1\n"
+        )
+        resp = await jwa.post(
+            "/api/namespaces/team/notebooks/yaml", data=yaml_text,
+            headers={**headers, "Content-Type": "application/yaml"},
+        )
+        assert resp.status == 200, await resp.text()
+        nb = await h.kube.get("Notebook", "from-yaml", "team")
+        from kubeflow_tpu.api import notebook as _nbapi
+        assert deep_get(nb, "metadata", "annotations",
+                        _nbapi.CREATOR_ANNOTATION) == "alice@example.com"
+
+        # Wrong kind rejected.
+        resp = await jwa.post(
+            "/api/namespaces/team/notebooks/yaml", data="kind: Pod\n",
+            headers={**headers, "Content-Type": "application/yaml"},
+        )
+        assert resp.status == 422
+
+        # Malformed metadata rejected, not 500.
+        resp = await jwa.post(
+            "/api/namespaces/team/notebooks/yaml",
+            data="kind: Notebook\nmetadata: oops\n",
+            headers={**headers, "Content-Type": "application/yaml"},
+        )
+        assert resp.status == 422
+
+        # Creator annotation is never spoofable via YAML.
+        resp = await jwa.post(
+            "/api/namespaces/team/notebooks/yaml",
+            data=("apiVersion: kubeflow.org/v1\nkind: Notebook\n"
+                  "metadata:\n  name: spoofer\n  annotations:\n"
+                  "    notebooks.kubeflow.org/creator: admin@example.com\n"
+                  "spec:\n  template:\n    spec:\n      containers:\n"
+                  "        - name: spoofer\n          image: img:v1\n"),
+            headers={**headers, "Content-Type": "application/yaml"},
+        )
+        assert resp.status == 200
+        spoofed = await h.kube.get("Notebook", "spoofer", "team")
+        assert deep_get(spoofed, "metadata", "annotations",
+                        _nbapi.CREATOR_ANNOTATION) == "alice@example.com"
+
+        # Cross-namespace smuggling rejected.
+        resp = await jwa.post(
+            "/api/namespaces/team/notebooks/yaml",
+            data=("apiVersion: kubeflow.org/v1\nkind: Notebook\n"
+                  "metadata:\n  name: evil\n  namespace: other\n"),
+            headers={**headers, "Content-Type": "application/yaml"},
+        )
+        assert resp.status == 422
+    finally:
+        await h.stop()
+
+
+async def test_twa_events_route():
+    h = await WebHarness().start()
+    try:
+        from kubeflow_tpu.web.tensorboards import create_app as create_twa
+
+        twa = await h.client(create_twa(h.kube))
+        headers = await csrf(twa, "/api/namespaces/ns/tensorboards")
+        await h.kube.create("Event", {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "tb-ev", "namespace": "ns"},
+            "involvedObject": {"kind": "Tensorboard", "name": "tb1"},
+            "reason": "Created", "message": "made it",
+        })
+        await h.kube.create("Event", {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "other-ev", "namespace": "ns"},
+            "involvedObject": {"kind": "Pod", "name": "tb1"},
+            "reason": "Noise", "message": "not ours",
+        })
+        resp = await twa.get("/api/namespaces/ns/tensorboards/tb1/events",
+                             headers=headers)
+        assert resp.status == 200
+        body = await resp.json()
+        assert [e["reason"] for e in body["events"]] == ["Created"]
+    finally:
+        await h.stop()
