@@ -398,7 +398,9 @@ KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSub
       },
     });
     textarea.value = initial;
+    let pending = false;
     function close(result) {
+      if (pending) return; // no cancel while the submit is in flight
       overlay.remove();
       document.removeEventListener("keydown", onKey);
       resolve(result);
@@ -406,13 +408,13 @@ KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSub
     function onKey(ev) {
       if (ev.key === "Escape") close(false);
     }
-    let pending = false;
     async function submit() {
       if (pending) return; // double-click guard while onSubmit is in flight
       pending = true;
       submitBtn.disabled = true;
       try {
         await onSubmit(textarea.value);
+        pending = false;
         close(true);
       } catch (err) {
         errorBox.textContent = String((err && err.message) || err);

@@ -112,3 +112,14 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     return Status(
         WARNING, "Couldn't find any information for the status of this notebook."
     )
+
+
+async def events_for(kube, namespace: str, name: str, kinds: tuple) -> list[dict]:
+    """One Event list call filtered to the involved object — shared by the
+    per-app events routes (JWA pod/CR events, VWA pvc_events, TWA
+    tensorboard_events) so involvedObject matching evolves in one place."""
+    return [
+        ev for ev in await kube.list("Event", namespace)
+        if (ev.get("involvedObject") or {}).get("name") == name
+        and (ev.get("involvedObject") or {}).get("kind") in kinds
+    ]

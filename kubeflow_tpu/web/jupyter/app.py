@@ -12,6 +12,7 @@ REST contract kept wire-compatible:
 
 from __future__ import annotations
 
+import yaml
 from aiohttp import web
 
 from kubeflow_tpu.api import notebook as nbapi
@@ -213,8 +214,6 @@ async def post_notebook_yaml(request):
     reference parity with kubeflow-common-lib's monaco editor module).
     Kind and namespace are enforced server-side; everything else goes
     through the normal admission chain (defaulting, validation, catalog)."""
-    import yaml
-
     kube, authz, user, ns = _ctx(request)
     await ensure(authz, user, "create", "Notebook", ns)
     raw = await request.text()

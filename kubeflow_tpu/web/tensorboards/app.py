@@ -13,6 +13,7 @@ from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
 from kubeflow_tpu.web.common.app import create_base_app, json_success
 from kubeflow_tpu.web.common.serving import add_spa
 from kubeflow_tpu.web.common.auth import ensure
+from kubeflow_tpu.web.common.status import events_for, filter_events
 
 
 def create_app(kube, **kwargs) -> web.Application:
@@ -103,17 +104,10 @@ async def tensorboard_events(request):
     """Events involving the Tensorboard CR or its Deployment (the details
     drawer's events table — VWA's pvc_events twin). Filtered to the
     current incarnation like the JWA events route."""
-    from kubeflow_tpu.web.common.status import filter_events
-
     kube, authz, user, ns = _ctx(request)
     name = request.match_info["name"]
     await ensure(authz, user, "list", "Event", ns)
-    events = [
-        ev for ev in await kube.list("Event", ns)
-        if (ev.get("involvedObject") or {}).get("name") == name
-        and (ev.get("involvedObject") or {}).get("kind")
-        in ("Tensorboard", "Deployment")
-    ]
+    events = await events_for(kube, ns, name, ("Tensorboard", "Deployment"))
     tb = await kube.get_or_none("Tensorboard", name, ns)
     if tb is not None:
         events = filter_events(tb, events)

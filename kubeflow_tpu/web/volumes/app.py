@@ -15,6 +15,7 @@ from kubeflow_tpu.runtime.objects import deep_get, name_of
 from kubeflow_tpu.web.common.app import create_base_app, json_success
 from kubeflow_tpu.web.common.serving import add_spa
 from kubeflow_tpu.web.common.auth import ensure
+from kubeflow_tpu.web.common.status import events_for
 
 
 def create_app(kube, **kwargs) -> web.Application:
@@ -140,12 +141,7 @@ async def pvc_events(request):
     kube, authz, user, ns = _ctx(request)
     name = request.match_info["name"]
     await ensure(authz, user, "list", "Event", ns)
-    events = [
-        ev
-        for ev in await kube.list("Event", ns)
-        if (ev.get("involvedObject") or {}).get("kind") == "PersistentVolumeClaim"
-        and (ev.get("involvedObject") or {}).get("name") == name
-    ]
+    events = await events_for(kube, ns, name, ("PersistentVolumeClaim",))
     return json_success({"events": events})
 
 
