@@ -1,0 +1,173 @@
+"""Training harness: optax optimizers + sharded state + checkpoint/resume.
+
+The burn-in models keep a deliberately optimizer-minimal SGD step (their
+job is lighting up the MXU); this module is the *user-model* story the
+notebook images document — the standard jax-native loop composed from
+parts this framework already ships:
+
+- any optax ``GradientTransformation`` (adamw with warmup-cosine by
+  default — the configuration the scaling literature assumes);
+- a TrainState that is a plain pytree, so the same
+  ``NamedSharding``-mapping used for params extends to optimizer moments
+  (``state_sharding_rules`` mirrors each param's spec onto the matching
+  moment leaves — Adam's mu/nu shard exactly like their params);
+- checkpoint/resume through :class:`kubeflow_tpu.utils.checkpoint.
+  CheckpointManager` (Orbax, atomic, multi-host) with a
+  resume-equivalence guarantee tested in CI: restore-at-k + (n-k) steps
+  equals n straight steps.
+
+Reference parity note: the reference is a control plane with no training
+loop anywhere; this is the TPU-native data-plane layer its notebooks need
+(SURVEY.md §5 checkpoint/resume: "document Orbax/jax.checkpoint from
+notebooks").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class TrainerConfig:
+    optimizer: str = "adamw"          # "adamw" | "sgd"
+    lr: float = 3e-4
+    weight_decay: float = 0.01
+    warmup_steps: int = 100
+    decay_steps: int = 10_000         # cosine horizon (adamw)
+    grad_clip: float = 1.0            # global-norm clip; 0 disables
+
+
+def make_optimizer(cfg: TrainerConfig):
+    import optax
+
+    if cfg.optimizer == "sgd":
+        tx = optax.sgd(cfg.lr)
+    elif cfg.optimizer == "adamw":
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=cfg.lr,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=max(cfg.decay_steps, cfg.warmup_steps + 1),
+        )
+        tx = optax.adamw(schedule, weight_decay=cfg.weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    if cfg.grad_clip:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+    return tx
+
+
+def init_state(params: Any, optimizer) -> dict:
+    """TrainState as a plain dict pytree (checkpoints/shards transparently)."""
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def abstract_state(params: Any, optimizer) -> dict:
+    """Abstract TrainState (ShapeDtypeStructs) — pass as ``restore``'s
+    ``abstract`` target so Orbax rebuilds optax's NamedTuple containers
+    (and, with shardings attached, places leaves on the mesh)."""
+    return jax.eval_shape(lambda p: init_state(p, optimizer), params)
+
+
+def make_train_step(loss_fn: Callable, optimizer):
+    """(state, batch) → (state, loss); jit/pjit-ready pure function.
+
+    ``loss_fn(params, batch) -> scalar`` — close over model config/mesh at
+    the call site (the model modules' loss_fn signatures fit with
+    functools.partial).
+    """
+
+    import optax
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    return step
+
+
+def state_sharding_rules(params_rules: Any, params: Any, optimizer) -> dict:
+    """PartitionSpecs for a full TrainState.
+
+    Optimizer moments that mirror the params pytree (Adam's mu/nu, any
+    optax state whose tree structure equals the params') inherit the
+    params' specs leaf-for-leaf; every other leaf (counts, schedule
+    state) is replicated.
+    """
+    params_struct = jax.tree.structure(params)
+    abstract_opt = jax.eval_shape(optimizer.init, params)
+
+    def rules_for(node):
+        try:
+            if jax.tree.structure(node) == params_struct:
+                return params_rules
+        except Exception:  # non-pytree leaf containers
+            pass
+        if isinstance(node, tuple):
+            children = [rules_for(child) for child in node]
+            return type(node)(*children) if hasattr(node, "_fields") \
+                else tuple(children)
+        return jax.tree.map(lambda _: P(), node)
+
+    return {
+        "params": params_rules,
+        "opt_state": rules_for(abstract_opt),
+        "step": P(),
+    }
+
+
+def shard_state(state: dict, mesh: Mesh, rules: dict) -> dict:
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        state, rules, is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def fit(
+    state: dict,
+    batches: Iterator,
+    *,
+    steps: int,
+    step_fn: Callable,
+    checkpoints=None,
+    save_every: int = 100,
+    on_step: Callable | None = None,
+) -> dict:
+    """Run ``step_fn`` until ``state["step"] == steps``, checkpointing.
+
+    Resume: pass a state restored from ``checkpoints.restore`` — the loop
+    continues from its step counter AND fast-forwards ``batches`` past the
+    first ``step`` elements, so interrupt-at-k + rerun over the same
+    deterministic batch sequence equals an uninterrupted run bit-for-bit
+    (tests/test_trainer.py::test_resume_equivalence).
+    """
+    from itertools import islice
+
+    start = int(state["step"])
+    if start:
+        batches = islice(batches, start, None)
+    for i in range(start, steps):
+        state, loss = step_fn(state, next(batches))
+        if on_step is not None:
+            on_step(i + 1, float(loss))
+        if checkpoints is not None and (i + 1) % save_every == 0:
+            checkpoints.save(i + 1, jax.device_get(state))
+    if checkpoints is not None:
+        checkpoints.wait()
+    return state

@@ -12,7 +12,6 @@ REST contract kept wire-compatible:
 
 from __future__ import annotations
 
-import yaml
 from aiohttp import web
 
 from kubeflow_tpu.api import notebook as nbapi
@@ -21,7 +20,7 @@ from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of, now_iso
 from kubeflow_tpu.web.common.app import create_base_app, json_success
 from kubeflow_tpu.web.common.serving import add_spa
 from kubeflow_tpu.web.common.auth import ensure
-from kubeflow_tpu.web.common.status import filter_events, process_status
+from kubeflow_tpu.web.common.status import events_for, filter_events, process_status
 from kubeflow_tpu.web.jupyter.form import notebook_from_form
 from kubeflow_tpu.web.jupyter.spawner_config import load_config, tpu_options
 
@@ -59,7 +58,7 @@ def _events_by_notebook(events: list[dict]) -> dict[str, list[dict]]:
 
 
 async def _notebook_events(kube, ns: str, name: str) -> list[dict]:
-    return _events_by_notebook(await kube.list("Event", ns)).get(name, [])
+    return await events_for(kube, ns, name, ("Notebook",))
 
 
 @routes.get("/api/config")
@@ -214,6 +213,8 @@ async def post_notebook_yaml(request):
     reference parity with kubeflow-common-lib's monaco editor module).
     Kind and namespace are enforced server-side; everything else goes
     through the normal admission chain (defaulting, validation, catalog)."""
+    import yaml  # lazy like every yaml use here: dependencies = [] by design
+
     kube, authz, user, ns = _ctx(request)
     await ensure(authz, user, "create", "Notebook", ns)
     raw = await request.text()
