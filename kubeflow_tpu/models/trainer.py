@@ -167,7 +167,10 @@ def fit(
         if on_step is not None:
             on_step(i + 1, float(loss))
         if checkpoints is not None and (i + 1) % save_every == 0:
-            checkpoints.save(i + 1, jax.device_get(state))
+            # Sharded pytree passed as-is: Orbax writes per-process shards
+            # (a device_get here would crash on multi-host state and
+            # gathers the full model to host even single-host).
+            checkpoints.save(i + 1, state)
     if checkpoints is not None:
         checkpoints.wait()
     return state

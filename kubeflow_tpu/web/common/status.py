@@ -38,6 +38,16 @@ def _age_seconds(notebook: dict) -> float:
     return max(0.0, time.time() - ts)
 
 
+def event_stamp(ev: dict) -> str:
+    """The one event-timestamp precedence rule (lastTimestamp →
+    eventTime → metadata.creationTimestamp) — shared by the filter below
+    and the dashboard activity feed so it can't drift."""
+    return (
+        ev.get("lastTimestamp") or ev.get("eventTime")
+        or deep_get(ev, "metadata", "creationTimestamp") or ""
+    )
+
+
 def filter_events(notebook: dict, events: list[dict]) -> list[dict]:
     """Drop events that predate the CR — a recreated server with the same
     name must not surface the previous incarnation's errors (reference
@@ -49,9 +59,7 @@ def filter_events(notebook: dict, events: list[dict]) -> list[dict]:
         return list(events)
     out = []
     for ev in events:
-        stamp = ev.get("lastTimestamp") or ev.get("eventTime") or deep_get(
-            ev, "metadata", "creationTimestamp"
-        )
+        stamp = event_stamp(ev)
         ts = parse_iso(stamp) if stamp else None
         if ts is None or ts >= created_ts:
             out.append(ev)

@@ -41,6 +41,7 @@ def create_app(
     kube,
     *,
     links: list[dict] | None = None,
+    settings: dict | None = None,
     registration_flow: bool = True,
     metrics_service=None,
     kfam_client=None,
@@ -54,6 +55,7 @@ def create_app(
 
     app = create_base_app(kube, **kwargs)
     app["links"] = links or DEFAULT_LINKS
+    app["settings"] = settings or {}
     app["registration_flow"] = registration_flow
     app["metrics_service"] = metrics_service or metrics_service_from_env(
         dict(os.environ)
@@ -200,6 +202,43 @@ async def remove_contributor(request):
 @routes.get("/api/dashboard-links")
 async def dashboard_links(request):
     return json_success({"menuLinks": request.app["links"]})
+
+
+@routes.get("/api/dashboard-settings")
+async def dashboard_settings(request):
+    """Admin settings blob (reference api.ts /dashboard-settings: the
+    links ConfigMap's data["settings"] JSON; default {})."""
+    return json_success({"settings": request.app.get("settings") or {}})
+
+
+@routes.get("/api/activities/{namespace}")
+async def activities(request):
+    """Recent events in the namespace, newest first (reference api.ts
+    /activities/:namespace → k8sService.getEventsForNamespace)."""
+    kube = request.app["kube"]
+    ns = request.match_info["namespace"]
+    await ensure(
+        request.app["authorizer"], request.get("user", ""), "list", "Event", ns
+    )
+    from kubeflow_tpu.web.common.status import event_stamp as stamp
+
+    events = await kube.list("Event", ns)
+    events.sort(key=stamp, reverse=True)
+    return json_success({
+        "activities": [
+            {
+                "time": stamp(ev),
+                "type": ev.get("type", "Normal"),
+                "reason": ev.get("reason", ""),
+                "message": ev.get("message", ""),
+                "involved": {
+                    "kind": (ev.get("involvedObject") or {}).get("kind", ""),
+                    "name": (ev.get("involvedObject") or {}).get("name", ""),
+                },
+            }
+            for ev in events[:100]
+        ]
+    })
 
 
 @routes.get("/api/metrics")

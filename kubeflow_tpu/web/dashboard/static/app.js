@@ -38,6 +38,31 @@ async function loadTpuUsage(namespace) {
   );
 }
 
+async function loadActivities(namespace) {
+  /* Reference /api/activities/:namespace — the landing page's "recent
+   * activity" feed of namespace events, newest first. */
+  const body = await api(`api/activities/${namespace}`);
+  const target = document.getElementById("activities");
+  target.classList.remove("muted");
+  target.replaceChildren(
+    body.activities.length
+      ? el(
+          "ul",
+          { class: "activity-feed" },
+          body.activities.slice(0, 15).map((a) =>
+            el(
+              "li",
+              { class: a.type === "Warning" ? "event-warning" : "" },
+              el("span", { class: "muted" }, KF.age(a.time) + " ago — "),
+              `${a.involved.kind} ${a.involved.name}: ${a.reason} `,
+              el("span", { class: "muted" }, a.message)
+            )
+          )
+        )
+      : el("p", { class: "muted" }, `No recent events in ${namespace}.`)
+  );
+}
+
 async function loadMetrics() {
   const host = document.getElementById("metrics-panels");
   if (!host) return;
@@ -166,6 +191,7 @@ async function refresh() {
                 ev.preventDefault();
                 KF.ns.set(n.namespace);
                 loadTpuUsage(n.namespace).catch(showError);
+                loadActivities(n.namespace).catch(showError);
               },
             },
             n.namespace
@@ -186,6 +212,7 @@ async function refresh() {
   );
   if (info.namespaces.length) {
     loadTpuUsage(info.namespaces[0].namespace).catch(() => {});
+    loadActivities(info.namespaces[0].namespace).catch(() => {});
   }
   await loadMetrics();
 }

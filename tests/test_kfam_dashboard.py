@@ -195,3 +195,40 @@ async def test_dashboard_workgroup_and_tpu_usage():
         for c in clients:
             await c.close()
         kube.close_watches()
+
+
+async def test_dashboard_activities_and_settings():
+    """Reference api.ts /activities/:namespace + /dashboard-settings."""
+    from kubeflow_tpu.web.dashboard import create_app as create_dash
+
+    kube = FakeKube()
+    app = create_dash(kube, settings={"theme": "dark"})
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        await kube.create("Event", {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "old", "namespace": "team"},
+            "involvedObject": {"kind": "Notebook", "name": "a"},
+            "reason": "Created", "message": "first",
+            "lastTimestamp": "2026-01-01T00:00:00Z",
+        })
+        await kube.create("Event", {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "new", "namespace": "team"},
+            "involvedObject": {"kind": "Pod", "name": "a-0"},
+            "reason": "Pulled", "message": "second", "type": "Warning",
+            "lastTimestamp": "2026-02-01T00:00:00Z",
+        })
+        resp = await client.get("/api/activities/team",
+                                headers={"kubeflow-userid": "a@x.com"})
+        assert resp.status == 200
+        acts = (await resp.json())["activities"]
+        assert [a["reason"] for a in acts] == ["Pulled", "Created"]  # newest first
+        assert acts[0]["type"] == "Warning"
+
+        resp = await client.get("/api/dashboard-settings",
+                                headers={"kubeflow-userid": "a@x.com"})
+        assert (await resp.json())["settings"] == {"theme": "dark"}
+    finally:
+        await client.close()
