@@ -1,0 +1,254 @@
+#!/usr/bin/env python3
+"""Pipelines-as-code: generate .github/workflows/ from builders.
+
+The reference's CI is programmatic — one ``create_workflow()`` builder per
+component emitting Argo specs (``py/kubeflow/kubeflow/ci/
+notebook_servers/notebook_server_jupyter_tests.py:8-44`` and ~30
+siblings). This is that layer for the rebuilt stack: each workflow is a
+Python builder over small composable helpers, the checked-in YAML is the
+render, and ``tests/test_ci_pipelines.py`` fails if the two drift — so
+editing CI means editing code, and review diffs show intent rather than
+YAML noise.
+
+Usage:
+    python ci/pipelines.py            # (re)write .github/workflows/
+    python ci/pipelines.py --check    # exit 1 if the tree drifted
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKFLOWS_DIR = os.path.join(REPO, ".github", "workflows")
+
+VIRTUAL_MESH_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+PIP_INSTALL = "pip install -e . jax aiohttp pytest pyyaml"
+
+DRYRUN_SNIPPET = """\
+python - <<'PY'
+import os
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+import jax; jax.config.update('jax_platforms', 'cpu')
+from __graft_entry__ import dryrun_multichip
+dryrun_multichip(8)
+print("dryrun ok")
+PY
+"""
+
+DCN_SNIPPET = """\
+make -C native
+python - <<'PY'
+from kubeflow_tpu.probe.dcn import run_local_ring
+print(run_local_ring(world=2, mbytes=8, iters=2))
+PY
+"""
+
+
+def checkout():
+    return {"uses": "actions/checkout@v4"}
+
+
+def setup_python(version: str = "3.12"):
+    return {"uses": "actions/setup-python@v5",
+            "with": {"python-version": version}}
+
+
+def run(name: str | None, cmd: str, *, env: dict | None = None,
+        if_: str | None = None) -> dict:
+    step: dict = {}
+    if name:
+        step["name"] = name
+    if if_:
+        step["if"] = if_
+    step["run"] = cmd
+    if env:
+        step["env"] = dict(env)
+    return step
+
+
+def on_push_pr(paths: list[str] | None = None) -> dict:
+    push: dict = {"branches": ["main"]}
+    pr: dict = {}
+    if paths:
+        push["paths"] = list(paths)
+        pr["paths"] = list(paths)
+    return {"push": push, "pull_request": pr}
+
+
+# ---- per-component builders (the create_workflow() analogues) ----------------
+
+
+def workflow_tests() -> dict:
+    """Unit + in-process integration + multichip dryrun + native probe.
+
+    The reference runs per-component unit workflows plus KinD integration;
+    the fake apiserver covers the integration surface in-process, so one
+    matrix job does both.
+    """
+    return {
+        "name": "tests",
+        "on": on_push_pr(),
+        "jobs": {
+            "pytest": {
+                "runs-on": "ubuntu-latest",
+                "strategy": {"matrix": {"python": ["3.11", "3.12"]}},
+                "steps": [
+                    checkout(),
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "${{ matrix.python }}"}},
+                    run(None, PIP_INSTALL),
+                    run("Unit + control-plane integration (8-device virtual mesh)",
+                        "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
+                    run("Multi-chip dryrun (GSPMD shardings on virtual devices)",
+                        DRYRUN_SNIPPET),
+                    run("Native DCN probe (build + loopback ring)", DCN_SNIPPET),
+                ],
+            }
+        },
+    }
+
+
+def workflow_kind_integration() -> dict:
+    """Live-apiserver integration on KinD (reference
+    notebook_controller_integration_test.yaml:60-110 pattern)."""
+    return {
+        "name": "kind-integration",
+        "on": on_push_pr(),
+        "jobs": {
+            "kind": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    checkout(),
+                    {"uses": "helm/kind-action@v1",
+                     "with": {"cluster_name": "kubeflow-tpu-ci"}},
+                    setup_python(),
+                    run(None, "pip install -e . aiohttp pytest pyyaml"),
+                    run("Install CRDs", "kubectl apply -f manifests/crds/"),
+                    run("Run controller against the live apiserver",
+                        "kubectl proxy --port 8001 &\n"
+                        "python -m kubeflow_tpu.cmd.controller_manager &\n"
+                        "sleep 5\n"
+                        "kubectl create namespace ci-test\n"
+                        "python ci/spawn_test_notebook.py ci-test\n",
+                        env={"ENABLE_CULLING": "false"}),
+                    run("Controller pods Ready within budget (reference gate: 100s)",
+                        "python ci/wait_notebook_ready.py ci-test test-notebook 100"),
+                ],
+            }
+        },
+    }
+
+
+# One leaf per image family; each pulls its parents via the Makefile DAG
+# (the reference builds every image via Kaniko no-push).
+IMAGE_BUILD_TARGETS = [
+    "jupyter-scipy",
+    "jupyter-jax",
+    "jupyter-pytorch-xla",
+    "codeserver-python",
+    "rstudio-tidyverse",
+]
+
+
+def workflow_image_builds() -> dict:
+    return {
+        "name": "image-builds",
+        "on": on_push_pr(paths=["images/**",
+                                ".github/workflows/image-builds.yaml"]),
+        "jobs": {
+            "build": {
+                "runs-on": "ubuntu-latest",
+                "strategy": {
+                    "fail-fast": False,
+                    "matrix": {
+                        "include": [{"target": t} for t in IMAGE_BUILD_TARGETS]
+                    },
+                },
+                "steps": [
+                    checkout(),
+                    run("Build wheel for the jax image's framework client",
+                        "pip install build\n"
+                        "python -m build --wheel --outdir images/jupyter-jax/\n",
+                        if_="matrix.target == 'jupyter-jax'"),
+                    run("Build ${{ matrix.target }} (and its base chain)",
+                        "make -C images ${{ matrix.target }}"),
+                    run("Smoke-test entrypoint",
+                        "docker run --rm --entrypoint python \\\n"
+                        "  kubeflow-tpu/${{ matrix.target }}:latest \\\n"
+                        "  -c \"import jupyterlab; print('jupyterlab ok')\"\n",
+                        if_="startsWith(matrix.target, 'jupyter')"),
+                    run("Smoke-test jax import (CPU fallback path)",
+                        "docker run --rm -e JAX_PLATFORMS=cpu --entrypoint python \\\n"
+                        "  kubeflow-tpu/jupyter-jax:latest \\\n"
+                        "  -c \"import jax; print(jax.jit(lambda x: x + 1)(41))\"\n",
+                        if_="matrix.target == 'jupyter-jax'"),
+                ],
+            }
+        },
+    }
+
+
+WORKFLOWS = {
+    "unit-tests.yaml": workflow_tests,
+    "kind-integration.yaml": workflow_kind_integration,
+    "image-builds.yaml": workflow_image_builds,
+}
+
+_HEADER = """\
+# GENERATED by ci/pipelines.py — edit the builder, then run
+#   python ci/pipelines.py
+# (tests/test_ci_pipelines.py fails on drift).
+"""
+
+
+def render(name: str) -> str:
+    import yaml
+
+    class _Dumper(yaml.SafeDumper):
+        pass
+
+    def _str(dumper, value):
+        if "\n" in value:
+            return dumper.represent_scalar("tag:yaml.org,2002:str", value,
+                                           style="|")
+        return dumper.represent_scalar("tag:yaml.org,2002:str", value)
+
+    _Dumper.add_representer(str, _str)
+    body = yaml.dump(WORKFLOWS[name](), Dumper=_Dumper, sort_keys=False,
+                     width=100)
+    return _HEADER + body
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 if the checked-in workflows drifted")
+    args = parser.parse_args()
+    drifted = []
+    for name in WORKFLOWS:
+        path = os.path.join(WORKFLOWS_DIR, name)
+        want = render(name)
+        have = open(path).read() if os.path.exists(path) else None
+        if have == want:
+            continue
+        if args.check:
+            drifted.append(name)
+        else:
+            with open(path, "w") as fh:
+                fh.write(want)
+            print(f"wrote {path}")
+    if drifted:
+        print(f"drift: {', '.join(drifted)} — run python ci/pipelines.py",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

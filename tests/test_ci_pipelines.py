@@ -1,0 +1,70 @@
+"""Pipelines-as-code: the checked-in workflows ARE the builders' render.
+
+Reference analogue: the Argo workflow builders under
+py/kubeflow/kubeflow/ci (create_workflow per component) — CI definitions
+live in code, the YAML is an artifact.
+"""
+
+import importlib.util
+import os
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location(
+    "ci_pipelines", REPO / "ci" / "pipelines.py"
+)
+pipelines = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(pipelines)
+
+
+def test_no_drift():
+    for name in pipelines.WORKFLOWS:
+        path = REPO / ".github" / "workflows" / name
+        assert path.exists(), f"{name} not generated — run python ci/pipelines.py"
+        assert path.read_text() == pipelines.render(name), (
+            f"{name} drifted from its builder — run python ci/pipelines.py"
+        )
+
+
+def test_rendered_yaml_parses_with_invariants():
+    docs = {n: yaml.safe_load(pipelines.render(n)) for n in pipelines.WORKFLOWS}
+
+    tests_wf = docs["unit-tests.yaml"]
+    steps = tests_wf["jobs"]["pytest"]["steps"]
+    pytest_step = next(s for s in steps if "python -m pytest" in s.get("run", ""))
+    # The virtual-mesh env is load-bearing (multi-chip tests need 8 devices).
+    assert pytest_step["env"]["XLA_FLAGS"].endswith("device_count=8")
+    assert any("dryrun_multichip" in s.get("run", "") for s in steps)
+    assert any("make -C native" in s.get("run", "") for s in steps)
+
+    kind_wf = docs["kind-integration.yaml"]
+    kind_steps = kind_wf["jobs"]["kind"]["steps"]
+    assert any("kubectl apply -f manifests/crds/" in s.get("run", "")
+               for s in kind_steps)
+    assert any("wait_notebook_ready" in s.get("run", "") for s in kind_steps)
+
+    img_wf = docs["image-builds.yaml"]
+    targets = [
+        m["target"]
+        for m in img_wf["jobs"]["build"]["strategy"]["matrix"]["include"]
+    ]
+    # Every leaf of the image DAG is built (parents come via the Makefile).
+    images = set(os.listdir(REPO / "images"))
+    for target in targets:
+        assert target in images, target
+    for leaf in ("jupyter-jax", "jupyter-pytorch-xla"):
+        assert leaf in targets
+
+
+def test_check_mode_detects_drift(tmp_path, monkeypatch):
+    # Point the generator at a scratch dir: --check must flag missing files.
+    monkeypatch.setattr(pipelines, "WORKFLOWS_DIR", str(tmp_path))
+    monkeypatch.setattr("sys.argv", ["pipelines.py", "--check"])
+    assert pipelines.main() == 1
+    monkeypatch.setattr("sys.argv", ["pipelines.py"])
+    assert pipelines.main() == 0
+    monkeypatch.setattr("sys.argv", ["pipelines.py", "--check"])
+    assert pipelines.main() == 0
