@@ -12,6 +12,7 @@ collectives; the only cross-chip traffic is the K/V ring and the loss psum.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -25,10 +26,14 @@ from kubeflow_tpu.parallel.ulysses import ulysses_attention
 # all-to-all sequence/context parallelism" are both first-class). Ring
 # bounds memory at O((S/P)^2) with P neighbor hops; ulysses does two
 # all-to-alls and exact full-sequence softmax per H/P heads. Pick per
-# config: extreme contexts -> ring, enough heads + mid contexts -> ulysses.
+# config: extreme contexts -> ring, enough heads + mid contexts ->
+# ulysses; "ulysses_flash" streams the gathered sequence through the
+# pallas flash kernel (fwd+bwd), so long-context TRAINING never holds
+# [S, S] logits in HBM.
 ATTENTION_STRATEGIES = {
     "ring": ring_attention,
     "ulysses": ulysses_attention,
+    "ulysses_flash": partial(ulysses_attention, block_impl="flash"),
 }
 
 
@@ -41,7 +46,8 @@ class LongContextConfig:
     d_ff: int = 512
     seq_len: int = 1024          # the point: long S, sharded S/P per chip
     dtype: str = "bfloat16"
-    attention: str = "ring"      # "ring" | "ulysses" (ATTENTION_STRATEGIES)
+    attention: str = "ring"      # "ring" | "ulysses" | "ulysses_flash"
+                                 # (ATTENTION_STRATEGIES)
 
     @property
     def head_dim(self) -> int:

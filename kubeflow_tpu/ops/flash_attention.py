@@ -100,7 +100,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to((m_scr[:, :1] + jnp.log(l)).T, (8, lse_ref.shape[2]))
 
 
-def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct with varying-axes metadata when running inside
+    shard_map (jax's manual-mode type checking requires it on pallas
+    outputs); plain struct otherwise."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+
+
+def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
+               vma=None):
     bh, s, d = q.shape
     bq, bk = min(block_q, s), min(block_k, s)
     nq, nk = s // bq, s // bk
@@ -118,8 +128,8 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 8, s), jnp.float32),
+            _sds((bh, s, d), q.dtype, vma),
+            _sds((bh, 8, s), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -195,7 +205,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret,
+               vma=None):
     q, k, v, out, lse = res
     bh, s, d = q.shape
     bq, bk = min(block_q, s), min(block_k, s)
@@ -218,7 +229,7 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
         grid=(bh, nq, nk),
         in_specs=common_in,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=_sds((bh, s, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -245,8 +256,8 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            _sds((bh, s, d), k.dtype, vma),
+            _sds((bh, s, d), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -263,23 +274,25 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------- public
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, vma):
     out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
-                        block_q=block_q, block_k=block_k, interpret=interpret)
+                        block_q=block_q, block_k=block_k, interpret=interpret,
+                        vma=vma)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret, vma):
     out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          interpret=interpret)
+                          interpret=interpret, vma=vma)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, vma, res, g):
     return _flash_bwd(res, g, scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k, interpret=interpret)
+                      block_q=block_q, block_k=block_k, interpret=interpret,
+                      vma=vma)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -288,14 +301,24 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    vma: tuple | None = None):
     """Fused causal attention. q/k/v ``[batch, seq, heads, head_dim]``.
 
     ``interpret=None`` auto-selects pallas interpreter mode off-TPU so the
-    same model code runs in CPU tests and on chips.
+    same model code runs in CPU tests and on chips. Inside ``shard_map``
+    the outputs' varying-axes metadata (which jax's manual-mode type
+    checking requires on pallas outputs) is derived from the inputs
+    automatically; ``vma`` overrides it when needed.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if vma is None:
+        try:
+            inferred = jax.typeof(q).vma
+            vma = tuple(inferred) if inferred else None
+        except AttributeError:  # pragma: no cover - older jax
+            pass
     b, s, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -307,7 +330,7 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     out = _flash(fold(q), fold(k), fold(v), scale, causal, block_q, block_k,
-                 interpret)
+                 interpret, tuple(vma) if vma else None)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
@@ -410,12 +433,9 @@ def flash_attention_partial(q, k, v, q_offset, k_offset, *,
         # Inside shard_map, outputs must declare their varying mesh axes
         # (vma) for jax's manual-mode type checking.
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), jnp.float32,
-                                 vma=frozenset(vma or ())),
-            jax.ShapeDtypeStruct((bh, 8, s), jnp.float32,
-                                 vma=frozenset(vma or ())),
-            jax.ShapeDtypeStruct((bh, 8, s), jnp.float32,
-                                 vma=frozenset(vma or ())),
+            _sds((bh, s, d), jnp.float32, vma or ()),
+            _sds((bh, 8, s), jnp.float32, vma or ()),
+            _sds((bh, 8, s), jnp.float32, vma or ()),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),

@@ -38,11 +38,18 @@ def _a2a(x, axis_name: str, scatter_dim: int, gather_dim: int):
     )
 
 
-def ulysses_attention_local(q, k, v, axis_name: str):
+def ulysses_attention_local(q, k, v, axis_name: str, block_impl: str = "xla"):
     """Per-shard exact causal attention via two all-to-alls.
 
     Args: q/k/v ``[batch, s_local, heads, head_dim]`` with heads divisible
     by the axis size. Call inside ``shard_map``; returns the same shape.
+
+    ``block_impl="flash"`` runs the gathered-sequence attention through the
+    pallas flash kernel (ops/flash_attention.py) instead of materializing
+    the [S, S] logits — and since that kernel carries a full custom VJP,
+    this makes ulysses the memory-efficient *training* path for long
+    context (ring's flash hops are forward-only). The post-a2a layout
+    [b, S, H/P, d] is exactly the kernel's bshd contract.
     """
     p = jax.lax.psum(1, axis_name)
     b, s_local, h, d = q.shape
@@ -56,24 +63,37 @@ def ulysses_attention_local(q, k, v, axis_name: str):
     # H/P heads, so causal attention is exact with a plain mask.
     q, k, v = (_a2a(t, axis_name, 2, 1) for t in (q, k, v))
 
-    s_full = s_local * p
-    scale = 1.0 / (d ** 0.5)
-    logits = (
-        jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    )
-    mask = jnp.tril(jnp.ones((s_full, s_full), bool))
-    logits = jnp.where(mask[None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if block_impl == "flash":
+        from kubeflow_tpu.ops import flash_attention
+
+        # The kernel derives its outputs' varying-axes metadata from the
+        # inputs (always correct, whatever mesh the caller shard_maps on).
+        out = flash_attention(q, k, v)
+    elif block_impl == "xla":
+        s_full = s_local * p
+        scale = 1.0 / (d ** 0.5)
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        )
+        mask = jnp.tril(jnp.ones((s_full, s_full), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    else:
+        raise ValueError(
+            f"unknown block_impl {block_impl!r} (want 'xla' or 'flash')"
+        )
 
     # [b, S, H/P, d] -> [b, S/P, H, d]: scatter seq back, gather heads.
     return _a2a(out, axis_name, 1, 2)
 
 
-def ulysses_attention(q, k, v, mesh, axis_name: str = "seq"):
+def ulysses_attention(q, k, v, mesh, axis_name: str = "seq",
+                      block_impl: str = "xla"):
     """GSPMD entrypoint mirroring ``ring_attention``'s signature: q/k/v
     ``[batch, seq, heads, head_dim]`` sequence-sharded over ``axis_name``;
-    other mesh axes shard batch."""
+    other mesh axes shard batch. ``block_impl="flash"`` swaps the exact
+    softmax for the pallas flash kernel (fwd+bwd — trainable)."""
     from jax.sharding import PartitionSpec as P
 
     try:
@@ -85,7 +105,8 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "seq"):
     batch_spec = data_axes[0] if len(data_axes) == 1 else (data_axes or None)
     spec = P(batch_spec if data_axes else None, axis_name, None, None)
     return shard_map(
-        partial(ulysses_attention_local, axis_name=axis_name),
+        partial(ulysses_attention_local, axis_name=axis_name,
+                block_impl=block_impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
