@@ -35,6 +35,7 @@ class MoEConfig:
     seq_len: int = 128
     n_experts: int = 4            # must be divisible by the expert-axis size
     capacity_factor: float = 1.25
+    router_top_k: int = 1         # 1 = switch; 2 = GShard-style top-2
     aux_weight: float = 0.01      # Switch §2.2 load-balancing loss weight
     dtype: str = "bfloat16"
     attention: str = "xla"        # burnin._attention duck-types on this
@@ -108,6 +109,7 @@ def forward(params: dict, tokens: jax.Array, cfg: MoEConfig, mesh: Mesh,
             h, layer["router"], layer["expert_w1"], layer["expert_w2"],
             mesh, expert_axis=expert_axis,
             capacity_factor=cfg.capacity_factor,
+            router_top_k=cfg.router_top_k,
         )
         x = x + y
         aux_total = aux_total + aux

@@ -23,28 +23,48 @@ import jax
 import jax.numpy as jnp
 
 
-def router_dispatch(logits, n_experts: int, capacity: int):
-    """Top-1 routing → (dispatch [T, E, C] one-hot, probs [T], idx [T]).
+def router_dispatch(logits, n_experts: int, capacity: int, k: int = 1):
+    """Top-k routing → (dispatch, combine [T, E, C], probs [T, E], idx [T]).
 
-    Tokens beyond an expert's capacity are dropped (their dispatch row is
-    zero and the combine step passes the residual stream through — the
-    standard switch overflow behavior, static shapes throughout).
+    ``dispatch`` is the 0/1 slot assignment; ``combine`` is dispatch scaled
+    by the token's renormalized gate for that expert (GShard top-2 style —
+    k=1 reduces exactly to the switch router). Capacity is accounted
+    choice-major: every token's first choice is seated before any second
+    choice (the standard priority rule), and overflow tokens are dropped —
+    their rows are zero and the residual stream upstream carries them.
+    Static shapes throughout.
     """
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
-    idx = jnp.argmax(probs, axis=-1)                             # [T]
-    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.int32)     # [T, E]
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1                # [T, E]
-    pos_in_expert = pos.max(axis=-1)                             # [T]
-    keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
-    dispatch = (
-        jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)[:, :, None]
-        * jax.nn.one_hot(
-            jnp.where(keep, pos_in_expert, capacity), capacity + 1,
-            dtype=jnp.float32,
-        )[:, None, :capacity]
-    )
-    return dispatch, gate, probs, idx
+    topk_p, topk_idx = jax.lax.top_k(probs, k)                   # [T, k]
+    if k == 1:
+        # Switch semantics: the gate IS the router probability — scaling
+        # the expert output by it is the router's gradient path through
+        # the task loss (renormalizing a single weight to 1.0 would sever
+        # it and silently change every top-1 config's numerics).
+        gates = topk_p
+    else:
+        gates = topk_p / jnp.maximum(topk_p.sum(-1, keepdims=True), 1e-9)
+
+    t = logits.shape[0]
+    dispatch = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    counts = jnp.zeros((n_experts,), jnp.int32)  # seats taken per expert
+    for j in range(k):  # static, tiny
+        onehot = jax.nn.one_hot(topk_idx[:, j], n_experts, dtype=jnp.int32)
+        pos = (jnp.cumsum(onehot, axis=0) + counts[None, :]) * onehot - 1
+        pos_tok = pos.max(axis=-1)                               # [T]
+        keep = (pos_tok >= 0) & (pos_tok < capacity)
+        disp_j = (
+            onehot.astype(jnp.float32)[:, :, None]
+            * jax.nn.one_hot(
+                jnp.where(keep, pos_tok, capacity), capacity + 1,
+                dtype=jnp.float32,
+            )[:, None, :capacity]
+        )
+        dispatch = dispatch + disp_j
+        combine = combine + disp_j * gates[:, j][:, None, None]
+        counts = counts + onehot.sum(axis=0)
+    return dispatch, combine, probs, topk_idx[:, 0]
 
 
 def load_balancing_loss(probs, idx, n_experts: int):
@@ -55,8 +75,8 @@ def load_balancing_loss(probs, idx, n_experts: int):
 
 
 def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
-                  capacity_factor: float = 1.25):
-    """Per-shard switch FF layer. Call inside ``shard_map``.
+                  capacity_factor: float = 1.25, router_top_k: int = 1):
+    """Per-shard switch/top-k FF layer. Call inside ``shard_map``.
 
     Args:
       x: ``[T, d]`` this shard's tokens.
@@ -69,10 +89,12 @@ def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
     e_local = expert_w1.shape[0]
     n_experts = e_local * p_e
     t, d = x.shape
-    capacity = max(1, int(capacity_factor * t / n_experts))
+    capacity = max(1, int(capacity_factor * router_top_k * t / n_experts))
 
     logits = (x @ router_w.astype(x.dtype)).astype(jnp.float32)  # [T, E]
-    dispatch, gate, probs, idx = router_dispatch(logits, n_experts, capacity)
+    dispatch, combine, probs, idx = router_dispatch(
+        logits, n_experts, capacity, k=router_top_k
+    )
     aux = load_balancing_loss(probs, idx, n_experts)
 
     # [T, E, C] × [T, d] → [E, C, d]: token slots grouped by global expert.
@@ -92,14 +114,16 @@ def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
     out = jax.lax.all_to_all(
         out, axis_name, split_axis=1, concat_axis=0, tiled=True
     )
-    # Combine: [T, E, C] × [E, C, d] → [T, d], scaled by the gate; dropped
-    # tokens get zeros (residual connection upstream carries them).
-    y = jnp.einsum("tec,ecd->td", dispatch.astype(out.dtype), out)
-    return y * gate[:, None].astype(y.dtype), aux
+    # Combine: [T, E, C] × [E, C, d] → [T, d] with the renormalized gates
+    # baked into the combine tensor; dropped tokens get zeros (residual
+    # connection upstream carries them).
+    y = jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
+    return y, aux
 
 
 def moe_ffn(x, router_w, expert_w1, expert_w2, mesh,
-            expert_axis: str = "expert", capacity_factor: float = 1.25):
+            expert_axis: str = "expert", capacity_factor: float = 1.25,
+            router_top_k: int = 1):
     """GSPMD entrypoint. ``x [batch, seq, d]`` batch-sharded over all mesh
     axes; experts sharded over ``expert_axis``. Returns ``(y, aux)``."""
     from jax.sharding import PartitionSpec as P
@@ -115,7 +139,7 @@ def moe_ffn(x, router_w, expert_w1, expert_w2, mesh,
         b, s, d = x.shape
         y, aux = moe_ffn_local(
             x.reshape(b * s, d), rw, w1, w2, expert_axis,
-            capacity_factor=capacity_factor,
+            capacity_factor=capacity_factor, router_top_k=router_top_k,
         )
         return y.reshape(b, s, d), jax.lax.pmean(
             aux, tuple(mesh.axis_names)

@@ -18,17 +18,17 @@ def dense_moe_reference(x, router_w, w1, w2, capacity):
     t = b * s
     xt = x.reshape(t, d)
     logits = xt @ router_w
-    dispatch, gate, _, _ = router_dispatch(logits, w1.shape[0], capacity)
+    dispatch, combine, _, _ = router_dispatch(logits, w1.shape[0], capacity)
     slots = jnp.einsum("tec,td->ecd", dispatch, xt)
     h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, w1))
     out = jnp.einsum("ecf,efd->ecd", h, w2)
-    y = jnp.einsum("tec,ecd->td", dispatch, out) * gate[:, None]
+    y = jnp.einsum("tec,ecd->td", combine, out)
     return y.reshape(b, s, d)
 
 
 def test_router_dispatch_capacity_and_positions():
     logits = jnp.array([[9.0, 0.0], [9.0, 0.0], [9.0, 0.0], [0.0, 9.0]])
-    dispatch, gate, probs, idx = router_dispatch(logits, 2, capacity=2)
+    dispatch, combine, probs, idx = router_dispatch(logits, 2, capacity=2)
     assert idx.tolist() == [0, 0, 0, 1]
     # Tokens 0,1 fill expert 0's two slots; token 2 overflows (dropped).
     assert float(dispatch[0].sum()) == 1 and float(dispatch[1].sum()) == 1
@@ -110,3 +110,75 @@ def test_moe_trains_on_data_x_expert_mesh():
     assert jnp.isfinite(loss1) and float(loss2) < float(loss1)
     # Experts stayed expert-sharded (spec may normalize trailing Nones).
     assert p1["w1"].sharding.spec[0] == "expert"
+
+
+def test_router_top2_dispatch():
+    """GShard-style top-2: each token seats in (up to) two experts with
+    renormalized gates; first choices outrank second choices for seats."""
+    logits = jnp.array([
+        [9.0, 8.0, -9.0],   # top-2 = experts 0, 1
+        [9.0, -9.0, 8.0],   # top-2 = experts 0, 2
+        [-9.0, 9.0, 8.0],   # top-2 = experts 1, 2
+    ])
+    dispatch, combine, probs, idx = router_dispatch(logits, 3, capacity=2, k=2)
+    assert idx.tolist() == [0, 0, 1]          # first choices
+    # Every token got both of its experts (capacity 2 is enough here).
+    assert dispatch.sum(axis=(1, 2)).tolist() == [2.0, 2.0, 2.0]
+    # Gates renormalize to ~1 per token when nothing is dropped.
+    np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))),
+                               np.ones(3), rtol=1e-5)
+    # First choice outranks second: expert 0 seats tokens 0 then 1.
+    assert float(dispatch[0, 0, 0]) == 1 and float(dispatch[1, 0, 1]) == 1
+
+
+def test_router_top2_priority_under_capacity_pressure():
+    """With capacity 1, a token's SECOND choice must lose its seat to
+    another token's FIRST choice regardless of row order."""
+    logits = jnp.array([
+        [8.0, 9.0],   # first choice: expert 1; second: expert 0
+        [9.0, -9.0],  # first choice: expert 0
+    ])
+    dispatch, combine, _, _ = router_dispatch(logits, 2, capacity=1, k=2)
+    # Expert 0's single seat goes to token 1 (a first choice), not token
+    # 0's second choice, even though token 0 comes earlier.
+    assert float(dispatch[1, 0, 0]) == 1.0
+    assert float(dispatch[0, 0, 0]) == 0.0
+
+
+def test_moe_model_trains_top2():
+    from kubeflow_tpu.models import moe as moe_model
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "expert"))
+    cfg = moe_model.MoEConfig(vocab=64, d_model=32, n_heads=4, n_layers=1,
+                              d_ff=64, seq_len=9, n_experts=2,
+                              router_top_k=2, dtype="float32")
+    params = moe_model.shard_params(
+        moe_model.init_params(jax.random.key(0), cfg), mesh, cfg)
+    tokens = jax.device_put(
+        jnp.zeros((8, cfg.seq_len), jnp.int32),
+        NamedSharding(mesh, P(("data", "expert"), None)))
+    step = jax.jit(moe_model.make_train_step(cfg, mesh))
+    new_params, loss = step(params, tokens)
+    jax.block_until_ready(loss)
+    assert jnp.isfinite(loss)
+    _, loss2 = step(new_params, tokens)
+    assert float(loss2) < float(loss)  # fixed batch: must improve
+
+
+def test_switch_gate_keeps_router_gradient():
+    """k=1 gates must be the RAW router probability (Switch semantics):
+    that scaling is the router's only gradient path through the task loss."""
+    logits = jnp.array([[2.0, 0.0], [0.0, 2.0]])
+    _, combine, probs, _ = router_dispatch(logits, 2, capacity=2, k=1)
+    # Gate == softmax probability of the chosen expert, not 1.0.
+    np.testing.assert_allclose(
+        np.asarray(combine.sum(axis=(1, 2))),
+        np.asarray(probs.max(axis=-1)), rtol=1e-6)
+
+    def task_loss(router_w):
+        x = jnp.ones((4, 2))
+        dispatch, comb, _, _ = router_dispatch(x @ router_w, 2, capacity=4)
+        return comb.sum()
+
+    g = jax.grad(task_loss)(jnp.eye(2) * 0.1)
+    assert float(jnp.abs(g).sum()) > 0, "router got no task-loss gradient"
