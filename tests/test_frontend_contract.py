@@ -183,3 +183,29 @@ def test_js_balanced_braces_smoke():
             assert src.count(open_ch) == src.count(close_ch), (
                 f"{path}: unbalanced {open_ch}{close_ch}"
             )
+
+
+def test_every_dom_lookup_resolves_to_markup():
+    """Every getElementById/querySelector('#...') target in an app's JS
+    must exist in that app's index.html (or be created by the JS itself) —
+    the DOM-level seam Karma/Cypress would cover in the reference."""
+    shared_js = (WEB / "common" / "static" / "kubeflow.js").read_text()
+
+    def creatable_ids(src: str) -> set:
+        ids = set(re.findall(r"""\bid:\s*["']([^"']+)["']""", src))
+        ids |= set(re.findall(r"""\bid\s*=\s*\\?["']([^"'\\]+)""", src))
+        return ids
+
+    for app_dir in APPS:
+        js = (WEB / app_dir / "static" / "app.js").read_text()
+        html = (WEB / app_dir / "static" / "index.html").read_text()
+        known = set(re.findall(r"""id=["']([^"']+)["']""", html))
+        known |= creatable_ids(js) | creatable_ids(shared_js)
+
+        lookups = re.findall(r"""getElementById\(["']([^"']+)["']\)""", js)
+        lookups += re.findall(r"""querySelector\(["']#([A-Za-z0-9_-]+)""", js)
+        for target in lookups:
+            assert target in known, (
+                f"{app_dir}/app.js looks up #{target} which neither "
+                f"index.html nor the JS creates"
+            )
