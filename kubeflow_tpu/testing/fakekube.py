@@ -146,7 +146,20 @@ class FakeKube:
         namespace: str | None = None,
         label_selector: str | dict | None = None,
         field_selector: Callable[[dict], bool] | None = None,
+        copy: bool = True,
     ) -> list[dict]:
+        """List objects; the returned list holds defensive copies by
+        default (``field_selector`` predicates always run against the live
+        store dicts and must be pure — don't mutate or retain their
+        argument).
+
+        ``copy=False`` hands out the LIVE store dicts for read-only scans —
+        a FakeKube-only escape hatch (HttpKube has no such parameter, so
+        production controller code can't grow a dependency on it) used by
+        the kubelet simulator and load test, whose per-event ownership
+        scans dominated the control-plane bench's profile otherwise.
+        Callers must not mutate the returned objects.
+        """
         selector = (
             parse_label_selector(label_selector)
             if isinstance(label_selector, str)
@@ -160,7 +173,7 @@ class FakeKube:
                 continue
             if field_selector and not field_selector(obj):
                 continue
-            out.append(deepcopy(obj))
+            out.append(deepcopy(obj) if copy else obj)
         out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
         return out
 

@@ -50,6 +50,12 @@ async def run_load_test(
             nbapi.new(name, namespace, accelerator=accelerator, topology=topology),
         )
 
+    from kubeflow_tpu.testing.fakekube import FakeKube
+
+    # Read-only poll: the copy-free fast path exists only on FakeKube
+    # (HttpKube keeps the standard signature).
+    list_kwargs = {"copy": False} if isinstance(kube, FakeKube) else {}
+
     ready_at: dict[str, float] = {}
     failed: dict[str, str] = {}
     wanted = set(names)
@@ -60,7 +66,8 @@ async def run_load_test(
         # latencies being measured).
         listed = {
             name: nb
-            for nb in await kube.list("Notebook", namespace)
+            for nb in await kube.list("Notebook", namespace,
+                                       **list_kwargs)
             if (name := nb["metadata"]["name"]) in wanted
         }
         for name in names:

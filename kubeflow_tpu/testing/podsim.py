@@ -124,7 +124,7 @@ class PodSimulator:
 
         existing = {
             name_of(p): p
-            for p in await self.kube.list("Pod", ns)
+            for p in await self.kube.list("Pod", ns, copy=False)
             if any(
                 r.get("uid") == get_meta(obj).get("uid")
                 for r in get_meta(p).get("ownerReferences", [])
@@ -258,14 +258,14 @@ class PodSimulator:
         )
         if owner_uid:
             for kind in ("StatefulSet", "Deployment"):
-                for wl in await self.kube.list(kind, ns):
+                for wl in await self.kube.list(kind, ns, copy=False):
                     if get_meta(wl).get("uid") == owner_uid:
                         await self._mirror_status(kind, wl, deep_get(wl, "spec", "replicas", default=1))
 
     async def _mirror_status(self, kind: str, obj: dict, replicas: int) -> None:
         ns = namespace_of(obj)
         ready = 0
-        for p in await self.kube.list("Pod", ns):
+        for p in await self.kube.list("Pod", ns, copy=False):
             if any(
                 r.get("uid") == get_meta(obj).get("uid")
                 for r in get_meta(p).get("ownerReferences", [])
