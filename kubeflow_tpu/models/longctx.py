@@ -32,6 +32,7 @@ from kubeflow_tpu.parallel.ulysses import ulysses_attention
 # [S, S] logits in HBM.
 ATTENTION_STRATEGIES = {
     "ring": ring_attention,
+    "ring_flash": partial(ring_attention, block_impl="flash"),
     "ulysses": ulysses_attention,
     "ulysses_flash": partial(ulysses_attention, block_impl="flash"),
 }
@@ -46,8 +47,8 @@ class LongContextConfig:
     d_ff: int = 512
     seq_len: int = 1024          # the point: long S, sharded S/P per chip
     dtype: str = "bfloat16"
-    attention: str = "ring"      # "ring" | "ulysses" | "ulysses_flash"
-                                 # (ATTENTION_STRATEGIES)
+    attention: str = "ring"      # any ATTENTION_STRATEGIES key; *_flash
+                                 # variants stream blocks through pallas
 
     @property
     def head_dim(self) -> int:

@@ -147,10 +147,11 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
 # ---------------------------------------------------------------- backward
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, bq, bk, nk, causal):
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, bq, bk, nk, causal):
     qi, ki = pl.program_id(1), pl.program_id(2)
-    q_start, k_start = qi * bq, ki * bk
+    q_start = offs_ref[0] + qi * bq
+    k_start = offs_ref[1] + ki * bk
 
     @pl.when(ki == 0)
     def _init():
@@ -174,11 +175,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale, bq, bk, nq, causal):
     ki, qi = pl.program_id(1), pl.program_id(2)
-    q_start, k_start = qi * bq, ki * bk
+    q_start = offs_ref[0] + qi * bq
+    k_start = offs_ref[1] + ki * bk
 
     @pl.when(qi == 0)
     def _init():
@@ -206,68 +208,84 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret,
-               vma=None):
+               vma=None, q_offset=0, k_offset=0, delta=None):
+    """dq/dk/dv kernels. With the default zero offsets this is the
+    full-sequence backward; ring hops pass the blocks' global starts (and
+    a precomputed delta from the FINAL ring output) to get the one
+    block-pair's partial gradients."""
     q, k, v, out, lse = res
     bh, s, d = q.shape
-    bq, bk = min(block_q, s), min(block_k, s)
-    nq, nk = s // bq, s // bk
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    sk = k.shape[1]
+    bq, bk = min(block_q, s), min(block_k, sk)
+    nq, nk = s // bq, sk // bk
+    if delta is None:
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
     # Same sublane-replicated (8, s) layout as lse (tiling constraint).
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, s))
+    offs = jnp.asarray(
+        jnp.stack([jnp.int32(q_offset), jnp.int32(k_offset)]), jnp.int32
+    )
 
     common_in = [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),      # q by qi
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),      # k by ki
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),      # v by ki
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),      # do by qi
-        pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),      # lse by qi
-        pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),      # delta by qi
+        pl.BlockSpec((1, bq, d), lambda b, i, j, offs: (b, i, 0)),  # q by qi
+        pl.BlockSpec((1, bk, d), lambda b, i, j, offs: (b, j, 0)),  # k by ki
+        pl.BlockSpec((1, bk, d), lambda b, i, j, offs: (b, j, 0)),  # v by ki
+        pl.BlockSpec((1, bq, d), lambda b, i, j, offs: (b, i, 0)),  # do by qi
+        pl.BlockSpec((1, 8, bq), lambda b, i, j, offs: (b, 0, i)),  # lse by qi
+        pl.BlockSpec((1, 8, bq), lambda b, i, j, offs: (b, 0, i)),  # delta
     ]
     dq = pl.pallas_call(
         partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk,
                 causal=causal),
-        grid=(bh, nq, nk),
-        in_specs=common_in,
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nq, nk),
+            in_specs=common_in,
+            out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j, offs: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
         out_shape=_sds((bh, s, d), q.dtype, vma),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(offs, q, k, v, g, lse, delta)
 
     # dk/dv: grid walks (bh, ki, qi) — K block resident, Q blocks stream.
     dkv_in = [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, 8, bq), lambda b, j, i: (b, 0, i)),
-        pl.BlockSpec((1, 8, bq), lambda b, j, i: (b, 0, i)),
+        pl.BlockSpec((1, bq, d), lambda b, j, i, offs: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i, offs: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i, offs: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, j, i, offs: (b, i, 0)),
+        pl.BlockSpec((1, 8, bq), lambda b, j, i, offs: (b, 0, i)),
+        pl.BlockSpec((1, 8, bq), lambda b, j, i, offs: (b, 0, i)),
     ]
     dk, dv = pl.pallas_call(
         partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq,
                 causal=causal),
-        grid=(bh, nk, nq),
-        in_specs=dkv_in,
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nk, nq),
+            in_specs=dkv_in,
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda b, j, i, offs: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i, offs: (b, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+        ),
         out_shape=[
-            _sds((bh, s, d), k.dtype, vma),
-            _sds((bh, s, d), v.dtype, vma),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, d), jnp.float32),
-            pltpu.VMEM((bk, d), jnp.float32),
+            _sds((bh, sk, d), k.dtype, vma),
+            _sds((bh, sk, d), v.dtype, vma),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(offs, q, k, v, g, lse, delta)
     return dq, dk, dv
 
 
@@ -388,8 +406,9 @@ def flash_attention_partial(q, k, v, q_offset, k_offset, *,
     are the blocks' global sequence starts (traced scalars are fine).
     Returns ``(o_unnorm [b, s, h, d] f32, m [b, h, s] f32, l [b, h, s]
     f32)`` — the exact online-softmax carry terms ring attention folds,
-    so the [s_block, s_block] logits never touch HBM. Not differentiable
-    (pallas has no autodiff); the training path keeps the einsum block.
+    so the [s_block, s_block] logits never touch HBM. For training, the
+    matching per-hop backward is ``flash_attention_partial_grads`` (wired
+    up by ring.py's custom VJP).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -446,3 +465,47 @@ def flash_attention_partial(q, k, v, q_offset, k_offset, *,
     m = m[:, 0, :].reshape(b, h, s)
     l = l[:, 0, :].reshape(b, h, s)
     return o, m, l
+
+
+def flash_attention_partial_grads(q, k, v, do, lse, delta, q_offset, k_offset,
+                                  *, scale: float | None = None,
+                                  block_q: int = DEFAULT_BLOCK_Q,
+                                  block_k: int = DEFAULT_BLOCK_K,
+                                  vma=None,
+                                  interpret: bool | None = None):
+    """One ring hop's backward: block-pair partial (dq, dk, dv).
+
+    q/do ``[b, s_q, h, d]``, k/v ``[b, s_k, h, d]``; ``lse`` is the FINAL
+    ring logsumexp ``[b, h, s_q]`` (after folding every hop) and ``delta``
+    the rowsum(do·o_final) ``[b, h, s_q]`` — with those, the standard
+    flash backward restricted to this block pair yields exactly this
+    hop's contribution to the gradients (ring.py sums dq locally and
+    rotates dk/dv home with their blocks).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    bq, bk = min(block_q, s), min(block_k, k.shape[1])
+    if s % bq or k.shape[1] % bk:
+        raise ValueError(f"seq {s}/{k.shape[1]} must divide by blocks {bq}/{bk}")
+
+    def fold(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], t.shape[3])
+
+    def fold_stat(t):  # [b, h, s] -> [bh, s]
+        return t.reshape(b * h, t.shape[2])
+
+    lse8 = jnp.broadcast_to(fold_stat(lse)[:, None, :], (b * h, 8, s))
+    dq, dk, dv = _flash_bwd(
+        (fold(q), fold(k), fold(v), None, lse8), fold(do),
+        scale=scale, causal=True, block_q=bq, block_k=bk,
+        interpret=interpret, vma=vma,
+        q_offset=q_offset, k_offset=k_offset, delta=fold_stat(delta),
+    )
+
+    def unfold(t):
+        return t.reshape(b, h, t.shape[1], d).transpose(0, 2, 1, 3)
+
+    return unfold(dq), unfold(dk), unfold(dv)

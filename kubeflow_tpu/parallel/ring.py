@@ -42,7 +42,16 @@ def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None,
     outputs inherit the q/k/v varying set (e.g. a ``data`` batch axis),
     and ``fori_loop`` requires carry types to be loop-invariant.
     Returns the attention output with the same shape.
+
+    ``block_impl="flash"`` runs each hop through the pallas partial
+    kernel AND is trainable: a hand-written custom VJP re-rotates K/V
+    (with their gradient accumulators) around the ring while the partial
+    backward kernels produce each block-pair's dq/dk/dv from the final
+    logsumexp — see ``_ring_flash``.
     """
+    if block_impl == "flash":
+        vary = tuple(mesh_axes) if mesh_axes else (axis_name,)
+        return _ring_flash(q, k, v, axis_name, vary)
     n_shards = jax.lax.psum(1, axis_name)
     my_block = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -56,50 +65,26 @@ def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None,
     l = jnp.zeros((b, h, s_local), jnp.float32)
     o = jnp.zeros((b, s_local, h, d), jnp.float32)
     vary_axes = tuple(mesh_axes) if mesh_axes else (axis_name,)
-    if hasattr(jax.lax, "pcast"):
-        m, l, o = (jax.lax.pcast(t, vary_axes, to="varying") for t in (m, l, o))
-    elif hasattr(jax.lax, "pvary"):
-        m, l, o = (jax.lax.pvary(t, vary_axes) for t in (m, l, o))
+    m, l, o = (_mark_varying(t, vary_axes) for t in (m, l, o))
 
     def body(t, carry):
         k_t, v_t, m, l, o = carry
         src_block = (my_block - t) % n_shards
 
-        if block_impl == "flash":
-            # Pallas partial-attention kernel: the [s_local, s_local]
-            # logits stay in VMEM (ops/flash_attention.py). Forward-only —
-            # pallas has no autodiff, so training uses the einsum path.
-            from kubeflow_tpu.ops.flash_attention import flash_attention_partial
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, k_t).astype(jnp.float32)
+            * scale
+        )
+        mask = _block_causal_mask(my_block, src_block, s_local)
+        logits = jnp.where(mask[None, None, :, :], logits, _NEG_BIG)
 
-            o_blk, m_blk, l_blk = flash_attention_partial(
-                q, k_t, v_t, my_block * s_local, src_block * s_local,
-                scale=scale, vma=vary_axes,
-            )
-            m_blk = m_blk  # [b, h, s_local] f32
-            m_new = jnp.maximum(m, m_blk)
-            corr = jnp.exp(m - m_new)
-            corr_blk = jnp.exp(m_blk - m_new)
-            l = l * corr + l_blk * corr_blk
-            o = (
-                o * corr.transpose(0, 2, 1)[..., None]
-                + o_blk.astype(jnp.float32)
-                * corr_blk.transpose(0, 2, 1)[..., None]
-            )
-        else:
-            logits = (
-                jnp.einsum("bqhd,bkhd->bhqk", q, k_t).astype(jnp.float32)
-                * scale
-            )
-            mask = _block_causal_mask(my_block, src_block, s_local)
-            logits = jnp.where(mask[None, None, :, :], logits, _NEG_BIG)
-
-            m_new = jnp.maximum(m, logits.max(axis=-1))
-            correction = jnp.exp(m - m_new)
-            p = jnp.exp(logits - m_new[..., None])
-            l = l * correction + p.sum(axis=-1)
-            o = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
-                "bhqk,bkhd->bqhd", p.astype(v_t.dtype), v_t
-            ).astype(jnp.float32)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l = l * correction + p.sum(axis=-1)
+        o = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v_t.dtype), v_t
+        ).astype(jnp.float32)
 
         # Rotate K/V to the next device; AFTER the matmul so XLA can overlap
         # the collective-permute with the next iteration's compute.
@@ -112,13 +97,122 @@ def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None,
     return (o / denom).astype(q.dtype)
 
 
+# ------------------------------------------------- trainable flash ring
+
+
+def _mark_varying(t, axes):
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(t, tuple(axes), to="varying")
+    return jax.lax.pvary(t, tuple(axes))  # pragma: no cover - pre-pcast jax
+
+
+def _ring_flash_fwd_loop(q, k, v, axis_name, vary_axes):
+    """Flash-kernel ring forward; returns (normalized o, lse [b, h, s])."""
+    from kubeflow_tpu.ops.flash_attention import flash_attention_partial
+
+    n_shards = jax.lax.psum(1, axis_name)
+    my_block = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    m = _mark_varying(jnp.full((b, h, s_local), _NEG_BIG, jnp.float32), vary_axes)
+    l = _mark_varying(jnp.zeros((b, h, s_local), jnp.float32), vary_axes)
+    o = _mark_varying(jnp.zeros((b, s_local, h, d), jnp.float32), vary_axes)
+
+    def body(t, carry):
+        k_t, v_t, m, l, o = carry
+        src_block = (my_block - t) % n_shards
+        o_blk, m_blk, l_blk = flash_attention_partial(
+            q, k_t, v_t, my_block * s_local, src_block * s_local,
+            scale=scale, vma=vary_axes,
+        )
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        corr_blk = jnp.exp(m_blk - m_new)
+        l = l * corr + l_blk * corr_blk
+        o = (
+            o * corr.transpose(0, 2, 1)[..., None]
+            + o_blk.astype(jnp.float32) * corr_blk.transpose(0, 2, 1)[..., None]
+        )
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        return (k_t, v_t, m_new, l, o)
+
+    _, _, m, l, o = jax.lax.fori_loop(0, n_shards, body, (k, v, m, l, o))
+    l_safe = jnp.maximum(l, 1e-20)
+    out = (o / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(q, k, v, axis_name, vary_axes):
+    out, _ = _ring_flash_fwd_loop(q, k, v, axis_name, vary_axes)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, vary_axes):
+    out, lse = _ring_flash_fwd_loop(q, k, v, axis_name, vary_axes)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, vary_axes, res, do):
+    """Second rotation around the ring: each hop's partial backward runs
+    on the pallas kernels with the FINAL logsumexp (so the block-pair
+    probabilities are the true softmax values), dq accumulates locally,
+    and dk/dv accumulators travel WITH their K/V blocks — after P hops
+    they are back on their home devices."""
+    from kubeflow_tpu.ops.flash_attention import flash_attention_partial_grads
+
+    q, k, v, out, lse = res
+    n_shards = jax.lax.psum(1, axis_name)
+    my_block = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    delta = jnp.einsum(
+        "bshd,bshd->bhs", do.astype(jnp.float32), out.astype(jnp.float32)
+    )
+    dq = _mark_varying(jnp.zeros((b, s_local, h, d), jnp.float32), vary_axes)
+    dk = _mark_varying(jnp.zeros((b, s_local, h, d), jnp.float32), vary_axes)
+    dv = _mark_varying(jnp.zeros((b, s_local, h, d), jnp.float32), vary_axes)
+
+    def body(t, carry):
+        k_t, v_t, dk_t, dv_t, dq = carry
+        src_block = (my_block - t) % n_shards
+        dq_p, dk_p, dv_p = flash_attention_partial_grads(
+            q, k_t, v_t, do, lse, delta,
+            my_block * s_local, src_block * s_local,
+            scale=scale, vma=vary_axes,
+        )
+        dq = dq + dq_p.astype(jnp.float32)
+        dk_t = dk_t + dk_p.astype(jnp.float32)
+        dv_t = dv_t + dv_p.astype(jnp.float32)
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        dk_t = jax.lax.ppermute(dk_t, axis_name, perm)
+        dv_t = jax.lax.ppermute(dv_t, axis_name, perm)
+        return (k_t, v_t, dk_t, dv_t, dq)
+
+    _, _, dk, dv, dq = jax.lax.fori_loop(
+        0, n_shards, body, (k, v, dk, dv, dq)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def ring_attention(q, k, v, mesh, axis_name: str = "seq",
                    block_impl: str = "xla"):
     """GSPMD entrypoint: q/k/v ``[batch, seq, heads, head_dim]`` with the
     seq dimension sharded over ``axis_name``; other mesh axes (data) shard
     batch transparently. ``block_impl="flash"`` runs each hop's block
-    attention as the pallas partial kernel (forward-only; see
-    ring_attention_local)."""
+    attention as the pallas partial kernel — fwd AND bwd (the custom VJP
+    re-rotates K/V with their gradient accumulators; see _ring_flash), so
+    ring long-context training never materializes block logits in HBM."""
     from jax.sharding import PartitionSpec as P
 
     try:
