@@ -167,8 +167,12 @@ def bench() -> dict:
     t_start = time.perf_counter()
     spawn = asyncio.run(_run_phase(spawn_notebook))
 
+    from functools import partial as _partial
+
     cfg = BurninConfig(**BENCH_MODEL)
-    params = init_params(jax.random.key(0), cfg)
+    # One jitted program for the whole init: eager per-leaf RNG costs ~12 s
+    # extra through the remote relay (measured; docs/perf.md).
+    params = jax.jit(_partial(init_params, cfg=cfg))(jax.random.key(0))
     tokens = jax.random.randint(
         jax.random.key(1), (BENCH_BATCH, cfg.seq_len), 0, cfg.vocab
     )
