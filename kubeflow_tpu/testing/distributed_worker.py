@@ -62,6 +62,39 @@ def main() -> None:
     out = allreduce(x)
     local = np.asarray(out.addressable_shards[0].data)
     print(f"PSUM_RESULT {float(local[0])} NPROC {jax.process_count()}", flush=True)
+
+    # Optional 2D-mesh mode (KFTPU_WORKER_MESH="DxM"): the multi-axis
+    # collectives a real dp x tp training step issues, across PROCESS
+    # boundaries — psum on the model axis and pmean on data must both
+    # cross the DCN bootstrap, not just a single 1D all-reduce.
+    mesh_spec = os.environ.get("KFTPU_WORKER_MESH")
+    if mesh_spec:
+        import math
+
+        dims = tuple(int(p) for p in mesh_spec.lower().split("x"))
+        if len(dims) != 2 or math.prod(dims) != jax.device_count():
+            # A stray inherited env var must not break the 1D contract run.
+            print(f"MESH2D_SKIPPED {mesh_spec} (have {jax.device_count()} "
+                  "devices)", flush=True)
+            jax.distributed.shutdown()
+            return
+        grid = np.asarray(jax.devices()).reshape(dims)
+        mesh2 = Mesh(grid, ("data", "model"))
+
+        @jax.jit
+        @partial(shard_map, mesh=mesh2, in_specs=P("data", "model"),
+                 out_specs=P("data", "model"))
+        def both_axes(v):
+            return jax.lax.pmean(jax.lax.psum(v, "model"), "data")
+
+        x2 = jax.make_array_from_callback(
+            dims, NamedSharding(mesh2, P("data", "model")),
+            lambda _idx: np.array([[float(pid + 1)]]),
+        )
+        out2 = both_axes(x2)
+        local2 = np.asarray(out2.addressable_shards[0].data)
+        print(f"MESH2D_RESULT {float(local2[0, 0])}", flush=True)
+
     jax.distributed.shutdown()
 
 

@@ -23,12 +23,11 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_psum_over_worker_env_contract():
-    tpu = TpuSlice.parse("v5e", "4x4")  # 16 chips / 8 per host = 2 hosts
-    assert tpu.num_hosts == 2
-    hostnames = ["localhost", "localhost"]
-    port = _free_port()
 
+def _spawn_workers(tpu, hostnames, extra_env=None):
+    """Spawn one worker per host with the controller's env contract; returns
+    the Popen list. Callers must reap via _communicate_all."""
+    port = _free_port()
     procs = []
     for i in range(tpu.num_hosts):
         env = dict(os.environ)
@@ -38,27 +37,59 @@ def test_two_process_psum_over_worker_env_contract():
             f for f in env.get("XLA_FLAGS", "").split()
             if "xla_force_host_platform_device_count" not in f
         )
+        env.pop("KFTPU_WORKER_MESH", None)  # never inherit from the shell
         env.update(tpu.worker_env(i, hostnames))
         # The controller's value uses the fixed in-cluster coordinator
         # port; on a shared test host we rebind to a free one.
         env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+        env.update(extra_env or {})
         procs.append(
             subprocess.Popen(
                 [sys.executable, "-m", "kubeflow_tpu.testing.distributed_worker"],
-                env=env,
-                cwd=REPO,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             )
         )
+    return procs
 
+
+def _communicate_all(procs):
+    """Reap every worker even when an early one fails — a dead coordinator
+    otherwise leaves the rest blocked in the collective until timeout."""
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs
 
-    for out in outs:
+
+def test_two_process_psum_over_worker_env_contract():
+    tpu = TpuSlice.parse("v5e", "4x4")  # 16 chips / 8 per host = 2 hosts
+    assert tpu.num_hosts == 2
+    procs = _spawn_workers(tpu, ["localhost", "localhost"])
+    for out in _communicate_all(procs):
         # 2 processes × 1 device: psum of (pid+1) = 1 + 2 = 3 everywhere.
         assert "PSUM_RESULT 3.0 NPROC 2" in out, out
+
+
+def test_four_process_2x2_mesh_collectives():
+    """4 hosts (v5e 4x8) as 4 processes forming a (data=2, model=2) mesh:
+    the dp×tp collective pattern a real sharded train step issues must
+    work across process boundaries, not just a 1D all-reduce."""
+    tpu = TpuSlice.parse("v5e", "4x8")
+    assert tpu.num_hosts == 4
+    procs = _spawn_workers(tpu, ["localhost"] * 4,
+                           extra_env={"KFTPU_WORKER_MESH": "2x2"})
+    for out in _communicate_all(procs):
+        # 1D psum: 1+2+3+4 = 10 on every process.
+        assert "PSUM_RESULT 10.0 NPROC 4" in out, out
+        # 2D: devices (data d, model m) hold pid+1 = [[1,2],[3,4]];
+        # psum over model → [[3],[7]]; pmean over data → 5 everywhere.
+        assert "MESH2D_RESULT 5.0" in out, out
