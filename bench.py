@@ -42,6 +42,15 @@ BENCH_MODEL = dict(
 
 SCALE_NOTEBOOKS = 200
 
+# Long-context story: ring attention with trainable flash hops at 8k
+# tokens on the single bench chip (multi-chip sequence parallelism is the
+# dryrun gate's job; this measures the kernel path's per-chip throughput).
+LONGCTX_MODEL = dict(
+    vocab=8192, d_model=2048, n_layers=2, d_ff=8192, n_heads=16,
+    seq_len=8192, attention="ring_flash",
+)
+LONGCTX_STEPS = 10
+
 
 class ControlPlane:
     """In-process control plane (fake apiserver + reconcilers + kubelet
@@ -152,6 +161,36 @@ def detect_accelerator(device) -> str | None:
     return None
 
 
+def _longctx_bench() -> dict:
+    """Trainable flash ring attention at 8k tokens (one chip)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import jax
+
+    from kubeflow_tpu.models import longctx
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "seq"))
+    cfg = longctx.LongContextConfig(**LONGCTX_MODEL)
+    params = longctx.init_params(jax.random.key(2), cfg)
+    tokens = np.zeros((1, cfg.seq_len), np.int64)
+    toks, params = longctx.shard_inputs(tokens, params, mesh)
+    step = jax.jit(longctx.make_train_step(cfg, mesh))
+    params, loss = step(params, toks)
+    float(loss)  # value fetch = reliable sync through the remote relay
+    t0 = time.perf_counter()
+    for _ in range(LONGCTX_STEPS):
+        params, loss = step(params, toks)
+    float(loss)
+    sec = (time.perf_counter() - t0) / LONGCTX_STEPS
+    return {
+        "attention": cfg.attention,
+        "seq_len": cfg.seq_len,
+        "step_sec": round(sec, 4),
+        "tokens_per_sec": round(cfg.seq_len / sec, 0),
+    }
+
+
 def bench() -> dict:
     import jax
 
@@ -216,6 +255,8 @@ def bench() -> dict:
 
         ici = run_ici_probe(accelerator=acc_name, topology=None).to_dict()
 
+    longctx_out = _longctx_bench()
+
     # Control-plane scale AFTER the cold-start window (its wall time must
     # not pollute coldstart_to_first_step_sec).
     scale = asyncio.run(_run_phase(scale_test))
@@ -238,6 +279,7 @@ def bench() -> dict:
         "coldstart_to_first_step_sec": round(coldstart_sec, 3),
         "control_plane_spawn_sec": round(spawn["spawn_sec"], 4),
         "control_plane_scale": scale,
+        "longctx": longctx_out,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "n_devices": len(devices),
         "backend": jax.default_backend(),
