@@ -173,9 +173,9 @@ def _longctx_bench() -> dict:
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "seq"))
     cfg = longctx.LongContextConfig(**LONGCTX_MODEL)
     params = longctx.init_params(jax.random.key(2), cfg)
-    tokens = np.zeros((1, cfg.seq_len), np.int64)
+    tokens = np.zeros((1, cfg.seq_len), np.int32)
     toks, params = longctx.shard_inputs(tokens, params, mesh)
-    step = jax.jit(longctx.make_train_step(cfg, mesh))
+    step = jax.jit(longctx.make_train_step(cfg, mesh), donate_argnums=(0,))
     params, loss = step(params, toks)
     float(loss)  # value fetch = reliable sync through the remote relay
     t0 = time.perf_counter()
