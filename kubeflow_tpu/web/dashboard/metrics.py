@@ -60,7 +60,27 @@ class MetricsService(Protocol):
     async def close(self) -> None: ...
 
 
-class PrometheusMetricsService:
+class _AiohttpSession:
+    """Shared lazy aiohttp session lifecycle for the HTTP drivers."""
+
+    _session = None
+
+    async def _session_get(self):
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=15)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class PrometheusMetricsService(_AiohttpSession):
     """Range queries against a Prometheus-compatible HTTP API.
 
     ``fetch_json`` is injectable for tests; the default drives aiohttp at
@@ -86,22 +106,12 @@ class PrometheusMetricsService:
         self._session = None
 
     async def _http_fetch(self, params: dict) -> dict:
-        import aiohttp
-
-        if self._session is None:
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=15)
-            )
-        async with self._session.get(
+        session = await self._session_get()
+        async with session.get(
             f"{self.url}/api/v1/query_range", params=params
         ) as resp:
             resp.raise_for_status()
             return await resp.json()
-
-    async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
 
     async def query(self, series: str, interval: str) -> list[TimeSeriesPoint]:
         if series not in self.queries:
@@ -145,6 +155,122 @@ class PrometheusMetricsService:
         }
 
 
+class CloudMonitoringMetricsService(_AiohttpSession):
+    """Google Cloud Monitoring driver (the reference's Stackdriver service,
+    ``stackdriver_metrics_service.ts``) — REST against
+    ``monitoring.googleapis.com/v3 timeSeries.list``, auth via the GCE
+    metadata server's workload-identity token (cached until ~expiry).
+
+    Metric types mirror the reference's ``kubernetes.io`` choices, plus the
+    TPU-first ``tpu.googleapis.com`` duty-cycle series that replaces its
+    GPU story. ``fetch_json``/``fetch_token`` are injectable for tests.
+    """
+
+    METRIC_TYPES = {
+        "node_cpu": "kubernetes.io/node/cpu/allocatable_utilization",
+        "pod_cpu": "kubernetes.io/container/cpu/limit_utilization",
+        "pod_mem": "kubernetes.io/container/memory/used_bytes",
+        "tpu_duty": "tpu.googleapis.com/accelerator/duty_cycle",
+    }
+    _TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                  "instance/service-accounts/default/token")
+
+    def __init__(self, project: str, *, cluster: str | None = None,
+                 fetch_json=None, fetch_token=None, clock=time.time):
+        self.project = project
+        self.cluster = cluster
+        self._fetch_json = fetch_json or self._http_fetch
+        self._fetch_token = fetch_token or self._http_token
+        self._clock = clock
+        self._token: tuple[str, float] | None = None  # (token, expiry)
+
+    async def _http_token(self) -> tuple[str, float]:
+        session = await self._session_get()
+        async with session.get(
+            self._TOKEN_URL, headers={"Metadata-Flavor": "Google"}
+        ) as resp:
+            resp.raise_for_status()
+            body = await resp.json()
+        return body["access_token"], self._clock() + body.get("expires_in", 300)
+
+    async def _token_value(self) -> str:
+        if self._token is None or self._clock() > self._token[1] - 60:
+            self._token = await self._fetch_token()
+        return self._token[0]
+
+    async def _http_fetch(self, params: dict) -> dict:
+        session = await self._session_get()
+        url = (f"https://monitoring.googleapis.com/v3/projects/"
+               f"{self.project}/timeSeries")
+        headers = {"Authorization": f"Bearer {await self._token_value()}"}
+        async with session.get(url, params=params, headers=headers) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def query(self, series: str, interval: str) -> list[TimeSeriesPoint]:
+        metric_type = self.METRIC_TYPES.get(series)
+        minutes = INTERVALS_MIN.get(interval)
+        if metric_type is None or minutes is None:
+            raise KeyError(f"unknown series/interval {series!r}/{interval!r}")
+        end = self._clock()
+
+        def rfc3339(t: float) -> str:
+            return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t))
+
+        filt = f'metric.type="{metric_type}"'
+        if self.cluster:
+            # Without this a multi-cluster project charts every cluster's
+            # nodes under colliding labels.
+            filt += f' AND resource.label.cluster_name="{self.cluster}"'
+        params = {
+            "filter": filt,
+            "interval.startTime": rfc3339(end - minutes * 60),
+            "interval.endTime": rfc3339(end),
+            "aggregation.alignmentPeriod": "60s",
+            "aggregation.perSeriesAligner": "ALIGN_MEAN",
+        }
+        points: list[TimeSeriesPoint] = []
+        for _page in range(10):  # bounded: 10 pages ≫ any sane dashboard
+            payload = await self._fetch_json(params)
+            points.extend(self._parse_time_series(payload))
+            token = (payload or {}).get("nextPageToken")
+            if not token:
+                break
+            params = {**params, "pageToken": token}
+        return points
+
+    @staticmethod
+    def _parse_time_series(payload: dict) -> list[TimeSeriesPoint]:
+        """timeSeries.list response → flat point list (the reference's
+        proto-Timestamp handling, minus the proto)."""
+        import calendar
+
+        points: list[TimeSeriesPoint] = []
+        for ts in (payload or {}).get("timeSeries", []):
+            labels = {**(ts.get("resource") or {}).get("labels", {}),
+                      **(ts.get("metric") or {}).get("labels", {})}
+            label = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            for p in ts.get("points", []):
+                stamp = ((p.get("interval") or {}).get("endTime") or "")
+                value = p.get("value") or {}
+                raw = value.get("doubleValue", value.get("int64Value"))
+                try:
+                    when = calendar.timegm(
+                        time.strptime(stamp[:19], "%Y-%m-%dT%H:%M:%S")
+                    )
+                    points.append(TimeSeriesPoint(float(when), label, float(raw)))
+                except (TypeError, ValueError):
+                    continue
+        return points
+
+    def charts_link(self) -> dict:
+        return {
+            "resourceChartsLink":
+                f"https://console.cloud.google.com/monitoring?project={self.project}",
+            "resourceChartsLinkText": "View in Cloud Monitoring",
+        }
+
+
 class NullMetricsService:
     """No metrics backend configured — the factory default, like the
     reference dashboard without PROMETHEUS_URL."""
@@ -160,11 +286,18 @@ class NullMetricsService:
 
 
 def metrics_service_from_env(env: dict) -> MetricsService:
-    """Driver selection (reference metrics_service_factory.ts): the
-    PROMETHEUS_URL env turns the Prometheus driver on."""
+    """Driver selection (reference metrics_service_factory.ts):
+    PROMETHEUS_URL turns the Prometheus driver on;
+    CLOUD_MONITORING_PROJECT the Cloud Monitoring (Stackdriver) one.
+    Prometheus wins when both are set (it is the in-cluster choice)."""
     url = env.get("PROMETHEUS_URL")
     if url:
         return PrometheusMetricsService(
             url, dashboard_url=env.get("METRICS_DASHBOARD")
+        )
+    project = env.get("CLOUD_MONITORING_PROJECT")
+    if project:
+        return CloudMonitoringMetricsService(
+            project, cluster=env.get("CLOUD_MONITORING_CLUSTER")
         )
     return NullMetricsService()

@@ -113,3 +113,80 @@ async def test_dashboard_metrics_route_and_factory():
     finally:
         for c in clients:
             await c.close()
+
+
+async def test_cloud_monitoring_driver_fixture_backed():
+    """The GCM driver (reference stackdriver_metrics_service.ts twin):
+    filter/interval construction, pagination, cluster scoping, token
+    caching, and timeSeries parsing — all against injected fixtures (no
+    cloud in CI)."""
+    from kubeflow_tpu.web.dashboard.metrics import (
+        CloudMonitoringMetricsService,
+        metrics_service_from_env,
+    )
+
+    calls = []
+    tokens = []
+
+    async def fetch_json(params):
+        calls.append(params)
+        page = {
+            "timeSeries": [{
+                "resource": {"labels": {"node_name": "tpu-node-1"}},
+                "metric": {"labels": {}},
+                "points": [
+                    {"interval": {"endTime": "2026-07-30T01:00:00Z"},
+                     "value": {"doubleValue": 0.91}},
+                    {"interval": {"endTime": "2026-07-30T01:01:00Z"},
+                     "value": {"int64Value": "1"}},
+                    {"interval": {"endTime": "bogus"}, "value": {}},
+                ],
+            }]
+        }
+        if "pageToken" not in params:
+            page["nextPageToken"] = "page2"  # second page must be fetched
+        return page
+
+    async def fetch_token():
+        tokens.append(1)
+        return "tok", clock() + 3600
+
+    now = [1_800_000_000.0]
+    clock = lambda: now[0]
+    svc = CloudMonitoringMetricsService(
+        "proj-1", cluster="cluster-a",
+        fetch_json=fetch_json, fetch_token=fetch_token, clock=clock)
+
+    pts = await svc.query("tpu_duty", "Last15m")
+    assert calls[0]["filter"] == (
+        'metric.type="tpu.googleapis.com/accelerator/duty_cycle"'
+        ' AND resource.label.cluster_name="cluster-a"')
+    assert calls[0]["interval.endTime"].endswith("Z")
+    assert len(calls) == 2 and calls[1]["pageToken"] == "page2"
+    assert [p.value for p in pts] == [0.91, 1.0] * 2  # both pages, bogus dropped
+    assert pts[0].label == "node_name=tpu-node-1"
+    assert svc.charts_link()["resourceChartsLink"].endswith("project=proj-1")
+
+    # Token caching: first use fetches, re-use within expiry does not,
+    # advancing the clock past expiry refetches.
+    assert await svc._token_value() == "tok" and len(tokens) == 1
+    await svc._token_value()
+    assert len(tokens) == 1
+    now[0] += 7200
+    await svc._token_value()
+    assert len(tokens) == 2
+
+    import pytest
+    with pytest.raises(KeyError):
+        await svc.query("nope", "Last15m")
+
+    # Factory: project env selects the GCM driver; Prometheus wins if both.
+    assert isinstance(
+        metrics_service_from_env({"CLOUD_MONITORING_PROJECT": "p"}),
+        CloudMonitoringMetricsService)
+    from kubeflow_tpu.web.dashboard.metrics import PrometheusMetricsService
+    assert isinstance(
+        metrics_service_from_env(
+            {"CLOUD_MONITORING_PROJECT": "p", "PROMETHEUS_URL": "http://x"}),
+        PrometheusMetricsService)
+    await svc.close()
