@@ -97,6 +97,10 @@ def _summarize(nb: dict, status) -> dict:
             nbapi.SERVER_TYPE_ANNOTATION, "jupyter"
         ),
         "age": meta.get("creationTimestamp"),
+        # The culler's annotation (reference JWA "Last activity" column).
+        "lastActivity": (meta.get("annotations") or {}).get(
+            nbapi.LAST_ACTIVITY_ANNOTATION
+        ),
         "image": containers[0].get("image", ""),
         "cpu": deep_get(containers[0], "resources", "requests", "cpu"),
         "memory": deep_get(containers[0], "resources", "requests", "memory"),

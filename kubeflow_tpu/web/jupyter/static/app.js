@@ -312,6 +312,11 @@ async function refresh() {
       sortKey: (nb) => nb.age || "",
     },
     {
+      title: "Last activity",
+      render: (nb) => (nb.lastActivity ? KF.age(nb.lastActivity) + " ago" : "—"),
+      sortKey: (nb) => nb.lastActivity || "",
+    },
+    {
       title: "Actions",
       render: (nb) => {
         const stopped = nb.status.phase === "stopped";
