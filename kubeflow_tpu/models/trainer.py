@@ -77,18 +77,55 @@ def abstract_state(params: Any, optimizer) -> dict:
     return jax.eval_shape(lambda p: init_state(p, optimizer), params)
 
 
-def make_train_step(loss_fn: Callable, optimizer):
+def make_train_step(loss_fn: Callable, optimizer, accum_steps: int = 1):
     """(state, batch) → (state, loss); jit/pjit-ready pure function.
 
     ``loss_fn(params, batch) -> scalar`` — close over model config/mesh at
     the call site (the model modules' loss_fn signatures fit with
     functools.partial).
+
+    ``accum_steps > 1`` enables gradient accumulation: the batch's leading
+    dim splits into that many microbatches, gradients average under a
+    ``lax.scan`` (one compiled microstep, activations of one microbatch
+    live at a time), and the optimizer applies once — the standard recipe
+    for effective batch sizes that don't fit HBM.
     """
 
     import optax
 
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def grads_of(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+        leading = jax.tree.leaves(batch)[0].shape[0]
+        if leading % accum_steps:
+            raise ValueError(
+                f"batch size {leading} not divisible by accum_steps={accum_steps}"
+            )
+        micro = jax.tree.map(
+            lambda x: x.reshape((accum_steps, x.shape[0] // accum_steps)
+                                + x.shape[1:]),
+            batch,
+        )
+
+        def micro_step(carry, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            acc_loss, acc_grads = carry
+            return (
+                acc_loss + loss / accum_steps,
+                jax.tree.map(lambda a, g: a + g / accum_steps, acc_grads, grads),
+            ), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss, grads), _ = jax.lax.scan(
+            micro_step, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        return loss, grads
+
     def step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        loss, grads = grads_of(state["params"], batch)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

@@ -103,3 +103,23 @@ def test_sharded_state_one_step():
     jax.block_until_ready(loss)
     assert jnp.isfinite(loss)
     assert int(new_state["step"]) == 1
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=4 over a batch of 8 == one full-batch step (the mean of
+    microbatch gradients IS the full-batch gradient for a mean loss)."""
+    tcfg = trainer.TrainerConfig(optimizer="sgd", lr=1e-2, grad_clip=0.0)
+    tx = trainer.make_optimizer(tcfg)
+    params = init_params(jax.random.key(0), CFG)
+    batch = next(batches(1, batch=8))
+
+    full = jax.jit(trainer.make_train_step(partial(loss_fn, cfg=CFG), tx))
+    accum = jax.jit(trainer.make_train_step(partial(loss_fn, cfg=CFG), tx,
+                                            accum_steps=4))
+    s_full, l_full = full(trainer.init_state(params, tx), batch)
+    s_acc, l_acc = accum(trainer.init_state(params, tx), batch)
+    np.testing.assert_allclose(float(l_acc), float(l_full), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_full["params"]),
+                    jax.tree.leaves(s_acc["params"])):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
