@@ -114,7 +114,7 @@ def make_train_step(loss_fn: Callable, optimizer, accum_steps: int = 1):
             loss, grads = jax.value_and_grad(loss_fn)(params, mb)
             acc_loss, acc_grads = carry
             return (
-                acc_loss + loss / accum_steps,
+                acc_loss + (loss / accum_steps).astype(acc_loss.dtype),
                 jax.tree.map(lambda a, g: a + g / accum_steps, acc_grads, grads),
             ), None
 

@@ -129,6 +129,10 @@ def create_base_app(
     )
     app["kube"] = kube
     app["authorizer"] = authorizer or AllowAll()
+    # The resolved identity contract, for introspection (/debug) — never
+    # re-derive from env, the kwargs are the truth.
+    app["userid_header"] = userid_header
+    app["userid_prefix"] = userid_prefix
 
     async def healthz(_request):
         return web.json_response({"status": "ok"})

@@ -204,6 +204,25 @@ async def dashboard_links(request):
     return json_success({"menuLinks": request.app["links"]})
 
 
+@routes.get("/debug")
+async def debug_info(request):
+    """Deployment self-description (reference server.ts /debug): who the
+    request resolved to and which env contract is active."""
+    from kubeflow_tpu.cmd.envconfig import controller_namespace
+
+    return json_success({
+        "user": request.get("user", ""),
+        "kfamBoundary": type(request.app["kfam"]).__name__,
+        "metricsDriver": type(request.app["metrics_service"]).__name__,
+        "registrationFlowAllowed": request.app["registration_flow"],
+        "controllerNamespace": controller_namespace(),
+        "headersForIdentity": {
+            "USERID_HEADER": request.app["userid_header"],
+            "USERID_PREFIX": request.app.get("userid_prefix", ""),
+        },
+    })
+
+
 @routes.get("/api/dashboard-settings")
 async def dashboard_settings(request):
     """Admin settings blob (reference api.ts /dashboard-settings: the

@@ -232,3 +232,18 @@ async def test_dashboard_activities_and_settings():
         assert (await resp.json())["settings"] == {"theme": "dark"}
     finally:
         await client.close()
+
+
+async def test_dashboard_debug_endpoint():
+    from kubeflow_tpu.web.dashboard import create_app as create_dash
+
+    client = TestClient(TestServer(create_dash(FakeKube())))
+    await client.start_server()
+    try:
+        resp = await client.get("/debug", headers={"kubeflow-userid": "d@x.com"})
+        body = await resp.json()
+        assert body["user"] == "d@x.com"
+        assert body["kfamBoundary"] == "InProcessKfam"
+        assert "USERID_HEADER" in body["headersForIdentity"]
+    finally:
+        await client.close()
