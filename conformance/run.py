@@ -256,7 +256,7 @@ class Conformance:
     async def check_image_catalog(self):
         """The spawner's image selection pins from the catalog ConfigMap at
         admission (odh ImageStream resolution, rebuilt k8s-native)."""
-        from kubeflow_tpu.cmd.envconfig import controller_namespace
+        from kubeflow_tpu.runtime.deployment import controller_namespace
 
         ns = controller_namespace()
         if await self.kube.get_or_none("ConfigMap", "notebook-images", ns):

@@ -29,11 +29,9 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
-def controller_namespace() -> str:
-    """The namespace this stack is installed in (downward-API POD_NAMESPACE)
-    — the single definition of the default; webhook catalog lookups, leader
-    election, and CA-bundle mirroring must all agree on it."""
-    return os.environ.get("POD_NAMESPACE", "kubeflow-tpu")
+# Re-exported from runtime so every layer shares one definition without
+# importing this cmd wiring module.
+from kubeflow_tpu.runtime.deployment import controller_namespace  # noqa: E402,F401
 
 
 def notebook_options():

@@ -1,0 +1,122 @@
+"""Vision burn-in: the convolution workload of the slice-validation suite.
+
+The transformer families exercise matmuls; this one exercises the MXU's
+*convolution* path (``lax.conv_general_dilated`` in NHWC, which XLA tiles
+onto the systolic array) — the op class PyTorch/XLA vision users run on
+these slices. A small pre-activation residual convnet: stem conv → stages
+of residual blocks with stride-2 downsamples → global pool → classifier.
+
+Design notes, TPU-first:
+- NHWC layout end to end (the TPU-native conv layout; NCHW costs a
+  transpose per conv).
+- Channel counts are multiples of 128 where it matters (the MXU lane
+  width) at the default widths.
+- RMSNorm over channels instead of batchnorm: no cross-batch state, so
+  the model is data-parallel with zero extra collectives beyond the grad
+  psum GSPMD inserts.
+
+Reference parity: the reference ships no models (SURVEY.md); families here
+validate slices (burnin=dp+tp matmuls, longctx=sp attention, moe=ep
+dispatch, pipelined=pp schedule, vision=conv path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.burnin import _rmsnorm
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 64
+    channels: int = 3
+    widths: tuple = (128, 256, 512)   # per stage; stride-2 between stages
+    blocks_per_stage: int = 2
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    scale = (2.0 / (kh * kw * cin)) ** 0.5  # He init for relu-family
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * scale
+
+
+def init_params(rng: jax.Array, cfg: VisionConfig) -> dict:
+    n_blocks = len(cfg.widths) * cfg.blocks_per_stage
+    # stem + head + one downsample per stage + two convs per block
+    keys = iter(jax.random.split(rng, 2 + len(cfg.widths) + 2 * n_blocks))
+    params: dict = {
+        "stem": _conv_init(next(keys), 3, 3, cfg.channels, cfg.widths[0]),
+        "stages": [],
+        "head_norm": jnp.ones((cfg.widths[-1],), jnp.float32),
+        "head": jax.random.normal(
+            next(keys), (cfg.widths[-1], cfg.num_classes), jnp.float32
+        ) * (1.0 / cfg.widths[-1]) ** 0.5,
+    }
+    cin = cfg.widths[0]
+    for width in cfg.widths:
+        stage = {"down": _conv_init(next(keys), 3, 3, cin, width), "blocks": []}
+        for _ in range(cfg.blocks_per_stage):
+            stage["blocks"].append({
+                "norm1": jnp.ones((width,), jnp.float32),
+                "conv1": _conv_init(next(keys), 3, 3, width, width),
+                "norm2": jnp.ones((width,), jnp.float32),
+                "conv2": _conv_init(next(keys), 3, 3, width, width),
+            })
+        params["stages"].append(stage)
+        cin = width
+    return params
+
+
+def _conv(x, w, stride: int = 1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def forward(params: dict, images: jax.Array, cfg: VisionConfig) -> jax.Array:
+    """[batch, H, W, C] images → [batch, num_classes] logits (f32)."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = _conv(images.astype(dtype), params["stem"])
+    for stage in params["stages"]:
+        x = _conv(jax.nn.relu(x), stage["down"], stride=2)
+        for block in stage["blocks"]:
+            h = _conv(jax.nn.relu(_rmsnorm(x, block["norm1"])), block["conv1"])
+            h = _conv(jax.nn.relu(_rmsnorm(h, block["norm2"])), block["conv2"])
+            x = x + h
+    x = _rmsnorm(x.mean(axis=(1, 2)), params["head_norm"])
+    return (x @ params["head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: tuple, cfg: VisionConfig) -> jax.Array:
+    """(images, labels) → mean cross entropy."""
+    images, labels = batch
+    logits = forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+
+def make_train_step(cfg: VisionConfig, lr: float = 1e-3):
+    def step(params, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return params, loss
+
+    return step
+
+
+def shard_batch(images, labels, mesh: Mesh, data_axis: str = "data"):
+    """Data-parallel placement; params replicate (GSPMD psums the grads)."""
+    spec = NamedSharding(mesh, P(data_axis, None, None, None))
+    return (
+        jax.device_put(images, spec),
+        jax.device_put(labels, NamedSharding(mesh, P(data_axis))),
+    )

@@ -208,7 +208,7 @@ async def dashboard_links(request):
 async def debug_info(request):
     """Deployment self-description (reference server.ts /debug): who the
     request resolved to and which env contract is active."""
-    from kubeflow_tpu.cmd.envconfig import controller_namespace
+    from kubeflow_tpu.runtime.deployment import controller_namespace
 
     return json_success({
         "user": request.get("user", ""),
@@ -218,7 +218,7 @@ async def debug_info(request):
         "controllerNamespace": controller_namespace(),
         "headersForIdentity": {
             "USERID_HEADER": request.app["userid_header"],
-            "USERID_PREFIX": request.app.get("userid_prefix", ""),
+            "USERID_PREFIX": request.app["userid_prefix"],
         },
     })
 

@@ -74,7 +74,7 @@ IMAGE_CATALOG_KEY = "images.yaml"
 
 
 def _controller_namespace() -> str:
-    from kubeflow_tpu.cmd.envconfig import controller_namespace
+    from kubeflow_tpu.runtime.deployment import controller_namespace
 
     return controller_namespace()
 
