@@ -1,0 +1,29 @@
+# Developer entry points (hermetic unless noted; see docs/).
+
+.PHONY: test conformance bench dryrun native workflows devserver images
+
+test:
+	python -m pytest tests/ -q
+
+conformance:
+	python -m conformance.run
+
+bench:                     # runs on the attached TPU chip
+	python bench.py
+
+dryrun:                    # the driver's multi-chip gate, locally
+	python -c "import os; os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8'; \
+	import jax; jax.config.update('jax_platforms','cpu'); \
+	from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun ok')"
+
+native:
+	$(MAKE) -C native
+
+workflows:                 # regenerate .github/workflows from ci/pipelines.py
+	python ci/pipelines.py
+
+devserver:
+	python -m kubeflow_tpu.cmd.devserver
+
+images:                    # build the full notebook-image DAG (docker)
+	$(MAKE) -C images
