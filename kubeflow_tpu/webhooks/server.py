@@ -24,6 +24,7 @@ from kubeflow_tpu.api import profile as profileapi
 from kubeflow_tpu.api import pvcviewer as pvcapi
 from kubeflow_tpu.api import tensorboard as tbapi
 from kubeflow_tpu.runtime.errors import ApiError
+from kubeflow_tpu.runtime.metrics import global_registry
 from kubeflow_tpu.runtime.objects import deepcopy
 from kubeflow_tpu.webhooks import jsonpatch
 from kubeflow_tpu.webhooks import notebook as nb_webhook
@@ -59,14 +60,23 @@ def _deny(uid: str, message: str, code: int = 400) -> dict:
     }
 
 
-def create_webhook_app(kube) -> web.Application:
+def create_webhook_app(kube, *, registry=None) -> web.Application:
+    registry = registry or global_registry
     app = web.Application()
     app["kube"] = kube
+    # Admission observability (controller-runtime webhooks expose the same
+    # shape; the reference's PodDefault server only klogs).
+    m_admissions = registry.counter(
+        "webhook_admission_total",
+        "AdmissionReview requests by endpoint and outcome",
+        ["path", "allowed"],
+    )
 
     async def handle(request: web.Request, mutator) -> web.Response:
         try:
             review = await request.json()
         except ValueError:
+            m_admissions.labels(path=request.path, allowed="false").inc()
             return web.json_response(
                 _deny("", "could not decode AdmissionReview"), status=400
             )
@@ -82,10 +92,13 @@ def create_webhook_app(kube) -> web.Application:
         try:
             await mutator(request.app["kube"], obj, operation, old)
         except ApiError as e:
+            m_admissions.labels(path=request.path, allowed="false").inc()
             return web.json_response(_deny(uid, e.message, e.code))
         except Exception:
             log.exception("webhook mutator failed")
+            m_admissions.labels(path=request.path, allowed="false").inc()
             return web.json_response(_deny(uid, "internal webhook error", 500))
+        m_admissions.labels(path=request.path, allowed="true").inc()
         return web.json_response(_allow(uid, jsonpatch.diff(original, obj)))
 
     # -- Pod mutation: PodDefault injection + per-worker TPU env ------------
@@ -193,6 +206,10 @@ def create_webhook_app(kube) -> web.Application:
     async def healthz(_request):
         return web.json_response({"status": "ok"})
 
+    async def metrics(_request):
+        return web.Response(text=registry.expose(), content_type="text/plain")
+
+    app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
     return app
 

@@ -173,3 +173,32 @@ async def test_admission_server_resolves_image_catalog():
         assert image == "reg.io/jax@sha256:aa"
     finally:
         await client.close()
+
+
+async def test_admission_server_metrics():
+    """The wire server counts admissions by endpoint/outcome and exposes
+    /metrics (controller-runtime webhook observability parity)."""
+    from kubeflow_tpu.api import notebook as nbapi
+    from kubeflow_tpu.runtime.metrics import Registry
+
+    registry = Registry()
+    client = TestClient(TestServer(create_webhook_app(FakeKube(),
+                                                      registry=registry)))
+    await client.start_server()
+    try:
+        nb = nbapi.new("m", "ns")
+        resp = await client.post("/mutate-notebooks", json=admission_review(nb))
+        assert (await resp.json())["response"]["allowed"]
+        resp = await client.post("/mutate-notebooks", json=admission_review(
+            {"apiVersion": nbapi.API_VERSION, "kind": "Notebook",
+             "metadata": {"name": "Bad_Name!", "namespace": "ns"},
+             "spec": {"template": {"spec": {"containers": []}}}}))
+        assert not (await resp.json())["response"]["allowed"]
+
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        assert ('webhook_admission_total'
+                '{allowed="true",path="/mutate-notebooks"} 1.0') in text
+        assert 'allowed="false",path="/mutate-notebooks"} 1.0' in text
+    finally:
+        await client.close()
