@@ -76,6 +76,10 @@ def create_webhook_app(kube, *, registry=None) -> web.Application:
         try:
             review = await request.json()
         except ValueError:
+            review = None
+        if not isinstance(review, dict):
+            # Counts valid-JSON-but-not-an-object bodies too — the failure
+            # class this metric exists to surface.
             m_admissions.labels(path=request.path, allowed="false").inc()
             return web.json_response(
                 _deny("", "could not decode AdmissionReview"), status=400

@@ -1,0 +1,55 @@
+"""Smoke tests for the cmd wiring layer — the one place nothing else
+exercises, where an env-var/options mismatch only surfaces at deploy time.
+"""
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.cmd import envconfig
+from kubeflow_tpu.cmd.webapp import build_app
+from kubeflow_tpu.testing.fakekube import FakeKube
+
+
+def test_envconfig_builds_every_options_block(monkeypatch):
+    monkeypatch.setenv("USE_ISTIO", "true")
+    monkeypatch.setenv("POD_NAMESPACE", "custom-ns")
+    monkeypatch.setenv("TRUSTED_CA_BUNDLE_CONFIGMAP", "corp-ca")
+    monkeypatch.setenv("PIPELINE_ACCESS_ROLE", "")
+    monkeypatch.setenv("CULL_IDLE_TIME", "60")
+
+    nb = envconfig.notebook_options()
+    assert nb.use_istio is True
+    assert nb.controller_namespace == "custom-ns"
+    assert nb.trusted_ca_configmap == "corp-ca"
+    assert nb.pipeline_access_role is None  # empty string disables
+
+    cull = envconfig.culling_options()
+    assert cull.cull_idle_seconds == 3600.0
+
+    prof = envconfig.profile_options()
+    assert prof.use_istio is True
+
+
+@pytest.mark.parametrize("which", ["jupyter", "volumes", "tensorboards",
+                                   "kfam", "dashboard", "all"])
+async def test_webapp_builds_and_serves(which, monkeypatch):
+    """Every deployable webapp flavor wires up and answers its probe."""
+    monkeypatch.setenv("DEV_DEFAULT_USER", "smoke@example.com")
+    app = build_app(FakeKube(), which)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        probe = "/healthz" if which != "all" else "/jupyter/healthz"
+        resp = await client.get(probe)
+        assert resp.status == 200, f"{which}: {probe} -> {resp.status}"
+        if which == "all":
+            for prefix in ("jupyter", "volumes", "tensorboards", "dashboard"):
+                resp = await client.get(f"/{prefix}/healthz")
+                assert resp.status == 200, prefix
+    finally:
+        await client.close()
+
+
+def test_build_app_rejects_unknown_flavor():
+    with pytest.raises(SystemExit, match="unknown WEBAPP"):
+        build_app(FakeKube(), "nope")

@@ -195,10 +195,15 @@ async def test_admission_server_metrics():
              "spec": {"template": {"spec": {"containers": []}}}}))
         assert not (await resp.json())["response"]["allowed"]
 
+        # Valid JSON that is not an object must deny AND count.
+        resp = await client.post("/mutate-notebooks", data="[1]",
+                                 headers={"Content-Type": "application/json"})
+        assert resp.status == 400
+
         resp = await client.get("/metrics")
         text = await resp.text()
         assert ('webhook_admission_total'
                 '{allowed="true",path="/mutate-notebooks"} 1.0') in text
-        assert 'allowed="false",path="/mutate-notebooks"} 1.0' in text
+        assert 'allowed="false",path="/mutate-notebooks"} 2.0' in text
     finally:
         await client.close()
