@@ -305,30 +305,50 @@ class Conformance:
                 await self.kube.delete("Role", "pipeline-user-access", NS)
 
     async def check_pipeline_parallel_step(self):
-        """The dp×pp(×tp) train step compiles and runs on this host's
-        devices (needs ≥2; CI provides the virtual 8-device CPU mesh)."""
-        import jax
+        """The dp×pp(×tp) train step compiles and runs on ≥2 devices.
 
-        if len(jax.devices()) < 2:
-            raise Skip("needs >=2 jax devices (CI forces an 8-device CPU mesh)")
-        import jax.numpy as jnp
+        Self-provisioning (same trick as ``__graft_entry__.dryrun_multichip``):
+        if this process can't produce ≥2 usable JAX devices — single real
+        chip, or a backend that refuses to initialize at all — re-exec the
+        check body in a subprocess with a forced 8-device CPU host platform,
+        so the gate never fails on environment plumbing.
+        """
+        try:
+            import jax
 
-        from kubeflow_tpu.models import pipelined
+            usable = len(jax.devices())
+        except Exception:  # backend init failure (e.g. tunneled TPU plugin)
+            usable = 0
+        if usable >= 2:
+            _pipeline_parallel_step_body()
+            return
 
-        n = min(len(jax.devices()), 8)
-        n_model = 2 if n >= 8 else 1
-        if n % (2 * n_model):
-            n = n - (n % (2 * n_model))  # largest usable subset (odd counts)
-        mesh = pipelined.make_pp_mesh(jax.devices()[:n], n_stages=2,
-                                      n_model=n_model)
-        cfg = pipelined.PipelinedConfig(
-            vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
-            seq_len=12, n_micro=2)
-        params = pipelined.shard_params(
-            pipelined.init_params(jax.random.key(0), cfg), mesh, cfg)
-        tokens = jnp.zeros((2 * mesh.shape["data"], cfg.seq_len), jnp.int32)
-        _, loss = jax.jit(pipelined.make_train_step(cfg, mesh))(params, tokens)
-        assert jnp.isfinite(loss), f"non-finite pipelined loss {loss}"
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        extra = "--xla_force_host_platform_device_count=8"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + extra).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo, env.get("PYTHONPATH")) if p)
+        # to_thread: the child compiles for tens of seconds — must not
+        # block this event loop (--live mode shares it with HTTP watches).
+        proc = await asyncio.to_thread(
+            subprocess.run,
+            [sys.executable, os.path.abspath(__file__), "--pp-step-child"],
+            env=env,
+            cwd=repo,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pp-step subprocess failed (rc={proc.returncode})\n"
+                f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}"
+            )
 
     async def check_sidecar_isolation(self):
         """A sidecar crash must NOT trigger the slice-atomic restart."""
@@ -356,6 +376,29 @@ class Conformance:
         ]
         assert not slice_restarts, "sidecar crash restarted the slice"
         self.sim.failure_injector = None
+
+
+def _pipeline_parallel_step_body() -> None:
+    """In-process body of the pipeline-parallel check (needs ≥2 devices)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import pipelined
+
+    n = min(len(jax.devices()), 8)
+    n_model = 2 if n >= 8 else 1
+    if n % (2 * n_model):
+        n = n - (n % (2 * n_model))  # largest usable subset (odd counts)
+    mesh = pipelined.make_pp_mesh(jax.devices()[:n], n_stages=2,
+                                  n_model=n_model)
+    cfg = pipelined.PipelinedConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        seq_len=12, n_micro=2)
+    params = pipelined.shard_params(
+        pipelined.init_params(jax.random.key(0), cfg), mesh, cfg)
+    tokens = jnp.zeros((2 * mesh.shape["data"], cfg.seq_len), jnp.int32)
+    _, loss = jax.jit(pipelined.make_train_step(cfg, mesh))(params, tokens)
+    assert jnp.isfinite(loss), f"non-finite pipelined loss {loss}"
 
 
 async def run(live: bool) -> int:
@@ -436,7 +479,16 @@ async def run(live: bool) -> int:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--live", action="store_true")
+    parser.add_argument("--pp-step-child", action="store_true",
+                        help=argparse.SUPPRESS)  # internal re-exec target
     args = parser.parse_args()
+    if args.pp_step_child:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _pipeline_parallel_step_body()
+        print("pp-step subprocess ok")
+        return
     sys.exit(asyncio.run(run(args.live)))
 
 

@@ -2,7 +2,24 @@
 
 from __future__ import annotations
 
+import hashlib
+
 from kubeflow_tpu.runtime.objects import deep_get
+
+
+def bounded_name(name: str, limit: int = 253) -> str:
+    """Clamp a generated child-object name to the apiserver's limit.
+
+    Kubernetes object names are DNS subdomains (≤253 chars); generated
+    names composed from user-controlled parts (role + notebook names) can
+    exceed that and fail the create. Over-long names are truncated and
+    suffixed with a short content hash so distinct inputs stay distinct
+    and the result is stable across reconciles.
+    """
+    if len(name) <= limit:
+        return name
+    digest = hashlib.sha256(name.encode()).hexdigest()[:10]
+    return f"{name[: limit - 11].rstrip('-.')}-{digest}"
 
 
 async def rwo_affinity(kube, ns: str, claim: str) -> dict | None:

@@ -400,7 +400,7 @@ def flash_attention_partial(q, k, v, q_offset, k_offset, *,
                             block_k: int = DEFAULT_BLOCK_K,
                             vma=None,
                             interpret: bool | None = None):
-    """One ring hop's attention block, flash-style (forward only).
+    """One ring hop's attention block, flash-style (the forward half).
 
     q/k/v ``[batch, s_block, heads, head_dim]``; ``q_offset``/``k_offset``
     are the blocks' global sequence starts (traced scalars are fine).

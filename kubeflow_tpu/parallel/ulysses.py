@@ -29,6 +29,27 @@ import jax
 import jax.numpy as jnp
 
 
+def _largest_divisor_block(s: int, cap: int = 1024) -> int:
+    """Largest tileable block ≤ ``cap`` that divides ``s`` — the flash
+    kernel tiles the sequence and requires s % block == 0, but ulysses
+    callers pick S freely (e.g. S=1536 → block 768).
+
+    ``s ≤ cap`` is always fine (one block). Beyond that, blocks must stay
+    lane-friendly (multiples of 128 — Mosaic's sublane tiling, and a floor
+    against degenerate tiny-block grids), so an awkward S (no 128-multiple
+    divisor, e.g. 2×prime) raises the same clear error the kernel used to,
+    here at the call site where the config that chose S is visible."""
+    if s <= cap:
+        return s
+    for block in range(cap, 127, -1):
+        if s % block == 0 and block % 128 == 0:
+            return block
+    raise ValueError(
+        f"gathered sequence {s} has no block-sized divisor ≤ {cap} "
+        f"(multiple of 128); choose a sequence length divisible by 128"
+    )
+
+
 def _a2a(x, axis_name: str, scatter_dim: int, gather_dim: int):
     """all_to_all with the manual-mode convention used inside shard_map:
     scatter ``scatter_dim`` across the axis, concatenate ``gather_dim``."""
@@ -46,10 +67,13 @@ def ulysses_attention_local(q, k, v, axis_name: str, block_impl: str = "xla"):
 
     ``block_impl="flash"`` runs the gathered-sequence attention through the
     pallas flash kernel (ops/flash_attention.py) instead of materializing
-    the [S, S] logits — and since that kernel carries a full custom VJP,
-    this makes ulysses the memory-efficient *training* path for long
-    context (ring's flash hops are forward-only). The post-a2a layout
-    [b, S, H/P, d] is exactly the kernel's bshd contract.
+    the [S, S] logits. Both long-context strategies are trainable end to
+    end — ring via the per-hop custom VJP in parallel/ring.py, ulysses via
+    this kernel's fused VJP — so the choice is the memory/collective
+    trade-off: ring keeps O((S/P)²) activation per device at the cost of P
+    neighbor hops; ulysses gathers the full sequence for H/P local heads
+    in two all-to-alls. The post-a2a layout [b, S, H/P, d] is exactly the
+    kernel's bshd contract.
     """
     p = jax.lax.psum(1, axis_name)
     b, s_local, h, d = q.shape
@@ -66,9 +90,13 @@ def ulysses_attention_local(q, k, v, axis_name: str, block_impl: str = "xla"):
     if block_impl == "flash":
         from kubeflow_tpu.ops import flash_attention
 
+        # Pick blocks from the gathered sequence's divisors so any S works
+        # (e.g. S=1536 → 768) instead of surfacing the kernel's ValueError
+        # at this distance from the config that chose S.
+        block = _largest_divisor_block(s_local * p)
         # The kernel derives its outputs' varying-axes metadata from the
         # inputs (always correct, whatever mesh the caller shard_maps on).
-        out = flash_attention(q, k, v)
+        out = flash_attention(q, k, v, block_q=block, block_k=block)
     elif block_impl == "xla":
         s_full = s_local * p
         scale = 1.0 / (d ** 0.5)
@@ -93,7 +121,8 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "seq",
     """GSPMD entrypoint mirroring ``ring_attention``'s signature: q/k/v
     ``[batch, seq, heads, head_dim]`` sequence-sharded over ``axis_name``;
     other mesh axes shard batch. ``block_impl="flash"`` swaps the exact
-    softmax for the pallas flash kernel (fwd+bwd — trainable)."""
+    softmax for the pallas flash kernel (fwd+bwd — trainable); block sizes
+    are chosen from the gathered sequence's divisors, so any S works."""
     from jax.sharding import PartitionSpec as P
 
     try:

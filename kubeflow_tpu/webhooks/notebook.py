@@ -15,6 +15,9 @@ re-queues the whole slice through the scheduler.
 
 from __future__ import annotations
 
+import time
+import weakref
+
 from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.runtime.objects import annotations_of, deep_get, deepcopy
 
@@ -88,6 +91,42 @@ def _catalog_lookup(catalog: dict, stream: str, tag: str) -> str | None:
     return None
 
 
+# Short TTL cache for the parsed catalog, per client object (weak keys so a
+# test's FakeKube doesn't pin stale entries for the next test). Admission
+# bursts — the 200-notebook load test — would otherwise GET the ConfigMap
+# once per Notebook CREATE/UPDATE; this mirrors the controller's TTL-cached
+# Role probe (controllers/notebook.py _namespace_has_role).
+CATALOG_CACHE_TTL = 10.0
+_catalog_cache: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
+
+
+async def _load_catalog(kube, ns: str, configmap: str) -> dict:
+    now = time.monotonic()
+    per_kube = None
+    try:
+        per_kube = _catalog_cache.setdefault(kube, {})
+        hit = per_kube.get((ns, configmap))
+        if hit and now - hit[0] < CATALOG_CACHE_TTL:
+            return hit[1]
+    except TypeError:  # non-weakrefable client: just skip caching
+        per_kube = None
+    cm = await kube.get_or_none("ConfigMap", configmap, ns)
+    catalog: dict = {}
+    if cm is not None:
+        try:
+            import yaml
+
+            parsed = yaml.safe_load(
+                (cm.get("data") or {}).get(IMAGE_CATALOG_KEY) or "")
+            if isinstance(parsed, dict):
+                catalog = parsed
+        except Exception:
+            catalog = {}
+    if per_kube is not None:
+        per_kube[(ns, configmap)] = (now, catalog)
+    return catalog
+
+
 async def resolve_image_from_catalog(
     kube,
     nb: dict,
@@ -112,17 +151,8 @@ async def resolve_image_from_catalog(
         return False
     if "@sha256:" in (container.get("image") or ""):
         return False  # already pinned; nothing to resolve
-    cm = await kube.get_or_none(
-        "ConfigMap", configmap, namespace or _controller_namespace()
-    )
-    if cm is None:
-        return False
-    try:
-        import yaml
-
-        catalog = yaml.safe_load((cm.get("data") or {}).get(IMAGE_CATALOG_KEY) or "") or {}
-    except Exception:
-        return False
+    catalog = await _load_catalog(
+        kube, namespace or _controller_namespace(), configmap)
     ref = _catalog_lookup(catalog, stream, tag)
     if ref is None or ref == container.get("image"):
         return False

@@ -293,3 +293,54 @@ async def test_pipeline_role_created_after_notebook_triggers_binding():
         assert rb["roleRef"]["name"] == "pipeline-user-access"
     finally:
         await stop(kube, mgr, sim)
+
+
+def test_bounded_name_clamps_and_stays_distinct():
+    """Generated child names (RoleBinding = pipelines-<role>-<nb>) must fit
+    the apiserver's 253-char DNS-subdomain limit whatever the inputs."""
+    from kubeflow_tpu.controllers.common import bounded_name
+
+    assert bounded_name("short") == "short"
+    long_a = "pipelines-" + "a" * 260 + "-nb1"
+    long_b = "pipelines-" + "a" * 260 + "-nb2"
+    out_a, out_b = bounded_name(long_a), bounded_name(long_b)
+    assert len(out_a) <= 253 and len(out_b) <= 253
+    assert out_a != out_b                      # distinct inputs stay distinct
+    assert out_a == bounded_name(long_a)       # stable across reconciles
+    assert not out_a.endswith(("-", "."))
+
+
+async def test_catalog_configmap_get_is_ttl_cached():
+    """Admission bursts must not GET the notebook-images ConfigMap per
+    Notebook (ADVICE r2): the parsed catalog is TTL-cached per client."""
+    kube, mgr, sim = await make_harness()
+    try:
+        await kube.create("ConfigMap", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "notebook-images", "namespace": "kubeflow-tpu"},
+            "data": {"images.yaml":
+                     "jupyter-jax:\n  latest: reg.example/jax@sha256:aaa\n"},
+        })
+        gets = {"n": 0}
+        orig = kube.get_or_none
+
+        async def counting(kind, name, ns=None):
+            if kind == "ConfigMap" and name == "notebook-images":
+                gets["n"] += 1
+            return await orig(kind, name, ns)
+
+        kube.get_or_none = counting
+        try:
+            for i in range(5):
+                nb = nbapi.new(f"burst-{i}", "ns", image="jupyter-jax:latest")
+                get_meta(nb).setdefault("annotations", {})[
+                    nbapi.IMAGE_SELECTION_ANNOTATION] = "jupyter-jax:latest"
+                await kube.create("Notebook", nb)
+        finally:
+            kube.get_or_none = orig
+        assert gets["n"] == 1, f"{gets['n']} catalog GETs for 5 admissions"
+        stored = await kube.get("Notebook", "burst-4", "ns")
+        assert deep_get(stored, "spec", "template", "spec",
+                        "containers")[0]["image"] == "reg.example/jax@sha256:aaa"
+    finally:
+        await stop(kube, mgr, sim)

@@ -48,7 +48,8 @@ def test_ring_with_data_and_seq_axes():
 
 
 def test_ring_flash_blocks_match_reference():
-    """Ring with the pallas partial-attention hop (forward-only path)."""
+    """Ring with the pallas partial-attention hop (forward values; the
+    matching backward is covered by the training tests below)."""
     mesh = seq_mesh(4)
     q, k, v = rand_qkv(jax.random.key(7), 2, 512, 2, 128)
     spec = NamedSharding(mesh, P(None, "seq", None, None))

@@ -115,8 +115,9 @@ def test_ulysses_flash_matches_reference():
 
 def test_ulysses_flash_trains_long_context():
     """The load-bearing property: the flash path has a working backward
-    (ring's flash hops are fwd-only), so the longctx model trains with it
-    and the first step matches the xla-attention path's gradients."""
+    (ring_flash trains too, via ring.py's per-hop VJP — see
+    test_ring_attention), so the longctx model trains with it and the
+    first step matches the xla-attention path's gradients."""
     from kubeflow_tpu.models import longctx
 
     devs = jax.devices()[:4]
@@ -143,3 +144,35 @@ def test_ulysses_flash_trains_long_context():
     for a, b in zip(jax.tree.leaves(p_xla), jax.tree.leaves(p_flash)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_flash_block_picked_from_sequence_divisors():
+    """Any gathered sequence length works with block_impl='flash': blocks
+    come from S's divisors instead of a fixed 1024 (ADVICE r2)."""
+    from kubeflow_tpu.parallel.ulysses import _largest_divisor_block
+
+    assert _largest_divisor_block(1536) == 768
+    assert _largest_divisor_block(1024) == 1024
+    assert _largest_divisor_block(192) == 192     # ≤ cap: single block
+    assert _largest_divisor_block(4096) == 1024
+    assert _largest_divisor_block(2560) == 640
+    for s in (1536, 4096, 2560):
+        assert s % _largest_divisor_block(s) == 0
+    # No lane-friendly divisor (2×5×103): a clear error at the call site,
+    # not a degenerate block-2 kernel launch.
+    with pytest.raises(ValueError, match="divisible by 128"):
+        _largest_divisor_block(1030)
+
+
+def test_ulysses_flash_nondivisible_sequence():
+    """S=1536 (> the 1024 default block, not a multiple of it — the exact
+    shape ADVICE r2 flagged as raising) runs through the flash path end to
+    end and matches the reference."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    q, k, v = rand_qkv(jax.random.key(11), 1, 1536, 4, 16)
+    spec = NamedSharding(mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = ulysses_attention(qs, ks, vs, mesh, block_impl="flash")
+    ref = reference_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
