@@ -41,12 +41,14 @@ import time
 from dataclasses import dataclass
 
 from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.common import bounded_name
 from kubeflow_tpu.runtime.apply import reconcile_child
 from kubeflow_tpu.runtime.errors import ApiError, Invalid, NotFound
 from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result, Watch
 from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.runtime.objects import (
+    annotations_of,
     deep_get,
     get_meta,
     name_of,
@@ -107,6 +109,13 @@ AUTH_PROXY_ANNOTATION = "notebooks.kubeflow.org/inject-auth-proxy"
 CA_BUNDLE_CONFIGMAP = "kubeflow-tpu-ca-bundle"
 CA_BUNDLE_KEY = "ca-bundle.crt"
 
+# Slice-restart backoff state (annotations so damping survives controller
+# restarts) + schedule: attempt N waits base·2^(N-1) seconds, capped.
+SLICE_RESTART_ATTEMPTS_ANNOTATION = "notebooks.kubeflow.org/slice-restart-attempts"
+SLICE_RESTART_AT_ANNOTATION = "notebooks.kubeflow.org/slice-restart-at"
+SLICE_RESTART_BASE_SECONDS = 10.0
+SLICE_RESTART_MAX_SECONDS = 300.0
+
 
 class NotebookReconciler:
     def __init__(
@@ -131,6 +140,14 @@ class NotebookReconciler:
         self._role_probe_cache: dict[str, tuple[bool, float]] = {}
         self._role_probe_gen: dict[str, int] = {}
         self._role_probe_ttl = 60.0
+        # Wall clock for the slice-restart backoff; tests inject a fake.
+        self._now = time.time
+        # Informer handles (set by setup_notebook_controller): mirror and
+        # status reads come from the watch-driven caches, not LISTs/GETs
+        # per reconcile. None (bare-reconciler unit tests) falls back to
+        # direct apiserver reads.
+        self._event_informer = None
+        self._sts_informer = None
         registry = registry or global_registry
         # Metric names match the reference (pkg/metrics/metrics.go:14-62) so
         # dashboards/alerts carry over.
@@ -151,35 +168,47 @@ class NotebookReconciler:
             return None  # children die by ownerReference cascade
 
         try:
-            tpu = nbapi.tpu_slice_of(nb)
+            ms = nbapi.multi_slice_of(nb)
         except Invalid as e:
             await self.recorder.event(nb, "Warning", "InvalidSpec", str(e))
             return None
+        tpu = ms.slice if ms else None
 
         if self.opts.trusted_ca_configmap:
             await self._mirror_ca_bundle(nb)
 
-        sts = self.generate_statefulset(nb, tpu)
-        created = await self._ensure(nb, sts)
-        if created:
-            self.m_create.inc()
-            await self.recorder.event(
-                nb, "Normal", "CreatedStatefulSet", f"Created StatefulSet {name}"
-            )
+        # One StatefulSet per slice (ICI placement is per-slice; DCN joins
+        # them — tpu/topology.py MultiSlice). Single-slice keeps the bare
+        # name, zero churn for the common case.
+        for slice_id in range(ms.num_slices if ms else 1):
+            sts = self.generate_statefulset(nb, tpu, multi=ms,
+                                            slice_id=slice_id)
+            created = await self._ensure(nb, sts)
+            if created:
+                self.m_create.inc()
+                await self.recorder.event(
+                    nb, "Normal", "CreatedStatefulSet",
+                    f"Created StatefulSet {name_of(sts)}"
+                )
+        if ms:
+            # Covers scale-in (numSlices 4→2) AND the multi→single
+            # transition (numSlices 2→1 renames the STS to the bare name;
+            # the stale -s* StatefulSets must not keep burning chips).
+            await self._gc_extra_slices(nb, ms)
 
-        await self._ensure(nb, self.generate_service(nb))
-        if tpu and tpu.multi_host:
-            await self._ensure(nb, self.generate_headless_service(nb))
+        await self._ensure(nb, self.generate_service(nb, multi=ms))
+        if (tpu and tpu.multi_host) or (ms and ms.multi):
+            await self._ensure(nb, self.generate_headless_service(nb, multi=ms))
         if self.opts.use_istio:
             await self._ensure(nb, self.generate_virtual_service(nb))
         if self.opts.create_network_policies:
             await self._ensure(nb, self.generate_network_policy(nb, tpu))
 
         await self._ensure_pipeline_rbac(nb)
-        await self._restart_broken_slice(nb, tpu)
+        requeue = await self._restart_broken_slice(nb, ms)
         await self._mirror_events(nb)
-        await self._update_status(nb, tpu)
-        return None
+        await self._update_status(nb, ms)
+        return requeue
 
     async def _ensure_pipeline_rbac(self, nb: dict) -> None:
         """odh notebook_rbac.go:36-154 analogue: if the pipelines Role
@@ -204,7 +233,7 @@ class NotebookReconciler:
                 # copy_rolebinding_fields invariant): a role-name config
                 # change creates a fresh binding; the stale one is
                 # garbage-collected with the notebook.
-                "name": f"pipelines-{role_name}-{name}",
+                "name": bounded_name(f"pipelines-{role_name}-{name}"),
                 "namespace": ns,
                 "labels": {nbapi.NOTEBOOK_NAME_LABEL: name},
             },
@@ -241,9 +270,16 @@ class NotebookReconciler:
 
     # ---- object generation ------------------------------------------------------
 
-    def generate_statefulset(self, nb: dict, tpu: TpuSlice | None) -> dict:
-        """Reference: generateStatefulSet (notebook_controller.go:408-484)."""
+    def generate_statefulset(
+        self, nb: dict, tpu: TpuSlice | None, *, multi=None, slice_id: int = 0
+    ) -> dict:
+        """Reference: generateStatefulSet (notebook_controller.go:408-484).
+
+        ``multi``/``slice_id``: in multislice mode each slice gets its own
+        StatefulSet (``<name>-s<j>``) with slice-static MEGASCALE_* env;
+        they all share the notebook's headless Service for DNS."""
         name, ns = name_of(nb), namespace_of(nb)
+        sts_name = multi.slice_sts_name(name, slice_id) if multi else name
         replicas = 0 if nbapi.is_stopped(nb) else (tpu.num_hosts if tpu else 1)
 
         pod_spec = deep_get(nb, "spec", "template", "spec", default={})
@@ -262,13 +298,14 @@ class NotebookReconciler:
 
         template_annotations: dict[str, str] = {}
         template_labels: dict[str, str] = {
-            STS_LABEL: name,
+            STS_LABEL: sts_name,
             nbapi.NOTEBOOK_NAME_LABEL: name,
             "app": name,
         }
         if tpu:
             self._apply_tpu(
-                main, pod_spec, template_annotations, template_labels, nb, tpu
+                main, pod_spec, template_annotations, template_labels, nb, tpu,
+                multi=multi, slice_id=slice_id,
             )
         containers[0] = main
         pod_spec["containers"] = containers
@@ -291,11 +328,14 @@ class NotebookReconciler:
         sts = {
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
-            "metadata": {"name": name, "namespace": ns},
+            "metadata": {"name": sts_name, "namespace": ns,
+                         "labels": {nbapi.NOTEBOOK_NAME_LABEL: name}},
             "spec": {
                 "replicas": replicas,
+                # All slices share the notebook's headless Service: every
+                # worker of every slice resolves under one DNS zone.
                 "serviceName": name + self.opts.workers_service_suffix,
-                "selector": {"matchLabels": {STS_LABEL: name}},
+                "selector": {"matchLabels": {STS_LABEL: sts_name}},
                 # Slice workers must come up together: sequential (OrderedReady)
                 # start would serialise libtpu mesh bootstrap across hosts.
                 "podManagementPolicy": "Parallel",
@@ -330,9 +370,14 @@ class NotebookReconciler:
         template_labels: dict,
         nb: dict,
         tpu: TpuSlice,
+        *,
+        multi=None,
+        slice_id: int = 0,
     ) -> None:
         """Wire the slice: selectors, chip requests, slice-static env, webhook
-        annotations. Per-worker env (TPU_WORKER_ID) is the pod webhook's job."""
+        annotations. Per-worker env (TPU_WORKER_ID) is the pod webhook's job.
+        In multislice mode the MEGASCALE_* env and global process space are
+        slice-static, so they bake into this slice's template here."""
         name, ns = name_of(nb), namespace_of(nb)
         selectors = dict(pod_spec.get("nodeSelector") or {})
         selectors.update(tpu.node_selectors())
@@ -346,10 +391,19 @@ class NotebookReconciler:
         main["resources"] = resources
 
         headless = name + self.opts.workers_service_suffix
-        hostnames = tpu.worker_hostnames(
-            name, headless, ns, self.opts.cluster_domain
-        )
-        static_env = tpu.worker_env(0, hostnames)
+        if multi and multi.multi:
+            all_hostnames = multi.worker_hostnames(
+                name, headless, ns, self.opts.cluster_domain
+            )
+            static_env = multi.worker_env(slice_id, 0, all_hostnames)
+            template_annotations[nbapi.TPU_SLICE_ID_ANNOTATION] = str(slice_id)
+            template_annotations[nbapi.TPU_NUM_SLICES_ANNOTATION] = str(
+                multi.num_slices)
+        else:
+            hostnames = tpu.worker_hostnames(
+                name, headless, ns, self.opts.cluster_domain
+            )
+            static_env = tpu.worker_env(0, hostnames)
         # Per-worker keys are the webhook's job; don't bake worker 0's values
         # into every pod of a multi-host slice.
         for per_worker in ("TPU_WORKER_ID", "JAX_PROCESS_ID"):
@@ -363,8 +417,15 @@ class NotebookReconciler:
         # (≥1.28) stamps the ordinal on the pod-index label, so even if the
         # admission webhook is unavailable the workers still get correct
         # ids and the slice can bootstrap its mesh (the webhook, when up,
-        # overrides these with plain values).
-        for per_worker in ("TPU_WORKER_ID", "JAX_PROCESS_ID"):
+        # overrides these with plain values). In multislice mode the global
+        # JAX_PROCESS_ID = sliceId·hosts + ordinal can NOT come from the
+        # pod index — only the webhook computes it; a wrong id would
+        # silently collide process ranks, so none is better than wrong.
+        fallback_keys = (
+            ("TPU_WORKER_ID",) if multi and multi.multi
+            else ("TPU_WORKER_ID", "JAX_PROCESS_ID")
+        )
+        for per_worker in fallback_keys:
             if per_worker not in have:
                 env.append({
                     "name": per_worker,
@@ -523,19 +584,21 @@ class NotebookReconciler:
             return self.opts.auth_proxy_port
         return self.opts.default_serving_port
 
-    def generate_service(self, nb: dict) -> dict:
+    def generate_service(self, nb: dict, multi=None) -> dict:
         """HTTP entrypoint. Reference: generateService (:486-513) — port 80 →
         named port ``http-<name>``. Multi-host twist: route to worker 0 only
         (the Jupyter server runs on worker 0; other workers are compute
-        peers), via the stable STS pod-name label."""
+        peers), via the stable STS pod-name label. In multislice mode the
+        server pod is slice 0's worker 0 (``<name>-s0-0``)."""
         name, ns = name_of(nb), namespace_of(nb)
+        sts0 = multi.slice_sts_name(name, 0) if multi else name
         return {
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {"name": name, "namespace": ns},
             "spec": {
                 "type": "ClusterIP",
-                "selector": {STS_LABEL: name, POD_NAME_LABEL: f"{name}-0"},
+                "selector": {STS_LABEL: sts0, POD_NAME_LABEL: f"{sts0}-0"},
                 "ports": [
                     {
                         "name": f"http-{name}"[:63],
@@ -547,10 +610,16 @@ class NotebookReconciler:
             },
         }
 
-    def generate_headless_service(self, nb: dict) -> dict:
+    def generate_headless_service(self, nb: dict, multi=None) -> dict:
         """Worker discovery for multi-host slices — the DNS backing
-        ``TPU_WORKER_HOSTNAMES`` (SURVEY.md §2.4 row 2)."""
+        ``TPU_WORKER_HOSTNAMES`` (SURVEY.md §2.4 row 2). In multislice mode
+        one headless Service spans every slice's pods (selected by the
+        notebook-name label), so cross-slice DCN peers resolve too."""
         name, ns = name_of(nb), namespace_of(nb)
+        selector = (
+            {nbapi.NOTEBOOK_NAME_LABEL: name} if multi and multi.multi
+            else {STS_LABEL: name}
+        )
         return {
             "apiVersion": "v1",
             "kind": "Service",
@@ -559,7 +628,7 @@ class NotebookReconciler:
             "spec": {
                 "clusterIP": "None",
                 "publishNotReadyAddresses": True,
-                "selector": {STS_LABEL: name},
+                "selector": selector,
                 "ports": [
                     {"name": "jax-coord", "port": JAX_COORDINATOR_PORT,
                      "protocol": "TCP"}
@@ -609,6 +678,25 @@ class NotebookReconciler:
             },
         }
 
+    async def _gc_extra_slices(self, nb: dict, ms) -> None:
+        """Delete slice StatefulSets beyond the current numSlices (scale-in:
+        numSlices 4 → 2 must not leave s2/s3 running and burning chips)."""
+        name, ns = name_of(nb), namespace_of(nb)
+        expected = {ms.slice_sts_name(name, j) for j in range(ms.num_slices)}
+        try:
+            owned = await self.kube.list(
+                "StatefulSet", ns,
+                label_selector={"matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name}},
+            )
+        except ApiError:
+            return
+        for sts in owned:
+            if name_of(sts) not in expected:
+                try:
+                    await self.kube.delete("StatefulSet", name_of(sts), ns)
+                except NotFound:
+                    pass
+
     # ---- failure semantics ------------------------------------------------------
 
     async def _worker_pods(self, nb: dict) -> list[dict]:
@@ -618,42 +706,103 @@ class NotebookReconciler:
             label_selector={"matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name_of(nb)}},
         )
 
-    async def _restart_broken_slice(self, nb: dict, tpu: TpuSlice | None) -> None:
+    async def _restart_broken_slice(self, nb: dict, ms) -> Result | None:
         """All-or-nothing slice recovery (the hard part the reference never
         faced with single-pod notebooks, SURVEY.md §7.5): one dead worker
-        breaks the whole ICI mesh, so every worker restarts together."""
-        if not (tpu and tpu.multi_host) or nbapi.is_stopped(nb):
-            return
+        breaks the whole ICI mesh, so every worker restarts together. In
+        multislice mode this spans every slice — all hosts are one
+        jax.distributed job, so a broken slice stalls them all.
+
+        Restarts back off exponentially (attempt counter + last-restart
+        timestamp persisted as CR annotations, so the damping survives a
+        controller restart): a main container that crashes at startup
+        would otherwise produce a hot delete→recreate→crash loop. The
+        counter resets once every worker reports Ready — a slice that was
+        stable and then faults gets a fresh budget. Protocol style after
+        the reference's retry/backoff lock removal
+        (odh notebook_controller.go:117-145)."""
+        tpu = ms.slice if ms else None
+        gang = (tpu and tpu.multi_host) or (ms and ms.multi)
+        if not gang or nbapi.is_stopped(nb):
+            return None
+        total_hosts = ms.total_hosts
+        ns, name = namespace_of(nb), name_of(nb)
         pods = await self._worker_pods(nb)
         main_name = _main_container_name(nb)
         broken = [p for p in pods if _worker_is_broken(p, main_name)]
+        annotations = annotations_of(nb)
+        try:  # annotations are user-writable; garbage must not wedge reconcile
+            attempts = int(annotations.get(SLICE_RESTART_ATTEMPTS_ANNOTATION) or 0)
+        except ValueError:
+            attempts = 0
+
         if not broken:
-            return
+            all_ready = len(pods) == total_hosts and all(
+                any(c.get("type") == "Ready" and c.get("status") == "True"
+                    for c in deep_get(p, "status", "conditions", default=[]))
+                for p in pods
+            )
+            if attempts and all_ready:
+                await self.kube.patch(
+                    "Notebook", name,
+                    {"metadata": {"annotations": {
+                        SLICE_RESTART_ATTEMPTS_ANNOTATION: None,
+                        SLICE_RESTART_AT_ANNOTATION: None,
+                    }}}, ns)
+            return None
+
+        if attempts:
+            delay = min(
+                SLICE_RESTART_BASE_SECONDS * (2 ** (attempts - 1)),
+                SLICE_RESTART_MAX_SECONDS,
+            )
+            try:
+                last = float(annotations.get(SLICE_RESTART_AT_ANNOTATION) or 0.0)
+            except ValueError:
+                last = 0.0
+            remaining = delay - (self._now() - last)
+            if remaining > 0:
+                return Result(requeue_after=remaining)
+
         names = ", ".join(sorted(name_of(p) for p in broken))
         await self.recorder.event(
             nb,
             "Warning",
             "SliceRestart",
-            f"Worker(s) {names} failed; restarting all {tpu.num_hosts} workers "
-            f"(TPU slices restart atomically)",
+            f"Worker(s) {names} failed; restarting all {total_hosts} workers "
+            f"(TPU slices restart atomically; attempt {attempts + 1})",
         )
+        await self.kube.patch(
+            "Notebook", name,
+            {"metadata": {"annotations": {
+                SLICE_RESTART_ATTEMPTS_ANNOTATION: str(attempts + 1),
+                SLICE_RESTART_AT_ANNOTATION: repr(self._now()),
+            }}}, ns)
         for p in pods:
             try:
                 await self.kube.delete("Pod", name_of(p), namespace_of(p))
             except NotFound:
                 pass
+        return None
 
     # ---- status ----------------------------------------------------------------
 
     async def _mirror_events(self, nb: dict) -> None:
         """Re-emit worker pod events onto the CR so the UI can surface them
-        (reference: notebook_controller.go:94-123 event mapping)."""
+        (reference: notebook_controller.go:94-123 event mapping — that
+        design is watch-driven, and so is this one: the manager's Event
+        informer feeds both the reconcile queue and this cache, so status
+        churn costs zero apiserver LISTs per reconcile)."""
         ns, name = namespace_of(nb), name_of(nb)
         pods = {name_of(p) for p in await self._worker_pods(nb)}
-        try:
-            events = await self.kube.list("Event", ns)
-        except ApiError:
-            return
+        if self._event_informer is not None:
+            events = [e for e in self._event_informer.items()
+                      if namespace_of(e) == ns]
+        else:
+            try:
+                events = await self.kube.list("Event", ns)
+            except ApiError:
+                return
         seen = self._mirrored.setdefault((ns, name), {})
         for ev in events:
             involved = ev.get("involvedObject") or {}
@@ -670,15 +819,28 @@ class NotebookReconciler:
                 f"[pod {involved['name']}] {ev.get('message', '')}",
             )
 
-    async def _update_status(self, nb: dict, tpu: TpuSlice | None) -> None:
+    async def _update_status(self, nb: dict, ms) -> None:
         """Mirror STS/pod state into the CR (reference :228-349): readyReplicas,
-        containerState of worker 0's server container, condition history."""
+        containerState of worker 0's server container, condition history.
+        Multislice: readyReplicas sums across every slice's StatefulSet."""
+        tpu = ms.slice if ms else None
         ns, name = namespace_of(nb), name_of(nb)
-        sts = await self.kube.get_or_none("StatefulSet", name, ns)
-        ready = deep_get(sts or {}, "status", "readyReplicas", default=0) or 0
+        ready = 0
+        for j in range(ms.num_slices if ms else 1):
+            sts_name = ms.slice_sts_name(name, j) if ms else name
+            # Informer cache first: a 64-slice notebook would otherwise pay
+            # 64 serialized apiserver GETs per reconcile. The controller
+            # owns StatefulSets, so this informer is always running under
+            # the manager; staleness self-corrects on the next STS event.
+            if self._sts_informer is not None:
+                sts = self._sts_informer.get(sts_name, ns)
+            else:
+                sts = await self.kube.get_or_none("StatefulSet", sts_name, ns)
+            ready += deep_get(sts or {}, "status", "readyReplicas", default=0) or 0
 
         container_state: dict = {}
-        pod0 = await self.kube.get_or_none("Pod", f"{name}-0", ns)
+        pod0_name = f"{ms.slice_sts_name(name, 0) if ms else name}-0"
+        pod0 = await self.kube.get_or_none("Pod", pod0_name, ns)
         if pod0:
             main_name = _main_container_name(nb)
             statuses = deep_get(pod0, "status", "containerStatuses", default=[])
@@ -696,7 +858,8 @@ class NotebookReconciler:
             conditions.insert(0, new_cond)
             conditions = conditions[:8]
 
-        want_hosts = 0 if nbapi.is_stopped(nb) else (tpu.num_hosts if tpu else 1)
+        want_hosts = 0 if nbapi.is_stopped(nb) else (
+            ms.total_hosts if ms else 1)
         status = {
             "readyReplicas": ready,
             "containerState": container_state,
@@ -705,7 +868,8 @@ class NotebookReconciler:
             "tpu": {
                 "hosts": want_hosts,
                 "readyHosts": ready,
-                "chips": tpu.num_chips if tpu else 0,
+                "chips": ms.num_chips if ms else 0,
+                "slices": ms.num_slices if ms else 0,
             },
         }
         if deep_get(nb, "status") != status:
@@ -832,6 +996,13 @@ def setup_notebook_controller(
             ],
         )
     )
+    # _mirror_events and _update_status read the watch caches the Watch /
+    # owns wiring above already maintains — watch streams instead of a
+    # namespace-wide Event LIST + per-slice StatefulSet GETs per reconcile
+    # (reference notebook_controller.go:739-787 is watch-driven the same
+    # way).
+    rec._event_informer = mgr.informer_for("Event")
+    rec._sts_informer = mgr.informer_for("StatefulSet")
     if rec.opts.pipeline_access_role:
         # A pipelines Role appearing AFTER notebooks exist must still get
         # bindings (the probe cache alone would leave idle notebooks

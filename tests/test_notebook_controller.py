@@ -174,7 +174,7 @@ async def test_tpu_multi_host_slice_spawns_workers_with_distinct_ids():
 
         nb = await h.kube.get("Notebook", "big", "ns")
         assert deep_get(nb, "status", "tpu") == {
-            "hosts": 2, "readyHosts": 2, "chips": 16,
+            "hosts": 2, "readyHosts": 2, "chips": 16, "slices": 1,
         }
     finally:
         await stop_harness(h)

@@ -113,7 +113,8 @@ async def test_jwa_full_lifecycle():
         nb = body["notebooks"][0]
         assert nb["name"] == "my-nb"
         assert nb["status"]["phase"] == "ready"
-        assert nb["tpuStatus"] == {"hosts": 1, "readyHosts": 1, "chips": 8}
+        assert nb["tpuStatus"] == {
+            "hosts": 1, "readyHosts": 1, "chips": 8, "slices": 1}
         assert nb["cpu"] == "0.5"
 
         # Pod endpoint finds the worker pod.
