@@ -1,0 +1,59 @@
+"""Persistent XLA compilation cache wiring.
+
+Cold start on TPU is compile-dominated (measured: ~12 s AOT compile +
+~15 s jitted init for the bench model, docs/perf.md). JAX ships a
+persistent compilation cache keyed on the HLO + compile options + libtpu
+version; pointing it at a directory that outlives the process turns every
+repeat compile into a disk read. This module is the one place that knows
+where that directory lives:
+
+- **Notebook images**: `$KFTPU_COMPILE_CACHE_DIR` defaults to
+  ``~/.cache/jax_compile`` — on the workspace PVC, so the cache survives
+  stop/start cycles and slice-atomic restarts (the controller's stop
+  semantics keep the PVC; SURVEY.md §5 checkpoint/resume). Exported by
+  the jupyter-jax image (images/jupyter-jax/Dockerfile).
+- **bench.py / local runs**: a repo-local ``.jax_cache/`` (gitignored).
+
+No reference counterpart: the reference's images have no accelerator
+runtime to cache for (its CUDA images pay framework JIT costs elsewhere).
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_VAR = "KFTPU_COMPILE_CACHE_DIR"
+DEFAULT_IMAGE_DIR = "~/.cache/jax_compile"
+
+
+def default_cache_dir() -> str:
+    return os.path.expanduser(os.environ.get(ENV_VAR) or DEFAULT_IMAGE_DIR)
+
+
+def cache_entries(cache_dir: str | None = None) -> int:
+    """Number of cached executables (0 for a missing/empty dir)."""
+    d = cache_dir or default_cache_dir()
+    try:
+        return sum(1 for e in os.scandir(d) if e.is_file())
+    except OSError:
+        return 0
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Idempotent; creates the directory. Must run before the first
+    compilation (config flips after a compile don't retro-cache it).
+    Returns the resolved directory.
+    """
+    import jax
+
+    d = os.path.abspath(cache_dir or default_cache_dir())
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # Cache everything: the default 1 s floor skips the many small
+    # programs (init, host transfers) whose compiles still add up through
+    # a remote relay, and the size floor skips tiny executables.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return d

@@ -1,0 +1,43 @@
+"""Persistent XLA compilation cache wiring (utils/compilecache.py)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.utils import compilecache
+
+
+def test_default_dir_env_override(monkeypatch):
+    monkeypatch.setenv(compilecache.ENV_VAR, "/tmp/kftpu-cache-x")
+    assert compilecache.default_cache_dir() == "/tmp/kftpu-cache-x"
+    monkeypatch.delenv(compilecache.ENV_VAR)
+    assert compilecache.default_cache_dir() == os.path.expanduser(
+        compilecache.DEFAULT_IMAGE_DIR)
+
+
+def test_cache_entries_missing_dir_is_zero(tmp_path):
+    assert compilecache.cache_entries(str(tmp_path / "nope")) == 0
+
+
+def test_persistent_cache_populates_on_compile(tmp_path):
+    """A compile after enable_persistent_cache lands on disk — the
+    mechanism the warm cold-start path (bench.py --warm-probe and the
+    jupyter-jax image's PVC cache) relies on."""
+    saved = {
+        "dir": jax.config.jax_compilation_cache_dir,
+        "min_secs": jax.config.jax_persistent_cache_min_compile_time_secs,
+        "min_bytes": jax.config.jax_persistent_cache_min_entry_size_bytes,
+    }
+    d = compilecache.enable_persistent_cache(str(tmp_path / "cache"))
+    try:
+        assert compilecache.cache_entries(d) == 0
+        fn = jax.jit(lambda x: (x @ x).sum() * 3 + x.mean())
+        fn(jnp.ones((64, 64), jnp.float32)).block_until_ready()
+        assert compilecache.cache_entries(d) >= 1
+    finally:
+        jax.config.update("jax_compilation_cache_dir", saved["dir"])
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", saved["min_secs"])
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", saved["min_bytes"])
