@@ -23,7 +23,7 @@ from __future__ import annotations
 
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
-from kubeflow_tpu.tpu.topology import TopologyError, TpuSlice
+from kubeflow_tpu.tpu.topology import MultiSlice, TopologyError, TpuSlice
 
 GROUP = "kubeflow.org"
 KIND = "Notebook"
@@ -76,6 +76,10 @@ RESTART_ANNOTATION = "notebooks.kubeflow.org/restart"
 # compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
 TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"
 TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
+# Multislice: stamped per-StatefulSet so the pod webhook can compute the
+# global JAX_PROCESS_ID (= sliceId·hostsPerSlice + ordinal) at admission.
+TPU_SLICE_ID_ANNOTATION = "tpu.kubeflow.org/slice-id"
+TPU_NUM_SLICES_ANNOTATION = "tpu.kubeflow.org/num-slices"
 # Pod-template label marking slice workers; the admission registration keys
 # a failurePolicy:Fail objectSelector on it (labels, not annotations, are
 # what objectSelector can match).
@@ -93,6 +97,7 @@ def new(
     image: str = "kubeflow-tpu/jupyter-jax:latest",
     accelerator: str | None = None,
     topology: str | None = None,
+    num_slices: int | None = None,
     pod_spec: dict | None = None,
 ) -> dict:
     """Convenience constructor used by tests, the web app, and the load test."""
@@ -101,6 +106,8 @@ def new(
     }}}
     if accelerator:
         spec["tpu"] = {"accelerator": accelerator, "topology": topology or "1x1"}
+        if num_slices and num_slices > 1:
+            spec["tpu"]["numSlices"] = num_slices
     return {
         "apiVersion": API_VERSION,
         "kind": KIND,
@@ -130,6 +137,22 @@ def tpu_slice_of(notebook: dict) -> TpuSlice | None:
         return TpuSlice.parse(
             str(tpu.get("accelerator", "")), str(tpu.get("topology", ""))
         )
+    except TopologyError as e:
+        raise Invalid(f"Notebook {name_of(notebook)}: invalid spec.tpu: {e}") from e
+
+
+def multi_slice_of(notebook: dict) -> MultiSlice | None:
+    """Resolve spec.tpu → MultiSlice (``numSlices`` ≥ 1 identical slices
+    joined over DCN); None when the notebook is CPU-only. Single-slice
+    notebooks get ``num_slices=1`` — callers branch on ``.multi``."""
+    tpu = tpu_spec_of(notebook)
+    if not tpu:
+        return None
+    try:
+        return MultiSlice.parse(
+            str(tpu.get("accelerator", "")), str(tpu.get("topology", "")),
+            tpu.get("numSlices", 1),  # parse() rejects non-ints with the
+        )                             # actual offending value in the message
     except TopologyError as e:
         raise Invalid(f"Notebook {name_of(notebook)}: invalid spec.tpu: {e}") from e
 
@@ -167,4 +190,4 @@ def validate(notebook: dict) -> None:
     )
     if not containers:
         raise Invalid(f"Notebook {name}: spec.template.spec.containers required")
-    tpu_slice_of(notebook)  # raises Invalid on a malformed tpu block
+    multi_slice_of(notebook)  # raises Invalid on a malformed tpu block

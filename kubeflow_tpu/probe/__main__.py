@@ -32,7 +32,11 @@ def main() -> None:
     }
 
     if not args.skip_dcn:
-        from kubeflow_tpu.probe.dcn import run_rank, worker_env_config
+        from kubeflow_tpu.probe.dcn import (
+            run_rank,
+            slice_env_config,
+            worker_env_config,
+        )
 
         config = worker_env_config()
         if config is not None:
@@ -41,6 +45,18 @@ def main() -> None:
                 report["dcn"] = run_rank(rank, world, peers, mbytes=args.mbytes)
             except Exception as e:  # burn-in keeps going; DCN result is advisory
                 report["dcn"] = {"error": str(e)}
+
+        # Cross-slice ring (multislice): one rank per slice, worker 0 only —
+        # validates the links megascale training rides. Separate port base
+        # so it never collides with the intra-slice ring above.
+        slice_config = slice_env_config()
+        if slice_config is not None:
+            rank, world, peers = slice_config
+            try:
+                report["dcn_cross_slice"] = run_rank(
+                    rank, world, peers, mbytes=args.mbytes, base_port=19500)
+            except Exception as e:
+                report["dcn_cross_slice"] = {"error": str(e)}
 
     print(json.dumps(report))
 

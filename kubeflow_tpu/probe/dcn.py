@@ -120,3 +120,24 @@ def worker_env_config() -> tuple[int, int, list[str]] | None:
         return None
     peers = hostnames.split(",")
     return int(worker_id), len(peers), peers
+
+
+def slice_env_config() -> tuple[int, int, list[str]] | None:
+    """(rank, world, peers) for the CROSS-SLICE ring: one rank per slice
+    (worker 0 of each), peers from the KFTPU_SLICE_PEERS env the controller
+    bakes into multislice StatefulSets (tpu/topology.py
+    MultiSlice.worker_env). This is the path that validates the DCN links
+    megascale training rides — run ``python -m kubeflow_tpu.probe`` from
+    worker 0 of any slice and the cross-slice ring runs automatically
+    (reported as ``dcn_cross_slice``).
+
+    Returns None off-multislice or on a non-zero worker (only worker 0 of
+    each slice participates; the others would collide on ports).
+    """
+    peers = os.environ.get("KFTPU_SLICE_PEERS", "")
+    slice_id = os.environ.get("MEGASCALE_SLICE_ID", "")
+    worker_id = os.environ.get("TPU_WORKER_ID", "0")
+    if not peers or not slice_id.isdigit() or worker_id != "0":
+        return None
+    peer_list = peers.split(",")
+    return int(slice_id), len(peer_list), peer_list

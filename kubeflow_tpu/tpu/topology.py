@@ -24,6 +24,10 @@ TPU_RESOURCE = "google.com/tpu"
 # Port our controllers wire for jax.distributed coordinator (DCN bootstrap).
 JAX_COORDINATOR_PORT = 8476
 
+# Megascale (multislice) coordinator port — the DCN-side rendezvous libtpu
+# uses to join N slices into one training job.
+MEGASCALE_PORT = 8080
+
 
 class TopologyError(ValueError):
     """Invalid accelerator/topology combination."""
@@ -283,6 +287,9 @@ class TpuSlice:
     def peak_bf16_tflops(self) -> float:
         return self.num_chips * self.accelerator.peak_bf16_tflops_per_chip
 
+    def with_slices(self, num_slices: int) -> "MultiSlice":
+        return MultiSlice(slice=self, num_slices=num_slices)
+
     def allreduce_algo_bandwidth_gbps(self) -> float:
         """Approximate achievable all-reduce algorithm bandwidth over ICI.
 
@@ -296,3 +303,129 @@ class TpuSlice:
         link = self.accelerator.ici_gbps_per_link
         # Bidirectional ring over the largest torus dimension as a floor estimate.
         return link * 2 * k / (2 * (k - 1))
+
+
+@dataclass(frozen=True)
+class MultiSlice:
+    """``num_slices`` identical TPU slices joined over DCN (Multislice).
+
+    ICI exists only *within* a slice; across slices traffic rides the
+    data-center network, joined by libtpu's megascale layer. The control
+    plane consequences, all derived here:
+
+    - one StatefulSet per slice (``slice_sts_name``) — ICI placement is
+      per-slice, so each slice schedules as its own gang;
+    - per-slice ``TPU_WORKER_*`` env (libtpu wires ICI per slice), plus
+      ``MEGASCALE_*`` env that is static per slice (slice id, slice
+      count, the DCN coordinator = slice 0's worker 0);
+    - one *global* jax.distributed process space: ``JAX_NUM_PROCESSES``
+      spans every host of every slice.
+
+    The reference has no analogue (single-pod notebooks); this is the
+    TPU-native frontier past parity (SURVEY.md §2.4/§7, VERDICT r2 #7).
+    """
+
+    slice: TpuSlice
+    num_slices: int
+
+    @classmethod
+    def parse(
+        cls, accelerator: str, topology: str, num_slices: int = 1,
+        *, strict: bool = False,
+    ) -> "MultiSlice":
+        if not isinstance(num_slices, int) or isinstance(num_slices, bool) \
+                or num_slices < 1:
+            raise TopologyError(f"numSlices must be a positive int, got {num_slices!r}")
+        if num_slices > 64:
+            raise TopologyError(f"numSlices {num_slices} exceeds the supported 64")
+        return cls(
+            slice=TpuSlice.parse(accelerator, topology, strict=strict),
+            num_slices=num_slices,
+        )
+
+    @property
+    def multi(self) -> bool:
+        return self.num_slices > 1
+
+    @property
+    def num_chips(self) -> int:
+        return self.slice.num_chips * self.num_slices
+
+    @property
+    def total_hosts(self) -> int:
+        return self.slice.num_hosts * self.num_slices
+
+    def slice_sts_name(self, base: str, slice_id: int) -> str:
+        """StatefulSet (and pod-name prefix) for one slice. Single-slice
+        notebooks keep the bare name — zero churn for the common case.
+
+        Defensively clamped: pod hostnames (``<sts>-<ordinal>``) must stay
+        valid DNS labels (≤63 chars). Admission caps Notebook names well
+        below this, but direct library callers get a truncate-and-hash
+        instead of an apiserver rejection at create time."""
+        if not self.multi:
+            return base
+        name = f"{base}-s{slice_id}"
+        limit = 56  # + "-<ordinal>" keeps the pod hostname ≤ 63
+        if len(name) <= limit:
+            return name
+        import hashlib
+
+        digest = hashlib.sha256(base.encode()).hexdigest()[:8]
+        suffix = f"-{digest}-s{slice_id}"
+        return base[: limit - len(suffix)].rstrip("-.") + suffix
+
+    def worker_hostnames(
+        self, name: str, headless_service: str, namespace: str,
+        cluster_domain: str = "cluster.local",
+    ) -> list[list[str]]:
+        """Per-slice stable DNS names (pods of every slice's StatefulSet
+        share one headless Service)."""
+        return [
+            self.slice.worker_hostnames(
+                self.slice_sts_name(name, j), headless_service, namespace,
+                cluster_domain,
+            )
+            for j in range(self.num_slices)
+        ]
+
+    def megascale_env(self, slice_id: int, hostnames: list[list[str]]) -> dict[str, str]:
+        """Slice-static megascale env (bakeable into slice ``slice_id``'s
+        StatefulSet template — unlike TPU_WORKER_ID it doesn't vary by
+        ordinal)."""
+        if not 0 <= slice_id < self.num_slices:
+            raise TopologyError(
+                f"slice_id {slice_id} out of range for {self.num_slices} slices"
+            )
+        if not self.multi:
+            return {}
+        coordinator = hostnames[0][0]
+        return {
+            "MEGASCALE_COORDINATOR_ADDRESS": f"{coordinator}:{MEGASCALE_PORT}",
+            "MEGASCALE_NUM_SLICES": str(self.num_slices),
+            "MEGASCALE_SLICE_ID": str(slice_id),
+        }
+
+    def worker_env(
+        self, slice_id: int, worker_id: int, hostnames: list[list[str]]
+    ) -> dict[str, str]:
+        """Full env for worker ``worker_id`` of slice ``slice_id``:
+        intra-slice TPU_* (ICI) + megascale (DCN) + the global
+        jax.distributed process space."""
+        env = self.slice.worker_env(worker_id, hostnames[slice_id])
+        env.update(self.megascale_env(slice_id, hostnames))
+        if self.multi:
+            env["JAX_COORDINATOR_ADDRESS"] = (
+                f"{hostnames[0][0]}:{JAX_COORDINATOR_PORT}"
+            )
+            env["JAX_NUM_PROCESSES"] = str(self.total_hosts)
+            env["JAX_PROCESS_ID"] = str(
+                slice_id * self.slice.num_hosts + worker_id
+            )
+            # DCN probe peers: worker 0 of every slice (probe/dcn.py runs
+            # one rank per slice to validate the cross-slice network).
+            env["KFTPU_SLICE_PEERS"] = ",".join(h[0] for h in hostnames)
+        return env
+
+    def peak_bf16_tflops(self) -> float:
+        return self.num_slices * self.slice.peak_bf16_tflops()

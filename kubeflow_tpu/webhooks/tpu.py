@@ -18,6 +18,8 @@ import logging
 
 from kubeflow_tpu.api.notebook import (
     TPU_ACCELERATOR_ANNOTATION,
+    TPU_NUM_SLICES_ANNOTATION,
+    TPU_SLICE_ID_ANNOTATION,
     TPU_TOPOLOGY_ANNOTATION,
 )
 from kubeflow_tpu.runtime.objects import get_meta, name_of
@@ -48,14 +50,23 @@ def mutate_pod(pod: dict) -> None:
     except TopologyError as e:
         log.warning("pod %s: bad TPU annotations: %s", name_of(pod), e)
         return
+    # Multislice: the controller stamps the slice id per StatefulSet, and
+    # the global jax.distributed rank is sliceId·hostsPerSlice + ordinal
+    # (tpu/topology.py MultiSlice.worker_env).
+    try:
+        slice_id = int(annotations.get(TPU_SLICE_ID_ANNOTATION, 0))
+        num_slices = int(annotations.get(TPU_NUM_SLICES_ANNOTATION, 1))
+    except ValueError:
+        log.warning("pod %s: bad multislice annotations", name_of(pod))
+        return
     worker_env = {
         "TPU_WORKER_ID": str(ordinal),
-        "JAX_PROCESS_ID": str(ordinal),
+        "JAX_PROCESS_ID": str(slice_id * tpu.num_hosts + ordinal),
     }
-    if ordinal >= tpu.num_hosts:
+    if ordinal >= tpu.num_hosts or slice_id >= num_slices:
         log.warning(
-            "pod %s: ordinal %d outside %d-host slice", name_of(pod), ordinal,
-            tpu.num_hosts,
+            "pod %s: ordinal %d / slice %d outside %d-host × %d-slice job",
+            name_of(pod), ordinal, slice_id, tpu.num_hosts, num_slices,
         )
         return
     for ctr in pod.get("spec", {}).get("containers", []):

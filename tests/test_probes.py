@@ -54,3 +54,17 @@ def test_worker_env_config(monkeypatch):
     monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a.svc,b.svc")
     monkeypatch.setenv("TPU_WORKER_ID", "1")
     assert worker_env_config() == (1, 2, ["a.svc", "b.svc"])
+
+
+def test_slice_env_config(monkeypatch):
+    """Cross-slice DCN ring config: one rank per slice, worker 0 only
+    (tpu/topology.py MultiSlice.worker_env bakes these)."""
+    from kubeflow_tpu.probe.dcn import slice_env_config
+
+    assert slice_env_config() is None  # off-multislice
+    monkeypatch.setenv("KFTPU_SLICE_PEERS", "s0.svc,s1.svc,s2.svc")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "2")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    assert slice_env_config() == (2, 3, ["s0.svc", "s1.svc", "s2.svc"])
+    monkeypatch.setenv("TPU_WORKER_ID", "1")   # non-zero workers sit out
+    assert slice_env_config() is None
