@@ -55,16 +55,56 @@ class Browser:
         self.blobs: list = []
         self._install_globals()
 
+    # ---- unhandled rejections --------------------------------------------------
+
+    def observe_rejection(self, value) -> None:
+        """Attach a last-resort rejection observer to a promise returned by
+        an event/timer callback: if nothing else handles it, it lands in
+        ``interp.unhandled_rejections`` and the next harness step raises."""
+        if not isinstance(value, Promise):
+            return
+
+        def record(reason):
+            if not value.handled:
+                self.interp.unhandled_rejections.append(reason)
+        value.callbacks.append((None, record, None))
+        if value.state == Promise.REJECTED:
+            value._schedule()
+
+    def check_rejections(self) -> None:
+        pending = self.interp.unhandled_rejections
+        if pending:
+            self.interp.unhandled_rejections = []
+            from kubeflow_tpu.testing.jsrt.interp import to_js_string_safe
+
+            raise BrowserError(
+                "unhandled promise rejection(s) in event/timer callbacks: "
+                + "; ".join(to_js_string_safe(r) for r in pending))
+
     # ---- cookies ---------------------------------------------------------------
 
     def cookie_string(self) -> str:
         return "; ".join(f"{k}={v}" for k, v in self.cookies.items())
 
     def set_cookie_string(self, s: str) -> None:
-        first = s.split(";")[0]
-        if "=" in first:
-            k, _, v = first.partition("=")
-            self.cookies[k.strip()] = v.strip()
+        parts = s.split(";")
+        first = parts[0]
+        if "=" not in first:
+            return
+        k, _, v = first.partition("=")
+        k = k.strip()
+        # Deletion semantics: Max-Age<=0 or an already-past expires removes
+        # the cookie (the logout path) instead of storing an empty value.
+        for attr in parts[1:]:
+            akey, _, aval = attr.strip().partition("=")
+            if akey.lower() == "max-age" and aval.strip().lstrip("-").isdigit() \
+                    and int(aval) <= 0:
+                self.cookies.pop(k, None)
+                return
+            if akey.lower() == "expires" and ("1970" in aval or "1969" in aval):
+                self.cookies.pop(k, None)
+                return
+        self.cookies[k] = v.strip()
 
     def _absorb_set_cookie(self, resp_headers) -> None:
         for key, value in resp_headers:
@@ -117,12 +157,15 @@ class Browser:
                 self.timers.remove(t)
             else:
                 t["due"] += t["interval"]
-            self.interp.call_function(t["fn"], undefined, list(t["args"]))
+            result = self.interp.call_function(
+                t["fn"], undefined, list(t["args"]))
+            self.observe_rejection(result)
             self.interp.run_microtasks()
             fired += 1
             if fired > 10_000:
                 raise BrowserError("timer storm: >10k callbacks in one advance")
         self.clock_ms = deadline
+        self.check_rejections()
         return fired
 
     # ---- test-facing conveniences ----------------------------------------------
@@ -144,7 +187,9 @@ class Browser:
         el = self.query(target) if isinstance(target, str) else target
         if el is None:
             raise BrowserError(f"no element matches {target!r}")
-        return self.document.dispatch(el, dom.Event("click"))
+        result = self.document.dispatch(el, dom.Event("click"))
+        self.check_rejections()
+        return result
 
     def set_value(self, selector: str, value: str, *, fire="input") -> None:
         el = self.query(selector)
@@ -166,7 +211,9 @@ class Browser:
         el = self.query(selector)
         if el is None:
             raise BrowserError(f"no element matches {selector!r}")
-        return self.document.dispatch(el, dom.Event("submit"))
+        result = self.document.dispatch(el, dom.Event("submit"))
+        self.check_rejections()
+        return result
 
     def keydown(self, key: str) -> None:
         self.document.dispatch(self.document.body, dom.Event(
@@ -191,7 +238,8 @@ class Browser:
     def fire_window(self, etype: str, props: dict | None = None) -> None:
         event = dom.Event(etype, props or {})
         for listener in list(self.window_listeners.get(etype, [])):
-            self.interp.call_function(listener, undefined, [event])
+            self.observe_rejection(
+                self.interp.call_function(listener, undefined, [event]))
         self.interp.run_microtasks()
 
     def fire_storage(self, key: str, new_value: str) -> None:
@@ -224,8 +272,15 @@ class Browser:
             return undefined
         window.props["addEventListener"] = HostFunction(
             window_add_listener, "addEventListener")
+
+        def window_remove_listener(this, args):
+            etype = to_js_string(args[0], interp)
+            listeners = self.window_listeners.get(etype, [])
+            if args[1] in listeners:
+                listeners.remove(args[1])
+            return undefined
         window.props["removeEventListener"] = HostFunction(
-            lambda this, args: undefined, "removeEventListener")
+            window_remove_listener, "removeEventListener")
         g.declare("window", window)
 
         # location + history

@@ -485,7 +485,12 @@ class Document(Element):
         while node is not None:
             listeners = list(getattr(node, "listeners", {}).get(event.etype, []))
             for listener in listeners:
-                self.browser.interp.call_function(listener, node, [event])
+                result = self.browser.interp.call_function(
+                    listener, node, [event])
+                # An async handler that throws yields a rejected promise no
+                # one will ever .catch — record it so the harness fails
+                # loudly instead of shipping the app bug green.
+                self.browser.observe_rejection(result)
                 if event.propagation_stopped:
                     break
             if event.propagation_stopped:

@@ -715,26 +715,22 @@ class Interpreter:
                     continue
         elif op == "try":
             _, block, param, catch_block, final = node
+            # Python's finally gives exact JS ordering: the finalizer runs
+            # on normal exit, on a caught/propagating throw, AND on
+            # return/break/continue control-flow signals escaping the try.
             try:
-                self.exec_stmt(block, env, this)
-            except JSException as e:
-                if catch_block is not None:
+                try:
+                    self.exec_stmt(block, env, this)
+                except JSException as e:
+                    if catch_block is None:
+                        raise
                     catch_env = Environment(env)
                     if param is not None:
                         self.bind_pattern(param, e.value, catch_env, "let")
                     self.exec_stmt(catch_block, catch_env, this)
-                elif final is None:
-                    raise
-                else:
-                    self.exec_stmt(final, env, this)
-                    raise
             finally:
-                if final is not None and catch_block is not None:
+                if final is not None:
                     self.exec_stmt(final, env, this)
-                elif final is not None and catch_block is None:
-                    pass  # handled in except path above / fallthrough below
-            if final is not None and catch_block is None:
-                self.exec_stmt(final, env, this)
         elif op == "throw":
             raise JSException(self.eval(node[1], env, this))
         elif op == "break":
@@ -1019,7 +1015,10 @@ class Interpreter:
             if rn == 0:
                 if math.isnan(ln) or ln == 0:
                     return math.nan
-                return math.inf if (ln > 0) == (rn == 0 or not math.copysign(1, rn) < 0) else -math.inf
+                # Sign of ±Infinity follows the signs of BOTH operands
+                # (x / -0 is -Infinity for positive x).
+                positive = (ln > 0) == (math.copysign(1.0, rn) > 0)
+                return math.inf if positive else -math.inf
             return ln / rn
         if sym == "%":
             rn = to_number(r)

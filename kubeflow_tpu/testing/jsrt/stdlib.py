@@ -80,7 +80,10 @@ def string_prop(interp: Interpreter, s: str, name: str):
         return method(lambda a: s[int(to_number(a[0]))] if a and
                       0 <= int(to_number(a[0])) < len(s) else "")
     if name == "charCodeAt":
-        return method(lambda a: float(ord(s[int(to_number(a[0])) if a else 0])))
+        def char_code_at(a):
+            i = int(to_number(a[0])) if a and a[0] is not undefined else 0
+            return float(ord(s[i])) if 0 <= i < len(s) else math.nan
+        return method(char_code_at)
     if name == "repeat":
         return method(lambda a: s * int(to_number(a[0])))
     if name == "padStart":
@@ -109,15 +112,23 @@ def string_prop(interp: Interpreter, s: str, name: str):
 
 
 def _slice_str(s: str, args):
-    start = int(to_number(args[0])) if args else 0
-    end = int(to_number(args[1])) if len(args) > 1 and args[1] is not undefined \
-        else len(s)
+    def idx(i, default):
+        if i >= len(args) or args[i] is undefined:
+            return default
+        n = to_number(args[i])
+        return 0 if math.isnan(n) else int(n)
+    start, end = idx(0, 0), idx(1, len(s))
     return s[slice(*_norm_range(len(s), start, end))]
 
 
 def _substring(s: str, args):
-    a = max(0, int(to_number(args[0]))) if args else 0
-    b = max(0, int(to_number(args[1]))) if len(args) > 1 else len(s)
+    def idx(i, default):
+        if i >= len(args) or args[i] is undefined:
+            return default
+        n = to_number(args[i])
+        return 0 if math.isnan(n) else max(0, int(n))
+    a = idx(0, 0)
+    b = idx(1, len(s))
     a, b = min(a, len(s)), min(b, len(s))
     if a > b:
         a, b = b, a
@@ -150,9 +161,10 @@ def _match(s: str, pattern):
     if not isinstance(pattern, RegExpObject):
         return null
     if pattern.is_global:
-        found = pattern.regex.findall(s)
-        return JSArray([f if isinstance(f, str) else f[0] for f in found]) \
-            if found else null
+        # finditer + group(0): findall would hand back capture groups, not
+        # full matches, whenever the pattern has groups.
+        found = [m.group(0) for m in pattern.regex.finditer(s)]
+        return JSArray(found) if found else null
     m = pattern.regex.search(s)
     if not m:
         return null

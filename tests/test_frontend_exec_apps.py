@@ -1,0 +1,249 @@
+"""Execute the VWA / TWA / dashboard frontends in the vendored JS runtime
+against their real aiohttp backends (reference: the per-app Cypress suites
++ Karma specs — SURVEY.md §4.3, VERDICT r2 missing #1)."""
+
+import pytest
+
+from kubeflow_tpu.controllers.profile import setup_profile_controller
+from kubeflow_tpu.controllers.pvcviewer import setup_pvcviewer_controller
+from kubeflow_tpu.controllers.tensorboard import setup_tensorboard_controller
+from kubeflow_tpu.testing.jsweb import JsWebHarness
+from kubeflow_tpu.web.dashboard import create_app as create_dashboard
+from kubeflow_tpu.web.tensorboards import create_app as create_twa
+from kubeflow_tpu.web.volumes import create_app as create_vwa
+
+
+def _setup_pvcviewer_with_urls(mgr):
+    from kubeflow_tpu.controllers.pvcviewer import PVCViewerOptions
+
+    # use_istio so the controller stamps status.url — the Browse link's
+    # ready-state in the table depends on it.
+    setup_pvcviewer_controller(mgr, PVCViewerOptions(use_istio=True))
+
+
+@pytest.fixture()
+def vwa():
+    with JsWebHarness(create_vwa,
+                      extra_controllers=(_setup_pvcviewer_with_urls,)) as h:
+        h.browser.local_storage["kubeflow.namespace"] = "team"
+        h.browser.load("/")
+        yield h
+
+
+@pytest.fixture()
+def twa():
+    with JsWebHarness(create_twa,
+                      extra_controllers=(setup_tensorboard_controller,)) as h:
+        h.browser.local_storage["kubeflow.namespace"] = "team"
+        h.browser.load("/")
+        yield h
+
+
+# ---- VWA --------------------------------------------------------------------
+
+
+def test_vwa_create_volume_via_form(vwa):
+    b = vwa.browser
+    assert "No volumes in this namespace." in b.text("#pvc-table")
+    b.click("#new-btn")
+    b.set_value('#new-form input[name="name"]', "scratch")
+    b.set_value('#new-form input[name="size"]', "3Gi")
+    b.submit("#new-form")
+    pvc = vwa.kube_get("PersistentVolumeClaim", "scratch", "team")
+    assert pvc is not None
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "3Gi"
+    vwa.poll_ui()
+    assert "scratch" in b.text("#pvc-table")
+
+
+def test_vwa_viewer_lifecycle(vwa):
+    b = vwa.browser
+    vwa.kube_create("PersistentVolumeClaim", {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "data", "namespace": "team"},
+        "spec": {"accessModes": ["ReadWriteMany"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+    })
+    vwa.poll_ui()
+    assert "data" in b.text("#pvc-table")
+
+    # "Open viewer" POSTs a PVCViewer CR through the real backend.
+    open_btn = [el for el in b.query_all("#pvc-table button")
+                if el.text_content() == "Open viewer"]
+    assert open_btn, b.text("#pvc-table")
+    b.click(open_btn[0])
+    viewers = vwa.kube_list("PVCViewer", "team")
+    assert len(viewers) == 1
+    assert viewers[0]["spec"]["pvc"] == "data"
+
+    # Once the viewer is ready the action becomes a Browse link; close it.
+    vwa.poll_ui(rounds=4)
+    assert "Browse" in b.text("#pvc-table")
+    close_btn = [el for el in b.query_all("#pvc-table button")
+                 if el.text_content() == "Close viewer"][0]
+    b.click(close_btn)
+    vwa.poll_ui()
+    assert vwa.kube_list("PVCViewer", "team") == []
+
+
+def test_vwa_delete_with_confirm(vwa):
+    b = vwa.browser
+    vwa.kube_create("PersistentVolumeClaim", {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "gone", "namespace": "team"},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+    })
+    vwa.poll_ui()
+    delete_btn = [el for el in b.query_all("#pvc-table button")
+                  if el.text_content() == "Delete"][0]
+    b.click(delete_btn)
+    confirm = [el for el in b.query_all(".kf-dialog button")
+               if el.text_content() == "Delete"][0]
+    b.click(confirm)
+    vwa.poll_ui()
+    assert vwa.kube_get("PersistentVolumeClaim", "gone", "team") is None
+
+
+# ---- TWA --------------------------------------------------------------------
+
+
+def test_twa_create_and_details(twa):
+    b = twa.browser
+    b.click("#new-btn")
+    b.set_value('#new-form input[name="name"]', "profiles")
+    b.set_value('#new-form input[name="logspath"]', "gs://bkt/traces")
+    b.submit("#new-form")
+    tb = twa.kube_get("Tensorboard", "profiles", "team")
+    assert tb is not None
+    assert tb["spec"]["logspath"] == "gs://bkt/traces"
+
+    twa.poll_ui()
+    table = b.text("#tb-table")
+    assert "profiles" in table
+    assert "GCS bucket (XLA profiler traces)" in table
+
+    # Row click → drawer with the profiler note + events table.
+    row = [el for el in b.query_all("#tb-table tbody tr")
+           if "profiles" in el.text_content()][0]
+    b.click(row)
+    drawer = b.text(".kf-drawer")
+    assert "TensorBoard profiles" in drawer
+    assert "/tensorboard/team/profiles/" in drawer
+    assert "jax.profiler" in drawer
+
+
+def test_twa_delete_with_confirm(twa):
+    b = twa.browser
+    twa.kube_create("Tensorboard", {
+        "apiVersion": "tensorboard.kubeflow.org/v1alpha1",
+        "kind": "Tensorboard",
+        "metadata": {"name": "old", "namespace": "team"},
+        "spec": {"logspath": "pvc://data/logs"},
+    })
+    twa.poll_ui()
+    assert "old" in b.text("#tb-table")
+    delete_btn = [el for el in b.query_all("#tb-table button")
+                  if el.text_content() == "Delete"][0]
+    b.click(delete_btn)
+    confirm = [el for el in b.query_all(".kf-dialog button")
+               if el.text_content() == "Delete"][0]
+    b.click(confirm)
+    twa.poll_ui()
+    assert twa.kube_get("Tensorboard", "old", "team") is None
+
+
+def test_twa_logspath_suggestions_from_pvcs(twa):
+    b = twa.browser
+    twa.kube_create("PersistentVolumeClaim", {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "trainlogs", "namespace": "team"},
+        "spec": {"accessModes": ["ReadWriteMany"]},
+    })
+    # Namespace change re-runs loadLogspathSuggestions.
+    picker = b.query("#ns-slot input")
+    picker._value = "team"
+    b.change("#ns-slot input")
+    options = [o.attrs.get("value", "")
+               for o in b.query_all("#logspath-options option")]
+    assert "pvc://trainlogs/logs" in options
+    assert "gs://your-bucket/tensorboard" in options
+
+
+# ---- dashboard --------------------------------------------------------------
+
+
+def test_dashboard_workgroup_flow_and_panels():
+    with JsWebHarness(create_dashboard,
+                      extra_controllers=(setup_profile_controller,)) as h:
+        b = h.browser
+        b.load("/")
+        # No workgroup yet: the register card is visible.
+        card = b.query("#register-card")
+        assert card.style.props.get("display") == "block"
+        assert "alice@example.com" in b.text("#user-slot")
+        # Links panel rendered from /api/dashboard-links.
+        assert b.query_all("#links a"), "menu links missing"
+
+        # Register: POST /api/workgroup/create → Profile CR → namespace.
+        b.click("#register-btn")
+        h.settle()
+        profiles = h.kube_list("Profile")
+        assert len(profiles) == 1
+        assert profiles[0]["spec"]["owner"]["name"] == "alice@example.com"
+
+        b.advance(10000)  # dashboard poller refresh
+        h.settle()
+        b.advance(10000)
+        table = b.text("#ns-table")
+        assert "alice" in table and "owner" in table
+        # Register card hid after the workgroup exists.
+        assert b.query("#register-card").style.props.get("display") == "none"
+        # TPU usage panel loaded for the first namespace.
+        assert "chips requested" in b.text("#tpu-table")
+        # Metrics panels rendered sparkline canvases with the no-backend
+        # note (no PROMETHEUS_URL in tests).
+        notes = [el.text_content() for el in b.query_all(".metric-note")]
+        assert len(notes) == 3
+        assert all("metrics" in n or "no data" in n for n in notes)
+
+
+def test_dashboard_contributor_management():
+    with JsWebHarness(create_dashboard,
+                      extra_controllers=(setup_profile_controller,)) as h:
+        from kubeflow_tpu.testing.rbac import register_sar_evaluator
+
+        register_sar_evaluator(h.kube)
+        b = h.browser
+        b.load("/")
+        b.click("#register-btn")
+        h.settle()
+        b.advance(10000)
+        h.settle()
+        b.advance(10000)
+
+        manage = [el for el in b.query_all("#ns-table button")
+                  if el.text_content() == "Manage"]
+        assert manage, b.text("#ns-table")
+        b.click(manage[0])
+        drawer = b.text(".kf-drawer")
+        assert "Contributors" in drawer
+        assert "bob@example.com" not in drawer
+
+        # Add a contributor through the real KFAM routes.
+        email = b.query(".kf-drawer input")
+        email._value = "bob@example.com"
+        add = [el for el in b.query_all(".kf-drawer button")
+               if el.text_content() == "Add"][0]
+        b.click(add)
+        h.settle()
+        assert "bob@example.com" in b.text(".kf-drawer")
+
+        # And remove them (the Remove button inside bob's row).
+        bob_li = [el for el in b.query_all(".kf-drawer li")
+                  if "bob@example.com" in el.text_content()][0]
+        remove = [el for el in b.query_all(".kf-drawer li button")
+                  if el in list(bob_li.walk())][0]
+        b.click(remove)
+        h.settle()
+        assert "bob@example.com" not in b.text(".kf-drawer")

@@ -1,0 +1,387 @@
+"""Unit tests for the vendored JS runtime (testing/jsrt): language
+semantics, stdlib, DOM, timers — the engine the frontend-execution suites
+stand on. A semantic divergence here would make those suites fail for
+engine reasons; these tests keep engine bugs distinguishable from app
+bugs."""
+
+import pytest
+
+from kubeflow_tpu.testing.jsrt import Browser
+from kubeflow_tpu.testing.jsrt.interp import (
+    Interpreter,
+    JSDeadlock,
+    JSException,
+)
+
+
+def run(src: str):
+    """Run src; return the 'out' global as a Python value."""
+    from kubeflow_tpu.testing.jsrt.interp import js_to_python
+
+    interp = Interpreter()
+    interp.run(src)
+    interp.run_microtasks()
+    return js_to_python(interp.global_env.lookup("out"))
+
+
+def browser(html="<body></body>"):
+    def http(method, path, headers, body):
+        return 200, "OK", [], html if path == "/" else ""
+    b = Browser(http)
+    return b
+
+
+# ---- language ---------------------------------------------------------------
+
+
+def test_closures_and_hoisting():
+    assert run("""
+      const out = [];
+      function counter() { let n = 0; return () => ++n; }
+      const c = counter(); c(); c();
+      out.push(c());                       // 3
+      out.push(hoisted());                 // function decls hoist
+      function hoisted() { return "up"; }
+    """) == [3, "up"]
+
+
+def test_this_binding_and_arrows():
+    assert run("""
+      const obj = {
+        n: 2,
+        plain() { return this.n; },
+        arrow: () => (typeof this === "undefined" ? "lexical" : "bound"),
+      };
+      const out = [obj.plain(), obj.arrow()];
+    """) == [2, "lexical"]
+
+
+def test_destructuring_corners():
+    assert run("""
+      const { a: { b = 7 } = {}, ...rest } = { a: {}, x: 1, y: 2 };
+      const [first, ...others] = [10, 20, 30];
+      const out = [b, rest.x + rest.y, first, others.length];
+    """) == [7, 3, 10, 2]
+
+
+def test_template_literals_and_regex():
+    assert run("""
+      const name = "tpu";
+      const m = `${name}-v5e`.match(/([a-z]+)-v(\\d)e/);
+      const out = [`${1 + 1}x`, m[1], m[2], /^a+$/.test("aaa")];
+    """) == ["2x", "tpu", "5", True]
+
+
+def test_switch_fallthrough_and_break():
+    assert run("""
+      function f(x) {
+        switch (x) {
+          case 1:
+          case 2: return "low";
+          case 3: break;
+          default: return "high";
+        }
+        return "three";
+      }
+      const out = [f(1), f(2), f(3), f(9)];
+    """) == ["low", "low", "three", "high"]
+
+
+def test_try_finally_ordering():
+    assert run("""
+      const out = [];
+      function f() {
+        try { throw new Error("boom"); }
+        catch (e) { out.push("caught:" + e.message); return 1; }
+        finally { out.push("finally"); }
+      }
+      f();
+    """) == ["caught:boom", "finally"]
+
+
+def test_loose_vs_strict_equality():
+    assert run("""
+      const out = [null == undefined, null === undefined, "1" == 1,
+                   "1" === 1, NaN === NaN, 0 == false];
+    """) == [True, False, True, False, False, True]
+
+
+def test_getters_setters_and_spread():
+    assert run("""
+      let backing = 0;
+      const o = { get v() { return backing; }, set v(x) { backing = x * 2; } };
+      o.v = 21;
+      const merged = { ...{ a: 1 }, b: 2 };
+      const out = [o.v, merged.a + merged.b, Math.max(...[3, 1, 4])];
+    """) == [42, 3, 4]
+
+
+def test_promise_chain_then_catch_finally():
+    assert run("""
+      const out = [];
+      Promise.reject(new Error("no"))
+        .catch((e) => "rescued:" + e.message)
+        .then((v) => out.push(v))
+        .finally(() => out.push("done"));
+    """) == ["rescued:no", "done"]
+
+
+def test_async_await_and_promise_all():
+    assert run("""
+      const out = [];
+      async function go() {
+        const [a, b] = await Promise.all([Promise.resolve(1), 2]);
+        return a + b;
+      }
+      go().then((v) => out.push(v));
+    """) == [3]
+
+
+def test_async_rejection_propagates():
+    assert run("""
+      const out = [];
+      async function bad() { throw new Error("nope"); }
+      async function caller() {
+        try { await bad(); } catch (e) { out.push("got:" + e.message); }
+      }
+      caller();
+    """) == ["got:nope"]
+
+
+def test_await_deadlock_raises_not_hangs():
+    interp = Interpreter()
+    with pytest.raises((JSDeadlock, JSException)):
+        interp.run("""
+          async function stuck() { await new Promise(() => {}); }
+          stuck();
+          """)
+        interp.run_microtasks()
+        # The await drains and then raises JSDeadlock synchronously.
+
+
+def test_unsupported_syntax_fails_loudly():
+    from kubeflow_tpu.testing.jsrt.jsparser import ParseError
+
+    with pytest.raises(ParseError):
+        Interpreter().run("class Foo {}")   # out of subset by design
+
+
+def test_array_and_string_methods():
+    assert run("""
+      const out = [
+        [3, 1, 2].sort((a, b) => a - b).join(""),
+        [[1, [2]], 3].flat(Infinity).length,
+        "a-b-c".split("-").map((s) => s.toUpperCase()).join(""),
+        [1, 2, 3, 4].filter((x) => x % 2).reduce((a, x) => a + x, 0),
+        "  pad  ".trim(),
+        "img/tag:v1".split("/").pop(),
+        [..."xyz"].reverse().join(""),
+      ];
+    """) == ["123", 3, "ABC", 4, "pad", "tag:v1", "zyx"]
+
+
+def test_number_formatting_matches_js():
+    assert run("""
+      const out = [String(3), String(3.5), 1 / 0, String(0.1 + 0.2 > 0.3)];
+    """) == [3, 3.5, None, "true"] or run("""
+      const out = [String(3), String(3.5), String(1 / 0), String(0.1 + 0.2 > 0.3)];
+    """) == ["3", "3.5", "Infinity", "true"]
+
+
+# ---- DOM + browser ----------------------------------------------------------
+
+
+def test_event_bubbling_and_stop_propagation():
+    b = browser()
+    b.interp.run("""
+      const hits = [];
+      const outer = document.createElement("div");
+      const inner = document.createElement("button");
+      outer.append(inner);
+      document.body.append(outer);
+      outer.addEventListener("click", () => hits.push("outer"));
+      inner.addEventListener("click", (ev) => {
+        hits.push("inner");
+        if (inner.dataset.stop) ev.stopPropagation();
+      });
+      """)
+    inner = b.query("button")
+    b.click(inner)
+    inner.attrs["data-stop"] = "1"
+    b.click(inner)
+    from kubeflow_tpu.testing.jsrt.interp import js_to_python
+
+    assert js_to_python(b.interp.global_env.lookup("hits")) == \
+        ["inner", "outer", "inner"]
+
+
+def test_selector_subset():
+    b = browser("""
+      <body>
+        <form id="f">
+          <input name="a" type="checkbox" checked>
+          <input name="b" type="checkbox">
+          <div class="row deep"><span class="leaf">x</span></div>
+        </form>
+      </body>""")
+    b.load("/")
+    assert b.query('#f input[name="a"]:checked') is not None
+    assert b.query('#f input[name="b"]:checked') is None
+    assert b.query(".row .leaf").text_content() == "x"
+    assert len(b.query_all("#f input")) == 2
+
+
+def test_virtual_timers_and_intervals():
+    b = browser()
+    b.interp.run("""
+      const ticks = [];
+      setTimeout(() => ticks.push("once"), 1000);
+      const iv = setInterval(() => ticks.push("iv"), 500);
+      setTimeout(() => clearInterval(iv), 1600);
+      """)
+    b.advance(2000)
+    from kubeflow_tpu.testing.jsrt.interp import js_to_python
+
+    ticks = js_to_python(b.interp.global_env.lookup("ticks"))
+    assert ticks == ["iv", "once", "iv", "iv"]
+    b.advance(5000)
+    assert js_to_python(b.interp.global_env.lookup("ticks")) == ticks
+
+
+def test_form_data_collects_controls():
+    b = browser("""
+      <body><form id="f">
+        <input name="name" value="nb1">
+        <input name="shm" type="checkbox" checked>
+        <input name="off" type="checkbox">
+        <input name="kind" type="radio" value="a">
+        <input name="kind" type="radio" value="b" checked>
+        <select name="sel"><option value="x">x</option>
+          <option value="y" selected>y</option></select>
+      </form></body>""")
+    b.load("/")
+    assert b.eval("""
+      const fd = new FormData(document.getElementById("f"));
+      [fd.get("name"), fd.get("shm"), fd.get("off"), fd.get("kind"),
+       fd.get("sel")].join("|");
+    """) == "nb1|on||b|y"   # join renders null as "" — JS semantics
+
+
+def test_cookie_roundtrip_through_fetch():
+    seen = {}
+
+    def http(method, path, headers, body):
+        seen["cookie"] = headers.get("Cookie", "")
+        return 200, "OK", [("Set-Cookie", "XSRF-TOKEN=t0k3n; Path=/")], "{}"
+    b = Browser(http)
+    b.interp.run("fetch('/api/x');")
+    b.interp.run_microtasks()
+    assert b.cookies["XSRF-TOKEN"] == "t0k3n"
+    assert b.eval("document.cookie.includes('XSRF-TOKEN=t0k3n')") is True
+    b.interp.run("fetch('/api/y');")
+    assert "XSRF-TOKEN=t0k3n" in seen["cookie"]
+
+
+def test_instanceof_node_and_error():
+    b = browser()
+    assert b.eval("document.createElement('p') instanceof Node") is True
+    assert b.eval("'str' instanceof Node") is False
+    assert b.eval("new Error('x') instanceof Error") is True
+
+
+def test_location_hash_fires_hashchange():
+    b = browser()
+    b.interp.run("""
+      let fired = null;
+      window.addEventListener("hashchange", () => { fired = location.hash; });
+      """)
+    b.eval('location.hash = "#/notebook/abc"')
+    assert b.eval("fired") == "#/notebook/abc"
+    # replaceState does NOT fire hashchange.
+    b.eval('history.replaceState(null, "", "#/other"); fired')
+    assert b.eval("location.hash") == "#/other"
+    assert b.eval("fired") == "#/notebook/abc"
+
+
+def test_finally_runs_on_return_and_break():
+    assert run("""
+      const out = [];
+      function f() {
+        for (let i = 0; i < 3; i++) {
+          try { if (i === 1) break; } finally { out.push("fin" + i); }
+        }
+        try { return "ret"; } finally { out.push("fin-ret"); }
+      }
+      out.push(f());
+    """) == ["fin0", "fin1", "fin-ret", "ret"]
+
+
+def test_async_listener_throw_fails_loudly():
+    """An async event handler that throws must surface as a harness error
+    (the fail-loud property the engine exists for)."""
+    from kubeflow_tpu.testing.jsrt import BrowserError
+
+    b = browser()
+    b.interp.run("""
+      const btn = document.createElement("button");
+      document.body.append(btn);
+      btn.addEventListener("click", async () => { throw new Error("app bug"); });
+      """)
+    with pytest.raises(BrowserError, match="app bug"):
+        b.click(b.query("button"))
+    # Handled rejections stay quiet.
+    b.interp.run("""
+      const ok = document.createElement("button");
+      ok.id = "ok";
+      document.body.append(ok);
+      ok.addEventListener("click", () =>
+        Promise.reject(new Error("x")).catch(() => {}));
+      """)
+    b.click("#ok")
+
+
+def test_global_regex_match_returns_full_matches():
+    assert run("""
+      const out = "a1 b2".match(/([a-z])(\\d)/g);
+    """) == ["a1", "b2"]
+
+
+def test_string_edge_semantics():
+    import math
+
+    out = run("""
+      const out = [
+        "".charCodeAt(0),                 // NaN, not a crash
+        "abcdef".substring(0, undefined), // undefined end = length
+        "abcdef".slice(undefined, 3),
+        1 / -0 === -Infinity,
+        -1 / -0 === Infinity,
+      ];
+    """)
+    assert math.isnan(out[0])
+    assert out[1:] == ["abcdef", "abc", True, True]
+
+
+def test_window_remove_event_listener():
+    b = browser()
+    b.interp.run("""
+      let count = 0;
+      const handler = () => count++;
+      window.addEventListener("hashchange", handler);
+      window.removeEventListener("hashchange", handler);
+      """)
+    b.fire_window("hashchange")
+    assert b.eval("count") == 0.0
+
+
+def test_cookie_deletion_via_max_age():
+    def http(method, path, headers, body):
+        if path == "/login":
+            return 200, "OK", [("Set-Cookie", "session=abc; Path=/")], "{}"
+        return 200, "OK", [("Set-Cookie", "session=; Max-Age=0")], "{}"
+    b = Browser(http)
+    b.interp.run("fetch('/login');")
+    assert b.cookies.get("session") == "abc"
+    b.interp.run("fetch('/logout');")
+    assert "session" not in b.cookies
+    assert "session" not in b.eval("document.cookie")
