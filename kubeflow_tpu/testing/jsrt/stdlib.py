@@ -81,7 +81,7 @@ def string_prop(interp: Interpreter, s: str, name: str):
                       0 <= int(to_number(a[0])) < len(s) else "")
     if name == "charCodeAt":
         def char_code_at(a):
-            i = int(to_number(a[0])) if a and a[0] is not undefined else 0
+            i = _to_index(a[0], len(s)) if a and a[0] is not undefined else 0
             return float(ord(s[i])) if 0 <= i < len(s) else math.nan
         return method(char_code_at)
     if name == "repeat":
@@ -111,12 +111,23 @@ def string_prop(interp: Interpreter, s: str, name: str):
     return undefined
 
 
+def _to_index(v, length: int) -> int:
+    """JS ToInteger for index args: NaN→0, ±Infinity clamps, else trunc."""
+    n = to_number(v)
+    if math.isnan(n):
+        return 0
+    if n == math.inf:
+        return length
+    if n == -math.inf:
+        return -length
+    return int(n)
+
+
 def _slice_str(s: str, args):
     def idx(i, default):
         if i >= len(args) or args[i] is undefined:
             return default
-        n = to_number(args[i])
-        return 0 if math.isnan(n) else int(n)
+        return _to_index(args[i], len(s))
     start, end = idx(0, 0), idx(1, len(s))
     return s[slice(*_norm_range(len(s), start, end))]
 
@@ -125,8 +136,7 @@ def _substring(s: str, args):
     def idx(i, default):
         if i >= len(args) or args[i] is undefined:
             return default
-        n = to_number(args[i])
-        return 0 if math.isnan(n) else max(0, int(n))
+        return max(0, _to_index(args[i], len(s)))
     a = idx(0, 0)
     b = idx(1, len(s))
     a, b = min(a, len(s)), min(b, len(s))

@@ -701,6 +701,208 @@ KF.sliceRollup = function (container, tpu, tpuStatus, pods) {
   );
 };
 
+/* ---------------- help popover (lib/help-popover) ----------------------- */
+
+/* A "?" affordance that toggles an inline popover. Click anywhere else
+ * (or Escape) closes it; only one popover is open at a time. One pair of
+ * module-level document listeners serves every instance — per-instance
+ * registration would leak a listener (and pin its detached popover) for
+ * each re-render. */
+KF.closeAllPopovers = function () {
+  document
+    .querySelectorAll(".kf-popover")
+    .forEach((p) => (p.style.display = "none"));
+};
+document.addEventListener("click", KF.closeAllPopovers);
+document.addEventListener("keydown", (ev) => {
+  if (ev.key === "Escape") KF.closeAllPopovers();
+});
+
+KF.helpPopover = function (text) {
+  const pop = KF.el("span", { class: "kf-popover", role: "tooltip" }, text);
+  pop.style.display = "none";
+  const icon = KF.el(
+    "button",
+    {
+      class: "kf-help",
+      "aria-label": "help",
+      onclick: (ev) => {
+        ev.stopPropagation();
+        const open = pop.style.display !== "none";
+        KF.closeAllPopovers();
+        pop.style.display = open ? "none" : "inline-block";
+      },
+    },
+    "?"
+  );
+  return KF.el("span", { class: "kf-help-slot" }, icon, pop);
+};
+
+/* ---------------- loading spinner (lib/loading-spinner) ----------------- */
+
+KF.spinner = function (label) {
+  return KF.el(
+    "span",
+    { class: "kf-spinner", role: "status" },
+    KF.el("span", { class: "kf-spinner-dot" }),
+    label || "Loading…"
+  );
+};
+
+/* Swap a container to a spinner until the promise settles; renders the
+ * resolved value through `render(container, value)` or the error through
+ * KF.showError. Returns the promise for chaining. */
+KF.withSpinner = function (container, promise, render) {
+  container.replaceChildren(KF.spinner());
+  return promise.then(
+    (value) => {
+      container.replaceChildren();
+      render(container, value);
+      return value;
+    },
+    (err) => {
+      container.replaceChildren(
+        KF.el("p", { class: "muted" }, "Failed: " + (err.message || err))
+      );
+      throw err;
+    }
+  );
+};
+
+/* ---------------- variables groups table (lib/variables-groups-table) --- */
+
+/* Grouped key/value rows with collapsible group headers — the reference's
+ * variables-groups-table (env vars grouped by their PodDefault/source).
+ * groups: [{name, vars: [{key, value}]}]. */
+KF.varsGroupsTable = function (container, groups) {
+  container.replaceChildren(
+    ...((groups || []).length
+      ? groups.map((group) => {
+          const body = KF.el(
+            "table",
+            { class: "kf-vars" },
+            KF.el(
+              "tbody",
+              {},
+              group.vars.map((v) =>
+                KF.el(
+                  "tr",
+                  {},
+                  KF.el("td", { class: "kf-var-key" }, v.key),
+                  KF.el(
+                    "td",
+                    { class: "kf-var-value" },
+                    v.value === undefined || v.value === null ? "—" : v.value
+                  )
+                )
+              )
+            )
+          );
+          const head = KF.el(
+            "button",
+            {
+              class: "kf-vars-group-head",
+              onclick: () => {
+                const hidden = body.style.display === "none";
+                body.style.display = hidden ? "" : "none";
+                head.textContent =
+                  (hidden ? "▾ " : "▸ ") + group.name +
+                  ` (${group.vars.length})`;
+              },
+            },
+            `▾ ${group.name} (${group.vars.length})`
+          );
+          return KF.el("div", { class: "kf-vars-group" }, head, body);
+        })
+      : [KF.el("p", { class: "muted" }, "No variables.")])
+  );
+};
+
+/* ---------------- advanced form section --------------------------------- */
+
+/* Collapsible "Advanced options" wrapper (the reference spawner's
+ * advanced panels). Starts collapsed; render(pane) runs once on first
+ * expand so hidden controls stay cheap. */
+KF.advancedSection = function (title, render) {
+  const pane = KF.el("div", { class: "kf-advanced-pane" });
+  pane.style.display = "none";
+  let rendered = false;
+  const toggle = KF.el(
+    "button",
+    {
+      class: "kf-advanced-toggle",
+      type: "button",
+      onclick: () => {
+        const hidden = pane.style.display === "none";
+        pane.style.display = hidden ? "block" : "none";
+        toggle.textContent = (hidden ? "▾ " : "▸ ") + title;
+        if (hidden && !rendered) {
+          rendered = true;
+          render(pane);
+        }
+      },
+    },
+    "▸ " + title
+  );
+  return KF.el("div", { class: "kf-advanced" }, toggle, pane);
+};
+
+/* ---------------- chips input (advanced form control) ------------------- */
+
+/* Free-form list-of-strings input: type + Enter adds a chip, ✕ removes.
+ * onChange receives the current list. */
+/* opts.validate(value) -> error string | null rejects bad entries at
+ * Enter time (red border + title) instead of silently dropping them at
+ * submit time. */
+KF.chipsInput = function (initial, onChange, { placeholder, validate } = {}) {
+  const values = (initial || []).slice();
+  const list = KF.el("span", { class: "kf-chips" });
+  function renderChips() {
+    list.replaceChildren(
+      ...values.map((value, idx) =>
+        KF.el(
+          "span",
+          { class: "chip" },
+          value,
+          KF.el(
+            "button",
+            {
+              type: "button",
+              class: "kf-chip-x",
+              onclick: () => {
+                values.splice(idx, 1);
+                renderChips();
+                onChange(values.slice());
+              },
+            },
+            "✕"
+          )
+        )
+      )
+    );
+  }
+  const input = KF.el("input", {
+    placeholder: placeholder || "add value, press Enter",
+    style: { width: "200px" },
+  });
+  input.addEventListener("keydown", (ev) => {
+    if (ev.key !== "Enter") return;
+    ev.preventDefault();
+    const value = (input.value || "").trim();
+    if (!value || values.includes(value)) return;
+    const err = validate ? validate(value) : null;
+    input.classList.toggle("invalid", !!err);
+    input.title = err || "";
+    if (err) return;
+    values.push(value);
+    input.value = "";
+    renderChips();
+    onChange(values.slice());
+  });
+  renderChips();
+  return KF.el("span", { class: "kf-chips-input" }, list, input);
+};
+
 /* ---------------- sparkline (dashboard metrics) ------------------------- */
 
 /* Dependency-free time-series mini chart; points: [{timestamp, value}]. */

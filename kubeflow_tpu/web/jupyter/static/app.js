@@ -76,6 +76,14 @@ async function loadCatalogs() {
   tpuCatalog = tpus.tpus;
   spawnerConfig = config.config;
 
+  document.getElementById("tpu-help-slot").replaceChildren(
+    KF.helpPopover(
+      "Accelerator + topology pick a whole TPU slice: multi-host " +
+        "topologies spawn one worker pod per host with TPU_WORKER_* wired " +
+        "for jax.distributed."
+    )
+  );
+
   const accSelect = document.getElementById("tpu-acc");
   // NB: replaceChildren stringifies arrays — always spread node lists.
   accSelect.replaceChildren(
@@ -207,6 +215,56 @@ function openDetails(nb) {
         load().catch(KF.showError);
         const t = setInterval(() => load().catch(() => {}), 5000);
         return { stop: () => clearInterval(t) };
+      },
+    },
+    {
+      label: "Env",
+      render: (pane) => {
+        /* Worker-0 environment grouped by source — the TPU_ and JAX_
+         * wiring is the first thing to check when a slice won't
+         * bootstrap. */
+        const host = el("div", {});
+        pane.append(host);
+        KF.withSpinner(
+          host,
+          api(`api/namespaces/${ns.get()}/notebooks/${name}/pod`),
+          (slot, body) => {
+            const containers =
+              ((body.pods[0] || {}).spec || {}).containers || [];
+            const env = ((containers[0] || {}).env || []).map((e) => ({
+              key: e.name,
+              value:
+                e.value !== undefined
+                  ? e.value
+                  : e.valueFrom
+                    ? "(downward API)"
+                    : "",
+            }));
+            const groups = [
+              {
+                name: "TPU slice",
+                vars: env.filter((v) => v.key.startsWith("TPU_")),
+              },
+              {
+                name: "JAX / megascale",
+                vars: env.filter(
+                  (v) =>
+                    v.key.startsWith("JAX_") || v.key.startsWith("MEGASCALE_")
+                ),
+              },
+              {
+                name: "Other",
+                vars: env.filter(
+                  (v) =>
+                    !v.key.startsWith("TPU_") &&
+                    !v.key.startsWith("JAX_") &&
+                    !v.key.startsWith("MEGASCALE_")
+                ),
+              },
+            ].filter((group) => group.vars.length);
+            KF.varsGroupsTable(slot, groups);
+          }
+        ).catch(() => {});
       },
     },
     {
@@ -385,6 +443,52 @@ const checks = [
   KF.validate(memInput, KF.validators.memoryQuantity),
 ];
 
+/* Advanced options: collapsed by default; extra environment variables as
+ * a KEY=VALUE chips input (feeds the backend's `environment` form field),
+ * plus the admin-defined toleration preset when the config offers one. */
+let extraEnv = [];
+document.getElementById("advanced-slot").append(
+  KF.advancedSection("Advanced options", (pane) => {
+    const tolerationOptions =
+      (spawnerConfig.tolerationGroup && spawnerConfig.tolerationGroup.options) ||
+      [];
+    pane.append(
+      el("label", { style: { display: "block", marginBottom: "4px" } },
+        "Environment variables (KEY=VALUE)"),
+      KF.chipsInput(extraEnv, (values) => {
+        extraEnv = values;
+      }, {
+        placeholder: "e.g. JAX_LOG_LEVEL=INFO",
+        validate: (value) =>
+          /^[A-Za-z_][A-Za-z0-9_]*=.*$/.test(value)
+            ? null
+            : "Use KEY=VALUE (key: letters, digits, underscores).",
+      }),
+      tolerationOptions.length
+        ? el(
+            "label",
+            { style: { display: "block", margin: "10px 0 4px" } },
+            "Toleration preset"
+          )
+        : "",
+      tolerationOptions.length
+        ? el(
+            "select",
+            { id: "toleration-group", style: { width: "auto" } },
+            el("option", { value: "" }, "none"),
+            ...tolerationOptions.map((group) =>
+              el(
+                "option",
+                { value: group.groupKey },
+                group.displayName || group.groupKey
+              )
+            )
+          )
+        : ""
+    );
+  })
+);
+
 document.getElementById("new-btn").addEventListener("click", () => {
   document.getElementById("new-form-card").style.display = "block";
 });
@@ -461,6 +565,17 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
     ...ev.target.querySelectorAll('input[name="configuration"]:checked'),
   ].map((box) => box.value);
   if (configurations.length) payload.configurations = configurations;
+  if (extraEnv.length) {
+    payload.environment = {};
+    for (const entry of extraEnv) {
+      const eq = entry.indexOf("=");
+      if (eq > 0) payload.environment[entry.slice(0, eq)] = entry.slice(eq + 1);
+    }
+  }
+  const tolerationSelect = document.getElementById("toleration-group");
+  if (tolerationSelect && tolerationSelect.value) {
+    payload.tolerationGroup = tolerationSelect.value;
+  }
   api(`api/namespaces/${ns.get()}/notebooks`, {
     method: "POST",
     body: JSON.stringify(payload),

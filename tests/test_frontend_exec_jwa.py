@@ -261,3 +261,83 @@ def _action_button(h, label: str):
                if el.text_content() == label]
     assert buttons, f"no {label} button in table"
     return buttons[0]
+
+
+def test_help_popover_toggles(jwa):
+    b = jwa.browser
+    pop = b.query(".kf-popover")
+    assert pop is not None and pop.style.props.get("display") == "none"
+    b.click(".kf-help")
+    assert pop.style.props.get("display") == "inline-block"
+    assert "TPU_WORKER_" in pop.text_content()
+    b.keydown("Escape")
+    assert pop.style.props.get("display") == "none"
+
+
+def test_advanced_env_chips_flow_into_payload(jwa):
+    """The advanced section's KEY=VALUE chips land in the created CR's
+    container env through the backend's environment form field."""
+    b = jwa.browser
+    b.click("#new-btn")
+    toggle = b.query(".kf-advanced-toggle")
+    b.click(toggle)  # expands + first render
+    chip_input = b.query(".kf-chips-input input")
+    assert chip_input is not None
+    chip_input._value = "JAX_LOG_LEVEL=DEBUG"
+    b.document.dispatch(chip_input, __import__(
+        "kubeflow_tpu.testing.jsrt.dom", fromlist=["Event"]
+    ).Event("keydown", {"key": "Enter"}))
+    assert "JAX_LOG_LEVEL=DEBUG" in b.text(".kf-chips")
+    # Toleration preset select rendered from the spawner config.
+    b.change("#toleration-group", "tpu-reserved")
+
+    b.set_value('#new-form input[name="name"]', "envy")
+    b.submit("#new-form")
+    nb = jwa.kube_get("Notebook", "envy", "team")
+    assert nb is not None
+    container = nb["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in container.get("env", [])}
+    assert env.get("JAX_LOG_LEVEL") == "DEBUG"
+    tolerations = nb["spec"]["template"]["spec"].get("tolerations", [])
+    assert any(t.get("key") == "google.com/tpu" for t in tolerations)
+
+    # Chip removal works too.
+    b.click(".kf-chip-x")
+    assert "JAX_LOG_LEVEL" not in b.text(".kf-chips")
+
+
+def test_env_tab_groups_tpu_variables(jwa):
+    b = jwa.browser
+    jwa.kube_create("Notebook", _nb("envtab", accelerator="v5e",
+                                    topology="2x4"))
+    jwa.poll_ui()
+    row = [el for el in b.query_all("#notebook-table tbody tr")
+           if "envtab" in el.text_content()][0]
+    b.click(row)
+    tabs = b.query_all(".kf-tabs button")
+    env_tab = [t for t in tabs if t.text_content() == "Env"][0]
+    b.click(env_tab)
+    pane = b.text(".kf-tab-pane")
+    assert "TPU slice" in pane
+    assert "TPU_WORKER_HOSTNAMES" in pane
+    assert "JAX / megascale" in pane
+    # Collapsing a group hides its rows.
+    head = b.query(".kf-vars-group-head")
+    b.click(head)
+    table = b.query(".kf-vars-group table")
+    assert table.style.props.get("display") == "none"
+
+
+def test_env_chips_reject_malformed_entries(jwa):
+    b = jwa.browser
+    b.click("#new-btn")
+    b.click(".kf-advanced-toggle")
+    chip_input = b.query(".kf-chips-input input")
+    chip_input._value = "NOEQUALS"
+    b.document.dispatch(chip_input, __import__(
+        "kubeflow_tpu.testing.jsrt.dom", fromlist=["Event"]
+    ).Event("keydown", {"key": "Enter"}))
+    # Rejected at entry time with a visible error, not dropped at submit.
+    assert "NOEQUALS" not in b.text(".kf-chips")
+    assert "invalid" in chip_input.attrs.get("class", "")
+    assert "KEY=VALUE" in chip_input.attrs.get("title", "")

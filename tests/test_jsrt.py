@@ -385,3 +385,17 @@ def test_cookie_deletion_via_max_age():
     b.interp.run("fetch('/logout');")
     assert "session" not in b.cookies
     assert "session" not in b.eval("document.cookie")
+
+
+def test_index_coercion_nan_and_infinity():
+    out = run("""
+      const out = [
+        "abc".slice(0, Infinity),
+        "abc".substring(0, Infinity),
+        "abc".charCodeAt("x"),            // NaN index -> index 0
+        "abc".slice(-Infinity, 2),
+      ];
+    """)
+    assert out[0] == "abc" and out[1] == "abc"
+    assert out[2] == 97.0
+    assert out[3] == "ab"
