@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""CI helper: rewrite manifests/base/webhook.yaml for a host-run webhook.
+
+In KinD CI the admission server runs as a host process (no image registry
+in the loop), so the MutatingWebhookConfiguration's service-based
+clientConfig is rewritten to a URL the apiserver (inside the KinD docker
+container) can reach — the docker bridge gateway — with the self-signed
+CA inlined as caBundle. Prints the transformed registration to stdout for
+``kubectl apply -f -``.
+
+Reference analogue: suite_test.go:88-99 installs WebhookInstallOptions
+into envtest so mutation flows through a real apiserver; this is the same
+contract on KinD.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import sys
+from pathlib import Path
+
+import yaml
+
+MANIFEST = Path(__file__).resolve().parent.parent / "manifests/base/webhook.yaml"
+
+
+def transform(host: str, port: int, ca_path: str) -> str:
+    ca_bundle = base64.b64encode(Path(ca_path).read_bytes()).decode()
+    out = []
+    for doc in yaml.safe_load_all(MANIFEST.read_text()):
+        if not doc or doc.get("kind") != "MutatingWebhookConfiguration":
+            continue  # Deployment/Service stay out: the server runs on host
+        doc.setdefault("metadata", {}).pop("annotations", None)  # cert-manager
+        for hook in doc.get("webhooks", []):
+            path = hook["clientConfig"]["service"]["path"]
+            hook["clientConfig"] = {
+                "url": f"https://{host}:{port}{path}",
+                "caBundle": ca_bundle,
+            }
+        out.append(doc)
+    return yaml.safe_dump_all(out, sort_keys=False)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="172.17.0.1",
+                        help="address the apiserver reaches the host at "
+                             "(docker bridge gateway on Linux runners)")
+    parser.add_argument("--port", type=int, default=9443)
+    parser.add_argument("--ca-file", required=True)
+    args = parser.parse_args()
+    sys.stdout.write(transform(args.host, args.port, args.ca_file))
+
+
+if __name__ == "__main__":
+    main()

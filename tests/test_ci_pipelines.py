@@ -68,3 +68,31 @@ def test_check_mode_detects_drift(tmp_path, monkeypatch):
     assert pipelines.main() == 0
     monkeypatch.setattr("sys.argv", ["pipelines.py", "--check"])
     assert pipelines.main() == 0
+
+
+def test_webhook_install_transform():
+    """The KinD webhook installer keeps every hook, rewrites clientConfig
+    to a URL on the host, and inlines the CA (suite_test.go:88-99
+    analogue's plumbing)."""
+    import base64
+    import tempfile
+
+    import yaml
+
+    from ci.install_webhooks import transform
+
+    with tempfile.NamedTemporaryFile("w", suffix=".crt") as f:
+        f.write("FAKE CA PEM")
+        f.flush()
+        docs = list(yaml.safe_load_all(transform("10.0.0.9", 9443, f.name)))
+    assert len(docs) == 1
+    hooks = docs[0]["webhooks"]
+    names = {h["name"] for h in hooks}
+    assert "tpu-worker-env.kubeflow-tpu.dev" in names   # the load-bearing one
+    for hook in hooks:
+        cc = hook["clientConfig"]
+        assert "service" not in cc
+        assert cc["url"].startswith("https://10.0.0.9:9443/")
+        assert base64.b64decode(cc["caBundle"]) == b"FAKE CA PEM"
+    # cert-manager injection annotation dropped (no cert-manager on host).
+    assert "annotations" not in docs[0].get("metadata", {})
