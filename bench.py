@@ -275,7 +275,7 @@ FAMILY_STEPS = 20
 # pipelined / vision were invisible with only the burnin number tracked).
 MOE_MODEL = dict(
     vocab=8192, d_model=2048, n_heads=16, n_layers=2, d_ff=8192,
-    seq_len=1025, n_experts=8, router_top_k=2,
+    seq_len=1025, n_experts=8, router_top_k=2, attention="flash",
 )
 PP_MODEL = dict(
     vocab=8192, d_model=2048, n_heads=16, n_layers=4, d_ff=8192,

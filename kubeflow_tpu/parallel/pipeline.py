@@ -83,6 +83,20 @@ def pipeline_apply(stage_fn, stage_params, x_micro, *, n_stages: int,
     ``psum`` as models/pipelined.py does for the loss).
     """
     n_micro = x_micro.shape[0]
+    if n_stages == 1:
+        # Degenerate single-stage pipeline: no bubble, no ppermute, no
+        # schedule scan — and the microbatches fuse back into one batch so
+        # the GEMMs run at full MXU tile sizes instead of n_micro small
+        # ones. The general path below is correct here too but pays
+        # schedule overhead for nothing (measured on the bench family).
+        # Input must be marked varying over every manual axis first: the
+        # layer scan inside stage_fn mixes in stage-varying params, and a
+        # {data}-only carry type would mismatch its output (same rule as
+        # the general path's state/outputs).
+        vary = tuple(mesh_axes) if mesh_axes else (axis_name,)
+        flat = _mark_varying(
+            x_micro.reshape((-1,) + tuple(x_micro.shape[2:])), vary)
+        return stage_fn(stage_params, flat).reshape(x_micro.shape)
     idx = jax.lax.axis_index(axis_name)
     last = n_stages - 1
     perm = stage_ring_perm(n_stages)

@@ -126,11 +126,22 @@ def _tpu_from_form(config: dict, body: dict) -> dict | None:
     if not tpu or tpu in ("none", {}):
         return None
     if not isinstance(tpu, dict) or "accelerator" not in tpu:
-        raise Invalid("form: tpu must be {accelerator, topology}")
-    return {
+        raise Invalid("form: tpu must be {accelerator, topology[, numSlices]}")
+    out = {
         "accelerator": str(tpu["accelerator"]),
         "topology": str(tpu.get("topology", "1x1")),
     }
+    num_slices = tpu.get("numSlices")
+    if num_slices not in (None, "", 1, "1"):
+        # Strict: bools/floats must not slip through int() coercion (true
+        # → 1, 2.9 → 2 would silently change the requested slice count).
+        if isinstance(num_slices, bool) or not isinstance(num_slices, (int, str)):
+            raise Invalid(f"form: numSlices must be an integer, got {num_slices!r}")
+        try:
+            out["numSlices"] = int(num_slices)
+        except ValueError:
+            raise Invalid(f"form: numSlices must be an integer, got {num_slices!r}")
+    return out
 
 
 def _apply_volumes(config, body, name, namespace, pod_spec, container) -> list[dict]:

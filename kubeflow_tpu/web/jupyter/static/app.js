@@ -112,6 +112,19 @@ function renderTopologies() {
       )
     )
   );
+  renderNumSlices();
+}
+
+function renderNumSlices() {
+  /* Multislice (DCN-joined slices) only makes sense with a TPU selected:
+   * show the slice-count stepper then, hide (and reset) it for CPU. */
+  const acc = document.getElementById("tpu-acc").value;
+  const input = document.getElementById("num-slices");
+  const label = document.getElementById("num-slices-label");
+  const show = acc ? "" : "none";
+  input.style.display = show;
+  label.style.display = show;
+  if (!acc) input.value = "1";
 }
 
 /* ---------------- details drawer ---------------------------------------- */
@@ -355,7 +368,8 @@ async function refresh() {
               el(
                 "span",
                 { class: "chip" },
-                `${nb.tpu.accelerator} ${nb.tpu.topology}`
+                `${nb.tpu.accelerator} ${nb.tpu.topology}` +
+                  (nb.tpu.numSlices > 1 ? ` ×${nb.tpu.numSlices}` : "")
               ),
               nb.tpuStatus
                 ? `${nb.tpuStatus.readyHosts}/${nb.tpuStatus.hosts} hosts`
@@ -549,6 +563,8 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
       accelerator: form.get("tpu-acc"),
       topology: form.get("tpu-topo"),
     };
+    const slices = parseInt(form.get("numSlices"), 10);
+    if (slices > 1) payload.tpu.numSlices = slices;
   }
   if (!form.get("workspace")) payload.workspaceVolume = null;
   if (form.get("dataVolume")) {

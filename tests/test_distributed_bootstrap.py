@@ -93,3 +93,37 @@ def test_four_process_2x2_mesh_collectives():
         # 2D: devices (data d, model m) hold pid+1 = [[1,2],[3,4]];
         # psum over model → [[3],[7]]; pmean over data → 5 everywhere.
         assert "MESH2D_RESULT 5.0" in out, out
+
+
+def test_multislice_global_process_space_bootstraps():
+    """2 slices × 2 hosts as 4 processes under MultiSlice.worker_env: the
+    GLOBAL jax.distributed space the controller wires for megascale jobs
+    (one coordinator, JAX_PROCESS_ID = sliceId·hosts + ordinal) must
+    bootstrap and carry a collective spanning both slices — unique ranks
+    and the right world size, or the psum result is wrong/hangs."""
+    from kubeflow_tpu.tpu.topology import MultiSlice
+
+    ms = MultiSlice.parse("v5e", "4x4", 2)
+    assert ms.total_hosts == 4
+    hostnames = ms.worker_hostnames("nb", "nb-workers", "ns")
+    port = _free_port()
+    procs = []
+    for slice_id in range(ms.num_slices):
+        for worker_id in range(ms.slice.num_hosts):
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = " ".join(
+                f for f in env.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f
+            )
+            env.pop("KFTPU_WORKER_MESH", None)
+            env.update(ms.worker_env(slice_id, worker_id, hostnames))
+            env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "kubeflow_tpu.testing.distributed_worker"],
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+    for out in _communicate_all(procs):
+        # 4 global processes: psum of (rank+1) = 1+2+3+4 = 10 everywhere.
+        assert "PSUM_RESULT 10.0 NPROC 4" in out, out

@@ -341,3 +341,32 @@ def test_env_chips_reject_malformed_entries(jwa):
     assert "NOEQUALS" not in b.text(".kf-chips")
     assert "invalid" in chip_input.attrs.get("class", "")
     assert "KEY=VALUE" in chip_input.attrs.get("title", "")
+
+
+def test_multislice_spawn_from_form(jwa):
+    """numSlices picker: hidden for CPU, shown for TPU picks, flows into
+    spec.tpu.numSlices, and the table badges the slice count."""
+    b = jwa.browser
+    b.click("#new-btn")
+    slices_input = b.query("#num-slices")
+    assert slices_input.style.props.get("display") == "none"  # CPU default
+
+    b.change("#tpu-acc", "v5e")
+    assert slices_input.style.props.get("display") == ""      # visible now
+    b.change("#tpu-topo", "4x4")
+    slices_input._value = "2"
+    b.set_value('#new-form input[name="name"]', "multi")
+    b.submit("#new-form")
+
+    nb = jwa.kube_get("Notebook", "multi", "team")
+    assert nb is not None
+    assert nb["spec"]["tpu"] == {
+        "accelerator": "v5e", "topology": "4x4", "numSlices": 2}
+
+    jwa.poll_ui(rounds=3)
+    table = table_text(jwa)
+    assert "v5e 4x4 ×2" in table
+    # Both slices' StatefulSets exist and the status rolls up 4 hosts.
+    assert jwa.kube_get("StatefulSet", "multi-s0", "team") is not None
+    assert jwa.kube_get("StatefulSet", "multi-s1", "team") is not None
+    assert "4/4 hosts" in table

@@ -243,3 +243,46 @@ def test_num_slices_rejects_bool_and_strings():
     nb["spec"]["tpu"]["numSlices"] = "2"
     with pytest.raises(Invalid, match="'2'"):
         nbapi.multi_slice_of(nb)
+
+
+async def test_multislice_idle_culling_parks_every_slice():
+    """An idle multislice notebook scales ALL slice StatefulSets to 0 —
+    parking one slice of a DCN-joined job would wedge, not save, chips."""
+    from test_culling import FakeClock
+
+    from kubeflow_tpu.controllers.culling import (
+        CullingOptions,
+        setup_culling_controller,
+    )
+
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr)
+    clock = FakeClock()   # deterministic: the shared culling-test stub
+
+    async def idle_prober(_url):
+        return []   # no kernels anywhere: idle
+
+    culler = setup_culling_controller(
+        mgr, idle_prober,
+        CullingOptions(cull_idle_seconds=300, enable_culling=True),
+        clock=clock)
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    try:
+        await kube.create("Notebook", nbapi.new(
+            "park", "ns", accelerator="v5e", topology="4x4", num_slices=2))
+        await settle(mgr)
+        await culler.reconcile(("ns", "park"))   # seed the idle clock
+        clock.t += 10_000
+        await culler.reconcile(("ns", "park"))
+        await settle(mgr)
+        for sts_name in ("park-s0", "park-s1"):
+            sts = await kube.get("StatefulSet", sts_name, "ns")
+            assert deep_get(sts, "spec", "replicas") == 0, f"{sts_name} not parked"
+        nb = await kube.get("Notebook", "park", "ns")
+        assert nbapi.STOP_ANNOTATION in nb["metadata"]["annotations"]
+    finally:
+        await stop(kube, mgr, sim)

@@ -121,3 +121,29 @@ def test_microbatch_divisibility_error():
     tokens = jnp.zeros((4, cfg.seq_len), jnp.int32)  # 4 % 3 != 0
     with pytest.raises(ValueError, match="n_micro"):
         jax.jit(pipelined.make_train_step(cfg, mesh))(params, tokens)
+
+
+def test_single_stage_matches_two_stage():
+    """The degenerate n_stages=1 fast path (no schedule scan, microbatches
+    fused into one batch) must compute exactly what the 2-stage ring
+    computes for the same config + seed."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import pipelined
+
+    cfg = pipelined.PipelinedConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        seq_len=12, n_micro=2, dtype="float32",
+    )
+    tokens = jnp.asarray(
+        jax.random.randint(jax.random.key(9), (4, cfg.seq_len), 0, cfg.vocab))
+    losses = {}
+    for n_stages in (1, 2):
+        mesh = pipelined.make_pp_mesh(
+            jax.devices()[:n_stages], n_stages=n_stages, n_model=1)
+        params = pipelined.shard_params(
+            pipelined.init_params(jax.random.key(0), cfg), mesh, cfg)
+        _, loss = jax.jit(pipelined.make_train_step(cfg, mesh))(params, tokens)
+        losses[n_stages] = float(loss)
+    assert abs(losses[1] - losses[2]) < 2e-5, losses
