@@ -281,7 +281,9 @@ PP_MODEL = dict(
     vocab=8192, d_model=2048, n_heads=16, n_layers=4, d_ff=8192,
     seq_len=1025, n_micro=4,
 )
-VISION_BATCH = 64
+# Swept on the chip (docs/perf.md): 64→128 lifts conv MFU 0.42→0.54 and
+# img/s 7.1k→9.2k; 256 adds only ~2% more MFU at 2× latency.
+VISION_BATCH = 128
 
 
 def _family_bench(peak_tflops: float | None) -> dict:

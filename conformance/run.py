@@ -114,6 +114,31 @@ class Conformance:
         if self.sim is not None:
             assert ids == {"0", "1"}, f"worker ids {ids}"
 
+    async def check_multislice(self):
+        """spec.tpu.numSlices fans out one StatefulSet per slice with the
+        megascale env + global process space wired (round 3)."""
+        await self.kube.create(
+            "Notebook",
+            nbapi.new("conf-ms", NS, accelerator="v5e", topology="4x4",
+                      num_slices=2))
+        await self.settle()
+        for j in range(2):
+            sts = await self.kube.get("StatefulSet", f"conf-ms-s{j}", NS)
+            assert deep_get(sts, "spec", "replicas") == 2
+            assert deep_get(sts, "spec", "serviceName") == "conf-ms-workers"
+        headless = await self.kube.get("Service", "conf-ms-workers", NS)
+        assert deep_get(headless, "spec", "selector") == {
+            "notebook-name": "conf-ms"}
+        if self.sim is not None:
+            pod = await self.kube.get("Pod", "conf-ms-s1-1", NS)
+            env = {e["name"]: e.get("value")
+                   for e in deep_get(pod, "spec", "containers")[0]["env"]}
+            assert env.get("MEGASCALE_SLICE_ID") == "1"
+            assert env.get("MEGASCALE_NUM_SLICES") == "2"
+            assert env.get("JAX_PROCESS_ID") == "3"  # slice·hosts + ordinal
+            assert env.get("JAX_NUM_PROCESSES") == "4"
+        await self.kube.delete("Notebook", "conf-ms", NS)
+
     async def check_poddefault(self):
         await self.kube.create(
             "PodDefault",
@@ -450,6 +475,7 @@ async def run(live: bool) -> int:
     await conf.check("crds-registered", conf.check_crds)
     await conf.check("notebook-lifecycle", conf.check_notebook_lifecycle)
     await conf.check("multi-host-slice", conf.check_multi_host_slice)
+    await conf.check("multislice-megascale", conf.check_multislice)
     await conf.check("poddefault-injection", conf.check_poddefault)
     await conf.check("profile-tenancy", conf.check_profile)
     await conf.check("tensorboard-pvcviewer", conf.check_tensorboard_pvcviewer)

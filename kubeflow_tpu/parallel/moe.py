@@ -5,8 +5,12 @@ implementation original):
 
 - tokens are data-sharded over every mesh axis (data and expert axes both
   carry batch); **experts** shard over the ``expert`` axis;
-- routing builds a one-hot dispatch tensor (einsum with one-hots is the
-  MXU-friendly formulation — no gather/scatter in the hot path);
+- routing assigns (expert, slot) seats per token (``router_slots``); the
+  hot path dispatches by scatter-add into ``[E·C, d]`` slot rows and
+  combines by gathered, gate-scaled ``jnp.take`` — measured ~13% faster
+  fwd+bwd than the dense GShard one-hot einsums on v5e, whose
+  ``[T, E, C]`` matmuls cost about as much as the expert FF itself
+  (``router_dispatch`` keeps the dense form as the test oracle);
 - two ``all_to_all``s move token slots expert-shard→expert-shard over ICI
   (dims: ``[E, C, d] → [E/P, P·C, d]`` and back);
 - capacity truncation keeps every shape static for XLA.

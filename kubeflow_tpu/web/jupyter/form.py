@@ -132,11 +132,14 @@ def _tpu_from_form(config: dict, body: dict) -> dict | None:
         "topology": str(tpu.get("topology", "1x1")),
     }
     num_slices = tpu.get("numSlices")
+    # Strict typing BEFORE the default-membership test: `true == 1` and
+    # `1.0 == 1` in Python, so a membership check first would silently
+    # admit bools/floats as "one slice" instead of rejecting them.
+    if num_slices is not None and (
+        isinstance(num_slices, bool) or not isinstance(num_slices, (int, str))
+    ):
+        raise Invalid(f"form: numSlices must be an integer, got {num_slices!r}")
     if num_slices not in (None, "", 1, "1"):
-        # Strict: bools/floats must not slip through int() coercion (true
-        # → 1, 2.9 → 2 would silently change the requested slice count).
-        if isinstance(num_slices, bool) or not isinstance(num_slices, (int, str)):
-            raise Invalid(f"form: numSlices must be an integer, got {num_slices!r}")
         try:
             out["numSlices"] = int(num_slices)
         except ValueError:

@@ -441,3 +441,25 @@ async def test_twa_events_route():
         assert [e["reason"] for e in body["events"]] == ["Created"]
     finally:
         await h.stop()
+
+
+async def test_jwa_num_slices_rejects_bool_and_float():
+    """True == 1 and 1.0 == 1 in Python — the form must reject them BEFORE
+    any default-membership comparison silently admits them as one slice."""
+    from kubeflow_tpu.runtime.errors import Invalid
+    from kubeflow_tpu.web.jupyter.form import _tpu_from_form
+
+    config = {"tpus": {"readOnly": False}}
+    for bad in (True, False, 1.0, 2.9, [2]):
+        try:
+            _tpu_from_form(config, {"tpu": {
+                "accelerator": "v5e", "topology": "4x4", "numSlices": bad}})
+            raise AssertionError(f"numSlices={bad!r} accepted")
+        except Invalid:
+            pass
+    ok = _tpu_from_form(config, {"tpu": {
+        "accelerator": "v5e", "topology": "4x4", "numSlices": "2"}})
+    assert ok["numSlices"] == 2
+    one = _tpu_from_form(config, {"tpu": {
+        "accelerator": "v5e", "topology": "4x4", "numSlices": 1}})
+    assert "numSlices" not in one
