@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Conformance runner: 16 checks, one JSON line each + a summary line.
+"""Conformance runner: 17 checks, one JSON line each + a summary line.
 
 Hermetic by default (in-process fake cluster + controllers); ``--live``
 targets the current kubeconfig/proxy endpoint instead and skips the checks

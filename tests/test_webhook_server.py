@@ -207,3 +207,41 @@ async def test_admission_server_metrics():
         assert 'allowed="false",path="/mutate-notebooks"} 2.0' in text
     finally:
         await client.close()
+
+
+async def test_admission_server_multislice_global_rank_on_the_wire():
+    """The wire AdmissionReview path (not just the in-process chain)
+    computes the multislice global rank: JAX_PROCESS_ID =
+    sliceId·hostsPerSlice + ordinal, TPU_WORKER_ID stays per-slice."""
+    kube = FakeKube()
+    client = TestClient(TestServer(create_webhook_app(kube)))
+    await client.start_server()
+    try:
+        pod = {
+            "kind": "Pod",
+            "metadata": {
+                "name": "nb-s1-1",   # slice 1, ordinal 1 of a 2×2-host job
+                "labels": {"notebook-name": "nb"},
+                "annotations": {
+                    "tpu.kubeflow.org/accelerator": "v5e",
+                    "tpu.kubeflow.org/topology": "4x4",
+                    "tpu.kubeflow.org/slice-id": "1",
+                    "tpu.kubeflow.org/num-slices": "2",
+                },
+            },
+            "spec": {"containers": [{"name": "nb", "env": []}]},
+        }
+        resp = await client.post(
+            "/mutate-pods", json=admission_review(pod, namespace="ns"))
+        body = await resp.json()
+        assert body["response"]["allowed"] is True
+        patched = apply(
+            {**pod, "metadata": {**pod["metadata"], "namespace": "ns"}},
+            decode_patch(body),
+        )
+        env = {e["name"]: e["value"]
+               for e in patched["spec"]["containers"][0]["env"]}
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["JAX_PROCESS_ID"] == "3"
+    finally:
+        await client.close()
