@@ -215,9 +215,13 @@ class Browser:
         self.check_rejections()
         return result
 
-    def keydown(self, key: str) -> None:
-        self.document.dispatch(self.document.body, dom.Event(
-            "keydown", {"key": key}))
+    def keydown(self, key: str, selector: str | None = None) -> None:
+        target = self.document.body
+        if selector is not None:
+            target = self.query(selector)
+            if target is None:
+                raise BrowserError(f"no element matches {selector!r}")
+        self.document.dispatch(target, dom.Event("keydown", {"key": key}))
 
     def eval(self, src: str):
         """Evaluate a JS expression/program for assertions; returns the

@@ -193,6 +193,8 @@ class Element(DomNode):
         self._checked: bool | None = None
         self.disabled = False
         self.scroll_top = 0.0
+        self.scroll_left = 0.0
+        self._sel: tuple[int, int] | None = None
         self._canvas_ctx: CanvasContext | None = None
 
     # -- js property protocol ----------------------------------------------------
@@ -243,6 +245,17 @@ class Element(DomNode):
                             if isinstance(c, Element) and c.tag == "option"])
         if name == "scrollTop":
             return self.scroll_top
+        if name == "scrollLeft":
+            return self.scroll_left
+        if name == "selectionStart":
+            return float(self._selection()[0])
+        if name == "selectionEnd":
+            return float(self._selection()[1])
+        if name == "setSelectionRange":
+            def set_range(this, args):
+                self._sel = (int(args[0]), int(args[1]))
+                return undefined
+            return _method(name, set_range)
         if name == "scrollHeight":
             return 1000.0
         if name == "clientWidth":
@@ -295,6 +308,15 @@ class Element(DomNode):
         if name == "scrollTop":
             self.scroll_top = float(to_js_string(value, interp) == "" or value)
             return True
+        if name == "scrollLeft":
+            self.scroll_left = float(to_js_string(value, interp) == "" or value)
+            return True
+        if name == "selectionStart":
+            self._sel = (int(value), self._selection()[1])
+            return True
+        if name == "selectionEnd":
+            self._sel = (self._selection()[0], int(value))
+            return True
         if name in ("width", "height"):
             self.attrs[name] = str(int(value)) if isinstance(value, float) \
                 else to_js_string(value, interp)
@@ -302,6 +324,14 @@ class Element(DomNode):
         return super().js_set_prop(name, value, interp)
 
     # -- value semantics ---------------------------------------------------------
+
+    def _selection(self) -> tuple[int, int]:
+        """Caret range clamped to the current value (collapsed at the end
+        by default, like a freshly-focused real textarea)."""
+        n = len(self.get_value())
+        if self._sel is None:
+            return (n, n)
+        return (min(self._sel[0], n), min(self._sel[1], n))
 
     def get_value(self) -> str:
         if self.tag == "select":

@@ -608,11 +608,8 @@ def install(interp: Interpreter) -> None:
     arr_ns.props["from"] = HostFunction(array_from, "from")
     g.declare("Array", arr_ns)
 
-    # Date (the subset used: Date.now(), Date.parse(iso))
-    date_ns = JSObject()
-    date_ns.props["now"] = HostFunction(
-        lambda this, args: float(int(interp._now() * 1000)), "now")
-
+    # Date — static now()/parse(iso) plus constructible instances with the
+    # UTC accessor subset (what KF.formatDate renders with).
     def date_parse(this, args):
         s = to_js_string(args[0], interp)
         try:
@@ -622,8 +619,44 @@ def install(interp: Interpreter) -> None:
             return dt.timestamp() * 1000.0
         except ValueError:
             return math.nan
-    date_ns.props["parse"] = HostFunction(date_parse, "parse")
-    g.declare("Date", date_ns)
+
+    def date_construct(args):
+        if not args:
+            ms = float(int(interp._now() * 1000))
+        elif isinstance(args[0], str):
+            ms = date_parse(undefined, args)
+        else:
+            ms = float(args[0])
+        obj = JSObject()
+        obj.class_name = "Date"
+        if math.isnan(ms):
+            dt = None
+        else:
+            dt = _dt.datetime.fromtimestamp(ms / 1000.0, _dt.timezone.utc)
+        def acc(name, fn):
+            obj.props[name] = HostFunction(
+                lambda this, a: math.nan if dt is None else float(fn(dt)),
+                name)
+        acc("getTime", lambda d: ms)
+        acc("getUTCFullYear", lambda d: d.year)
+        acc("getUTCMonth", lambda d: d.month - 1)
+        acc("getUTCDate", lambda d: d.day)
+        acc("getUTCHours", lambda d: d.hour)
+        acc("getUTCMinutes", lambda d: d.minute)
+        acc("getUTCSeconds", lambda d: d.second)
+        acc("getUTCDay", lambda d: (d.weekday() + 1) % 7)
+        obj.props["toISOString"] = HostFunction(
+            lambda this, a: ("Invalid Date" if dt is None else
+                             dt.strftime("%Y-%m-%dT%H:%M:%S.") +
+                             f"{dt.microsecond // 1000:03d}Z"), "toISOString")
+        return obj
+
+    date_cls = HostClass("Date", date_construct,
+                         lambda v: getattr(v, "class_name", "") == "Date")
+    date_cls.props["now"] = HostFunction(
+        lambda this, args: float(int(interp._now() * 1000)), "now")
+    date_cls.props["parse"] = HostFunction(date_parse, "parse")
+    g.declare("Date", date_cls)
 
     # Promise
     def promise_construct(args):

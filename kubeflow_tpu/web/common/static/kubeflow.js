@@ -121,6 +121,29 @@ KF.age = function (timestamp) {
   return Math.floor(sec / 86400) + "d";
 };
 
+/* Absolute timestamp, UTC, second resolution — the tooltip form of the
+ * reference's date-time module ("2026-07-30 09:14:05 UTC"). */
+KF.formatDate = function (timestamp) {
+  if (!timestamp) return "—";
+  const d = new Date(Date.parse(timestamp));
+  const pad = (n) => String(n).padStart(2, "0");
+  return (
+    d.getUTCFullYear() + "-" + pad(d.getUTCMonth() + 1) + "-" +
+    pad(d.getUTCDate()) + " " + pad(d.getUTCHours()) + ":" +
+    pad(d.getUTCMinutes()) + ":" + pad(d.getUTCSeconds()) + " UTC"
+  );
+};
+
+/* Relative age with the absolute time as a hover tooltip — what every
+ * "Age"/"Last activity" table cell should render. */
+KF.ageCell = function (timestamp, suffix) {
+  return KF.el(
+    "span",
+    { class: "kf-age", title: KF.formatDate(timestamp) },
+    KF.age(timestamp) + (timestamp && suffix ? suffix : "")
+  );
+};
+
 /* ---------------- resource table (lib/resource-table) ------------------- */
 
 /* columns: [{title, render(row) -> Node|string, sortKey?(row) -> any}]
@@ -374,12 +397,123 @@ KF.confirmDialog = function ({ title, message, confirmText = "Delete" }) {
   });
 };
 
+/* ---------------- code editor (lib/editor) ------------------------------ */
+
+/* YAML line tokenizer for the editor's highlight layer: returns a list of
+ * spans for one line. Recognizes comments, `key:` heads (with list dashes),
+ * quoted strings, numbers, booleans/null. Token classes are kf-tok-*. */
+KF.highlightYamlLine = function (line) {
+  const out = [];
+  const tok = (cls, text) =>
+    out.push(KF.el("span", { class: "kf-tok-" + cls }, text));
+  // Whole-line comment (possibly indented).
+  const cm = line.match(/^(\s*)(#.*)$/);
+  if (cm) {
+    if (cm[1]) tok("plain", cm[1]);
+    tok("comment", cm[2]);
+    return out;
+  }
+  let rest = line;
+  // `  - key:` / `key:` head — the indent+dash stays plain, the key colors.
+  const km = rest.match(/^(\s*(?:-\s+)?)([A-Za-z0-9_.\/-]+)(:)(\s|$)/);
+  if (km) {
+    if (km[1]) tok("plain", km[1]);
+    tok("key", km[2]);
+    tok("plain", km[3] + km[4]);
+    rest = rest.slice(km[0].length);
+  } else {
+    const dm = rest.match(/^(\s*-\s+)/);
+    if (dm) {
+      tok("plain", dm[1]);
+      rest = rest.slice(dm[1].length);
+    }
+  }
+  // Value part: strings / numbers / booleans / trailing comment.
+  while (rest.length) {
+    let m;
+    if ((m = rest.match(/^("[^"]*"?|'[^']*'?)/))) tok("string", m[1]);
+    else if ((m = rest.match(/^(#.*)$/))) tok("comment", m[1]);
+    else if ((m = rest.match(/^(-?\d+(?:\.\d+)?)(?![A-Za-z0-9_.-])/)))
+      tok("number", m[1]);
+    else if ((m = rest.match(/^(true|false|null)(?![A-Za-z0-9_-])/)))
+      tok("bool", m[1]);
+    else if ((m = rest.match(/^(\s+|[^\s"'#]+)/))) tok("plain", m[1]);
+    else {
+      tok("plain", rest);
+      break;
+    }
+    rest = rest.slice(m[1].length);
+  }
+  return out;
+};
+
+/* Line-numbered, syntax-highlighted editor — the buildless stand-in for
+ * the monaco bundle in the reference's lib/editor: a transparent textarea
+ * overlaid on a highlight layer, a line-number gutter that tracks edits
+ * and scrolling, and Tab inserting two spaces at the caret instead of
+ * leaving the field. Returns {root, textarea, getValue, setValue}. */
+KF.codeEditor = function (initial, opts = {}) {
+  const gutter = KF.el("div", { class: "kf-code-gutter", "aria-hidden": "true" });
+  const hl = KF.el("pre", { class: "kf-code-hl", "aria-hidden": "true" });
+  const textarea = KF.el("textarea", {
+    class: "kf-code-input " + (opts.textareaClass || ""),
+    spellcheck: "false",
+  });
+  textarea.value = initial || "";
+  function render() {
+    const lines = textarea.value.split("\n");
+    gutter.replaceChildren(
+      ...lines.map((_, i) => KF.el("div", {}, String(i + 1)))
+    );
+    hl.replaceChildren(
+      ...lines.map((line) =>
+        KF.el("div", { class: "kf-code-line" },
+          line ? KF.highlightYamlLine(line) : " ")
+      )
+    );
+    if (opts.onChange) opts.onChange(textarea.value);
+  }
+  textarea.addEventListener("input", render);
+  textarea.addEventListener("scroll", () => {
+    hl.scrollTop = textarea.scrollTop;
+    hl.scrollLeft = textarea.scrollLeft;
+    gutter.scrollTop = textarea.scrollTop;
+  });
+  textarea.addEventListener("keydown", (ev) => {
+    if (ev.key !== "Tab") return;
+    ev.preventDefault();
+    const start = textarea.selectionStart;
+    const end = textarea.selectionEnd;
+    const v = textarea.value;
+    textarea.value = v.slice(0, start) + "  " + v.slice(end);
+    textarea.setSelectionRange(start + 2, start + 2);
+    render();
+  });
+  render();
+  const root = KF.el(
+    "div",
+    { class: "kf-code-editor" },
+    gutter,
+    KF.el("div", { class: "kf-code-area" }, hl, textarea)
+  );
+  return {
+    root,
+    textarea,
+    getValue() {
+      return textarea.value;
+    },
+    setValue(v) {
+      textarea.value = v;
+      render();
+    },
+  };
+};
+
 /* ---------------- YAML editor dialog (lib/editor) ----------------------- */
 
-/* Textarea-based manifest editor (the reference bundles monaco; a
- * dependency-free editor keeps the buildless-SPA property). onSubmit
- * receives the raw YAML text and may throw/reject — the error renders
- * inline and the dialog stays open for another attempt. */
+/* Manifest editor dialog over KF.codeEditor. onSubmit receives the raw
+ * YAML text and may throw/reject — the error renders inline and the
+ * dialog stays open for another attempt. */
 KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSubmit }) {
   return new Promise((resolve) => {
     const overlay = KF.el("div", { class: "kf-overlay" });
@@ -387,17 +521,8 @@ KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSub
       class: "kf-yaml-error",
       style: { color: "#c5221f", whiteSpace: "pre-wrap", display: "none" },
     });
-    const textarea = KF.el("textarea", {
-      class: "kf-yaml-editor",
-      spellcheck: "false",
-      style: {
-        width: "100%",
-        minHeight: "320px",
-        fontFamily: "monospace",
-        fontSize: "13px",
-      },
-    });
-    textarea.value = initial;
+    const editor = KF.codeEditor(initial, { textareaClass: "kf-yaml-editor" });
+    const textarea = editor.textarea;
     let pending = false;
     function close(result) {
       if (pending) return; // no cancel while the submit is in flight
@@ -433,7 +558,7 @@ KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSub
         "div",
         { class: "kf-dialog kf-dialog-wide", role: "dialog", "aria-modal": "true" },
         KF.el("h3", {}, title),
-        textarea,
+        editor.root,
         errorBox,
         KF.el(
           "div",
@@ -901,6 +1026,45 @@ KF.chipsInput = function (initial, onChange, { placeholder, validate } = {}) {
   });
   renderChips();
   return KF.el("span", { class: "kf-chips-input" }, list, input);
+};
+
+/* ---------------- title-actions toolbar (lib/title-actions-toolbar) ----- */
+
+/* Page/drawer header row: back affordance, title + subtitle on the left,
+ * action buttons on the right — the reference's title-actions-toolbar. */
+KF.titleActionsToolbar = function ({ title, subtitle, actions, onBack }) {
+  return KF.el(
+    "div",
+    { class: "kf-toolbar" },
+    onBack
+      ? KF.el(
+          "button",
+          { class: "kf-toolbar-back", "aria-label": "back", onclick: onBack },
+          "←"
+        )
+      : null,
+    KF.el(
+      "div",
+      { class: "kf-toolbar-titles" },
+      KF.el("h2", {}, title),
+      subtitle ? KF.el("span", { class: "muted" }, subtitle) : null
+    ),
+    KF.el("div", { class: "kf-toolbar-actions" }, actions || [])
+  );
+};
+
+/* ---------------- app URLs (lib/urls) ----------------------------------- */
+
+/* The L7 URL contract in one place — every link the mesh routes
+ * (/notebook/<ns>/<name>/, /tensorboard/..., /pvcviewer/...) is built
+ * here so the scheme can't drift per app. */
+KF.urls = {
+  notebook: (ns, name) =>
+    "/notebook/" + encodeURIComponent(ns) + "/" + encodeURIComponent(name) + "/",
+  tensorboard: (ns, name) =>
+    "/tensorboard/" + encodeURIComponent(ns) + "/" + encodeURIComponent(name) + "/",
+  pvcviewer: (ns, name) =>
+    "/pvcviewer/" + encodeURIComponent(ns) + "/" + encodeURIComponent(name) + "/",
 };
 
 /* ---------------- sparkline (dashboard metrics) ------------------------- */

@@ -53,7 +53,7 @@ async function loadActivities(namespace) {
             el(
               "li",
               { class: a.type === "Warning" ? "event-warning" : "" },
-              el("span", { class: "muted" }, KF.age(a.time) + " ago — "),
+              KF.ageCell(a.time, " ago"), el("span", { class: "muted" }, " — "),
               `${a.involved.kind} ${a.involved.name}: ${a.reason} `,
               el("span", { class: "muted" }, a.message)
             )

@@ -175,13 +175,13 @@ function openDetails(nb) {
               ["Message", ps.message],
               ["Image", nb.image],
               ["CPU / Memory", `${nb.cpu || "—"} / ${nb.memory || "—"}`],
-              ["Created", meta.creationTimestamp],
+              ["Created", KF.ageCell(meta.creationTimestamp, " ago")],
               [
                 "Connect",
                 el(
                   "a",
-                  { href: `/notebook/${ns.get()}/${name}/`, target: "_blank" },
-                  `/notebook/${ns.get()}/${name}/`
+                  { href: KF.urls.notebook(ns.get(), name), target: "_blank" },
+                  KF.urls.notebook(ns.get(), name)
                 ),
               ],
             ])
@@ -380,12 +380,12 @@ async function refresh() {
     },
     {
       title: "Age",
-      render: (nb) => KF.age(nb.age),
+      render: (nb) => KF.ageCell(nb.age),
       sortKey: (nb) => nb.age || "",
     },
     {
       title: "Last activity",
-      render: (nb) => (nb.lastActivity ? KF.age(nb.lastActivity) + " ago" : "—"),
+      render: (nb) => (nb.lastActivity ? KF.ageCell(nb.lastActivity, " ago") : "—"),
       sortKey: (nb) => nb.lastActivity || "",
     },
     {
@@ -430,7 +430,7 @@ async function refresh() {
           el(
             "a",
             {
-              href: `/notebook/${ns.get()}/${nb.name}/`,
+              href: KF.urls.notebook(ns.get(), nb.name),
               target: "_blank",
               onclick: (ev) => ev.stopPropagation(),
             },

@@ -24,8 +24,8 @@ function openDetails(tb) {
         "Open",
         el(
           "a",
-          { href: `/tensorboard/${ns.get()}/${tb.name}/`, target: "_blank" },
-          `/tensorboard/${ns.get()}/${tb.name}/`
+          { href: KF.urls.tensorboard(ns.get(), tb.name), target: "_blank" },
+          KF.urls.tensorboard(ns.get(), tb.name)
         ),
       ],
     ]),
@@ -69,7 +69,7 @@ async function refresh() {
           el(
             "a",
             {
-              href: `/tensorboard/${ns.get()}/${tb.name}/`,
+              href: KF.urls.tensorboard(ns.get(), tb.name),
               target: "_blank",
               onclick: (ev) => ev.stopPropagation(),
             },
