@@ -72,6 +72,12 @@ IMAGE_SELECTION_ANNOTATION = "notebooks.kubeflow.org/last-image-selection"
 # Restart protocol (reference: culler pkg + odh webhook "update-pending"):
 RESTART_ANNOTATION = "notebooks.kubeflow.org/restart"
 
+# Controller-mirrored impending-maintenance signal: comma-joined nodes
+# hosting this notebook's TPU workers that carry a maintenance taint
+# (controllers/notebook.py _check_maintenance). Read by the status
+# machine and by in-notebook tooling that wants to checkpoint early.
+MAINTENANCE_ANNOTATION = "notebooks.kubeflow.org/maintenance-pending"
+
 # Pod-template annotations the controller stamps so pod-level admission can
 # compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
 TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"

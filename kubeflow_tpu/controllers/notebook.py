@@ -67,6 +67,15 @@ STS_LABEL = "statefulset"  # reference labels pods with statefulset=<name> (:429
 POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"  # set by the STS controller
 
 
+# Impending-maintenance surfacing: nodes hosting TPU workers get this taint
+# from GKE graceful node termination ahead of a maintenance event; the
+# controller mirrors it onto the CR (api/notebook.py MAINTENANCE_ANNOTATION,
+# a comma-joined sorted node list) so the UI and in-notebook tooling can
+# checkpoint before the slice goes down.
+MAINTENANCE_ANNOTATION = nbapi.MAINTENANCE_ANNOTATION
+DEFAULT_MAINTENANCE_TAINTS = ("cloud.google.com/impending-node-termination",)
+
+
 @dataclass
 class NotebookOptions:
     """The reference's env-var sprawl (USE_ISTIO, ISTIO_GATEWAY, CLUSTER_DOMAIN,
@@ -103,6 +112,11 @@ class NotebookOptions:
     # ServiceAccount to it so in-notebook pipeline clients (elyra-style)
     # can submit runs. None disables the probe entirely.
     pipeline_access_role: str | None = "pipeline-user-access"
+
+    # Taint keys that mean "this node is about to go down for maintenance"
+    # (GKE graceful node termination for TPU/GPU maintenance events).
+    # Empty disables the maintenance-pending mirror.
+    maintenance_taints: tuple[str, ...] = DEFAULT_MAINTENANCE_TAINTS
 
 
 AUTH_PROXY_ANNOTATION = "notebooks.kubeflow.org/inject-auth-proxy"
@@ -148,6 +162,7 @@ class NotebookReconciler:
         # direct apiserver reads.
         self._event_informer = None
         self._sts_informer = None
+        self._node_informer = None
         registry = registry or global_registry
         # Metric names match the reference (pkg/metrics/metrics.go:14-62) so
         # dashboards/alerts carry over.
@@ -205,8 +220,10 @@ class NotebookReconciler:
             await self._ensure(nb, self.generate_network_policy(nb, tpu))
 
         await self._ensure_pipeline_rbac(nb)
-        requeue = await self._restart_broken_slice(nb, ms)
-        await self._mirror_events(nb)
+        pods = await self._worker_pods(nb)  # one LIST, shared by the tail
+        requeue = await self._restart_broken_slice(nb, ms, pods)
+        await self._check_maintenance(nb, pods)
+        await self._mirror_events(nb, pods)
         await self._update_status(nb, ms)
         return requeue
 
@@ -706,7 +723,9 @@ class NotebookReconciler:
             label_selector={"matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name_of(nb)}},
         )
 
-    async def _restart_broken_slice(self, nb: dict, ms) -> Result | None:
+    async def _restart_broken_slice(
+        self, nb: dict, ms, pods: list[dict] | None = None
+    ) -> Result | None:
         """All-or-nothing slice recovery (the hard part the reference never
         faced with single-pod notebooks, SURVEY.md §7.5): one dead worker
         breaks the whole ICI mesh, so every worker restarts together. In
@@ -727,9 +746,21 @@ class NotebookReconciler:
             return None
         total_hosts = ms.total_hosts
         ns, name = namespace_of(nb), name_of(nb)
-        pods = await self._worker_pods(nb)
+        if pods is None:
+            pods = await self._worker_pods(nb)
         main_name = _main_container_name(nb)
-        broken = [p for p in pods if _worker_is_broken(p, main_name)]
+        # A disrupted-but-still-running worker (spot preemption, node
+        # drain) dooms the slice just as surely as a crashed one: restart
+        # all workers now so the replacement gang schedules together
+        # instead of limping until the kubelet finishes the eviction.
+        disrupted = {
+            name_of(p): reason for p in pods
+            if (reason := _pod_disruption(p)) is not None
+        }
+        broken = [
+            p for p in pods
+            if name_of(p) in disrupted or _worker_is_broken(p, main_name)
+        ]
         annotations = annotations_of(nb)
         try:  # annotations are user-writable; garbage must not wedge reconcile
             attempts = int(annotations.get(SLICE_RESTART_ATTEMPTS_ANNOTATION) or 0)
@@ -765,11 +796,24 @@ class NotebookReconciler:
                 return Result(requeue_after=remaining)
 
         names = ", ".join(sorted(name_of(p) for p in broken))
+        if disrupted:
+            why = ", ".join(
+                f"{n} ({r})" for n, r in sorted(disrupted.items()))
+            detail = f"Worker(s) {why} disrupted"
+            # Don't let a concurrent crash hide behind the preemption:
+            # name the workers that failed on their own too.
+            crashed = sorted(
+                name_of(p) for p in broken if name_of(p) not in disrupted)
+            if crashed:
+                detail += f"; worker(s) {', '.join(crashed)} failed"
+            reason = "SlicePreempted"
+        else:
+            reason, detail = "SliceRestart", f"Worker(s) {names} failed"
         await self.recorder.event(
             nb,
             "Warning",
-            "SliceRestart",
-            f"Worker(s) {names} failed; restarting all {total_hosts} workers "
+            reason,
+            f"{detail}; restarting all {total_hosts} workers "
             f"(TPU slices restart atomically; attempt {attempts + 1})",
         )
         await self.kube.patch(
@@ -785,16 +829,79 @@ class NotebookReconciler:
                 pass
         return None
 
+    async def _check_maintenance(
+        self, nb: dict, pods: list[dict] | None = None
+    ) -> None:
+        """Mirror impending node maintenance onto the CR. TPU hosts get a
+        taint (NotebookOptions.maintenance_taints; GKE graceful node
+        termination) ahead of a maintenance event — the one advance
+        warning a slice gets before it goes down. The controller stamps
+        the affected node list into MAINTENANCE_ANNOTATION and emits a
+        Warning event, so the UI (and in-notebook tooling watching its
+        own CR) can checkpoint to the workspace PVC / GCS while the
+        workers are still up. No reference counterpart: single-pod CUDA
+        notebooks never had a gang to lose (SURVEY.md §7.5 failure
+        semantics)."""
+        if not self.opts.maintenance_taints:
+            return
+        if pods is None:
+            pods = await self._worker_pods(nb)
+        node_names = {deep_get(p, "spec", "nodeName") for p in pods}
+        node_names.discard(None)
+        if not node_names:
+            # No scheduled workers right now (slice restarting, stopped,
+            # or pods still Pending) — hold the last-known state rather
+            # than emitting a false MaintenanceCleared while the taint
+            # may still be there; the next reconcile with placed pods
+            # recomputes it.
+            return
+        if self._node_informer is not None:
+            nodes = self._node_informer.items()
+        else:  # bare-reconciler unit tests without a manager
+            nodes = await self.kube.list("Node")
+        pending = sorted(
+            name_of(n) for n in nodes
+            if name_of(n) in node_names and any(
+                t.get("key") in self.opts.maintenance_taints
+                for t in deep_get(n, "spec", "taints", default=[])
+            )
+        )
+        current = annotations_of(nb).get(MAINTENANCE_ANNOTATION)
+        want = ",".join(pending) if pending else None
+        if want == current:
+            return
+        await self.kube.patch(
+            "Notebook", name_of(nb),
+            {"metadata": {"annotations": {MAINTENANCE_ANNOTATION: want}}},
+            namespace_of(nb),
+        )
+        if want:
+            await self.recorder.event(
+                nb, "Warning", "MaintenancePending",
+                f"Node(s) {want} hosting this notebook's TPU workers are "
+                "scheduled for maintenance; checkpoint now — the slice "
+                "restarts when they go down",
+            )
+        else:
+            await self.recorder.event(
+                nb, "Normal", "MaintenanceCleared",
+                "Impending-maintenance taints cleared from all worker nodes",
+            )
+
     # ---- status ----------------------------------------------------------------
 
-    async def _mirror_events(self, nb: dict) -> None:
+    async def _mirror_events(
+        self, nb: dict, worker_pods: list[dict] | None = None
+    ) -> None:
         """Re-emit worker pod events onto the CR so the UI can surface them
         (reference: notebook_controller.go:94-123 event mapping — that
         design is watch-driven, and so is this one: the manager's Event
         informer feeds both the reconcile queue and this cache, so status
         churn costs zero apiserver LISTs per reconcile)."""
         ns, name = namespace_of(nb), name_of(nb)
-        pods = {name_of(p) for p in await self._worker_pods(nb)}
+        if worker_pods is None:
+            worker_pods = await self._worker_pods(nb)
+        pods = {name_of(p) for p in worker_pods}
         if self._event_informer is not None:
             events = [e for e in self._event_informer.items()
                       if namespace_of(e) == ns]
@@ -889,6 +996,19 @@ def _main_container_name(nb: dict) -> str:
     PodSpec by the reference contract, falling back to the CR name."""
     containers = deep_get(nb, "spec", "template", "spec", "containers", default=[])
     return (containers[0].get("name") if containers else None) or name_of(nb)
+
+
+def _pod_disruption(pod: dict) -> str | None:
+    """Classify a worker that is going away through no fault of its own:
+    kubelet/scheduler/taint-manager set a ``DisruptionTarget`` condition
+    (reason PreemptionByScheduler, DeletionByTaintManager,
+    EvictionByEvictionAPI, TerminationByKubelet) on such pods. This is the
+    upstream, vendor-neutral signal, so spot-TPU preemptions on GKE and
+    plain node drains classify identically. Returns the reason, or None."""
+    for c in deep_get(pod, "status", "conditions", default=[]):
+        if c.get("type") == "DisruptionTarget" and c.get("status") == "True":
+            return c.get("reason") or "Disrupted"
+    return None
 
 
 def _worker_is_broken(pod: dict, main_container: str) -> bool:
@@ -1003,6 +1123,37 @@ def setup_notebook_controller(
     # way).
     rec._event_informer = mgr.informer_for("Event")
     rec._sts_informer = mgr.informer_for("StatefulSet")
+    if rec.opts.maintenance_taints:
+        # Maintenance taints land on Nodes, not on anything the Notebook
+        # owns — watch Nodes and re-enqueue the notebooks whose workers
+        # run there (resolved from the Pod informer cache, zero LISTs).
+        # Nodes churn constantly (status heartbeats, label updates), so
+        # the handler keys on the *maintenance-taint set* changing — every
+        # other Node event is dropped without touching the Pod cache.
+        rec._node_informer = mgr.informer_for("Node")
+        pod_informer = mgr.informer_for("Pod")
+        watched = frozenset(rec.opts.maintenance_taints)
+        last_taints: dict[str, frozenset] = {}
+
+        def node_handler(event: str, node: dict) -> None:
+            node_name = name_of(node)
+            if event == "DELETED":
+                now = frozenset()
+                last_taints.pop(node_name, None)
+            else:
+                now = watched & {
+                    t.get("key")
+                    for t in deep_get(node, "spec", "taints", default=[])
+                }
+                if last_taints.get(node_name, frozenset()) == now:
+                    return
+                last_taints[node_name] = now
+            for pod in pod_informer.items():
+                if deep_get(pod, "spec", "nodeName") == node_name:
+                    for key in pod_to_notebook(pod):
+                        mgr.enqueue("notebook", key)
+
+        rec._node_informer.add_handler(node_handler)
     if rec.opts.pipeline_access_role:
         # A pipelines Role appearing AFTER notebooks exist must still get
         # bindings (the probe cache alone would leave idle notebooks

@@ -626,7 +626,10 @@ def install(interp: Interpreter) -> None:
         elif isinstance(args[0], str):
             ms = date_parse(undefined, args)
         else:
-            ms = float(args[0])
+            try:  # non-numeric (undefined/null/objects) → Invalid Date
+                ms = float(args[0])
+            except (TypeError, ValueError):
+                ms = math.nan
         obj = JSObject()
         obj.class_name = "Date"
         if math.isnan(ms):

@@ -40,13 +40,17 @@ class PodSimulator:
         start_latency: float = 0.0,
         failure_injector=None,
     ):
-        """``failure_injector(pod) -> None | "fail" | "crash" | "crash:<ctr>"``
-        — fault injection the reference never had (SURVEY.md §5 "No fault
-        injection framework"): "fail" leaves the pod phase=Failed
-        (scheduling/image errors); "crash" marks one in-place restart of
-        every container (the signal the slice-atomic restart logic keys
-        on); "crash:<name>" restarts only the named container (e.g. a
-        sidecar), leaving the rest healthy."""
+        """``failure_injector(pod) -> None | "fail" | "crash" | "crash:<ctr>"
+        | "disrupt" | "disrupt:<reason>"`` — fault injection the reference
+        never had (SURVEY.md §5 "No fault injection framework"): "fail"
+        leaves the pod phase=Failed (scheduling/image errors); "crash"
+        marks one in-place restart of every container (the signal the
+        slice-atomic restart logic keys on); "crash:<name>" restarts only
+        the named container (e.g. a sidecar), leaving the rest healthy;
+        "disrupt" brings the pod up healthy but stamped with a
+        DisruptionTarget=True condition (default reason
+        PreemptionByScheduler) — a spot preemption / node drain in
+        flight, containers still running."""
         self.kube = kube
         self.start_latency = start_latency
         self.failure_injector = failure_injector
@@ -227,6 +231,22 @@ class PodSimulator:
             except NotFound:
                 pass
             return
+        disrupt_reason = None
+        if fault == "disrupt" or (
+            isinstance(fault, str) and fault.startswith("disrupt:")
+        ):
+            disrupt_reason = (
+                fault.split(":", 1)[1] if ":" in fault
+                else "PreemptionByScheduler"
+            )
+        conditions = [{"type": "Ready", "status": "True"}]
+        if disrupt_reason:
+            conditions.append({
+                "type": "DisruptionTarget",
+                "status": "True",
+                "reason": disrupt_reason,
+                "message": "injected disruption",
+            })
         try:
             await self.kube.patch(
                 "Pod",
@@ -235,7 +255,7 @@ class PodSimulator:
                     "status": {
                         "phase": "Running",
                         "podIP": _fake_pod_ip(name),
-                        "conditions": [{"type": "Ready", "status": "True"}],
+                        "conditions": conditions,
                         "containerStatuses": [
                             {
                                 "name": c.get("name", "main"),

@@ -87,6 +87,15 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
         return Status(TERMINATING, "Deleting this Notebook Server.")
 
     if ready >= want_hosts and ready > 0:
+        # Impending node maintenance (controller-mirrored taint): the
+        # server is still up — say so, but tell the user to checkpoint.
+        pending = annotations.get(nbapi.MAINTENANCE_ANNOTATION)
+        if pending:
+            return Status(
+                READY,
+                f"Running — node maintenance pending on {pending}; "
+                "checkpoint your work",
+            )
         if want_hosts > 1:
             return Status(READY, f"Running ({ready}/{want_hosts} TPU workers)")
         return Status(READY, "Running")

@@ -14,6 +14,7 @@ from kubeflow_tpu.controllers.culling import (
 )
 from kubeflow_tpu.controllers.notebook import setup_notebook_controller
 from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import Registry
 from kubeflow_tpu.runtime.objects import deep_get, get_meta
 from kubeflow_tpu.testing.fakekube import FakeKube
 from kubeflow_tpu.testing.podsim import PodSimulator
@@ -180,7 +181,10 @@ async def test_culled_slice_scales_to_zero_end_to_end():
     worker pods deleted, chips metric incremented."""
     kube = FakeKube()
     register_all(kube)
-    mgr = Manager(kube)
+    # Fresh registry: the chips-culled counter must not accumulate counts
+    # leaked by other test modules through the process-wide registry (the
+    # assertion below is order-sensitive otherwise).
+    mgr = Manager(kube, registry=Registry())
     setup_notebook_controller(mgr)
     clock = FakeClock()
     prober = make_prober({"kernels": [], "terminals": []})

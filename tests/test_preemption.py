@@ -1,0 +1,154 @@
+"""Preemption + impending-maintenance handling for TPU slices.
+
+TPU capacity is preemptible (spot) and maintenance events take whole
+hosts down — failure classes the reference's single-pod CUDA notebooks
+never modeled. Two signals, two behaviors:
+
+- A worker pod stamped ``DisruptionTarget=True`` (the upstream
+  kubelet/scheduler eviction-classification condition) dooms the slice →
+  slice-atomic restart, classified ``SlicePreempted`` instead of
+  ``SliceRestart`` so operators can tell capacity loss from app crashes.
+- A node hosting workers tainted with
+  ``cloud.google.com/impending-node-termination`` (GKE graceful node
+  termination) → the controller mirrors the node list into the
+  ``notebooks.kubeflow.org/maintenance-pending`` annotation + a Warning
+  event, and the status machine tells the user to checkpoint while the
+  workers are still up.
+"""
+
+import asyncio
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get, name_of
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.web.common.status import process_status
+from kubeflow_tpu.webhooks import register_all
+
+TAINT = "cloud.google.com/impending-node-termination"
+
+
+class Harness:
+    def __init__(self, injector=None):
+        self.kube = FakeKube()
+        register_all(self.kube)
+        self.mgr = Manager(self.kube)
+        setup_notebook_controller(self.mgr)
+        self.sim = PodSimulator(self.kube, failure_injector=injector)
+
+    async def __aenter__(self):
+        await self.mgr.start()
+        await self.sim.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.sim.stop()
+        await self.mgr.stop()
+        self.kube.close_watches()
+
+    async def settle(self, rounds=8):
+        for _ in range(rounds):
+            await self.mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.02)
+
+
+async def test_disrupted_worker_restarts_slice_as_preempted():
+    # Disrupt worker-1 exactly once (the recreated gang comes up clean —
+    # a real spot preemption doesn't follow the replacement pods around).
+    hits = []
+
+    def injector(pod):
+        if name_of(pod) == "spot-1" and not hits:
+            hits.append(1)
+            return "disrupt"
+        return None
+
+    async with Harness(injector) as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("spot", "ns", accelerator="v5e",
+                                  topology="4x4"))
+        await h.settle(12)
+
+        events = await h.kube.list("Event", "ns")
+        preempted = [e for e in events if e.get("reason") == "SlicePreempted"]
+        assert preempted, [e.get("reason") for e in events]
+        assert "PreemptionByScheduler" in preempted[0]["message"]
+        # Atomic: the whole gang restarts, not just the disrupted worker.
+        assert "all 2 workers" in preempted[0]["message"]
+        # The replacement gang converged back to Ready.
+        nb = await h.kube.get("Notebook", "spot", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+        # Crash-class restarts were NOT logged for a capacity event.
+        assert not any(e.get("reason") == "SliceRestart" for e in events)
+
+
+async def test_maintenance_taint_mirrors_annotation_and_clears():
+    async with Harness() as h:
+        await h.kube.create("Node", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "tpu-node-a"},
+            "spec": {},
+        })
+        await h.kube.create(
+            "Notebook", nbapi.new("maint", "ns", accelerator="v5e",
+                                  topology="4x4"))
+        await h.settle()
+        # Place worker-0 on the node (the sim doesn't schedule).
+        await h.kube.patch(
+            "Pod", "maint-0", {"spec": {"nodeName": "tpu-node-a"}}, "ns")
+        await h.settle()
+        nb = await h.kube.get("Notebook", "maint", "ns")
+        assert nbapi.MAINTENANCE_ANNOTATION not in (
+            nb["metadata"].get("annotations") or {})
+
+        # GKE graceful node termination taints the node ahead of the event.
+        await h.kube.patch(
+            "Node", "tpu-node-a",
+            {"spec": {"taints": [
+                {"key": TAINT, "effect": "NoSchedule"}]}})
+        await h.settle()
+
+        nb = await h.kube.get("Notebook", "maint", "ns")
+        anns = nb["metadata"].get("annotations") or {}
+        assert anns.get(nbapi.MAINTENANCE_ANNOTATION) == "tpu-node-a"
+        events = await h.kube.list("Event", "ns")
+        warn = [e for e in events if e.get("reason") == "MaintenancePending"]
+        assert warn and "tpu-node-a" in warn[0]["message"]
+        assert "checkpoint" in warn[0]["message"]
+        # Status machine: still ready, but the message says checkpoint.
+        status = process_status(nb)
+        assert status.phase == "ready"
+        assert "maintenance pending on tpu-node-a" in status.message
+
+        # Maintenance done — taint removed; the mirror clears.
+        await h.kube.patch("Node", "tpu-node-a", {"spec": {"taints": []}})
+        await h.settle()
+        nb = await h.kube.get("Notebook", "maint", "ns")
+        anns = nb["metadata"].get("annotations") or {}
+        assert not anns.get(nbapi.MAINTENANCE_ANNOTATION)
+        events = await h.kube.list("Event", "ns")
+        assert any(e.get("reason") == "MaintenanceCleared" for e in events)
+        assert process_status(nb).message.startswith("Running")
+
+
+async def test_untainted_nodes_do_not_annotate():
+    async with Harness() as h:
+        await h.kube.create("Node", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "fine-node"},
+            "spec": {"taints": [{"key": "some-other-taint",
+                                 "effect": "NoSchedule"}]},
+        })
+        await h.kube.create("Notebook", nbapi.new("calm", "ns"))
+        await h.settle()
+        await h.kube.patch(
+            "Pod", "calm-0", {"spec": {"nodeName": "fine-node"}}, "ns")
+        await h.settle()
+        nb = await h.kube.get("Notebook", "calm", "ns")
+        assert nbapi.MAINTENANCE_ANNOTATION not in (
+            nb["metadata"].get("annotations") or {})
+        events = await h.kube.list("Event", "ns")
+        assert not any(
+            e.get("reason") == "MaintenancePending" for e in events)
