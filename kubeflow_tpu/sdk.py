@@ -1,0 +1,277 @@
+"""In-notebook client: slice introspection, distributed bootstrap, and
+preemption-aware checkpointing.
+
+Everything a notebook needs to act on the control plane's TPU wiring,
+with zero configuration — every input is env the controller/webhook
+injected (tpu/topology.py worker_env + webhooks/tpu.py per-ordinal
+patch) or in-cluster credentials the pod already has:
+
+    from kubeflow_tpu import sdk
+
+    info = sdk.SliceInfo.from_env()       # who am I in the slice?
+    sdk.initialize_distributed()          # jax.distributed from env
+
+    mgr = sdk.CheckpointManager("gs://bucket/run7",
+                                save_interval_steps=100)
+    guard = sdk.CheckpointGuard(mgr)
+    for step in range(start, n_steps):
+        params, loss = train_step(params, batch)
+        guard.step(step, params)          # scheduled saves (the manager's
+                                          # cadence) + an immediate save
+                                          # when the controller flags
+                                          # impending node maintenance
+
+The maintenance signal is the ``notebooks.kubeflow.org/maintenance-pending``
+annotation the notebook controller mirrors from GKE's
+impending-node-termination taints (controllers/notebook.py
+_check_maintenance) — the notebook reads its *own* CR through the
+in-cluster apiserver, a GET the profile controller's RBAC already allows
+(default-editor can read notebooks in its namespace).
+
+The reference has no counterpart: its notebooks are single pods whose
+death loses nothing but kernel state (SURVEY.md §5 checkpoint/resume is
+PVC persistence alone). A TPU slice loses a training run.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass
+
+from kubeflow_tpu.api.notebook import MAINTENANCE_ANNOTATION
+from kubeflow_tpu.utils.checkpoint import CheckpointManager
+
+__all__ = [
+    "CheckpointGuard",
+    "CheckpointManager",
+    "MaintenanceWatcher",
+    "SliceInfo",
+    "initialize_distributed",
+]
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+_log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class SliceInfo:
+    """This worker's place in the slice/multislice, parsed from the env
+    contract in tpu/topology.py worker_env / MultiSlice.worker_env."""
+
+    worker_id: int
+    num_workers: int
+    hostnames: tuple[str, ...]
+    process_id: int
+    num_processes: int
+    coordinator_address: str | None
+    slice_id: int
+    num_slices: int
+    accelerator_type: str | None
+    topology: str | None
+    namespace: str | None
+    notebook: str | None
+
+    @classmethod
+    def from_env(cls, environ=os.environ) -> "SliceInfo":
+        hostnames = tuple(
+            h for h in (environ.get("TPU_WORKER_HOSTNAMES") or "").split(",")
+            if h
+        )
+        ns = name = None
+        prefix = environ.get("NB_PREFIX") or ""
+        parts = prefix.strip("/").split("/")
+        if len(parts) == 3 and parts[0] == "notebook":
+            ns, name = parts[1], parts[2]
+        worker_id = int(environ.get("TPU_WORKER_ID") or 0)
+        return cls(
+            worker_id=worker_id,
+            num_workers=max(len(hostnames), 1),
+            hostnames=hostnames,
+            process_id=int(environ.get("JAX_PROCESS_ID") or worker_id),
+            num_processes=int(
+                environ.get("JAX_NUM_PROCESSES") or max(len(hostnames), 1)),
+            coordinator_address=environ.get("JAX_COORDINATOR_ADDRESS"),
+            slice_id=int(environ.get("MEGASCALE_SLICE_ID") or 0),
+            num_slices=int(environ.get("MEGASCALE_NUM_SLICES") or 1),
+            accelerator_type=environ.get("TPU_ACCELERATOR_TYPE"),
+            topology=environ.get("TPU_TOPOLOGY"),
+            namespace=ns,
+            notebook=name,
+        )
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def initialize_distributed(environ=os.environ) -> bool:
+    """``jax.distributed.initialize`` from the injected env. Returns True
+    when a multi-process world was initialized, False for the single-host
+    no-op (so the same notebook code runs on a v5e-4 and a v5p-128).
+    Idempotent: a second call is a no-op."""
+    info = SliceInfo.from_env(environ)
+    if info.num_processes <= 1 or not info.coordinator_address:
+        return False
+    import jax
+
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return True  # already initialized
+    jax.distributed.initialize(
+        coordinator_address=info.coordinator_address,
+        num_processes=info.num_processes,
+        process_id=info.process_id,
+    )
+    return True
+
+
+def _in_cluster_fetch(namespace: str, name: str):
+    """Build a () -> annotations fetcher reading this notebook's CR via the
+    in-cluster apiserver with the pod's ServiceAccount (stdlib-only — a
+    notebook image need not carry an HTTP client library)."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    url = (f"https://{host}:{port}/apis/kubeflow.org/v1"
+           f"/namespaces/{namespace}/notebooks/{name}")
+    ctx = ssl.create_default_context(cafile=os.path.join(_SA_DIR, "ca.crt"))
+
+    def fetch() -> dict:
+        with open(os.path.join(_SA_DIR, "token")) as f:
+            token = f.read().strip()
+        req = urllib.request.Request(
+            url, headers={"Authorization": f"Bearer {token}"})
+        with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+            obj = json.loads(resp.read())
+        return (obj.get("metadata") or {}).get("annotations") or {}
+
+    return fetch
+
+
+class MaintenanceWatcher:
+    """Polls this notebook's CR for the controller's maintenance-pending
+    annotation. ``check()`` for in-loop use (CheckpointGuard), or
+    ``start(callback)`` for a daemon thread that fires once per
+    pending-transition with the affected node list."""
+
+    def __init__(self, fetch=None, *, interval: float = 30.0,
+                 environ=os.environ):
+        if fetch is None:
+            info = SliceInfo.from_env(environ)
+            if not (info.namespace and info.notebook):
+                raise ValueError(
+                    "not running under the controller (no NB_PREFIX); "
+                    "pass fetch= explicitly")
+            fetch = _in_cluster_fetch(info.namespace, info.notebook)
+        self._fetch = fetch
+        self.interval = interval
+        self._last: str | None = None
+        self._last_at = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def check(self, *, max_age: float | None = None) -> str | None:
+        """Current pending-node list ("" semantics: None = clear). Rate
+        limited to one apiserver GET per ``interval`` (or ``max_age``);
+        between polls the cached answer is returned — cheap enough for a
+        per-training-step call."""
+        age_limit = self.interval if max_age is None else max_age
+        now = time.monotonic()
+        if now - self._last_at >= age_limit:
+            self._last_at = now
+            try:
+                self._last = self._fetch().get(MAINTENANCE_ANNOTATION) or None
+            except Exception:  # noqa: BLE001 — a flaky apiserver read must
+                pass           # not take down the training loop
+        return self._last
+
+    def start(self, callback) -> None:
+        """callback(nodes: str) fires once each time maintenance becomes
+        pending (not per poll). A callback exception is logged, not
+        fatal — the watcher keeps watching (same policy as check()'s
+        fetch errors). start() after stop() resumes watching."""
+        self._stop = threading.Event()  # restartable after stop()
+
+        def loop():
+            armed = True
+            while not self._stop.wait(self.interval):
+                pending = self.check(max_age=0.0)
+                if pending and armed:
+                    armed = False
+                    try:
+                        callback(pending)
+                    except Exception:  # noqa: BLE001
+                        _log.exception(
+                            "maintenance callback failed; still watching")
+                elif not pending:
+                    armed = True
+
+        self._thread = threading.Thread(
+            target=loop, name="kftpu-maintenance-watch", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class CheckpointGuard:
+    """Checkpoint on the manager's schedule — and immediately when the
+    control plane says the slice is about to lose a node.
+
+    Wraps utils/checkpoint.CheckpointManager: ``step()`` defers scheduled
+    saves to the manager (its ``save_interval_steps`` is the one cadence
+    knob), and forces an out-of-schedule save (then blocks until it
+    commits) the first time the maintenance annotation appears. One
+    forced save per pending-transition — a long maintenance window
+    doesn't re-save every step.
+
+    **Multi-host:** an Orbax save is a collective — every process must
+    save the *same* step. Per-worker watchers poll on their own clocks,
+    so the pending decision is made by process 0 alone and broadcast to
+    the others (``broadcast_one_to_all``) every ``sync_every_steps``
+    steps. Call ``step()`` from every process with the same step number
+    (the normal SPMD loop); the collective only runs on sync steps, so
+    its cost amortizes. Single-process worlds skip the collective
+    entirely."""
+
+    def __init__(self, manager: CheckpointManager,
+                 watcher: MaintenanceWatcher | None = None, *,
+                 sync_every_steps: int = 16, environ=os.environ):
+        self.manager = manager
+        self.watcher = watcher or MaintenanceWatcher(environ=environ)
+        self.sync_every_steps = max(1, sync_every_steps)
+        self._armed = True
+
+    def _pending_coordinated(self) -> bool:
+        """Process 0's watcher verdict, agreed on by every process."""
+        import jax
+
+        if jax.process_count() == 1:
+            return bool(self.watcher.check())
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        local = 0
+        if jax.process_index() == 0:
+            local = 1 if self.watcher.check() else 0
+        flag = multihost_utils.broadcast_one_to_all(np.int32(local))
+        return bool(int(flag))
+
+    def step(self, step: int, pytree) -> bool:
+        if step % self.sync_every_steps == 0:
+            if self._pending_coordinated():
+                if self._armed:
+                    self._armed = False
+                    saved = self.manager.save(step, pytree, force=True)
+                    self.manager.wait()  # commit before the node goes away
+                    return saved
+            else:
+                self._armed = True
+        return self.manager.save(step, pytree)

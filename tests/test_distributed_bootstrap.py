@@ -127,3 +127,62 @@ def test_multislice_global_process_space_bootstraps():
     for out in _communicate_all(procs):
         # 4 global processes: psum of (rank+1) = 1+2+3+4 = 10 everywhere.
         assert "PSUM_RESULT 10.0 NPROC 4" in out, out
+
+
+GUARD_WORKER = r"""
+import os, sys, tempfile
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+    num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+    process_id=int(os.environ["JAX_PROCESS_ID"]),
+)
+import numpy as np
+from kubeflow_tpu import sdk
+from kubeflow_tpu.api.notebook import MAINTENANCE_ANNOTATION
+
+# Only process 0's watcher ever sees the annotation — the coordination
+# broadcast must still make every process force-save the same step.
+def fetch():
+    if jax.process_index() == 0:
+        return {MAINTENANCE_ANNOTATION: "tpu-node-a"}
+    raise AssertionError("non-coordinator polled the apiserver")
+
+ckpt_dir = os.environ["GUARD_CKPT_DIR"]
+with sdk.CheckpointManager(ckpt_dir, save_interval_steps=10_000) as mgr:
+    guard = sdk.CheckpointGuard(
+        mgr, sdk.MaintenanceWatcher(fetch=fetch, interval=0.0),
+        sync_every_steps=4)
+    tree = {"w": np.full(4, float(jax.process_index()), np.float32)}
+    guard.step(1, tree)                   # orbax saves the first step seen
+    assert guard.step(3, tree) is False   # off-sync: no poll anywhere
+    assert guard.step(4, tree) is True    # sync step: all force-save 4
+    assert mgr.latest_step() == 4
+print("GUARD_SAVED_STEP", 4, "PID", jax.process_index())
+"""
+
+
+def test_checkpoint_guard_coordinates_forced_save_across_processes(tmp_path):
+    """The multi-host contract of CheckpointGuard: process 0 observes the
+    maintenance flag, the broadcast makes BOTH processes force-save the
+    same step, and the collective Orbax save commits. Would hang (save
+    barrier) or fail latest_step() if the decision were per-process."""
+    tpu = TpuSlice.parse("v5e", "4x4")
+    port = _free_port()
+    procs = []
+    for i in range(tpu.num_hosts):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        env.update(tpu.worker_env(i, ["localhost", "localhost"]))
+        env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+        env["GUARD_CKPT_DIR"] = str(tmp_path)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", GUARD_WORKER], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    for out in _communicate_all(procs):
+        assert "GUARD_SAVED_STEP 4" in out, out

@@ -1,0 +1,255 @@
+"""In-notebook SDK: slice introspection, maintenance watching, and
+preemption-aware checkpointing (kubeflow_tpu/sdk.py).
+
+Closes the loop the controller's maintenance mirror opens
+(tests/test_preemption.py): the annotation it stamps is what
+MaintenanceWatcher polls and CheckpointGuard acts on.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu import sdk
+from kubeflow_tpu.api.notebook import MAINTENANCE_ANNOTATION
+
+WORKER_ENV = {
+    "TPU_WORKER_ID": "1",
+    "TPU_WORKER_HOSTNAMES": "nb-0.nb-workers.team,nb-1.nb-workers.team",
+    "TPU_ACCELERATOR_TYPE": "v5litepod-16",
+    "TPU_TOPOLOGY": "4x4",
+    "JAX_COORDINATOR_ADDRESS": "nb-0.nb-workers.team:8476",
+    "JAX_NUM_PROCESSES": "2",
+    "JAX_PROCESS_ID": "1",
+    "NB_PREFIX": "/notebook/team/nb",
+}
+
+
+def test_slice_info_from_env():
+    info = sdk.SliceInfo.from_env(WORKER_ENV)
+    assert info.worker_id == 1
+    assert info.num_workers == 2
+    assert info.hostnames[0] == "nb-0.nb-workers.team"
+    assert info.process_id == 1 and info.num_processes == 2
+    assert info.coordinator_address == "nb-0.nb-workers.team:8476"
+    assert (info.namespace, info.notebook) == ("team", "nb")
+    assert not info.is_coordinator
+    assert info.slice_id == 0 and info.num_slices == 1
+
+
+def test_slice_info_multislice_env():
+    env = dict(WORKER_ENV, MEGASCALE_SLICE_ID="1", MEGASCALE_NUM_SLICES="2",
+               JAX_PROCESS_ID="3", JAX_NUM_PROCESSES="4")
+    info = sdk.SliceInfo.from_env(env)
+    assert info.slice_id == 1 and info.num_slices == 2
+    assert info.process_id == 3 and info.num_processes == 4
+
+
+def test_slice_info_single_host_defaults():
+    info = sdk.SliceInfo.from_env({})
+    assert info.worker_id == 0
+    assert info.num_workers == 1 and info.num_processes == 1
+    assert info.coordinator_address is None
+    assert info.namespace is None and info.notebook is None
+    assert info.is_coordinator
+
+
+def test_initialize_distributed_is_noop_single_host():
+    # No coordinator env → False without touching jax.distributed.
+    assert sdk.initialize_distributed({}) is False
+    assert sdk.initialize_distributed({"JAX_NUM_PROCESSES": "1"}) is False
+
+
+def test_watcher_requires_identity_or_fetch():
+    with pytest.raises(ValueError, match="NB_PREFIX"):
+        sdk.MaintenanceWatcher(environ={})
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_watcher(annotations_ref, interval=30.0):
+    w = sdk.MaintenanceWatcher(
+        fetch=lambda: dict(annotations_ref), interval=interval)
+    return w
+
+
+def test_watcher_check_rate_limits_and_tracks_transitions(monkeypatch):
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    calls = []
+    ann = {}
+
+    def fetch():
+        calls.append(1)
+        return dict(ann)
+
+    w = sdk.MaintenanceWatcher(fetch=fetch, interval=30.0)
+    clock.t = 100.0
+    assert w.check() is None
+    assert len(calls) == 1
+    # Within the interval: cached, no second GET.
+    clock.t = 110.0
+    ann[MAINTENANCE_ANNOTATION] = "tpu-node-a"
+    assert w.check() is None
+    assert len(calls) == 1
+    # Past the interval: sees the pending nodes.
+    clock.t = 131.0
+    assert w.check() == "tpu-node-a"
+    # Cleared upstream → cleared here on the next poll.
+    del ann[MAINTENANCE_ANNOTATION]
+    clock.t = 162.0
+    assert w.check() is None
+
+
+def test_watcher_survives_fetch_errors(monkeypatch):
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    state = {"fail": False, "ann": {MAINTENANCE_ANNOTATION: "n1"}}
+
+    def fetch():
+        if state["fail"]:
+            raise OSError("apiserver flake")
+        return dict(state["ann"])
+
+    w = sdk.MaintenanceWatcher(fetch=fetch, interval=10.0)
+    clock.t = 10.0
+    assert w.check() == "n1"
+    state["fail"] = True
+    clock.t = 21.0
+    # The flake is swallowed; the last-known answer stands.
+    assert w.check() == "n1"
+
+
+class FakeManager:
+    """Models utils/checkpoint.CheckpointManager's contract: scheduling
+    lives in the manager (Orbax save_interval_steps); force overrides."""
+
+    def __init__(self, interval=5):
+        self.interval = interval
+        self.saves = []
+        self.waits = 0
+
+    def save(self, step, pytree, *, force=False):
+        due = force or step % self.interval == 0
+        if due:
+            self.saves.append((step, force))
+        return due
+
+    def wait(self):
+        self.waits += 1
+
+
+def test_checkpoint_guard_forces_one_save_per_pending_window(monkeypatch):
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    ann = {}
+    mgr = FakeManager(interval=5)
+    guard = sdk.CheckpointGuard(
+        mgr, make_watcher(ann, interval=0.0), sync_every_steps=1)
+
+    assert guard.step(0, {}) is True          # manager's schedule
+    assert guard.step(1, {}) is False
+    ann[MAINTENANCE_ANNOTATION] = "tpu-node-a"
+    clock.t = 1.0
+    assert guard.step(2, {}) is True          # forced, committed
+    assert mgr.saves[-1] == (2, True)
+    assert mgr.waits == 1
+    # Still pending: no re-force every step; scheduled cadence continues.
+    clock.t = 2.0
+    assert guard.step(3, {}) is False
+    assert guard.step(5, {}) is True
+    assert mgr.saves[-1] == (5, False)
+    # Window clears, then a new one → exactly one more forced save.
+    del ann[MAINTENANCE_ANNOTATION]
+    clock.t = 3.0
+    guard.step(6, {})
+    ann[MAINTENANCE_ANNOTATION] = "tpu-node-b"
+    clock.t = 4.0
+    assert guard.step(7, {}) is True
+    assert mgr.saves[-1] == (7, True)
+    assert mgr.waits == 2
+
+
+def test_guard_sync_cadence_defers_decision(monkeypatch):
+    """Off-sync steps never poll (no per-step collective in multi-host);
+    the forced save lands on the next sync step."""
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    calls = []
+    ann = {MAINTENANCE_ANNOTATION: "n1"}
+
+    def fetch():
+        calls.append(1)
+        return dict(ann)
+
+    mgr = FakeManager(interval=1000)
+    guard = sdk.CheckpointGuard(
+        mgr, sdk.MaintenanceWatcher(fetch=fetch, interval=0.0),
+        sync_every_steps=4)
+    clock.t = 1.0
+    assert guard.step(1, {}) is False   # off-sync: no poll, no force
+    assert guard.step(2, {}) is False
+    assert not calls
+    clock.t = 2.0
+    assert guard.step(4, {}) is True    # sync step: poll + forced save
+    assert mgr.saves == [(4, True)]
+
+
+def test_watcher_restart_after_stop():
+    fired = []
+    w = sdk.MaintenanceWatcher(
+        fetch=lambda: {MAINTENANCE_ANNOTATION: "n"}, interval=0.01)
+    w.stop()     # stop before/without start must not wedge a later start
+    w.start(lambda nodes: fired.append(nodes))
+    deadline = time.time() + 5
+    while not fired and time.time() < deadline:
+        time.sleep(0.01)
+    w.stop()
+    assert fired == ["n"]
+
+
+def test_watcher_survives_callback_exception():
+    fired = []
+    ann = {MAINTENANCE_ANNOTATION: "n1"}
+
+    def cb(nodes):
+        fired.append(nodes)
+        raise RuntimeError("user callback bug")
+
+    w = sdk.MaintenanceWatcher(fetch=lambda: dict(ann), interval=0.01)
+    w.start(cb)
+    deadline = time.time() + 5
+    while not fired and time.time() < deadline:
+        time.sleep(0.01)
+    # The thread survived the raise: clear, then a second window re-fires.
+    del ann[MAINTENANCE_ANNOTATION]
+    time.sleep(0.05)
+    ann[MAINTENANCE_ANNOTATION] = "n2"
+    deadline = time.time() + 5
+    while len(fired) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    w.stop()
+    assert fired[:2] == ["n1", "n2"]
+
+
+def test_guard_end_to_end_with_orbax(tmp_path):
+    """Real CheckpointManager under the guard: the forced save lands on
+    disk and restores bit-exact."""
+    import numpy as np
+
+    ann = {MAINTENANCE_ANNOTATION: "node-x"}
+    with sdk.CheckpointManager(str(tmp_path), keep=2,
+                               save_interval_steps=1000) as mgr:
+        guard = sdk.CheckpointGuard(
+            mgr, make_watcher(ann, interval=0.0), sync_every_steps=1)
+        tree = {"w": np.arange(8, dtype=np.float32)}
+        assert guard.step(7, tree) is True    # forced by maintenance
+        assert mgr.latest_step() == 7
+        got = mgr.restore(7)
+        np.testing.assert_array_equal(got["w"], tree["w"])
