@@ -1,0 +1,262 @@
+"""Input pipeline: deterministic sharded loading with device prefetch.
+
+The missing third of the in-notebook training story (models/trainer.py is
+the loop, utils/checkpoint.py the persistence; this feeds them). TPU
+steps are short — a v5e chip finishes a 200ms train step while a naive
+Python loader is still indexing — so the loader's job is to keep host
+work off the step's critical path:
+
+- **Deterministic sharding**: one global seeded permutation per epoch;
+  process ``p`` of ``P`` takes every ``P``-th batch. Every process
+  computes the same permutation locally (no coordination traffic), the
+  shards are disjoint by construction, and a given ``(seed, step)``
+  always names the same examples — which is what makes checkpoint/resume
+  exact (trainer.fit fast-forwards by step count).
+- **Static shapes**: the trailing partial batch is dropped, so every
+  batch XLA sees has the same shape — no recompiles mid-epoch.
+- **Prefetch**: a daemon thread stays ``depth`` batches ahead, so host
+  indexing/augmentation overlaps the device step (the TPU equivalent of
+  the CUDA-stream prefetch every GPU loader ships).
+- **Multi-host assembly**: ``global_batches`` wraps the per-process
+  stream with ``jax.make_array_from_process_local_data`` so each process
+  feeds only its shard yet the train step sees one global jax.Array laid
+  out on the mesh — the input-side complement of the controller's
+  ``JAX_PROCESS_ID`` wiring.
+
+Reference parity note: the reference has no data path at all (it is a
+control plane; SURVEY.md §2.4); this module is part of the TPU data plane
+its notebooks need. The design follows the public grain/tf.data split of
+source vs sampler vs prefetch, rebuilt jax-first with stdlib threading.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+__all__ = [
+    "ArraySource",
+    "ShardedLoader",
+    "global_batches",
+    "prefetch",
+]
+
+
+class ArraySource:
+    """Index-addressable source over aligned arrays (numpy or memmap —
+    a memmapped .npy on the workspace PVC streams without loading).
+
+    ``source(idx)`` returns a tuple of ``arr[idx]`` per array."""
+
+    def __init__(self, *arrays: np.ndarray):
+        if not arrays:
+            raise ValueError("need at least one array")
+        n = len(arrays[0])
+        if any(len(a) != n for a in arrays):
+            raise ValueError("arrays must be index-aligned")
+        self.arrays = arrays
+
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+    def __call__(self, idx: np.ndarray) -> tuple:
+        return tuple(a[idx] for a in self.arrays)
+
+
+@dataclass(frozen=True)
+class _Position:
+    epoch: int
+    batch_in_epoch: int
+
+
+class ShardedLoader:
+    """Deterministic, per-process-sharded, infinitely-repeating batches.
+
+    ``source``: ``len()`` + ``(indices ndarray) -> batch`` (ArraySource or
+    any callable with those two). ``process_id``/``num_processes`` default
+    to this worker's place in the slice (sdk.SliceInfo), so the same
+    notebook code shards correctly from a v5e-4 to a multislice job.
+
+    Iteration order is a pure function of ``(seed, epoch)`` — resuming by
+    skipping ``step`` batches (trainer.fit's contract) reproduces the
+    exact stream. ``state_dict()``/``load_state_dict()`` snapshot the
+    position for loaders driven outside fit().
+    """
+
+    def __init__(self, source, batch_size: int, *, seed: int = 0,
+                 shuffle: bool = True, process_id: int | None = None,
+                 num_processes: int | None = None, transform: Callable | None = None):
+        if process_id is None or num_processes is None:
+            from kubeflow_tpu.sdk import SliceInfo
+
+            info = SliceInfo.from_env()
+            process_id = info.process_id if process_id is None else process_id
+            num_processes = (info.num_processes if num_processes is None
+                             else num_processes)
+        if not (0 <= process_id < num_processes):
+            raise ValueError(
+                f"process_id {process_id} not in [0, {num_processes})")
+        self.source = source
+        self.batch_size = batch_size
+        self.seed = seed
+        self.shuffle = shuffle
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.transform = transform
+        # Whole batches per epoch, then whole batches per process: both
+        # remainders dropped so shapes are static and shards symmetric
+        # (every process runs the same number of steps — a ragged shard
+        # would desync the collective in the train step).
+        self.batches_per_epoch = len(source) // batch_size
+        self.batches_per_process = self.batches_per_epoch // num_processes
+        if self.batches_per_process == 0:
+            raise ValueError(
+                f"{len(source)} examples < one batch per process "
+                f"({batch_size} × {num_processes})")
+        self._pos = _Position(0, 0)
+        self._order_cache: tuple[int, np.ndarray] | None = None
+
+    # -- deterministic order -----------------------------------------------------
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        if self._order_cache is not None and self._order_cache[0] == epoch:
+            return self._order_cache[1]
+        n = self.batches_per_epoch * self.batch_size
+        if not self.shuffle:
+            order = np.arange(n)
+        else:
+            rng = np.random.default_rng((self.seed, epoch))
+            order = rng.permutation(len(self.source))[:n]
+        self._order_cache = (epoch, order)
+        return order
+
+    def _batch_indices(self, epoch: int, batch_in_epoch: int) -> np.ndarray:
+        order = self._epoch_order(epoch)
+        start = batch_in_epoch * self.batch_size
+        return order[start:start + self.batch_size]
+
+    # -- iteration ----------------------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        while True:
+            epoch, b = self._pos.epoch, self._pos.batch_in_epoch
+            # Process p takes batches p, p+P, p+2P, … of the global order.
+            global_batch = self.process_id + b * self.num_processes
+            batch = self.source(self._batch_indices(epoch, global_batch))
+            if self.transform is not None:
+                batch = self.transform(batch)
+            if b + 1 >= self.batches_per_process:
+                self._pos = _Position(epoch + 1, 0)
+            else:
+                self._pos = _Position(epoch, b + 1)
+            yield batch
+
+    # -- resume -------------------------------------------------------------------
+
+    def skip(self, n_batches: int) -> None:
+        """O(1) fast-forward: position this loader exactly where a fresh
+        loader would be after yielding ``n_batches``. The resume path
+        that composes with ``prefetch`` — count the steps the *consumer*
+        ran (the trainer's step counter) and skip that many; the wrapped
+        loader's own cursor runs ahead by the prefetch depth and must not
+        be snapshotted."""
+        epoch, b = divmod(int(n_batches), self.batches_per_process)
+        self._pos = _Position(epoch, b)
+
+    def state_dict(self) -> dict:
+        """Cursor snapshot — valid only for a directly-iterated loader
+        (under ``prefetch`` the cursor includes the producer's read-ahead;
+        use ``skip`` with the consumed-step count instead)."""
+        return {"epoch": self._pos.epoch,
+                "batch_in_epoch": self._pos.batch_in_epoch}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._pos = _Position(int(state["epoch"]),
+                              int(state["batch_in_epoch"]))
+
+
+def prefetch(batches: Iterator, *, depth: int = 2,
+             to_device: Callable | None = None) -> Iterator:
+    """Run the upstream iterator ``depth`` elements ahead on a daemon
+    thread, optionally pushing each element to device (``to_device``,
+    e.g. a ``jax.device_put`` with the batch sharding) so the transfer
+    overlaps the current step. An upstream exception re-raises at the
+    consumer's ``next()``. Closing (or garbage-collecting) the returned
+    iterator stops the producer — an abandoned pipeline (re-run notebook
+    cell) releases its thread and buffered batches instead of pinning
+    them for process lifetime.
+
+    Note: the producer reads ahead, so the *upstream* iterator's position
+    runs up to ``depth + 1`` elements past what the consumer has seen —
+    snapshot resume state from consumed-step counts
+    (``ShardedLoader.skip``), not from the wrapped loader's cursor."""
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in batches:
+                if to_device is not None:
+                    item = to_device(item)
+                if not put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            put((_END, e))
+            return
+        put((_END, None))
+
+    threading.Thread(target=producer, daemon=True,
+                     name="kftpu-data-prefetch").start()
+
+    def consume():
+        try:
+            while True:
+                item = q.get()
+                if (isinstance(item, tuple) and len(item) == 2
+                        and item[0] is _END):
+                    if item[1] is not None:
+                        raise item[1]
+                    return
+                yield item
+        finally:
+            # Generator closed or abandoned (GC runs close()): release the
+            # producer, which may be blocked mid-put.
+            stop.set()
+
+    return consume()
+
+
+def global_batches(batches: Iterator, mesh, spec) -> Iterator:
+    """Assemble each process's local batch into one global ``jax.Array``
+    laid out as ``spec`` on ``mesh`` (``jax.make_array_from_process_local_
+    data``). Single-process: a plain ``device_put`` with the same
+    sharding, so notebook code is identical at every scale."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+
+    def to_global(x):
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    for batch in batches:
+        yield jax.tree.map(to_global, batch)

@@ -193,7 +193,11 @@ class MaintenanceWatcher:
         """callback(nodes: str) fires once each time maintenance becomes
         pending (not per poll). A callback exception is logged, not
         fatal — the watcher keeps watching (same policy as check()'s
-        fetch errors). start() after stop() resumes watching."""
+        fetch errors). start() after stop() resumes watching; start()
+        while already watching is a no-op (re-running a notebook cell
+        must not stack a second poller)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
         self._stop = threading.Event()  # restartable after stop()
 
         def loop():

@@ -1,0 +1,252 @@
+"""Input pipeline (kubeflow_tpu/data.py): deterministic sharding, resume
+exactness, prefetch correctness, multi-host global-array assembly.
+
+The properties tested are the ones training correctness rests on: shard
+disjointness (no example trains twice per epoch), determinism by (seed,
+step) (what makes trainer.fit's skip-ahead resume bit-exact), and static
+batch shapes (no mid-epoch recompiles).
+"""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu import data as kfdata
+
+
+def make_source(n=64, width=3):
+    x = np.arange(n * width, dtype=np.float32).reshape(n, width)
+    y = np.arange(n, dtype=np.int32)
+    return kfdata.ArraySource(x, y)
+
+
+def take(loader, k):
+    it = iter(loader)
+    return [next(it) for _ in range(k)]
+
+
+def test_array_source_alignment_checked():
+    with pytest.raises(ValueError, match="aligned"):
+        kfdata.ArraySource(np.zeros(4), np.zeros(5))
+    with pytest.raises(ValueError, match="at least one"):
+        kfdata.ArraySource()
+
+
+def test_static_shapes_and_remainder_dropped():
+    loader = kfdata.ShardedLoader(
+        make_source(n=70), batch_size=8, process_id=0, num_processes=1)
+    assert loader.batches_per_epoch == 8  # 70 // 8, remainder dropped
+    for x, y in take(loader, 10):        # crosses an epoch boundary
+        assert x.shape == (8, 3) and y.shape == (8,)
+
+
+def test_epoch_covers_every_kept_example_once():
+    loader = kfdata.ShardedLoader(
+        make_source(n=64), batch_size=8, process_id=0, num_processes=1,
+        seed=3)
+    seen = np.concatenate([y for _, y in take(loader, 8)])
+    assert sorted(seen.tolist()) == list(range(64))
+
+
+def test_process_shards_are_disjoint_and_cover():
+    loaders = [
+        kfdata.ShardedLoader(make_source(n=64), batch_size=8, seed=7,
+                             process_id=p, num_processes=2)
+        for p in range(2)
+    ]
+    per_proc = [
+        np.concatenate([y for _, y in take(ld, ld.batches_per_process)])
+        for ld in loaders
+    ]
+    assert not set(per_proc[0]) & set(per_proc[1])
+    assert sorted(np.concatenate(per_proc).tolist()) == list(range(64))
+
+
+def test_determinism_and_epoch_reshuffle():
+    def stream(seed):
+        ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=seed,
+                                  process_id=0, num_processes=1)
+        return [y.tolist() for _, y in take(ld, 16)]  # two epochs
+
+    a, b = stream(5), stream(5)
+    assert a == b                       # same seed → same stream
+    assert stream(6) != a               # seed changes the order
+    assert a[:8] != a[8:]               # epoch 1 reshuffled vs epoch 0
+
+
+def test_resume_by_skip_matches_straight_run():
+    """trainer.fit's resume contract: skipping k batches of a fresh
+    loader equals continuing the original — exactly."""
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=9,
+                              process_id=0, num_processes=1)
+    straight = [y.tolist() for _, y in take(ld, 12)]
+
+    fresh = kfdata.ShardedLoader(make_source(), batch_size=8, seed=9,
+                                 process_id=0, num_processes=1)
+    it = iter(fresh)
+    for _ in range(5):
+        next(it)
+    resumed = [next(it)[1].tolist() for _ in range(7)]
+    assert resumed == straight[5:]
+
+
+def test_state_dict_roundtrip():
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=1,
+                              process_id=0, num_processes=1)
+    take(ld, 5)
+    snap = ld.state_dict()
+    want = [y.tolist() for _, y in take(ld, 4)]
+
+    ld2 = kfdata.ShardedLoader(make_source(), batch_size=8, seed=1,
+                               process_id=0, num_processes=1)
+    ld2.load_state_dict(snap)
+    got = [y.tolist() for _, y in take(ld2, 4)]
+    assert got == want
+
+
+def test_transform_applies():
+    ld = kfdata.ShardedLoader(
+        make_source(), batch_size=8, process_id=0, num_processes=1,
+        transform=lambda b: (b[0] * 2, b[1]))
+    x, y = take(ld, 1)[0]
+    src_x, _ = make_source()(np.array([0]))
+    # Determinism of the un-transformed stream lets us check the doubling.
+    ld2 = kfdata.ShardedLoader(
+        make_source(), batch_size=8, process_id=0, num_processes=1)
+    x2, _ = take(ld2, 1)[0]
+    np.testing.assert_array_equal(x, x2 * 2)
+
+
+def test_too_few_examples_raises():
+    with pytest.raises(ValueError, match="one batch per process"):
+        kfdata.ShardedLoader(make_source(n=8), batch_size=8,
+                             process_id=0, num_processes=2)
+
+
+def test_prefetch_preserves_order_and_values():
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=2,
+                              process_id=0, num_processes=1)
+    want = [y.tolist() for _, y in take(ld, 10)]
+    ld2 = kfdata.ShardedLoader(make_source(), batch_size=8, seed=2,
+                               process_id=0, num_processes=1)
+    pf = kfdata.prefetch(iter(ld2), depth=3)
+    got = [next(pf)[1].tolist() for _ in range(10)]
+    assert got == want
+
+
+def test_prefetch_relays_upstream_exception():
+    def boom():
+        yield (np.zeros(1),)
+        raise RuntimeError("source died")
+
+    pf = kfdata.prefetch(boom(), depth=2)
+    next(pf)
+    with pytest.raises(RuntimeError, match="source died"):
+        next(pf)
+
+
+def test_prefetch_finite_stream_ends():
+    pf = kfdata.prefetch(iter([1, 2, 3]), depth=2)
+    assert list(pf) == [1, 2, 3]
+
+
+def test_prefetch_to_device_runs_on_thread():
+    moved = []
+
+    def to_device(item):
+        moved.append(item)
+        return item * 10
+
+    pf = kfdata.prefetch(iter([1, 2]), depth=2, to_device=to_device)
+    assert list(pf) == [10, 20]
+    assert moved == [1, 2]
+
+
+def test_global_batches_places_on_mesh():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("data",))
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=4,
+                              process_id=0, num_processes=1)
+    gb = kfdata.global_batches(iter(ld), mesh, P("data"))
+    x, y = next(gb)
+    assert isinstance(x, jax.Array) and x.shape == (8, 3)
+    assert x.sharding.spec == P("data")
+    # Values survive placement (compare against the deterministic stream).
+    ld2 = kfdata.ShardedLoader(make_source(), batch_size=8, seed=4,
+                               process_id=0, num_processes=1)
+    x2, y2 = next(iter(ld2))
+    np.testing.assert_array_equal(np.asarray(x), x2)
+    np.testing.assert_array_equal(np.asarray(y), y2)
+
+
+def test_loader_feeds_trainer_fit(tmp_path):
+    """The three-module story end to end: loader → trainer.fit with
+    checkpointing → resume mid-epoch reproduces the straight run."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import trainer
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"]
+        return jnp.mean((pred - y.astype(jnp.float32)) ** 2)
+
+    cfg = trainer.TrainerConfig(optimizer="sgd", lr=1e-3, grad_clip=0)
+    opt = trainer.make_optimizer(cfg)
+    step_fn = jax.jit(trainer.make_train_step(loss_fn, opt))
+
+    def fresh_state():
+        return trainer.init_state(
+            {"w": jnp.zeros((3,), jnp.float32)}, opt)
+
+    def loader():
+        return iter(kfdata.ShardedLoader(
+            make_source(), batch_size=8, seed=11,
+            process_id=0, num_processes=1))
+
+    full = trainer.fit(fresh_state(), loader(), steps=10, step_fn=step_fn)
+
+    from kubeflow_tpu.utils.checkpoint import CheckpointManager
+
+    with CheckpointManager(str(tmp_path)) as ckpt:
+        mid = trainer.fit(fresh_state(), loader(), steps=6,
+                          step_fn=step_fn, checkpoints=ckpt, save_every=6)
+        restored = ckpt.restore(6)
+        resumed = trainer.fit(restored, loader(), steps=10, step_fn=step_fn)
+
+    np.testing.assert_array_equal(
+        np.asarray(full["params"]["w"]), np.asarray(resumed["params"]["w"]))
+
+
+def test_skip_matches_fresh_consumption():
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=13,
+                              process_id=0, num_processes=1)
+    straight = [y.tolist() for _, y in take(ld, 20)]  # crosses epochs
+    ld2 = kfdata.ShardedLoader(make_source(), batch_size=8, seed=13,
+                               process_id=0, num_processes=1)
+    ld2.skip(11)
+    got = [y.tolist() for _, y in take(ld2, 9)]
+    assert got == straight[11:]
+
+
+def test_abandoned_prefetch_releases_producer_thread():
+    import threading
+    import time as _time
+
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=0,
+                              process_id=0, num_processes=1)
+    pf = kfdata.prefetch(iter(ld), depth=1)
+    next(pf)
+    assert any(t.name == "kftpu-data-prefetch" and t.is_alive()
+               for t in threading.enumerate())
+    pf.close()  # what GC does to an abandoned pipeline
+    deadline = _time.time() + 5
+    while _time.time() < deadline and any(
+            t.name == "kftpu-data-prefetch" and t.is_alive()
+            for t in threading.enumerate()):
+        _time.sleep(0.02)
+    assert not any(t.name == "kftpu-data-prefetch" and t.is_alive()
+                   for t in threading.enumerate()), "producer leaked"

@@ -253,3 +253,19 @@ def test_guard_end_to_end_with_orbax(tmp_path):
         assert mgr.latest_step() == 7
         got = mgr.restore(7)
         np.testing.assert_array_equal(got["w"], tree["w"])
+
+
+def test_watcher_double_start_is_noop():
+    fired = []
+    ann = {MAINTENANCE_ANNOTATION: "n"}
+    w = sdk.MaintenanceWatcher(fetch=lambda: dict(ann), interval=0.01)
+    w.start(lambda nodes: fired.append(nodes))
+    first = w._thread
+    w.start(lambda nodes: fired.append("second-" + nodes))  # re-run cell
+    assert w._thread is first  # no second poller stacked
+    deadline = time.time() + 5
+    while not fired and time.time() < deadline:
+        time.sleep(0.01)
+    w.stop()
+    time.sleep(0.05)
+    assert fired and all(not f.startswith("second-") for f in fired)
