@@ -224,23 +224,42 @@ def prefetch(batches: Iterator, *, depth: int = 2,
 
     threading.Thread(target=producer, daemon=True,
                      name="kftpu-data-prefetch").start()
+    return _Prefetcher(q, stop, _END)
 
-    def consume():
-        try:
-            while True:
-                item = q.get()
-                if (isinstance(item, tuple) and len(item) == 2
-                        and item[0] is _END):
-                    if item[1] is not None:
-                        raise item[1]
-                    return
-                yield item
-        finally:
-            # Generator closed or abandoned (GC runs close()): release the
-            # producer, which may be blocked mid-put.
-            stop.set()
 
-    return consume()
+class _Prefetcher:
+    """Consumer half of prefetch(). A real object (not a generator) so
+    abandoning the pipeline before the first ``next()`` still releases
+    the producer — a never-started generator's ``finally`` never runs,
+    but ``__del__``/``close()`` here always do."""
+
+    def __init__(self, q, stop, end):
+        self._q = q
+        self._stop = stop
+        self._end = end
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if (isinstance(item, tuple) and len(item) == 2
+                and item[0] is self._end):
+            self._done = True
+            self._stop.set()
+            if item[1] is not None:
+                raise item[1]
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._done = True
+        self._stop.set()
+
+    __del__ = close
 
 
 def global_batches(batches: Iterator, mesh, spec) -> Iterator:

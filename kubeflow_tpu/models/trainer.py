@@ -185,6 +185,7 @@ def fit(
     checkpoints=None,
     save_every: int = 100,
     on_step: Callable | None = None,
+    skip_batches: bool = True,
 ) -> dict:
     """Run ``step_fn`` until ``state["step"] == steps``, checkpointing.
 
@@ -193,11 +194,21 @@ def fit(
     first ``step`` elements, so interrupt-at-k + rerun over the same
     deterministic batch sequence equals an uninterrupted run bit-for-bit
     (tests/test_trainer.py::test_resume_equivalence).
+
+    The islice fast-forward materializes every skipped batch — O(steps)
+    host work (and device transfers if the stream is device-placed).
+    When the stream can reposition itself in O(1)
+    (``kubeflow_tpu.data.ShardedLoader.skip``), do that instead and pass
+    ``skip_batches=False``::
+
+        loader.skip(int(state["step"]))
+        batches = data.global_batches(data.prefetch(iter(loader)), ...)
+        trainer.fit(state, batches, ..., skip_batches=False)
     """
     from itertools import islice
 
     start = int(state["step"])
-    if start:
+    if start and skip_batches:
         batches = islice(batches, start, None)
     for i in range(start, steps):
         state, loss = step_fn(state, next(batches))

@@ -53,6 +53,8 @@ __all__ = [
     "MaintenanceWatcher",
     "SliceInfo",
     "initialize_distributed",
+    "start_profiler_server",
+    "trace",
 ]
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -128,6 +130,45 @@ def initialize_distributed(environ=os.environ) -> bool:
         process_id=info.process_id,
     )
     return True
+
+
+PROFILER_PORT = 9999
+_profiler_started = False
+
+
+def start_profiler_server(port: int = PROFILER_PORT) -> None:
+    """``jax.profiler.start_server`` on the conventional port — the
+    target of TensorBoard's profile-plugin "capture" button (SURVEY §5:
+    the ``jax.profiler.start_server`` convention in images). Point a
+    ``Tensorboard`` CR with ``spec.profilerPlugin: true`` at the
+    notebook's DNS name to capture live. Idempotent: re-running the
+    setup cell is a no-op (jax allows one server per process)."""
+    global _profiler_started
+    if _profiler_started:
+        return
+    import jax
+
+    try:
+        jax.profiler.start_server(port)
+    except ValueError:
+        # A server already runs in this process (started outside the
+        # sdk); that's the state the caller wanted.
+        _log.warning("profiler server already running; reusing it")
+    _profiler_started = True
+
+
+def trace(logdir: str):
+    """Context manager writing an XLA/TPU trace under ``logdir`` —
+    readable by a ``Tensorboard`` CR with ``spec.profilerPlugin: true``
+    over the same PVC/GCS path (controllers/tensorboard.py)::
+
+        with sdk.trace("/home/jovyan/logs"):
+            params, loss = train_step(params, batch)
+            loss.block_until_ready()
+    """
+    import jax
+
+    return jax.profiler.trace(logdir)
 
 
 def _in_cluster_fetch(namespace: str, name: str):

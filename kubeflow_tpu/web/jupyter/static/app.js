@@ -463,9 +463,30 @@ const checks = [
 let extraEnv = [];
 document.getElementById("advanced-slot").append(
   KF.advancedSection("Advanced options", (pane) => {
-    const tolerationOptions =
-      (spawnerConfig.tolerationGroup && spawnerConfig.tolerationGroup.options) ||
-      [];
+    // Admin presets share one builder: label + select with a "none"
+    // option, keyed by the config's option-key field.
+    const presetSelect = (id, label, options, keyField) =>
+      options.length
+        ? [
+            el(
+              "label",
+              { style: { display: "block", margin: "10px 0 4px" } },
+              label
+            ),
+            el(
+              "select",
+              { id, style: { width: "auto" } },
+              el("option", { value: "" }, "none"),
+              ...options.map((opt) =>
+                el(
+                  "option",
+                  { value: opt[keyField] },
+                  opt.displayName || opt[keyField]
+                )
+              )
+            ),
+          ]
+        : [];
     pane.append(
       el("label", { style: { display: "block", marginBottom: "4px" } },
         "Environment variables (KEY=VALUE)"),
@@ -478,27 +499,18 @@ document.getElementById("advanced-slot").append(
             ? null
             : "Use KEY=VALUE (key: letters, digits, underscores).",
       }),
-      tolerationOptions.length
-        ? el(
-            "label",
-            { style: { display: "block", margin: "10px 0 4px" } },
-            "Toleration preset"
-          )
-        : "",
-      tolerationOptions.length
-        ? el(
-            "select",
-            { id: "toleration-group", style: { width: "auto" } },
-            el("option", { value: "" }, "none"),
-            ...tolerationOptions.map((group) =>
-              el(
-                "option",
-                { value: group.groupKey },
-                group.displayName || group.groupKey
-              )
-            )
-          )
-        : ""
+      ...presetSelect(
+        "toleration-group", "Toleration preset",
+        (spawnerConfig.tolerationGroup &&
+          spawnerConfig.tolerationGroup.options) || [],
+        "groupKey"
+      ),
+      ...presetSelect(
+        "affinity-config", "Affinity preset",
+        (spawnerConfig.affinityConfig &&
+          spawnerConfig.affinityConfig.options) || [],
+        "configKey"
+      )
     );
   })
 );
@@ -591,6 +603,10 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
   const tolerationSelect = document.getElementById("toleration-group");
   if (tolerationSelect && tolerationSelect.value) {
     payload.tolerationGroup = tolerationSelect.value;
+  }
+  const affinitySelect = document.getElementById("affinity-config");
+  if (affinitySelect && affinitySelect.value) {
+    payload.affinityConfig = affinitySelect.value;
   }
   api(`api/namespaces/${ns.get()}/notebooks`, {
     method: "POST",

@@ -250,3 +250,25 @@ def test_abandoned_prefetch_releases_producer_thread():
         _time.sleep(0.02)
     assert not any(t.name == "kftpu-data-prefetch" and t.is_alive()
                    for t in threading.enumerate()), "producer leaked"
+
+
+def test_unstarted_prefetch_releases_on_close():
+    """Abandoning the pipeline before the first next() (re-run cell,
+    cell error) must still release the producer thread — a generator's
+    finally would never run here."""
+    import gc
+    import threading
+    import time as _time
+
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=0,
+                              process_id=0, num_processes=1)
+    pf = kfdata.prefetch(iter(ld), depth=1)
+    del pf          # never consumed
+    gc.collect()
+    deadline = _time.time() + 5
+    while _time.time() < deadline and any(
+            t.name == "kftpu-data-prefetch" and t.is_alive()
+            for t in threading.enumerate()):
+        _time.sleep(0.02)
+    assert not any(t.name == "kftpu-data-prefetch" and t.is_alive()
+                   for t in threading.enumerate()), "producer leaked"

@@ -142,3 +142,53 @@ def test_title_actions_toolbar(jwa):
     assert "namespace team" in b.text(".kf-toolbar")
     b.click("#tb-act")
     assert b.eval("clicked") == 1
+
+
+def test_affinity_and_toleration_presets_reach_the_pod_spec():
+    """Admin-configured affinity/toleration presets render in the form's
+    advanced section and land on the created Notebook's pod spec (the
+    reference spawner's affinityConfig/tolerationGroup fields,
+    spawner_ui_config.yaml)."""
+    from kubeflow_tpu.web.jupyter.spawner_config import load_config
+
+    cfg = load_config(None)
+    cfg["affinityConfig"] = {
+        "value": "", "readOnly": False,
+        "options": [{
+            "configKey": "tpu-pool",
+            "displayName": "TPU node pool",
+            "affinity": {"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [{
+                        "key": "pool", "operator": "In",
+                        "values": ["tpu"]}]}]}}},
+        }],
+    }
+    cfg["tolerationGroup"] = {
+        "value": "", "readOnly": False,
+        "options": [{
+            "groupKey": "preemptible",
+            "displayName": "Preemptible",
+            "tolerations": [{"key": "cloud.google.com/gke-spot",
+                             "operator": "Exists"}],
+        }],
+    }
+    with JsWebHarness(lambda kube: create_jwa(kube, config=cfg)) as h:
+        b = h.browser
+        b.local_storage["kubeflow.namespace"] = "team"
+        b.load("/")
+        b.click("#new-btn")
+        b.click(".kf-advanced-toggle")  # render the advanced pane
+        b.set_value('#new-form input[name="name"]', "pinned")
+        b.change("#affinity-config", "tpu-pool")
+        b.change("#toleration-group", "preemptible")
+        b.submit("#new-form")
+        nb = h.kube_get("Notebook", "pinned", "team")
+        assert nb is not None
+        spec = nb["spec"]["template"]["spec"]
+        terms = spec["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"][
+            "nodeSelectorTerms"]
+        assert terms[0]["matchExpressions"][0]["values"] == ["tpu"]
+        assert {"key": "cloud.google.com/gke-spot",
+                "operator": "Exists"} in spec["tolerations"]

@@ -269,3 +269,31 @@ def test_watcher_double_start_is_noop():
     w.stop()
     time.sleep(0.05)
     assert fired and all(not f.startswith("second-") for f in fired)
+
+
+def test_trace_writes_xla_profile_artifacts(tmp_path):
+    """sdk.trace produces the on-disk layout TensorBoard's profile plugin
+    reads (plugins/profile/<run>/) — the contract a profilerPlugin
+    Tensorboard CR serves over the same logdir."""
+    import glob
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    with sdk.trace(str(tmp_path)):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    found = glob.glob(
+        os.path.join(str(tmp_path), "**", "plugins", "profile", "*"),
+        recursive=True)
+    assert found, f"no profile runs under {tmp_path}"
+
+
+def test_start_profiler_server_is_idempotent():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    sdk.start_profiler_server(port)
+    sdk.start_profiler_server(port)  # re-run setup cell: must not raise
