@@ -133,7 +133,7 @@ def initialize_distributed(environ=os.environ) -> bool:
 
 
 PROFILER_PORT = 9999
-_profiler_started = False
+_profiler_port: int | None = None
 
 
 def start_profiler_server(port: int = PROFILER_PORT) -> None:
@@ -143,8 +143,14 @@ def start_profiler_server(port: int = PROFILER_PORT) -> None:
     ``Tensorboard`` CR with ``spec.profilerPlugin: true`` at the
     notebook's DNS name to capture live. Idempotent: re-running the
     setup cell is a no-op (jax allows one server per process)."""
-    global _profiler_started
-    if _profiler_started:
+    global _profiler_port
+    if _profiler_port is not None:
+        if port != _profiler_port:
+            # jax allows one server per process; a move is impossible —
+            # say so instead of silently ignoring the new port.
+            _log.warning(
+                "profiler server already on port %d; cannot move to %d "
+                "(one server per process)", _profiler_port, port)
         return
     import jax
 
@@ -154,7 +160,7 @@ def start_profiler_server(port: int = PROFILER_PORT) -> None:
         # A server already runs in this process (started outside the
         # sdk); that's the state the caller wanted.
         _log.warning("profiler server already running; reusing it")
-    _profiler_started = True
+    _profiler_port = port
 
 
 def trace(logdir: str):

@@ -464,8 +464,10 @@ let extraEnv = [];
 document.getElementById("advanced-slot").append(
   KF.advancedSection("Advanced options", (pane) => {
     // Admin presets share one builder: label + select with a "none"
-    // option, keyed by the config's option-key field.
-    const presetSelect = (id, label, options, keyField) =>
+    // option, keyed by the config's option-key field. Call sites pass
+    // the id as a literal attrs object so static DOM-contract checks
+    // can see which ids the JS creates.
+    const presetSelect = (attrs, label, options, keyField) =>
       options.length
         ? [
             el(
@@ -475,7 +477,7 @@ document.getElementById("advanced-slot").append(
             ),
             el(
               "select",
-              { id, style: { width: "auto" } },
+              Object.assign({ style: { width: "auto" } }, attrs),
               el("option", { value: "" }, "none"),
               ...options.map((opt) =>
                 el(
@@ -500,13 +502,13 @@ document.getElementById("advanced-slot").append(
             : "Use KEY=VALUE (key: letters, digits, underscores).",
       }),
       ...presetSelect(
-        "toleration-group", "Toleration preset",
+        { id: "toleration-group" }, "Toleration preset",
         (spawnerConfig.tolerationGroup &&
           spawnerConfig.tolerationGroup.options) || [],
         "groupKey"
       ),
       ...presetSelect(
-        "affinity-config", "Affinity preset",
+        { id: "affinity-config" }, "Affinity preset",
         (spawnerConfig.affinityConfig &&
           spawnerConfig.affinityConfig.options) || [],
         "configKey"

@@ -272,3 +272,41 @@ def test_unstarted_prefetch_releases_on_close():
         _time.sleep(0.02)
     assert not any(t.name == "kftpu-data-prefetch" and t.is_alive()
                    for t in threading.enumerate()), "producer leaked"
+
+
+def test_fit_skip_batches_false_resume_equivalence(tmp_path):
+    """The O(1) resume recipe (loader.skip + skip_batches=False) must be
+    bit-for-bit equal to the straight run — the same guarantee
+    test_loader_feeds_trainer_fit pins for the islice path."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import trainer
+    from kubeflow_tpu.utils.checkpoint import CheckpointManager
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y.astype(jnp.float32)) ** 2)
+
+    cfg = trainer.TrainerConfig(optimizer="sgd", lr=1e-3, grad_clip=0)
+    opt = trainer.make_optimizer(cfg)
+    step_fn = jax.jit(trainer.make_train_step(loss_fn, opt))
+    fresh = lambda: trainer.init_state({"w": jnp.zeros((3,), jnp.float32)}, opt)
+
+    def loader():
+        return kfdata.ShardedLoader(make_source(), batch_size=8, seed=21,
+                                    process_id=0, num_processes=1)
+
+    full = trainer.fit(fresh(), iter(loader()), steps=10, step_fn=step_fn)
+
+    with CheckpointManager(str(tmp_path)) as ckpt:
+        trainer.fit(fresh(), iter(loader()), steps=6, step_fn=step_fn,
+                    checkpoints=ckpt, save_every=6)
+        restored = ckpt.restore(6)
+        ld = loader()
+        ld.skip(int(restored["step"]))          # O(1), no replay
+        resumed = trainer.fit(restored, iter(ld), steps=10,
+                              step_fn=step_fn, skip_batches=False)
+
+    np.testing.assert_array_equal(
+        np.asarray(full["params"]["w"]), np.asarray(resumed["params"]["w"]))
