@@ -145,7 +145,7 @@ def start_profiler_server(port: int = PROFILER_PORT) -> None:
     setup cell is a no-op (jax allows one server per process)."""
     global _profiler_port
     if _profiler_port is not None:
-        if port != _profiler_port:
+        if _profiler_port >= 0 and port != _profiler_port:
             # jax allows one server per process; a move is impossible —
             # say so instead of silently ignoring the new port.
             _log.warning(
@@ -156,11 +156,14 @@ def start_profiler_server(port: int = PROFILER_PORT) -> None:
 
     try:
         jax.profiler.start_server(port)
+        _profiler_port = port
     except ValueError:
         # A server already runs in this process (started outside the
-        # sdk); that's the state the caller wanted.
+        # sdk) — on an unknown port, so record the sentinel rather than
+        # a port we can't confirm (a later mismatch warning would state
+        # the inverse of reality).
         _log.warning("profiler server already running; reusing it")
-    _profiler_port = port
+        _profiler_port = -1
 
 
 def trace(logdir: str):
