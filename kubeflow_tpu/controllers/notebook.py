@@ -163,6 +163,7 @@ class NotebookReconciler:
         self._event_informer = None
         self._sts_informer = None
         self._node_informer = None
+        self._nb_informer = None
         registry = registry or global_registry
         # Metric names match the reference (pkg/metrics/metrics.go:14-62) so
         # dashboards/alerts carry over.
@@ -172,6 +173,10 @@ class NotebookReconciler:
         self.m_running = registry.gauge(
             "notebook_running", "Running notebooks in the cluster", ["namespace"]
         )
+        self.m_chips = registry.gauge(
+            "notebook_tpu_chips_requested",
+            "TPU chips requested by non-stopped notebooks", ["namespace"],
+        )
 
     # ---- reconcile --------------------------------------------------------------
 
@@ -180,6 +185,10 @@ class NotebookReconciler:
         nb = await self.kube.get_or_none("Notebook", name, namespace)
         if nb is None or get_meta(nb).get("deletionTimestamp"):
             self._mirrored.pop((namespace, name), None)
+            # The namespace's running/chip gauges must drop the deleted
+            # notebook's contribution now, not at the next unrelated
+            # reconcile in this namespace.
+            await self._update_namespace_gauges(namespace)
             return None  # children die by ownerReference cascade
 
         try:
@@ -986,9 +995,35 @@ class NotebookReconciler:
                 )
             except ApiError:
                 pass
-        self.m_running.labels(namespace=ns or "").set(
-            1 if ready and ready == want_hosts else 0
-        )
+        await self._update_namespace_gauges(ns)
+
+    async def _update_namespace_gauges(self, ns: str) -> None:
+        """Recompute the per-namespace gauges from the Notebook informer
+        (fallback: one LIST in bare-reconciler tests). Set-per-notebook
+        would be wrong the moment a namespace holds two notebooks — the
+        last reconcile would overwrite the other's contribution."""
+        if self._nb_informer is not None:
+            notebooks = [n for n in self._nb_informer.items()
+                         if namespace_of(n) == ns]
+        else:
+            try:
+                notebooks = await self.kube.list("Notebook", ns)
+            except ApiError:
+                return
+        running = 0
+        chips = 0
+        for nb in notebooks:
+            if nbapi.is_stopped(nb):
+                # Parked: not running even while old pods drain, and its
+                # chip demand is released.
+                continue
+            ready = deep_get(nb, "status", "readyReplicas", default=0) or 0
+            hosts = deep_get(nb, "status", "tpu", "hosts", default=1) or 1
+            if ready and ready >= hosts:
+                running += 1
+            chips += deep_get(nb, "status", "tpu", "chips", default=0) or 0
+        self.m_running.labels(namespace=ns or "").set(running)
+        self.m_chips.labels(namespace=ns or "").set(chips)
 
 
 def _main_container_name(nb: dict) -> str:
@@ -1123,6 +1158,7 @@ def setup_notebook_controller(
     # way).
     rec._event_informer = mgr.informer_for("Event")
     rec._sts_informer = mgr.informer_for("StatefulSet")
+    rec._nb_informer = mgr.informer_for("Notebook")
     if rec.opts.maintenance_taints:
         # Maintenance taints land on Nodes, not on anything the Notebook
         # owns — watch Nodes and re-enqueue the notebooks whose workers

@@ -152,3 +152,54 @@ async def test_untainted_nodes_do_not_annotate():
         events = await h.kube.list("Event", "ns")
         assert not any(
             e.get("reason") == "MaintenancePending" for e in events)
+
+
+async def test_namespace_gauges_aggregate_not_overwrite():
+    """notebook_running / notebook_tpu_chips_requested are per-namespace
+    aggregates computed from the informer cache — a second notebook in
+    the namespace must not overwrite the first's contribution, and
+    stopping a notebook releases its chip demand."""
+    from kubeflow_tpu.runtime.metrics import Registry
+    from kubeflow_tpu.runtime.manager import Manager as _Mgr
+
+    kube = FakeKube()
+    register_all(kube)
+    registry = Registry()
+    mgr = _Mgr(kube, registry=registry)
+    rec = setup_notebook_controller(mgr)
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    try:
+        await kube.create("Notebook", nbapi.new("cpu-only", "team"))
+        await kube.create(
+            "Notebook", nbapi.new("slice", "team", accelerator="v5e",
+                                  topology="4x4"))
+        for _ in range(10):
+            await mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.02)
+        assert rec.m_running.labels(namespace="team").value == 2.0
+        assert rec.m_chips.labels(namespace="team").value == 16.0
+
+        await kube.patch(
+            "Notebook", "slice",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: "t"}}},
+            "team")
+        for _ in range(10):
+            await mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.02)
+        assert rec.m_running.labels(namespace="team").value == 1.0
+        assert rec.m_chips.labels(namespace="team").value == 0.0
+
+        # Deleting the last running notebook zeroes the gauges on the
+        # deletion reconcile itself, not at some later unrelated event.
+        await kube.delete("Notebook", "cpu-only", "team")
+        for _ in range(10):
+            await mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.02)
+        assert rec.m_running.labels(namespace="team").value == 0.0
+        assert rec.m_chips.labels(namespace="team").value == 0.0
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        kube.close_watches()
