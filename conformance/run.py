@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Conformance runner: 18 checks, one JSON line each + a summary line.
+"""Conformance runner: 19 checks, one JSON line each + a summary line.
 
 Hermetic by default (in-process fake cluster + controllers); ``--live``
 targets the current kubeconfig/proxy endpoint instead and skips the checks
@@ -245,6 +245,40 @@ class Conformance:
         assert deep_get(nb, "status", "readyReplicas") == 2, (
             "replacement slice did not converge")
         self.sim.failure_injector = None
+
+    async def check_queued_provisioning(self):
+        """spec.tpu.queuedProvisioning gates the gang on a GKE
+        ProvisioningRequest: no StatefulSet until Provisioned=True, then
+        the pods consume the reservation."""
+        if self.sim is None:
+            # Live mode: patching the PR status would impersonate (and
+            # race) the real autoscaler, and the CRD may not exist.
+            raise Skip("needs the simulated autoscaler")
+        await self.kube.create(
+            "Notebook",
+            nbapi.new("conf-queued", NS, accelerator="v5e", topology="4x4",
+                      queued=True))
+        await self.settle()
+        assert await self.kube.get_or_none(
+            "StatefulSet", "conf-queued", NS) is None, (
+            "gang created before capacity was provisioned")
+        pr = await self.kube.get(
+            "ProvisioningRequest", "conf-queued-capacity", NS)
+        assert deep_get(pr, "spec", "podSets")[0]["count"] == 2
+        await self.kube.patch(
+            "ProvisioningRequest", "conf-queued-capacity",
+            {"status": {"conditions": [
+                {"type": "Provisioned", "status": "True"}]}},
+            NS, subresource="status")
+        await self.settle()
+        sts = await self.kube.get("StatefulSet", "conf-queued", NS)
+        anns = deep_get(sts, "spec", "template", "metadata", "annotations")
+        assert anns.get(
+            "cluster-autoscaler.kubernetes.io/consume-provisioning-request"
+        ) == "conf-queued-capacity"
+        if self.sim is not None:
+            nb = await self.kube.get("Notebook", "conf-queued", NS)
+            assert deep_get(nb, "status", "readyReplicas") == 2
 
     async def check_version_conversion(self):
         """Old served apiVersions reconcile like v1 (VERDICT r1 gap #4)."""
@@ -511,6 +545,7 @@ async def run(live: bool) -> int:
     await conf.check("culling", conf.check_culling)
     await conf.check("slice-atomic-restart", conf.check_slice_restart)
     await conf.check("preemption-recovery", conf.check_preemption_recovery)
+    await conf.check("queued-provisioning", conf.check_queued_provisioning)
     await conf.check("version-conversion", conf.check_version_conversion)
     await conf.check("event-hygiene", conf.check_event_hygiene)
     await conf.check("contributor-authz", conf.check_contributor_authz)

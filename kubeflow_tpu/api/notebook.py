@@ -104,6 +104,7 @@ def new(
     accelerator: str | None = None,
     topology: str | None = None,
     num_slices: int | None = None,
+    queued: bool = False,
     pod_spec: dict | None = None,
 ) -> dict:
     """Convenience constructor used by tests, the web app, and the load test."""
@@ -114,6 +115,8 @@ def new(
         spec["tpu"] = {"accelerator": accelerator, "topology": topology or "1x1"}
         if num_slices and num_slices > 1:
             spec["tpu"]["numSlices"] = num_slices
+        if queued:
+            spec["tpu"]["queuedProvisioning"] = True
     return {
         "apiVersion": API_VERSION,
         "kind": KIND,
@@ -129,6 +132,14 @@ def pod_spec_of(notebook: dict) -> dict:
 
 def tpu_spec_of(notebook: dict) -> dict | None:
     return deep_get(notebook, "spec", "tpu")
+
+
+def queued_provisioning(notebook: dict) -> bool:
+    """spec.tpu.queuedProvisioning: gate slice creation on a GKE
+    ProvisioningRequest reserving the whole slice's capacity first
+    (queued-provisioning.gke.io) — large slices are scarce, and a
+    half-scheduled gang burns quota while it waits."""
+    return bool((tpu_spec_of(notebook) or {}).get("queuedProvisioning"))
 
 
 def tpu_slice_of(notebook: dict) -> TpuSlice | None:
@@ -197,3 +208,8 @@ def validate(notebook: dict) -> None:
     if not containers:
         raise Invalid(f"Notebook {name}: spec.template.spec.containers required")
     multi_slice_of(notebook)  # raises Invalid on a malformed tpu block
+    qp = (tpu_spec_of(notebook) or {}).get("queuedProvisioning")
+    if qp is not None and not isinstance(qp, bool):
+        raise Invalid(
+            f"Notebook {name}: spec.tpu.queuedProvisioning must be a boolean"
+        )

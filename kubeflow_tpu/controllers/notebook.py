@@ -75,6 +75,17 @@ POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"  # set by the STS controll
 MAINTENANCE_ANNOTATION = nbapi.MAINTENANCE_ANNOTATION
 DEFAULT_MAINTENANCE_TAINTS = ("cloud.google.com/impending-node-termination",)
 
+# Queued provisioning (spec.tpu.queuedProvisioning): the slice's capacity
+# is reserved through a GKE ProvisioningRequest before any worker pod
+# exists; once Provisioned, the pods consume the reservation via the
+# cluster-autoscaler annotation. Names: <notebook>-capacity for both the
+# request and its PodTemplate.
+PROVISIONING_CLASS = "queued-provisioning.gke.io"
+CONSUME_PR_ANNOTATION = (
+    "cluster-autoscaler.kubernetes.io/consume-provisioning-request")
+PR_CLASS_ANNOTATION = (
+    "cluster-autoscaler.kubernetes.io/provisioning-class-name")
+
 
 @dataclass
 class NotebookOptions:
@@ -117,6 +128,12 @@ class NotebookOptions:
     # (GKE graceful node termination for TPU/GPU maintenance events).
     # Empty disables the maintenance-pending mirror.
     maintenance_taints: tuple[str, ...] = DEFAULT_MAINTENANCE_TAINTS
+
+    # Queued provisioning support (spec.tpu.queuedProvisioning). Disable
+    # on clusters without the autoscaling.x-k8s.io ProvisioningRequest
+    # CRD — the watch would otherwise relist-404 forever. When disabled,
+    # a queued spec runs as if unqueued.
+    enable_queued_provisioning: bool = True
 
 
 AUTH_PROXY_ANNOTATION = "notebooks.kubeflow.org/inject-auth-proxy"
@@ -164,6 +181,7 @@ class NotebookReconciler:
         self._sts_informer = None
         self._node_informer = None
         self._nb_informer = None
+        self._pr_informer = None
         registry = registry or global_registry
         # Metric names match the reference (pkg/metrics/metrics.go:14-62) so
         # dashboards/alerts carry over.
@@ -201,10 +219,36 @@ class NotebookReconciler:
         if self.opts.trusted_ca_configmap:
             await self._mirror_ca_bundle(nb)
 
+        # Queued provisioning: reserve the whole slice's capacity through
+        # a ProvisioningRequest BEFORE creating any worker — a partially
+        # scheduled gang on a scarce topology burns quota and wedges
+        # (every host must land together for ICI). Until Provisioned, no
+        # StatefulSet exists; the Services are still created below so
+        # DNS is ready the moment pods land.
+        capacity_pending = False
+        if (ms and nbapi.queued_provisioning(nb) and not nbapi.is_stopped(nb)
+                and self.opts.enable_queued_provisioning):
+            provisioned, capacity_requeue = await self._ensure_capacity(nb, ms)
+            if not provisioned:
+                # The reservation is a PRE-CREATE gate only: a gang that
+                # already exists (flag flipped on later, or the PR object
+                # deleted from under a running slice) must keep
+                # reconciling — freezing it would block spec drift and
+                # flip status to a false "waiting for capacity".
+                sts0 = ms.slice_sts_name(name_of(nb), 0)
+                if self._sts_informer is not None:
+                    existing = self._sts_informer.cache.get(
+                        (namespace_of(nb), sts0))
+                else:
+                    existing = await self.kube.get_or_none(
+                        "StatefulSet", sts0, namespace_of(nb))
+                capacity_pending = existing is None
+
         # One StatefulSet per slice (ICI placement is per-slice; DCN joins
         # them — tpu/topology.py MultiSlice). Single-slice keeps the bare
         # name, zero churn for the common case.
-        for slice_id in range(ms.num_slices if ms else 1):
+        for slice_id in range(0 if capacity_pending
+                              else (ms.num_slices if ms else 1)):
             sts = self.generate_statefulset(nb, tpu, multi=ms,
                                             slice_id=slice_id)
             created = await self._ensure(nb, sts)
@@ -233,8 +277,86 @@ class NotebookReconciler:
         requeue = await self._restart_broken_slice(nb, ms, pods)
         await self._check_maintenance(nb, pods)
         await self._mirror_events(nb, pods)
-        await self._update_status(nb, ms)
+        await self._update_status(nb, ms, capacity_pending=capacity_pending)
+        if capacity_pending:
+            return capacity_requeue
         return requeue
+
+    async def _ensure_capacity(self, nb: dict, ms) -> tuple[bool, Result | None]:
+        """Reserve the slice's capacity via a GKE ProvisioningRequest
+        (queued-provisioning.gke.io). Creates an owned PodTemplate (one
+        worker's pod shape — chips + node selectors drive what capacity
+        the autoscaler must find) and a ProvisioningRequest asking for
+        ``total_hosts`` of them, then reads its conditions:
+
+        - ``Provisioned=True`` → (True, None): create the StatefulSets;
+          their pods consume the reservation via CONSUME_PR_ANNOTATION.
+        - ``Failed=True`` → Warning event, long requeue (capacity class
+          rejected the request; flapping on it would spam the
+          autoscaler).
+        - otherwise → short requeue while the request queues.
+
+        Both objects are owner-referenced, so they die with the notebook.
+        A notebook that turns the flag off keeps its stale request until
+        deletion — harmless (Provisioned reservations expire server-side)
+        and cheaper than probing for it every reconcile."""
+        name, ns = name_of(nb), namespace_of(nb)
+        cap_name = bounded_name(f"{name}-capacity")
+        # Steady state: the PR informer already saw Provisioned=True —
+        # zero API calls and no throwaway template generation for the
+        # rest of the notebook's life.
+        cached = (self._pr_informer.cache.get((ns, cap_name))
+                  if self._pr_informer is not None else None)
+        if cached is not None and any(
+            c.get("type") == "Provisioned" and c.get("status") == "True"
+            for c in deep_get(cached, "status", "conditions", default=[]) or []
+        ):
+            return True, None
+        sts = self.generate_statefulset(nb, ms.slice, multi=ms, slice_id=0)
+        template = deep_get(sts, "spec", "template", default={})
+        pod_template = {
+            "apiVersion": "v1",
+            "kind": "PodTemplate",
+            "metadata": {"name": cap_name, "namespace": ns,
+                         "labels": {nbapi.NOTEBOOK_NAME_LABEL: name}},
+            "template": template,
+        }
+        await self._ensure(nb, pod_template)
+        pr = {
+            "apiVersion": "autoscaling.x-k8s.io/v1beta1",
+            "kind": "ProvisioningRequest",
+            "metadata": {"name": cap_name, "namespace": ns,
+                         "labels": {nbapi.NOTEBOOK_NAME_LABEL: name}},
+            "spec": {
+                "provisioningClassName": PROVISIONING_CLASS,
+                "podSets": [{
+                    "podTemplateRef": {"name": cap_name},
+                    "count": ms.total_hosts,
+                }],
+            },
+        }
+        created = await self._ensure(nb, pr)
+        if created:
+            await self.recorder.event(
+                nb, "Normal", "CapacityRequested",
+                f"Created ProvisioningRequest {cap_name} for "
+                f"{ms.total_hosts} TPU host(s); workers start once "
+                "capacity is provisioned",
+            )
+        live = await self.kube.get_or_none("ProvisioningRequest", cap_name, ns)
+        conditions = deep_get(live, "status", "conditions", default=[]) or []
+        by_type = {c.get("type"): c for c in conditions}
+        if (by_type.get("Provisioned") or {}).get("status") == "True":
+            return True, None
+        failed = by_type.get("Failed") or {}
+        if failed.get("status") == "True":
+            await self.recorder.event(
+                nb, "Warning", "CapacityFailed",
+                f"ProvisioningRequest {cap_name} failed: "
+                f"{failed.get('reason', '')} {failed.get('message', '')}",
+            )
+            return False, Result(requeue_after=300.0)
+        return False, Result(requeue_after=15.0)
 
     async def _ensure_pipeline_rbac(self, nb: dict) -> None:
         """odh notebook_rbac.go:36-154 analogue: if the pipelines Role
@@ -333,6 +455,12 @@ class NotebookReconciler:
                 main, pod_spec, template_annotations, template_labels, nb, tpu,
                 multi=multi, slice_id=slice_id,
             )
+            if nbapi.queued_provisioning(nb):
+                # Consume the capacity _ensure_capacity reserved instead
+                # of triggering fresh (and possibly partial) scale-up.
+                template_annotations[CONSUME_PR_ANNOTATION] = bounded_name(
+                    f"{name}-capacity")
+                template_annotations[PR_CLASS_ANNOTATION] = PROVISIONING_CLASS
         containers[0] = main
         pod_spec["containers"] = containers
 
@@ -935,10 +1063,13 @@ class NotebookReconciler:
                 f"[pod {involved['name']}] {ev.get('message', '')}",
             )
 
-    async def _update_status(self, nb: dict, ms) -> None:
+    async def _update_status(self, nb: dict, ms, *,
+                             capacity_pending: bool = False) -> None:
         """Mirror STS/pod state into the CR (reference :228-349): readyReplicas,
         containerState of worker 0's server container, condition history.
-        Multislice: readyReplicas sums across every slice's StatefulSet."""
+        Multislice: readyReplicas sums across every slice's StatefulSet.
+        ``capacity_pending``: queued provisioning hasn't delivered yet —
+        surfaced via status.tpu so the UI can say why nothing runs."""
         tpu = ms.slice if ms else None
         ns, name = namespace_of(nb), name_of(nb)
         ready = 0
@@ -986,6 +1117,13 @@ class NotebookReconciler:
                 "readyHosts": ready,
                 "chips": ms.num_chips if ms else 0,
                 "slices": ms.num_slices if ms else 0,
+                # Merge-patch semantics: flag present → True; flag stale
+                # on the live object → explicit None deletes it; neither
+                # → omit (no churn).
+                **({"capacityPending": True} if capacity_pending else
+                   ({"capacityPending": None}
+                    if deep_get(nb, "status", "tpu", "capacityPending")
+                    else {})),
             },
         }
         if deep_get(nb, "status") != status:
@@ -1112,6 +1250,15 @@ def _condition_from_state(state: dict) -> dict | None:
     return None
 
 
+def provisioning_request_to_notebook(pr: dict) -> list[tuple]:
+    """Map ProvisioningRequest events (Provisioned/Failed condition
+    flips) back to the waiting Notebook via the notebook-name label."""
+    name = (get_meta(pr).get("labels") or {}).get(nbapi.NOTEBOOK_NAME_LABEL)
+    if not name:
+        return []
+    return [(namespace_of(pr), name)]
+
+
 def pod_to_notebook(pod: dict) -> list[tuple]:
     """Map pod events to their Notebook (reference SetupWithManager watch by
     ``notebook-name`` label, notebook_controller.go:739-787)."""
@@ -1148,7 +1295,9 @@ def setup_notebook_controller(
             watches=[
                 Watch("Pod", pod_to_notebook),
                 Watch("Event", event_to_notebook),
-            ],
+            ] + ([Watch("ProvisioningRequest",
+                        provisioning_request_to_notebook)]
+                 if rec.opts.enable_queued_provisioning else []),
         )
     )
     # _mirror_events and _update_status read the watch caches the Watch /
@@ -1159,6 +1308,8 @@ def setup_notebook_controller(
     rec._event_informer = mgr.informer_for("Event")
     rec._sts_informer = mgr.informer_for("StatefulSet")
     rec._nb_informer = mgr.informer_for("Notebook")
+    if rec.opts.enable_queued_provisioning:
+        rec._pr_informer = mgr.informer_for("ProvisioningRequest")
     if rec.opts.maintenance_taints:
         # Maintenance taints land on Nodes, not on anything the Notebook
         # owns — watch Nodes and re-enqueue the notebooks whose workers

@@ -72,6 +72,9 @@ _CORE = [
     GVK("", "v1", "PersistentVolumeClaim", "persistentvolumeclaims"),
     GVK("", "v1", "ResourceQuota", "resourcequotas"),
     GVK("", "v1", "Node", "nodes", namespaced=False),
+    GVK("", "v1", "PodTemplate", "podtemplates"),
+    GVK("autoscaling.x-k8s.io", "v1beta1", "ProvisioningRequest",
+        "provisioningrequests"),
     GVK("apps", "v1", "StatefulSet", "statefulsets"),
     GVK("apps", "v1", "Deployment", "deployments"),
     GVK("rbac.authorization.k8s.io", "v1", "Role", "roles"),

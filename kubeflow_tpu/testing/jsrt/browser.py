@@ -187,7 +187,7 @@ class Browser:
         el = self.query(target) if isinstance(target, str) else target
         if el is None:
             raise BrowserError(f"no element matches {target!r}")
-        result = self.document.dispatch(el, dom.Event("click"))
+        result = dom.activate(self.document, el)
         self.check_rejections()
         return result
 

@@ -91,6 +91,21 @@ class TextNode(DomNode):
         return super().js_get_prop(name, interp)
 
 
+def activate(doc, el) -> bool:
+    """Click with the browser's pre-dispatch activation behavior: a
+    checkbox toggles (a radio sets) its checked state before listeners
+    see the event."""
+    if isinstance(el, Element) and el.tag == "input":
+        input_type = el.attrs.get("type")
+        if input_type == "checkbox":
+            current = el._checked if el._checked is not None \
+                else ("checked" in el.attrs)
+            el._checked = not current
+        elif input_type == "radio":
+            el._checked = True
+    return doc.dispatch(el, Event("click"))
+
+
 class Event(JSObject):
     class_name = "Event"
 
@@ -464,7 +479,7 @@ class Element(DomNode):
             return _method(name, lambda this, args: undefined)
         if name == "click":
             def click(this, args):
-                return doc.dispatch(self, Event("click"))
+                return activate(doc, self)
             return _method(name, click)
         if name == "getContext":
             def get_context(this, args):

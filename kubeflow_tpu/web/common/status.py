@@ -74,6 +74,14 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     conditions = deep_get(notebook, "status", "conditions", default=[])
     want_hosts = deep_get(notebook, "status", "tpu", "hosts", default=1) or 1
 
+    # Queued provisioning: nothing runs yet *by design* — more specific
+    # than any age/pod-state heuristic below, so it goes first.
+    if deep_get(notebook, "status", "tpu", "capacityPending"):
+        return Status(
+            WAITING,
+            "Waiting for TPU capacity (queued ProvisioningRequest)",
+        )
+
     # Brand-new CR: show a benign waiting message for the first seconds.
     if not container_state and not conditions and _age_seconds(notebook) <= 10:
         return Status(WAITING, "Waiting for StatefulSet to create the underlying Pod.")

@@ -144,6 +144,12 @@ def _tpu_from_form(config: dict, body: dict) -> dict | None:
             out["numSlices"] = int(num_slices)
         except ValueError:
             raise Invalid(f"form: numSlices must be an integer, got {num_slices!r}")
+    queued = tpu.get("queuedProvisioning")
+    if queued not in (None, False, True):
+        raise Invalid(
+            f"form: queuedProvisioning must be a boolean, got {queued!r}")
+    if queued:
+        out["queuedProvisioning"] = True
     return out
 
 

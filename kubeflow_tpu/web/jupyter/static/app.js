@@ -116,8 +116,9 @@ function renderTopologies() {
 }
 
 function renderNumSlices() {
-  /* Multislice (DCN-joined slices) only makes sense with a TPU selected:
-   * show the slice-count stepper then, hide (and reset) it for CPU. */
+  /* Multislice (DCN-joined slices) and queued provisioning only make
+   * sense with a TPU selected: show those controls then, hide (and
+   * reset) them for CPU. */
   const acc = document.getElementById("tpu-acc").value;
   const input = document.getElementById("num-slices");
   const label = document.getElementById("num-slices-label");
@@ -125,6 +126,10 @@ function renderNumSlices() {
   input.style.display = show;
   label.style.display = show;
   if (!acc) input.value = "1";
+  document.getElementById("queued-label").style.display = show;
+  document.getElementById("queued-row").style.display =
+    acc ? "inline-flex" : "none";
+  if (!acc) document.getElementById("queued-prov").checked = false;
 }
 
 /* ---------------- details drawer ---------------------------------------- */
@@ -579,6 +584,9 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
     };
     const slices = parseInt(form.get("numSlices"), 10);
     if (slices > 1) payload.tpu.numSlices = slices;
+    if (document.getElementById("queued-prov").checked) {
+      payload.tpu.queuedProvisioning = true;
+    }
   }
   if (!form.get("workspace")) payload.workspaceVolume = null;
   if (form.get("dataVolume")) {

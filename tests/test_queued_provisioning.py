@@ -1,0 +1,246 @@
+"""Queued provisioning: spec.tpu.queuedProvisioning gates slice creation
+on a GKE ProvisioningRequest (queued-provisioning.gke.io).
+
+Large TPU topologies are scarce; scheduling a gang before the capacity
+exists burns quota on a half-placed slice that can never wire ICI. With
+the flag on, the controller reserves all hosts through a
+ProvisioningRequest first, surfaces "waiting for capacity" in status,
+and only creates the StatefulSets — whose pods consume the reservation
+via the cluster-autoscaler annotation — once Provisioned=True.
+"""
+
+import asyncio
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import (
+    CONSUME_PR_ANNOTATION,
+    PR_CLASS_ANNOTATION,
+    PROVISIONING_CLASS,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get, get_meta
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.web.common.status import process_status
+from kubeflow_tpu.webhooks import register_all
+
+
+class Harness:
+    def __init__(self):
+        self.kube = FakeKube()
+        register_all(self.kube)
+        self.mgr = Manager(self.kube)
+        setup_notebook_controller(self.mgr)
+        self.sim = PodSimulator(self.kube)
+
+    async def __aenter__(self):
+        await self.mgr.start()
+        await self.sim.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.sim.stop()
+        await self.mgr.stop()
+        self.kube.close_watches()
+
+    async def settle(self, rounds=8):
+        for _ in range(rounds):
+            await self.mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.02)
+
+    async def provision(self, cap_name, ns="ns"):
+        await self.kube.patch(
+            "ProvisioningRequest", cap_name,
+            {"status": {"conditions": [
+                {"type": "Provisioned", "status": "True"}]}},
+            ns, subresource="status")
+
+
+async def test_queued_slice_waits_then_starts():
+    async with Harness() as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("big", "ns", accelerator="v5e",
+                                  topology="4x4", queued=True))
+        await h.settle()
+
+        # No workers yet — but the reservation exists, sized to the gang.
+        assert await h.kube.get_or_none("StatefulSet", "big", "ns") is None
+        pr = await h.kube.get("ProvisioningRequest", "big-capacity", "ns")
+        assert deep_get(pr, "spec", "provisioningClassName") == \
+            PROVISIONING_CLASS
+        podset = deep_get(pr, "spec", "podSets")[0]
+        assert podset["count"] == 2
+        assert podset["podTemplateRef"]["name"] == "big-capacity"
+        # The PodTemplate carries the TPU shape capacity must match.
+        pt = await h.kube.get("PodTemplate", "big-capacity", "ns")
+        res = deep_get(pt, "template", "spec", "containers")[0]["resources"]
+        assert res["limits"]["google.com/tpu"] == "8"
+        # Both owned → die with the notebook.
+        assert get_meta(pr).get("ownerReferences")
+        assert get_meta(pt).get("ownerReferences")
+
+        # Status + events say why nothing is running.
+        nb = await h.kube.get("Notebook", "big", "ns")
+        assert deep_get(nb, "status", "tpu", "capacityPending") is True
+        status = process_status(nb)
+        assert status.phase == "waiting"
+        assert "TPU capacity" in status.message
+        events = await h.kube.list("Event", "ns")
+        assert any(e.get("reason") == "CapacityRequested" for e in events)
+
+        # Capacity lands → gang starts, consuming the reservation.
+        await h.provision("big-capacity")
+        await h.settle(12)
+        sts = await h.kube.get("StatefulSet", "big", "ns")
+        anns = deep_get(sts, "spec", "template", "metadata", "annotations")
+        assert anns[CONSUME_PR_ANNOTATION] == "big-capacity"
+        assert anns[PR_CLASS_ANNOTATION] == PROVISIONING_CLASS
+        nb = await h.kube.get("Notebook", "big", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+        assert not deep_get(nb, "status", "tpu", "capacityPending")
+        assert process_status(nb).phase == "ready"
+
+
+async def test_failed_provisioning_surfaces_warning():
+    async with Harness() as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("starved", "ns", accelerator="v5p",
+                                  topology="2x2x2", queued=True))
+        await h.settle()
+        await h.kube.patch(
+            "ProvisioningRequest", "starved-capacity",
+            {"status": {"conditions": [
+                {"type": "Failed", "status": "True",
+                 "reason": "OutOfStock",
+                 "message": "no v5p capacity in zone"}]}},
+            "ns", subresource="status")
+        await h.settle()
+        events = await h.kube.list("Event", "ns")
+        failed = [e for e in events if e.get("reason") == "CapacityFailed"]
+        assert failed and "OutOfStock" in failed[0]["message"]
+        assert await h.kube.get_or_none("StatefulSet", "starved", "ns") is None
+
+
+async def test_multislice_reserves_all_hosts():
+    async with Harness() as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("ms", "ns", accelerator="v5e",
+                                  topology="4x4", num_slices=2, queued=True))
+        await h.settle()
+        pr = await h.kube.get("ProvisioningRequest", "ms-capacity", "ns")
+        assert deep_get(pr, "spec", "podSets")[0]["count"] == 4  # 2 slices × 2
+        await h.provision("ms-capacity")
+        await h.settle(12)
+        for j in range(2):
+            assert await h.kube.get_or_none(
+                "StatefulSet", f"ms-s{j}", "ns") is not None
+
+
+async def test_unqueued_notebook_creates_no_request():
+    async with Harness() as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("plain", "ns", accelerator="v5e",
+                                  topology="2x2"))
+        await h.settle()
+        assert await h.kube.get_or_none(
+            "ProvisioningRequest", "plain-capacity", "ns") is None
+        sts = await h.kube.get("StatefulSet", "plain", "ns")
+        anns = deep_get(sts, "spec", "template", "metadata",
+                        "annotations", default={}) or {}
+        assert CONSUME_PR_ANNOTATION not in anns
+
+
+def test_validation_rejects_non_bool_flag():
+    nb = nbapi.new("bad", "ns", accelerator="v5e", topology="2x2")
+    nb["spec"]["tpu"]["queuedProvisioning"] = "yes"
+    try:
+        nbapi.validate(nb)
+        raise AssertionError("non-bool queuedProvisioning accepted")
+    except Invalid:
+        pass
+
+
+def test_queued_checkbox_flows_from_ui_to_spec():
+    """The spawner's queued-provisioning checkbox (shown only when a TPU
+    is selected) lands on spec.tpu.queuedProvisioning through the real
+    form POST, and the created notebook waits on the ProvisioningRequest."""
+    from kubeflow_tpu.testing.jsweb import JsWebHarness
+    from kubeflow_tpu.web.jupyter import create_app as create_jwa
+
+    with JsWebHarness(create_jwa) as h:
+        b = h.browser
+        b.local_storage["kubeflow.namespace"] = "team"
+        b.load("/")
+        b.click("#new-btn")
+        # Hidden for CPU-only; appears when an accelerator is picked.
+        assert b.query("#queued-row").style.props.get("display") == "none"
+        b.set_value('#new-form input[name="name"]', "queued-ui")
+        b.change("#tpu-acc", "v5e")
+        b.change("#tpu-topo", "4x4")
+        assert b.query("#queued-row").style.props.get("display") == "inline-flex"
+        b.click("#queued-prov")
+        b.submit("#new-form")
+        nb = h.kube_get("Notebook", "queued-ui", "team")
+        assert nb is not None
+        assert nb["spec"]["tpu"].get("queuedProvisioning") is True
+        h.poll_ui()
+        assert h.kube_get("StatefulSet", "queued-ui", "team") is None
+        assert h.kube_get(
+            "ProvisioningRequest", "queued-ui-capacity", "team") is not None
+
+
+async def test_flag_flipped_on_running_gang_does_not_freeze():
+    """Enabling queuedProvisioning on an already-running slice must not
+    park reconciliation or flip status to a false capacity wait — the
+    reservation is a pre-create gate only."""
+    async with Harness() as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("late", "ns", accelerator="v5e",
+                                  topology="4x4"))
+        await h.settle(10)
+        nb = await h.kube.get("Notebook", "late", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+
+        await h.kube.patch(
+            "Notebook", "late",
+            {"spec": {"tpu": {"queuedProvisioning": True}}}, "ns")
+        await h.settle(10)
+        nb = await h.kube.get("Notebook", "late", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+        assert not deep_get(nb, "status", "tpu", "capacityPending")
+        assert process_status(nb).phase == "ready"
+        # The gang still reconciles: spec drift propagates.
+        assert await h.kube.get_or_none("StatefulSet", "late", "ns")
+
+
+async def test_disabled_option_runs_queued_spec_unqueued():
+    """Clusters without the ProvisioningRequest CRD disable the feature;
+    a queued spec then runs immediately and no PR objects are created."""
+    from kubeflow_tpu.controllers.notebook import NotebookOptions
+
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    setup_notebook_controller(
+        mgr, NotebookOptions(enable_queued_provisioning=False))
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    try:
+        await kube.create(
+            "Notebook", nbapi.new("noqp", "ns", accelerator="v5e",
+                                  topology="4x4", queued=True))
+        for _ in range(10):
+            await mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.02)
+        assert await kube.get_or_none("StatefulSet", "noqp", "ns") is not None
+        assert await kube.get_or_none(
+            "ProvisioningRequest", "noqp-capacity", "ns") is None
+        nb = await kube.get("Notebook", "noqp", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        kube.close_watches()
