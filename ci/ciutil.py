@@ -1,0 +1,18 @@
+"""Shared helpers for the CI e2e scripts (run as ``python ci/<script>.py``,
+so sibling imports resolve via sys.path[0])."""
+
+import asyncio
+import time
+
+
+async def wait_for(fn, budget: float, what: str, *, interval: float = 2.0):
+    """Poll ``fn`` (async, returns None while unsatisfied) until it yields
+    a value or the budget runs out; SystemExit on timeout so the CI step
+    fails with the missing condition named."""
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        result = await fn()
+        if result is not None:
+            return result
+        await asyncio.sleep(interval)
+    raise SystemExit(f"FAIL: {what} not satisfied within {budget}s")

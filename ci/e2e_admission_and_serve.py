@@ -26,9 +26,9 @@ from __future__ import annotations
 
 import asyncio
 import sys
-import time
 
 import aiohttp
+from ciutil import wait_for
 
 from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.runtime.httpclient import HttpKube
@@ -49,15 +49,6 @@ SERVER_PY = (
     "http.server.HTTPServer(('0.0.0.0',8888),H).serve_forever()"
 )
 
-
-async def wait_for(fn, budget: float, what: str):
-    deadline = time.monotonic() + budget
-    while time.monotonic() < deadline:
-        result = await fn()
-        if result is not None:
-            return result
-        await asyncio.sleep(2)
-    raise SystemExit(f"FAIL: {what} not satisfied within {budget}s")
 
 
 async def admission_leg(kube: HttpKube, ns: str) -> None:

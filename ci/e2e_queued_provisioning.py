@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""KinD e2e: the queued-provisioning gate against a REAL apiserver.
+
+Creates a queued TPU Notebook, asserts the controller holds the gang
+behind a ProvisioningRequest (no StatefulSet), then plays autoscaler —
+patches the PR's status subresource to Provisioned=True (the stub CRD
+from manifests/thirdparty/ has the status subresource, so this exercises
+the same RBAC/subresource path the real autoscaler uses) — and asserts
+the StatefulSet appears carrying the consume annotation. Pod readiness is
+out of scope: KinD has no google.com/tpu capacity to schedule.
+"""
+
+import asyncio
+import sys
+
+from ciutil import wait_for
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import CONSUME_PR_ANNOTATION
+from kubeflow_tpu.runtime.httpclient import HttpKube
+from kubeflow_tpu.runtime.objects import deep_get
+
+
+async def main(namespace: str) -> int:
+    kube = HttpKube()
+    name = "queued-e2e"
+    await kube.create(
+        "Notebook",
+        nbapi.new(name, namespace, accelerator="v5e", topology="4x4",
+                  queued=True))
+    print(f"created queued Notebook {namespace}/{name}")
+
+    pr = await wait_for(
+        lambda: kube.get_or_none(
+            "ProvisioningRequest", f"{name}-capacity", namespace),
+        60, "ProvisioningRequest")
+    assert deep_get(pr, "spec", "podSets")[0]["count"] == 2, pr["spec"]
+    # The gate held: still no StatefulSet while unprovisioned.
+    assert await kube.get_or_none("StatefulSet", name, namespace) is None, (
+        "gang created before capacity was provisioned")
+
+    # The status write lands after PR creation — poll, don't race it.
+    async def pending_flag():
+        nb = await kube.get("Notebook", name, namespace)
+        return deep_get(nb, "status", "tpu", "capacityPending")
+
+    assert await wait_for(pending_flag, 60, "capacityPending=True") is True
+    print("gate held: PR created, no StatefulSet, capacityPending=True")
+
+    # Play autoscaler: flip Provisioned via the status subresource.
+    await kube.patch(
+        "ProvisioningRequest", f"{name}-capacity",
+        {"status": {"conditions": [
+            {"type": "Provisioned", "status": "True",
+             "lastTransitionTime": "2026-01-01T00:00:00Z"}]}},
+        namespace, subresource="status")
+    sts = await wait_for(
+        lambda: kube.get_or_none("StatefulSet", name, namespace),
+        60, "StatefulSet after Provisioned=True")
+    anns = deep_get(sts, "spec", "template", "metadata", "annotations",
+                    default={}) or {}
+    assert anns.get(CONSUME_PR_ANNOTATION) == f"{name}-capacity", anns
+    print("provisioned: StatefulSet created with consume annotation")
+    await kube.delete("Notebook", name, namespace)
+    await kube.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main(sys.argv[1] if len(sys.argv) > 1 else "default")))

@@ -134,7 +134,10 @@ def workflow_kind_integration() -> dict:
                      "with": {"cluster_name": "kubeflow-tpu-ci"}},
                     setup_python(),
                     run(None, "pip install -e . aiohttp pytest pyyaml"),
-                    run("Install CRDs", "kubectl apply -f manifests/crds/"),
+                    run("Install CRDs (+ stub ProvisioningRequest CRD — "
+                        "KinD has no GKE autoscaler)",
+                        "kubectl apply -f manifests/crds/\n"
+                        "kubectl apply -f manifests/thirdparty/\n"),
                     run("Self-signed webhook cert (SAN = docker bridge gateway)",
                         "mkdir -p certs\n"
                         "openssl req -x509 -newkey rsa:2048 -nodes -days 1 \\\n"
@@ -160,6 +163,8 @@ def workflow_kind_integration() -> dict:
                         "python ci/wait_notebook_ready.py ci-test test-notebook 100"),
                     run("e2e: per-ordinal admission env + HTTP GET through the Service",
                         "python ci/e2e_admission_and_serve.py ci-test"),
+                    run("e2e: queued provisioning gate against the real apiserver",
+                        "python ci/e2e_queued_provisioning.py ci-test"),
                 ],
             }
         },
