@@ -87,6 +87,13 @@ PR_CLASS_ANNOTATION = (
     "cluster-autoscaler.kubernetes.io/provisioning-class-name")
 
 
+def capacity_name(notebook_name: str) -> str:
+    """The one place the PR/PodTemplate/consume-annotation name contract
+    lives — three consumers must agree or the pods reference a request
+    that doesn't exist."""
+    return bounded_name(f"{notebook_name}-capacity")
+
+
 @dataclass
 class NotebookOptions:
     """The reference's env-var sprawl (USE_ISTIO, ISTIO_GATEWAY, CLUSTER_DOMAIN,
@@ -226,15 +233,23 @@ class NotebookReconciler:
         # StatefulSet exists; the Services are still created below so
         # DNS is ready the moment pods land.
         capacity_pending = False
+        if (ms and nbapi.queued_provisioning(nb) and nbapi.is_stopped(nb)
+                and self.opts.enable_queued_provisioning):
+            # Parked: the reservation is one-shot — its capacity was
+            # consumed (or expired) when the gang went away. Delete the
+            # request so a restart queues for FRESH capacity instead of
+            # sailing past the gate on a spent Provisioned=True.
+            await self._release_capacity(nb)
         if (ms and nbapi.queued_provisioning(nb) and not nbapi.is_stopped(nb)
                 and self.opts.enable_queued_provisioning):
             provisioned, capacity_requeue = await self._ensure_capacity(nb, ms)
             if not provisioned:
-                # The reservation is a PRE-CREATE gate only: a gang that
-                # already exists (flag flipped on later, or the PR object
-                # deleted from under a running slice) must keep
-                # reconciling — freezing it would block spec drift and
-                # flip status to a false "waiting for capacity".
+                # The gate holds unless the gang is ACTIVELY running
+                # (flag flipped on mid-flight, or the PR deleted from
+                # under a live slice — freezing those would block spec
+                # drift and flip status to a false capacity wait). A
+                # parked STS (replicas 0, reservation released on park)
+                # still gates: restart queues for fresh capacity.
                 sts0 = ms.slice_sts_name(name_of(nb), 0)
                 if self._sts_informer is not None:
                     existing = self._sts_informer.cache.get(
@@ -242,7 +257,9 @@ class NotebookReconciler:
                 else:
                     existing = await self.kube.get_or_none(
                         "StatefulSet", sts0, namespace_of(nb))
-                capacity_pending = existing is None
+                actively_running = existing is not None and (
+                    deep_get(existing, "spec", "replicas") or 0) > 0
+                capacity_pending = not actively_running
 
         # One StatefulSet per slice (ICI placement is per-slice; DCN joins
         # them — tpu/topology.py MultiSlice). Single-slice keeps the bare
@@ -301,7 +318,7 @@ class NotebookReconciler:
         deletion — harmless (Provisioned reservations expire server-side)
         and cheaper than probing for it every reconcile."""
         name, ns = name_of(nb), namespace_of(nb)
-        cap_name = bounded_name(f"{name}-capacity")
+        cap_name = capacity_name(name)
         # Steady state: the PR informer already saw Provisioned=True —
         # zero API calls and no throwaway template generation for the
         # rest of the notebook's life.
@@ -357,6 +374,35 @@ class NotebookReconciler:
             )
             return False, Result(requeue_after=300.0)
         return False, Result(requeue_after=15.0)
+
+    async def _release_capacity(self, nb: dict) -> None:
+        """Drop a parked notebook's ProvisioningRequest (informer-checked,
+        so steady-state parked notebooks cost nothing). The PodTemplate
+        stays — it's inert and the next queue-up reuses the name."""
+        name, ns = name_of(nb), namespace_of(nb)
+        cap_name = capacity_name(name)
+        cached = (self._pr_informer.cache.get((ns, cap_name))
+                  if self._pr_informer is not None
+                  else await self.kube.get_or_none(
+                      "ProvisioningRequest", cap_name, ns))
+        if cached is None:
+            return
+        try:
+            await self.kube.delete("ProvisioningRequest", cap_name, ns)
+        except NotFound:
+            return
+        # Evict the deleted PR from the informer cache NOW: a restart
+        # reconcile can land before the watch task processes the DELETE,
+        # and _ensure_capacity's fast path would trust the stale
+        # Provisioned=True — sailing past the very gate this release
+        # exists to re-arm.
+        if self._pr_informer is not None:
+            self._pr_informer.cache.pop((ns, cap_name), None)
+        await self.recorder.event(
+            nb, "Normal", "CapacityReleased",
+            f"Deleted ProvisioningRequest {cap_name}: the reservation is "
+            "one-shot; restarting will queue for fresh capacity",
+        )
 
     async def _ensure_pipeline_rbac(self, nb: dict) -> None:
         """odh notebook_rbac.go:36-154 analogue: if the pipelines Role
@@ -458,8 +504,8 @@ class NotebookReconciler:
             if nbapi.queued_provisioning(nb):
                 # Consume the capacity _ensure_capacity reserved instead
                 # of triggering fresh (and possibly partial) scale-up.
-                template_annotations[CONSUME_PR_ANNOTATION] = bounded_name(
-                    f"{name}-capacity")
+                template_annotations[CONSUME_PR_ANNOTATION] = \
+                    capacity_name(name)
                 template_annotations[PR_CLASS_ANNOTATION] = PROVISIONING_CLASS
         containers[0] = main
         pod_spec["containers"] = containers

@@ -244,3 +244,80 @@ async def test_disabled_option_runs_queued_spec_unqueued():
         await sim.stop()
         await mgr.stop()
         kube.close_watches()
+
+
+async def test_park_releases_reservation_and_restart_requeues():
+    """The reservation is one-shot: stopping a queued notebook deletes
+    its ProvisioningRequest; restarting queues for fresh capacity (the
+    parked StatefulSet stays at 0 until the new request provisions)."""
+    async with Harness() as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("cycle", "ns", accelerator="v5e",
+                                  topology="4x4", queued=True))
+        await h.settle()
+        await h.provision("cycle-capacity")
+        await h.settle(12)
+        nb = await h.kube.get("Notebook", "cycle", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+
+        # Park: the spent reservation is released.
+        await h.kube.patch(
+            "Notebook", "cycle",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: "t"}}},
+            "ns")
+        await h.settle(10)
+        assert await h.kube.get_or_none(
+            "ProvisioningRequest", "cycle-capacity", "ns") is None
+        events = await h.kube.list("Event", "ns")
+        assert any(e.get("reason") == "CapacityReleased" for e in events)
+
+        # Restart: a FRESH request queues; the gang stays down until it
+        # provisions (the stale Provisioned=True must not leak through).
+        await h.kube.patch(
+            "Notebook", "cycle",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: None}}},
+            "ns")
+        await h.settle(10)
+        pr = await h.kube.get("ProvisioningRequest", "cycle-capacity", "ns")
+        assert not deep_get(pr, "status", "conditions", default=[])
+        sts = await h.kube.get("StatefulSet", "cycle", "ns")
+        assert deep_get(sts, "spec", "replicas") == 0
+        nb = await h.kube.get("Notebook", "cycle", "ns")
+        assert deep_get(nb, "status", "tpu", "capacityPending") is True
+
+        await h.provision("cycle-capacity")
+        await h.settle(12)
+        nb = await h.kube.get("Notebook", "cycle", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+
+
+async def test_release_evicts_informer_cache():
+    """_release_capacity must evict the deleted PR from the informer
+    cache synchronously: a restart reconcile can run before the watch
+    task processes the DELETE, and the fast path would trust the stale
+    Provisioned=True — sailing past the re-armed gate."""
+    from kubeflow_tpu.controllers.notebook import NotebookReconciler
+
+    kube = FakeKube()
+    register_all(kube)
+    rec = NotebookReconciler(kube)
+    pr = {"apiVersion": "autoscaling.x-k8s.io/v1beta1",
+          "kind": "ProvisioningRequest",
+          "metadata": {"name": "stale-capacity", "namespace": "ns"},
+          "spec": {},
+          "status": {"conditions": [
+              {"type": "Provisioned", "status": "True"}]}}
+    await kube.create("ProvisioningRequest", pr)
+
+    class FakeInformer:
+        cache = {("ns", "stale-capacity"): pr}
+
+    rec._pr_informer = FakeInformer()
+    nb = nbapi.new("stale", "ns", accelerator="v5e", topology="4x4",
+                   queued=True)
+    await kube.create("Notebook", nb)
+    await rec._release_capacity(nb)
+    assert ("ns", "stale-capacity") not in FakeInformer.cache
+    assert await kube.get_or_none(
+        "ProvisioningRequest", "stale-capacity", "ns") is None
+    kube.close_watches()
