@@ -49,6 +49,16 @@ def notebook_options():
         auth_proxy_image=os.environ.get("AUTH_PROXY_IMAGE"),
         pipeline_access_role=env_str("PIPELINE_ACCESS_ROLE",
                                      "pipeline-user-access") or None,
+        # Comma-separated taint keys; empty string disables the mirror.
+        maintenance_taints=tuple(
+            t.strip() for t in env_str(
+                "MAINTENANCE_TAINTS",
+                "cloud.google.com/impending-node-termination").split(",")
+            if t.strip()
+        ),
+        # Off for clusters without the ProvisioningRequest CRD.
+        enable_queued_provisioning=env_bool("ENABLE_QUEUED_PROVISIONING",
+                                            True),
     )
 
 

@@ -233,14 +233,15 @@ class NotebookReconciler:
         # StatefulSet exists; the Services are still created below so
         # DNS is ready the moment pods land.
         capacity_pending = False
-        if (ms and nbapi.queued_provisioning(nb) and nbapi.is_stopped(nb)
-                and self.opts.enable_queued_provisioning):
+        if (ms and nbapi.queued_provisioning(nb)
+                and self.opts.enable_queued_provisioning
+                and nbapi.is_stopped(nb)):
             # Parked: the reservation is one-shot — its capacity was
             # consumed (or expired) when the gang went away. Delete the
             # request so a restart queues for FRESH capacity instead of
             # sailing past the gate on a spent Provisioned=True.
             await self._release_capacity(nb)
-        if (ms and nbapi.queued_provisioning(nb) and not nbapi.is_stopped(nb)
+        elif (ms and nbapi.queued_provisioning(nb)
                 and self.opts.enable_queued_provisioning):
             provisioned, capacity_requeue = await self._ensure_capacity(nb, ms)
             if not provisioned:
@@ -387,15 +388,18 @@ class NotebookReconciler:
                       "ProvisioningRequest", cap_name, ns))
         if cached is None:
             return
+        # Evict from the informer cache regardless of how the delete
+        # goes: a restart reconcile can land before the watch task
+        # processes the DELETE (ours or an out-of-band one), and
+        # _ensure_capacity's fast path would trust the stale
+        # Provisioned=True — sailing past the very gate this release
+        # exists to re-arm.
         try:
             await self.kube.delete("ProvisioningRequest", cap_name, ns)
         except NotFound:
+            if self._pr_informer is not None:
+                self._pr_informer.cache.pop((ns, cap_name), None)
             return
-        # Evict the deleted PR from the informer cache NOW: a restart
-        # reconcile can land before the watch task processes the DELETE,
-        # and _ensure_capacity's fast path would trust the stale
-        # Provisioned=True — sailing past the very gate this release
-        # exists to re-arm.
         if self._pr_informer is not None:
             self._pr_informer.cache.pop((ns, cap_name), None)
         await self.recorder.event(

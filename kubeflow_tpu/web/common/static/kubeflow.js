@@ -791,7 +791,7 @@ KF.drawer = function (title) {
 /* The panel the reference never needed: worker-by-worker slice health.
  * tpu: spec.tpu {accelerator, topology}; tpuStatus: status.tpu
  * {hosts, readyHosts, chips}; pods: [{name, ready}] worker pod list. */
-KF.sliceRollup = function (container, tpu, tpuStatus, pods) {
+KF.sliceRollup = function (container, tpu, tpuStatus, pods, opts = {}) {
   if (!tpu) {
     container.replaceChildren(
       KF.el("p", { class: "muted" }, "CPU-only notebook (no TPU slice).")
@@ -801,6 +801,29 @@ KF.sliceRollup = function (container, tpu, tpuStatus, pods) {
   const hosts = (tpuStatus && tpuStatus.hosts) || 1;
   const ready = (tpuStatus && tpuStatus.readyHosts) || 0;
   const chips = (tpuStatus && tpuStatus.chips) || "?";
+  const banners = [];
+  if (tpuStatus && tpuStatus.capacityPending) {
+    banners.push(
+      KF.el(
+        "p",
+        { class: "kf-capacity-banner" },
+        "⏳ Waiting for TPU capacity — a queued ProvisioningRequest is ",
+        "reserving all " + hosts + " host(s); workers start when it is ",
+        "provisioned."
+      )
+    );
+  }
+  if (opts.maintenancePending) {
+    banners.push(
+      KF.el(
+        "p",
+        { class: "kf-maintenance-banner" },
+        "⚠ Node maintenance pending on " + opts.maintenancePending +
+          " — checkpoint your work; the slice restarts when the node(s) " +
+          "go down."
+      )
+    );
+  }
   const workers = KF.el(
     "div",
     { class: "slice-grid" },
@@ -816,9 +839,11 @@ KF.sliceRollup = function (container, tpu, tpuStatus, pods) {
     })
   );
   container.replaceChildren(
+    ...banners,
     KF.detailsList([
       ["Accelerator", tpu.accelerator],
       ["Topology", tpu.topology],
+      ["Slices", tpu.numSlices > 1 ? String(tpu.numSlices) : null],
       ["Chips", String(chips)],
       ["Hosts ready", ready + " / " + hosts],
     ]),

@@ -192,11 +192,18 @@ function openDetails(nb) {
             ])
           );
           const pods = await podsFor().catch(() => []);
+          const nbAnns =
+            (body.notebook.metadata && body.notebook.metadata.annotations) ||
+            {};
           KF.sliceRollup(
             slice,
             body.notebook.spec && body.notebook.spec.tpu,
             body.notebook.status && body.notebook.status.tpu,
-            pods
+            pods,
+            {
+              maintenancePending:
+                nbAnns["notebooks.kubeflow.org/maintenance-pending"],
+            }
           );
         }
         load().catch(KF.showError);

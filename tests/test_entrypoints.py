@@ -53,3 +53,19 @@ async def test_webapp_builds_and_serves(which, monkeypatch):
 def test_build_app_rejects_unknown_flavor():
     with pytest.raises(SystemExit, match="unknown WEBAPP"):
         build_app(FakeKube(), "nope")
+
+
+def test_notebook_options_env_round3(monkeypatch):
+    """Round-3 knobs reach NotebookOptions from env: maintenance taint
+    list (comma-separated, empty disables) and the queued-provisioning
+    switch for clusters without the PR CRD."""
+    from kubeflow_tpu.cmd import envconfig
+
+    monkeypatch.setenv("MAINTENANCE_TAINTS", "x.io/drain, y.io/maint")
+    monkeypatch.setenv("ENABLE_QUEUED_PROVISIONING", "false")
+    opts = envconfig.notebook_options()
+    assert opts.maintenance_taints == ("x.io/drain", "y.io/maint")
+    assert opts.enable_queued_provisioning is False
+
+    monkeypatch.setenv("MAINTENANCE_TAINTS", "")
+    assert envconfig.notebook_options().maintenance_taints == ()

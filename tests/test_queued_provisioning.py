@@ -321,3 +321,39 @@ async def test_release_evicts_informer_cache():
     assert await kube.get_or_none(
         "ProvisioningRequest", "stale-capacity", "ns") is None
     kube.close_watches()
+
+
+def test_drawer_banners_for_capacity_and_maintenance():
+    """The details drawer's slice rollup surfaces the two control-plane
+    warnings: capacity pending (queued provisioning) and maintenance
+    pending (taint mirror annotation)."""
+    from kubeflow_tpu.testing.jsweb import JsWebHarness
+    from kubeflow_tpu.web.jupyter import create_app as create_jwa
+
+    with JsWebHarness(create_jwa) as h:
+        b = h.browser
+        b.local_storage["kubeflow.namespace"] = "team"
+        h.kube_create("Notebook", nbapi.new(
+            "banners", "team", accelerator="v5e", topology="4x4",
+            queued=True))
+        b.load("/")
+        h.poll_ui()
+        row = [el for el in b.query_all("#notebook-table tbody tr")
+               if "banners" in el.text_content()][0]
+        b.click(row)
+        text = b.text(".kf-drawer")
+        assert "Waiting for TPU capacity" in text
+
+        # Maintenance annotation appears (controller mirror) → banner on
+        # the next drawer open.
+        close = b.query_all(".kf-drawer-head button")[0]
+        b.click(close)
+        h.kube_patch("Notebook", "banners", {"metadata": {"annotations": {
+            nbapi.MAINTENANCE_ANNOTATION: "tpu-node-a"}}}, "team")
+        h.poll_ui()
+        row = [el for el in b.query_all("#notebook-table tbody tr")
+               if "banners" in el.text_content()][0]
+        b.click(row)
+        text = b.text(".kf-drawer")
+        assert "maintenance pending on tpu-node-a" in text
+        assert "checkpoint your work" in text
