@@ -35,7 +35,10 @@ from kubeflow_tpu.runtime.deployment import controller_namespace  # noqa: E402,F
 
 
 def notebook_options():
-    from kubeflow_tpu.controllers.notebook import NotebookOptions
+    from kubeflow_tpu.controllers.notebook import (
+        DEFAULT_MAINTENANCE_TAINTS,
+        NotebookOptions,
+    )
 
     return NotebookOptions(
         use_istio=env_bool("USE_ISTIO", False),
@@ -53,7 +56,7 @@ def notebook_options():
         maintenance_taints=tuple(
             t.strip() for t in env_str(
                 "MAINTENANCE_TAINTS",
-                "cloud.google.com/impending-node-termination").split(",")
+                ",".join(DEFAULT_MAINTENANCE_TAINTS)).split(",")
             if t.strip()
         ),
         # Off for clusters without the ProvisioningRequest CRD.

@@ -389,19 +389,19 @@ class NotebookReconciler:
         if cached is None:
             return
         # Evict from the informer cache regardless of how the delete
-        # goes: a restart reconcile can land before the watch task
-        # processes the DELETE (ours or an out-of-band one), and
-        # _ensure_capacity's fast path would trust the stale
+        # goes (already-gone, transient apiserver error, success): a
+        # restart reconcile can land before the watch task processes the
+        # DELETE, and _ensure_capacity's fast path would trust the stale
         # Provisioned=True — sailing past the very gate this release
-        # exists to re-arm.
+        # exists to re-arm. If the PR actually still exists, the watch
+        # repopulates the cache.
         try:
             await self.kube.delete("ProvisioningRequest", cap_name, ns)
         except NotFound:
+            return
+        finally:
             if self._pr_informer is not None:
                 self._pr_informer.cache.pop((ns, cap_name), None)
-            return
-        if self._pr_informer is not None:
-            self._pr_informer.cache.pop((ns, cap_name), None)
         await self.recorder.event(
             nb, "Normal", "CapacityReleased",
             f"Deleted ProvisioningRequest {cap_name}: the reservation is "
@@ -505,9 +505,14 @@ class NotebookReconciler:
                 main, pod_spec, template_annotations, template_labels, nb, tpu,
                 multi=multi, slice_id=slice_id,
             )
-            if nbapi.queued_provisioning(nb):
+            if (nbapi.queued_provisioning(nb)
+                    and self.opts.enable_queued_provisioning):
                 # Consume the capacity _ensure_capacity reserved instead
                 # of triggering fresh (and possibly partial) scale-up.
+                # Gated on the SAME flag as the reconcile gate: with the
+                # feature off no request exists, and a consume annotation
+                # for a nonexistent request parks the pods forever (the
+                # autoscaler won't scale up for them).
                 template_annotations[CONSUME_PR_ANNOTATION] = \
                     capacity_name(name)
                 template_annotations[PR_CLASS_ANNOTATION] = PROVISIONING_CLASS

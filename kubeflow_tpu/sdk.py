@@ -329,3 +329,17 @@ class CheckpointGuard:
             else:
                 self._armed = True
         return self.manager.save(step, pytree)
+
+
+def _main() -> None:
+    """``python -m kubeflow_tpu.sdk`` — print this worker's slice identity
+    as one JSON line (the in-pod debugging companion to
+    ``python -m kubeflow_tpu.probe``)."""
+    import dataclasses
+    import json
+
+    print(json.dumps(dataclasses.asdict(SliceInfo.from_env())))
+
+
+if __name__ == "__main__":
+    _main()

@@ -240,6 +240,13 @@ async def test_disabled_option_runs_queued_spec_unqueued():
             "ProvisioningRequest", "noqp-capacity", "ns") is None
         nb = await kube.get("Notebook", "noqp", "ns")
         assert deep_get(nb, "status", "readyReplicas") == 2
+        # No consume annotation either — it would reference a request
+        # that never exists, parking the pods forever (the autoscaler
+        # refuses to scale up for consumers of a missing PR).
+        sts = await kube.get("StatefulSet", "noqp", "ns")
+        anns = deep_get(sts, "spec", "template", "metadata",
+                        "annotations", default={}) or {}
+        assert CONSUME_PR_ANNOTATION not in anns
     finally:
         await sim.stop()
         await mgr.stop()
