@@ -9,7 +9,11 @@ import os
 from aiohttp import web
 
 from kubeflow_tpu.runtime.httpclient import HttpKube
-from kubeflow_tpu.webhooks.server import create_webhook_app, ssl_context
+from kubeflow_tpu.webhooks.server import (
+    create_webhook_app,
+    rotate_certs,
+    ssl_context,
+)
 
 
 async def amain() -> None:
@@ -38,9 +42,15 @@ async def amain() -> None:
         ssl_context=ctx,
     )
     await site.start()
+    # cert-manager/service-ca renew the mounted certs in place; reload
+    # them into the live context so admission never needs a pod restart.
+    rotator = (asyncio.create_task(rotate_certs(ctx, cert, key))
+               if ctx is not None else None)
     try:
         await asyncio.Event().wait()
     finally:
+        if rotator is not None:
+            rotator.cancel()
         await runner.cleanup()
         await kube.close()
 

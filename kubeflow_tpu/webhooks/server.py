@@ -222,3 +222,38 @@ def ssl_context(cert_file: str, key_file: str) -> ssl.SSLContext:
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(cert_file, key_file)
     return ctx
+
+
+async def rotate_certs(ctx: ssl.SSLContext, cert_file: str, key_file: str,
+                       *, watcher=None, poll_seconds: float = 30.0) -> None:
+    """Reload renewed certs into the live SSLContext — cert-manager /
+    service-ca rotate the files in place, and ``load_cert_chain`` on an
+    in-use context makes every NEW handshake present the new chain, so
+    the admission server never needs the pod restart the reference
+    relies on. Half-written files mid-rotation (cert swapped before key)
+    fail the load and retry on the next change event. Run as an asyncio
+    task; cancel to stop."""
+    from kubeflow_tpu.utils.fswatch import FileWatcher
+
+    w = watcher or FileWatcher(cert_file)
+    retry_pending = False
+    try:
+        while True:
+            changed = await w.wait(timeout=poll_seconds)
+            # Only the cert file's mtime is watched; a renewal that
+            # writes cert-then-key can fail the load on the first event
+            # and never fire another. While a failed load is pending,
+            # retry on every wakeup (timeouts included) until it sticks.
+            if not changed and not retry_pending:
+                continue
+            try:
+                ctx.load_cert_chain(cert_file, key_file)
+                log.info("webhook TLS certs reloaded from %s", cert_file)
+                retry_pending = False
+            except (ssl.SSLError, OSError) as e:
+                log.warning("cert reload failed (mid-rotation?): %s — "
+                            "will retry", e)
+                retry_pending = True
+    finally:
+        if hasattr(w, "close"):
+            w.close()
