@@ -71,6 +71,9 @@ IMAGE_SELECTION_ANNOTATION = "notebooks.kubeflow.org/last-image-selection"
 
 # Restart protocol (reference: culler pkg + odh webhook "update-pending"):
 RESTART_ANNOTATION = "notebooks.kubeflow.org/restart"
+# Stamped by the restart-blocking webhook when a live pod-affecting edit
+# was reverted (webhooks/notebook.py); read by the status machine.
+UPDATE_PENDING_ANNOTATION = "notebooks.kubeflow.org/update-pending"
 
 # Controller-mirrored impending-maintenance signal: comma-joined nodes
 # hosting this notebook's TPU workers that carry a maintenance taint

@@ -46,6 +46,20 @@ async def amain() -> None:
     # them into the live context so admission never needs a pod restart.
     rotator = (asyncio.create_task(rotate_certs(ctx, cert, key))
                if ctx is not None else None)
+    if rotator is not None:
+        def _rotator_died(task):
+            if task.cancelled():
+                return
+            # An unexpected failure must not silently end rotation — the
+            # cert would quietly age out and admission would start
+            # failing cluster-wide. Crash loudly; the pod restarts with
+            # fresh certs and a fresh rotator.
+            exc = task.exception()
+            if exc is not None:
+                logging.getLogger(__name__).critical(
+                    "cert rotator died: %s", exc)
+                raise SystemExit(1)
+        rotator.add_done_callback(_rotator_died)
     try:
         await asyncio.Event().wait()
     finally:

@@ -104,6 +104,15 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
                 f"Running — node maintenance pending on {pending}; "
                 "checkpoint your work",
             )
+        # The webhook reverted a live pod-affecting edit (restart
+        # blocking, reference maybeRestartRunningNotebook): the change
+        # was NOT applied — say so, and say what to do.
+        if annotations.get(nbapi.UPDATE_PENDING_ANNOTATION):
+            return Status(
+                READY,
+                "Running — a configuration change was blocked while the "
+                "server is running; stop it and re-apply the change",
+            )
         if want_hosts > 1:
             return Status(READY, f"Running ({ready}/{want_hosts} TPU workers)")
         return Status(READY, "Running")

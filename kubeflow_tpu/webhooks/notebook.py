@@ -21,7 +21,7 @@ import weakref
 from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.runtime.objects import annotations_of, deep_get, deepcopy
 
-UPDATE_PENDING_ANNOTATION = "notebooks.kubeflow.org/update-pending"
+UPDATE_PENDING_ANNOTATION = nbapi.UPDATE_PENDING_ANNOTATION
 
 # Spec paths whose change forces a pod restart (the template IS the pod;
 # the tpu block changes replicas/selectors/env).

@@ -247,12 +247,20 @@ async def rotate_certs(ctx: ssl.SSLContext, cert_file: str, key_file: str,
             if not changed and not retry_pending:
                 continue
             try:
+                # Validate the pair in a throwaway context FIRST:
+                # load_cert_chain installs the cert before the key check
+                # can raise, so loading a half-rotated pair directly
+                # into the live context would leave it serving
+                # new-cert/old-key — a handshake outage, not a stale
+                # cert. Only a pair that loads cleanly touches ctx.
+                probe = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                probe.load_cert_chain(cert_file, key_file)
                 ctx.load_cert_chain(cert_file, key_file)
                 log.info("webhook TLS certs reloaded from %s", cert_file)
                 retry_pending = False
             except (ssl.SSLError, OSError) as e:
                 log.warning("cert reload failed (mid-rotation?): %s — "
-                            "will retry", e)
+                            "will retry; old chain keeps serving", e)
                 retry_pending = True
     finally:
         if hasattr(w, "close"):

@@ -463,3 +463,20 @@ async def test_jwa_num_slices_rejects_bool_and_float():
     one = _tpu_from_form(config, {"tpu": {
         "accelerator": "v5e", "topology": "4x4", "numSlices": 1}})
     assert "numSlices" not in one
+
+
+def test_status_surfaces_blocked_live_edit():
+    """The restart-blocking webhook reverts live pod-affecting edits and
+    stamps update-pending; the status machine must tell the user the
+    change was NOT applied (reference maybeRestartRunningNotebook)."""
+    from kubeflow_tpu.api import notebook as nbapi
+    from kubeflow_tpu.web.common.status import process_status
+
+    nb = nbapi.new("edited", "ns")
+    nb["metadata"]["annotations"] = {
+        "notebooks.kubeflow.org/update-pending": "true"}
+    nb["status"] = {"readyReplicas": 1, "tpu": {"hosts": 1}}
+    status = process_status(nb)
+    assert status.phase == "ready"
+    assert "blocked" in status.message
+    assert "stop" in status.message
