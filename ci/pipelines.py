@@ -133,7 +133,7 @@ def workflow_kind_integration() -> dict:
                     {"uses": "helm/kind-action@v1",
                      "with": {"cluster_name": "kubeflow-tpu-ci"}},
                     setup_python(),
-                    run(None, "pip install -e . aiohttp pytest pyyaml"),
+                    run(None, "pip install -e . aiohttp pytest pyyaml jax"),
                     run("Install CRDs (+ stub ProvisioningRequest CRD — "
                         "KinD has no GKE autoscaler)",
                         "kubectl apply -f manifests/crds/\n"
@@ -165,6 +165,9 @@ def workflow_kind_integration() -> dict:
                         "python ci/e2e_admission_and_serve.py ci-test"),
                     run("e2e: queued provisioning gate against the real apiserver",
                         "python ci/e2e_queued_provisioning.py ci-test"),
+                    run("Conformance against the live cluster "
+                        "(simulator-only checks skip)",
+                        "python -m conformance.run --live"),
                 ],
             }
         },
