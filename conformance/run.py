@@ -370,9 +370,9 @@ class Conformance:
             "friend@example.com", "list", "Notebook", "conf-authz")
 
     async def check_profile_v1beta1(self):
+        """Profile served at v1beta1 normalizes to storage v1 (round 3)."""
         if self.sim is None:
             raise Skip("hermetic-only: live conversion goes via the webhook")
-        """Profile served at v1beta1 normalizes to storage v1 (round 3)."""
         p = profileapi.new("conf-beta", "beta@example.com")
         p["apiVersion"] = "kubeflow.org/v1beta1"
         await self.kube.create("Profile", p)

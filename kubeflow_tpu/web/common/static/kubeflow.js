@@ -767,8 +767,11 @@ KF.drawer = function (title) {
     KF.el(
       "div",
       { class: "kf-drawer-head" },
-      KF.el("h2", {}, title),
-      KF.el("button", { onclick: close }, "✕")
+      KF.titleActionsToolbar({
+        title,
+        actions: [KF.el("button", { onclick: close, "aria-label": "close" },
+                        "✕")],
+      })
     ),
     content
   );
