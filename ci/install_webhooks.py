@@ -29,7 +29,10 @@ def transform(host: str, port: int, ca_path: str) -> str:
     ca_bundle = base64.b64encode(Path(ca_path).read_bytes()).decode()
     out = []
     for doc in yaml.safe_load_all(MANIFEST.read_text()):
-        if not doc or doc.get("kind") != "MutatingWebhookConfiguration":
+        if not doc or doc.get("kind") not in (
+            "MutatingWebhookConfiguration",
+            "ValidatingWebhookConfiguration",
+        ):
             continue  # Deployment/Service stay out: the server runs on host
         doc.setdefault("metadata", {}).pop("annotations", None)  # cert-manager
         for hook in doc.get("webhooks", []):

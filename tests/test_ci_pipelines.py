@@ -85,14 +85,17 @@ def test_webhook_install_transform():
         f.write("FAKE CA PEM")
         f.flush()
         docs = list(yaml.safe_load_all(transform("10.0.0.9", 9443, f.name)))
-    assert len(docs) == 1
-    hooks = docs[0]["webhooks"]
-    names = {h["name"] for h in hooks}
+    # Mutating + Validating configurations both ride along.
+    assert {d["kind"] for d in docs} == {
+        "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration"}
+    names = {h["name"] for d in docs for h in d["webhooks"]}
     assert "tpu-worker-env.kubeflow-tpu.dev" in names   # the load-bearing one
-    for hook in hooks:
-        cc = hook["clientConfig"]
-        assert "service" not in cc
-        assert cc["url"].startswith("https://10.0.0.9:9443/")
-        assert base64.b64decode(cc["caBundle"]) == b"FAKE CA PEM"
-    # cert-manager injection annotation dropped (no cert-manager on host).
-    assert "annotations" not in docs[0].get("metadata", {})
+    assert "validate-poddefaults.kubeflow-tpu.dev" in names
+    for doc in docs:
+        for hook in doc["webhooks"]:
+            cc = hook["clientConfig"]
+            assert "service" not in cc
+            assert cc["url"].startswith("https://10.0.0.9:9443/")
+            assert base64.b64decode(cc["caBundle"]) == b"FAKE CA PEM"
+        # cert-manager injection annotation dropped (no cert-manager on host).
+        assert "annotations" not in doc.get("metadata", {})
