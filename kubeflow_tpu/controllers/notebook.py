@@ -233,6 +233,7 @@ class NotebookReconciler:
         # StatefulSet exists; the Services are still created below so
         # DNS is ready the moment pods land.
         capacity_pending = False
+        capacity_provisioned = True
         if (ms and nbapi.queued_provisioning(nb)
                 and self.opts.enable_queued_provisioning
                 and nbapi.is_stopped(nb)):
@@ -244,6 +245,7 @@ class NotebookReconciler:
         elif (ms and nbapi.queued_provisioning(nb)
                 and self.opts.enable_queued_provisioning):
             provisioned, capacity_requeue = await self._ensure_capacity(nb, ms)
+            capacity_provisioned = provisioned
             if not provisioned:
                 # The gate holds unless the gang is ACTIVELY running
                 # (flag flipped on mid-flight, or the PR deleted from
@@ -252,12 +254,7 @@ class NotebookReconciler:
                 # parked STS (replicas 0, reservation released on park)
                 # still gates: restart queues for fresh capacity.
                 sts0 = ms.slice_sts_name(name_of(nb), 0)
-                if self._sts_informer is not None:
-                    existing = self._sts_informer.cache.get(
-                        (namespace_of(nb), sts0))
-                else:
-                    existing = await self.kube.get_or_none(
-                        "StatefulSet", sts0, namespace_of(nb))
+                existing = await self._live_sts(sts0, namespace_of(nb))
                 actively_running = existing is not None and (
                     deep_get(existing, "spec", "replicas") or 0) > 0
                 capacity_pending = not actively_running
@@ -267,8 +264,16 @@ class NotebookReconciler:
         # name, zero churn for the common case.
         for slice_id in range(0 if capacity_pending
                               else (ms.num_slices if ms else 1)):
-            sts = self.generate_statefulset(nb, tpu, multi=ms,
-                                            slice_id=slice_id)
+            sts = self.generate_statefulset(
+                nb, tpu, multi=ms, slice_id=slice_id,
+                capacity_provisioned=capacity_provisioned)
+            if not capacity_provisioned:
+                # Sticky consume annotation: when the request is (or has
+                # become) unprovisioned over a LIVE gang — e.g. the PR was
+                # deleted from under it and recreated — keep whatever the
+                # running StatefulSet already carries. Stripping it would
+                # diff the template and rolling-restart a healthy slice.
+                await self._preserve_consume_annotation(nb, sts)
             created = await self._ensure(nb, sts)
             if created:
                 self.m_create.inc()
@@ -300,6 +305,40 @@ class NotebookReconciler:
             return capacity_requeue
         return requeue
 
+    async def _live_sts(self, name: str, ns: str) -> dict | None:
+        """Informer-cached StatefulSet read with apiserver fallback. The
+        controller owns StatefulSets, so the informer is always running
+        under the manager (a 64-slice notebook would otherwise pay 64
+        serialized GETs per reconcile); staleness self-corrects on the
+        next STS event."""
+        if self._sts_informer is not None:
+            return self._sts_informer.get(name, ns)
+        return await self.kube.get_or_none("StatefulSet", name, ns)
+
+    async def _preserve_consume_annotation(self, nb: dict, sts: dict) -> None:
+        """Copy the live StatefulSet's consume-provisioning-request
+        annotations onto the freshly generated template when the request
+        is not (currently) Provisioned. Two cases meet here:
+
+        - PR deleted/recreated under a live consuming gang → the live
+          template has the annotation; keeping it avoids a spurious
+          rolling restart, and the recreated request reuses the same name.
+        - Mid-flight flip (flag turned on over a running gang on a
+          cluster without the admission webhook) → the live template has
+          no annotation; generating none means no rollout until the
+          request actually provisions (an unprovisioned consume reference
+          would park replacement pods behind the autoscaler)."""
+        live = await self._live_sts(name_of(sts), namespace_of(nb))
+        live_anns = deep_get(live, "spec", "template", "metadata",
+                             "annotations", default={}) or {}
+        if CONSUME_PR_ANNOTATION not in live_anns:
+            return
+        meta = sts["spec"]["template"].setdefault("metadata", {})
+        anns = meta.setdefault("annotations", {})
+        anns[CONSUME_PR_ANNOTATION] = live_anns[CONSUME_PR_ANNOTATION]
+        if PR_CLASS_ANNOTATION in live_anns:
+            anns[PR_CLASS_ANNOTATION] = live_anns[PR_CLASS_ANNOTATION]
+
     async def _ensure_capacity(self, nb: dict, ms) -> tuple[bool, Result | None]:
         """Reserve the slice's capacity via a GKE ProvisioningRequest
         (queued-provisioning.gke.io). Creates an owned PodTemplate (one
@@ -330,7 +369,12 @@ class NotebookReconciler:
             for c in deep_get(cached, "status", "conditions", default=[]) or []
         ):
             return True, None
-        sts = self.generate_statefulset(nb, ms.slice, multi=ms, slice_id=0)
+        # The PR's capacity template must not self-reference the request:
+        # the autoscaler matches on shape (resources/selectors), and a
+        # consume annotation inside the template it provisions against is
+        # at best noise, at worst a circular reference.
+        sts = self.generate_statefulset(nb, ms.slice, multi=ms, slice_id=0,
+                                        capacity_provisioned=False)
         template = deep_get(sts, "spec", "template", default={})
         pod_template = {
             "apiVersion": "v1",
@@ -469,13 +513,22 @@ class NotebookReconciler:
     # ---- object generation ------------------------------------------------------
 
     def generate_statefulset(
-        self, nb: dict, tpu: TpuSlice | None, *, multi=None, slice_id: int = 0
+        self, nb: dict, tpu: TpuSlice | None, *, multi=None, slice_id: int = 0,
+        capacity_provisioned: bool = True,
     ) -> dict:
         """Reference: generateStatefulSet (notebook_controller.go:408-484).
 
         ``multi``/``slice_id``: in multislice mode each slice gets its own
         StatefulSet (``<name>-s<j>``) with slice-static MEGASCALE_* env;
-        they all share the notebook's headless Service for DNS."""
+        they all share the notebook's headless Service for DNS.
+
+        ``capacity_provisioned``: whether the notebook's ProvisioningRequest
+        (if any) is known Provisioned. The consume-provisioning-request
+        annotation is only stamped when True — a rolling update whose
+        replacement pods reference an *unprovisioned* request would park
+        them behind the autoscaler (the mid-flight-flip case: the flag
+        turned on over an already-running gang). Once the request
+        provisions, the next reconcile rolls the consume annotation on."""
         name, ns = name_of(nb), namespace_of(nb)
         sts_name = multi.slice_sts_name(name, slice_id) if multi else name
         replicas = 0 if nbapi.is_stopped(nb) else (tpu.num_hosts if tpu else 1)
@@ -506,7 +559,8 @@ class NotebookReconciler:
                 multi=multi, slice_id=slice_id,
             )
             if (nbapi.queued_provisioning(nb)
-                    and self.opts.enable_queued_provisioning):
+                    and self.opts.enable_queued_provisioning
+                    and capacity_provisioned):
                 # Consume the capacity _ensure_capacity reserved instead
                 # of triggering fresh (and possibly partial) scale-up.
                 # Gated on the SAME flag as the reconcile gate: with the
@@ -1134,10 +1188,7 @@ class NotebookReconciler:
             # 64 serialized apiserver GETs per reconcile. The controller
             # owns StatefulSets, so this informer is always running under
             # the manager; staleness self-corrects on the next STS event.
-            if self._sts_informer is not None:
-                sts = self._sts_informer.get(sts_name, ns)
-            else:
-                sts = await self.kube.get_or_none("StatefulSet", sts_name, ns)
+            sts = await self._live_sts(sts_name, ns)
             ready += deep_get(sts or {}, "status", "readyReplicas", default=0) or 0
 
         container_state: dict = {}

@@ -32,7 +32,10 @@ source vs sampler vs prefetch, rebuilt jax-first with stdlib threading.
 from __future__ import annotations
 
 import queue
+import sys
 import threading
+import warnings
+import weakref
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator
 
@@ -119,6 +122,21 @@ class ShardedLoader:
                 f"({batch_size} × {num_processes})")
         self._pos = _Position(0, 0)
         self._order_cache: tuple[int, np.ndarray] | None = None
+        # Bumped by every explicit repositioning (skip/load_state_dict).
+        # A prefetcher's deferred rewind is only valid against the cursor
+        # state it observed; a user skip() in between must win.
+        self._cursor_moves = 0
+        # Serializes cursor claims across concurrent generators; counts
+        # every batch ever pulled (monotonic — never rewound), so a
+        # prefetcher can tell whether pulls other than its own happened.
+        self._iter_lock = threading.Lock()
+        self._total_pulls = 0
+        # The prefetcher currently wrapping this loader (weakref). A new
+        # prefetch() over the same loader closes the old one FIRST — the
+        # re-run-cell rebind `pf = prefetch(ld)` evaluates the RHS before
+        # the old pf's __del__, so relying on GC alone would start the new
+        # producer on the un-rewound cursor and then yank it back.
+        self._active_prefetch: weakref.ref | None = None
 
     # -- deterministic order -----------------------------------------------------
 
@@ -143,16 +161,42 @@ class ShardedLoader:
 
     def __iter__(self) -> Iterator:
         while True:
-            epoch, b = self._pos.epoch, self._pos.batch_in_epoch
-            # Process p takes batches p, p+P, p+2P, … of the global order.
-            global_batch = self.process_id + b * self.num_processes
-            batch = self.source(self._batch_indices(epoch, global_batch))
-            if self.transform is not None:
-                batch = self.transform(batch)
-            if b + 1 >= self.batches_per_process:
-                self._pos = _Position(epoch + 1, 0)
-            else:
-                self._pos = _Position(epoch, b + 1)
+            # Claim the position and advance the cursor atomically, BEFORE
+            # the heavy work: concurrent generators (a prefetch producer
+            # plus anything else iterating the same loader) must each get
+            # a distinct batch — unlocked read-modify-write of _pos loses
+            # updates, silently re-yielding or skipping batches. Indexing
+            # and transform stay outside the lock so pulls overlap.
+            with self._iter_lock:
+                claimed = self._pos
+                epoch, b = claimed.epoch, claimed.batch_in_epoch
+                # Process p takes batches p, p+P, p+2P, … of the global order.
+                global_batch = self.process_id + b * self.num_processes
+                idx = self._batch_indices(epoch, global_batch)
+                if b + 1 >= self.batches_per_process:
+                    self._pos = _Position(epoch + 1, 0)
+                else:
+                    self._pos = _Position(epoch, b + 1)
+                self._total_pulls += 1
+                my_serial = self._total_pulls
+                moves_at_claim = self._cursor_moves
+            try:
+                batch = self.source(idx)
+                if self.transform is not None:
+                    batch = self.transform(batch)
+            except BaseException:
+                # Hand the claim back when nothing else touched the
+                # cursor since: a direct reader that catches a transient
+                # source/transform error and re-iterates must retry this
+                # batch, not silently skip it. With interleaved pulls or
+                # an explicit reposition the claim stands (rolling back
+                # out of order would corrupt the other reader's stream).
+                with self._iter_lock:
+                    if (self._total_pulls == my_serial
+                            and self._cursor_moves == moves_at_claim):
+                        self._pos = claimed
+                        self._total_pulls -= 1
+                raise
             yield batch
 
     # -- resume -------------------------------------------------------------------
@@ -164,19 +208,69 @@ class ShardedLoader:
         ran (the trainer's step counter) and skip that many; the wrapped
         loader's own cursor runs ahead by the prefetch depth and must not
         be snapshotted."""
+        self._detach_prefetcher()
         epoch, b = divmod(int(n_batches), self.batches_per_process)
-        self._pos = _Position(epoch, b)
+        with self._iter_lock:
+            # Same lock as the iterator's cursor claim: a foreign
+            # reader's read-modify-write must not overwrite this.
+            self._pos = _Position(epoch, b)
+            self._cursor_moves += 1
+
+    def _detach_prefetcher(self, wait: float = 60.0) -> None:
+        """Stop any prefetcher currently producing from this loader. An
+        explicit reposition under a live producer is otherwise a race —
+        the producer could pull one more batch *after* the new position
+        lands, silently shifting the stream by one. Waits out a producer
+        wedged in a slow transform (up to ``wait`` seconds past close()'s
+        own short join) and retries the deferred rewind it skipped; only
+        a producer still running after that gets a RuntimeWarning."""
+        prev = (self._active_prefetch()
+                if self._active_prefetch is not None else None)
+        if prev is None:
+            return
+        prev.close()
+        t = prev._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=wait)
+            if t.is_alive():
+                warnings.warn(
+                    "a prefetch() over this ShardedLoader is still "
+                    "producing after {:.0f}s; the stream may shift — "
+                    "close() it explicitly first".format(wait),
+                    RuntimeWarning, stacklevel=3)
+                return
+        # Unconditional: an earlier close() may have skipped the rewind
+        # while the producer was still wedged, even if that thread has
+        # exited on its own by now. _try_rewind self-guards (once, only
+        # with the producer stopped and the cursor untouched).
+        prev._try_rewind()
+        self._active_prefetch = None
+
+    def _linear(self) -> int:
+        """Cursor as a monotonic batch count (epochs never rewind)."""
+        return (self._pos.epoch * self.batches_per_process
+                + self._pos.batch_in_epoch)
+
+    def rewind(self, n_batches: int) -> None:
+        """Move the cursor back ``n_batches`` (floored at the start).
+        Used by ``prefetch``'s close path to hand back read-ahead batches
+        the consumer never saw, so re-wrapping the same loader resumes
+        where the *consumer* stopped — not ``depth+1`` batches later."""
+        self.skip(max(0, self._linear() - int(n_batches)))
 
     def state_dict(self) -> dict:
         """Cursor snapshot — valid only for a directly-iterated loader
         (under ``prefetch`` the cursor includes the producer's read-ahead;
         use ``skip`` with the consumed-step count instead)."""
-        return {"epoch": self._pos.epoch,
-                "batch_in_epoch": self._pos.batch_in_epoch}
+        pos = self._pos  # single atomic read — no torn epoch/batch pair
+        return {"epoch": pos.epoch, "batch_in_epoch": pos.batch_in_epoch}
 
     def load_state_dict(self, state: dict) -> None:
-        self._pos = _Position(int(state["epoch"]),
-                              int(state["batch_in_epoch"]))
+        self._detach_prefetcher()
+        with self._iter_lock:
+            self._pos = _Position(int(state["epoch"]),
+                                  int(state["batch_in_epoch"]))
+            self._cursor_moves += 1
 
 
 def prefetch(batches: Iterator, *, depth: int = 2,
@@ -191,14 +285,46 @@ def prefetch(batches: Iterator, *, depth: int = 2,
     them for process lifetime.
 
     Note: the producer reads ahead, so the *upstream* iterator's position
-    runs up to ``depth + 1`` elements past what the consumer has seen —
-    snapshot resume state from consumed-step counts
+    runs up to ``depth + 1`` elements past what the consumer has seen.
+    When ``batches`` is a ``ShardedLoader`` directly, closing (or GC'ing)
+    the prefetcher **rewinds** its cursor by the read-ahead the consumer
+    never received — so the re-run-a-notebook-cell pattern (re-wrap the
+    same loader in a fresh ``prefetch``) resumes exactly where training
+    stopped instead of silently dropping ``depth+1`` batches. For any
+    other iterator, snapshot resume state from consumed-step counts
     (``ShardedLoader.skip``), not from the wrapped loader's cursor."""
     if depth < 1:
         raise ValueError("depth must be >= 1")
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
     stop = threading.Event()
+
+    # Rewind support: count every batch pulled from a ShardedLoader (each
+    # pull advances its cursor by exactly one) so close() can hand back
+    # the produced-but-unconsumed difference.
+    rewindable = batches if isinstance(batches, ShardedLoader) else None
+    produced = [0]
+    if rewindable is not None:
+        # Hand off from any previous prefetcher over this loader: detach
+        # (close + rewind) it BEFORE our producer starts pulling, so the
+        # new stream continues exactly where the old consumer stopped
+        # even when the old prefetcher is only dropped by the rebind
+        # itself (`pf = prefetch(ld)` evaluates the RHS first).
+        rewindable._detach_prefetcher()
+        src = iter(batches)
+
+        def counting():
+            # Counts SUCCESSFUL pulls only: a failed pull rolls its own
+            # cursor claim back inside ShardedLoader.__iter__ (when no
+            # other reader interleaved), so it must not count toward the
+            # close-time rewind either — the pair keeps
+            # `_total_pulls == _start_pulls + produced` exactly.
+            while not stop.is_set():
+                item = next(src)
+                produced[0] += 1
+                yield item
+
+        batches = counting()
 
     def put(item) -> bool:
         """Bounded put that gives up when the consumer is gone."""
@@ -222,9 +348,18 @@ def prefetch(batches: Iterator, *, depth: int = 2,
             return
         put((_END, None))
 
-    threading.Thread(target=producer, daemon=True,
-                     name="kftpu-data-prefetch").start()
-    return _Prefetcher(q, stop, _END)
+    thread = threading.Thread(target=producer, daemon=True,
+                              name="kftpu-data-prefetch")
+    pf = _Prefetcher(q, stop, _END, thread=thread,
+                     rewindable=rewindable, produced=produced)
+    if rewindable is not None:
+        # Snapshots must precede thread.start(): the producer pulls (and
+        # moves the cursor) the moment it runs.
+        pf._cursor_moves_seen = rewindable._cursor_moves
+        pf._start_pulls = rewindable._total_pulls
+        rewindable._active_prefetch = weakref.ref(pf)
+    thread.start()
+    return pf
 
 
 class _Prefetcher:
@@ -233,10 +368,19 @@ class _Prefetcher:
     the producer — a never-started generator's ``finally`` never runs,
     but ``__del__``/``close()`` here always do."""
 
-    def __init__(self, q, stop, end):
+    def __init__(self, q, stop, end, *, thread=None, rewindable=None,
+                 produced=None):
         self._q = q
         self._stop = stop
         self._end = end
+        self._thread = thread
+        self._rewindable = rewindable
+        self._produced = produced or [0]
+        self._consumed = 0
+        self._cursor_moves_seen = 0
+        self._start_pulls = 0
+        self._rewound = False
+        self._closed = False
         self._done = False
 
     def __iter__(self):
@@ -253,13 +397,69 @@ class _Prefetcher:
             if item[1] is not None:
                 raise item[1]
             raise StopIteration
+        self._consumed += 1
         return item
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         self._done = True
         self._stop.set()
+        if self._rewindable is None:
+            return
+        if sys is None or sys.is_finalizing():
+            # Interpreter teardown (final GC runs __del__): threading
+            # internals are already gone — joining would raise inside
+            # teardown, and a rewind is pointless with the process dying.
+            return
+        # Hand the read-ahead back: the producer stops within one put
+        # timeout of the stop flag; once it has, produced-consumed is
+        # exactly the batches the loader's cursor ran past the consumer.
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            if self._thread.is_alive():
+                # _try_rewind will refuse below; say so — a user who next
+                # iterates the loader DIRECTLY (no re-wrap, so no detach
+                # retry) would otherwise silently lose the read-ahead.
+                warnings.warn(
+                    "prefetch producer still running after close(); the "
+                    "loader cursor stays ahead by the read-ahead until a "
+                    "re-wrap in prefetch() retries the hand-back",
+                    RuntimeWarning, stacklevel=2)
+        self._try_rewind()
 
-    __del__ = close
+    def _try_rewind(self):
+        """Rewind the loader by the read-ahead, once, and only while it
+        is safe: the producer must be stopped (a live one could still
+        pull) and the cursor untouched since this prefetcher started
+        (skip/load_state_dict — a checkpoint resume — wins over a
+        relative rewind). Retried by _detach_prefetcher after it waits
+        out a producer close() gave up on."""
+        if self._rewound:
+            return
+        if self._thread is not None and self._thread.is_alive():
+            return
+        if self._rewindable._cursor_moves != self._cursor_moves_seen:
+            return
+        if (self._rewindable._total_pulls
+                != self._start_pulls + self._produced[0]):
+            # Pulls beyond our own happened: something else has been
+            # iterating the loader (e.g. it was re-wrapped as
+            # prefetch(iter(ld)) — an iterator, so the handoff couldn't
+            # see it). Rewinding under a foreign reader would re-deliver
+            # batches it already produced.
+            return
+        self._rewound = True
+        over = self._produced[0] - self._consumed
+        if over > 0:
+            self._rewindable.rewind(over)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — a destructor must never raise
+            pass
 
 
 def global_batches(batches: Iterator, mesh, spec) -> Iterator:

@@ -186,6 +186,8 @@ def _in_cluster_fetch(namespace: str, name: str):
     notebook image need not carry an HTTP client library)."""
     host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"  # bare IPv6 apiserver address (IPv6-only clusters)
     url = (f"https://{host}:{port}/apis/kubeflow.org/v1"
            f"/namespaces/{namespace}/notebooks/{name}")
     ctx = ssl.create_default_context(cafile=os.path.join(_SA_DIR, "ca.crt"))
@@ -239,6 +241,20 @@ class MaintenanceWatcher:
                 pass           # not take down the training loop
         return self._last
 
+    def _poll(self, stop: threading.Event) -> str | None:
+        """The poller thread's fetch. Commits to the shared check() cache
+        only while this generation is live — a stopped generation's
+        wedged fetch returning late must not poison ``_last`` for direct
+        check() callers (CheckpointGuard) or a successor poller."""
+        try:
+            val = self._fetch().get(MAINTENANCE_ANNOTATION) or None
+        except Exception:  # noqa: BLE001 — same policy as check()
+            return self._last
+        if not stop.is_set():
+            self._last = val
+            self._last_at = time.monotonic()
+        return val
+
     def start(self, callback) -> None:
         """callback(nodes: str) fires once each time maintenance becomes
         pending (not per poll). A callback exception is logged, not
@@ -248,12 +264,25 @@ class MaintenanceWatcher:
         must not stack a second poller)."""
         if self._thread is not None and self._thread.is_alive():
             return
-        self._stop = threading.Event()  # restartable after stop()
+        # Each generation gets ITS OWN event, bound into the closure: a
+        # stop() whose join times out (fetch wedged) followed by start()
+        # replaces self._stop — the old thread must keep seeing the set
+        # event, or it would un-suppress and fire its stale callback
+        # alongside the new poller.
+        stop = self._stop = threading.Event()  # restartable after stop()
 
         def loop():
             armed = True
-            while not self._stop.wait(self.interval):
-                pending = self.check(max_age=0.0)
+            # Poll before the first wait: a window already pending when the
+            # watcher starts must fire now, not up to `interval` later —
+            # that delay is exactly the time before a node termination.
+            while True:
+                if stop.is_set():
+                    return  # stop() raced the first poll: no late fetch
+                            # or callback on torn-down state
+                pending = self._poll(stop)
+                if stop.is_set():
+                    return  # stop() landed mid-fetch: no late callback
                 if pending and armed:
                     armed = False
                     try:
@@ -263,6 +292,8 @@ class MaintenanceWatcher:
                             "maintenance callback failed; still watching")
                 elif not pending:
                     armed = True
+                if stop.wait(self.interval):
+                    return
 
         self._thread = threading.Thread(
             target=loop, name="kftpu-maintenance-watch", daemon=True)

@@ -310,3 +310,285 @@ def test_fit_skip_batches_false_resume_equivalence(tmp_path):
 
     np.testing.assert_array_equal(
         np.asarray(full["params"]["w"]), np.asarray(resumed["params"]["w"]))
+
+
+def test_prefetch_close_rewinds_sharded_loader():
+    """Re-running a cell that re-wraps the SAME loader in prefetch must
+    resume where the consumer stopped — close() hands back the producer's
+    read-ahead (the silent-data-loss footgun from the round-3 advice)."""
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=7,
+                              process_id=0, num_processes=1)
+    reference = kfdata.ShardedLoader(make_source(), batch_size=8, seed=7,
+                                     process_id=0, num_processes=1)
+    expect = [y.tolist() for _, y in take(reference, 10)]
+
+    pf = kfdata.prefetch(ld, depth=3)  # loader passed directly → rewindable
+    got = [y.tolist() for _, y in [next(pf) for _ in range(4)]]
+    pf.close()  # == what GC does on cell re-run
+    assert got == expect[:4]
+
+    pf2 = kfdata.prefetch(ld, depth=3)
+    got2 = [y.tolist() for _, y in [next(pf2) for _ in range(6)]]
+    pf2.close()
+    assert got2 == expect[4:10], "read-ahead batches were dropped"
+
+
+def test_prefetch_iterator_arg_does_not_rewind():
+    """Passing iter(loader) (not the loader) keeps the documented
+    cursor-runs-ahead behavior — rewind only engages when prefetch can
+    see the ShardedLoader itself."""
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=7,
+                              process_id=0, num_processes=1)
+    import time as _time
+
+    pf = kfdata.prefetch(iter(ld), depth=3)
+    next(pf)
+
+    def linear():
+        st = ld.state_dict()
+        return st["epoch"] * ld.batches_per_process + st["batch_in_epoch"]
+
+    deadline = _time.time() + 5
+    while _time.time() < deadline and linear() < 3:
+        _time.sleep(0.01)  # let the producer read ahead
+    ahead = linear()
+    assert ahead >= 3
+    pf.close()
+    assert linear() == ahead  # cursor ran ahead and STAYED there
+
+
+def test_rewind_floors_at_start_and_crosses_epochs():
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=3,
+                              process_id=0, num_processes=1)
+    take(ld, 11)  # batches_per_process=8 → now epoch 1, batch 3
+    ld.rewind(5)
+    assert ld.state_dict() == {"epoch": 0, "batch_in_epoch": 6}
+    ld.rewind(100)
+    assert ld.state_dict() == {"epoch": 0, "batch_in_epoch": 0}
+
+
+def test_prefetch_rebind_without_close_continues_exactly():
+    """The literal re-run-cell pattern `pf = prefetch(ld)` (no explicit
+    close): the rebind evaluates the new prefetch FIRST, so the handoff —
+    not GC ordering — must guarantee the new stream continues where the
+    consumer stopped."""
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=9,
+                              process_id=0, num_processes=1)
+    reference = kfdata.ShardedLoader(make_source(), batch_size=8, seed=9,
+                                     process_id=0, num_processes=1)
+    expect = [y.tolist() for _, y in take(reference, 12)]
+
+    pf = kfdata.prefetch(ld, depth=3)
+    got = [y.tolist() for _, y in [next(pf) for _ in range(4)]]
+    assert got == expect[:4]
+    pf = kfdata.prefetch(ld, depth=3)  # rebind; old pf never closed
+    got2 = [y.tolist() for _, y in [next(pf) for _ in range(8)]]
+    pf.close()
+    assert got2 == expect[4:12], "handoff lost or duplicated batches"
+
+
+def test_prefetch_shutdown_del_is_silent():
+    """A process exiting with a live rewindable prefetcher (the normal
+    notebook case) must not print 'Exception ignored' tracebacks from
+    __del__ during interpreter teardown."""
+    import subprocess
+    import sys as _sys
+    import textwrap
+
+    code = textwrap.dedent("""
+        import numpy as np
+        from kubeflow_tpu import data as kfdata
+        x = np.arange(192, dtype=np.float32).reshape(64, 3)
+        y = np.arange(64, dtype=np.int32)
+        ld = kfdata.ShardedLoader(kfdata.ArraySource(x, y), batch_size=8,
+                                  seed=0, process_id=0, num_processes=1)
+        pf = kfdata.prefetch(ld, depth=3)
+        next(pf)
+        # exit with pf alive: final GC runs __del__ during teardown
+    """)
+    out = subprocess.run([_sys.executable, "-c", code], cwd="/root/repo",
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert "Exception ignored" not in out.stderr
+    assert "Traceback" not in out.stderr
+
+
+def test_skip_between_prefetchers_wins_over_rewind():
+    """Checkpoint-resume pattern: train under prefetch, then ld.skip(k)
+    to a restored step and re-wrap. The explicit reposition must win —
+    the old prefetcher's deferred rewind would drag the cursor off by
+    the read-ahead."""
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=17,
+                              process_id=0, num_processes=1)
+    reference = kfdata.ShardedLoader(make_source(), batch_size=8, seed=17,
+                                     process_id=0, num_processes=1)
+    expect = [y.tolist() for _, y in take(reference, 12)]
+
+    pf = kfdata.prefetch(ld, depth=3)
+    [next(pf) for _ in range(6)]   # consumed 6; produced up to 10
+    ld.skip(2)                     # resume from checkpoint at step 2
+    pf = kfdata.prefetch(ld, depth=3)  # handoff closes old pf AFTER skip
+    got = [y.tolist() for _, y in [next(pf) for _ in range(4)]]
+    pf.close()
+    assert got == expect[2:6], "deferred rewind clobbered the skip"
+
+
+def test_rebind_with_slow_transform_still_rewinds():
+    """Producer wedged in a >2s transform when the handoff happens:
+    close()'s short join gives up, but the handoff must wait the
+    producer out and still apply the rewind — not silently drop the
+    read-ahead."""
+    import threading
+    import time as _time
+
+    slow = threading.Event()
+
+    def transform(batch):
+        if slow.is_set():
+            _time.sleep(2.6)  # longer than close()'s 2.0s join
+        return batch
+
+    def mk(t=None):
+        return kfdata.ShardedLoader(make_source(), batch_size=8, seed=23,
+                                    process_id=0, num_processes=1,
+                                    transform=t)
+
+    expect = [y.tolist() for _, y in take(mk(), 4)]
+    ld = mk(transform)
+    pf = kfdata.prefetch(ld, depth=1)
+    first = next(pf)[1].tolist()
+    assert first == expect[0]
+    slow.set()  # the producer's NEXT pull sleeps past close()'s join
+    _time.sleep(0.3)  # let it enter the slow transform
+    slow.clear()
+    pf = kfdata.prefetch(ld, depth=1)  # rebind while producer wedged
+    got = next(pf)[1].tolist()
+    pf.close()
+    assert got == expect[1], "read-ahead dropped when join timed out"
+
+
+def test_rewrap_after_timed_out_close_still_rewinds():
+    """close() during a wedged transform times out and skips the rewind;
+    once the producer has exited on its own, a later re-wrap must still
+    hand the read-ahead back."""
+    import threading
+    import time as _time
+
+    slow = threading.Event()
+
+    def transform(batch):
+        if slow.is_set():
+            _time.sleep(2.6)  # outlasts close()'s 2.0s join
+        return batch
+
+    def mk(t=None):
+        return kfdata.ShardedLoader(make_source(), batch_size=8, seed=29,
+                                    process_id=0, num_processes=1,
+                                    transform=t)
+
+    expect = [y.tolist() for _, y in take(mk(), 4)]
+    ld = mk(transform)
+    pf = kfdata.prefetch(ld, depth=1)
+    assert next(pf)[1].tolist() == expect[0]
+    slow.set()
+    _time.sleep(0.3)          # producer enters the slow transform
+    slow.clear()
+    pf.close()                # 2s join times out; rewind skipped
+    t = pf._thread
+    t.join(timeout=10)        # producer finishes and exits on its own
+    assert not t.is_alive()
+    pf2 = kfdata.prefetch(ld, depth=1)  # re-wrap AFTER the thread died
+    got = next(pf2)[1].tolist()
+    pf2.close()
+    assert got == expect[1], "read-ahead dropped after timed-out close"
+
+
+def test_gc_of_old_prefetcher_never_rewinds_under_foreign_iterator():
+    """Mixed pattern: pf = prefetch(ld); ...; pf = prefetch(iter(ld)).
+    The new wrap is a plain iterator (invisible to the handoff), so the
+    old prefetcher's GC close must NOT rewind under the live foreign
+    producer — that would re-deliver already-produced batches."""
+    import time as _time
+
+    ld = kfdata.ShardedLoader(make_source(), batch_size=8, seed=31,
+                              process_id=0, num_processes=1)
+    pf = kfdata.prefetch(ld, depth=3)
+    next(pf)
+    old = pf
+    old_pulls = ld._total_pulls
+    pf = kfdata.prefetch(iter(ld), depth=3)  # foreign reader starts NOW
+    deadline = _time.time() + 5
+    while _time.time() < deadline and ld._total_pulls <= old_pulls:
+        _time.sleep(0.01)                    # let it pull something
+    assert ld._total_pulls > old_pulls
+    before = ld._linear()
+    old.close()                              # == GC of the old binding
+    # The foreign producer may legitimately pull MORE during close()'s
+    # join — but the old prefetcher must never have rewound the cursor.
+    assert not old._rewound
+    assert ld._linear() >= before, \
+        "old prefetcher rewound under a live foreign reader"
+    pf.close()
+
+
+def test_transform_exception_then_rewrap_retries_failed_batch():
+    """A transform/source exception mid-read-ahead must not silently
+    skip batches: the failed pull advanced the cursor, so the rewind
+    hands it back and a re-wrap retries it."""
+    calls = [0]
+
+    def flaky(batch):
+        calls[0] += 1
+        if calls[0] == 3:
+            raise RuntimeError("augmentation bug")
+        return batch
+
+    def mk(t=None):
+        return kfdata.ShardedLoader(make_source(), batch_size=8, seed=37,
+                                    process_id=0, num_processes=1,
+                                    transform=t)
+
+    expect = [y.tolist() for _, y in take(mk(), 4)]
+    ld = mk(flaky)
+    pf = kfdata.prefetch(ld, depth=2)
+    assert next(pf)[1].tolist() == expect[0]
+    got = [expect[0]]
+    with pytest.raises(RuntimeError, match="augmentation bug"):
+        while True:
+            got.append(next(pf)[1].tolist())
+    assert got == expect[:2]  # batch 2 died in transform
+
+    pf = kfdata.prefetch(ld, depth=2)  # re-run the cell
+    resumed = next(pf)[1].tolist()
+    pf.close()
+    assert resumed == expect[2], "failed batch was skipped, not retried"
+
+
+def test_direct_iteration_retries_failed_batch():
+    """Direct (non-prefetch) iteration: a transient source/transform
+    error must not consume the batch — re-iterating retries it (the
+    cursor claim is handed back)."""
+    calls = [0]
+
+    def flaky(batch):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise OSError("transient read error")
+        return batch
+
+    def mk(t=None):
+        return kfdata.ShardedLoader(make_source(), batch_size=8, seed=41,
+                                    process_id=0, num_processes=1,
+                                    transform=t)
+
+    expect = [y.tolist() for _, y in take(mk(), 3)]
+    ld = mk(flaky)
+    it = iter(ld)
+    assert next(it)[1].tolist() == expect[0]
+    with pytest.raises(OSError):
+        next(it)
+    assert ld.state_dict() == {"epoch": 0, "batch_in_epoch": 1}
+    # A fresh generator (or the same one is dead — generators die on
+    # raise) resumes at the failed batch.
+    got = [y.tolist() for _, y in take(ld, 2)]
+    assert got == expect[1:3], "failed batch was consumed, not retried"

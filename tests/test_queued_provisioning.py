@@ -28,9 +28,10 @@ from kubeflow_tpu.webhooks import register_all
 
 
 class Harness:
-    def __init__(self):
+    def __init__(self, webhooks: bool = True):
         self.kube = FakeKube()
-        register_all(self.kube)
+        if webhooks:
+            register_all(self.kube)
         self.mgr = Manager(self.kube)
         setup_notebook_controller(self.mgr)
         self.sim = PodSimulator(self.kube)
@@ -193,8 +194,10 @@ def test_queued_checkbox_flows_from_ui_to_spec():
 
 async def test_flag_flipped_on_running_gang_does_not_freeze():
     """Enabling queuedProvisioning on an already-running slice must not
-    park reconciliation or flip status to a false capacity wait — the
-    reservation is a pre-create gate only."""
+    park reconciliation or flip status to a false capacity wait. With
+    webhooks installed the live spec.tpu edit is itself blocked
+    (update-pending) — the flip only applies through a stop→start cycle,
+    which routes through the normal pre-create gate."""
     async with Harness() as h:
         await h.kube.create(
             "Notebook", nbapi.new("late", "ns", accelerator="v5e",
@@ -211,8 +214,103 @@ async def test_flag_flipped_on_running_gang_does_not_freeze():
         assert deep_get(nb, "status", "readyReplicas") == 2
         assert not deep_get(nb, "status", "tpu", "capacityPending")
         assert process_status(nb).phase == "ready"
+        # The webhook held the live edit back and flagged the restart.
+        assert not nbapi.queued_provisioning(nb)
+        assert (get_meta(nb).get("annotations") or {}).get(
+            nbapi.UPDATE_PENDING_ANNOTATION) == "true"
         # The gang still reconciles: spec drift propagates.
         assert await h.kube.get_or_none("StatefulSet", "late", "ns")
+
+
+async def test_flag_flipped_without_webhook_defers_consumption():
+    """On a cluster running the controller without the admission webhook,
+    the live flip lands in spec. The consume annotation must then be
+    DEFERRED until the request provisions — a rolling update whose
+    replacement pods reference an unprovisioned PR parks them behind the
+    autoscaler, mid-flight."""
+    async with Harness(webhooks=False) as h:
+        nb0 = nbapi.new("late", "ns", accelerator="v5e", topology="4x4")
+        nbapi.default(nb0)
+        await h.kube.create("Notebook", nb0)
+        await h.settle(10)
+        nb = await h.kube.get("Notebook", "late", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+
+        await h.kube.patch(
+            "Notebook", "late",
+            {"spec": {"tpu": {"queuedProvisioning": True}}}, "ns")
+        await h.settle(10)
+        nb = await h.kube.get("Notebook", "late", "ns")
+        assert nbapi.queued_provisioning(nb)
+        # Gang keeps running (no false capacity wait) …
+        assert deep_get(nb, "status", "readyReplicas") == 2
+        assert not deep_get(nb, "status", "tpu", "capacityPending")
+        # … the request now exists but is unprovisioned …
+        assert await h.kube.get_or_none(
+            "ProvisioningRequest", "late-capacity", "ns")
+        # … and the template does NOT consume it yet.
+        sts = await h.kube.get("StatefulSet", "late", "ns")
+        anns = deep_get(sts, "spec", "template", "metadata",
+                        "annotations", default={}) or {}
+        assert CONSUME_PR_ANNOTATION not in anns
+
+        # Once the request provisions, the consume annotation rolls on —
+        # it now references real capacity.
+        await h.provision("late-capacity")
+        await h.settle(10)
+        sts = await h.kube.get("StatefulSet", "late", "ns")
+        anns = deep_get(sts, "spec", "template", "metadata",
+                        "annotations", default={}) or {}
+        assert anns[CONSUME_PR_ANNOTATION] == "late-capacity"
+        assert anns[PR_CLASS_ANNOTATION] == PROVISIONING_CLASS
+
+
+async def test_pr_deleted_under_live_gang_keeps_annotation_stable():
+    """Deleting the ProvisioningRequest from under a live consuming gang
+    must not rolling-restart it: the recreated (unprovisioned) request
+    keeps the same name, and the template's consume annotation is
+    preserved — not stripped-then-restamped."""
+    async with Harness() as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("solid", "ns", accelerator="v5e",
+                                  topology="4x4", queued=True))
+        await h.settle()
+        await h.provision("solid-capacity")
+        await h.settle(12)
+        sts = await h.kube.get("StatefulSet", "solid", "ns")
+        anns0 = deep_get(sts, "spec", "template", "metadata",
+                         "annotations", default={}) or {}
+        assert anns0[CONSUME_PR_ANNOTATION] == "solid-capacity"
+        gen0 = get_meta(sts).get("generation")
+
+        await h.kube.delete("ProvisioningRequest", "solid-capacity", "ns")
+        await h.settle(10)
+        # Recreated by the reconciler (unprovisioned), gang untouched.
+        pr = await h.kube.get("ProvisioningRequest", "solid-capacity", "ns")
+        assert not deep_get(pr, "status", "conditions")
+        sts = await h.kube.get("StatefulSet", "solid", "ns")
+        anns1 = deep_get(sts, "spec", "template", "metadata",
+                         "annotations", default={}) or {}
+        assert anns1[CONSUME_PR_ANNOTATION] == "solid-capacity"
+        assert get_meta(sts).get("generation") == gen0, \
+            "healthy slice was rolling-restarted"
+        nb = await h.kube.get("Notebook", "solid", "ns")
+        assert deep_get(nb, "status", "readyReplicas") == 2
+
+
+async def test_capacity_template_does_not_self_reference():
+    """The PodTemplate the ProvisioningRequest provisions against must
+    not itself carry the consume annotation (circular reference; the
+    autoscaler matches shape, not annotations)."""
+    async with Harness() as h:
+        await h.kube.create(
+            "Notebook", nbapi.new("shape", "ns", accelerator="v5e",
+                                  topology="4x4", queued=True))
+        await h.settle()
+        pt = await h.kube.get("PodTemplate", "shape-capacity", "ns")
+        anns = deep_get(pt, "template", "metadata",
+                        "annotations", default={}) or {}
+        assert CONSUME_PR_ANNOTATION not in anns
 
 
 async def test_disabled_option_runs_queued_spec_unqueued():

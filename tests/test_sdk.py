@@ -297,3 +297,132 @@ def test_start_profiler_server_is_idempotent():
         port = s.getsockname()[1]
     sdk.start_profiler_server(port)
     sdk.start_profiler_server(port)  # re-run setup cell: must not raise
+
+
+def test_watcher_first_poll_is_immediate():
+    """A window already pending when start() runs must fire the callback
+    right away — not one full interval (default 30s) later, time that
+    matters right before a node termination."""
+    fired = []
+    w = sdk.MaintenanceWatcher(
+        fetch=lambda: {MAINTENANCE_ANNOTATION: "node-now"}, interval=3600.0)
+    start = time.time()
+    w.start(lambda nodes: fired.append(nodes))
+    deadline = time.time() + 5
+    while not fired and time.time() < deadline:
+        time.sleep(0.01)
+    w.stop()
+    assert fired == ["node-now"]
+    assert time.time() - start < 5, "first poll waited for the interval"
+
+
+def test_in_cluster_fetch_brackets_ipv6_host(monkeypatch):
+    """IPv6-only clusters inject a bare IPv6 KUBERNETES_SERVICE_HOST;
+    the apiserver URL must bracket it or every fetch fails (and check()
+    deliberately swallows fetch errors — the watcher would silently
+    never see the maintenance annotation)."""
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "fd00:10:96::1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    captured = {}
+
+    def fake_create_default_context(cafile=None):
+        class Ctx:
+            pass
+        return Ctx()
+
+    monkeypatch.setattr(sdk.ssl, "create_default_context",
+                        fake_create_default_context)
+    fetch = sdk._in_cluster_fetch("ns1", "nb1")
+    # The URL is baked at build time; reach it via the closure.
+    url = next(c for c in fetch.__closure__
+               for c in [c.cell_contents] if isinstance(c, str))
+    assert url.startswith("https://[fd00:10:96::1]:443/")
+    from urllib.parse import urlsplit
+    parts = urlsplit(url)  # urlsplit itself rejects a malformed netloc
+    assert parts.hostname == "fd00:10:96::1"
+    assert parts.port == 443
+
+
+def test_watcher_stop_mid_fetch_suppresses_callback():
+    """stop() landing while the first poll's fetch is in flight must not
+    fire the callback afterward — shutdown code runs right after stop()
+    returns and a forced checkpoint on torn-down state would throw."""
+    import threading
+
+    entered = threading.Event()
+    release = threading.Event()
+    fired = []
+
+    def gated_fetch():
+        entered.set()
+        release.wait(5)
+        return {MAINTENANCE_ANNOTATION: "late-window"}
+
+    w = sdk.MaintenanceWatcher(fetch=gated_fetch, interval=3600.0)
+    w.start(lambda n: fired.append(n))
+    assert entered.wait(5)
+    w._stop.set()   # the flag stop() sets, without its join (we hold the
+    release.set()   # fetch open); then let the fetch finish
+    w.stop()
+    assert not fired, "callback fired after stop()"
+
+
+def test_watcher_restart_after_timed_out_stop_keeps_old_thread_suppressed():
+    """stop() with a wedged fetch times out its join; a following
+    start() (re-run cell) must not let the OLD thread's eventual wakeup
+    fire a stale callback — each poller generation binds its own stop
+    event."""
+    import threading
+
+    release = threading.Event()
+    entered = threading.Event()
+    fired = []
+
+    def gated_fetch():
+        entered.set()
+        release.wait(10)
+        return {MAINTENANCE_ANNOTATION: "stale-window"}
+
+    w = sdk.MaintenanceWatcher(fetch=gated_fetch, interval=3600.0)
+    w.start(lambda n: fired.append(("old", n)))
+    assert entered.wait(5)
+    old_thread = w._thread
+    w._stop.set()          # stop() flag; skip its 5s join (fetch is held)
+    w._thread = None
+    # Re-run-cell: new generation with a fast fetch and no pending window.
+    w._fetch = lambda: {}
+    w.start(lambda n: fired.append(("new", n)))
+    release.set()          # old thread's fetch finally returns
+    old_thread.join(timeout=5)
+    assert not old_thread.is_alive()
+    w.stop()
+    assert not any(tag == "old" for tag, _ in fired), \
+        "stale callback fired after its generation was stopped"
+
+
+def test_stopped_generation_late_fetch_does_not_poison_check_cache():
+    """A stopped poller's wedged fetch returning late must not write the
+    shared check() cache — CheckpointGuard would see a maintenance
+    window the successor's fresher fetch already cleared."""
+    import threading
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    def gated_fetch():
+        entered.set()
+        release.wait(10)
+        return {MAINTENANCE_ANNOTATION: "ghost-node"}
+
+    w = sdk.MaintenanceWatcher(fetch=gated_fetch, interval=3600.0)
+    w.start(lambda n: None)
+    assert entered.wait(5)
+    old_thread = w._thread
+    w._stop.set()
+    w._thread = None
+    w._fetch = lambda: {}    # new generation: window already cleared
+    w.start(lambda n: None)
+    release.set()            # ghost fetch returns after its stop()
+    old_thread.join(timeout=5)
+    assert w.check(max_age=float("inf")) is None, \
+        "stale fetch poisoned the shared cache"
