@@ -53,10 +53,27 @@ def main() -> None:
         if slice_config is not None:
             rank, world, peers = slice_config
             try:
-                report["dcn_cross_slice"] = run_rank(
+                raw = run_rank(
                     rank, world, peers, mbytes=args.mbytes, base_port=19500)
+                report["dcn_cross_slice"] = raw
             except Exception as e:
                 report["dcn_cross_slice"] = {"error": str(e)}
+            else:
+                # Score this rank's ring rate against the topology
+                # estimate (same contract as report["ici"]). A scoring
+                # failure must not discard the measurement above.
+                if args.accelerator and args.topology:
+                    try:
+                        from kubeflow_tpu.probe.dcn import score_reports
+                        from kubeflow_tpu.tpu.topology import MultiSlice
+
+                        ms = MultiSlice.parse(args.accelerator,
+                                              args.topology,
+                                              num_slices=world)
+                        report["dcn_cross_slice_scored"] = score_reports(
+                            [raw], multi=ms).to_dict()
+                    except Exception as e:
+                        report["dcn_cross_slice_scored"] = {"error": str(e)}
 
     print(json.dumps(report))
 

@@ -8,12 +8,70 @@ injected; in tests all ranks run as local subprocesses over loopback.
 from __future__ import annotations
 
 import json
+import math
 import os
 import shutil
 import subprocess
+from dataclasses import asdict, dataclass
 from pathlib import Path
 
 NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+
+
+@dataclass(frozen=True)
+class DcnReport:
+    """Scored cross-slice (or cross-worker) ring result — the DCN analogue
+    of ``probe.ici.IciReport``. ``min_gbps`` is the slowest rank: a ring is
+    only as fast as its weakest link, so that is the number scored."""
+
+    world: int
+    mbytes: float
+    iters: int
+    min_gbps: float
+    mean_gbps: float
+    peak_estimate_gbps: float | None
+    fraction_of_peak: float | None
+
+    def to_dict(self) -> dict:
+        out = asdict(self)
+        for key, value in out.items():
+            if isinstance(value, float) and not math.isfinite(value):
+                out[key] = None  # JSON has no Infinity
+        return out
+
+
+def score_reports(reports: list[dict], multi=None) -> DcnReport:
+    """Fold per-rank probe JSON into one scored report.
+
+    ``multi``: a ``tpu.topology.MultiSlice`` — when given (and multi-slice),
+    the measured ring rate is scored against its
+    ``dcn_ring_bandwidth_gbps()`` estimate, mirroring how the ICI probe
+    scores against ``allreduce_algo_bandwidth_gbps``."""
+    if not reports:
+        raise DcnProbeError("no rank reports to score")
+    rates = [r["gbps"] for r in reports if r.get("gbps") is not None]
+    if not rates:  # world=1 sentinel report: no inter-host traffic
+        min_gbps = mean_gbps = float("inf")
+    else:
+        min_gbps = min(rates)
+        mean_gbps = sum(rates) / len(rates)
+    peak = fraction = None
+    if multi is not None:
+        peak = multi.dcn_ring_bandwidth_gbps()
+        if peak and math.isfinite(peak) and math.isfinite(min_gbps):
+            fraction = min_gbps / peak
+    return DcnReport(
+        world=max(int(r.get("world", 1)) for r in reports),
+        mbytes=float(reports[0].get("mbytes", 0.0)),
+        iters=int(reports[0].get("iters", 0)),
+        min_gbps=round(min_gbps, 3) if math.isfinite(min_gbps) else min_gbps,
+        mean_gbps=round(mean_gbps, 3) if math.isfinite(mean_gbps) else mean_gbps,
+        peak_estimate_gbps=(round(peak, 3)
+                            if peak is not None and math.isfinite(peak)
+                            else peak),
+        fraction_of_peak=(round(fraction, 4)
+                          if fraction is not None else None),
+    )
 
 
 class DcnProbeError(RuntimeError):

@@ -51,6 +51,8 @@ class TpuAccelerator:
     ici_gbps_per_link: float       # one-way ICI bandwidth per link, GB/s (approx)
     topologies: tuple[str, ...]    # GKE-documented topology strings
     accelerator_type_prefix: str = ""  # e.g. "v5litepod" -> accelerator_type "v5litepod-16"
+    dcn_gbps_per_host: float = 12.5  # host NIC bandwidth, GB/s (approx public
+                                     # figure; the cross-slice DCN floor)
 
     @property
     def ndim(self) -> int:
@@ -104,6 +106,7 @@ ACCELERATORS: dict[str, TpuAccelerator] = {
             peak_bf16_tflops_per_chip=459.0,
             hbm_gbps_per_chip=2765.0,
             ici_gbps_per_link=100.0,
+            dcn_gbps_per_host=25.0,
             topologies=(
                 "2x2x1", "2x2x2", "2x4x4", "4x4x4", "4x4x8", "4x8x8",
                 "8x8x8", "8x8x16", "8x16x16", "16x16x16", "16x16x24",
@@ -118,6 +121,7 @@ ACCELERATORS: dict[str, TpuAccelerator] = {
             peak_bf16_tflops_per_chip=918.0,
             hbm_gbps_per_chip=1640.0,
             ici_gbps_per_link=100.0,
+            dcn_gbps_per_host=25.0,
             topologies=("1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"),
         ),
     )
@@ -429,3 +433,14 @@ class MultiSlice:
 
     def peak_bf16_tflops(self) -> float:
         return self.num_slices * self.slice.peak_bf16_tflops()
+
+    def dcn_ring_bandwidth_gbps(self) -> float:
+        """Approximate achievable per-direction DCN ring bandwidth for the
+        cross-slice probe (one rank per slice — worker 0's host NIC is the
+        bottleneck). Used by probe/dcn.py to score "fraction of peak" for
+        the megascale path, the DCN analogue of
+        ``TpuSlice.allreduce_algo_bandwidth_gbps`` (BASELINE.md config 4).
+        Single-slice: no cross-slice traffic exists → inf."""
+        if self.num_slices <= 1:
+            return float("inf")
+        return self.slice.accelerator.dcn_gbps_per_host

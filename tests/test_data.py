@@ -7,6 +7,8 @@ step) (what makes trainer.fit's skip-ahead resume bit-exact), and static
 batch shapes (no mid-epoch recompiles).
 """
 
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -406,7 +408,8 @@ def test_prefetch_shutdown_del_is_silent():
         next(pf)
         # exit with pf alive: final GC runs __del__ during teardown
     """)
-    out = subprocess.run([_sys.executable, "-c", code], cwd="/root/repo",
+    repo_root = str(Path(__file__).resolve().parent.parent)
+    out = subprocess.run([_sys.executable, "-c", code], cwd=repo_root,
                          capture_output=True, text=True, timeout=120)
     assert out.returncode == 0
     assert "Exception ignored" not in out.stderr

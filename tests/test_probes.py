@@ -68,3 +68,51 @@ def test_slice_env_config(monkeypatch):
     assert slice_env_config() == (2, 3, ["s0.svc", "s1.svc", "s2.svc"])
     monkeypatch.setenv("TPU_WORKER_ID", "1")   # non-zero workers sit out
     assert slice_env_config() is None
+
+
+def test_dcn_score_reports_against_multislice():
+    """score_reports folds per-rank ring JSON and scores min_gbps against
+    the MultiSlice DCN estimate — the cross-slice analogue of the ICI
+    probe's fraction_of_peak."""
+    from kubeflow_tpu.probe.dcn import score_reports
+    from kubeflow_tpu.tpu.topology import MultiSlice
+
+    reports = [
+        {"rank": 0, "world": 2, "mbytes": 4.0, "iters": 2,
+         "seconds": 0.01, "gbps": 5.0},
+        {"rank": 1, "world": 2, "mbytes": 4.0, "iters": 2,
+         "seconds": 0.01, "gbps": 4.0},
+    ]
+    ms = MultiSlice.parse("v5e", "4x4", num_slices=2)
+    scored = score_reports(reports, multi=ms)
+    assert scored.world == 2
+    assert scored.min_gbps == 4.0      # slowest rank gates the ring
+    assert scored.mean_gbps == 4.5
+    assert scored.peak_estimate_gbps == 12.5
+    assert scored.fraction_of_peak == round(4.0 / 12.5, 4)
+
+
+def test_dcn_score_single_slice_has_no_peak():
+    from kubeflow_tpu.probe.dcn import score_reports
+    from kubeflow_tpu.tpu.topology import MultiSlice
+
+    ms = MultiSlice.parse("v5p", "2x2x1", num_slices=1)
+    scored = score_reports(
+        [{"rank": 0, "world": 1, "gbps": None}], multi=ms)
+    assert scored.fraction_of_peak is None
+    d = scored.to_dict()
+    assert d["min_gbps"] is None       # inf serialized as null
+    assert d["peak_estimate_gbps"] is None
+
+
+def test_dcn_score_end_to_end_loopback():
+    """Real binary, two loopback ranks, scored — what the multichip gate
+    runs across its two virtual slices."""
+    from kubeflow_tpu.probe.dcn import run_local_ring, score_reports
+    from kubeflow_tpu.tpu.topology import MultiSlice
+
+    reports = run_local_ring(world=2, mbytes=2.0, iters=2, base_port=19800)
+    scored = score_reports(
+        reports, multi=MultiSlice.parse("v5e", "2x2", num_slices=2))
+    assert scored.min_gbps > 0
+    assert scored.fraction_of_peak is not None and scored.fraction_of_peak > 0
