@@ -108,7 +108,7 @@ async def scale_test(cp: ControlPlane) -> dict:
     """The N-notebook load test (testing/loadtest.py — the harness the
     reference ships without ever recording numbers, SURVEY.md §6). Runs
     AFTER the cold-start measurement so its wall time never pollutes
-    coldstart_to_first_step_sec."""
+    in_process_to_first_step_sec."""
     from kubeflow_tpu.testing.loadtest import run_load_test
 
     report = await run_load_test(
@@ -168,6 +168,23 @@ def detect_accelerator(device) -> str | None:
     return None
 
 
+MEASURE_TRIALS = 3
+
+
+def _measure_trials(run_window, *, trials: int = MEASURE_TRIALS) -> dict:
+    """Run a timing window ``trials`` times; report the median plus the
+    raw trials and relative spread, so a shared-relay blip (r02→r03's
+    unexplained 4.7% longctx drift) is classifiable from the JSON alone:
+    large spread → variance, tight spread + moved median → regression."""
+    secs = sorted(run_window() for _ in range(trials))
+    median = secs[trials // 2]
+    return {
+        "median_sec": median,
+        "trials_sec": [round(s, 4) for s in secs],
+        "spread_pct": round(100.0 * (secs[-1] - secs[0]) / median, 2),
+    }
+
+
 def _longctx_bench() -> dict:
     """Trainable flash ring attention at 8k tokens (one chip)."""
     import numpy as np
@@ -185,27 +202,38 @@ def _longctx_bench() -> dict:
     step = jax.jit(longctx.make_train_step(cfg, mesh), donate_argnums=(0,))
     params, loss = step(params, toks)
     float(loss)  # value fetch = reliable sync through the remote relay
-    t0 = time.perf_counter()
-    for _ in range(LONGCTX_STEPS):
-        params, loss = step(params, toks)
-    float(loss)
-    sec = (time.perf_counter() - t0) / LONGCTX_STEPS
+
+    def window():
+        nonlocal params
+        t0 = time.perf_counter()
+        for _ in range(LONGCTX_STEPS):
+            params, loss = step(params, toks)
+        float(loss)
+        return (time.perf_counter() - t0) / LONGCTX_STEPS
+
+    m = _measure_trials(window)
+    sec = m["median_sec"]
     return {
         "attention": cfg.attention,
         "seq_len": cfg.seq_len,
         "step_sec": round(sec, 4),
         "tokens_per_sec": round(cfg.seq_len / sec, 0),
+        "trials_sec": m["trials_sec"],
+        "spread_pct": m["spread_pct"],
     }
 
 
-def _warm_probe(t0_epoch: float) -> None:
-    """Fresh-process cold start with a warm compilation cache: everything
-    the cold path pays (interpreter + imports + device client + init +
-    compile + first step), except the compiles come from disk. Prints one
+def _fresh_probe(t0_epoch: float) -> None:
+    """Fresh-process start-to-first-step: everything a user's notebook
+    start pays — interpreter + imports + device-client attach + init +
+    compile + first step. The compilation cache dir comes from the
+    ``KFTPU_BENCH_CACHE_DIR`` env: pointed at the populated repo cache
+    this measures the WARM start; pointed at an empty temp dir it
+    measures the TRUE COLD start (nothing reusable on disk). Prints one
     JSON line; the parent folds it into the main output."""
     from kubeflow_tpu.utils.compilecache import enable_persistent_cache
 
-    enable_persistent_cache(CACHE_DIR)
+    enable_persistent_cache(os.environ.get("KFTPU_BENCH_CACHE_DIR", CACHE_DIR))
     from functools import partial
 
     import jax
@@ -224,21 +252,23 @@ def _warm_probe(t0_epoch: float) -> None:
     params, loss = compiled(params, tokens)
     float(loss)
     print(json.dumps({
-        "warm_coldstart_sec": round(time.time() - t0_epoch, 3),
-        "warm_compile_sec": round(compile_sec, 3),
+        "coldstart_sec": round(time.time() - t0_epoch, 3),
+        "compile_sec": round(compile_sec, 3),
     }))
 
 
-def _run_warm_probe() -> dict | None:
-    """Run the warm-start probe in a subprocess (the axon relay multiplexes
-    the chip, so the child can attach while this process holds it)."""
+def _run_fresh_probe(cache_dir: str) -> dict | None:
+    """Run a fresh-process start probe in a subprocess (the axon relay
+    multiplexes the chip, so the child can attach while this process
+    holds it) against the given compilation-cache dir."""
     import subprocess
 
+    env = dict(os.environ, KFTPU_BENCH_CACHE_DIR=cache_dir)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
-             "--warm-probe", repr(time.time())],
-            capture_output=True, text=True, timeout=300,
+             "--fresh-probe", repr(time.time())],
+            capture_output=True, text=True, timeout=300, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         if proc.returncode != 0:
@@ -246,6 +276,40 @@ def _run_warm_probe() -> dict | None:
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except Exception:
         return None
+
+
+def _coldstart_probes() -> dict:
+    """Both fresh-process start numbers, measured apples-to-apples:
+
+    - ``cold_cache``: empty cache dir — the first-ever notebook start.
+    - ``warm_cache``: re-run over the cache the cold probe just wrote —
+      guaranteed-warm for the CURRENT model, and independent of whatever
+      state the repo cache is in.
+
+    Must run BEFORE the bench process attaches its own jax client: a
+    probe compiling while the parent holds the chip through the shared
+    relay measures contention, not start-up (measured: warm compile
+    16 s under a live parent vs 2.6 s without).
+
+    (The in-process ``in_process_to_first_step_sec`` is a third, smaller
+    number: it starts its clock after imports and device attach, so it
+    is NOT comparable to these — that asymmetry, not cache state, was
+    the r03 "warm slower than cold" inversion.)"""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="kftpu-coldcache-")
+    try:
+        cold = _run_fresh_probe(tmp)
+        warm = _run_fresh_probe(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "coldstart_cold_cache_sec": cold.get("coldstart_sec") if cold else None,
+        "cold_compile_sec": cold.get("compile_sec") if cold else None,
+        "coldstart_warm_cache_sec": warm.get("coldstart_sec") if warm else None,
+        "warm_compile_sec": warm.get("compile_sec") if warm else None,
+    }
 
 
 def moe_train_step_flops(cfg, batch: int) -> float:
@@ -300,13 +364,19 @@ def _family_bench(peak_tflops: float | None) -> dict:
     dev = jax.devices()[:1]
 
     def timed(step, params, *rest):
+        """Median of MEASURE_TRIALS windows + spread (see _measure_trials)."""
         params, loss = step(params, *rest)   # warm-up (and donate-in)
         float(loss)
-        t0 = time.perf_counter()
-        for _ in range(FAMILY_STEPS):
-            params, loss = step(params, *rest)
-        float(loss)
-        return (time.perf_counter() - t0) / FAMILY_STEPS
+
+        def window():
+            nonlocal params
+            t0 = time.perf_counter()
+            for _ in range(FAMILY_STEPS):
+                params, loss = step(params, *rest)
+            float(loss)
+            return (time.perf_counter() - t0) / FAMILY_STEPS
+
+        return _measure_trials(window)
 
     # --- MoE (top-2 routed FF; expert axis size 1 on one chip) ---------------
     from kubeflow_tpu.models import moe as moe_model
@@ -318,11 +388,14 @@ def _family_bench(peak_tflops: float | None) -> dict:
     tokens = jax.random.randint(
         jax.random.key(6), (4, cfg.seq_len), 0, cfg.vocab)
     step = jax.jit(moe_model.make_train_step(cfg, mesh), donate_argnums=(0,))
-    sec = timed(step, params, tokens)
+    m = timed(step, params, tokens)
+    sec = m["median_sec"]
     flops = moe_train_step_flops(cfg, 4)
     tf = flops / sec / 1e12
     out["moe"] = {
         "step_sec": round(sec, 4),
+        "trials_sec": m["trials_sec"],
+        "spread_pct": m["spread_pct"],
         "achieved_tflops": round(tf, 2),
         "mfu": round(tf / peak_tflops, 4) if peak_tflops else None,
         "router_top_k": cfg.router_top_k,
@@ -340,14 +413,42 @@ def _family_bench(peak_tflops: float | None) -> dict:
         jax.random.key(8), (8, pp_cfg.seq_len), 0, pp_cfg.vocab)
     pp_step = jax.jit(pipelined.make_train_step(pp_cfg, pp_mesh),
                       donate_argnums=(0,))
-    sec = timed(pp_step, pp_params, pp_tokens)
+    m = timed(pp_step, pp_params, pp_tokens)
+    sec = m["median_sec"]
     flops = train_step_flops(pp_cfg, 8)
     tf = flops / sec / 1e12
     out["pipelined"] = {
         "step_sec": round(sec, 4),
+        "trials_sec": m["trials_sec"],
+        "spread_pct": m["spread_pct"],
         "achieved_tflops": round(tf, 2),
         "mfu": round(tf / peak_tflops, 4) if peak_tflops else None,
         "n_micro": pp_cfg.n_micro,
+        "path": "fused_bypass",  # n_stages=1 routes around the schedule
+    }
+
+    # Same model through the REAL GPipe tick/scan (force_schedule): the
+    # row that moves when models/pipelined.py's schedule machinery — the
+    # scan, masking, ppermute self-hop — regresses. The fused row above
+    # tracks the production single-stage path; this one tracks the
+    # machinery multi-stage jobs actually run (r03 weak #3: the schedule
+    # had no tracked number on hardware).
+    sched_params = pipelined.shard_params(
+        pipelined.init_params(jax.random.key(7), pp_cfg), pp_mesh, pp_cfg)
+    sched_step = jax.jit(
+        pipelined.make_train_step(pp_cfg, pp_mesh, force_schedule=True),
+        donate_argnums=(0,))
+    m = timed(sched_step, sched_params, pp_tokens)
+    sec = m["median_sec"]
+    tf = flops / sec / 1e12
+    out["pipelined_schedule"] = {
+        "step_sec": round(sec, 4),
+        "trials_sec": m["trials_sec"],
+        "spread_pct": m["spread_pct"],
+        "achieved_tflops": round(tf, 2),
+        "mfu": round(tf / peak_tflops, 4) if peak_tflops else None,
+        "n_micro": pp_cfg.n_micro,
+        "path": "gpipe_schedule",
     }
 
     # --- Vision (residual convnet; FLOPs from XLA's cost model — conv
@@ -367,7 +468,8 @@ def _family_bench(peak_tflops: float | None) -> dict:
     v_step_fn = vision.make_train_step(v_cfg)
     v_compiled = jax.jit(v_step_fn, donate_argnums=(0,)).lower(
         v_params, (images, labels)).compile()
-    sec = timed(v_compiled, v_params, (images, labels))
+    m = timed(v_compiled, v_params, (images, labels))
+    sec = m["median_sec"]
     try:
         cost = v_compiled.cost_analysis()
         cost = cost[0] if isinstance(cost, (list, tuple)) else cost
@@ -377,6 +479,8 @@ def _family_bench(peak_tflops: float | None) -> dict:
     tf = flops / sec / 1e12 if flops else None
     out["vision"] = {
         "step_sec": round(sec, 4),
+        "trials_sec": m["trials_sec"],
+        "spread_pct": m["spread_pct"],
         "images_per_sec": round(VISION_BATCH / sec, 1),
         "achieved_tflops": round(tf, 2) if tf else None,
         "mfu": round(tf / peak_tflops, 4) if (tf and peak_tflops) else None,
@@ -401,6 +505,11 @@ def bench() -> dict:
             return await fn(cp)
         finally:
             await cp.stop()
+
+    # Fresh-process start probes FIRST — before this process attaches its
+    # own jax client (see _coldstart_probes: a probe compiling while the
+    # parent holds the chip measures relay contention, not start-up).
+    starts = _coldstart_probes()
 
     t_start = time.perf_counter()
     spawn = asyncio.run(_run_phase(spawn_notebook))
@@ -430,11 +539,23 @@ def bench() -> dict:
     float(loss)
     coldstart_sec = time.perf_counter() - t_start
 
+    # The 100 measured steps, timed as 4 chunks: the headline step_sec /
+    # MFU stay the full-window mean (comparable to prior rounds), and the
+    # chunk median + spread classify relay noise vs real drift (r03 weak
+    # #6) without extra chip time.
+    chunk = BENCH_STEPS // 4
+    chunk_secs = []
     t1 = time.perf_counter()
-    for _ in range(BENCH_STEPS):
-        params, loss = compiled(params, tokens)
-    float(loss)
-    step_sec = (time.perf_counter() - t1) / BENCH_STEPS
+    for _ in range(4):
+        tc = time.perf_counter()
+        for _ in range(chunk):
+            params, loss = compiled(params, tokens)
+        float(loss)
+        chunk_secs.append((time.perf_counter() - tc) / chunk)
+    step_sec = (time.perf_counter() - t1) / (4 * chunk)
+    chunk_secs.sort()
+    step_spread_pct = round(
+        100.0 * (chunk_secs[-1] - chunk_secs[0]) / chunk_secs[2], 2)
 
     flops = train_step_flops(cfg, BENCH_BATCH)
     achieved_tflops = flops / step_sec / 1e12
@@ -457,13 +578,19 @@ def bench() -> dict:
     longctx_out = _longctx_bench()
     families = _family_bench(peak_tflops)
 
-    # Warm-start probe: a fresh process over the now-populated cache — the
-    # number a user's SECOND notebook start pays (VERDICT r2 #3).
-    warm = _run_warm_probe()
-
     # Control-plane scale AFTER the cold-start window (its wall time must
-    # not pollute coldstart_to_first_step_sec).
-    scale = asyncio.run(_run_phase(scale_test))
+    # not pollute in_process_to_first_step_sec). Three trials, each on a
+    # FRESH control plane; the median-throughput trial is the tracked
+    # number and the per-trial list bounds host-load variance (r03 weak
+    # #1: a doc quoted an untracked low-load run the artifact refuted).
+    scale_trials = [asyncio.run(_run_phase(scale_test))
+                    for _ in range(MEASURE_TRIALS)]
+    scale_trials.sort(key=lambda s: s["notebooks_per_sec"])
+    scale = dict(scale_trials[len(scale_trials) // 2])
+    rates = [s["notebooks_per_sec"] for s in scale_trials]
+    scale["trials_notebooks_per_sec"] = rates
+    scale["spread_pct"] = round(
+        100.0 * (rates[-1] - rates[0]) / rates[len(rates) // 2], 2)
 
     out = {
         "metric": "train_step_mfu",
@@ -477,19 +604,22 @@ def bench() -> dict:
         "achieved_tflops": round(achieved_tflops, 3),
         "peak_bf16_tflops": peak_tflops,
         "step_sec": round(step_sec, 6),
+        "step_chunk_secs": [round(s, 6) for s in chunk_secs],
+        "step_spread_pct": step_spread_pct,
         "compile_sec": round(compile_sec, 3),
         "steps_measured": BENCH_STEPS,
         "step_flops": flops,
-        "coldstart_to_first_step_sec": round(coldstart_sec, 3),
+        # In-process number: clock starts AFTER imports + device attach,
+        # so it is smaller than (and not comparable to) the fresh-process
+        # coldstart_* fields below.
+        "in_process_to_first_step_sec": round(coldstart_sec, 3),
         "compile_cache": {
             "dir": CACHE_DIR,
             "entries_before": entries_before,
             "entries_after": cache_entries(CACHE_DIR),
             "warm_start": entries_before > 0,
         },
-        "coldstart_warm_cache_sec": (
-            warm.get("warm_coldstart_sec") if warm else None),
-        "warm_compile_sec": (warm.get("warm_compile_sec") if warm else None),
+        **starts,
         "control_plane_spawn_sec": round(spawn["spawn_sec"], 4),
         "control_plane_scale": scale,
         "longctx": longctx_out,
@@ -504,7 +634,7 @@ def bench() -> dict:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 2 and sys.argv[1] == "--warm-probe":
-        _warm_probe(float(sys.argv[2]) if len(sys.argv) > 2 else time.time())
+    if len(sys.argv) >= 2 and sys.argv[1] == "--fresh-probe":
+        _fresh_probe(float(sys.argv[2]) if len(sys.argv) > 2 else time.time())
     else:
         print(json.dumps(bench()))

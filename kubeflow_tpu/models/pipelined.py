@@ -179,7 +179,8 @@ def reference_loss(params: dict, tokens: jax.Array, cfg: PipelinedConfig):
 
 def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
                     data_axis: str = "data", stage_axis: str = "stage",
-                    model_axis: str = "model"):
+                    model_axis: str = "model",
+                    force_schedule: bool = False):
     """(params, tokens) -> (params, loss) over a (data, stage[, model]) mesh.
 
     Grad bookkeeping: none by hand. Params enter less-varying than the
@@ -188,6 +189,9 @@ def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
     axes each leaf is replicated on (measured: a manual psum on top
     double-counts by the axis size). The only explicit collectives are the
     forward ones: stage ppermute, model psum.
+
+    ``force_schedule``: run the GPipe tick/scan even at one stage (bench
+    tracking of the schedule machinery itself — see pipeline_apply).
     """
     n_stages = mesh.shape[stage_axis]
     pipeline_spans(cfg.n_layers, n_stages)  # clear divisibility error up front
@@ -211,6 +215,7 @@ def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
         outs = pipeline_apply(
             stage_run, params["layers"], x_micro,
             n_stages=n_stages, axis_name=stage_axis, mesh_axes=mesh_axes,
+            force_schedule=force_schedule,
         )
         x = outs.reshape(b, s, cfg.d_model)
         x = _rmsnorm(x, params["out_norm"])

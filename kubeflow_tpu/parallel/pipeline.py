@@ -59,7 +59,8 @@ def pipeline_spans(n_layers: int, n_stages: int) -> list[tuple[int, int]]:
 
 
 def pipeline_apply(stage_fn, stage_params, x_micro, *, n_stages: int,
-                   axis_name: str = "stage", mesh_axes=None):
+                   axis_name: str = "stage", mesh_axes=None,
+                   force_schedule: bool = False):
     """Run microbatches through the stage ring. Call inside ``shard_map``.
 
     Args:
@@ -77,13 +78,17 @@ def pipeline_apply(stage_fn, stage_params, x_micro, *, n_stages: int,
       mesh_axes: every manual axis of the enclosing shard_map — the scan
         carries must be marked varying over all of them (same rule as
         ring_attention_local's online-softmax carries).
+      force_schedule: run the general tick/scan schedule even at
+        ``n_stages == 1`` (normally routed around — see below). The bench
+        uses this so the schedule machinery's overhead is a *tracked*
+        number on hardware rather than only compiled in multi-stage gates.
 
     Returns ``[n_micro, mb, ...]`` outputs — valid on the LAST stage only;
     other stages hold zeros/garbage (reduce with a ``where(idx==last)`` +
     ``psum`` as models/pipelined.py does for the loss).
     """
     n_micro = x_micro.shape[0]
-    if n_stages == 1:
+    if n_stages == 1 and not force_schedule:
         # Degenerate single-stage pipeline: no bubble, no ppermute, no
         # schedule scan — and the microbatches fuse back into one batch so
         # the GEMMs run at full MXU tile sizes instead of n_micro small

@@ -22,7 +22,7 @@ def test_cache_entries_missing_dir_is_zero(tmp_path):
 
 def test_persistent_cache_populates_on_compile(tmp_path):
     """A compile after enable_persistent_cache lands on disk — the
-    mechanism the warm cold-start path (bench.py --warm-probe and the
+    mechanism the warm cold-start path (bench.py --fresh-probe and the
     jupyter-jax image's PVC cache) relies on."""
     saved = {
         "dir": jax.config.jax_compilation_cache_dir,

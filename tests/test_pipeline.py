@@ -147,3 +147,30 @@ def test_single_stage_matches_two_stage():
         _, loss = jax.jit(pipelined.make_train_step(cfg, mesh))(params, tokens)
         losses[n_stages] = float(loss)
     assert abs(losses[1] - losses[2]) < 2e-5, losses
+
+
+def test_forced_schedule_single_stage_matches_fast_path():
+    """force_schedule=True runs the real GPipe tick/scan at n_stages=1
+    (the bench's tracked-schedule row); it must compute exactly what the
+    fused fast path computes."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import pipelined
+
+    cfg = pipelined.PipelinedConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        seq_len=12, n_micro=2, dtype="float32",
+    )
+    tokens = jnp.asarray(
+        jax.random.randint(jax.random.key(9), (4, cfg.seq_len), 0, cfg.vocab))
+    mesh = pipelined.make_pp_mesh(jax.devices()[:1], n_stages=1, n_model=1)
+    params = pipelined.shard_params(
+        pipelined.init_params(jax.random.key(0), cfg), mesh, cfg)
+    losses = {}
+    for forced in (False, True):
+        step = jax.jit(pipelined.make_train_step(
+            cfg, mesh, force_schedule=forced))
+        _, loss = step(params, tokens)
+        losses[forced] = float(loss)
+    assert abs(losses[False] - losses[True]) < 2e-5, losses
