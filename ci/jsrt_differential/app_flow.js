@@ -1,0 +1,88 @@
+#!/usr/bin/env node
+/* Node executor for a shipped frontend's load-and-first-poll flow.
+ *
+ * Usage:
+ *   node app_flow.js --html <index.html> --scripts <a.js,b.js> \
+ *       --fixtures <fixtures.json> [--observe <selector>] \
+ *       [--storage k=v,...] [--settle-ms 200]
+ *
+ * Loads the real index.html into the dom_adapter environment, executes
+ * the real shipped scripts (kubeflow.js + app.js — the same files jsrt
+ * executes in tests/test_frontend_exec_*.py), replays the recorded HTTP
+ * fixtures through fetch, lets timers/microtasks settle, then prints one
+ * JSON line of observables:
+ *   { observed: <textContent of --observe>, docText, requests: [...] }
+ * The Python differential test compares these against the jsrt run that
+ * produced the fixtures.
+ */
+"use strict";
+
+const fs = require("fs");
+const vm = require("vm");
+const { makeEnvironment } = require("./dom_adapter.js");
+
+function arg(name, dflt) {
+  const at = process.argv.indexOf("--" + name);
+  return at >= 0 ? process.argv[at + 1] : dflt;
+}
+
+const htmlPath = arg("html");
+const scriptPaths = (arg("scripts") || "").split(",").filter(Boolean);
+const fixturesPath = arg("fixtures");
+const observeSel = arg("observe", "body");
+const settleMs = parseInt(arg("settle-ms", "200"), 10);
+const storagePairs = (arg("storage") || "").split(",").filter(Boolean);
+
+const fixtures = JSON.parse(fs.readFileSync(fixturesPath, "utf8"));
+const requests = [];
+const env = makeEnvironment({ fixtures, requests });
+
+for (const pair of storagePairs) {
+  const eq = pair.indexOf("=");
+  env.localStorage.setItem(pair.slice(0, eq), pair.slice(eq + 1));
+}
+
+env.parseHTML(fs.readFileSync(htmlPath, "utf8"));
+
+const sandbox = {
+  document: env.document,
+  window: env.window,
+  location: env.location,
+  history: env.history,
+  localStorage: env.localStorage,
+  fetch: env.fetch,
+  FormData: env.FormData,
+  Event: env.Event,
+  navigator: env.navigator,
+  Node: env.Node,
+  console,
+  setTimeout,
+  clearTimeout,
+  setInterval,
+  clearInterval,
+  URL,
+  URLSearchParams,
+  encodeURIComponent,
+  decodeURIComponent,
+};
+sandbox.window.document = env.document;
+sandbox.globalThis = sandbox;
+const context = vm.createContext(sandbox);
+
+for (const p of scriptPaths) {
+  // One shared context: top-level const/let from kubeflow.js (KF, aliases)
+  // stay visible to app.js, matching browser <script> tag semantics.
+  vm.runInContext(fs.readFileSync(p, "utf8"), context, { filename: p });
+}
+
+setTimeout(() => {
+  const target = env.document.querySelector(observeSel) || env.document.body;
+  process.stdout.write(
+    JSON.stringify({
+      observed: target.textContent,
+      docText: env.document.body.textContent,
+      requests,
+    }) + "\n"
+  );
+  process.exit(0);
+}, settleMs);

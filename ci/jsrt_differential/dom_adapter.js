@@ -1,0 +1,584 @@
+/* Minimal browser environment for Node — the INDEPENDENT side of the
+ * frontend differential battery.
+ *
+ * Implements exactly the DOM surface the shipped frontends use (inventory
+ * in tests/test_node_frontend_differential.py): element tree with
+ * bubbling events, a small selector engine (#id, .class, tag,
+ * [attr="v"], :checked, compounds, descendant), form controls with
+ * value/checked properties, FormData, cookies, localStorage, location +
+ * history, and a fixture-replay fetch that records every request.
+ *
+ * Deliberately independent of testing/jsrt: any semantics shared with it
+ * would defeat the differential purpose. Written against MDN/WHATWG
+ * behavior. Style note: factories + closures (no `class`) so the repo's
+ * offline syntax gate — jsrt's parser, which scopes to the subset the
+ * shipped frontends use — can parse these files too.
+ */
+"use strict";
+
+/* ---------------- element tree ----------------------------------------- */
+
+const VOID_TAGS = {
+  area: 1, base: 1, br: 1, col: 1, embed: 1, hr: 1, img: 1, input: 1,
+  link: 1, meta: 1, source: 1, track: 1, wbr: 1,
+};
+
+function makeTextNode(text) {
+  return {
+    nodeType: 3,
+    data: String(text),
+    parentNode: null,
+    get textContent() {
+      return this.data;
+    },
+  };
+}
+
+function isNode(x) {
+  return x && (x.nodeType === 1 || x.nodeType === 3);
+}
+
+function makeElement(tagName, doc) {
+  const el = {
+    nodeType: 1,
+    tagName: tagName.toUpperCase(),
+    ownerDocument: doc,
+    attrs: {},
+    childNodes: [],
+    parentNode: null,
+    style: {},
+    listeners: {},
+    _value: undefined, // form-control property, shadows the attr
+    _checked: undefined,
+    _selected: false,
+
+    /* -- attributes -- */
+    setAttribute(name, value) {
+      el.attrs[name] = String(value);
+    },
+    getAttribute(name) {
+      return name in el.attrs ? el.attrs[name] : null;
+    },
+    removeAttribute(name) {
+      delete el.attrs[name];
+    },
+    get id() {
+      return el.attrs.id || "";
+    },
+    set id(v) {
+      el.attrs.id = String(v);
+    },
+    get name() {
+      return el.attrs.name || "";
+    },
+    get type() {
+      return el.attrs.type || (el.tagName === "INPUT" ? "text" : "");
+    },
+    get className() {
+      return el.attrs.class || "";
+    },
+    set className(v) {
+      el.attrs.class = String(v);
+    },
+    get classList() {
+      const classes = () =>
+        (el.attrs.class || "").split(/\s+/).filter(Boolean);
+      const list = {
+        add(...cs) {
+          const set = classes();
+          for (const c of cs) if (set.indexOf(c) < 0) set.push(c);
+          el.attrs.class = set.join(" ");
+        },
+        remove(...cs) {
+          el.attrs.class = classes()
+            .filter((c) => cs.indexOf(c) < 0)
+            .join(" ");
+        },
+        toggle(c, force) {
+          const has = classes().indexOf(c) >= 0;
+          const want = force === undefined ? !has : !!force;
+          if (want && !has) list.add(c);
+          if (!want && has) list.remove(c);
+          return want;
+        },
+        contains(c) {
+          return classes().indexOf(c) >= 0;
+        },
+      };
+      return list;
+    },
+
+    /* -- form-control properties (separate from attrs, per spec) -- */
+    get value() {
+      if (el.tagName === "SELECT") {
+        const opts = el.querySelectorAll("option");
+        for (const o of opts) if (o._selected) return o.value;
+        return opts.length ? opts[0].value : "";
+      }
+      if (el._value !== undefined) return el._value;
+      return el.attrs.value !== undefined ? el.attrs.value : "";
+    },
+    set value(v) {
+      if (el.tagName === "SELECT") {
+        for (const o of el.querySelectorAll("option")) {
+          o._selected = o.value === String(v);
+        }
+        return;
+      }
+      el._value = String(v);
+    },
+    get checked() {
+      if (el._checked !== undefined) return el._checked;
+      return "checked" in el.attrs;
+    },
+    set checked(v) {
+      el._checked = !!v;
+    },
+    get selected() {
+      return !!el._selected;
+    },
+    set selected(v) {
+      el._selected = !!v;
+    },
+    get disabled() {
+      return "disabled" in el.attrs;
+    },
+    set disabled(v) {
+      if (v) el.attrs.disabled = "";
+      else delete el.attrs.disabled;
+    },
+    focus() {},
+    getContext() {
+      // canvas stub (sparkline): every drawing call is a no-op.
+      const noop = () => undefined;
+      return {
+        beginPath: noop, moveTo: noop, lineTo: noop, stroke: noop,
+        fill: noop, clearRect: noop, arc: noop, closePath: noop,
+        fillRect: noop, strokeRect: noop, save: noop, restore: noop,
+        scale: noop, translate: noop,
+      };
+    },
+
+    /* -- tree -- */
+    _adopt(child) {
+      if (child.parentNode) child.parentNode._unlink(child);
+      child.parentNode = el;
+      return child;
+    },
+    _unlink(child) {
+      const at = el.childNodes.indexOf(child);
+      if (at >= 0) el.childNodes.splice(at, 1);
+      child.parentNode = null;
+    },
+    _toNode(x) {
+      return isNode(x) ? x : makeTextNode(x);
+    },
+    append(...children) {
+      for (const c of children.flat(Infinity)) {
+        if (c === null || c === undefined) continue;
+        el.childNodes.push(el._adopt(el._toNode(c)));
+      }
+    },
+    appendChild(child) {
+      el.append(child);
+      return child;
+    },
+    prepend(...children) {
+      const items = [...children];
+      items.reverse();
+      for (const c of items) {
+        el.childNodes.unshift(el._adopt(el._toNode(c)));
+      }
+    },
+    replaceChildren(...children) {
+      for (const c of [...el.childNodes]) el._unlink(c);
+      el.append(...children);
+    },
+    remove() {
+      if (el.parentNode) el.parentNode._unlink(el);
+    },
+    get children() {
+      return el.childNodes.filter((c) => c.nodeType === 1);
+    },
+    get firstChild() {
+      return el.childNodes[0] || null;
+    },
+    get textContent() {
+      let out = "";
+      for (const c of el.childNodes) out += c.textContent;
+      return out;
+    },
+    set textContent(v) {
+      el.replaceChildren(makeTextNode(v));
+    },
+
+    /* -- events (capture-less bubbling, what the frontends rely on) -- */
+    addEventListener(type, fn) {
+      (el.listeners[type] = el.listeners[type] || []).push(fn);
+    },
+    removeEventListener(type, fn) {
+      const fns = el.listeners[type] || [];
+      const at = fns.indexOf(fn);
+      if (at >= 0) fns.splice(at, 1);
+    },
+    dispatchEvent(event) {
+      event.target = event.target || el;
+      let node = el;
+      while (node && !event._stopped) {
+        event.currentTarget = node;
+        for (const fn of [...(node.listeners[event.type] || [])]) {
+          fn.call(node, event);
+          if (event._stopped) break;
+        }
+        node = node.parentNode ||
+          (node.nodeType === 9 ? null : node.ownerDocument);
+      }
+      return !event.defaultPrevented;
+    },
+
+    /* -- selectors -- */
+    matches(selector) {
+      return selector
+        .split(",")
+        .some((alt) => matchesCompound(el, parseCompound(lastPart(alt))));
+    },
+    closest(selector) {
+      let node = el;
+      while (node && node.nodeType === 1) {
+        if (node.matches(selector)) return node;
+        node = node.parentNode;
+      }
+      return null;
+    },
+    querySelector(selector) {
+      return el.querySelectorAll(selector)[0] || null;
+    },
+    querySelectorAll(selector) {
+      const out = [];
+      for (const alt of selector.split(",")) {
+        const parts = alt.trim().split(/\s+/).map(parseCompound);
+        walk(el, (child) => {
+          if (matchesChain(child, parts, el)) out.push(child);
+        });
+      }
+      return out;
+    },
+  };
+  return el;
+}
+
+function walk(root, fn) {
+  for (const c of root.childNodes || []) {
+    if (c.nodeType === 1) {
+      fn(c);
+      walk(c, fn);
+    }
+  }
+}
+
+function lastPart(alt) {
+  const parts = alt.trim().split(/\s+/);
+  return parts[parts.length - 1];
+}
+
+/* compound: tag?(#id|.class|[attr="v"]|[attr]|:checked)* */
+function parseCompound(s) {
+  const out = { tag: null, id: null, classes: [], attrs: [], pseudos: [] };
+  const re = /^([a-zA-Z][\w-]*)|^#([\w-]+)|^\.([\w-]+)|^\[([\w-]+)(?:=["']?([^\]"']*)["']?)?\]|^:([\w-]+)/;
+  let rest = s;
+  while (rest.length) {
+    const m = re.exec(rest);
+    if (!m) throw new Error("unsupported selector: " + s);
+    if (m[1]) out.tag = m[1].toUpperCase();
+    else if (m[2]) out.id = m[2];
+    else if (m[3]) out.classes.push(m[3]);
+    else if (m[4]) out.attrs.push([m[4], m[5] === undefined ? null : m[5]]);
+    else if (m[6]) out.pseudos.push(m[6]);
+    rest = rest.slice(m[0].length);
+  }
+  return out;
+}
+
+function matchesCompound(el, c) {
+  if (el.nodeType !== 1) return false;
+  if (c.tag && el.tagName !== c.tag) return false;
+  if (c.id && el.id !== c.id) return false;
+  const classes = (el.attrs.class || "").split(/\s+/);
+  for (const cls of c.classes) {
+    if (classes.indexOf(cls) < 0) return false;
+  }
+  for (const [k, v] of c.attrs) {
+    if (v === null) {
+      if (!(k in el.attrs)) return false;
+    } else if ((el.attrs[k] !== undefined ? el.attrs[k] : "") !== v &&
+               !(k === "value" && el.value === v)) {
+      return false;
+    }
+  }
+  for (const p of c.pseudos) {
+    if (p === "checked") {
+      if (!el.checked && !el.selected) return false;
+    } else {
+      throw new Error("unsupported pseudo :" + p);
+    }
+  }
+  return true;
+}
+
+function matchesChain(el, parts, scope) {
+  if (!matchesCompound(el, parts[parts.length - 1])) return false;
+  let node = el.parentNode;
+  let at = parts.length - 2;
+  while (at >= 0 && node && node !== scope) {
+    if (node.nodeType === 1 && matchesCompound(node, parts[at])) at--;
+    node = node.parentNode;
+  }
+  return at < 0;
+}
+
+/* ---------------- events ------------------------------------------------ */
+
+function makeEvent(type, props) {
+  const event = Object.assign({}, props || {});
+  event.type = type;
+  event.defaultPrevented = false;
+  event._stopped = false;
+  event.target = (props && props.target) || null;
+  event.preventDefault = function () {
+    event.defaultPrevented = true;
+  };
+  event.stopPropagation = function () {
+    event._stopped = true;
+  };
+  return event;
+}
+
+/* ---------------- document --------------------------------------------- */
+
+function makeDocument() {
+  const doc = makeElement("#document", null);
+  doc.nodeType = 9;
+  doc.ownerDocument = doc;
+  doc._cookies = {};
+  const html = makeElement("html", doc);
+  doc.append(html);
+  doc.documentElement = html;
+  doc.head = makeElement("head", doc);
+  doc.body = makeElement("body", doc);
+  html.append(doc.head, doc.body);
+  doc.createElement = (tag) => makeElement(tag, doc);
+  doc.createTextNode = (text) => makeTextNode(text);
+  doc.getElementById = (id) => {
+    let found = null;
+    walk(doc, (el) => {
+      if (!found && el.id === id) found = el;
+    });
+    return found;
+  };
+  Object.defineProperty(doc, "cookie", {
+    get() {
+      return Object.entries(doc._cookies)
+        .map(([k, v]) => k + "=" + v)
+        .join("; ");
+    },
+    set(str) {
+      const [pair] = String(str).split(";");
+      const eq = pair.indexOf("=");
+      if (eq > 0) {
+        doc._cookies[pair.slice(0, eq).trim()] = pair.slice(eq + 1).trim();
+      }
+    },
+  });
+  return doc;
+}
+
+/* ---------------- HTML parser (well-formed static pages only) ----------- */
+
+function parseHTML(doc, html) {
+  // strip doctype + comments
+  html = html
+    .replace(/<!doctype[^>]*>/gi, "")
+    .replace(/<!--[\s\S]*?-->/g, "");
+  const re = /<\/?[a-zA-Z][^>]*>|[^<]+/g;
+  const stack = [];
+  let root = null;
+  for (const tok of html.match(re) || []) {
+    if (tok[0] !== "<") {
+      if (stack.length && tok) {
+        stack[stack.length - 1].append(makeTextNode(tok));
+      }
+      continue;
+    }
+    if (tok.slice(0, 2) === "</") {
+      const tag = tok.slice(2, -1).trim().toLowerCase();
+      for (let i = stack.length - 1; i >= 0; i--) {
+        if (stack[i].tagName.toLowerCase() === tag) {
+          stack.length = i;
+          break;
+        }
+      }
+      continue;
+    }
+    const m = /^<([a-zA-Z][\w-]*)((?:[^>"']|"[^"]*"|'[^']*')*?)(\/?)>$/.exec(tok);
+    if (!m) continue;
+    const el = doc.createElement(m[1]);
+    const attrRe = /([\w-]+)(?:=("([^"]*)"|'([^']*)'|[^\s"'>]+))?/g;
+    let am;
+    while ((am = attrRe.exec(m[2]))) {
+      const raw = am[2];
+      let val = "";
+      if (raw !== undefined) {
+        val = am[3] !== undefined ? am[3]
+          : am[4] !== undefined ? am[4] : raw;
+      }
+      el.setAttribute(am[1], val);
+    }
+    if (stack.length) stack[stack.length - 1].append(el);
+    else root = el;
+    const tag = m[1].toLowerCase();
+    if (!m[3] && !VOID_TAGS[tag]) stack.push(el);
+  }
+  return root;
+}
+
+/* ---------------- FormData --------------------------------------------- */
+
+function makeFormDataFactory() {
+  function FormData(form) {
+    const entries = [];
+    if (form) {
+      walk(form, (el) => {
+        const name = el.attrs.name;
+        if (!name || el.disabled) return;
+        if (el.tagName === "INPUT") {
+          const type = (el.attrs.type || "text").toLowerCase();
+          if ((type === "checkbox" || type === "radio") && !el.checked) {
+            return;
+          }
+          entries.push([name, el.value]);
+        } else if (el.tagName === "SELECT" || el.tagName === "TEXTAREA") {
+          entries.push([name, el.value]);
+        }
+      });
+    }
+    this._entries = entries;
+    this.get = (name) => {
+      const hit = entries.find(([k]) => k === name);
+      return hit ? hit[1] : null;
+    };
+    this.getAll = (name) =>
+      entries.filter(([k]) => k === name).map(([, v]) => v);
+  }
+  return FormData;
+}
+
+/* ---------------- environment assembly ---------------------------------- */
+
+function makeEnvironment(opts) {
+  const fixtures = opts.fixtures;
+  const requests = opts.requests;
+  const document = makeDocument();
+  const location = { hash: "", pathname: "/", href: "/" };
+  const history = {
+    replaceState(_state, _title, url) {
+      if (String(url)[0] === "#") location.hash = String(url);
+      else {
+        location.pathname = String(url);
+        location.hash = "";
+      }
+    },
+    pushState(state, title, url) {
+      history.replaceState(state, title, url);
+    },
+  };
+  const storageMap = {};
+  const localStorage = {
+    getItem: (k) => (k in storageMap ? storageMap[k] : null),
+    setItem: (k, v) => {
+      storageMap[k] = String(v);
+    },
+    removeItem: (k) => {
+      delete storageMap[k];
+    },
+  };
+  const windowListeners = {};
+  const window = {
+    addEventListener(type, fn) {
+      (windowListeners[type] = windowListeners[type] || []).push(fn);
+    },
+    removeEventListener(type, fn) {
+      const fns = windowListeners[type] || [];
+      const at = fns.indexOf(fn);
+      if (at >= 0) fns.splice(at, 1);
+    },
+    location,
+    open: () => null,
+  };
+
+  function fetch(path, options = {}) {
+    // Pages live at "/": relative URLs resolve against the root, same
+    // normalization the jsrt browser applies before its http bridge.
+    if (!/^https?:/.test(path) && path[0] !== "/") path = "/" + path;
+    const method = ((options && options.method) || "GET").toUpperCase();
+    requests.push({
+      method, path, headers: (options && options.headers) || {},
+    });
+    const key = method + " " + path;
+    const hit = fixtures[key] !== undefined ? fixtures[key] : fixtures[path];
+    return Promise.resolve().then(() => {
+      if (hit === undefined) {
+        throw new TypeError("fetch failed: no fixture for " + key);
+      }
+      const status = hit.status !== undefined ? hit.status : 200;
+      const bodyText =
+        typeof hit.body === "string" ? hit.body : JSON.stringify(hit.body);
+      return {
+        ok: status >= 200 && status < 300,
+        status,
+        statusText: hit.statusText || (status === 200 ? "OK" : String(status)),
+        json: () => Promise.resolve().then(() => JSON.parse(bodyText)),
+        text: () => Promise.resolve(bodyText),
+        headers: { get: () => null },
+      };
+    });
+  }
+
+  // `instanceof Node` must work on factory-made nodes (kubeflow.js
+  // KF.el uses it): a host class with a custom hasInstance brand check.
+  function NodeBrand() {}
+  if (typeof Symbol !== "undefined" && Symbol.hasInstance) {
+    Object.defineProperty(NodeBrand, Symbol.hasInstance, {
+      value: (x) => !!x && (x.nodeType === 1 || x.nodeType === 3 ||
+                            x.nodeType === 9),
+    });
+  }
+
+  return {
+    document,
+    window,
+    location,
+    history,
+    localStorage,
+    fetch,
+    Event: makeEvent,
+    Node: NodeBrand,
+    FormData: makeFormDataFactory(),
+    navigator: { userAgent: "node-differential" },
+    parseHTML: (html) => {
+      const root = parseHTML(document, html);
+      if (root) {
+        // graft parsed <head>/<body> contents into the document's own
+        const head = root.querySelector("head");
+        const body = root.querySelector("body");
+        if (head) document.head.replaceChildren(...head.childNodes);
+        if (body) document.body.replaceChildren(...body.childNodes);
+      }
+      return document;
+    },
+    dispatch(el, type, props) {
+      return el.dispatchEvent(makeEvent(type, props));
+    },
+  };
+}
+
+module.exports = { makeEnvironment, makeElement, makeTextNode, makeEvent };

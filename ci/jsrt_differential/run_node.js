@@ -1,0 +1,53 @@
+#!/usr/bin/env node
+/* Node side of the jsrt differential battery.
+ *
+ * Executes every case in corpus.json under Node (the independent,
+ * real-world engine) and compares the JSON-normalized result to the
+ * hand-written `expected` constant. Exits non-zero on any mismatch and
+ * prints one JSON report line either way, so the Python test (and the CI
+ * job) can also cross-compare Node's values against jsrt's.
+ *
+ * No dependencies; runs on any Node >= 14.
+ */
+"use strict";
+
+const fs = require("fs");
+const path = require("path");
+
+const corpusPath =
+  process.argv[2] || path.join(__dirname, "corpus.json");
+const corpus = JSON.parse(fs.readFileSync(corpusPath, "utf8"));
+
+function normalize(v) {
+  // JSON round-trip: same normalization the Python side applies to both
+  // engines (drops undefined object members, maps NaN→null, etc.).
+  return JSON.parse(JSON.stringify(v === undefined ? null : v));
+}
+
+async function runCase(c) {
+  // Indirect eval: evaluates in global scope, like jsrt's program run.
+  const value = await (0, eval)(c.js);
+  return normalize(value);
+}
+
+(async () => {
+  const results = {};
+  const failures = [];
+  for (const c of corpus.cases) {
+    let got;
+    try {
+      got = await runCase(c);
+    } catch (err) {
+      got = { __error__: String((err && err.message) || err) };
+    }
+    results[c.name] = got;
+    const want = normalize(c.expected);
+    if (JSON.stringify(got) !== JSON.stringify(want)) {
+      failures.push({ name: c.name, got, want });
+    }
+  }
+  process.stdout.write(
+    JSON.stringify({ engine: "node", version: process.version, results, failures }) + "\n"
+  );
+  process.exit(failures.length ? 1 : 0);
+})();

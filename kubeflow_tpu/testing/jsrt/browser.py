@@ -380,7 +380,10 @@ class Browser:
             h = options.props.get("headers")
             if isinstance(h, JSObject):
                 for k in h.own_keys():
-                    headers[k] = to_js_string(h.props[k], interp)
+                    # get_prop, not a raw props read: a getter-defined
+                    # header must invoke the getter (and accessor slots
+                    # never leak their placeholder).
+                    headers[k] = to_js_string(interp.get_prop(h, k), interp)
             body = options.props.get("body")
             body_bytes = to_js_string(body, interp).encode() \
                 if body is not None and body is not undefined else None

@@ -6,11 +6,22 @@ classes below. Host integration happens through ``JSObject`` subclasses
 overriding ``js_get_prop``/``js_set_prop`` (the DOM does this) and through
 ``HostFunction`` wrapping Python callables.
 
-``await`` semantics: synchronous resolution — drain microtasks (and the
-host's I/O pump) until the promise settles; a promise that can only be
-settled by a *future* host event raises JSDeadlock instead of hanging.
-This matches how the apps use async (fetch-shaped work awaited;
-user-gesture promises ``.then()``-ed).
+``await`` semantics: spec-faithful suspension. Each in-flight async
+function body runs on a cooperative carrier thread (``_AsyncBody``) with
+a strict one-at-a-time handoff: at ``await`` the body parks, schedules
+its continuation as a real promise-reaction microtask, and control
+returns to the caller — so ``await`` always defers at least one
+microtask turn and interleaves exactly like a browser's event loop (the
+round-4 differential battery pinned the old run-to-completion model as
+divergent: ``async-await-sequencing``/``settimeout-zero-after-
+microtasks`` in ci/jsrt_differential/corpus.json). Only one thread ever
+executes JS at a time, enforced by event handoff — there is no
+concurrency, just continuations carried by parked threads.
+
+Top-level ``await`` (outside any async function) keeps the old
+synchronous drain: run microtasks (and the host's I/O pump) until the
+promise settles, raising JSDeadlock for promises only a future host
+event can settle.
 """
 
 from __future__ import annotations
@@ -18,6 +29,7 @@ from __future__ import annotations
 import json as _json
 import math
 import re as _re
+import threading as _threading
 import time as _time
 from collections import deque
 
@@ -96,7 +108,8 @@ class JSObject:
         if name in self.getters:
             return interp.call_function(self.getters[name], self, [])
         if name in self.props:
-            return self.props[name]
+            value = self.props[name]
+            return undefined if value is ACCESSOR_SLOT else value
         return NOT_PRESENT
 
     def js_set_prop(self, name: str, value, interp) -> bool:
@@ -108,12 +121,24 @@ class JSObject:
 
     def js_delete_prop(self, name: str) -> None:
         self.props.pop(name, None)
+        self.getters.pop(name, None)
+        self.setters.pop(name, None)
 
     def own_keys(self) -> list:
-        return list(self.props.keys())
+        # Accessor properties are enumerable own properties too (spec:
+        # Object.keys lists them; for-in walks them). Object literals
+        # reserve an ACCESSOR_SLOT in props at definition time so the
+        # insertion order interleaves exactly as written; accessors
+        # installed by other means (host code) land after.
+        keys = list(self.props.keys())
+        keys += [k for k in self.getters if k not in self.props]
+        return keys
 
 
 NOT_PRESENT = object()
+ACCESSOR_SLOT = object()  # placeholder in props holding a getter's slot
+                          # in enumeration order (js_get_prop routes the
+                          # actual read through the getter)
 
 
 class JSArray(JSObject):
@@ -222,6 +247,82 @@ class Environment:
                 return True
             env = env.parent
         return False
+
+
+class _AsyncBody:
+    """One in-flight async function call, carried by a parked thread.
+
+    Cooperative, never concurrent: exactly one thread executes JS at any
+    moment. The controller (whoever called the async function, or later
+    the microtask resuming it) blocks until the body YIELDS — either by
+    parking at an ``await`` or by finishing. ``await`` registers the
+    continuation as a promise reaction, so resumption order is exactly
+    the microtask order a browser would use."""
+
+    def __init__(self, interp, fn, env, this):
+        self.interp = interp
+        self.fn, self.env, self.this = fn, env, this
+        self.promise = Promise(interp)
+        self._resume = _threading.Event()   # body waits; controller sets
+        self._yielded = _threading.Event()  # controller waits; body sets
+        self._box = None                    # ("value" | "error", payload)
+        self._thread = _threading.Thread(
+            target=self._run, daemon=True, name="jsrt-async-body")
+
+    # ---- controller side ----
+
+    def start(self) -> "Promise":
+        self._thread.start()
+        self._wait_for_yield()
+        return self.promise
+
+    def _wait_for_yield(self) -> None:
+        self._yielded.wait()
+        self._yielded.clear()
+
+    def _deliver(self, kind, payload) -> None:
+        """Runs as a promise-reaction microtask: hand the settled value
+        (or rejection) into the parked body and run it to its next
+        yield point."""
+        self.interp.parked_async.remove(self)
+        self._box = (kind, payload)
+        self._resume.set()
+        self._wait_for_yield()
+
+    # ---- body side (carrier thread) ----
+
+    def _run(self) -> None:
+        tls = self.interp._async_tls
+        tls.body = self
+        try:
+            result = self.interp._run_body(self.fn, self.env, self.this)
+            self.promise.resolve(result)
+        except JSException as e:
+            self.promise.reject(e.value)
+        except BaseException as e:  # host bug — surface, don't hang
+            self.promise.reject(make_error("InternalError", repr(e)))
+        finally:
+            tls.body = None
+            self._yielded.set()  # final yield: body is done
+
+    def await_on(self, value):
+        wrapped = Promise(self.interp)
+        wrapped.resolve(value)  # non-promises settle immediately; chains
+        self.interp.parked_async.append(self)
+        wrapped.then_callbacks(
+            lambda v: self._deliver("value", v),
+            lambda e: self._deliver("error", e),
+        )
+        # Park: control goes back to the controller …
+        self._yielded.set()
+        self._resume.wait()
+        self._resume.clear()
+        # … and a microtask brought us back with the settled value.
+        kind, payload = self._box
+        self._box = None
+        if kind == "error":
+            raise JSException(payload)
+        return payload
 
 
 class Promise(JSObject):
@@ -385,7 +486,7 @@ def js_to_python(v):
     if isinstance(v, JSObject):
         return {k: js_to_python(val) for k, val in v.props.items()
                 if not isinstance(val, (JSFunction, HostFunction))
-                and val is not undefined}
+                and val is not undefined and val is not ACCESSOR_SLOT}
     return None
 
 
@@ -418,6 +519,8 @@ class Interpreter:
         self.console: list = []
         self.unhandled_rejections: list = []
         self._now = _time.time       # virtual clock hook (browser overrides)
+        self._async_tls = _threading.local()  # carrier-thread → _AsyncBody
+        self.parked_async: list = []  # bodies parked at an await right now
         install_stdlib(self)
 
     # -- program entry ----------------------------------------------------------
@@ -439,6 +542,13 @@ class Interpreter:
     # -- promise await ----------------------------------------------------------
 
     def await_value(self, v):
+        body = getattr(self._async_tls, "body", None)
+        if body is not None:
+            # Inside an async function: park the carrier and resume via a
+            # promise-reaction microtask — ALWAYS defers at least one
+            # turn, even for non-promises/settled promises (spec).
+            return body.await_on(v)
+        # Top-level await: synchronous drain (see module docstring).
         if not isinstance(v, Promise):
             return v
         for _ in range(10_000):
@@ -472,13 +582,7 @@ class Interpreter:
         self.bind_params(fn, env, args)
         use_this = fn.this_val if fn.is_arrow else this
         if fn.is_async:
-            promise = Promise(self)
-            try:
-                result = self._run_body(fn, env, use_this)
-                promise.resolve(result)
-            except JSException as e:
-                promise.reject(e.value)
-            return promise
+            return _AsyncBody(self, fn, env, use_this).start()
         return self._run_body(fn, env, use_this)
 
     def _run_body(self, fn: JSFunction, env: Environment, this):
@@ -680,13 +784,30 @@ class Interpreter:
             loop_env = Environment(env)
             if init is not None:
                 self.exec_stmt(init, loop_env, this)
-            while cond is None or is_truthy(self.eval(cond, loop_env, this)):
+            # let/const loop heads get a FRESH binding per iteration
+            # (CreatePerIterationEnvironment): closures made in the body
+            # capture that iteration's value — `for (let i …) push(() => i)`
+            # yields 0,1,2, not the final value like `var`.
+            per_iter = (init is not None and init[0] == "var"
+                        and init[1] in ("let", "const"))
+            while True:
+                if cond is not None and not is_truthy(
+                        self.eval(cond, loop_env, this)):
+                    break
                 try:
                     self.exec_stmt(body, Environment(loop_env), this)
                 except BreakSignal:
                     break
                 except ContinueSignal:
                     pass
+                if per_iter:
+                    # Copy AFTER the body, BEFORE the update: closures
+                    # made this iteration keep this iteration's values;
+                    # the update mutates only the next iteration's env.
+                    fresh = Environment(env)
+                    fresh.vars.update(loop_env.vars)
+                    fresh.consts |= loop_env.consts
+                    loop_env = fresh
                 if update is not None:
                     self.eval(update, loop_env, this)
         elif op == "forof":
@@ -826,6 +947,11 @@ class Interpreter:
                 elif kind == "getter":
                     obj.getters[prop[1]] = JSFunction(
                         prop[1], [], None, prop[2], env)
+                    # Accessor keys enumerate interleaved with data keys
+                    # in DEFINITION order (Object.keys/for-in): reserve
+                    # the slot now, tombstoned so reads still hit the
+                    # getter via js_get_prop's precedence.
+                    obj.props.setdefault(prop[1], ACCESSOR_SLOT)
                 elif kind == "setter":
                     obj.setters[prop[1]] = JSFunction(
                         prop[1], [(prop[2], None)], None, prop[3], env)
@@ -1131,6 +1257,12 @@ def loose_equals(l, r) -> bool:
         return l == to_number(r)
     if isinstance(l, str) and isinstance(r, float):
         return to_number(l) == r
+    # object vs primitive: ToPrimitive the object, then retry —
+    # `[] == ""` and `[1] == 1` are true in every real engine.
+    if isinstance(l, JSObject) and isinstance(r, (str, float)):
+        return loose_equals(to_js_string(l), r)
+    if isinstance(r, JSObject) and isinstance(l, (str, float)):
+        return loose_equals(l, to_js_string(r))
     return False
 
 

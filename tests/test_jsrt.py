@@ -148,15 +148,33 @@ def test_async_rejection_propagates():
     """) == ["got:nope"]
 
 
-def test_await_deadlock_raises_not_hangs():
+def test_await_on_unsettleable_promise_parks_without_hanging():
+    """Spec-faithful await: a body awaiting a promise nothing will ever
+    settle simply stays suspended (visible via parked_async) while the
+    rest of the program — and the interpreter — keeps running. The old
+    synchronous-await design had to raise JSDeadlock here instead; the
+    hang risk that guarded against is structurally gone."""
+    interp = Interpreter()
+    interp.run("""
+      const out = [];
+      async function stuck() { out.push("in"); await new Promise(() => {}); out.push("never"); }
+      stuck();
+      out.push("after");
+    """)
+    interp.run_microtasks()
+    from kubeflow_tpu.testing.jsrt.interp import js_to_python
+
+    assert js_to_python(interp.global_env.lookup("out")) == ["in", "after"]
+    assert len(interp.parked_async) == 1  # the suspended body, observable
+
+
+def test_toplevel_await_deadlock_still_raises():
+    """Outside an async function the synchronous drain remains — and so
+    does its JSDeadlock guard for promises only a future host event can
+    settle."""
     interp = Interpreter()
     with pytest.raises((JSDeadlock, JSException)):
-        interp.run("""
-          async function stuck() { await new Promise(() => {}); }
-          stuck();
-          """)
-        interp.run_microtasks()
-        # The await drains and then raises JSDeadlock synchronously.
+        interp.run("const p = new Promise(() => {}); await p;")
 
 
 def test_unsupported_syntax_fails_loudly():
@@ -399,3 +417,31 @@ def test_index_coercion_nan_and_infinity():
     assert out[0] == "abc" and out[1] == "abc"
     assert out[2] == 97.0
     assert out[3] == "ab"
+
+
+def test_object_keys_interleaves_accessors_in_definition_order():
+    """Object.keys must enumerate accessor properties interleaved with
+    data properties in definition order — browsers do; a different order
+    would re-render tables/entries differently than a real engine."""
+    assert run("""
+      const o = { a: 1, get b() { return 2; }, c: 3 };
+      const out = [Object.keys(o), Object.entries(o), o.b];
+    """) == [["a", "b", "c"], [["a", 1], ["b", 2], ["c", 3]], 2]
+
+
+def test_fetch_headers_defined_by_getter():
+    """A getter-defined header value must be read through the getter —
+    not crash the interpreter with a raw-dict KeyError."""
+    seen = {}
+
+    def http(method, path, headers, body):
+        seen.update(headers)
+        return 200, "OK", [], "{}"
+
+    b = Browser(http)
+    b.load("/")
+    b.eval("""
+      fetch("/api/x", { headers: { get auth() { return "tok-" + (1 + 2); } } });
+    """)
+    b.advance(1)
+    assert seen.get("auth") == "tok-3"
