@@ -149,8 +149,35 @@ def create_base_app(
         )
         return json_success({"namespaces": [n for n in names if n]})
 
+    DEFAULT_SC_ANNOTATIONS = (
+        "storageclass.kubernetes.io/is-default-class",
+        "storageclass.beta.kubernetes.io/is-default-class",  # GKE legacy
+    )
+
+    async def storageclasses(_request):
+        """Names for the volume form's class picker (reference
+        crud_backend/routes/get.py:18-23)."""
+        names = sorted(
+            (sc.get("metadata") or {}).get("name", "")
+            for sc in await kube.list("StorageClass")
+        )
+        return json_success({"storageClasses": [n for n in names if n]})
+
+    async def default_storageclass(_request):
+        """The cluster default, or "" when none is marked (reference
+        crud_backend/routes/get.py:26-52 — both annotation spellings)."""
+        for sc in await kube.list("StorageClass"):
+            annotations = (sc.get("metadata") or {}).get("annotations") or {}
+            if any(annotations.get(key) == "true"
+                   for key in DEFAULT_SC_ANNOTATIONS):
+                return json_success(
+                    {"defaultStorageClass": sc["metadata"]["name"]})
+        return json_success({"defaultStorageClass": ""})
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/namespaces", namespaces)
+    app.router.add_get("/api/storageclasses", storageclasses)
+    app.router.add_get("/api/storageclasses/default", default_storageclass)
     return app

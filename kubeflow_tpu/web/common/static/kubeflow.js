@@ -16,6 +16,147 @@
 
 const KF = {};
 
+/* ---------------- i18n (reference: frontends' translation infra) --------
+ *
+ * Message-catalog layer: KF.t(key, params) resolves through the active
+ * locale's catalog, falls back to English, then to the key itself.
+ * Catalogs are plain objects; apps extend them with KF.registerMessages.
+ * The chosen locale persists in localStorage and a change notifies
+ * subscribers so live views re-render in place. */
+
+KF.i18n = {
+  locale: "en",
+  fallback: "en",
+  catalogs: { en: {}, de: {} },
+  listeners: [],
+  available: function () {
+    return Object.keys(KF.i18n.catalogs).sort();
+  },
+};
+
+KF.registerMessages = function (locale, messages) {
+  KF.i18n.catalogs[locale] = Object.assign(
+    KF.i18n.catalogs[locale] || {},
+    messages
+  );
+};
+
+KF.hasMessage = function (key) {
+  const cat = KF.i18n.catalogs[KF.i18n.locale] || {};
+  const fall = KF.i18n.catalogs[KF.i18n.fallback] || {};
+  return cat[key] !== undefined || fall[key] !== undefined;
+};
+
+KF.t = function (key, params) {
+  const cat = KF.i18n.catalogs[KF.i18n.locale] || {};
+  const fall = KF.i18n.catalogs[KF.i18n.fallback] || {};
+  let msg = cat[key];
+  if (msg === undefined) msg = fall[key];
+  if (msg === undefined) msg = key;
+  if (params) {
+    for (const [k, v] of Object.entries(params)) {
+      msg = msg.split("{" + k + "}").join(String(v));
+    }
+  }
+  return msg;
+};
+
+KF.setLocale = function (locale) {
+  KF.i18n.locale = locale;
+  try {
+    localStorage.setItem("kf.locale", locale);
+  } catch (err) {
+    /* storage-less context (sandboxed iframe) — session-only locale */
+  }
+  for (const fn of KF.i18n.listeners.slice()) {
+    try {
+      fn(locale);
+    } catch (err) {
+      /* one subscriber's render error must not stop the others */
+    }
+  }
+};
+
+KF.onLocaleChange = function (fn) {
+  KF.i18n.listeners.push(fn);
+  return function () {
+    const at = KF.i18n.listeners.indexOf(fn);
+    if (at >= 0) KF.i18n.listeners.splice(at, 1);
+  };
+};
+
+KF.localePicker = function () {
+  const select = document.createElement("select");
+  select.className = "kf-locale-picker";
+  select.style.width = "auto";
+  for (const loc of KF.i18n.available()) {
+    const opt = document.createElement("option");
+    opt.value = loc;
+    opt.append(document.createTextNode(loc));
+    if (loc === KF.i18n.locale) opt.setAttribute("selected", "selected");
+    select.append(opt);
+  }
+  select.addEventListener("change", () => KF.setLocale(select.value));
+  return select;
+};
+
+/* Common-lib message catalogs. English is the fallback source of truth;
+ * German proves the pipe end-to-end (picker → setLocale → re-render). */
+KF.registerMessages("en", {
+  "status.ready": "Running",
+  "status.waiting": "Starting",
+  "status.warning": "Error",
+  "status.terminating": "Deleting",
+  "status.stopped": "Stopped",
+  "table.status": "Status",
+  "table.name": "Name",
+  "table.image": "Image",
+  "table.cpu": "CPU",
+  "table.memory": "Memory",
+  "table.tpu": "TPU",
+  "table.age": "Age",
+  "table.lastActivity": "Last activity",
+  "table.actions": "Actions",
+  "action.start": "Start",
+  "action.stop": "Stop",
+  "action.delete": "Delete",
+  "action.connect": "Connect",
+  "common.none": "none",
+  "common.cancel": "Cancel",
+  "jwa.empty": "No notebook servers in this namespace.",
+});
+KF.registerMessages("de", {
+  "status.ready": "Läuft",
+  "status.waiting": "Startet",
+  "status.warning": "Fehler",
+  "status.terminating": "Wird gelöscht",
+  "status.stopped": "Gestoppt",
+  "table.status": "Status",
+  "table.name": "Name",
+  "table.image": "Image",
+  "table.cpu": "CPU",
+  "table.memory": "Speicher",
+  "table.tpu": "TPU",
+  "table.age": "Alter",
+  "table.lastActivity": "Letzte Aktivität",
+  "table.actions": "Aktionen",
+  "action.start": "Starten",
+  "action.stop": "Stoppen",
+  "action.delete": "Löschen",
+  "action.connect": "Verbinden",
+  "common.none": "keine",
+  "common.cancel": "Abbrechen",
+  "jwa.empty": "Keine Notebook-Server in diesem Namespace.",
+});
+
+/* Restore the persisted locale (after the catalogs exist). */
+try {
+  const saved = localStorage.getItem("kf.locale");
+  if (saved) KF.i18n.locale = saved;
+} catch (err) {
+  /* storage-less context: default locale */
+}
+
 /* ---------------- backend service (lib/services/backend) ---------------- */
 
 KF.getCookie = function (name) {
@@ -102,11 +243,14 @@ KF.STATUS_TITLES = {
 };
 
 KF.statusDot = function (phase, message) {
+  const label = KF.hasMessage("status." + phase)
+    ? KF.t("status." + phase)
+    : KF.STATUS_TITLES[phase] || phase;
   return KF.el(
     "span",
     { class: "status", title: message || "" },
     KF.el("span", { class: "dot " + phase }),
-    KF.STATUS_TITLES[phase] || phase
+    label
   );
 };
 
@@ -175,7 +319,9 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
               },
             }
           : {},
-        c.title,
+        /* title may be a thunk (e.g. () => KF.t(...)) so headers follow
+         * the active locale on every render. */
+        typeof c.title === "function" ? c.title() : c.title,
         state.idx === idx ? (state.dir > 0 ? " ▲" : " ▼") : ""
       )
     )
@@ -1007,6 +1153,246 @@ KF.advancedSection = function (title, render) {
 /* opts.validate(value) -> error string | null rejects bad entries at
  * Enter time (red border + title) instead of silently dropping them at
  * submit time. */
+/* ---------------- volume forms (reference: jupyter form-new/volume) ------
+ *
+ * Per-volume panel with new-vs-existing choice; "new" edits name
+ * (with {notebook-name} templating), size, storage class and access
+ * mode; "existing" picks a PVC. value() emits the backend's
+ * workspaceVolume/dataVolumes contract (web/jupyter/form.py
+ * _apply_volumes): {newPvc: {metadata, spec}, mount} |
+ * {existingSource: {persistentVolumeClaim}, mount} | null.
+ * Mirrors form-workspace-volume / form-data-volumes / volume/new/*
+ * (name, size, storage-class, access-modes sub-components). */
+
+KF.ACCESS_MODES = ["ReadWriteOnce", "ReadWriteMany", "ReadOnlyMany"];
+
+KF.volumePanel = function (opts = {}) {
+  const kind = opts.kind || "data"; // "workspace" | "data"
+  const catalogs = opts.catalogs || {}; // {pvcs, storageClasses, defaultClass}
+  const modes = kind === "workspace"
+    ? ["new", "existing", "none"]
+    : ["new", "existing"];
+  const modeLabels = {
+    new: KF.t("volumes.typeNew"),
+    existing: KF.t("volumes.typeExisting"),
+    none: KF.t("volumes.typeNone"),
+  };
+
+  const root = KF.el("div", { class: "kf-volume-panel" });
+  const body = KF.el("div", {});
+  const modeSelect = KF.el(
+    "select",
+    { class: "kf-volume-mode", style: { width: "auto" }, onchange: render },
+    modes.map((m) => KF.el("option", { value: m }, modeLabels[m]))
+  );
+  if (opts.mode) modeSelect.value = opts.mode;
+
+  const state = {
+    name: opts.name || (kind === "workspace"
+      ? "{notebook-name}-workspace"
+      : `{notebook-name}-datavol-${opts.index || 1}`),
+    sizeGi: opts.sizeGi || (kind === "workspace" ? "10" : "5"),
+    storageClass: "",         // "" = cluster default
+    accessMode: "ReadWriteOnce",
+    existing: "",
+    mount: opts.mount || (kind === "workspace"
+      ? "/home/jovyan"
+      : `/home/jovyan/data-${opts.index || 1}`),
+  };
+
+  function field(labelKey, control) {
+    return KF.el(
+      "label",
+      { class: "kf-volume-field",
+        style: { display: "block", margin: "6px 0" } },
+      KF.el("span", { style: { display: "inline-block", minWidth: "110px" } },
+            KF.t(labelKey)),
+      control
+    );
+  }
+
+  function bound(attrs, key, tag = "input") {
+    const node = KF.el(tag, Object.assign({
+      value: state[key],
+      oninput: (ev) => { state[key] = ev.target.value; },
+      onchange: (ev) => { state[key] = ev.target.value; },
+    }, attrs));
+    if (tag === "input") node.value = state[key];
+    return node;
+  }
+
+  function render() {
+    const mode = modeSelect.value;
+    if (mode === "none") {
+      body.replaceChildren(
+        KF.el("p", { class: "muted" }, KF.t("volumes.noneHint")));
+      return;
+    }
+    if (mode === "existing") {
+      const pvcs = catalogs.pvcs || [];
+      const pick = KF.el(
+        "select",
+        { class: "kf-volume-existing", style: { width: "auto" },
+          onchange: (ev) => { state.existing = ev.target.value; } },
+        pvcs.length
+          ? pvcs.map((p) => KF.el(
+              "option", { value: p.name },
+              `${p.name} (${p.capacity || "?"})`))
+          : [KF.el("option", { value: "" }, KF.t("volumes.noPvcs"))]
+      );
+      if (pvcs.length && !state.existing) state.existing = pvcs[0].name;
+      if (state.existing) pick.value = state.existing;
+      body.replaceChildren(
+        field("volumes.existingPvc", pick),
+        field("volumes.mount", bound({ class: "kf-volume-mount" }, "mount"))
+      );
+      return;
+    }
+    const classes = catalogs.storageClasses || [];
+    const classSelect = KF.el(
+      "select",
+      { class: "kf-volume-class", style: { width: "auto" },
+        onchange: (ev) => { state.storageClass = ev.target.value; } },
+      KF.el("option", { value: "" },
+            KF.t("volumes.defaultClass",
+                 { name: catalogs.defaultClass || "—" })),
+      classes.map((c) => KF.el("option", { value: c }, c))
+    );
+    if (state.storageClass) classSelect.value = state.storageClass;
+    const modeSel = KF.el(
+      "select",
+      { class: "kf-volume-access", style: { width: "auto" },
+        onchange: (ev) => { state.accessMode = ev.target.value; } },
+      KF.ACCESS_MODES.map((m) => KF.el("option", { value: m }, m))
+    );
+    modeSel.value = state.accessMode;
+    body.replaceChildren(
+      field("volumes.name", bound({ class: "kf-volume-name" }, "name")),
+      field("volumes.size", KF.el(
+        "span", {},
+        bound({ class: "kf-volume-size", type: "number", min: "1",
+                style: { width: "70px" } }, "sizeGi"),
+        " Gi")),
+      field("volumes.class", classSelect),
+      field("volumes.accessMode", modeSel),
+      field("volumes.mount", bound({ class: "kf-volume-mount" }, "mount"))
+    );
+  }
+
+  render();
+  root.append(modeSelect, body);
+  return {
+    root,
+    get mode() {
+      return modeSelect.value;
+    },
+    value() {
+      const mode = modeSelect.value;
+      if (mode === "none") return null;
+      if (mode === "existing") {
+        if (!state.existing) return null;
+        return {
+          existingSource: {
+            persistentVolumeClaim: { claimName: state.existing },
+          },
+          mount: state.mount,
+        };
+      }
+      // A cleared number input yields "" — fall back to the panel's
+      // default rather than emitting the invalid quantity "Gi" (the
+      // apiserver rejects it with an opaque parse error).
+      const size = parseInt(state.sizeGi, 10);
+      const sizeGi = Number.isFinite(size) && size >= 1
+        ? size
+        : (kind === "workspace" ? 10 : 5);
+      const spec = {
+        accessModes: [state.accessMode],
+        resources: { requests: { storage: `${sizeGi}Gi` } },
+      };
+      if (state.storageClass) spec.storageClassName = state.storageClass;
+      return {
+        newPvc: { metadata: { name: state.name }, spec },
+        mount: state.mount,
+      };
+    },
+  };
+};
+
+KF.dataVolumesForm = function (container, catalogs = {}) {
+  /* N removable volume panels + the two add buttons (reference
+   * form-data-volumes: addNewVolume / attachExistingVolume). */
+  const panels = [];
+  const list = KF.el("div", {});
+  let counter = 0;
+
+  function add(mode) {
+    counter += 1;
+    const panel = KF.volumePanel({
+      kind: "data", index: counter, mode, catalogs,
+    });
+    const row = KF.el(
+      "div",
+      { class: "kf-data-volume", style: { margin: "6px 0" } },
+      panel.root,
+      KF.actionButton(KF.t("action.delete"), () => {
+        const at = panels.indexOf(panel);
+        if (at >= 0) panels.splice(at, 1);
+        row.remove();
+      }, { class: "danger" })
+    );
+    panels.push(panel);
+    list.append(row);
+  }
+
+  container.replaceChildren(
+    list,
+    KF.el("div", { style: { marginTop: "4px" } },
+      KF.actionButton(KF.t("volumes.addNew"), () => add("new")),
+      " ",
+      KF.actionButton(KF.t("volumes.attachExisting"), () => add("existing"))
+    )
+  );
+  return {
+    add,
+    value() {
+      return panels.map((p) => p.value()).filter(Boolean);
+    },
+  };
+};
+
+KF.registerMessages("en", {
+  "volumes.typeNew": "New volume",
+  "volumes.typeExisting": "Existing volume",
+  "volumes.typeNone": "No volume",
+  "volumes.noneHint": "The server runs on ephemeral storage only.",
+  "volumes.name": "Name",
+  "volumes.size": "Size",
+  "volumes.class": "Storage class",
+  "volumes.defaultClass": "cluster default ({name})",
+  "volumes.accessMode": "Access mode",
+  "volumes.mount": "Mount path",
+  "volumes.existingPvc": "PVC",
+  "volumes.noPvcs": "no PVCs in this namespace",
+  "volumes.addNew": "+ Add new volume",
+  "volumes.attachExisting": "+ Attach existing volume",
+});
+KF.registerMessages("de", {
+  "volumes.typeNew": "Neues Volume",
+  "volumes.typeExisting": "Vorhandenes Volume",
+  "volumes.typeNone": "Kein Volume",
+  "volumes.noneHint": "Der Server läuft nur mit flüchtigem Speicher.",
+  "volumes.name": "Name",
+  "volumes.size": "Größe",
+  "volumes.class": "Speicherklasse",
+  "volumes.defaultClass": "Cluster-Standard ({name})",
+  "volumes.accessMode": "Zugriffsmodus",
+  "volumes.mount": "Mount-Pfad",
+  "volumes.existingPvc": "PVC",
+  "volumes.noPvcs": "keine PVCs in diesem Namespace",
+  "volumes.addNew": "+ Neues Volume",
+  "volumes.attachExisting": "+ Vorhandenes Volume anhängen",
+});
+
 KF.chipsInput = function (initial, onChange, { placeholder, validate } = {}) {
   const values = (initial || []).slice();
   const list = KF.el("span", { class: "kf-chips" });

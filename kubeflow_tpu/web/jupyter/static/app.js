@@ -10,8 +10,35 @@
 let tpuCatalog = [];
 let tablePoller = null;
 
+/* Shared catalogs for the volume panels (KF.volumePanel): PVCs are
+ * per-namespace; storage classes are cluster-scoped. The same object is
+ * handed to every panel, so a namespace change refreshes them all. */
+const volumeCatalogs = { pvcs: [], storageClasses: [], defaultClass: "" };
+let workspacePanel = null;
+let dataVolumesList = null;
+
+function renderVolumeForms() {
+  workspacePanel = KF.volumePanel({ kind: "workspace",
+                                    catalogs: volumeCatalogs });
+  document.getElementById("workspace-volume-slot")
+    .replaceChildren(workspacePanel.root);
+  dataVolumesList = KF.dataVolumesForm(
+    document.getElementById("data-volumes-slot"), volumeCatalogs);
+}
+
+async function loadStorageCatalogs() {
+  const [classes, dflt] = await Promise.all([
+    api("api/storageclasses").catch(() => ({ storageClasses: [] })),
+    api("api/storageclasses/default").catch(
+      () => ({ defaultStorageClass: "" })),
+  ]);
+  volumeCatalogs.storageClasses = classes.storageClasses || [];
+  volumeCatalogs.defaultClass = dflt.defaultStorageClass || "";
+  renderVolumeForms();
+}
+
 async function loadNamespaceCatalogs() {
-  /* PVCs for the data-volume picker + PodDefaults for configurations —
+  /* PVCs for the volume panels + PodDefaults for configurations —
    * refetched on namespace change. */
   const [pvcs, pds] = await Promise.all([
     api(`api/namespaces/${ns.get()}/pvcs`).catch(() => ({ pvcs: [] })),
@@ -19,13 +46,14 @@ async function loadNamespaceCatalogs() {
       poddefaults: [],
     })),
   ]);
-  const dataVolume = document.getElementById("data-volume");
-  dataVolume.replaceChildren(
-    el("option", { value: "" }, "none"),
-    ...(pvcs.pvcs || []).map((p) =>
-      el("option", { value: p.name }, `${p.name} (${p.capacity || "?"})`)
-    )
-  );
+  // The backend hands back raw PVC objects; the panels want name+size.
+  volumeCatalogs.pvcs = (pvcs.pvcs || []).map((p) => ({
+    name: ((p.metadata || {}).name) || p.name || "",
+    capacity:
+      ((((p.spec || {}).resources || {}).requests || {}).storage) ||
+      p.capacity || "",
+  })).filter((p) => p.name);
+  renderVolumeForms();
   const slot = document.getElementById("configurations-slot");
   const options = pds.poddefaults || [];
   slot.classList.toggle("muted", !options.length);
@@ -358,20 +386,20 @@ async function refresh() {
   const body = await api(`api/namespaces/${ns.get()}/notebooks`);
   const columns = [
     {
-      title: "Status",
+      title: () => KF.t("table.status"),
       render: (nb) => statusDot(nb.status.phase, nb.status.message),
       sortKey: (nb) => nb.status.phase,
     },
-    { title: "Name", render: (nb) => nb.name, sortKey: (nb) => nb.name },
+    { title: () => KF.t("table.name"), render: (nb) => nb.name, sortKey: (nb) => nb.name },
     {
-      title: "Image",
+      title: () => KF.t("table.image"),
       render: (nb) => nb.image.split("/").pop(),
       sortKey: (nb) => nb.image,
     },
-    { title: "CPU", render: (nb) => nb.cpu || "—" },
-    { title: "Memory", render: (nb) => nb.memory || "—" },
+    { title: () => KF.t("table.cpu"), render: (nb) => nb.cpu || "—" },
+    { title: () => KF.t("table.memory"), render: (nb) => nb.memory || "—" },
     {
-      title: "TPU",
+      title: () => KF.t("table.tpu"),
       render: (nb) =>
         nb.tpu
           ? el(
@@ -391,23 +419,23 @@ async function refresh() {
       sortKey: (nb) => (nb.tpu ? nb.tpu.accelerator : ""),
     },
     {
-      title: "Age",
+      title: () => KF.t("table.age"),
       render: (nb) => KF.ageCell(nb.age),
       sortKey: (nb) => nb.age || "",
     },
     {
-      title: "Last activity",
+      title: () => KF.t("table.lastActivity"),
       render: (nb) => (nb.lastActivity ? KF.ageCell(nb.lastActivity, " ago") : "—"),
       sortKey: (nb) => nb.lastActivity || "",
     },
     {
-      title: "Actions",
+      title: () => KF.t("table.actions"),
       render: (nb) => {
         const stopped = nb.status.phase === "stopped";
         return el(
           "span",
           {},
-          KF.actionButton(stopped ? "Start" : "Stop", () =>
+          KF.actionButton(stopped ? KF.t("action.start") : KF.t("action.stop"), () =>
             api(`api/namespaces/${ns.get()}/notebooks/${nb.name}`, {
               method: "PATCH",
               body: JSON.stringify({ stopped: !stopped }),
@@ -420,7 +448,7 @@ async function refresh() {
           ),
           " ",
           KF.actionButton(
-            "Delete",
+            KF.t("action.delete"),
             () =>
               KF.confirmDialog({
                 title: `Delete notebook ${nb.name}?`,
@@ -446,7 +474,7 @@ async function refresh() {
               target: "_blank",
               onclick: (ev) => ev.stopPropagation(),
             },
-            "Connect"
+            KF.t("action.connect")
           )
         );
       },
@@ -454,7 +482,7 @@ async function refresh() {
   ];
   renderTable(document.getElementById("notebook-table"), columns, body.notebooks, {
     onRowClick: openDetails,
-    emptyText: "No notebook servers in this namespace.",
+    emptyText: KF.t("jwa.empty"),
   });
 }
 
@@ -595,16 +623,12 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
       payload.tpu.queuedProvisioning = true;
     }
   }
-  if (!form.get("workspace")) payload.workspaceVolume = null;
-  if (form.get("dataVolume")) {
-    payload.dataVolumes = [
-      {
-        existingSource: {
-          persistentVolumeClaim: { claimName: form.get("dataVolume") },
-        },
-      },
-    ];
-  }
+  /* Volumes: the panels own the whole story (new-vs-existing, size,
+   * class, access mode, mount). A "none" workspace explicitly suppresses
+   * the config default; data volumes are included only when present. */
+  payload.workspaceVolume = workspacePanel ? workspacePanel.value() : null;
+  const dataVols = dataVolumesList ? dataVolumesList.value() : [];
+  if (dataVols.length) payload.dataVolumes = dataVols;
   payload.shm = !!form.get("shm");
   const configurations = [
     ...ev.target.querySelectorAll('input[name="configuration"]:checked'),
@@ -639,9 +663,17 @@ document.getElementById("ns-slot").append(
   namespacePicker(() => {
     tablePoller.refresh();
     loadNamespaceCatalogs().catch(() => {});
-  })
+  }),
+  " ",
+  KF.localePicker()
 );
+/* Locale switch re-renders the live table (headers, status labels,
+ * action buttons) in place. */
+KF.onLocaleChange(() => {
+  refresh().catch(() => {});
+});
 loadCatalogs().catch(showError);
+loadStorageCatalogs().catch(() => {});
 loadNamespaceCatalogs().catch(() => {});
 tablePoller = poll(refresh);
 openDetailsFromHash();

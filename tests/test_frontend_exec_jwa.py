@@ -370,3 +370,147 @@ def test_multislice_spawn_from_form(jwa):
     assert jwa.kube_get("StatefulSet", "multi-s0", "team") is not None
     assert jwa.kube_get("StatefulSet", "multi-s1", "team") is not None
     assert "4/4 hosts" in table
+
+
+def test_locale_switch_rerenders_table_headers(jwa):
+    """i18n pipe end to end: picker → KF.setLocale → subscriber re-render.
+    The live table's headers, empty-state text and (after a create) status
+    labels follow the locale."""
+    b = jwa.browser
+    assert "Last activity" in table_text(jwa)
+    assert "No notebook servers in this namespace." in table_text(jwa)
+
+    picker = b.query("select.kf-locale-picker")
+    assert picker is not None, "locale picker not rendered"
+    b.change("select.kf-locale-picker", "de")
+    jwa.poll_ui()
+    assert "Letzte Aktivität" in table_text(jwa)
+    assert "Keine Notebook-Server in diesem Namespace." in table_text(jwa)
+    assert "Last activity" not in table_text(jwa)
+    # Persisted: the next page load starts in German.
+    assert b.local_storage.get("kf.locale") == "de"
+
+    # Status labels and action buttons localize on live rows too.
+    b.click("#new-btn")
+    b.set_value('#new-form input[name="name"]', "lokal")
+    b.set_value('#new-form input[name="cpu"]', "1")
+    b.set_value('#new-form input[name="memory"]', "2Gi")
+    b.submit("#new-form")
+    jwa.poll_ui()
+    assert "lokal" in table_text(jwa)
+    assert "Läuft" in table_text(jwa)      # status.ready
+    assert "Stoppen" in table_text(jwa)    # action.stop
+
+    b.change("select.kf-locale-picker", "en")
+    jwa.poll_ui()
+    assert "Running" in table_text(jwa)
+
+
+def test_locale_persists_across_page_load(jwa):
+    b = jwa.browser
+    b.change("select.kf-locale-picker", "de")
+    b.load("/")  # fresh page: catalogs re-register, locale restored
+    jwa.poll_ui()
+    assert "Letzte Aktivität" in table_text(jwa)
+
+
+def test_kf_t_fallback_and_params(jwa):
+    """KF.t resolves locale → fallback → key, and interpolates params."""
+    b = jwa.browser
+    assert b.eval('KF.t("table.memory")') == "Memory"
+    b.eval('KF.setLocale("de")')
+    assert b.eval('KF.t("table.memory")') == "Speicher"
+    # Key missing from de falls back to en; missing everywhere → the key.
+    b.eval('KF.registerMessages("en", {"only.english": "English only"})')
+    assert b.eval('KF.t("only.english")') == "English only"
+    assert b.eval('KF.t("no.such.key")') == "no.such.key"
+    assert (
+        b.eval('KF.t("only.english", {x: 1})') == "English only"
+    )
+    b.eval('KF.registerMessages("de", {"greet": "Hallo {name}, {n} Slices"})')
+    assert b.eval('KF.t("greet", {name: "Ada", n: 4})') == "Hallo Ada, 4 Slices"
+
+
+def test_create_with_custom_volumes_e2e(jwa):
+    """VERDICT r3 #6: per-volume new-vs-existing, size, storage-class and
+    access-mode editing, driven through the executed frontend into real
+    admission — the created PVCs carry the chosen class and modes."""
+    b = jwa.browser
+    # Cluster catalogs: two storage classes, one default; one existing PVC.
+    jwa.kube_create("StorageClass", {
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": {"name": "standard", "annotations": {
+            "storageclass.kubernetes.io/is-default-class": "true"}}})
+    jwa.kube_create("StorageClass", {
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": {"name": "fast-ssd"}})
+    jwa.kube_create("PersistentVolumeClaim", {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "datasets", "namespace": "team"},
+        "spec": {"resources": {"requests": {"storage": "100Gi"}}}})
+    b.load("/")  # re-load so the pickers see the catalogs
+
+    b.click("#new-btn")
+    b.set_value('#new-form input[name="name"]', "volly")
+    b.set_value('#new-form input[name="cpu"]', "1")
+    b.set_value('#new-form input[name="memory"]', "2Gi")
+
+    # Workspace: new volume, custom size/class/mode.
+    ws = b.query("#workspace-volume-slot")
+    assert ws is not None
+    b.set_value("#workspace-volume-slot .kf-volume-size", "20")
+    b.change("#workspace-volume-slot .kf-volume-class", "fast-ssd")
+    b.change("#workspace-volume-slot .kf-volume-access", "ReadWriteMany")
+
+    # Data volume 1: brand new; data volume 2: attach the existing PVC.
+    b.click("#data-volumes-slot button")          # "+ Add new volume"
+    b.set_value("#data-volumes-slot .kf-volume-size", "50")
+    buttons = b.query_all("#data-volumes-slot button")
+    # last button row: [delete(vol1), add-new, attach-existing]
+    b.click(buttons[-1])                          # "+ Attach existing"
+    # Only the second (existing-mode) panel renders a PVC select, so the
+    # flat selector is unambiguous.
+    b.change("#data-volumes-slot select.kf-volume-existing", "datasets")
+    assert b.submit("#new-form") is False
+
+    nb = jwa.kube_get("Notebook", "volly", "team")
+    assert nb is not None
+    pod_spec = nb["spec"]["template"]["spec"]
+    mounts = {m["mountPath"]
+              for c in pod_spec["containers"] for m in c["volumeMounts"]}
+    assert "/home/jovyan" in mounts
+    assert "/home/jovyan/data-1" in mounts
+    assert "/home/jovyan/data-2" in mounts
+
+    ws_pvc = jwa.kube_get("PersistentVolumeClaim", "volly-workspace", "team")
+    assert ws_pvc is not None
+    assert ws_pvc["spec"]["storageClassName"] == "fast-ssd"
+    assert ws_pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+    assert ws_pvc["spec"]["resources"]["requests"]["storage"] == "20Gi"
+
+    dv_pvc = jwa.kube_get("PersistentVolumeClaim", "volly-datavol-1", "team")
+    assert dv_pvc is not None
+    assert dv_pvc["spec"]["resources"]["requests"]["storage"] == "50Gi"
+    # No explicit class → cluster default applies server-side (unset here).
+    assert "storageClassName" not in dv_pvc["spec"]
+
+    # The existing PVC is referenced, not re-created.
+    vols = {v.get("persistentVolumeClaim", {}).get("claimName")
+            for v in pod_spec["volumes"] if "persistentVolumeClaim" in v}
+    assert "datasets" in vols
+
+
+def test_workspace_none_suppresses_default(jwa):
+    b = jwa.browser
+    b.click("#new-btn")
+    b.set_value('#new-form input[name="name"]', "bare")
+    b.set_value('#new-form input[name="cpu"]', "1")
+    b.set_value('#new-form input[name="memory"]', "2Gi")
+    b.change("#workspace-volume-slot .kf-volume-mode", "none")
+    b.submit("#new-form")
+    nb = jwa.kube_get("Notebook", "bare", "team")
+    assert nb is not None
+    vols = nb["spec"]["template"]["spec"].get("volumes") or []
+    assert not any("persistentVolumeClaim" in v for v in vols)
+    assert jwa.kube_get("PersistentVolumeClaim", "bare-workspace",
+                        "team") is None
