@@ -14,6 +14,13 @@ Design notes, TPU-first:
 - RMSNorm over channels instead of batchnorm: no cross-batch state, so
   the model is data-parallel with zero extra collectives beyond the grad
   psum GSPMD inserts.
+- Space-to-depth stem (the MLPerf ResNet TPU trick): a 3-channel conv is
+  pathological on a 128-lane MXU — profiled on the chip, the stem's
+  weight-gradient fusion alone cost 0.7 ms/step (~2% MXU efficiency) at
+  batch 256. Folding 2×2 pixel blocks into channels first (3→12) quarters
+  the stem's positions, 4×s its contraction depth, and leaves every
+  downstream stage's spatial schedule unchanged (stage 1's downsample
+  becomes stride 1 because the stem already runs at half resolution).
 
 Reference parity: the reference ships no models (SURVEY.md); families here
 validate slices (burnin=dp+tp matmuls, longctx=sp attention, moe=ep
@@ -51,7 +58,8 @@ def init_params(rng: jax.Array, cfg: VisionConfig) -> dict:
     # stem + head + one downsample per stage + two convs per block
     keys = iter(jax.random.split(rng, 2 + len(cfg.widths) + 2 * n_blocks))
     params: dict = {
-        "stem": _conv_init(next(keys), 3, 3, cfg.channels, cfg.widths[0]),
+        # 4·channels: the stem consumes the 2×2 space-to-depth folding.
+        "stem": _conv_init(next(keys), 3, 3, 4 * cfg.channels, cfg.widths[0]),
         "stages": [],
         "head_norm": jnp.ones((cfg.widths[-1],), jnp.float32),
         "head": jax.random.normal(
@@ -82,12 +90,26 @@ def _conv(x, w, stride: int = 1):
     )
 
 
+def _space_to_depth(x, r: int = 2):
+    """[B, H, W, C] → [B, H/r, W/r, r²·C]: fold pixel blocks into lanes."""
+    b, h, w, c = x.shape
+    if h % r or w % r:
+        raise ValueError(
+            f"space-to-depth stem needs H and W divisible by {r}; "
+            f"got {h}x{w} — pad or resize the input (or use an even "
+            f"image_size)")
+    x = x.reshape(b, h // r, r, w // r, r, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // r, w // r, r * r * c)
+
+
 def forward(params: dict, images: jax.Array, cfg: VisionConfig) -> jax.Array:
     """[batch, H, W, C] images → [batch, num_classes] logits (f32)."""
     dtype = jnp.dtype(cfg.dtype)
-    x = _conv(images.astype(dtype), params["stem"])
-    for stage in params["stages"]:
-        x = _conv(jax.nn.relu(x), stage["down"], stride=2)
+    x = _conv(_space_to_depth(images.astype(dtype)), params["stem"])
+    for i, stage in enumerate(params["stages"]):
+        # The stem already halved the resolution; stage 0 keeps it.
+        x = _conv(jax.nn.relu(x), stage["down"], stride=1 if i == 0 else 2)
         for block in stage["blocks"]:
             h = _conv(jax.nn.relu(_rmsnorm(x, block["norm1"])), block["conv1"])
             h = _conv(jax.nn.relu(_rmsnorm(h, block["norm2"])), block["conv2"])

@@ -52,3 +52,19 @@ def test_data_parallel_matches_single_device():
     _, loss_dp = step(params_repl, sharded)
     np.testing.assert_allclose(float(loss_dp), float(loss_single),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_odd_image_size_fails_loudly():
+    """The space-to-depth stem requires even H/W; the error must be
+    actionable, not an opaque reshape failure inside jit tracing."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from kubeflow_tpu.models import vision
+
+    cfg = vision.VisionConfig(image_size=15)
+    params = vision.init_params(jax.random.key(0), cfg)
+    images = jnp.zeros((2, 15, 15, 3), jnp.bfloat16)
+    with pytest.raises(ValueError, match="divisible"):
+        vision.forward(params, images, cfg)
