@@ -147,7 +147,14 @@ function makeElement(tagName, doc) {
       if (v) el.attrs.disabled = "";
       else delete el.attrs.disabled;
     },
-    focus() {},
+    focus() {
+      const doc = el.ownerDocument;
+      if (doc) doc._activeElement = el;
+    },
+    blur() {
+      const doc = el.ownerDocument;
+      if (doc && doc._activeElement === el) doc._activeElement = null;
+    },
     getContext() {
       // canvas stub (sparkline): every drawing call is a no-op.
       const noop = () => undefined;
@@ -375,6 +382,11 @@ function makeDocument() {
     });
     return found;
   };
+  Object.defineProperty(doc, "activeElement", {
+    get() {
+      return doc._activeElement || doc.body;
+    },
+  });
   Object.defineProperty(doc, "cookie", {
     get() {
       return Object.entries(doc._cookies)

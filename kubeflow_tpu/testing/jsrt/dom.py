@@ -475,8 +475,17 @@ class Element(DomNode):
         if name == "matches":
             return _method(name, lambda this, args: matches(
                 self, to_js_string(args[0], interp)))
-        if name == "focus" or name == "blur":
-            return _method(name, lambda this, args: undefined)
+        if name == "focus":
+            def do_focus(this, args):
+                doc._active_element = self
+                return undefined
+            return _method(name, do_focus)
+        if name == "blur":
+            def do_blur(this, args):
+                if getattr(doc, "_active_element", None) is self:
+                    doc._active_element = None
+                return undefined
+            return _method(name, do_blur)
         if name == "click":
             def click(this, args):
                 return activate(doc, self)
@@ -549,6 +558,11 @@ class Document(Element):
             return self.body
         if name == "head":
             return self.head
+        if name == "activeElement":
+            # Tracked by Element.focus()/blur(); components use it for
+            # modal focus restore (confirmDialog/drawer opener capture).
+            active = getattr(self, "_active_element", None)
+            return active if active is not None else self.body
         if name == "cookie":
             return self.browser.cookie_string()
         if name == "createElement":

@@ -88,6 +88,7 @@ KF.onLocaleChange = function (fn) {
 KF.localePicker = function () {
   const select = document.createElement("select");
   select.className = "kf-locale-picker";
+  select.setAttribute("aria-label", "language");
   select.style.width = "auto";
   for (const loc of KF.i18n.available()) {
     const opt = document.createElement("option");
@@ -249,7 +250,7 @@ KF.statusDot = function (phase, message) {
   return KF.el(
     "span",
     { class: "status", title: message || "" },
-    KF.el("span", { class: "dot " + phase }),
+    KF.el("span", { class: "dot " + phase, "aria-hidden": "true" }),
     label
   );
 };
@@ -306,32 +307,63 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
   const head = KF.el(
     "tr",
     {},
-    columns.map((c, idx) =>
-      KF.el(
+    columns.map((c, idx) => {
+      /* title may be a thunk (e.g. () => KF.t(...)) so headers follow
+       * the active locale on every render. */
+      const label = typeof c.title === "function" ? c.title() : c.title;
+      if (!c.sortKey) return KF.el("th", { scope: "col" }, label);
+      /* a11y: the WAI-ARIA sortable-table pattern — the <th> KEEPS its
+       * columnheader semantics (scope=col, aria-sort lives here; it is
+       * only valid on column/row headers) and the interactive part is a
+       * real <button> nested inside. After the sort re-render, focus is
+       * restored onto the same column's button, so keyboard users can
+       * toggle direction without re-tabbing through the page. */
+      const sort = () => {
+        state.dir = state.idx === idx ? -state.dir : 1;
+        state.idx = idx;
+        state.refocus = idx;
+        KF.renderTable(container, columns, rows, opts);
+      };
+      return KF.el(
         "th",
-        c.sortKey
-          ? {
-              class: "sortable" + (state.idx === idx ? " sorted" : ""),
-              onclick: () => {
-                state.dir = state.idx === idx ? -state.dir : 1;
-                state.idx = idx;
-                KF.renderTable(container, columns, rows, opts);
-              },
-            }
-          : {},
-        /* title may be a thunk (e.g. () => KF.t(...)) so headers follow
-         * the active locale on every render. */
-        typeof c.title === "function" ? c.title() : c.title,
-        state.idx === idx ? (state.dir > 0 ? " ▲" : " ▼") : ""
-      )
-    )
+        {
+          scope: "col",
+          class: "sortable" + (state.idx === idx ? " sorted" : ""),
+          "aria-sort":
+            state.idx !== idx
+              ? "none"
+              : state.dir > 0
+                ? "ascending"
+                : "descending",
+        },
+        KF.el(
+          "button",
+          { class: "kf-sort-btn", onclick: sort },
+          label,
+          state.idx === idx ? (state.dir > 0 ? " ▲" : " ▼") : ""
+        )
+      );
+    })
   );
   const body = sorted.length
     ? sorted.map((row) =>
         KF.el(
           "tr",
           opts.onRowClick
-            ? { class: "clickable", onclick: () => opts.onRowClick(row) }
+            ? {
+                class: "clickable",
+                tabindex: "0",
+                onclick: () => opts.onRowClick(row),
+                onkeydown: (ev) => {
+                  /* Only when the ROW itself is focused: Enter on a
+                   * nested action button bubbles here too, and firing
+                   * the row would stack the drawer on the button's own
+                   * dialog. */
+                  const within = ev.target && ev.target.closest &&
+                    ev.target.closest("button, a, input, select, textarea");
+                  if (ev.key === "Enter" && !within) opts.onRowClick(row);
+                },
+              }
             : {},
           columns.map((c) => KF.el("td", {}, c.render(row)))
         )
@@ -350,6 +382,14 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
   container.replaceChildren(
     KF.el("table", {}, KF.el("thead", {}, head), KF.el("tbody", {}, body))
   );
+  if (state.refocus !== undefined) {
+    const idx = state.refocus;
+    delete state.refocus;
+    const buttons = container.querySelectorAll("th .kf-sort-btn");
+    // nth sortable column: count sortable columns up to idx
+    const at = columns.slice(0, idx).filter((c) => c.sortKey).length;
+    if (buttons[at]) buttons[at].focus();
+  }
 };
 
 /* Action buttons that stop row-click propagation (so a Delete click never
@@ -506,33 +546,58 @@ KF.logsViewer = function (container, pods, fetchLogs) {
 
 /* ---------------- confirm dialog (lib/confirm-dialog) ------------------- */
 
-KF.confirmDialog = function ({ title, message, confirmText = "Delete" }) {
+KF._dialogIds = 0;
+
+/* Modal layering: every modal (dialogs, the drawer) registers here, and
+ * only the TOPMOST layer reacts to Escape — a confirm dialog opened from
+ * drawer content must not take the drawer down with it. */
+KF._modalStack = [];
+KF._isTopModal = function (token) {
+  return KF._modalStack[KF._modalStack.length - 1] === token;
+};
+KF._popModal = function (token) {
+  const at = KF._modalStack.indexOf(token);
+  if (at >= 0) KF._modalStack.splice(at, 1);
+};
+
+KF.confirmDialog = function ({ title, message, confirmText }) {
   return new Promise((resolve) => {
     const overlay = KF.el("div", { class: "kf-overlay" });
+    const titleId = "kf-dialog-title-" + ++KF._dialogIds;
+    const token = {};
+    /* a11y: restore focus to the opener when the dialog closes (WAI-ARIA
+     * dialog pattern) — keyboard users otherwise land back at <body>. */
+    const opener = document.activeElement || null;
     function close(result) {
       overlay.remove();
       document.removeEventListener("keydown", onKey);
+      KF._popModal(token);
+      if (opener && opener.focus) opener.focus();
       resolve(result);
     }
     function onKey(ev) {
-      if (ev.key === "Escape") close(false);
+      if (ev.key === "Escape" && KF._isTopModal(token)) close(false);
     }
     document.addEventListener("keydown", onKey);
+    KF._modalStack.push(token);
+    const confirmBtn = KF.el(
+      "button",
+      { class: "danger", onclick: () => close(true) },
+      confirmText || KF.t("action.delete")
+    );
     overlay.append(
       KF.el(
         "div",
-        { class: "kf-dialog", role: "dialog", "aria-modal": "true" },
-        KF.el("h3", {}, title),
+        { class: "kf-dialog", role: "dialog", "aria-modal": "true",
+          "aria-labelledby": titleId },
+        KF.el("h3", { id: titleId }, title),
         KF.el("p", {}, message),
         KF.el(
           "div",
           { class: "kf-dialog-actions" },
-          KF.el("button", { onclick: () => close(false) }, "Cancel"),
-          KF.el(
-            "button",
-            { class: "danger", onclick: () => close(true) },
-            confirmText
-          )
+          KF.el("button", { onclick: () => close(false) },
+                KF.t("common.cancel")),
+          confirmBtn
         )
       )
     );
@@ -540,6 +605,7 @@ KF.confirmDialog = function ({ title, message, confirmText = "Delete" }) {
       if (ev.target === overlay) close(false);
     });
     document.body.append(overlay);
+    confirmBtn.focus();
   });
 };
 
@@ -669,15 +735,20 @@ KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSub
     });
     const editor = KF.codeEditor(initial, { textareaClass: "kf-yaml-editor" });
     const textarea = editor.textarea;
+    const titleId = "kf-dialog-title-" + ++KF._dialogIds;
+    const token = {};
+    const opener = document.activeElement || null;
     let pending = false;
     function close(result) {
       if (pending) return; // no cancel while the submit is in flight
       overlay.remove();
       document.removeEventListener("keydown", onKey);
+      KF._popModal(token);
+      if (opener && opener.focus) opener.focus();
       resolve(result);
     }
     function onKey(ev) {
-      if (ev.key === "Escape") close(false);
+      if (ev.key === "Escape" && KF._isTopModal(token)) close(false);
     }
     async function submit() {
       if (pending) return; // double-click guard while onSubmit is in flight
@@ -696,20 +767,23 @@ KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSub
       }
     }
     document.addEventListener("keydown", onKey);
+    KF._modalStack.push(token);
     const submitBtn = KF.el(
       "button", { class: "primary", onclick: submit }, submitText
     );
     overlay.append(
       KF.el(
         "div",
-        { class: "kf-dialog kf-dialog-wide", role: "dialog", "aria-modal": "true" },
-        KF.el("h3", {}, title),
+        { class: "kf-dialog kf-dialog-wide", role: "dialog",
+          "aria-modal": "true", "aria-labelledby": titleId },
+        KF.el("h3", { id: titleId }, title),
         editor.root,
         errorBox,
         KF.el(
           "div",
           { class: "kf-dialog-actions" },
-          KF.el("button", { onclick: () => close(false) }, "Cancel"),
+          KF.el("button", { onclick: () => close(false) },
+                KF.t("common.cancel")),
           submitBtn
         )
       )
@@ -730,7 +804,16 @@ KF.snackbar = function (message, kind = "info") {
     host = KF.el("div", { id: "kf-snackbar-host" });
     document.body.append(host);
   }
-  const bar = KF.el("div", { class: "kf-snackbar " + kind }, message);
+  /* a11y: polite live region for info, assertive alert for errors —
+   * screen readers announce the toast without focus moving. */
+  const bar = KF.el(
+    "div",
+    kind === "error"
+      ? { class: "kf-snackbar " + kind, role: "alert" }
+      : { class: "kf-snackbar " + kind, role: "status",
+          "aria-live": "polite" },
+    message
+  );
   host.append(bar);
   setTimeout(() => bar.classList.add("visible"), 10);
   setTimeout(() => {
@@ -820,6 +903,9 @@ KF.validate = function (input, validator) {
     const err = validator(input.value);
     input.classList.toggle("invalid", !!err);
     input.title = err || "";
+    /* a11y: announce validity to assistive tech, not only via color. */
+    if (err) input.setAttribute("aria-invalid", "true");
+    else input.removeAttribute("aria-invalid");
     return !err;
   }
   input.addEventListener("input", check);
@@ -828,21 +914,44 @@ KF.validate = function (input, validator) {
 
 /* ---------------- tabs ------------------------------------------------- */
 
-/* tabs: [{label, render(pane) (may return cleanup.stop)}] */
+/* tabs: [{label, render(pane) (may return cleanup.stop)}]
+ * a11y: the WAI-ARIA tabs pattern — tablist/tab/tabpanel roles,
+ * aria-selected state, Arrow-key roving between tabs. */
 KF.tabs = function (container, tabs) {
-  const bar = KF.el("div", { class: "kf-tabs" });
-  const pane = KF.el("div", { class: "kf-tab-pane" });
+  const bar = KF.el("div", { class: "kf-tabs", role: "tablist" });
+  const pane = KF.el("div", { class: "kf-tab-pane", role: "tabpanel" });
   let cleanup = null;
   function select(idx) {
     if (cleanup && cleanup.stop) cleanup.stop();
     cleanup = null;
-    [...bar.children].forEach((b, i) => b.classList.toggle("active", i === idx));
+    [...bar.children].forEach((b, i) => {
+      b.classList.toggle("active", i === idx);
+      b.setAttribute("aria-selected", i === idx ? "true" : "false");
+      b.setAttribute("tabindex", i === idx ? "0" : "-1");
+    });
     pane.replaceChildren();
     cleanup = tabs[idx].render(pane) || null;
   }
   tabs.forEach((tab, idx) =>
     bar.append(
-      KF.el("button", { class: "kf-tab", onclick: () => select(idx) }, tab.label)
+      KF.el(
+        "button",
+        {
+          class: "kf-tab",
+          role: "tab",
+          onclick: () => select(idx),
+          onkeydown: (ev) => {
+            const delta = ev.key === "ArrowRight" ? 1
+              : ev.key === "ArrowLeft" ? -1 : 0;
+            if (!delta) return;
+            ev.preventDefault();
+            const next = (idx + delta + tabs.length) % tabs.length;
+            select(next);
+            bar.children[next].focus();
+          },
+        },
+        tab.label
+      )
     )
   );
   container.replaceChildren(bar, pane);
@@ -903,21 +1012,31 @@ KF.drawer = function (title) {
   const content = KF.el("div", { class: "kf-drawer-content" });
   let onClose = null;
   const overlay = KF.el("div", { class: "kf-overlay kf-drawer-overlay" });
+  /* a11y: full modal-dialog focus management — focus moves INTO the
+   * drawer on open (aria-modal declares the page behind it inert, so
+   * leaving focus on the opening row would strand assistive tech) and
+   * returns to the opener on close. */
+  const opener = document.activeElement || null;
+  function onDrawerKey(ev) {
+    if (ev.key === "Escape") close();
+  }
   function close() {
+    document.removeEventListener("keydown", onDrawerKey);
     overlay.remove();
+    if (opener && opener.focus) opener.focus();
     if (onClose) onClose();
   }
+  document.addEventListener("keydown", onDrawerKey);
+  const closeBtn = KF.el(
+    "button", { onclick: close, "aria-label": "close" }, "✕");
   const panel = KF.el(
     "div",
-    { class: "kf-drawer" },
+    { class: "kf-drawer", role: "dialog", "aria-modal": "true",
+      "aria-label": String(title) },
     KF.el(
       "div",
       { class: "kf-drawer-head" },
-      KF.titleActionsToolbar({
-        title,
-        actions: [KF.el("button", { onclick: close, "aria-label": "close" },
-                        "✕")],
-      })
+      KF.titleActionsToolbar({ title, actions: [closeBtn] })
     ),
     content
   );
@@ -926,6 +1045,7 @@ KF.drawer = function (title) {
   });
   overlay.append(panel);
   document.body.append(overlay);
+  closeBtn.focus();
   return {
     content,
     close,
@@ -982,7 +1102,7 @@ KF.sliceRollup = function (container, tpu, tpuStatus, pods, opts = {}) {
       return KF.el(
         "div",
         { class: "slice-worker " + phase, title: pod ? pod.name : "no pod" },
-        KF.el("span", { class: "dot " + phase }),
+        KF.el("span", { class: "dot " + phase, "aria-hidden": "true" }),
         "worker-" + i
       );
     })

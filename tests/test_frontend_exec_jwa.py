@@ -514,3 +514,90 @@ def test_workspace_none_suppresses_default(jwa):
     assert not any("persistentVolumeClaim" in v for v in vols)
     assert jwa.kube_get("PersistentVolumeClaim", "bare-workspace",
                         "team") is None
+
+
+def test_a11y_table_and_tabs_semantics(jwa):
+    """WAI-ARIA semantics on the shared components (reference gets these
+    from Angular Material): sortable headers are keyboard buttons with
+    aria-sort, rows are focusable, tabs carry the tabs pattern, and the
+    details drawer is a labeled modal dialog that Escape closes."""
+    b = jwa.browser
+    from kubeflow_tpu.api import notebook as nbapi
+
+    jwa.kube_create("Notebook", nbapi.new("a11y-nb", "team",
+                                          accelerator="v5e", topology="2x2"))
+    jwa.poll_ui()
+
+    # Sortable header: the <th> KEEPS columnheader semantics (scope=col,
+    # aria-sort on it) and the interactive part is a nested real button.
+    header = next(th for th in b.query_all("#notebook-table th")
+                  if "sortable" in th.attrs.get("class", ""))
+    assert header.attrs.get("scope") == "col"
+    assert header.attrs.get("aria-sort") == "none"
+    assert b.query("#notebook-table th .kf-sort-btn") is not None
+    b.click("#notebook-table th .kf-sort-btn")
+    header = next(th for th in b.query_all("#notebook-table th")
+                  if "sortable" in th.attrs.get("class", ""))
+    assert header.attrs.get("aria-sort") == "ascending"
+    # Focus survives the sort re-render (restored onto the same column's
+    # button) so direction can be toggled without re-tabbing.
+    active = b.eval("document.activeElement && document.activeElement.className")
+    assert active == "kf-sort-btn"
+
+    # Clickable rows are reachable by keyboard.
+    row = b.query("#notebook-table tr.clickable")
+    assert row is not None and row.attrs.get("tabindex") == "0"
+
+    # Open the drawer: modal dialog + tabs pattern.
+    b.click("#notebook-table tr.clickable")
+    drawer = b.query(".kf-drawer")
+    assert drawer is not None
+    assert drawer.attrs.get("role") == "dialog"
+    assert drawer.attrs.get("aria-modal") == "true"
+    assert "a11y-nb" in drawer.attrs.get("aria-label", "")
+    bar = b.query(".kf-tabs")
+    assert bar.attrs.get("role") == "tablist"
+    tabs = b.query_all(".kf-tabs .kf-tab")
+    assert all(t.attrs.get("role") == "tab" for t in tabs)
+    assert tabs[0].attrs.get("aria-selected") == "true"
+    assert tabs[1].attrs.get("aria-selected") == "false"
+    # Opening the drawer moved focus INTO it (aria-modal inerts the rest).
+    active_label = b.eval(
+        'document.activeElement && document.activeElement.getAttribute'
+        '("aria-label")')
+    assert active_label == "close"
+    # Arrow-key roving moves the selection.
+    b.keydown("ArrowRight", ".kf-tabs .kf-tab")
+    tabs = b.query_all(".kf-tabs .kf-tab")
+    assert tabs[1].attrs.get("aria-selected") == "true"
+    # Escape closes the drawer.
+    b.keydown("Escape")
+    assert b.query(".kf-drawer") is None
+
+
+def test_a11y_dialog_validation_and_snackbar(jwa):
+    b = jwa.browser
+    # Invalid field announces via aria-invalid, not only CSS.
+    b.click("#new-btn")
+    b.set_value('#new-form input[name="name"]', "Bad_Name!")
+    b.submit("#new-form")
+    name_input = b.query('#new-form input[name="name"]')
+    assert name_input.attrs.get("aria-invalid") == "true"
+    b.set_value('#new-form input[name="name"]', "good-name")
+    assert name_input.attrs.get("aria-invalid") is None
+
+    # Snackbar is a polite live region (errors are role=alert).
+    b.eval('KF.snackbar("saved", "info"); KF.snackbar("boom", "error")')
+    bars = b.query_all("#kf-snackbar-host .kf-snackbar")
+    roles = {bar.attrs.get("role") for bar in bars}
+    assert roles == {"status", "alert"}
+
+    # Confirm dialog: labeled, Cancel localized, Escape cancels.
+    b.eval('window.__dlg = KF.confirmDialog({title: "Delete it?", '
+           'message: "gone forever"})')
+    dlg = b.query(".kf-dialog")
+    assert dlg.attrs.get("aria-modal") == "true"
+    title_id = dlg.attrs.get("aria-labelledby")
+    assert title_id and b.query("#" + title_id).text_content() == "Delete it?"
+    b.keydown("Escape")
+    assert b.query(".kf-dialog") is None
