@@ -58,9 +58,23 @@ def pipeline_spans(n_layers: int, n_stages: int) -> list[tuple[int, int]]:
     return [(i * per, (i + 1) * per) for i in range(n_stages)]
 
 
+# Full-unroll ceiling for the schedule scan. Unrolling removes the scan's
+# per-tick dispatch AND lets XLA schedule across tick boundaries (on one
+# chip the injected microbatches are independent once ``idx == 0`` folds,
+# so their GEMMs interleave; on real multi-stage meshes the ppermute chain
+# keeps ticks ordered but XLA still overlaps the hop with the next tick's
+# compute). Measured on a v5e chip (bench family ``pipelined_schedule``,
+# n_micro=4, n_stages=1): scan 0.30 MFU, unroll=2 0.26 (worse — the partial
+# unroll keeps the scan AND doubles its body), full unroll 0.42. Hence
+# full-or-nothing: unroll completely when the tick count is small, keep the
+# scan for long schedules where unrolled code size would bloat compiles.
+UNROLL_MAX_TICKS = 16
+
+
 def pipeline_apply(stage_fn, stage_params, x_micro, *, n_stages: int,
                    axis_name: str = "stage", mesh_axes=None,
-                   force_schedule: bool = False):
+                   force_schedule: bool = False,
+                   unroll: int | bool | None = None):
     """Run microbatches through the stage ring. Call inside ``shard_map``.
 
     Args:
@@ -82,12 +96,17 @@ def pipeline_apply(stage_fn, stage_params, x_micro, *, n_stages: int,
         ``n_stages == 1`` (normally routed around — see below). The bench
         uses this so the schedule machinery's overhead is a *tracked*
         number on hardware rather than only compiled in multi-stage gates.
+      unroll: scan unroll override. ``None`` (default) fully unrolls
+        schedules of ≤ ``UNROLL_MAX_TICKS`` ticks and keeps the scan above
+        that (see the constant's rationale).
 
     Returns ``[n_micro, mb, ...]`` outputs — valid on the LAST stage only;
-    other stages hold zeros/garbage (reduce with a ``where(idx==last)`` +
-    ``psum`` as models/pipelined.py does for the loss).
+    other stages hold that stage's local compute on drain-bubble garbage
+    (reduce with a ``where(idx==last)`` + ``psum`` as models/pipelined.py
+    does for the loss).
     """
     n_micro = x_micro.shape[0]
+    vary = tuple(mesh_axes) if mesh_axes else (axis_name,)
     if n_stages == 1 and not force_schedule:
         # Degenerate single-stage pipeline: no bubble, no ppermute, no
         # schedule scan — and the microbatches fuse back into one batch so
@@ -98,38 +117,41 @@ def pipeline_apply(stage_fn, stage_params, x_micro, *, n_stages: int,
         # layer scan inside stage_fn mixes in stage-varying params, and a
         # {data}-only carry type would mismatch its output (same rule as
         # the general path's state/outputs).
-        vary = tuple(mesh_axes) if mesh_axes else (axis_name,)
         flat = _mark_varying(
             x_micro.reshape((-1,) + tuple(x_micro.shape[2:])), vary)
         return stage_fn(stage_params, flat).reshape(x_micro.shape)
     idx = jax.lax.axis_index(axis_name)
     last = n_stages - 1
     perm = stage_ring_perm(n_stages)
+    n_ticks = n_micro + n_stages - 1
+    if unroll is None:
+        unroll = n_ticks if n_ticks <= UNROLL_MAX_TICKS else 1
 
-    state = jnp.zeros(x_micro.shape[1:], x_micro.dtype)
-    outputs = jnp.zeros_like(x_micro)
-    vary = tuple(mesh_axes) if mesh_axes else (axis_name,)
-    state, outputs = (_mark_varying(t, vary) for t in (state, outputs))
+    # The injection stream rides the scan's ``xs`` — a static per-tick
+    # slice instead of the dynamic ``x_micro[min(t, n_micro-1)]`` gather
+    # (whose transpose was a scatter-add over the whole buffer every
+    # backward tick). Drain-bubble ticks re-inject the last microbatch;
+    # whatever they compute never reaches a valid output slot.
+    if n_stages > 1:
+        pad = jnp.broadcast_to(
+            x_micro[-1:], (n_stages - 1,) + x_micro.shape[1:])
+        xs = jnp.concatenate([x_micro, pad], axis=0)
+    else:
+        xs = x_micro
 
-    def tick(carry, t):
-        state, outputs = carry
-        # Stage 0 injects microbatch t (clamped during the drain bubble —
-        # those ticks' outputs never reach a valid write slot below).
-        inject = x_micro[jnp.minimum(t, n_micro - 1)]
+    state = _mark_varying(jnp.zeros(x_micro.shape[1:], x_micro.dtype), vary)
+
+    def tick(state, inject):
         h = jnp.where(idx == 0, inject, state)
         out = stage_fn(stage_params, h)
-        # The last stage has finished microbatch (t - last) at tick t.
-        out_idx = t - last
-        written = jax.lax.dynamic_update_index_in_dim(
-            outputs, out, jnp.clip(out_idx, 0, n_micro - 1), 0
-        )
-        outputs = jnp.where((idx == last) & (out_idx >= 0), written, outputs)
         # Hop AFTER the compute so XLA overlaps the collective-permute with
         # the next tick's stage_fn.
         state = jax.lax.ppermute(out, axis_name, perm)
-        return (state, outputs), None
+        return state, out
 
-    (_, outputs), _ = jax.lax.scan(
-        tick, (state, outputs), jnp.arange(n_micro + n_stages - 1)
-    )
-    return outputs
+    # Per-tick outputs ride ``ys``: the last stage finishes microbatch m at
+    # tick m + last, so its results are one static slice of the stack — no
+    # carried outputs buffer, no per-tick dynamic_update + where masking
+    # (which re-wrote the full buffer every tick, forward and transposed).
+    _, ys = jax.lax.scan(tick, state, xs, unroll=unroll)
+    return jax.lax.slice_in_dim(ys, last, last + n_micro, axis=0)

@@ -149,6 +149,34 @@ def test_single_stage_matches_two_stage():
     assert abs(losses[1] - losses[2]) < 2e-5, losses
 
 
+def test_flash_attention_matches_oracle():
+    """The bench's PP family runs attention="flash" (pallas kernel,
+    interpret mode on CPU); it must match the xla-attention oracle on the
+    same params/tokens in every code path the bench exercises: the
+    n_stages=1 fused bypass (microbatches folded into one batch), the
+    n_stages=1 forced schedule, and a real 2-stage ring."""
+    base = dict(vocab=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+                seq_len=17, n_micro=2, dtype="float32")
+    cfg_x = pipelined.PipelinedConfig(**base)
+    cfg_f = pipelined.PipelinedConfig(**base, attention="flash")
+    params = pipelined.init_params(jax.random.key(6), cfg_x)
+    tokens = jnp.asarray(jax.random.randint(
+        jax.random.key(7), (4, cfg_x.seq_len), 0, cfg_x.vocab))
+    oracle = pipelined.reference_loss(params, tokens, cfg_x)
+    for n_stages, forced in ((1, False), (1, True), (2, False)):
+        mesh = _mesh(1, n_stages)
+        sharded = pipelined.shard_params(params, mesh, cfg_f)
+        _, loss = jax.jit(pipelined.make_train_step(
+            cfg_f, mesh, force_schedule=forced))(sharded, tokens)
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(oracle),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_attention_option_is_validated():
+    with pytest.raises(ValueError, match="attention"):
+        pipelined.PipelinedConfig(attention="Flash")
+
+
 def test_forced_schedule_single_stage_matches_fast_path():
     """force_schedule=True runs the real GPipe tick/scan at n_stages=1
     (the bench's tracked-schedule row); it must compute exactly what the
