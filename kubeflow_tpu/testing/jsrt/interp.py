@@ -95,6 +95,12 @@ class ContinueSignal(Exception):
     pass
 
 
+class _OptShortCircuit(Exception):
+    """Raised by an optional link (?.) on a nullish base; caught by the
+    enclosing optchain wrapper, which yields undefined (spec: one nullish
+    base short-circuits the whole chain, not just that link)."""
+
+
 class JSObject:
     class_name = "Object"
 
@@ -102,14 +108,21 @@ class JSObject:
         self.props: dict = props or {}
         self.getters: dict = {}
         self.setters: dict = {}
+        self.proto = None  # [[Prototype]] — set by Object.create
 
     # Host-overridable hooks. Return NOT_PRESENT to fall through.
     def js_get_prop(self, name: str, interp):
-        if name in self.getters:
-            return interp.call_function(self.getters[name], self, [])
-        if name in self.props:
-            value = self.props[name]
-            return undefined if value is ACCESSOR_SLOT else value
+        # Walk the prototype chain with the ORIGINAL receiver as `this`
+        # for accessor properties (spec OrdinaryGet): shadowing = first
+        # hit wins, own keys stay own (own_keys doesn't walk).
+        obj = self
+        while obj is not None:
+            if name in obj.getters:
+                return interp.call_function(obj.getters[name], self, [])
+            if name in obj.props:
+                value = obj.props[name]
+                return undefined if value is ACCESSOR_SLOT else value
+            obj = getattr(obj, "proto", None)
         return NOT_PRESENT
 
     def js_set_prop(self, name: str, value, interp) -> bool:
@@ -431,7 +444,18 @@ def format_number(n: float) -> str:
         return "-Infinity"
     if n == int(n) and abs(n) < 1e21:
         return str(int(n))
-    return repr(n)
+    r = repr(n)
+    if "e" in r:
+        # JS (Number::toString) prints positionally down to 1e-6 and
+        # writes exponents without zero padding: 0.000001 not 1e-06,
+        # and 1e-7 not 1e-07 below that (Python repr does both).
+        if 0 < abs(n) < 1e-6 or abs(n) >= 1e21:
+            mant, _, exp = r.partition("e")
+            e = int(exp)
+            return f"{mant}e{'+' if e >= 0 else '-'}{abs(e)}"
+        from decimal import Decimal
+        return format(Decimal(r), "f")
+    return r
 
 
 def to_js_string(v, interp=None) -> str:
@@ -980,6 +1004,9 @@ class Interpreter:
             lv = self.eval(l, env, this)
             if sym == "&&":
                 return self.eval(r, env, this) if is_truthy(lv) else lv
+            if sym == "??":
+                return (self.eval(r, env, this)
+                        if lv is null or lv is undefined else lv)
             return lv if is_truthy(lv) else self.eval(r, env, this)
         if op == "binop":
             _, sym, l, r = node
@@ -1034,6 +1061,23 @@ class Interpreter:
             return self.get_index(obj, key)
         if op == "call":
             return self.eval_call(node, env, this)
+        if op == "optchain":
+            try:
+                return self.eval(node[1], env, this)
+            except _OptShortCircuit:
+                return undefined
+        if op == "optmember":
+            obj = self.eval(node[1], env, this)
+            if obj is null or obj is undefined:
+                raise _OptShortCircuit()
+            return self.get_prop(obj, node[2])
+        if op == "optindex":
+            obj = self.eval(node[1], env, this)
+            if obj is null or obj is undefined:
+                raise _OptShortCircuit()
+            return self.get_index(obj, self.eval(node[2], env, this))
+        if op == "optcall":
+            return self.eval_call(node, env, this, optional=True)
         if op == "new":
             _, callee_node, arg_nodes = node
             callee = self.eval(callee_node, env, this)
@@ -1067,20 +1111,26 @@ class Interpreter:
                 args.append(self.eval(a, env, this))
         return args
 
-    def eval_call(self, node, env, this):
+    def eval_call(self, node, env, this, optional=False):
         _, callee_node, arg_nodes = node
-        if callee_node[0] == "member":
+        if callee_node[0] in ("member", "optmember"):
             obj = self.eval(callee_node[1], env, this)
+            if callee_node[0] == "optmember" and (obj is null or obj is undefined):
+                raise _OptShortCircuit()
             fn = self.get_prop(obj, callee_node[2])
             bind_this = obj
-        elif callee_node[0] == "index":
+        elif callee_node[0] in ("index", "optindex"):
             obj = self.eval(callee_node[1], env, this)
+            if callee_node[0] == "optindex" and (obj is null or obj is undefined):
+                raise _OptShortCircuit()
             key = self.eval(callee_node[2], env, this)
             fn = self.get_index(obj, key)
             bind_this = obj
         else:
             fn = self.eval(callee_node, env, this)
             bind_this = undefined
+        if optional and (fn is null or fn is undefined):
+            raise _OptShortCircuit()
         args = self.eval_args(arg_nodes, env, this)
         return self.call_function(fn, bind_this, args)
 
@@ -1126,11 +1176,15 @@ class Interpreter:
 
     def binop(self, sym: str, l, r):
         if sym == "+":
-            if isinstance(l, str) or isinstance(r, str):
-                return to_js_string(l, self) + to_js_string(r, self)
-            if isinstance(l, (JSObject,)) or isinstance(r, (JSObject,)):
-                return to_js_string(l, self) + to_js_string(r, self)
-            return to_number(l) + to_number(r)
+            # ToPrimitive both sides first (spec 13.15.3): a custom
+            # valueOf makes `({valueOf: () => 1}) + 1` numeric 2, while
+            # objects without one still stringify ("[object Object]",
+            # array join) exactly as before.
+            lp = to_primitive(l, self)
+            rp = to_primitive(r, self)
+            if isinstance(lp, str) or isinstance(rp, str):
+                return to_js_string(lp, self) + to_js_string(rp, self)
+            return to_number(lp) + to_number(rp)
         if sym == "-":
             return to_number(l) - to_number(r)
         if sym == "*":
@@ -1157,9 +1211,9 @@ class Interpreter:
         if sym == "!==":
             return not strict_equals(l, r)
         if sym == "==":
-            return loose_equals(l, r)
+            return loose_equals(l, r, self)
         if sym == "!=":
-            return not loose_equals(l, r)
+            return not loose_equals(l, r, self)
         if sym in ("<", ">", "<=", ">="):
             if isinstance(l, str) and isinstance(r, str):
                 if sym == "<":
@@ -1242,27 +1296,52 @@ def strict_equals(l, r) -> bool:
     return l is r
 
 
-def loose_equals(l, r) -> bool:
+def to_primitive(v, interp=None, hint="default"):
+    """ToPrimitive (ES2023 §7.1.1): ``valueOf`` first for the
+    default/number hints, ``toString`` first for the string hint —
+    JS-defined methods run through ``interp`` so a custom
+    ``{valueOf: () => 1}`` coerces the way a real engine does. Falls back
+    to the engine's default stringification when neither method yields a
+    primitive (plain objects → "[object Object]", arrays → join)."""
+    if not isinstance(v, JSObject):
+        return v
+    if interp is not None:
+        order = (("toString", "valueOf") if hint == "string"
+                 else ("valueOf", "toString"))
+        for name in order:
+            m = interp.get_prop(v, name)
+            if isinstance(m, (JSFunction, HostFunction)):
+                res = interp.call_function(m, v, [])
+                if not isinstance(res, JSObject):
+                    return res
+    return to_js_string(v, interp)
+
+
+def loose_equals(l, r, interp=None) -> bool:
     nullish_l = l is undefined or l is null
     nullish_r = r is undefined or r is null
     if nullish_l or nullish_r:
         return nullish_l and nullish_r
     if type(l) is type(r) or (isinstance(l, JSObject) and isinstance(r, JSObject)):
         return strict_equals(l, r)
+    # Booleans coerce to numbers FIRST (spec steps 9-10) — so the
+    # object-vs-primitive retry below sees a number, making
+    # `[] == false` / `[1] == true` come out true as in real engines.
     if isinstance(l, bool):
-        return loose_equals(to_number(l), r)
+        return loose_equals(to_number(l), r, interp)
     if isinstance(r, bool):
-        return loose_equals(l, to_number(r))
+        return loose_equals(l, to_number(r), interp)
     if isinstance(l, float) and isinstance(r, str):
         return l == to_number(r)
     if isinstance(l, str) and isinstance(r, float):
         return to_number(l) == r
     # object vs primitive: ToPrimitive the object, then retry —
-    # `[] == ""` and `[1] == 1` are true in every real engine.
+    # `[] == ""`, `[1] == 1`, and `({valueOf: () => 2}) == 2` are true in
+    # every real engine (custom valueOf/toString run via ``interp``).
     if isinstance(l, JSObject) and isinstance(r, (str, float)):
-        return loose_equals(to_js_string(l), r)
+        return loose_equals(to_primitive(l, interp), r, interp)
     if isinstance(r, JSObject) and isinstance(l, (str, float)):
-        return loose_equals(l, to_js_string(r))
+        return loose_equals(l, to_primitive(r, interp), interp)
     return False
 
 

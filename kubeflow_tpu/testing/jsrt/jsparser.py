@@ -11,15 +11,17 @@ from kubeflow_tpu.testing.jsrt.lexer import tokenize
 
 ASSIGN_OPS = {"=", "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "**="}
 
-# Binary precedence (higher binds tighter).
+# Binary precedence (higher binds tighter). ?? sits at the ||/&& tier
+# (the spec forbids unparenthesized mixing; we accept it, || first).
 BINARY = {
-    "||": 1, "&&": 2,
+    "??": 1, "||": 1, "&&": 2,
     "|": 3, "^": 4, "&": 5,
     "==": 6, "!=": 6, "===": 6, "!==": 6,
     "<": 7, ">": 7, "<=": 7, ">=": 7, "instanceof": 7, "in": 7,
     "<<": 8, ">>": 8,
     "+": 9, "-": 9,
     "*": 10, "/": 10, "%": 10,
+    "**": 11,  # right-associative (handled in binary())
 }
 
 
@@ -422,8 +424,9 @@ class Parser:
             if prec is None or prec < min_prec:
                 return left
             self.next()
-            right = self.binary(prec + 1)
-            left = ("logic" if op in ("&&", "||") else "binop", op, left, right)
+            right = self.binary(prec if op == "**" else prec + 1)
+            left = ("logic" if op in ("&&", "||", "??") else "binop",
+                    op, left, right)
 
     def unary(self):
         t, v, _ = self.peek()
@@ -450,8 +453,23 @@ class Parser:
         return expr
 
     def call_member(self, expr):
+        # Optional links (?.) mark the whole chain: one nullish base
+        # short-circuits the REST of the chain (spec OptionalExpression),
+        # which the interpreter implements by unwinding to the optchain
+        # wrapper emitted here.
+        has_opt = False
         while True:
-            if self.eat("punct", "."):
+            if self.eat("punct", "?."):
+                has_opt = True
+                if self.at("punct", "("):
+                    expr = ("optcall", expr, self.arguments())
+                elif self.eat("punct", "["):
+                    idx = self.expression()
+                    self.expect("punct", "]")
+                    expr = ("optindex", expr, idx)
+                else:
+                    expr = ("optmember", expr, self.prop_name())
+            elif self.eat("punct", "."):
                 expr = ("member", expr, self.prop_name())
             elif self.at("punct", "["):
                 self.next()
@@ -461,7 +479,7 @@ class Parser:
             elif self.at("punct", "("):
                 expr = ("call", expr, self.arguments())
             else:
-                return expr
+                return ("optchain", expr) if has_opt else expr
 
     def arguments(self):
         self.expect("punct", "(")

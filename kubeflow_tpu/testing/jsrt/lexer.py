@@ -20,6 +20,7 @@ KEYWORDS = {
 PUNCT = sorted(
     [
         "===", "!==", "**=", "...", "=>", "==", "!=", "<=", ">=", "&&", "||",
+        "??", "?.", "**",
         "++", "--", "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "<<", ">>",
         "{", "}", "(", ")", "[", "]", ";", ",", "<", ">", "+", "-", "*", "/",
         "%", "&", "|", "^", "!", "~", "?", ":", "=", ".",

@@ -79,6 +79,13 @@ def string_prop(interp: Interpreter, s: str, name: str):
     if name == "charAt":
         return method(lambda a: s[int(to_number(a[0]))] if a and
                       0 <= int(to_number(a[0])) < len(s) else "")
+    if name == "at":
+        def str_at(a):
+            i = int(to_number(a[0])) if a and a[0] is not undefined else 0
+            if i < 0:
+                i += len(s)
+            return s[i] if 0 <= i < len(s) else undefined
+        return method(str_at)
     if name == "charCodeAt":
         def char_code_at(a):
             i = _to_index(a[0], len(s)) if a and a[0] is not undefined else 0
@@ -158,11 +165,15 @@ def _split(s: str, args):
         return JSArray([s])
     sep = args[0]
     if isinstance(sep, RegExpObject):
-        return JSArray(sep.regex.split(s))
-    sep = to_js_string(sep)
-    if sep == "":
-        return JSArray(list(s))
-    return JSArray(s.split(sep))
+        parts = sep.regex.split(s)
+    else:
+        sep = to_js_string(sep)
+        parts = list(s) if sep == "" else s.split(sep)
+    # Spec: the limit TRUNCATES the result (it is not Python's maxsplit —
+    # 'a,b,c'.split(',', 2) is ['a','b'], never ['a','b,c']).
+    if len(args) > 1 and args[1] is not undefined:
+        parts = parts[:int(to_number(args[1]))]
+    return JSArray(parts)
 
 
 def _match(s: str, pattern):
@@ -228,13 +239,47 @@ def _replace(interp, s: str, args):
 # ---- number methods --------------------------------------------------------------
 
 
+def _num_to_radix(n: float, radix: int) -> str:
+    """Number::toString(radix) — integer part exact, fraction to 20
+    digits (the SPAs only format integers; hex ids, base-36 slugs)."""
+    if math.isnan(n):
+        return "NaN"
+    if math.isinf(n):
+        return "Infinity" if n > 0 else "-Infinity"
+    digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+    neg, n = n < 0, abs(n)
+    i, out = int(n), ""
+    while True:
+        out = digits[i % radix] + out
+        i //= radix
+        if i == 0:
+            break
+    frac = n - int(n)
+    if frac:
+        out += "."
+        for _ in range(20):
+            frac *= radix
+            d = int(frac)
+            out += digits[d]
+            frac -= d
+            if not frac:
+                break
+    return ("-" if neg else "") + out
+
+
 def number_prop(interp: Interpreter, n: float, name: str):
     if name == "toFixed":
         return HostFunction(
             lambda this, args: f"{n:.{int(to_number(args[0])) if args else 0}f}",
             "toFixed")
     if name == "toString":
-        return HostFunction(lambda this, args: format_number(n), "toString")
+        def num_to_string(this, args):
+            if args and args[0] is not undefined:
+                radix = int(to_number(args[0]))
+                if radix != 10:
+                    return _num_to_radix(n, radix)
+            return format_number(n)
+        return HostFunction(num_to_string, "toString")
     return undefined
 
 
@@ -253,6 +298,13 @@ def array_prop(interp: Interpreter, arr: JSArray, name: str):
         return method(lambda a: (items.extend(a), float(len(items)))[1])
     if name == "pop":
         return method(lambda a: items.pop() if items else undefined)
+    if name == "at":
+        def arr_at(a):
+            i = int(to_number(a[0])) if a and a[0] is not undefined else 0
+            if i < 0:
+                i += len(items)
+            return items[i] if 0 <= i < len(items) else undefined
+        return method(arr_at)
     if name == "shift":
         return method(lambda a: items.pop(0) if items else undefined)
     if name == "unshift":
@@ -535,7 +587,11 @@ def install(interp: Interpreter) -> None:
             indent = int(to_number(args[2]))
         if args and args[0] is undefined:
             return undefined
-        return _json.dumps(value, indent=indent)
+        # Node emits compact separators ('{"a":1}'); Python's defaults
+        # insert spaces — a cross-engine divergence the differential
+        # corpus pins (json-stringify-compact).
+        seps = (",", ": ") if indent is not None else (",", ":")
+        return _json.dumps(value, indent=indent, separators=seps)
 
     def json_parse(this, args):
         try:
@@ -577,6 +633,17 @@ def install(interp: Interpreter) -> None:
             to_js_string(pair.items[0], interp): pair.items[1]
             for pair in args[0].items}), "fromEntries")
     obj_ns.props["freeze"] = HostFunction(lambda this, args: args[0], "freeze")
+
+    def object_create(this, args):
+        proto = args[0] if args else undefined
+        o = JSObject()
+        if isinstance(proto, JSObject):
+            o.proto = proto
+        return o
+    obj_ns.props["create"] = HostFunction(object_create, "create")
+    obj_ns.props["getPrototypeOf"] = HostFunction(
+        lambda this, args: (getattr(args[0], "proto", None) or null)
+        if isinstance(args[0], JSObject) else null, "getPrototypeOf")
     g.declare("Object", obj_ns)
 
     # Array
@@ -746,7 +813,155 @@ def install(interp: Interpreter) -> None:
         return out
     promise_ns.props["allSettled"] = HostFunction(promise_all_settled,
                                                   "allSettled")
+
+    def promise_race(this, args):
+        out = Promise(interp)
+        settled = {"done": False}
+
+        def first(settle_fn):
+            def cb(v):
+                if not settled["done"]:
+                    settled["done"] = True
+                    settle_fn(v)
+            return cb
+        ok, err = first(out.resolve), first(out.reject)
+        for entry in list(interp.iterate(args[0])):
+            if isinstance(entry, Promise):
+                entry.then_callbacks(ok, err)
+            else:
+                ok(entry)
+        return out
+    promise_ns.props["race"] = HostFunction(promise_race, "race")
     g.declare("Promise", promise_ns)
+
+    # Map / Set — SameValueZero keying: primitives by (type-tagged) value,
+    # objects by identity. keys()/values()/entries() return arrays (spec:
+    # iterators — for-of and spread over them behave identically here).
+    def _svz_key(k):
+        if isinstance(k, JSObject):
+            return ("o", id(k))
+        if isinstance(k, bool):
+            return ("b", k)
+        if isinstance(k, float):
+            return ("n", "NaN" if math.isnan(k) else k)
+        if isinstance(k, str):
+            return ("s", k)
+        return ("x", id(k))  # undefined / null singletons
+
+    class MapObject(JSObject):
+        class_name = "Map"
+
+        def __init__(self):
+            super().__init__()
+            self.data = {}  # svz key -> (original key, value)
+
+        def js_iter(self):
+            return (JSArray([k, v]) for k, v in self.data.values())
+
+        def js_get_prop(self, name, itp):
+            d = self.data
+            if name == "size":
+                return float(len(d))
+            if name == "get":
+                return HostFunction(
+                    lambda this, a: d.get(_svz_key(a[0]), (None, undefined))[1],
+                    "get")
+            if name == "set":
+                def mset(this, a):
+                    k = a[0] if a else undefined
+                    v = a[1] if len(a) > 1 else undefined
+                    d[_svz_key(k)] = (k, v)
+                    return self
+                return HostFunction(mset, "set")
+            if name == "has":
+                return HostFunction(
+                    lambda this, a: _svz_key(a[0]) in d, "has")
+            if name == "delete":
+                return HostFunction(
+                    lambda this, a: d.pop(_svz_key(a[0]), NOT_PRESENT)
+                    is not NOT_PRESENT, "delete")
+            if name == "clear":
+                return HostFunction(
+                    lambda this, a: (d.clear(), undefined)[1], "clear")
+            if name == "keys":
+                return HostFunction(
+                    lambda this, a: JSArray([k for k, _ in d.values()]), "keys")
+            if name == "values":
+                return HostFunction(
+                    lambda this, a: JSArray([v for _, v in d.values()]),
+                    "values")
+            if name == "entries":
+                return HostFunction(
+                    lambda this, a: JSArray(list(self.js_iter())), "entries")
+            if name == "forEach":
+                def meach(this, a):
+                    for k, v in list(d.values()):
+                        itp.call_function(a[0], undefined, [v, k, self])
+                    return undefined
+                return HostFunction(meach, "forEach")
+            return super().js_get_prop(name, itp)
+
+    class SetObject(JSObject):
+        class_name = "Set"
+
+        def __init__(self):
+            super().__init__()
+            self.data = {}  # svz key -> original value
+
+        def js_iter(self):
+            return iter(list(self.data.values()))
+
+        def js_get_prop(self, name, itp):
+            d = self.data
+            if name == "size":
+                return float(len(d))
+            if name == "add":
+                def sadd(this, a):
+                    v = a[0] if a else undefined
+                    d.setdefault(_svz_key(v), v)
+                    return self
+                return HostFunction(sadd, "add")
+            if name == "has":
+                return HostFunction(
+                    lambda this, a: _svz_key(a[0]) in d, "has")
+            if name == "delete":
+                return HostFunction(
+                    lambda this, a: d.pop(_svz_key(a[0]), NOT_PRESENT)
+                    is not NOT_PRESENT, "delete")
+            if name == "clear":
+                return HostFunction(
+                    lambda this, a: (d.clear(), undefined)[1], "clear")
+            if name == "values":
+                return HostFunction(
+                    lambda this, a: JSArray(list(d.values())), "values")
+            if name == "forEach":
+                def seach(this, a):
+                    for v in list(d.values()):
+                        itp.call_function(a[0], undefined, [v, v, self])
+                    return undefined
+                return HostFunction(seach, "forEach")
+            return super().js_get_prop(name, itp)
+
+    def map_construct(args):
+        m = MapObject()
+        if args and args[0] is not undefined and args[0] is not null:
+            for pair in interp.iterate(args[0]):
+                k = interp.get_index(pair, 0.0)
+                v = interp.get_index(pair, 1.0)
+                m.data[_svz_key(k)] = (k, v)
+        return m
+
+    def set_construct(args):
+        s = SetObject()
+        if args and args[0] is not undefined and args[0] is not null:
+            for v in interp.iterate(args[0]):
+                s.data.setdefault(_svz_key(v), v)
+        return s
+
+    g.declare("Map", HostClass(
+        "Map", map_construct, lambda v: isinstance(v, MapObject)))
+    g.declare("Set", HostClass(
+        "Set", set_construct, lambda v: isinstance(v, SetObject)))
 
     # Error family
     def error_class(kind):
