@@ -171,13 +171,22 @@ def detect_accelerator(device) -> str | None:
 MEASURE_TRIALS = 3
 
 
+def _median_sorted(xs: list) -> float:
+    """True median of an already-sorted list (mean of the two middle
+    elements for even counts — ``xs[n//2]`` alone is the upper-middle,
+    which biased even-count spreads slightly high)."""
+    n = len(xs)
+    mid = n // 2
+    return xs[mid] if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
 def _measure_trials(run_window, *, trials: int = MEASURE_TRIALS) -> dict:
     """Run a timing window ``trials`` times; report the median plus the
     raw trials and relative spread, so a shared-relay blip (r02→r03's
     unexplained 4.7% longctx drift) is classifiable from the JSON alone:
     large spread → variance, tight spread + moved median → regression."""
     secs = sorted(run_window() for _ in range(trials))
-    median = secs[trials // 2]
+    median = _median_sorted(secs)
     return {
         "median_sec": median,
         "trials_sec": [round(s, 4) for s in secs],
@@ -569,7 +578,8 @@ def bench() -> dict:
     step_sec = (time.perf_counter() - t1) / (4 * chunk)
     chunk_secs.sort()
     step_spread_pct = round(
-        100.0 * (chunk_secs[-1] - chunk_secs[0]) / chunk_secs[2], 2)
+        100.0 * (chunk_secs[-1] - chunk_secs[0]) / _median_sorted(chunk_secs),
+        2)
 
     flops = train_step_flops(cfg, BENCH_BATCH)
     achieved_tflops = flops / step_sec / 1e12

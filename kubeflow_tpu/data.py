@@ -201,14 +201,19 @@ class ShardedLoader:
 
     # -- resume -------------------------------------------------------------------
 
-    def skip(self, n_batches: int) -> None:
+    def skip(self, n_batches: int, *, detach_wait: float = 60.0) -> None:
         """O(1) fast-forward: position this loader exactly where a fresh
         loader would be after yielding ``n_batches``. The resume path
         that composes with ``prefetch`` — count the steps the *consumer*
         ran (the trainer's step counter) and skip that many; the wrapped
         loader's own cursor runs ahead by the prefetch depth and must not
-        be snapshotted."""
-        self._detach_prefetcher()
+        be snapshotted.
+
+        ``detach_wait`` bounds the synchronous stall while a live
+        prefetch producer wedged in a slow source/transform is waited
+        out (default 60s — a checkpoint restore that must not block can
+        pass a small value and accept the RuntimeWarning instead)."""
+        self._detach_prefetcher(wait=detach_wait)
         epoch, b = divmod(int(n_batches), self.batches_per_process)
         with self._iter_lock:
             # Same lock as the iterator's cursor claim: a foreign
@@ -251,12 +256,13 @@ class ShardedLoader:
         return (self._pos.epoch * self.batches_per_process
                 + self._pos.batch_in_epoch)
 
-    def rewind(self, n_batches: int) -> None:
+    def rewind(self, n_batches: int, *, detach_wait: float = 60.0) -> None:
         """Move the cursor back ``n_batches`` (floored at the start).
         Used by ``prefetch``'s close path to hand back read-ahead batches
         the consumer never saw, so re-wrapping the same loader resumes
         where the *consumer* stopped — not ``depth+1`` batches later."""
-        self.skip(max(0, self._linear() - int(n_batches)))
+        self.skip(max(0, self._linear() - int(n_batches)),
+                  detach_wait=detach_wait)
 
     def state_dict(self) -> dict:
         """Cursor snapshot — valid only for a directly-iterated loader
@@ -265,8 +271,9 @@ class ShardedLoader:
         pos = self._pos  # single atomic read — no torn epoch/batch pair
         return {"epoch": pos.epoch, "batch_in_epoch": pos.batch_in_epoch}
 
-    def load_state_dict(self, state: dict) -> None:
-        self._detach_prefetcher()
+    def load_state_dict(self, state: dict, *,
+                        detach_wait: float = 60.0) -> None:
+        self._detach_prefetcher(wait=detach_wait)
         with self._iter_lock:
             self._pos = _Position(int(state["epoch"]),
                                   int(state["batch_in_epoch"]))

@@ -47,6 +47,17 @@ class VisionConfig:
     num_classes: int = 1000
     dtype: str = "bfloat16"
 
+    def __post_init__(self):
+        # The space-to-depth stem folds 2x2 pixel blocks into channels, so
+        # the stem weight is [3, 3, 4*channels, w0] (NOT [3, 3, channels,
+        # w0] as before r4 — params saved from the old stem don't load)
+        # and inputs must have even H/W. Fail at config time, not first
+        # forward.
+        if self.image_size % 2:
+            raise ValueError(
+                f"image_size={self.image_size} must be even: the "
+                f"space-to-depth stem folds 2x2 pixel blocks into channels")
+
 
 def _conv_init(key, kh, kw, cin, cout):
     scale = (2.0 / (kh * kw * cin)) ** 0.5  # He init for relu-family

@@ -668,8 +668,11 @@ document.getElementById("ns-slot").append(
   KF.localePicker()
 );
 /* Locale switch re-renders the live table (headers, status labels,
- * action buttons) in place. */
+ * action buttons) AND the already-built volume panels (mode selects,
+ * field labels) in place — refresh() alone left the form in the old
+ * locale until a namespace change happened to rebuild it. */
 KF.onLocaleChange(() => {
+  renderVolumeForms();
   refresh().catch(() => {});
 });
 loadCatalogs().catch(showError);

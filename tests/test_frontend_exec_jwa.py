@@ -390,6 +390,12 @@ def test_locale_switch_rerenders_table_headers(jwa):
     # Persisted: the next page load starts in German.
     assert b.local_storage.get("kf.locale") == "de"
 
+    # The ALREADY-RENDERED volume panels re-render too (ADVICE r4: they
+    # kept the old locale until a namespace change rebuilt them).
+    vol_form = b.query("#data-volumes-slot")
+    assert "Neues Volume" in vol_form.text_content(), (
+        "volume form stuck in the previous locale after a locale switch")
+
     # Status labels and action buttons localize on live rows too.
     b.click("#new-btn")
     b.set_value('#new-form input[name="name"]', "lokal")

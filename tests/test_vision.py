@@ -55,16 +55,20 @@ def test_data_parallel_matches_single_device():
 
 
 def test_odd_image_size_fails_loudly():
-    """The space-to-depth stem requires even H/W; the error must be
-    actionable, not an opaque reshape failure inside jit tracing."""
+    """The space-to-depth stem requires even H/W. An odd configured size
+    fails at CONFIG time (ADVICE r4: not at first forward); odd actual
+    inputs that bypass the config still fail actionably at forward."""
     import jax
     import jax.numpy as jnp
     import pytest
 
     from kubeflow_tpu.models import vision
 
-    cfg = vision.VisionConfig(image_size=15)
+    with pytest.raises(ValueError, match="even"):
+        vision.VisionConfig(image_size=15)
+
+    cfg = vision.VisionConfig(image_size=16)
     params = vision.init_params(jax.random.key(0), cfg)
-    images = jnp.zeros((2, 15, 15, 3), jnp.bfloat16)
+    images = jnp.zeros((2, 15, 15, 3), jnp.bfloat16)  # shape lies vs cfg
     with pytest.raises(ValueError, match="divisible"):
         vision.forward(params, images, cfg)
