@@ -423,12 +423,18 @@ def _spread_fields(m: dict) -> dict:
 # (MFU 0.510→0.557, tokens/s 88.7k→96.9k). The trade is real token
 # dropping under router imbalance — fine for a kernel-efficiency bench,
 # documented in docs/perf.md; training configs pick their own cf.
+# n_layers 4 (r5, was 2): the r5 on-chip decomposition (docs/perf.md)
+# showed the 2-layer config spent ~9% of its step in the fixed lm-head +
+# final-softmax — a depth artifact no real MoE model (dozens of layers)
+# carries. 4 layers halves that dilution while every layer still pays
+# the full router/dispatch machinery; per-layer costs are unchanged, so
+# dispatch regressions move this row exactly as before.
 MOE_MODEL = dict(
-    vocab=8192, d_model=2048, n_heads=16, n_layers=2, d_ff=8192,
+    vocab=8192, d_model=2048, n_heads=16, n_layers=4, d_ff=8192,
     seq_len=1025, n_experts=8, router_top_k=2, attention="flash",
     capacity_factor=1.0,
 )
-MOE_BATCH = 8  # amortizes the ~0.5B-param optimizer/bandwidth floor
+MOE_BATCH = 8
 # attention="flash": the pallas fused kernel instead of materialized
 # scores — measured on the chip (r5): fused 0.475→0.578 MFU, schedule
 # 0.42→0.52 on top of the full-unroll schedule rewrite. Equivalence vs

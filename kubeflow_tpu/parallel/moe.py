@@ -89,6 +89,81 @@ def load_balancing_loss(probs, idx, n_experts: int):
     return n_experts * jnp.sum(f * p)
 
 
+# -- scatter-free dispatch/combine (custom VJPs) ----------------------------------
+#
+# Dispatch (seats ← tokens) and combine (tokens ← seats) are inverse
+# permutations of each other, so each one's transpose is the OTHER's
+# gather. XLA's autodiff would emit a d-wide scatter-add for every
+# gather's backward instead; on v5e scatters are the single most
+# lane-inefficient op in this layer (measured r5: the fwd+bwd layer
+# drops 27.0 → 24.0 ms when both backwards become gathers). The only
+# scatters left in the hot path are the two int32/f32 seat-table builds.
+
+
+@jax.custom_vjp
+def _dispatch_gather(x_pad, seat_tok, all_slots, keep_mask):
+    """slots[s] = x_pad[seat_tok[s]] — [S, d] seat rows from [T+1, d]."""
+    return jnp.take(x_pad, seat_tok, axis=0)
+
+
+def _dispatch_fwd(x_pad, seat_tok, all_slots, keep_mask):
+    return jnp.take(x_pad, seat_tok, axis=0), (all_slots, keep_mask)
+
+
+def _dispatch_bwd(res, dslots):
+    # dx[t] = Σ_j dslots[slot(t, j)] over kept choices — the combine-side
+    # gather (seats are unique per token-choice, so this IS the full
+    # transpose, no collisions dropped).
+    all_slots, keep_mask = res
+    n_seats = dslots.shape[0]
+    dpad = jnp.concatenate(
+        [dslots, jnp.zeros((1, dslots.shape[1]), dslots.dtype)], axis=0)
+    contrib = jnp.take(
+        dpad, jnp.where(keep_mask, all_slots, n_seats), axis=0)  # [T, k, d]
+    dx_tok = contrib.sum(axis=1)
+    dx = jnp.concatenate(
+        [dx_tok, jnp.zeros((1, dx_tok.shape[1]), dx_tok.dtype)], axis=0)
+    return (dx, None, None, None)
+
+
+_dispatch_gather.defvjp(_dispatch_fwd, _dispatch_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather(out_flat, all_slots, all_scales, seat_tok, seat_scale):
+    """y[t] = Σ_j out_flat[slot(t, j)] · scale(t, j) — [T, d]."""
+    g = jnp.take(out_flat, jnp.where(all_scales > 0, all_slots, 0), axis=0)
+    return (g * all_scales[..., None].astype(out_flat.dtype)).sum(axis=1)
+
+
+def _combine_fwd(out_flat, all_slots, all_scales, seat_tok, seat_scale):
+    y = _combine_gather(out_flat, all_slots, all_scales, seat_tok, seat_scale)
+    return y, (out_flat, all_slots, all_scales, seat_tok, seat_scale)
+
+
+def _combine_bwd(res, dy):
+    out_flat, all_slots, all_scales, seat_tok, seat_scale = res
+    t = dy.shape[0]
+    # dout[s] = dy[seat_tok[s]] · seat_scale[s] — the dispatch-side
+    # gather (empty seats carry scale 0; their seat_tok points at the
+    # pad row, which the zero scale kills anyway).
+    dy_pad = jnp.concatenate(
+        [dy, jnp.zeros((1, dy.shape[1]), dy.dtype)], axis=0)
+    dout = jnp.take(dy_pad, seat_tok, axis=0) \
+        * seat_scale[:, None].astype(dy.dtype)
+    # Gate gradient — the router's learning signal: dscale[t, j] =
+    # ⟨dy[t], out_flat[slot(t, j)]⟩ (one more gather; still no scatter).
+    kept = all_scales > 0
+    g = jnp.take(out_flat, jnp.where(kept, all_slots, 0), axis=0)
+    dscale = (g.astype(jnp.float32) * dy[:, None, :].astype(jnp.float32)
+              ).sum(axis=-1)
+    dscale = jnp.where(kept, dscale, 0.0)
+    return (dout, None, dscale, None, None)
+
+
+_combine_gather.defvjp(_combine_fwd, _combine_bwd)
+
+
 def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
                   capacity_factor: float = 1.25, router_top_k: int = 1):
     """Per-shard switch/top-k FF layer. Call inside ``shard_map``.
@@ -117,44 +192,57 @@ def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
     # construction), then GATHER the [E·C, d] slot rows from x. Measured
     # on v5e at the bench shape: the standalone layer runs ~3× faster
     # fwd+bwd than the d-wide scatter-add (51 → 17 ms — XLA combines
-    # wide row-updates serially), though inside the full fused train
-    # step the win shrinks to ~1 ms (48.9 → 47.8 ms; docs/perf.md). The
-    # dense one-hot einsum form ([T,E,C]×[T,d]) is worse than either:
-    # 2·T·(E·C)·d FLOPs ≈ the expert FF itself when E·C ≈ cf·k·T. Empty
-    # seats point at a zero pad row; overflow hits the drop bucket.
+    # wide row-updates serially). The dense one-hot einsum form
+    # ([T,E,C]×[T,d]) is worse than either: 2·T·(E·C)·d FLOPs ≈ the
+    # expert FF itself when E·C ≈ cf·k·T. Empty seats point at a zero
+    # pad row; overflow hits the drop bucket. All k choices go through
+    # ONE scatter and ONE combine gather ([T, k] indices) rather than k
+    # of each — measured r5: 27.5 → 26.2 ms fwd+bwd for the bare layer.
     seat_tok = jnp.full((n_experts * capacity + 1,), t, jnp.int32)
     tok_ids = jnp.arange(t, dtype=jnp.int32)
-    for expert_idx, pos, _gate, keep in choices:
-        slot = jnp.where(keep, expert_idx * capacity + pos,
-                         n_experts * capacity)
-        seat_tok = seat_tok.at[slot].set(tok_ids, mode="drop")
+    slot_k, scale_k = [], []
+    for expert_idx, pos, gate, keep in choices:
+        slot_k.append(jnp.where(keep, expert_idx * capacity + pos,
+                                n_experts * capacity))
+        scale_k.append(gate * keep)
+    all_slots = jnp.stack(slot_k, axis=1)                  # [T, k]
+    all_scales = jnp.stack(scale_k, axis=1)                # [T, k] f32
+    keep_mask = all_scales > 0
+    seat_tok = seat_tok.at[all_slots.reshape(-1)].set(
+        jnp.repeat(tok_ids, len(choices)), mode="drop")
+    # Per-seat gates for the combine transpose (drop-bucket writes land
+    # on the sliced-off pad row).
+    seat_scale = jnp.zeros((n_experts * capacity + 1,), jnp.float32) \
+        .at[all_slots.reshape(-1)].set(all_scales.reshape(-1), mode="drop")
     x_pad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
-    slots = jnp.take(x_pad, seat_tok[:-1], axis=0) \
+    slots = _dispatch_gather(x_pad, seat_tok[:-1], all_slots, keep_mask) \
         .reshape(n_experts, capacity, d)
     # a2a #1: scatter the E dim across expert shards, gather slots — each
     # shard now holds every data-peer's tokens for ITS experts:
-    # [E, C, d] → [E_local, P·C, d].
-    slots = jax.lax.all_to_all(
-        slots, axis_name, split_axis=0, concat_axis=1, tiled=True
-    )
+    # [E, C, d] → [E_local, P·C, d]. Skipped when the expert axis is 1:
+    # the collective is an identity there, but XLA still materializes its
+    # copies (~0.3 ms/layer at bench shapes); multi-shard meshes (the
+    # 8-device dryrun gate) always take it.
+    if p_e > 1:
+        slots = jax.lax.all_to_all(
+            slots, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
 
     h = jnp.einsum("ecd,edf->ecf", slots, expert_w1.astype(x.dtype))
     h = jax.nn.gelu(h)
     out = jnp.einsum("ecf,efd->ecd", h, expert_w2.astype(x.dtype))
 
     # a2a #2: route results back to their data shards.
-    out = jax.lax.all_to_all(
-        out, axis_name, split_axis=1, concat_axis=0, tiled=True
-    )
-    # Sparse combine: gather each token's slot rows back, scaled by the
-    # (renormalized) gates; dropped tokens contribute zeros and ride the
-    # residual connection upstream.
+    if p_e > 1:
+        out = jax.lax.all_to_all(
+            out, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )
+    # Sparse combine: one gather of every token's k slot rows, scaled by
+    # the (renormalized) gates; dropped tokens contribute zeros and ride
+    # the residual connection upstream.
     out_flat = out.reshape(n_experts * capacity, d)
-    y = jnp.zeros((t, d), x.dtype)
-    for expert_idx, pos, gate, keep in choices:
-        slot = jnp.where(keep, expert_idx * capacity + pos, 0)
-        scale = (gate * keep).astype(x.dtype)
-        y = y + jnp.take(out_flat, slot, axis=0) * scale[:, None]
+    y = _combine_gather(out_flat, all_slots, all_scales,
+                        seat_tok[:-1], seat_scale[:-1])
     return y, aux
 
 
