@@ -85,6 +85,29 @@ KF.onLocaleChange = function (fn) {
   };
 };
 
+/* Static-HTML localization: elements marked data-i18n="key" get their
+ * text from the catalog; data-i18n-attr="placeholder:key;title:key2"
+ * localizes attributes. The first call subscribes to locale changes so
+ * the static chrome re-renders with the dynamic views. */
+KF.localizeDocument = function (root) {
+  const scope = root || document;
+  for (const node of scope.querySelectorAll("[data-i18n]")) {
+    node.textContent = KF.t(node.getAttribute("data-i18n"));
+  }
+  for (const node of scope.querySelectorAll("[data-i18n-attr]")) {
+    for (const pair of node.getAttribute("data-i18n-attr").split(";")) {
+      const at = pair.indexOf(":");
+      if (at > 0) {
+        node.setAttribute(pair.slice(0, at), KF.t(pair.slice(at + 1)));
+      }
+    }
+  }
+  if (!KF.localizeDocument._subscribed) {
+    KF.localizeDocument._subscribed = true;
+    KF.onLocaleChange(() => KF.localizeDocument(root));
+  }
+};
+
 KF.localePicker = function () {
   const select = document.createElement("select");
   select.className = "kf-locale-picker";
@@ -124,6 +147,9 @@ KF.registerMessages("en", {
   "action.connect": "Connect",
   "common.none": "none",
   "common.cancel": "Cancel",
+  "common.loading": "Loading…",
+  "common.apply": "Apply",
+  "common.chipPlaceholder": "add value, press Enter",
   "jwa.empty": "No notebook servers in this namespace.",
 });
 KF.registerMessages("de", {
@@ -147,6 +173,9 @@ KF.registerMessages("de", {
   "action.connect": "Verbinden",
   "common.none": "keine",
   "common.cancel": "Abbrechen",
+  "common.loading": "Lädt…",
+  "common.apply": "Übernehmen",
+  "common.chipPlaceholder": "Wert eingeben, Enter drücken",
   "jwa.empty": "Keine Notebook-Server in diesem Namespace.",
 });
 
@@ -477,7 +506,7 @@ KF.eventsTable = function (container, events) {
 /* fetchLogs(podName) -> Promise<string[]>; pods: [{name}] for the worker
  * picker (multi-host slices have one log stream per worker). */
 KF.logsViewer = function (container, pods, fetchLogs) {
-  const pre = KF.el("pre", { class: "logs" }, "Loading…");
+  const pre = KF.el("pre", { class: "logs" }, KF.t("common.loading"));
   const picker = KF.el(
     "select",
     { style: { width: "auto" } },
@@ -726,7 +755,8 @@ KF.codeEditor = function (initial, opts = {}) {
 /* Manifest editor dialog over KF.codeEditor. onSubmit receives the raw
  * YAML text and may throw/reject — the error renders inline and the
  * dialog stays open for another attempt. */
-KF.yamlEditDialog = function ({ title, initial = "", submitText = "Apply", onSubmit }) {
+KF.yamlEditDialog = function ({ title, initial = "", submitText, onSubmit }) {
+  submitText = submitText || KF.t("common.apply");
   return new Promise((resolve) => {
     const overlay = KF.el("div", { class: "kf-overlay" });
     const errorBox = KF.el("pre", {
@@ -1164,7 +1194,7 @@ KF.spinner = function (label) {
     "span",
     { class: "kf-spinner", role: "status" },
     KF.el("span", { class: "kf-spinner-dot" }),
-    label || "Loading…"
+    label || KF.t("common.loading")
   );
 };
 
@@ -1541,7 +1571,7 @@ KF.chipsInput = function (initial, onChange, { placeholder, validate } = {}) {
     );
   }
   const input = KF.el("input", {
-    placeholder: placeholder || "add value, press Enter",
+    placeholder: placeholder || KF.t("common.chipPlaceholder"),
     style: { width: "200px" },
   });
   input.addEventListener("keydown", (ev) => {

@@ -1,11 +1,96 @@
 /* Dashboard frontend: workgroup bootstrap, app links, namespaces, TPU
  * usage, and time-series metrics panels (sparklines over /api/metrics —
- * the reference's resource-chart.js over the pluggable metrics service). */
+ * the reference's resource-chart.js over the pluggable metrics service).
+ * All user-visible strings route through KF.t (reference: the
+ * centraldashboard's i18n pipeline). */
+
+KF.registerMessages("en", {
+  "cd.metricTpuDuty": "TPU duty cycle",
+  "cd.metricNodeCpu": "Node CPU",
+  "cd.metricPodMem": "Pod memory",
+  "cd.noQuota": "no quota",
+  "cd.quota": "quota {n}",
+  "cd.chipsRequested": "{n} chips requested in {ns} ({quota})",
+  "cd.noTpuPods": "No TPU pods running.",
+  "cd.noRecentEvents": "No recent events in {ns}.",
+  "cd.loading": "loading…",
+  "cd.noDataInRange": "no data in range",
+  "cd.noMetricsBackend": "no metrics backend configured (set PROMETHEUS_URL)",
+  "cd.latest": "latest: {value} ({label})",
+  "cd.metricsUnavailable": "metrics unavailable: {message}",
+  "cd.contributorsTitle": "Contributors — {ns}",
+  "cd.loadingCap": "Loading…",
+  "cd.remove": "Remove",
+  "cd.noContributors": "No contributors yet.",
+  "cd.contributorsHint":
+    "Contributors get edit access to every app in this namespace.",
+  "cd.contributorAdded": "Contributor added",
+  "cd.add": "Add",
+  "cd.colNamespace": "Namespace",
+  "cd.colRole": "Role",
+  "cd.colContributors": "Contributors",
+  "cd.manage": "Manage",
+  "cd.emptyNamespaces": "No namespaces yet — register a workgroup below.",
+  "cd.workgroupCreated": "Workgroup created",
+  "cd.title": "Kubeflow TPU",
+  "cd.welcome": "Welcome",
+  "cd.noWorkspaceYet": "You don't have a workspace namespace yet.",
+  "cd.createMyNamespace": "Create my namespace",
+  "cd.applications": "Applications",
+  "cd.myNamespaces": "My namespaces",
+  "cd.tpuUsage": "TPU usage",
+  "cd.recentActivity": "Recent activity",
+  "cd.clusterMetrics": "Cluster metrics",
+  "cd.selectNamespace": "Select a namespace above.",
+  "cd.ago": " ago",
+});
+KF.registerMessages("de", {
+  "cd.metricTpuDuty": "TPU-Auslastung",
+  "cd.metricNodeCpu": "Node-CPU",
+  "cd.metricPodMem": "Pod-Speicher",
+  "cd.noQuota": "kein Kontingent",
+  "cd.quota": "Kontingent {n}",
+  "cd.chipsRequested": "{n} Chips angefordert in {ns} ({quota})",
+  "cd.noTpuPods": "Keine TPU-Pods laufen.",
+  "cd.noRecentEvents": "Keine aktuellen Ereignisse in {ns}.",
+  "cd.loading": "lädt…",
+  "cd.noDataInRange": "keine Daten im Zeitraum",
+  "cd.noMetricsBackend":
+    "kein Metrik-Backend konfiguriert (PROMETHEUS_URL setzen)",
+  "cd.latest": "aktuell: {value} ({label})",
+  "cd.metricsUnavailable": "Metriken nicht verfügbar: {message}",
+  "cd.contributorsTitle": "Mitwirkende — {ns}",
+  "cd.loadingCap": "Lädt…",
+  "cd.remove": "Entfernen",
+  "cd.noContributors": "Noch keine Mitwirkenden.",
+  "cd.contributorsHint":
+    "Mitwirkende erhalten Schreibzugriff auf alle Apps in diesem Namespace.",
+  "cd.contributorAdded": "Mitwirkende(r) hinzugefügt",
+  "cd.add": "Hinzufügen",
+  "cd.colNamespace": "Namespace",
+  "cd.colRole": "Rolle",
+  "cd.colContributors": "Mitwirkende",
+  "cd.manage": "Verwalten",
+  "cd.emptyNamespaces":
+    "Noch keine Namespaces — unten eine Workgroup registrieren.",
+  "cd.workgroupCreated": "Workgroup erstellt",
+  "cd.title": "Kubeflow TPU",
+  "cd.welcome": "Willkommen",
+  "cd.noWorkspaceYet": "Sie haben noch keinen Workspace-Namespace.",
+  "cd.createMyNamespace": "Meinen Namespace erstellen",
+  "cd.applications": "Anwendungen",
+  "cd.myNamespaces": "Meine Namespaces",
+  "cd.tpuUsage": "TPU-Nutzung",
+  "cd.recentActivity": "Aktuelle Aktivität",
+  "cd.clusterMetrics": "Cluster-Metriken",
+  "cd.selectNamespace": "Oben einen Namespace auswählen.",
+  "cd.ago": " zuvor",
+});
 
 const METRIC_PANELS = [
-  { type: "tpu_duty", label: "TPU duty cycle" },
-  { type: "node_cpu", label: "Node CPU" },
-  { type: "pod_mem", label: "Pod memory" },
+  { type: "tpu_duty", labelKey: "cd.metricTpuDuty" },
+  { type: "node_cpu", labelKey: "cd.metricNodeCpu" },
+  { type: "pod_mem", labelKey: "cd.metricPodMem" },
 ];
 
 async function loadLinks() {
@@ -22,10 +107,13 @@ async function loadLinks() {
 async function loadTpuUsage(namespace) {
   const body = await api(`api/namespaces/${namespace}/tpu-usage`);
   const target = document.getElementById("tpu-table");
-  const quota = body.chipsQuota == null ? "no quota" : `quota ${body.chipsQuota}`;
+  const quota = body.chipsQuota == null
+    ? KF.t("cd.noQuota")
+    : KF.t("cd.quota", { n: body.chipsQuota });
   target.classList.remove("muted");
   target.replaceChildren(
-    el("p", {}, `${body.chipsRequested} chips requested in ${namespace} (${quota})`),
+    el("p", {}, KF.t("cd.chipsRequested",
+                     { n: body.chipsRequested, ns: namespace, quota })),
     body.pods.length
       ? el(
           "div",
@@ -34,7 +122,7 @@ async function loadTpuUsage(namespace) {
             el("span", { class: "chip" }, `${p.pod}: ${p.chips}`)
           )
         )
-      : el("p", { class: "muted" }, "No TPU pods running.")
+      : el("p", { class: "muted" }, KF.t("cd.noTpuPods"))
   );
 }
 
@@ -53,13 +141,15 @@ async function loadActivities(namespace) {
             el(
               "li",
               { class: a.type === "Warning" ? "event-warning" : "" },
-              KF.ageCell(a.time, " ago"), el("span", { class: "muted" }, " — "),
+              KF.ageCell(a.time, KF.t("cd.ago")),
+              el("span", { class: "muted" }, " — "),
               `${a.involved.kind} ${a.involved.name}: ${a.reason} `,
               el("span", { class: "muted" }, a.message)
             )
           )
         )
-      : el("p", { class: "muted" }, `No recent events in ${namespace}.`)
+      : el("p", { class: "muted" },
+           KF.t("cd.noRecentEvents", { ns: namespace }))
   );
 }
 
@@ -72,11 +162,13 @@ async function loadMetrics() {
       slot = el(
         "div",
         { id: "metric-" + panel.type, class: "card" },
-        el("h4", {}, panel.label),
+        el("h4", { class: "metric-title" }, KF.t(panel.labelKey)),
         el("canvas", { class: "spark" }),
-        el("p", { class: "muted metric-note" }, "loading…")
+        el("p", { class: "muted metric-note" }, KF.t("cd.loading"))
       );
       host.append(slot);
+    } else {
+      slot.querySelector(".metric-title").textContent = KF.t(panel.labelKey);
     }
     try {
       const body = await api(
@@ -86,15 +178,18 @@ async function loadMetrics() {
       const note = slot.querySelector(".metric-note");
       if (!body.points.length) {
         note.textContent = body.resourceChartsLink
-          ? "no data in range"
-          : "no metrics backend configured (set PROMETHEUS_URL)";
+          ? KF.t("cd.noDataInRange")
+          : KF.t("cd.noMetricsBackend");
       } else {
         const last = body.points[body.points.length - 1];
-        note.textContent = `latest: ${last.value.toFixed(3)} (${last.label || panel.type})`;
+        note.textContent = KF.t("cd.latest", {
+          value: last.value.toFixed(3),
+          label: last.label || panel.type,
+        });
       }
     } catch (err) {
       slot.querySelector(".metric-note").textContent =
-        "metrics unavailable: " + err.message;
+        KF.t("cd.metricsUnavailable", { message: err.message });
     }
   }
 }
@@ -103,8 +198,8 @@ function openContributors(n) {
   /* Manage-contributors drawer (the reference dashboard's manage-users
    * view over KFAM bindings). Only owners can mutate; others see a 403
    * surfaced in the list area. */
-  const drawer = KF.drawer(`Contributors — ${n.namespace}`);
-  const list = el("div", {}, "Loading…");
+  const drawer = KF.drawer(KF.t("cd.contributorsTitle", { ns: n.namespace }));
+  const list = el("div", {}, KF.t("cd.loadingCap"));
   const emailInput = el("input", {
     placeholder: "someone@example.com",
     style: { width: "260px" },
@@ -124,7 +219,7 @@ function openContributors(n) {
                   "li",
                   { style: { marginBottom: "6px" } },
                   email + " ",
-                  KF.actionButton("Remove", () =>
+                  KF.actionButton(KF.t("cd.remove"), () =>
                     api(
                       `api/workgroup/remove-contributor/${n.namespace}`,
                       {
@@ -136,15 +231,14 @@ function openContributors(n) {
                 )
               )
             )
-          : el("p", { class: "muted" }, "No contributors yet.")
+          : el("p", { class: "muted" }, KF.t("cd.noContributors"))
       );
     } catch (err) {
       list.replaceChildren(el("p", { class: "muted" }, err.message));
     }
   }
   drawer.content.append(
-    el("p", { class: "muted" },
-      "Contributors get edit access to every app in this namespace."),
+    el("p", { class: "muted" }, KF.t("cd.contributorsHint")),
     list,
     el(
       "div",
@@ -160,11 +254,11 @@ function openContributors(n) {
               body: JSON.stringify({ contributor: emailInput.value }),
             }).then(() => {
               emailInput.value = "";
-              KF.snackbar("Contributor added");
+              KF.snackbar(KF.t("cd.contributorAdded"));
               load();
             }, KF.showError),
         },
-        "Add"
+        KF.t("cd.add")
       )
     )
   );
@@ -181,7 +275,7 @@ async function refresh() {
     document.getElementById("ns-table"),
     [
       {
-        title: "Namespace",
+        title: () => KF.t("cd.colNamespace"),
         render: (n) =>
           el(
             "a",
@@ -198,17 +292,17 @@ async function refresh() {
           ),
         sortKey: (n) => n.namespace,
       },
-      { title: "Role", render: (n) => n.role },
+      { title: () => KF.t("cd.colRole"), render: (n) => n.role },
       {
-        title: "Contributors",
+        title: () => KF.t("cd.colContributors"),
         render: (n) =>
           n.role === "owner"
-            ? KF.actionButton("Manage", () => openContributors(n))
+            ? KF.actionButton(KF.t("cd.manage"), () => openContributors(n))
             : "—",
       },
     ],
     info.namespaces,
-    { emptyText: "No namespaces yet — register a workgroup below." }
+    { emptyText: KF.t("cd.emptyNamespaces") }
   );
   if (info.namespaces.length) {
     loadTpuUsage(info.namespaces[0].namespace).catch(() => {});
@@ -220,12 +314,16 @@ async function refresh() {
 document.getElementById("register-btn").addEventListener("click", () => {
   api("api/workgroup/create", { method: "POST", body: "{}" }).then(
     () => {
-      KF.snackbar("Workgroup created");
+      KF.snackbar(KF.t("cd.workgroupCreated"));
       refresh().catch(showError);
     },
     showError
   );
 });
 
+const localeSlot = document.getElementById("locale-slot");
+if (localeSlot) localeSlot.append(KF.localePicker());
+KF.localizeDocument();
+KF.onLocaleChange(() => refresh().catch(() => {}));
 loadLinks().catch(showError);
 poll(refresh, 10000);

@@ -7,6 +7,59 @@
  * YAML tabs wired to the backend's pod, events and logs routes.
  */
 
+/* Static-chrome + form-label keys (the dynamic strings use the common
+ * jwa.* / table.* / action.* catalogs in kubeflow.js). */
+KF.registerMessages("en", {
+  "jwa.title": "Notebook Servers",
+  "jwa.namespace": "namespace",
+  "jwa.fromYaml": "From YAML",
+  "jwa.fromYamlTitle": "Create a Notebook from a raw manifest",
+  "jwa.newNotebook": "+ New notebook",
+  "jwa.formTitle": "New notebook server",
+  "jwa.formName": "Name",
+  "jwa.formServerType": "Server type",
+  "jwa.formImage": "Image",
+  "jwa.formCustomImage": "Custom image",
+  "jwa.formTopology": "Topology",
+  "jwa.formSlices": "Slices",
+  "jwa.formCapacity": "Capacity",
+  "jwa.queuedHint":
+    "queue a ProvisioningRequest (start when capacity is reserved)",
+  "jwa.formAdvanced": "Advanced",
+  "jwa.formWorkspaceVolume": "Workspace volume",
+  "jwa.formDataVolumes": "Data volumes",
+  "jwa.formConfigurations": "Configurations",
+  "jwa.noneAvailable": "none available",
+  "jwa.formSharedMemory": "Shared memory",
+  "jwa.shmMount": "mount",
+  "jwa.launch": "Launch",
+});
+KF.registerMessages("de", {
+  "jwa.title": "Notebook-Server",
+  "jwa.namespace": "Namespace",
+  "jwa.fromYaml": "Aus YAML",
+  "jwa.fromYamlTitle": "Notebook aus einem Roh-Manifest erstellen",
+  "jwa.newNotebook": "+ Neues Notebook",
+  "jwa.formTitle": "Neuer Notebook-Server",
+  "jwa.formName": "Name",
+  "jwa.formServerType": "Server-Typ",
+  "jwa.formImage": "Image",
+  "jwa.formCustomImage": "Eigenes Image",
+  "jwa.formTopology": "Topologie",
+  "jwa.formSlices": "Slices",
+  "jwa.formCapacity": "Kapazität",
+  "jwa.queuedHint":
+    "ProvisioningRequest einreihen (Start, sobald Kapazität reserviert ist)",
+  "jwa.formAdvanced": "Erweitert",
+  "jwa.formWorkspaceVolume": "Workspace-Volume",
+  "jwa.formDataVolumes": "Daten-Volumes",
+  "jwa.formConfigurations": "Konfigurationen",
+  "jwa.noneAvailable": "keine verfügbar",
+  "jwa.formSharedMemory": "Gemeinsamer Speicher",
+  "jwa.shmMount": "einhängen:",
+  "jwa.launch": "Starten",
+});
+
 let tpuCatalog = [];
 let tablePoller = null;
 
@@ -72,7 +125,7 @@ async function loadNamespaceCatalogs() {
             pd.desc || pd.label
           )
         )
-      : "none available"
+      : KF.t("jwa.noneAvailable")
   );
 }
 
@@ -671,6 +724,7 @@ document.getElementById("ns-slot").append(
  * action buttons) AND the already-built volume panels (mode selects,
  * field labels) in place — refresh() alone left the form in the old
  * locale until a namespace change happened to rebuild it. */
+KF.localizeDocument();
 KF.onLocaleChange(() => {
   renderVolumeForms();
   refresh().catch(() => {});

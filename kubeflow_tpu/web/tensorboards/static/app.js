@@ -1,27 +1,88 @@
 /* TWA frontend on the shared KF lib: sortable table, confirm dialogs,
- * snackbars, details drawer with the logspath scheme explained. */
+ * snackbars, details drawer with the logspath scheme explained. All
+ * user-visible strings route through KF.t (reference: the tensorboards
+ * frontend's xlf translation pipeline). */
+
+KF.registerMessages("en", {
+  "twa.drawerTitle": "TensorBoard {name}",
+  "twa.logsPath": "Logs path",
+  "twa.source": "Source",
+  "twa.open": "Open",
+  "twa.schemeUnknown": "unknown",
+  "twa.schemePvc": "PVC subpath",
+  "twa.schemeGcs": "GCS bucket (XLA profiler traces)",
+  "twa.schemeS3": "S3 bucket",
+  "twa.schemePath": "path",
+  "twa.profilerHintPre": "gs:// paths serve XLA/TPU profiler traces captured with ",
+  "twa.profilerHintPost": " — open the Profile tab inside TensorBoard.",
+  "twa.events": "Events",
+  "twa.noEvents": "No events.",
+  "twa.deleteTitle": "Delete TensorBoard {name}?",
+  "twa.deleteMessage": "The server is removed; the logs themselves are kept.",
+  "twa.deleting": "Deleting {name}",
+  "twa.empty": "No TensorBoards in this namespace.",
+  "twa.fixName": "Fix the name first.",
+  "twa.creating": "Creating TensorBoard {name}",
+  "twa.title": "TensorBoards",
+  "twa.namespace": "namespace",
+  "twa.newTensorboard": "+ New TensorBoard",
+  "twa.formTitle": "New TensorBoard",
+  "twa.formName": "Name",
+  "twa.formLogspath": "Logs path",
+  "twa.formProfiler": "XLA profiler",
+  "twa.create": "Create",
+});
+KF.registerMessages("de", {
+  "twa.drawerTitle": "TensorBoard {name}",
+  "twa.logsPath": "Log-Pfad",
+  "twa.source": "Quelle",
+  "twa.open": "Öffnen",
+  "twa.schemeUnknown": "unbekannt",
+  "twa.schemePvc": "PVC-Unterpfad",
+  "twa.schemeGcs": "GCS-Bucket (XLA-Profiler-Traces)",
+  "twa.schemeS3": "S3-Bucket",
+  "twa.schemePath": "Pfad",
+  "twa.profilerHintPre": "gs://-Pfade liefern XLA/TPU-Profiler-Traces, aufgezeichnet mit ",
+  "twa.profilerHintPost": " — den Profile-Tab in TensorBoard öffnen.",
+  "twa.events": "Ereignisse",
+  "twa.noEvents": "Keine Ereignisse.",
+  "twa.deleteTitle": "TensorBoard {name} löschen?",
+  "twa.deleteMessage": "Der Server wird entfernt; die Logs selbst bleiben erhalten.",
+  "twa.deleting": "{name} wird gelöscht",
+  "twa.empty": "Keine TensorBoards in diesem Namespace.",
+  "twa.fixName": "Bitte zuerst den Namen korrigieren.",
+  "twa.creating": "TensorBoard {name} wird erstellt",
+  "twa.title": "TensorBoards",
+  "twa.namespace": "Namespace",
+  "twa.newTensorboard": "+ Neues TensorBoard",
+  "twa.formTitle": "Neues TensorBoard",
+  "twa.formName": "Name",
+  "twa.formLogspath": "Log-Pfad",
+  "twa.formProfiler": "XLA-Profiler",
+  "twa.create": "Erstellen",
+});
 
 let tablePoller = null;
 
 function schemeOf(logspath) {
-  if (!logspath) return "unknown";
-  if (logspath.startsWith("pvc://")) return "PVC subpath";
-  if (logspath.startsWith("gs://")) return "GCS bucket (XLA profiler traces)";
-  if (logspath.startsWith("s3://")) return "S3 bucket";
-  return "path";
+  if (!logspath) return KF.t("twa.schemeUnknown");
+  if (logspath.startsWith("pvc://")) return KF.t("twa.schemePvc");
+  if (logspath.startsWith("gs://")) return KF.t("twa.schemeGcs");
+  if (logspath.startsWith("s3://")) return KF.t("twa.schemeS3");
+  return KF.t("twa.schemePath");
 }
 
 function openDetails(tb) {
-  const drawer = KF.drawer(`TensorBoard ${tb.name}`);
+  const drawer = KF.drawer(KF.t("twa.drawerTitle", { name: tb.name }));
   const eventsHost = el("div", {});
   drawer.content.append(
     KF.detailsList([
-      ["Name", tb.name],
-      ["Status", KF.statusDot(tb.ready ? "ready" : "waiting", "")],
-      ["Logs path", tb.logspath],
-      ["Source", schemeOf(tb.logspath)],
+      [KF.t("table.name"), tb.name],
+      [KF.t("table.status"), KF.statusDot(tb.ready ? "ready" : "waiting", "")],
+      [KF.t("twa.logsPath"), tb.logspath],
+      [KF.t("twa.source"), schemeOf(tb.logspath)],
       [
-        "Open",
+        KF.t("twa.open"),
         el(
           "a",
           { href: KF.urls.tensorboard(ns.get(), tb.name), target: "_blank" },
@@ -32,16 +93,16 @@ function openDetails(tb) {
     el(
       "p",
       { class: "muted" },
-      "gs:// paths serve XLA/TPU profiler traces captured with ",
+      KF.t("twa.profilerHintPre"),
       el("code", {}, "jax.profiler"),
-      " — open the Profile tab inside TensorBoard."
+      KF.t("twa.profilerHintPost")
     ),
-    el("h4", {}, "Events"),
+    el("h4", {}, KF.t("twa.events")),
     eventsHost
   );
   api(`api/namespaces/${ns.get()}/tensorboards/${tb.name}/events`).then(
     (body) => KF.eventsTable(eventsHost, body.events),
-    () => eventsHost.append(el("p", { class: "muted" }, "No events."))
+    () => eventsHost.append(el("p", { class: "muted" }, KF.t("twa.noEvents")))
   );
 }
 
@@ -49,19 +110,21 @@ async function refresh() {
   const body = await api(`api/namespaces/${ns.get()}/tensorboards`);
   const columns = [
     {
-      title: "Status",
+      title: () => KF.t("table.status"),
       render: (tb) => statusDot(tb.ready ? "ready" : "waiting", ""),
       sortKey: (tb) => (tb.ready ? 0 : 1),
     },
-    { title: "Name", render: (tb) => tb.name, sortKey: (tb) => tb.name },
+    { title: () => KF.t("table.name"),
+      render: (tb) => tb.name, sortKey: (tb) => tb.name },
     {
-      title: "Logs path",
+      title: () => KF.t("twa.logsPath"),
       render: (tb) => tb.logspath,
       sortKey: (tb) => tb.logspath || "",
     },
-    { title: "Source", render: (tb) => schemeOf(tb.logspath) },
+    { title: () => KF.t("twa.source"),
+      render: (tb) => schemeOf(tb.logspath) },
     {
-      title: "Actions",
+      title: () => KF.t("table.actions"),
       render: (tb) =>
         el(
           "span",
@@ -73,22 +136,22 @@ async function refresh() {
               target: "_blank",
               onclick: (ev) => ev.stopPropagation(),
             },
-            "Open"
+            KF.t("twa.open")
           ),
           " ",
           KF.actionButton(
-            "Delete",
+            KF.t("action.delete"),
             () =>
               KF.confirmDialog({
-                title: `Delete TensorBoard ${tb.name}?`,
-                message: "The server is removed; the logs themselves are kept.",
+                title: KF.t("twa.deleteTitle", { name: tb.name }),
+                message: KF.t("twa.deleteMessage"),
               }).then(
                 (ok) =>
                   ok &&
                   api(`api/namespaces/${ns.get()}/tensorboards/${tb.name}`, {
                     method: "DELETE",
                   }).then(() => {
-                    KF.snackbar("Deleting " + tb.name);
+                    KF.snackbar(KF.t("twa.deleting", { name: tb.name }));
                     tablePoller.refresh();
                   }, showError)
               ),
@@ -99,7 +162,7 @@ async function refresh() {
   ];
   renderTable(document.getElementById("tb-table"), columns, body.tensorboards, {
     onRowClick: openDetails,
-    emptyText: "No TensorBoards in this namespace.",
+    emptyText: KF.t("twa.empty"),
   });
 }
 
@@ -116,7 +179,7 @@ document.getElementById("cancel-btn").addEventListener("click", () => {
 });
 document.getElementById("new-form").addEventListener("submit", (ev) => {
   ev.preventDefault();
-  if (!nameCheck()) return KF.snackbar("Fix the name first.", "error");
+  if (!nameCheck()) return KF.snackbar(KF.t("twa.fixName"), "error");
   const form = new FormData(ev.target);
   api(`api/namespaces/${ns.get()}/tensorboards`, {
     method: "POST",
@@ -127,7 +190,7 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
     }),
   }).then(() => {
     document.getElementById("new-form-card").style.display = "none";
-    KF.snackbar("Creating TensorBoard " + form.get("name"));
+    KF.snackbar(KF.t("twa.creating", { name: form.get("name") }));
     tablePoller.refresh();
   }, showError);
 });
@@ -156,7 +219,11 @@ document.getElementById("ns-slot").append(
   namespacePicker(() => {
     tablePoller.refresh();
     loadLogspathSuggestions();
-  })
+  }),
+  " ",
+  KF.localePicker()
 );
+KF.localizeDocument();
+KF.onLocaleChange(() => refresh().catch(() => {}));
 tablePoller = poll(refresh);
 loadLogspathSuggestions();

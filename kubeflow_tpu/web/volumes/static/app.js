@@ -2,50 +2,129 @@
  *
  * The reference's Angular volumes app on the shared KF lib: sortable
  * table, confirm dialogs, snackbars, and a per-PVC drawer with details,
- * live events (backend /pvcs/{name}/events) and YAML.
- */
+ * live events (backend /pvcs/{name}/events) and YAML. All user-visible
+ * strings route through KF.t (reference: the volumes frontend's xlf
+ * translation pipeline, i18n/fr/messages.fr.xlf). */
+
+KF.registerMessages("en", {
+  "vwa.drawerTitle": "Volume {name}",
+  "vwa.tabOverview": "Overview",
+  "vwa.tabEvents": "Events",
+  "vwa.capacity": "Capacity",
+  "vwa.accessModes": "Access modes",
+  "vwa.storageClass": "Storage class",
+  "vwa.classDefault": "default",
+  "vwa.usedBy": "Used by",
+  "vwa.usedByNothing": "nothing",
+  "vwa.viewer": "Viewer",
+  "vwa.viewerOpen": "open",
+  "vwa.viewerStarting": "starting…",
+  "vwa.viewerNone": "none",
+  "vwa.colSize": "Size",
+  "vwa.colModes": "Modes",
+  "vwa.colUsedBy": "Used by",
+  "vwa.browse": "Browse",
+  "vwa.viewerStartingBtn": "Viewer starting…",
+  "vwa.openViewer": "Open viewer",
+  "vwa.closeViewer": "Close viewer",
+  "vwa.startingViewerFor": "Starting viewer for {name}",
+  "vwa.deleteTitle": "Delete volume {name}?",
+  "vwa.deleteMessage": "All data on the volume is permanently removed.",
+  "vwa.deleting": "Deleting {name}",
+  "vwa.empty": "No volumes in this namespace.",
+  "vwa.fixName": "Fix the volume name first.",
+  "vwa.creating": "Creating volume {name}",
+  "vwa.title": "Volumes",
+  "vwa.namespace": "namespace",
+  "vwa.newVolume": "+ New volume",
+  "vwa.formTitle": "New volume",
+  "vwa.formName": "Name",
+  "vwa.formSize": "Size",
+  "vwa.formAccessMode": "Access mode",
+  "vwa.create": "Create",
+  "vwa.loading": "Loading…",
+});
+KF.registerMessages("de", {
+  "vwa.drawerTitle": "Volume {name}",
+  "vwa.tabOverview": "Übersicht",
+  "vwa.tabEvents": "Ereignisse",
+  "vwa.capacity": "Kapazität",
+  "vwa.accessModes": "Zugriffsmodi",
+  "vwa.storageClass": "Speicherklasse",
+  "vwa.classDefault": "Standard",
+  "vwa.usedBy": "Verwendet von",
+  "vwa.usedByNothing": "nichts",
+  "vwa.viewer": "Viewer",
+  "vwa.viewerOpen": "öffnen",
+  "vwa.viewerStarting": "startet…",
+  "vwa.viewerNone": "keiner",
+  "vwa.colSize": "Größe",
+  "vwa.colModes": "Modi",
+  "vwa.colUsedBy": "Verwendet von",
+  "vwa.browse": "Durchsuchen",
+  "vwa.viewerStartingBtn": "Viewer startet…",
+  "vwa.openViewer": "Viewer öffnen",
+  "vwa.closeViewer": "Viewer schließen",
+  "vwa.startingViewerFor": "Viewer für {name} wird gestartet",
+  "vwa.deleteTitle": "Volume {name} löschen?",
+  "vwa.deleteMessage": "Alle Daten auf dem Volume werden endgültig entfernt.",
+  "vwa.deleting": "{name} wird gelöscht",
+  "vwa.empty": "Keine Volumes in diesem Namespace.",
+  "vwa.fixName": "Bitte zuerst den Volume-Namen korrigieren.",
+  "vwa.creating": "Volume {name} wird erstellt",
+  "vwa.title": "Volumes",
+  "vwa.namespace": "Namespace",
+  "vwa.newVolume": "+ Neues Volume",
+  "vwa.formTitle": "Neues Volume",
+  "vwa.formName": "Name",
+  "vwa.formSize": "Größe",
+  "vwa.formAccessMode": "Zugriffsmodus",
+  "vwa.create": "Erstellen",
+  "vwa.loading": "Lädt…",
+});
 
 let tablePoller = null;
 
 function openDetails(p) {
-  const drawer = KF.drawer(`Volume ${p.name}`);
+  const drawer = KF.drawer(KF.t("vwa.drawerTitle", { name: p.name }));
   const tabHost = el("div", {});
   drawer.content.append(tabHost);
   const tabs = KF.tabs(tabHost, [
     {
-      label: "Overview",
+      label: KF.t("vwa.tabOverview"),
       render: (pane) => {
         pane.append(
           KF.detailsList([
-            ["Name", p.name],
-            ["Capacity", p.capacity || "—"],
-            ["Access modes", (p.modes || []).join(", ")],
-            ["Storage class", p.class || "default"],
-            ["Status", p.status],
+            [KF.t("table.name"), p.name],
+            [KF.t("vwa.capacity"), p.capacity || "—"],
+            [KF.t("vwa.accessModes"), (p.modes || []).join(", ")],
+            [KF.t("vwa.storageClass"), p.class || KF.t("vwa.classDefault")],
+            [KF.t("table.status"), p.status],
             [
-              "Used by",
+              KF.t("vwa.usedBy"),
               (p.usedBy || []).length
                 ? el(
                     "span",
                     {},
                     p.usedBy.map((name) => el("span", { class: "chip" }, name))
                   )
-                : "nothing",
+                : KF.t("vwa.usedByNothing"),
             ],
             [
-              "Viewer",
+              KF.t("vwa.viewer"),
               p.viewer
                 ? p.viewer.ready && p.viewer.url
-                  ? el("a", { href: p.viewer.url, target: "_blank" }, "open")
-                  : "starting…"
-                : "none",
+                  ? el("a", { href: p.viewer.url, target: "_blank" },
+                       KF.t("vwa.viewerOpen"))
+                  : KF.t("vwa.viewerStarting")
+                : KF.t("vwa.viewerNone"),
             ],
           ])
         );
       },
     },
     {
-      label: "Events",
+      label: KF.t("vwa.tabEvents"),
       render: (pane) => {
         const host = el("div", {});
         pane.append(host);
@@ -67,23 +146,26 @@ function openDetails(p) {
 async function refresh() {
   const body = await api(`api/namespaces/${ns.get()}/pvcs`);
   const columns = [
-    { title: "Name", render: (p) => p.name, sortKey: (p) => p.name },
+    { title: () => KF.t("table.name"),
+      render: (p) => p.name, sortKey: (p) => p.name },
     {
-      title: "Size",
+      title: () => KF.t("vwa.colSize"),
       render: (p) => p.capacity || "—",
       sortKey: (p) => p.capacity || "",
     },
-    { title: "Modes", render: (p) => (p.modes || []).join(", ") },
-    { title: "Status", render: (p) => p.status, sortKey: (p) => p.status },
+    { title: () => KF.t("vwa.colModes"),
+      render: (p) => (p.modes || []).join(", ") },
+    { title: () => KF.t("table.status"),
+      render: (p) => p.status, sortKey: (p) => p.status },
     {
-      title: "Used by",
+      title: () => KF.t("vwa.colUsedBy"),
       render: (p) =>
         (p.usedBy || []).length
           ? p.usedBy.map((name) => el("span", { class: "chip" }, name))
           : "—",
     },
     {
-      title: "Actions",
+      title: () => KF.t("table.actions"),
       render: (p) =>
         el(
           "span",
@@ -96,22 +178,24 @@ async function refresh() {
                   target: "_blank",
                   onclick: (ev) => ev.stopPropagation(),
                 },
-                "Browse"
+                KF.t("vwa.browse")
               )
             : KF.actionButton(
-                p.viewer ? "Viewer starting…" : "Open viewer",
+                p.viewer ? KF.t("vwa.viewerStartingBtn")
+                         : KF.t("vwa.openViewer"),
                 () =>
                   api(`api/namespaces/${ns.get()}/viewers`, {
                     method: "POST",
                     body: JSON.stringify({ pvc: p.name }),
                   }).then(() => {
-                    KF.snackbar("Starting viewer for " + p.name);
+                    KF.snackbar(
+                      KF.t("vwa.startingViewerFor", { name: p.name }));
                     tablePoller.refresh();
                   }, showError)
               ),
           " ",
           p.viewer
-            ? KF.actionButton("Close viewer", () =>
+            ? KF.actionButton(KF.t("vwa.closeViewer"), () =>
                 api(`api/namespaces/${ns.get()}/viewers/${p.viewer.name}`, {
                   method: "DELETE",
                 }).then(() => tablePoller.refresh(), showError)
@@ -119,18 +203,18 @@ async function refresh() {
             : "",
           " ",
           KF.actionButton(
-            "Delete",
+            KF.t("action.delete"),
             () =>
               KF.confirmDialog({
-                title: `Delete volume ${p.name}?`,
-                message: "All data on the volume is permanently removed.",
+                title: KF.t("vwa.deleteTitle", { name: p.name }),
+                message: KF.t("vwa.deleteMessage"),
               }).then(
                 (ok) =>
                   ok &&
                   api(`api/namespaces/${ns.get()}/pvcs/${p.name}`, {
                     method: "DELETE",
                   }).then(() => {
-                    KF.snackbar("Deleting " + p.name);
+                    KF.snackbar(KF.t("vwa.deleting", { name: p.name }));
                     tablePoller.refresh();
                   }, showError)
               ),
@@ -141,7 +225,7 @@ async function refresh() {
   ];
   renderTable(document.getElementById("pvc-table"), columns, body.pvcs, {
     onRowClick: openDetails,
-    emptyText: "No volumes in this namespace.",
+    emptyText: KF.t("vwa.empty"),
   });
 }
 
@@ -158,7 +242,7 @@ document.getElementById("cancel-btn").addEventListener("click", () => {
 });
 document.getElementById("new-form").addEventListener("submit", (ev) => {
   ev.preventDefault();
-  if (!nameCheck()) return KF.snackbar("Fix the volume name first.", "error");
+  if (!nameCheck()) return KF.snackbar(KF.t("vwa.fixName"), "error");
   const form = new FormData(ev.target);
   api(`api/namespaces/${ns.get()}/pvcs`, {
     method: "POST",
@@ -169,12 +253,14 @@ document.getElementById("new-form").addEventListener("submit", (ev) => {
     }),
   }).then(() => {
     document.getElementById("new-form-card").style.display = "none";
-    KF.snackbar("Creating volume " + form.get("name"));
+    KF.snackbar(KF.t("vwa.creating", { name: form.get("name") }));
     tablePoller.refresh();
   }, showError);
 });
 
 document
   .getElementById("ns-slot")
-  .append(namespacePicker(() => tablePoller.refresh()));
+  .append(namespacePicker(() => tablePoller.refresh()), " ", KF.localePicker());
+KF.localizeDocument();
+KF.onLocaleChange(() => refresh().catch(() => {}));
 tablePoller = poll(refresh);

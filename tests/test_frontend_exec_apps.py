@@ -247,3 +247,74 @@ def test_dashboard_contributor_management():
         b.click(remove)
         h.settle()
         assert "bob@example.com" not in b.text(".kf-drawer")
+
+
+# ---- i18n (VERDICT r4 #5: every SPA, not just JWA) --------------------------
+
+
+def test_vwa_locale_switch(vwa):
+    """VWA: picker → de → table headers, static chrome (data-i18n), and
+    row actions re-render in German; switching back restores English."""
+    b = vwa.browser
+    vwa.kube_create("PersistentVolumeClaim", {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "data", "namespace": "team"},
+        "spec": {"accessModes": ["ReadWriteMany"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+    })
+    vwa.poll_ui()
+    assert "Open viewer" in b.text("#pvc-table")
+
+    b.change("select.kf-locale-picker", "de")
+    vwa.poll_ui()
+    table = b.text("#pvc-table")
+    assert "Viewer öffnen" in table            # action button
+    assert "Größe" in table                    # column header
+    assert "Open viewer" not in table
+    assert "Neues Volume" in b.text("#new-btn")       # static chrome
+    assert "Abbrechen" in b.text("#cancel-btn")
+    assert b.local_storage.get("kf.locale") == "de"
+
+    b.change("select.kf-locale-picker", "en")
+    vwa.poll_ui()
+    assert "Open viewer" in b.text("#pvc-table")
+    assert "+ New volume" in b.text("#new-btn")
+
+
+def test_twa_locale_switch(twa):
+    b = twa.browser
+    assert "No TensorBoards in this namespace." in b.text("#tb-table")
+    b.change("select.kf-locale-picker", "de")
+    twa.poll_ui()
+    assert "Keine TensorBoards in diesem Namespace." in b.text("#tb-table")
+    assert "Neues TensorBoard" in b.text("#new-btn")
+    assert "Log-Pfad" in b.text("#new-form-card")      # form label
+    b.change("select.kf-locale-picker", "en")
+    twa.poll_ui()
+    assert "No TensorBoards in this namespace." in b.text("#tb-table")
+
+
+def test_dashboard_locale_switch():
+    with JsWebHarness(create_dashboard,
+                      extra_controllers=(setup_profile_controller,)) as h:
+        b = h.browser
+        b.load("/")
+        b.click("#register-btn")
+        h.settle()
+        b.advance(10000)
+        h.settle()
+        b.advance(10000)
+        assert "My namespaces" in b.text("main")
+        assert "Manage" in b.text("#ns-table")
+
+        b.change("select.kf-locale-picker", "de")
+        h.settle()
+        b.advance(10000)  # poller re-render under the new locale
+        h.settle()
+        text = b.text("main")
+        assert "Meine Namespaces" in text          # static chrome
+        assert "TPU-Nutzung" in text
+        table = b.text("#ns-table")
+        assert "Verwalten" in table                # table action
+        assert "Rolle" in table                    # column header
+        assert "Chips angefordert" in b.text("#tpu-table")

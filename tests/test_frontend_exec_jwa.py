@@ -395,6 +395,9 @@ def test_locale_switch_rerenders_table_headers(jwa):
     vol_form = b.query("#data-volumes-slot")
     assert "Neues Volume" in vol_form.text_content(), (
         "volume form stuck in the previous locale after a locale switch")
+    # Static chrome (data-i18n + KF.localizeDocument) follows as well.
+    assert "+ Neues Notebook" in b.text("#new-btn")
+    assert "Notebook-Server" in b.text("h1")
 
     # Status labels and action buttons localize on live rows too.
     b.click("#new-btn")
