@@ -210,6 +210,18 @@ function makeElement(tagName, doc) {
     get firstChild() {
       return el.childNodes[0] || null;
     },
+    get nextElementSibling() {
+      if (!el.parentNode) return null;
+      const sibs = el.parentNode.childNodes.filter((c) => c.nodeType === 1);
+      const at = sibs.indexOf(el);
+      return at >= 0 && sibs[at + 1] ? sibs[at + 1] : null;
+    },
+    get previousElementSibling() {
+      if (!el.parentNode) return null;
+      const sibs = el.parentNode.childNodes.filter((c) => c.nodeType === 1);
+      const at = sibs.indexOf(el);
+      return at > 0 ? sibs[at - 1] : null;
+    },
     get textContent() {
       let out = "";
       for (const c of el.childNodes) out += c.textContent;

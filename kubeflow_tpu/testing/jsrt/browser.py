@@ -191,6 +191,12 @@ class Browser:
         self.check_rejections()
         return result
 
+    def focus(self, target) -> None:
+        el = self.query(target) if isinstance(target, str) else target
+        if el is None:
+            raise BrowserError(f"no element matches {target!r}")
+        self.document._active_element = el
+
     def set_value(self, selector: str, value: str, *, fire="input") -> None:
         el = self.query(selector)
         if el is None:
@@ -215,13 +221,15 @@ class Browser:
         self.check_rejections()
         return result
 
-    def keydown(self, key: str, selector: str | None = None) -> None:
+    def keydown(self, key: str, selector=None, shift: bool = False) -> None:
         target = self.document.body
         if selector is not None:
-            target = self.query(selector)
+            target = (self.query(selector) if isinstance(selector, str)
+                      else selector)
             if target is None:
                 raise BrowserError(f"no element matches {selector!r}")
-        self.document.dispatch(target, dom.Event("keydown", {"key": key}))
+        self.document.dispatch(
+            target, dom.Event("keydown", {"key": key, "shiftKey": shift}))
 
     def eval(self, src: str):
         """Evaluate a JS expression/program for assertions; returns the

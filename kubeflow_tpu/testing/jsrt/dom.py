@@ -255,6 +255,17 @@ class Element(DomNode):
             return self.parent if self.parent is not None else null
         if name == "firstChild":
             return self.child_nodes[0] if self.child_nodes else null
+        if name in ("nextElementSibling", "previousElementSibling"):
+            parent = self.parent
+            if parent is None:
+                return null
+            sibs = [c for c in parent.child_nodes if isinstance(c, Element)]
+            try:
+                at = sibs.index(self)
+            except ValueError:  # pragma: no cover - detached node
+                return null
+            at += 1 if name == "nextElementSibling" else -1
+            return sibs[at] if 0 <= at < len(sibs) else null
         if name == "options":
             return JSArray([c for c in self.walk()
                             if isinstance(c, Element) and c.tag == "option"])

@@ -391,6 +391,20 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
                   const within = ev.target && ev.target.closest &&
                     ev.target.closest("button, a, input, select, textarea");
                   if (ev.key === "Enter" && !within) opts.onRowClick(row);
+                  /* Arrow-key roving between data rows (WAI-ARIA grid
+                   * navigation): focus moves to the adjacent clickable
+                   * row without tabbing through its action buttons. */
+                  if ((ev.key === "ArrowDown" || ev.key === "ArrowUp") &&
+                      !within) {
+                    const tr = ev.target.closest("tr");
+                    const sib = tr && (ev.key === "ArrowDown"
+                      ? tr.nextElementSibling
+                      : tr.previousElementSibling);
+                    if (sib && sib.focus) {
+                      ev.preventDefault();
+                      sib.focus();
+                    }
+                  }
                 },
               }
             : {},
@@ -589,6 +603,29 @@ KF._popModal = function (token) {
   if (at >= 0) KF._modalStack.splice(at, 1);
 };
 
+/* Modal focus trap (WAI-ARIA dialog pattern): Tab/Shift+Tab cycle
+ * within the panel instead of escaping into the aria-modal-inerted page
+ * behind it. Call from the modal's keydown handler. */
+KF._trapTab = function (panel, ev) {
+  if (ev.key !== "Tab") return;
+  const items = Array.from(
+    panel.querySelectorAll(
+      "button, a, input, select, textarea, [tabindex]")
+  ).filter((n) => !n.disabled && n.getAttribute("tabindex") !== "-1");
+  if (!items.length) return;
+  const first = items[0];
+  const last = items[items.length - 1];
+  const active = document.activeElement;
+  const inside = panel.contains(active);
+  if (ev.shiftKey && (!inside || active === first)) {
+    ev.preventDefault();
+    last.focus();
+  } else if (!ev.shiftKey && (!inside || active === last)) {
+    ev.preventDefault();
+    first.focus();
+  }
+};
+
 KF.confirmDialog = function ({ title, message, confirmText }) {
   return new Promise((resolve) => {
     const overlay = KF.el("div", { class: "kf-overlay" });
@@ -605,7 +642,9 @@ KF.confirmDialog = function ({ title, message, confirmText }) {
       resolve(result);
     }
     function onKey(ev) {
-      if (ev.key === "Escape" && KF._isTopModal(token)) close(false);
+      if (!KF._isTopModal(token)) return;
+      if (ev.key === "Escape") close(false);
+      else KF._trapTab(panel, ev);
     }
     document.addEventListener("keydown", onKey);
     KF._modalStack.push(token);
@@ -614,22 +653,21 @@ KF.confirmDialog = function ({ title, message, confirmText }) {
       { class: "danger", onclick: () => close(true) },
       confirmText || KF.t("action.delete")
     );
-    overlay.append(
+    const panel = KF.el(
+      "div",
+      { class: "kf-dialog", role: "dialog", "aria-modal": "true",
+        "aria-labelledby": titleId },
+      KF.el("h3", { id: titleId }, title),
+      KF.el("p", {}, message),
       KF.el(
         "div",
-        { class: "kf-dialog", role: "dialog", "aria-modal": "true",
-          "aria-labelledby": titleId },
-        KF.el("h3", { id: titleId }, title),
-        KF.el("p", {}, message),
-        KF.el(
-          "div",
-          { class: "kf-dialog-actions" },
-          KF.el("button", { onclick: () => close(false) },
-                KF.t("common.cancel")),
-          confirmBtn
-        )
+        { class: "kf-dialog-actions" },
+        KF.el("button", { onclick: () => close(false) },
+              KF.t("common.cancel")),
+        confirmBtn
       )
     );
+    overlay.append(panel);
     overlay.addEventListener("click", (ev) => {
       if (ev.target === overlay) close(false);
     });
@@ -778,7 +816,9 @@ KF.yamlEditDialog = function ({ title, initial = "", submitText, onSubmit }) {
       resolve(result);
     }
     function onKey(ev) {
-      if (ev.key === "Escape" && KF._isTopModal(token)) close(false);
+      if (!KF._isTopModal(token)) return;
+      if (ev.key === "Escape") close(false);
+      else KF._trapTab(panel, ev);
     }
     async function submit() {
       if (pending) return; // double-click guard while onSubmit is in flight
@@ -801,23 +841,22 @@ KF.yamlEditDialog = function ({ title, initial = "", submitText, onSubmit }) {
     const submitBtn = KF.el(
       "button", { class: "primary", onclick: submit }, submitText
     );
-    overlay.append(
+    const panel = KF.el(
+      "div",
+      { class: "kf-dialog kf-dialog-wide", role: "dialog",
+        "aria-modal": "true", "aria-labelledby": titleId },
+      KF.el("h3", { id: titleId }, title),
+      editor.root,
+      errorBox,
       KF.el(
         "div",
-        { class: "kf-dialog kf-dialog-wide", role: "dialog",
-          "aria-modal": "true", "aria-labelledby": titleId },
-        KF.el("h3", { id: titleId }, title),
-        editor.root,
-        errorBox,
-        KF.el(
-          "div",
-          { class: "kf-dialog-actions" },
-          KF.el("button", { onclick: () => close(false) },
-                KF.t("common.cancel")),
-          submitBtn
-        )
+        { class: "kf-dialog-actions" },
+        KF.el("button", { onclick: () => close(false) },
+              KF.t("common.cancel")),
+        submitBtn
       )
     );
+    overlay.append(panel);
     overlay.addEventListener("click", (ev) => {
       if (ev.target === overlay) close(false);
     });
@@ -1049,6 +1088,7 @@ KF.drawer = function (title) {
   const opener = document.activeElement || null;
   function onDrawerKey(ev) {
     if (ev.key === "Escape") close();
+    else KF._trapTab(panel, ev);
   }
   function close() {
     document.removeEventListener("keydown", onDrawerKey);
