@@ -1,19 +1,34 @@
 #!/usr/bin/env node
-/* Node executor for a shipped frontend's load-and-first-poll flow.
+/* Node executor for a shipped frontend flow (load, interact, observe).
  *
  * Usage:
  *   node app_flow.js --html <index.html> --scripts <a.js,b.js> \
  *       --fixtures <fixtures.json> [--observe <selector>] \
- *       [--storage k=v,...] [--settle-ms 200]
+ *       [--actions <actions.json>] [--storage k=v,...] [--settle-ms 120]
  *
  * Loads the real index.html into the dom_adapter environment, executes
  * the real shipped scripts (kubeflow.js + app.js — the same files jsrt
  * executes in tests/test_frontend_exec_*.py), replays the recorded HTTP
- * fixtures through fetch, lets timers/microtasks settle, then prints one
- * JSON line of observables:
+ * fixtures through fetch (arrays replay per-key in order), runs the
+ * scripted interaction sequence, lets timers/microtasks settle, then
+ * prints one JSON line of observables:
  *   { observed: <textContent of --observe>, docText, requests: [...] }
  * The Python differential test compares these against the jsrt run that
- * produced the fixtures.
+ * produced the fixtures and executed the SAME action list.
+ *
+ * Action ops (mirrored by the jsrt executor in
+ * tests/test_node_frontend_differential.py):
+ *   {op:"click", sel, index?}        activation click (checkbox/radio
+ *                                    pre-toggle like a real browser)
+ *   {op:"clickText", sel, text}      click the element whose textContent
+ *                                    equals `text`
+ *   {op:"set", sel, value}           set a control's value + input event
+ *   {op:"change", sel, value?}       set value (if given) + change event
+ *   {op:"submit", sel}               dispatch submit on the form
+ *   {op:"keydown", key, sel?, shift?}
+ *   {op:"js", code}                  run a snippet in the page context
+ *                                    (both engines share the code path)
+ *   {op:"settle"}                    drain timers/promises
  */
 "use strict";
 
@@ -30,10 +45,14 @@ const htmlPath = arg("html");
 const scriptPaths = (arg("scripts") || "").split(",").filter(Boolean);
 const fixturesPath = arg("fixtures");
 const observeSel = arg("observe", "body");
-const settleMs = parseInt(arg("settle-ms", "200"), 10);
+const settleMs = parseInt(arg("settle-ms", "120"), 10);
+const actionsPath = arg("actions", "");
 const storagePairs = (arg("storage") || "").split(",").filter(Boolean);
 
 const fixtures = JSON.parse(fs.readFileSync(fixturesPath, "utf8"));
+const actions = actionsPath
+  ? JSON.parse(fs.readFileSync(actionsPath, "utf8"))
+  : [];
 const requests = [];
 const env = makeEnvironment({ fixtures, requests });
 
@@ -75,7 +94,65 @@ for (const p of scriptPaths) {
   vm.runInContext(fs.readFileSync(p, "utf8"), context, { filename: p });
 }
 
-setTimeout(() => {
+function sleep(ms) {
+  return new Promise((resolve) => setTimeout(resolve, ms));
+}
+
+function pick(a) {
+  let els = env.document.querySelectorAll(a.sel);
+  if (a.op === "clickText") {
+    els = els.filter((e) => e.textContent === a.text);
+  }
+  const el = els[a.index || 0];
+  if (!el) throw new Error("no element for action " + JSON.stringify(a));
+  return el;
+}
+
+async function runAction(a) {
+  if (a.op === "settle") {
+    await sleep(settleMs);
+    return;
+  }
+  if (a.op === "js") {
+    vm.runInContext(a.code, context, { filename: "<action>" });
+    await sleep(10);
+    return;
+  }
+  if (a.op === "keydown") {
+    const target = a.sel ? pick(a) : env.document.body;
+    env.dispatch(target, "keydown", { key: a.key, shiftKey: !!a.shift });
+  } else if (a.op === "set") {
+    const el = pick(a);
+    el.value = a.value;
+    env.dispatch(el, "input", { target: el });
+  } else if (a.op === "change") {
+    const el = pick(a);
+    if (a.value !== undefined && a.value !== null) el.value = a.value;
+    env.dispatch(el, "change", { target: el });
+  } else if (a.op === "submit") {
+    env.dispatch(pick(a), "submit", {});
+  } else if (a.op === "click" || a.op === "clickText") {
+    const el = pick(a);
+    // Browser pre-dispatch activation: checkbox toggles / radio sets
+    // BEFORE listeners run (same as jsrt's dom.activate).
+    if (el.tagName === "INPUT") {
+      const type = (el.attrs.type || "text").toLowerCase();
+      if (type === "checkbox") el.checked = !el.checked;
+      else if (type === "radio") el.checked = true;
+    }
+    env.dispatch(el, "click", { target: el });
+  } else {
+    throw new Error("unknown action op " + a.op);
+  }
+  await sleep(10); // drain the promise chains the event kicked off
+}
+
+async function main() {
+  await sleep(settleMs); // page-load fetches settle
+  for (const a of actions) {
+    await runAction(a);
+  }
+  await sleep(settleMs);
   const target = env.document.querySelector(observeSel) || env.document.body;
   process.stdout.write(
     JSON.stringify({
@@ -85,4 +162,9 @@ setTimeout(() => {
     }) + "\n"
   );
   process.exit(0);
-}, settleMs);
+}
+
+main().catch((err) => {
+  process.stderr.write(String((err && err.stack) || err) + "\n");
+  process.exit(1);
+});

@@ -548,7 +548,14 @@ function makeEnvironment(opts) {
       method, path, headers: (options && options.headers) || {},
     });
     const key = method + " " + path;
-    const hit = fixtures[key] !== undefined ? fixtures[key] : fixtures[path];
+    let hit = fixtures[key] !== undefined ? fixtures[key] : fixtures[path];
+    // Sequenced fixtures: an ARRAY per key replays responses in recorded
+    // order (a created resource's list changes between polls); the last
+    // entry repeats once the queue is exhausted so extra polls converge
+    // on the steady state, mirroring the jsrt run.
+    if (Array.isArray(hit)) {
+      hit = hit.length > 1 ? hit.shift() : hit[0];
+    }
     return Promise.resolve().then(() => {
       if (hit === undefined) {
         throw new TypeError("fetch failed: no fixture for " + key);
