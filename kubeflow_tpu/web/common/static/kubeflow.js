@@ -27,7 +27,7 @@ const KF = {};
 KF.i18n = {
   locale: "en",
   fallback: "en",
-  catalogs: { en: {}, de: {} },
+  catalogs: { en: {}, de: {}, fr: {} },
   listeners: [],
   available: function () {
     return Object.keys(KF.i18n.catalogs).sort();
@@ -177,6 +177,34 @@ KF.registerMessages("de", {
   "common.apply": "Übernehmen",
   "common.chipPlaceholder": "Wert eingeben, Enter drücken",
   "jwa.empty": "Keine Notebook-Server in diesem Namespace.",
+});
+/* French — the locale the reference actually ships xlf catalogs for
+ * (volumes/frontend/i18n/fr/messages.fr.xlf). */
+KF.registerMessages("fr", {
+  "status.ready": "En cours",
+  "status.waiting": "Démarrage",
+  "status.warning": "Erreur",
+  "status.terminating": "Suppression",
+  "status.stopped": "Arrêté",
+  "table.status": "Statut",
+  "table.name": "Nom",
+  "table.image": "Image",
+  "table.cpu": "CPU",
+  "table.memory": "Mémoire",
+  "table.tpu": "TPU",
+  "table.age": "Âge",
+  "table.lastActivity": "Dernière activité",
+  "table.actions": "Actions",
+  "action.start": "Démarrer",
+  "action.stop": "Arrêter",
+  "action.delete": "Supprimer",
+  "action.connect": "Connecter",
+  "common.none": "aucun",
+  "common.cancel": "Annuler",
+  "common.loading": "Chargement…",
+  "common.apply": "Appliquer",
+  "common.chipPlaceholder": "saisir une valeur, puis Entrée",
+  "jwa.empty": "Aucun serveur de notebooks dans ce namespace.",
 });
 
 /* Restore the persisted locale (after the catalogs exist). */
@@ -1581,6 +1609,22 @@ KF.registerMessages("de", {
   "volumes.noPvcs": "keine PVCs in diesem Namespace",
   "volumes.addNew": "+ Neues Volume",
   "volumes.attachExisting": "+ Vorhandenes Volume anhängen",
+});
+KF.registerMessages("fr", {
+  "volumes.typeNew": "Nouveau volume",
+  "volumes.typeExisting": "Volume existant",
+  "volumes.typeNone": "Aucun volume",
+  "volumes.noneHint": "Le serveur utilise uniquement un stockage éphémère.",
+  "volumes.name": "Nom",
+  "volumes.size": "Taille",
+  "volumes.class": "Classe de stockage",
+  "volumes.defaultClass": "défaut du cluster ({name})",
+  "volumes.accessMode": "Mode d'accès",
+  "volumes.mount": "Chemin de montage",
+  "volumes.existingPvc": "PVC",
+  "volumes.noPvcs": "aucun PVC dans ce namespace",
+  "volumes.addNew": "+ Ajouter un volume",
+  "volumes.attachExisting": "+ Attacher un volume existant",
 });
 
 KF.chipsInput = function (initial, onChange, { placeholder, validate } = {}) {

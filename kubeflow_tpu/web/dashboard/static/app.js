@@ -86,6 +86,50 @@ KF.registerMessages("de", {
   "cd.selectNamespace": "Oben einen Namespace auswählen.",
   "cd.ago": " zuvor",
 });
+KF.registerMessages("fr", {
+  "cd.metricTpuDuty": "Taux d'occupation TPU",
+  "cd.metricNodeCpu": "CPU du nœud",
+  "cd.metricPodMem": "Mémoire des pods",
+  "cd.noQuota": "pas de quota",
+  "cd.quota": "quota {n}",
+  "cd.chipsRequested": "{n} puces demandées dans {ns} ({quota})",
+  "cd.noTpuPods": "Aucun pod TPU en cours.",
+  "cd.noRecentEvents": "Aucun événement récent dans {ns}.",
+  "cd.loading": "chargement…",
+  "cd.noDataInRange": "aucune donnée sur la période",
+  "cd.noMetricsBackend":
+    "aucun backend de métriques configuré (définir PROMETHEUS_URL)",
+  "cd.latest": "dernier : {value} ({label})",
+  "cd.metricsUnavailable": "métriques indisponibles : {message}",
+  "cd.contributorsTitle": "Contributeurs — {ns}",
+  "cd.loadingCap": "Chargement…",
+  "cd.remove": "Retirer",
+  "cd.noContributors": "Aucun contributeur pour l'instant.",
+  "cd.contributorsHint":
+    "Les contributeurs ont un accès en écriture à toutes les " +
+    "applications de ce namespace.",
+  "cd.contributorAdded": "Contributeur ajouté",
+  "cd.add": "Ajouter",
+  "cd.colNamespace": "Namespace",
+  "cd.colRole": "Rôle",
+  "cd.colContributors": "Contributeurs",
+  "cd.manage": "Gérer",
+  "cd.emptyNamespaces":
+    "Aucun namespace — enregistrez un groupe de travail ci-dessous.",
+  "cd.workgroupCreated": "Groupe de travail créé",
+  "cd.title": "Kubeflow TPU",
+  "cd.welcome": "Bienvenue",
+  "cd.noWorkspaceYet":
+    "Vous n'avez pas encore de namespace d'espace de travail.",
+  "cd.createMyNamespace": "Créer mon namespace",
+  "cd.applications": "Applications",
+  "cd.myNamespaces": "Mes namespaces",
+  "cd.tpuUsage": "Utilisation TPU",
+  "cd.recentActivity": "Activité récente",
+  "cd.clusterMetrics": "Métriques du cluster",
+  "cd.selectNamespace": "Sélectionnez un namespace ci-dessus.",
+  "cd.ago": " plus tôt",
+});
 
 const METRIC_PANELS = [
   { type: "tpu_duty", labelKey: "cd.metricTpuDuty" },

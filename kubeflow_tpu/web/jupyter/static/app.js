@@ -59,6 +59,32 @@ KF.registerMessages("de", {
   "jwa.shmMount": "einhängen:",
   "jwa.launch": "Starten",
 });
+KF.registerMessages("fr", {
+  "jwa.title": "Serveurs de notebooks",
+  "jwa.namespace": "namespace",
+  "jwa.fromYaml": "Depuis YAML",
+  "jwa.fromYamlTitle": "Créer un Notebook à partir d'un manifeste brut",
+  "jwa.newNotebook": "+ Nouveau notebook",
+  "jwa.formTitle": "Nouveau serveur de notebooks",
+  "jwa.formName": "Nom",
+  "jwa.formServerType": "Type de serveur",
+  "jwa.formImage": "Image",
+  "jwa.formCustomImage": "Image personnalisée",
+  "jwa.formTopology": "Topologie",
+  "jwa.formSlices": "Slices",
+  "jwa.formCapacity": "Capacité",
+  "jwa.queuedHint":
+    "mettre en file une ProvisioningRequest (démarre quand la capacité " +
+    "est réservée)",
+  "jwa.formAdvanced": "Avancé",
+  "jwa.formWorkspaceVolume": "Volume d'espace de travail",
+  "jwa.formDataVolumes": "Volumes de données",
+  "jwa.formConfigurations": "Configurations",
+  "jwa.noneAvailable": "aucune disponible",
+  "jwa.formSharedMemory": "Mémoire partagée",
+  "jwa.shmMount": "monter",
+  "jwa.launch": "Lancer",
+});
 
 let tpuCatalog = [];
 let tablePoller = null;

@@ -61,6 +61,39 @@ KF.registerMessages("de", {
   "twa.formProfiler": "XLA-Profiler",
   "twa.create": "Erstellen",
 });
+KF.registerMessages("fr", {
+  "twa.drawerTitle": "TensorBoard {name}",
+  "twa.logsPath": "Chemin des logs",
+  "twa.source": "Source",
+  "twa.open": "Ouvrir",
+  "twa.schemeUnknown": "inconnu",
+  "twa.schemePvc": "sous-chemin PVC",
+  "twa.schemeGcs": "bucket GCS (traces du profileur XLA)",
+  "twa.schemeS3": "bucket S3",
+  "twa.schemePath": "chemin",
+  "twa.profilerHintPre":
+    "les chemins gs:// servent des traces du profileur XLA/TPU " +
+    "capturées avec ",
+  "twa.profilerHintPost":
+    " — ouvrez l'onglet Profile dans TensorBoard.",
+  "twa.events": "Événements",
+  "twa.noEvents": "Aucun événement.",
+  "twa.deleteTitle": "Supprimer le TensorBoard {name} ?",
+  "twa.deleteMessage":
+    "Le serveur est supprimé ; les logs eux-mêmes sont conservés.",
+  "twa.deleting": "Suppression de {name}",
+  "twa.empty": "Aucun TensorBoard dans ce namespace.",
+  "twa.fixName": "Corrigez d'abord le nom.",
+  "twa.creating": "Création du TensorBoard {name}",
+  "twa.title": "TensorBoards",
+  "twa.namespace": "namespace",
+  "twa.newTensorboard": "+ Nouveau TensorBoard",
+  "twa.formTitle": "Nouveau TensorBoard",
+  "twa.formName": "Nom",
+  "twa.formLogspath": "Chemin des logs",
+  "twa.formProfiler": "Profileur XLA",
+  "twa.create": "Créer",
+});
 
 let tablePoller = null;
 

@@ -82,6 +82,45 @@ KF.registerMessages("de", {
   "vwa.create": "Erstellen",
   "vwa.loading": "Lädt…",
 });
+KF.registerMessages("fr", {
+  "vwa.drawerTitle": "Volume {name}",
+  "vwa.tabOverview": "Aperçu",
+  "vwa.tabEvents": "Événements",
+  "vwa.capacity": "Capacité",
+  "vwa.accessModes": "Modes d'accès",
+  "vwa.storageClass": "Classe de stockage",
+  "vwa.classDefault": "défaut",
+  "vwa.usedBy": "Utilisé par",
+  "vwa.usedByNothing": "rien",
+  "vwa.viewer": "Visionneuse",
+  "vwa.viewerOpen": "ouvrir",
+  "vwa.viewerStarting": "démarrage…",
+  "vwa.viewerNone": "aucune",
+  "vwa.colSize": "Taille",
+  "vwa.colModes": "Modes",
+  "vwa.colUsedBy": "Utilisé par",
+  "vwa.browse": "Parcourir",
+  "vwa.viewerStartingBtn": "Visionneuse en démarrage…",
+  "vwa.openViewer": "Ouvrir la visionneuse",
+  "vwa.closeViewer": "Fermer la visionneuse",
+  "vwa.startingViewerFor": "Démarrage de la visionneuse pour {name}",
+  "vwa.deleteTitle": "Supprimer le volume {name} ?",
+  "vwa.deleteMessage":
+    "Toutes les données du volume seront définitivement supprimées.",
+  "vwa.deleting": "Suppression de {name}",
+  "vwa.empty": "Aucun volume dans ce namespace.",
+  "vwa.fixName": "Corrigez d'abord le nom du volume.",
+  "vwa.creating": "Création du volume {name}",
+  "vwa.title": "Volumes",
+  "vwa.namespace": "namespace",
+  "vwa.newVolume": "+ Nouveau volume",
+  "vwa.formTitle": "Nouveau volume",
+  "vwa.formName": "Nom",
+  "vwa.formSize": "Taille",
+  "vwa.formAccessMode": "Mode d'accès",
+  "vwa.create": "Créer",
+  "vwa.loading": "Chargement…",
+});
 
 let tablePoller = null;
 

@@ -318,3 +318,39 @@ def test_dashboard_locale_switch():
         assert "Verwalten" in table                # table action
         assert "Rolle" in table                    # column header
         assert "Chips angefordert" in b.text("#tpu-table")
+
+
+_MISSING_KEYS_JS = (
+    'JSON.stringify(Object.keys(KF.i18n.catalogs.en).filter((k) =>'
+    ' KF.i18n.catalogs.de[k] === undefined ||'
+    ' KF.i18n.catalogs.fr[k] === undefined))'
+)
+
+
+def _assert_catalogs_complete(browser):
+    import json as _json
+
+    from kubeflow_tpu.testing.jsrt.interp import js_to_python
+
+    missing = _json.loads(js_to_python(browser.eval(_MISSING_KEYS_JS)))
+    assert missing == [], (
+        f"en catalog keys without a de or fr translation: {missing}")
+
+
+def test_vwa_catalogs_complete_and_french(vwa):
+    _assert_catalogs_complete(vwa.browser)
+    vwa.browser.change("select.kf-locale-picker", "fr")
+    vwa.poll_ui()
+    assert "Aucun volume dans ce namespace." in vwa.browser.text("#pvc-table")
+    assert "+ Nouveau volume" in vwa.browser.text("#new-btn")
+
+
+def test_twa_catalogs_complete(twa):
+    _assert_catalogs_complete(twa.browser)
+
+
+def test_dashboard_catalogs_complete():
+    with JsWebHarness(create_dashboard,
+                      extra_controllers=(setup_profile_controller,)) as h:
+        h.browser.load("/")
+        _assert_catalogs_complete(h.browser)

@@ -665,3 +665,18 @@ def test_a11y_focus_trap_and_row_arrows(jwa):
 def test_a11y_error_banner_is_alert(jwa):
     banner = jwa.browser.query("#error-banner")
     assert banner.attrs.get("role") == "alert"
+
+
+def test_jwa_catalogs_complete(jwa):
+    """Every en key JWA registers has de and fr translations (the fr set
+    mirrors the reference's messages.fr.xlf)."""
+    import json as _json
+
+    from kubeflow_tpu.testing.jsrt.interp import js_to_python
+
+    missing = _json.loads(js_to_python(jwa.browser.eval(
+        'JSON.stringify(Object.keys(KF.i18n.catalogs.en).filter((k) =>'
+        ' KF.i18n.catalogs.de[k] === undefined ||'
+        ' KF.i18n.catalogs.fr[k] === undefined))')))
+    assert missing == [], (
+        f"en catalog keys without a de or fr translation: {missing}")
