@@ -507,3 +507,109 @@ def test_dashboard_workgroup_flow_matches_under_node(tmp_path):
              ("POST /api/workgroup/create",
               "POST /api/workgroup/add-contributor/alice",
               "GET /api/workgroup/get-contributors/alice"))
+
+
+# ---- flow 8: VWA create + delete-confirm ------------------------------------
+
+VWA_CREATE_ACTIONS = [
+    {"op": "click", "sel": "#new-btn"},
+    {"op": "set", "sel": '#new-form input[name="name"]', "value": "diff-new"},
+    {"op": "set", "sel": '#new-form input[name="size"]', "value": "3Gi"},
+    {"op": "change", "sel": '#new-form select[name="mode"]',
+     "value": "ReadWriteMany"},
+    {"op": "submit", "sel": "#new-form"},
+    {"op": "settle"},
+    {"op": "js", "code": "tablePoller.refresh()"},
+    {"op": "settle"},
+]
+
+
+def test_vwa_create_flow_matches_under_node(tmp_path):
+    from kubeflow_tpu.web.volumes import create_app as create_vwa
+
+    vwa_static = WEB / "volumes" / "static"
+
+    with RecordingHarness(create_vwa) as rec:
+        h = rec.h
+        h.browser.local_storage["kubeflow.namespace"] = "team"
+        h.browser.load("/")
+        h.poll_ui()
+        run_jsrt_actions(h, VWA_CREATE_ACTIONS)
+        pvc = h.kube_get("PersistentVolumeClaim", "diff-new", "team")
+        assert pvc is not None
+        assert pvc["spec"]["resources"]["requests"]["storage"] == "3Gi"
+        assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+        jsrt_table = _normalize_text(h.browser.text("#pvc-table"))
+        jsrt_requests = set(rec.fixtures)
+        fixtures = dict(rec.fixtures)
+
+    assert "diff-new" in jsrt_table
+
+    _require_node()
+    node_out = _run_node_flow(
+        tmp_path,
+        html=vwa_static / "index.html",
+        scripts=[COMMON_STATIC / "kubeflow.js", vwa_static / "app.js"],
+        fixtures=fixtures,
+        observe="#pvc-table",
+        actions=VWA_CREATE_ACTIONS,
+        storage="kubeflow.namespace=team",
+    )
+    _compare(jsrt_table, jsrt_requests, node_out,
+             ("POST /api/namespaces/team/pvcs",))
+
+
+# ---- flow 9: TWA create through the form ------------------------------------
+
+TWA_CREATE_ACTIONS = [
+    {"op": "click", "sel": "#new-btn"},
+    {"op": "set", "sel": '#new-form input[name="name"]', "value": "diff-tb2"},
+    {"op": "set", "sel": '#new-form input[name="logspath"]',
+     "value": "gs://bucket/xla-traces"},
+    {"op": "change", "sel": '#new-form select[name="profiler"]',
+     "value": "on"},
+    {"op": "submit", "sel": "#new-form"},
+    {"op": "settle"},
+    {"op": "js", "code": "tablePoller.refresh()"},
+    {"op": "settle"},
+]
+
+
+def test_twa_create_flow_matches_under_node(tmp_path):
+    from kubeflow_tpu.controllers.tensorboard import (
+        setup_tensorboard_controller,
+    )
+    from kubeflow_tpu.web.tensorboards import create_app as create_twa
+
+    twa_static = WEB / "tensorboards" / "static"
+
+    with RecordingHarness(
+            create_twa,
+            extra_controllers=(setup_tensorboard_controller,)) as rec:
+        h = rec.h
+        h.browser.local_storage["kubeflow.namespace"] = "team"
+        h.browser.load("/")
+        h.poll_ui()
+        run_jsrt_actions(h, TWA_CREATE_ACTIONS)
+        tb = h.kube_get("Tensorboard", "diff-tb2", "team")
+        assert tb is not None
+        assert tb["spec"]["logspath"] == "gs://bucket/xla-traces"
+        assert tb["spec"].get("profilerPlugin") is True
+        jsrt_table = _normalize_text(h.browser.text("#tb-table"))
+        jsrt_requests = set(rec.fixtures)
+        fixtures = dict(rec.fixtures)
+
+    assert "diff-tb2" in jsrt_table
+
+    _require_node()
+    node_out = _run_node_flow(
+        tmp_path,
+        html=twa_static / "index.html",
+        scripts=[COMMON_STATIC / "kubeflow.js", twa_static / "app.js"],
+        fixtures=fixtures,
+        observe="#tb-table",
+        actions=TWA_CREATE_ACTIONS,
+        storage="kubeflow.namespace=team",
+    )
+    _compare(jsrt_table, jsrt_requests, node_out,
+             ("POST /api/namespaces/team/tensorboards",))
