@@ -270,11 +270,61 @@ def workflow_node_differential() -> dict:
     }
 
 
+def workflow_release() -> dict:
+    """Tag-push release gate (reference: releasing/ + its manual steps,
+    here enforced by CI): full unit suite, hermetic conformance, the
+    image build matrix via workflow_call-free duplication of the jax
+    target, and releasing/release.py check — the drift gate that fails
+    when VERSION, pyproject.toml and the manifest image tags disagree."""
+    return {
+        "name": "release",
+        "on": {"push": {"tags": ["v*"]}},
+        "jobs": {
+            "gate": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    checkout(),
+                    setup_python(),
+                    run(None, PIP_INSTALL),
+                    run("Version/tag consistency",
+                        "python releasing/release.py check"),
+                    run("Unit suite", "python -m pytest tests/ -q",
+                        env=VIRTUAL_MESH_ENV),
+                    run("Hermetic conformance",
+                        "python conformance/run.py",
+                        env=VIRTUAL_MESH_ENV),
+                ],
+            },
+            "images": {
+                "runs-on": "ubuntu-latest",
+                "needs": "gate",
+                "strategy": {
+                    "fail-fast": False,
+                    "matrix": {
+                        "include": [{"target": t} for t in IMAGE_BUILD_TARGETS]
+                    },
+                },
+                "steps": [
+                    checkout(),
+                    run("Build wheel for the jax image's framework client",
+                        "pip install build\n"
+                        "python -m build --wheel --outdir images/jupyter-jax/\n",
+                        if_="matrix.target == 'jupyter-jax'"),
+                    run("Build ${{ matrix.target }} at the release tag",
+                        "make -C images ${{ matrix.target }} "
+                        "TAG=${{ github.ref_name }}"),
+                ],
+            },
+        },
+    }
+
+
 WORKFLOWS = {
     "unit-tests.yaml": workflow_tests,
     "kind-integration.yaml": workflow_kind_integration,
     "image-builds.yaml": workflow_image_builds,
     "node-differential.yaml": workflow_node_differential,
+    "release.yaml": workflow_release,
 }
 
 _HEADER = """\

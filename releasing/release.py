@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""Release tooling: version stamping, manifest image pinning, changelog.
+
+The reference ships this as `releasing/` (README + `update-manifests-images`
+rewriting Deployment image tags + a `version` marker — reference
+releasing/README.md steps 1-4). Rebuilt here as one idempotent tool over
+this repo's actual surfaces:
+
+    python releasing/release.py set-version v1.2.0
+        Writes VERSION, syncs pyproject.toml's `version`, rewrites every
+        `kubeflow-tpu/*:<tag>` image reference in manifests/ to the new
+        tag, and prepends a changelog section generated from git history
+        (subjects since the previous release tag).
+
+    python releasing/release.py check
+        Exit 1 if VERSION, pyproject.toml and the manifest image tags
+        disagree — the drift gate the release workflow runs.
+
+Release-branch flow mirrors the reference: cut a branch, run set-version,
+commit, tag. `VERSION` of `dev` means manifests float on `:latest`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VERSION_FILE = os.path.join(REPO, "VERSION")
+PYPROJECT = os.path.join(REPO, "pyproject.toml")
+CHANGELOG = os.path.join(REPO, "CHANGELOG.md")
+MANIFEST_DIRS = [os.path.join(REPO, "manifests")]
+
+# Every first-party image reference looks like kubeflow-tpu/<name>:<tag>.
+IMAGE_RE = re.compile(r"(kubeflow-tpu/[\w.-]+):([\w.-]+)")
+
+
+def read_version() -> str:
+    if not os.path.exists(VERSION_FILE):
+        return "dev"
+    return open(VERSION_FILE).read().strip() or "dev"
+
+
+def _manifest_files():
+    for root_dir in MANIFEST_DIRS:
+        for dirpath, _dirs, files in os.walk(root_dir):
+            for name in sorted(files):
+                if name.endswith((".yaml", ".yml")):
+                    yield os.path.join(dirpath, name)
+
+
+def manifest_tags() -> dict[str, set[str]]:
+    """image name → set of tags referenced across manifests/."""
+    out: dict[str, set[str]] = {}
+    for path in _manifest_files():
+        for image, tag in IMAGE_RE.findall(open(path).read()):
+            out.setdefault(image, set()).add(tag)
+    return out
+
+
+def rewrite_manifest_tags(tag: str) -> list[str]:
+    changed = []
+    for path in _manifest_files():
+        src = open(path).read()
+        out = IMAGE_RE.sub(lambda m: f"{m.group(1)}:{tag}", src)
+        if out != src:
+            open(path, "w").write(out)
+            changed.append(os.path.relpath(path, REPO))
+    return changed
+
+
+def pyproject_version() -> str:
+    m = re.search(r'^version = "([^"]+)"', open(PYPROJECT).read(),
+                  re.MULTILINE)
+    if not m:
+        raise SystemExit("pyproject.toml has no version field")
+    return m.group(1)
+
+
+def set_pyproject_version(version: str) -> None:
+    src = open(PYPROJECT).read()
+    out = re.sub(r'^version = "[^"]+"', f'version = "{version}"', src,
+                 count=1, flags=re.MULTILINE)
+    open(PYPROJECT, "w").write(out)
+
+
+def previous_tag() -> str | None:
+    try:
+        return subprocess.run(
+            ["git", "describe", "--tags", "--abbrev=0"],
+            cwd=REPO, capture_output=True, text=True, check=True,
+        ).stdout.strip() or None
+    except subprocess.CalledProcessError:
+        return None
+
+
+def changelog_section(version: str) -> str:
+    prev = previous_tag()
+    rev_range = f"{prev}..HEAD" if prev else "HEAD"
+    subjects = subprocess.run(
+        ["git", "log", "--no-merges", "--pretty=format:%s", rev_range],
+        cwd=REPO, capture_output=True, text=True, check=True,
+    ).stdout.strip().splitlines()
+    since = f" (since {prev})" if prev else ""
+    lines = [f"## {version}{since}", ""]
+    lines += [f"- {s}" for s in subjects] or ["- (no changes)"]
+    return "\n".join(lines) + "\n"
+
+
+def cmd_set_version(version: str) -> int:
+    if not re.fullmatch(r"v\d+\.\d+\.\d+(-[\w.]+)?", version):
+        raise SystemExit(
+            f"version {version!r} must look like v1.2.3 or v1.2.3-rc.0")
+    open(VERSION_FILE, "w").write(version + "\n")
+    set_pyproject_version(version.lstrip("v"))
+    changed = rewrite_manifest_tags(version)
+    section = changelog_section(version)
+    existing = open(CHANGELOG).read() if os.path.exists(CHANGELOG) else (
+        "# Changelog\n\n")
+    head, _, rest = existing.partition("\n## ")
+    body = head + "\n" + section + ("\n## " + rest if rest else "")
+    open(CHANGELOG, "w").write(body)
+    print(f"VERSION={version}; pyproject={version.lstrip('v')}; "
+          f"manifests updated: {changed or 'none'}; changelog section added")
+    return 0
+
+
+def cmd_check() -> int:
+    version = read_version()
+    errors = []
+    if version == "dev":
+        expected_tag = "latest"
+    else:
+        expected_tag = version
+        if pyproject_version() != version.lstrip("v"):
+            errors.append(
+                f"pyproject version {pyproject_version()} != VERSION "
+                f"{version}")
+    for image, tags in sorted(manifest_tags().items()):
+        if tags != {expected_tag}:
+            errors.append(
+                f"{image} pinned to {sorted(tags)}, expected "
+                f"[{expected_tag!r}] for VERSION={version}")
+    for err in errors:
+        print(f"release check: {err}", file=sys.stderr)
+    print("release check: OK" if not errors else
+          f"release check: {len(errors)} problem(s)")
+    return 1 if errors else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_set = sub.add_parser("set-version",
+                           help="stamp VERSION/pyproject/manifests")
+    p_set.add_argument("version")
+    sub.add_parser("check", help="verify version/tag consistency")
+    args = parser.parse_args(argv)
+    if args.cmd == "set-version":
+        return cmd_set_version(args.version)
+    return cmd_check()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

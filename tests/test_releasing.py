@@ -1,0 +1,87 @@
+"""Release tooling (releasing/release.py — the reference's releasing/
+folder rebuilt): version stamping is consistent, idempotent, and the
+check subcommand catches drift."""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _copy_release_tree(tmp_path):
+    """A minimal repo copy with the surfaces release.py touches."""
+    (tmp_path / "releasing").mkdir()
+    shutil.copy(REPO / "releasing" / "release.py",
+                tmp_path / "releasing" / "release.py")
+    shutil.copytree(REPO / "manifests", tmp_path / "manifests")
+    shutil.copy(REPO / "pyproject.toml", tmp_path / "pyproject.toml")
+    (tmp_path / "VERSION").write_text("dev\n")
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    subprocess.run(["git", "add", "-A"], cwd=tmp_path, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed"], cwd=tmp_path, check=True)
+    return tmp_path
+
+
+def _run(tree, *args):
+    return subprocess.run(
+        [sys.executable, str(tree / "releasing" / "release.py"), *args],
+        capture_output=True, text=True)
+
+
+def test_dev_tree_passes_check(tmp_path):
+    tree = _copy_release_tree(tmp_path)
+    proc = _run(tree, "check")
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_set_version_stamps_everything_and_checks(tmp_path):
+    tree = _copy_release_tree(tmp_path)
+    proc = _run(tree, "set-version", "v1.2.3")
+    assert proc.returncode == 0, proc.stderr
+
+    assert (tree / "VERSION").read_text().strip() == "v1.2.3"
+    assert 'version = "1.2.3"' in (tree / "pyproject.toml").read_text()
+    manifest = (tree / "manifests" / "base"
+                / "controller-manager.yaml").read_text()
+    assert "kubeflow-tpu/controller:v1.2.3" in manifest
+    assert ":latest" not in manifest
+    changelog = (tree / "CHANGELOG.md").read_text()
+    assert "## v1.2.3" in changelog and "- seed" in changelog
+
+    assert _run(tree, "check").returncode == 0
+
+    # Idempotent: stamping again changes nothing material.
+    assert _run(tree, "set-version", "v1.2.3").returncode == 0
+    assert _run(tree, "check").returncode == 0
+
+
+def test_check_catches_drift(tmp_path):
+    tree = _copy_release_tree(tmp_path)
+    _run(tree, "set-version", "v1.2.3")
+    # Someone hand-edits one manifest back to :latest → drift.
+    path = tree / "manifests" / "base" / "webapps.yaml"
+    path.write_text(path.read_text().replace(
+        "kubeflow-tpu/controller:v1.2.3", "kubeflow-tpu/controller:latest"))
+    proc = _run(tree, "check")
+    assert proc.returncode == 1
+    assert "controller" in proc.stderr
+
+
+def test_bad_version_rejected(tmp_path):
+    tree = _copy_release_tree(tmp_path)
+    proc = _run(tree, "set-version", "1.2.3")   # missing the v
+    assert proc.returncode != 0
+
+
+def test_main_tree_is_release_consistent():
+    """The real tree must always pass the gate the release workflow runs."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "releasing" / "release.py"), "check"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
