@@ -319,12 +319,45 @@ def workflow_release() -> dict:
     }
 
 
+def workflow_image_refresh() -> dict:
+    """Scheduled no-push rebuild of the full image DAG (the reference's
+    image-updater workflow): catches upstream-base rot — a removed apt
+    package, a yanked wheel — between releases instead of on release
+    day. Weekly, off-peak; failures page via normal workflow alerts."""
+    return {
+        "name": "image-refresh",
+        "on": {"schedule": [{"cron": "17 3 * * 1"}],
+               "workflow_dispatch": {}},
+        "jobs": {
+            "rebuild": {
+                "runs-on": "ubuntu-latest",
+                "strategy": {
+                    "fail-fast": False,
+                    "matrix": {
+                        "include": [{"target": t} for t in IMAGE_BUILD_TARGETS]
+                    },
+                },
+                "steps": [
+                    checkout(),
+                    run("Build wheel for the jax image's framework client",
+                        "pip install build\n"
+                        "python -m build --wheel --outdir images/jupyter-jax/\n",
+                        if_="matrix.target == 'jupyter-jax'"),
+                    run("Rebuild ${{ matrix.target }} from scratch",
+                        "make -C images ${{ matrix.target }}"),
+                ],
+            }
+        },
+    }
+
+
 WORKFLOWS = {
     "unit-tests.yaml": workflow_tests,
     "kind-integration.yaml": workflow_kind_integration,
     "image-builds.yaml": workflow_image_builds,
     "node-differential.yaml": workflow_node_differential,
     "release.yaml": workflow_release,
+    "image-refresh.yaml": workflow_image_refresh,
 }
 
 _HEADER = """\
