@@ -141,6 +141,11 @@ KF.registerMessages("en", {
   "table.age": "Age",
   "table.lastActivity": "Last activity",
   "table.actions": "Actions",
+  "table.filterPlaceholder": "Filter rows",
+  "table.noMatches": 'No rows match "{query}".',
+  "table.prevPage": "Previous",
+  "table.nextPage": "Next",
+  "table.pageInfo": "{first}–{last} of {total}",
   "action.start": "Start",
   "action.stop": "Stop",
   "action.delete": "Delete",
@@ -167,6 +172,11 @@ KF.registerMessages("de", {
   "table.age": "Alter",
   "table.lastActivity": "Letzte Aktivität",
   "table.actions": "Aktionen",
+  "table.filterPlaceholder": "Zeilen filtern",
+  "table.noMatches": 'Keine Zeilen passen auf "{query}".',
+  "table.prevPage": "Zurück",
+  "table.nextPage": "Weiter",
+  "table.pageInfo": "{first}–{last} von {total}",
   "action.start": "Starten",
   "action.stop": "Stoppen",
   "action.delete": "Löschen",
@@ -195,6 +205,11 @@ KF.registerMessages("fr", {
   "table.age": "Âge",
   "table.lastActivity": "Dernière activité",
   "table.actions": "Actions",
+  "table.filterPlaceholder": "Filtrer les lignes",
+  "table.noMatches": 'Aucune ligne ne correspond à "{query}".',
+  "table.prevPage": "Précédent",
+  "table.nextPage": "Suivant",
+  "table.pageInfo": "{first}–{last} sur {total}",
   "action.start": "Démarrer",
   "action.stop": "Arrêter",
   "action.delete": "Supprimer",
@@ -245,6 +260,7 @@ KF.api = async function (path, options = {}) {
 KF.el = function (tag, attrs = {}, ...children) {
   const node = document.createElement(tag);
   for (const [k, v] of Object.entries(attrs)) {
+    if (v === undefined || v === null) continue; // e.g. conditional disabled
     if (k.startsWith("on") && typeof v === "function") {
       node.addEventListener(k.slice(2), v);
     } else if (k === "class") node.className = v;
@@ -353,7 +369,27 @@ KF.ageCell = function (timestamp, suffix) {
  * onRowClick is provided (the reference's details navigation). */
 KF.renderTable = function (container, columns, rows, opts = {}) {
   const state = (container._kfSort = container._kfSort || { idx: -1, dir: 1 });
-  const sorted = rows.slice();
+  // Filter + pagination state live with the sort state so a data poll
+  // re-render keeps the user's page and query (reference resource-table:
+  // MatPaginator + filter predicate).
+  if (state.page === undefined) state.page = 0;
+  if (state.query === undefined) state.query = "";
+  let filtered = rows;
+  if (opts.filterable && state.query) {
+    const q = state.query.toLowerCase();
+    filtered = rows.filter((row) =>
+      Object.values(row)
+        .filter((v) => typeof v === "string" || typeof v === "number")
+        .join(" ")
+        .toLowerCase()
+        .includes(q)
+    );
+  }
+  const pageSize = opts.pageSize || 0;
+  const pages = pageSize ? Math.max(1, Math.ceil(filtered.length / pageSize))
+                         : 1;
+  if (state.page >= pages) state.page = pages - 1;
+  const sorted = filtered.slice();
   if (state.idx >= 0 && columns[state.idx] && columns[state.idx].sortKey) {
     const key = columns[state.idx].sortKey;
     sorted.sort((a, b) => {
@@ -361,6 +397,10 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
       return (ka > kb ? 1 : ka < kb ? -1 : 0) * state.dir;
     });
   }
+  const pageRows = pageSize
+    ? sorted.slice(state.page * pageSize, (state.page + 1) * pageSize)
+    : sorted;
+  const rerender = () => KF.renderTable(container, columns, rows, opts);
   const head = KF.el(
     "tr",
     {},
@@ -402,8 +442,8 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
       );
     })
   );
-  const body = sorted.length
-    ? sorted.map((row) =>
+  const body = pageRows.length
+    ? pageRows.map((row) =>
         KF.el(
           "tr",
           opts.onRowClick
@@ -446,13 +486,70 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
           KF.el(
             "td",
             { colspan: String(columns.length), class: "muted" },
-            opts.emptyText || "Nothing here yet."
+            rows.length && opts.filterable && state.query
+              ? KF.t("table.noMatches", { query: state.query })
+              : opts.emptyText || "Nothing here yet."
           )
         ),
       ];
+  const chrome = [];
+  if (opts.filterable) {
+    const input = KF.el("input", {
+      class: "kf-table-filter",
+      type: "search",
+      placeholder: KF.t("table.filterPlaceholder"),
+      "aria-label": KF.t("table.filterPlaceholder"),
+      value: state.query,
+      oninput: (ev) => {
+        state.query = (ev.target && ev.target.value) || "";
+        state.page = 0;
+        state.refocusFilter = true;
+        rerender();
+      },
+    });
+    input._value = state.query;
+    chrome.push(KF.el("div", { class: "kf-table-toolbar" }, input));
+  }
   container.replaceChildren(
+    ...chrome,
     KF.el("table", {}, KF.el("thead", {}, head), KF.el("tbody", {}, body))
   );
+  if (pageSize && (filtered.length > pageSize || state.page > 0)) {
+    /* Pager (reference: MatPaginator): range info + prev/next as real
+     * buttons, disabled at the bounds, labels localized. */
+    const first = state.page * pageSize + 1;
+    const last = Math.min(filtered.length, (state.page + 1) * pageSize);
+    const move = (delta) => () => {
+      state.page += delta;
+      rerender();
+    };
+    container.append(
+      KF.el(
+        "div",
+        { class: "kf-table-pager" },
+        KF.el("button", {
+          class: "kf-page-prev",
+          "aria-label": KF.t("table.prevPage"),
+          disabled: state.page === 0 ? "disabled" : undefined,
+          onclick: move(-1),
+        }, "‹ " + KF.t("table.prevPage")),
+        KF.el("span", { class: "kf-page-info", "aria-live": "polite" },
+              KF.t("table.pageInfo",
+                   { first, last, total: filtered.length })),
+        KF.el("button", {
+          class: "kf-page-next",
+          "aria-label": KF.t("table.nextPage"),
+          disabled: state.page >= pages - 1 ? "disabled" : undefined,
+          onclick: move(1),
+        }, KF.t("table.nextPage") + " ›")
+      )
+    );
+  }
+  if (state.refocusFilter) {
+    delete state.refocusFilter;
+    const filterInput = container.querySelector(".kf-table-filter");
+    if (filterInput) filterInput.focus();
+  }
   if (state.refocus !== undefined) {
     const idx = state.refocus;
     delete state.refocus;

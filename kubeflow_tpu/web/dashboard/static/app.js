@@ -346,7 +346,7 @@ async function refresh() {
       },
     ],
     info.namespaces,
-    { emptyText: KF.t("cd.emptyNamespaces") }
+    { emptyText: KF.t("cd.emptyNamespaces"), pageSize: 25, filterable: true }
   );
   if (info.namespaces.length) {
     loadTpuUsage(info.namespaces[0].namespace).catch(() => {});

@@ -562,6 +562,8 @@ async function refresh() {
   renderTable(document.getElementById("notebook-table"), columns, body.notebooks, {
     onRowClick: openDetails,
     emptyText: KF.t("jwa.empty"),
+    pageSize: 25,
+    filterable: true,
   });
 }
 

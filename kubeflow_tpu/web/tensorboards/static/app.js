@@ -196,6 +196,8 @@ async function refresh() {
   renderTable(document.getElementById("tb-table"), columns, body.tensorboards, {
     onRowClick: openDetails,
     emptyText: KF.t("twa.empty"),
+    pageSize: 25,
+    filterable: true,
   });
 }
 

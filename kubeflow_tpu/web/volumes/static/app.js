@@ -265,6 +265,8 @@ async function refresh() {
   renderTable(document.getElementById("pvc-table"), columns, body.pvcs, {
     onRowClick: openDetails,
     emptyText: KF.t("vwa.empty"),
+    pageSize: 25,
+    filterable: true,
   });
 }
 

@@ -680,3 +680,47 @@ def test_jwa_catalogs_complete(jwa):
         ' KF.i18n.catalogs.fr[k] === undefined))')))
     assert missing == [], (
         f"en catalog keys without a de or fr translation: {missing}")
+
+
+def test_table_pagination_and_filter(jwa):
+    """KF.renderTable pagination + filtering (reference: MatPaginator +
+    filter predicate): page slicing, bounds-disabled pager buttons,
+    localized range info, and a live filter that resets to page 1."""
+    b = jwa.browser
+    from kubeflow_tpu.api import notebook as nbapi
+
+    for i in range(30):
+        jwa.kube_create("Notebook", nbapi.new(f"nb-{i:02d}", "team"))
+    jwa.poll_ui()
+
+    table = table_text(jwa)
+    assert "nb-00" in table
+    assert "nb-29" not in table          # beyond page 1 (pageSize 25)
+    info = b.text("#notebook-table .kf-page-info")
+    assert "1–25 of 30" in info
+    prev = b.query("#notebook-table .kf-page-prev")
+    assert prev.attrs.get("disabled") is not None  # at the first page
+
+    b.click("#notebook-table .kf-page-next")
+    table = table_text(jwa)
+    assert "nb-29" in table and "nb-00" not in table
+    assert "26–30 of 30" in b.text("#notebook-table .kf-page-info")
+    nxt = b.query("#notebook-table .kf-page-next")
+    assert nxt.attrs.get("disabled") is not None   # at the last page
+
+    # Filtering narrows rows, resets to page 1, keeps focus in the box.
+    b.set_value("#notebook-table .kf-table-filter", "nb-07")
+    table = table_text(jwa)
+    assert "nb-07" in table and "nb-29" not in table
+    assert b.query("#notebook-table .kf-page-info") is None  # fits one page
+    active = b.eval("document.activeElement && document.activeElement.className")
+    assert active == "kf-table-filter"
+
+    # No matches: localized empty state names the query.
+    b.set_value("#notebook-table .kf-table-filter", "zzz")
+    assert 'No rows match "zzz".' in table_text(jwa)
+
+    # Clearing restores everything; a poll re-render keeps the filter.
+    b.set_value("#notebook-table .kf-table-filter", "")
+    jwa.poll_ui()
+    assert "1–25 of 30" in b.text("#notebook-table .kf-page-info")
