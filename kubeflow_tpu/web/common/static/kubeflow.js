@@ -377,9 +377,29 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
   let filtered = rows;
   if (opts.filterable && state.query) {
     const q = state.query.toLowerCase();
+    // Match on what the user SEES (the referenced MatTable filters
+    // displayed data): each cell's RENDERED text, minus button labels —
+    // raw row fields would false-match on invisible data (ISO
+    // timestamps rendered as ages, raw phase keys rendered as localized
+    // labels) and never match computed cells, while action-button
+    // labels ("Delete") would match every row.
+    const cellText = (v) => {
+      if (v == null) return "";
+      if (typeof v === "string" || typeof v === "number") return String(v);
+      if (Array.isArray(v)) return v.map(cellText).join(" ");
+      if (v.tagName === "BUTTON") return "";
+      if (v.querySelectorAll) {
+        let text = v.textContent || "";
+        for (const btn of v.querySelectorAll("button")) {
+          text = text.split(btn.textContent).join(" ");
+        }
+        return text;
+      }
+      return v.textContent !== undefined ? v.textContent : "";
+    };
     filtered = rows.filter((row) =>
-      Object.values(row)
-        .filter((v) => typeof v === "string" || typeof v === "number")
+      columns
+        .map((c) => cellText(c.render(row)))
         .join(" ")
         .toLowerCase()
         .includes(q)
@@ -400,7 +420,11 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
   const pageRows = pageSize
     ? sorted.slice(state.page * pageSize, (state.page + 1) * pageSize)
     : sorted;
-  const rerender = () => KF.renderTable(container, columns, rows, opts);
+  // Stashed on the container so long-lived listeners (the reused filter
+  // input) always re-render with the LATEST rows, not the closure from
+  // the render that created them.
+  const rerender = container._kfRerender =
+    () => KF.renderTable(container, columns, rows, opts);
   const head = KF.el(
     "tr",
     {},
@@ -493,21 +517,33 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
         ),
       ];
   const chrome = [];
+  let refocusFilter = null;
   if (opts.filterable) {
-    const input = KF.el("input", {
-      class: "kf-table-filter",
-      type: "search",
-      placeholder: KF.t("table.filterPlaceholder"),
-      "aria-label": KF.t("table.filterPlaceholder"),
-      value: state.query,
-      oninput: (ev) => {
-        state.query = (ev.target && ev.target.value) || "";
-        state.page = 0;
-        state.refocusFilter = true;
-        rerender();
-      },
-    });
-    input._value = state.query;
+    // The input element is REUSED across re-renders (stashed on the
+    // container): replacing it per keystroke would reset the caret
+    // position and abort IME composition in a real browser — the
+    // oninput handler re-renders only the rows/pager around it.
+    let input = container._kfFilterInput;
+    if (input && document.activeElement === input) {
+      // replaceChildren detaches the element, which drops focus in a
+      // real browser (element state — value, selection — survives).
+      refocusFilter = input;
+    }
+    if (!input) {
+      input = container._kfFilterInput = KF.el("input", {
+        class: "kf-table-filter",
+        type: "search",
+        value: state.query,
+        oninput: (ev) => {
+          state.query = (ev.target && ev.target.value) || "";
+          state.page = 0;
+          container._kfRerender();
+        },
+      });
+    }
+    // Placeholder/label follow the active locale on every render.
+    input.setAttribute("placeholder", KF.t("table.filterPlaceholder"));
+    input.setAttribute("aria-label", KF.t("table.filterPlaceholder"));
     chrome.push(KF.el("div", { class: "kf-table-toolbar" }, input));
   }
   container.replaceChildren(
@@ -545,11 +581,7 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
       )
     );
   }
-  if (state.refocusFilter) {
-    delete state.refocusFilter;
-    const filterInput = container.querySelector(".kf-table-filter");
-    if (filterInput) filterInput.focus();
-  }
+  if (refocusFilter) refocusFilter.focus();
   if (state.refocus !== undefined) {
     const idx = state.refocus;
     delete state.refocus;

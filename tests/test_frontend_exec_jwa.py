@@ -708,13 +708,26 @@ def test_table_pagination_and_filter(jwa):
     nxt = b.query("#notebook-table .kf-page-next")
     assert nxt.attrs.get("disabled") is not None   # at the last page
 
-    # Filtering narrows rows, resets to page 1, keeps focus in the box.
+    # Filtering narrows rows, resets to page 1, keeps focus in the box
+    # (the input is the SAME element across re-renders — caret/IME
+    # survive; focus is restored after the detach).
+    b.focus("#notebook-table .kf-table-filter")
     b.set_value("#notebook-table .kf-table-filter", "nb-07")
     table = table_text(jwa)
     assert "nb-07" in table and "nb-29" not in table
     assert b.query("#notebook-table .kf-page-info") is None  # fits one page
     active = b.eval("document.activeElement && document.activeElement.className")
     assert active == "kf-table-filter"
+
+    # The filter matches VISIBLE cell text (status label), not raw row
+    # fields: every row shows "Running", none carries it as a field.
+    b.set_value("#notebook-table .kf-table-filter", "running")
+    assert "1–25 of 30" in b.text("#notebook-table .kf-page-info")
+    # ...and invisible raw fields don't false-match: the ISO creation
+    # timestamp ("2026-...") is rendered as an age ("3s"), so a year
+    # query matches nothing.
+    b.set_value("#notebook-table .kf-table-filter", "2026")
+    assert 'No rows match "2026".' in table_text(jwa)
 
     # No matches: localized empty state names the query.
     b.set_value("#notebook-table .kf-table-filter", "zzz")
