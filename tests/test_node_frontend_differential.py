@@ -613,3 +613,50 @@ def test_twa_create_flow_matches_under_node(tmp_path):
     )
     _compare(jsrt_table, jsrt_requests, node_out,
              ("POST /api/namespaces/team/tensorboards",))
+
+
+# ---- flow 10: VWA details drawer (row click → tabs + events) ----------------
+
+VWA_DRAWER_ACTIONS = [
+    {"op": "click", "sel": "#pvc-table tr.clickable"},
+    {"op": "settle"},
+]
+
+
+def test_vwa_drawer_flow_matches_under_node(tmp_path):
+    from kubeflow_tpu.web.volumes import create_app as create_vwa
+
+    vwa_static = WEB / "volumes" / "static"
+
+    with RecordingHarness(create_vwa) as rec:
+        h = rec.h
+        h.browser.local_storage["kubeflow.namespace"] = "team"
+        h.kube_create("PersistentVolumeClaim", {
+            "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "drawer-pvc", "namespace": "team"},
+            "spec": {"accessModes": ["ReadWriteMany"],
+                     "resources": {"requests": {"storage": "2Gi"}}},
+        })
+        h.settle()
+        h.browser.load("/")
+        h.poll_ui()
+        run_jsrt_actions(h, VWA_DRAWER_ACTIONS)
+        jsrt_drawer = _normalize_text(h.browser.text(".kf-drawer"))
+        jsrt_requests = set(rec.fixtures)
+        fixtures = dict(rec.fixtures)
+
+    assert "drawer-pvc" in jsrt_drawer
+    assert "2Gi" in jsrt_drawer
+
+    _require_node()
+    node_out = _run_node_flow(
+        tmp_path,
+        html=vwa_static / "index.html",
+        scripts=[COMMON_STATIC / "kubeflow.js", vwa_static / "app.js"],
+        fixtures=fixtures,
+        observe=".kf-drawer",
+        actions=VWA_DRAWER_ACTIONS,
+        storage="kubeflow.namespace=team",
+    )
+    _compare(jsrt_drawer, jsrt_requests, node_out,
+             ("GET /api/namespaces/team/pvcs/drawer-pvc/events",))
