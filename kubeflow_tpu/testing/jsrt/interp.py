@@ -145,7 +145,14 @@ class JSObject:
         # installed by other means (host code) land after.
         keys = list(self.props.keys())
         keys += [k for k in self.getters if k not in self.props]
-        return keys
+        # OrdinaryOwnPropertyKeys: canonical array indexes first in
+        # ascending NUMERIC order, then string keys in insertion order —
+        # Object.keys({b:1, 2:2, 1:3}) is ["1","2","b"] in every real
+        # engine ("01" is not canonical and keeps insertion order).
+        def is_index(k):
+            return k.isdigit() and (k == "0" or not k.startswith("0"))
+        ints = sorted((k for k in keys if is_index(k)), key=int)
+        return ints + [k for k in keys if not is_index(k)]
 
 
 NOT_PRESENT = object()
@@ -508,9 +515,17 @@ def js_to_python(v):
     if isinstance(v, JSArray):
         return [js_to_python(x) for x in v.items]
     if isinstance(v, JSObject):
-        return {k: js_to_python(val) for k, val in v.props.items()
-                if not isinstance(val, (JSFunction, HostFunction))
-                and val is not undefined and val is not ACCESSOR_SLOT}
+        # own_keys order (integer indexes first) so JSON.stringify and
+        # host bridges see the same enumeration a real engine produces.
+        out = {}
+        for k in v.own_keys():
+            val = v.props.get(k, NOT_PRESENT)
+            if (val is NOT_PRESENT or val is undefined
+                    or val is ACCESSOR_SLOT
+                    or isinstance(val, (JSFunction, HostFunction))):
+                continue
+            out[k] = js_to_python(val)
+        return out
     return None
 
 
