@@ -108,9 +108,19 @@ async def scale_test(cp: ControlPlane) -> dict:
     """The N-notebook load test (testing/loadtest.py — the harness the
     reference ships without ever recording numbers, SURVEY.md §6). Runs
     AFTER the cold-start measurement so its wall time never pollutes
-    in_process_to_first_step_sec."""
+    in_process_to_first_step_sec.
+
+    Besides throughput/latency, reports the control plane's API-write
+    count (fakekube per-verb request counter — write elision should keep
+    this near the object count, not the event count), the workqueue
+    high-water mark, and mean reconcile latency from the manager's
+    histogram."""
     from kubeflow_tpu.testing.loadtest import run_load_test
 
+    writes_before = cp.kube.write_count()
+    # The manager defaults to the process-wide registry; diff the
+    # histogram around the run so each trial reports its own reconciles.
+    rec_before = cp.mgr.reconcile_seconds.snapshot(controller="notebook")
     report = await run_load_test(
         cp.kube, count=SCALE_NOTEBOOKS, accelerator="v5e", topology="2x2",
         timeout=120,
@@ -120,12 +130,21 @@ async def scale_test(cp: ControlPlane) -> dict:
             f"load test: only {report.ready}/{SCALE_NOTEBOOKS} ready "
             f"(failures: {report.failures[:3]})"
         )
+    rec_after = cp.mgr.reconcile_seconds.snapshot(controller="notebook")
+    rec = {"count": rec_after["count"] - rec_before["count"],
+           "sum": rec_after["sum"] - rec_before["sum"]}
     return {
         "notebooks": report.notebooks,
         "wall_sec": round(report.wall_seconds, 3),
         "notebooks_per_sec": round(report.notebooks / report.wall_seconds, 1),
         "p50_ready_sec": round(report.p50_ready_seconds, 4),
         "p95_ready_sec": round(report.p95_ready_seconds, 4),
+        "api_writes": cp.kube.write_count() - writes_before,
+        "queue_depth_peak": max(
+            (q.peak_depth for q in cp.mgr._queues.values()), default=0),
+        "reconciles": rec["count"],
+        "reconcile_mean_sec": (
+            round(rec["sum"] / rec["count"], 5) if rec["count"] else None),
     }
 
 

@@ -287,7 +287,7 @@ def workflow_release() -> dict:
                     setup_python(),
                     run(None, PIP_INSTALL),
                     run("Version/tag consistency",
-                        "python releasing/release.py check"),
+                        'python releasing/release.py check "$GITHUB_REF_NAME"'),
                     run("Unit suite", "python -m pytest tests/ -q",
                         env=VIRTUAL_MESH_ENV),
                     run("Hermetic conformance",

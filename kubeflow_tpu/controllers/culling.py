@@ -86,6 +86,10 @@ class CullingReconciler:
         self.opts = options or CullingOptions()
         self.clock = clock
         self.recorder = EventRecorder(kube, "culling-controller")
+        # Pod informer (wired by setup_culling_controller): the auth-proxy
+        # probe path resolves worker-0's pod IP from the watch cache
+        # instead of a per-check apiserver GET.
+        self._pod_informer = None
         registry = registry or global_registry
         self.m_culled = registry.counter(
             "notebook_culling_total", "Total times of culling notebooks"
@@ -126,7 +130,10 @@ class CullingReconciler:
                 api: self.probe_url(name, ns, api)
                 for api in ("kernels", "terminals")
             }
-        pod = await self.kube.get_or_none("Pod", f"{name}-0", ns)
+        if self._pod_informer is not None:
+            pod = self._pod_informer.get(f"{name}-0", ns)
+        else:
+            pod = await self.kube.get_or_none("Pod", f"{name}-0", ns)
         pod_ip = deep_get(pod or {}, "status", "podIP")
         if not pod_ip:
             return None
@@ -242,4 +249,5 @@ def setup_culling_controller(
     mgr.add_controller(
         Controller(name="culling", kind="Notebook", reconcile=rec.reconcile)
     )
+    rec._pod_informer = mgr.informer_for("Pod")
     return rec

@@ -12,8 +12,16 @@ import logging
 from dataclasses import dataclass
 
 from kubeflow_tpu.api import pvcviewer as pvcapi
-from kubeflow_tpu.controllers.common import rwo_affinity
-from kubeflow_tpu.runtime.apply import reconcile_child
+from kubeflow_tpu.controllers.common import (
+    POD_PVC_INDEX,
+    index_pod_by_pvc,
+    rwo_affinity,
+)
+from kubeflow_tpu.runtime.apply import (
+    ApplyCache,
+    informer_reader,
+    reconcile_child,
+)
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result
 from kubeflow_tpu.runtime.objects import (
     deep_get,
@@ -39,6 +47,12 @@ class PVCViewerReconciler:
     def __init__(self, kube, options: PVCViewerOptions | None = None):
         self.kube = kube
         self.opts = options or PVCViewerOptions()
+        # Wired by setup_pvcviewer_controller; bare-reconciler tests run
+        # with the apiserver fallbacks.
+        self._pod_informer = None
+        self._child_informers: dict[str, object] = {}
+        self._reader = informer_reader(self._child_informers)
+        self._apply_cache = ApplyCache()
 
     async def reconcile(self, key) -> Result | None:
         ns, name = key
@@ -54,7 +68,10 @@ class PVCViewerReconciler:
         live_deployment = None
         for desired in children:
             set_controller_owner(desired, viewer)
-            live, _ = await reconcile_child(self.kube, desired)
+            live, _ = await reconcile_child(
+                self.kube, desired,
+                cache=self._apply_cache, reader=self._reader,
+            )
             if desired["kind"] == "Deployment":
                 live_deployment = live
         await self._update_status(viewer, live_deployment)
@@ -65,7 +82,8 @@ class PVCViewerReconciler:
         pod_spec = deepcopy(deep_get(viewer, "spec", "podSpec", default={}))
         if deep_get(viewer, "spec", "rwoScheduling"):
             affinity = await rwo_affinity(
-                self.kube, ns, deep_get(viewer, "spec", "pvc")
+                self.kube, ns, deep_get(viewer, "spec", "pvc"),
+                pod_informer=self._pod_informer,
             )
             if affinity:
                 pod_spec["affinity"] = affinity
@@ -163,13 +181,18 @@ def setup_pvcviewer_controller(
     mgr: Manager, options: PVCViewerOptions | None = None
 ) -> PVCViewerReconciler:
     rec = PVCViewerReconciler(mgr.kube, options)
+    owned = ["Deployment", "Service"] + (
+        ["VirtualService"] if rec.opts.use_istio else [])
     mgr.add_controller(
         Controller(
             name="pvcviewer",
             kind="PVCViewer",
             reconcile=rec.reconcile,
-            owns=["Deployment", "Service"]
-            + (["VirtualService"] if rec.opts.use_istio else []),
+            owns=owned,
         )
     )
+    # update(), not rebind: rec._reader closed over this dict in __init__.
+    rec._child_informers.update({k: mgr.informer_for(k) for k in owned})
+    rec._pod_informer = mgr.informer_for("Pod")
+    rec._pod_informer.add_indexer(POD_PVC_INDEX, index_pod_by_pvc)
     return rec

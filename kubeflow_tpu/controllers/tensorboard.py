@@ -19,8 +19,16 @@ import logging
 from dataclasses import dataclass
 
 from kubeflow_tpu.api import tensorboard as tbapi
-from kubeflow_tpu.controllers.common import rwo_affinity
-from kubeflow_tpu.runtime.apply import reconcile_child
+from kubeflow_tpu.controllers.common import (
+    POD_PVC_INDEX,
+    index_pod_by_pvc,
+    rwo_affinity,
+)
+from kubeflow_tpu.runtime.apply import (
+    ApplyCache,
+    informer_reader,
+    reconcile_child,
+)
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result, Watch
 from kubeflow_tpu.runtime.objects import (
@@ -51,6 +59,12 @@ class TensorboardReconciler:
     def __init__(self, kube, options: TensorboardOptions | None = None):
         self.kube = kube
         self.opts = options or TensorboardOptions()
+        # Wired by setup_tensorboard_controller; bare-reconciler tests run
+        # with the apiserver fallbacks.
+        self._pod_informer = None
+        self._child_informers: dict[str, object] = {}
+        self._reader = informer_reader(self._child_informers)
+        self._apply_cache = ApplyCache()
 
     async def reconcile(self, key) -> Result | None:
         ns, name = key
@@ -67,7 +81,10 @@ class TensorboardReconciler:
             [self.generate_virtual_service(tb)] if self.opts.use_istio else []
         ):
             set_controller_owner(desired, tb)
-            live, _ = await reconcile_child(self.kube, desired)
+            live, _ = await reconcile_child(
+                self.kube, desired,
+                cache=self._apply_cache, reader=self._reader,
+            )
             if desired["kind"] == "Deployment":
                 live_deployment = live
         await self._update_status(tb, live_deployment)
@@ -105,7 +122,8 @@ class TensorboardReconciler:
                 {"name": "logs", "mountPath": "/tensorboard_logs", "readOnly": True}
             ]
             if self.opts.rwo_pvc_scheduling:
-                affinity = await rwo_affinity(self.kube, ns, claim)
+                affinity = await rwo_affinity(
+                    self.kube, ns, claim, pod_informer=self._pod_informer)
                 if affinity:
                     pod_spec["affinity"] = affinity
         elif scheme == tbapi.SCHEME_GCS and self.opts.gcp_creds_secret:
@@ -206,13 +224,19 @@ def setup_tensorboard_controller(
     mgr: Manager, options: TensorboardOptions | None = None
 ) -> TensorboardReconciler:
     rec = TensorboardReconciler(mgr.kube, options)
+    owned = ["Deployment", "Service"] + (
+        ["VirtualService"] if rec.opts.use_istio else [])
     mgr.add_controller(
         Controller(
             name="tensorboard",
             kind="Tensorboard",
             reconcile=rec.reconcile,
-            owns=["Deployment", "Service"]
-            + (["VirtualService"] if rec.opts.use_istio else []),
+            owns=owned,
         )
     )
+    # update(), not rebind: rec._reader closed over this dict in __init__.
+    rec._child_informers.update({k: mgr.informer_for(k) for k in owned})
+    if rec.opts.rwo_pvc_scheduling:
+        rec._pod_informer = mgr.informer_for("Pod")
+        rec._pod_informer.add_indexer(POD_PVC_INDEX, index_pod_by_pvc)
     return rec

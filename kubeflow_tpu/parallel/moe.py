@@ -130,19 +130,29 @@ _dispatch_gather.defvjp(_dispatch_fwd, _dispatch_bwd)
 
 
 @jax.custom_vjp
-def _combine_gather(out_flat, all_slots, all_scales, seat_tok, seat_scale):
-    """y[t] = Σ_j out_flat[slot(t, j)] · scale(t, j) — [T, d]."""
-    g = jnp.take(out_flat, jnp.where(all_scales > 0, all_slots, 0), axis=0)
+def _combine_gather(out_flat, all_slots, all_scales, keep_mask, seat_tok,
+                    seat_scale):
+    """y[t] = Σ_j out_flat[slot(t, j)] · scale(t, j) — [T, d].
+
+    ``keep_mask`` is the router's boolean keep decision per (token,
+    choice) — NOT derivable from ``all_scales > 0``: a kept second choice
+    whose renormalized gate underflows to exactly 0.0 is still routed (its
+    slot is valid) and must keep its true gate gradient.
+    """
+    g = jnp.take(out_flat, jnp.where(keep_mask, all_slots, 0), axis=0)
     return (g * all_scales[..., None].astype(out_flat.dtype)).sum(axis=1)
 
 
-def _combine_fwd(out_flat, all_slots, all_scales, seat_tok, seat_scale):
-    y = _combine_gather(out_flat, all_slots, all_scales, seat_tok, seat_scale)
-    return y, (out_flat, all_slots, all_scales, seat_tok, seat_scale)
+def _combine_fwd(out_flat, all_slots, all_scales, keep_mask, seat_tok,
+                 seat_scale):
+    y = _combine_gather(out_flat, all_slots, all_scales, keep_mask, seat_tok,
+                        seat_scale)
+    return y, (out_flat, all_slots, all_scales, keep_mask, seat_tok,
+               seat_scale)
 
 
 def _combine_bwd(res, dy):
-    out_flat, all_slots, all_scales, seat_tok, seat_scale = res
+    out_flat, all_slots, all_scales, keep_mask, seat_tok, seat_scale = res
     t = dy.shape[0]
     # dout[s] = dy[seat_tok[s]] · seat_scale[s] — the dispatch-side
     # gather (empty seats carry scale 0; their seat_tok points at the
@@ -153,12 +163,15 @@ def _combine_bwd(res, dy):
         * seat_scale[:, None].astype(dy.dtype)
     # Gate gradient — the router's learning signal: dscale[t, j] =
     # ⟨dy[t], out_flat[slot(t, j)]⟩ (one more gather; still no scatter).
-    kept = all_scales > 0
-    g = jnp.take(out_flat, jnp.where(kept, all_slots, 0), axis=0)
+    # Masked on the router's KEEP flags, not on all_scales > 0: a kept
+    # expert whose renormalized gate underflowed to 0.0 contributes
+    # nothing to y, but d y / d gate is its expert output — zeroing it
+    # would freeze that gate at 0 forever.
+    g = jnp.take(out_flat, jnp.where(keep_mask, all_slots, 0), axis=0)
     dscale = (g.astype(jnp.float32) * dy[:, None, :].astype(jnp.float32)
               ).sum(axis=-1)
-    dscale = jnp.where(kept, dscale, 0.0)
-    return (dout, None, dscale, None, None)
+    dscale = jnp.where(keep_mask, dscale, 0.0)
+    return (dout, None, dscale, None, None, None)
 
 
 _combine_gather.defvjp(_combine_fwd, _combine_bwd)
@@ -200,14 +213,19 @@ def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
     # of each — measured r5: 27.5 → 26.2 ms fwd+bwd for the bare layer.
     seat_tok = jnp.full((n_experts * capacity + 1,), t, jnp.int32)
     tok_ids = jnp.arange(t, dtype=jnp.int32)
-    slot_k, scale_k = [], []
+    slot_k, scale_k, keep_k = [], [], []
     for expert_idx, pos, gate, keep in choices:
         slot_k.append(jnp.where(keep, expert_idx * capacity + pos,
                                 n_experts * capacity))
         scale_k.append(gate * keep)
+        keep_k.append(keep)
     all_slots = jnp.stack(slot_k, axis=1)                  # [T, k]
     all_scales = jnp.stack(scale_k, axis=1)                # [T, k] f32
-    keep_mask = all_scales > 0
+    # The router's boolean keep decision, threaded through dispatch AND
+    # combine: ``all_scales > 0`` is NOT equivalent — a kept choice whose
+    # renormalized gate underflows to 0.0 still occupies its seat and must
+    # keep its gate gradient (see _combine_bwd).
+    keep_mask = jnp.stack(keep_k, axis=1)                  # [T, k] bool
     seat_tok = seat_tok.at[all_slots.reshape(-1)].set(
         jnp.repeat(tok_ids, len(choices)), mode="drop")
     # Per-seat gates for the combine transpose (drop-bucket writes land
@@ -241,7 +259,7 @@ def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
     # the (renormalized) gates; dropped tokens contribute zeros and ride
     # the residual connection upstream.
     out_flat = out.reshape(n_experts * capacity, d)
-    y = _combine_gather(out_flat, all_slots, all_scales,
+    y = _combine_gather(out_flat, all_slots, all_scales, keep_mask,
                         seat_tok[:-1], seat_scale[:-1])
     return y, aux
 

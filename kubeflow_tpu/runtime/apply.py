@@ -9,9 +9,13 @@ instead of fighting the apiserver.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
+from collections import OrderedDict
 
 from kubeflow_tpu.runtime.errors import AlreadyExists, Conflict, NotFound
+from kubeflow_tpu.runtime.metrics import global_registry
 from kubeflow_tpu.runtime.objects import (
     deep_get,
     deepcopy,
@@ -21,6 +25,76 @@ from kubeflow_tpu.runtime.objects import (
 )
 
 log = logging.getLogger(__name__)
+
+# Write-elision telemetry (bench reports these): "hash" means the
+# last-applied cache short-circuited before even diffing; "diff" means the
+# copier compared and found no drift. Either way zero API writes happened.
+M_ELIDED = global_registry.counter(
+    "apply_writes_elided_total",
+    "Child reconciles that issued no API write",
+    ["kind", "via"],
+)
+
+
+def state_hash(obj) -> str:
+    """Stable content hash of a JSON-shaped object (dict key order and
+    whitespace don't matter; list order does — k8s list order is
+    semantic, matching subset_equal below)."""
+    return hashlib.sha1(
+        json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                   default=str).encode()
+    ).hexdigest()
+
+
+class ApplyCache:
+    """Per-key last-applied memory: (kind, ns, name) → (desired-state
+    hash, live resourceVersion at last convergence). A reconcile whose
+    desired state hashes the same while the live object's rv is unchanged
+    is provably a no-op — skip the diff entirely. Any external change
+    bumps the rv and falls through to the copier, so drift repair is
+    untouched; a desired-state change misses on the hash.
+
+    LRU-bounded: deletion paths (owner cascade, GC) don't flow through
+    here, so without a bound the cache would grow with *historical*
+    object count under create/delete churn. Eviction only costs a diff
+    on the next reconcile of that key — never correctness."""
+
+    def __init__(self, max_entries: int = 4096):
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[tuple, tuple[str, str | None]]" = \
+            OrderedDict()
+
+    @staticmethod
+    def key_of(desired: dict) -> tuple:
+        return (desired.get("kind"), namespace_of(desired), name_of(desired))
+
+    def unchanged(self, key: tuple, desired_hash: str, live_rv) -> bool:
+        entry = self._entries.get(key)
+        if entry is None or entry != (desired_hash, live_rv):
+            return False
+        self._entries.move_to_end(key)
+        return True
+
+    def record(self, key: tuple, desired_hash: str, live_rv) -> None:
+        self._entries[key] = (desired_hash, live_rv)
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+
+    def forget(self, key: tuple) -> None:
+        self._entries.pop(key, None)
+
+
+def informer_reader(informers: dict):
+    """A ``reconcile_child`` reader over a kind → informer mapping (the
+    shape every reconciler wires in its setup fn). The dict is read live,
+    so setup may populate it after constructing the reader."""
+
+    def reader(kind: str, name: str, namespace: str | None) -> dict | None:
+        inf = informers.get(kind)
+        return inf.get(name, namespace) if inf is not None else None
+
+    return reader
 
 
 def subset_equal(want, have) -> bool:
@@ -130,7 +204,10 @@ COPIERS = {
 }
 
 
-async def reconcile_child(kube, desired: dict, *, copier=None) -> tuple[dict, bool]:
+async def reconcile_child(
+    kube, desired: dict, *, copier=None, cache: ApplyCache | None = None,
+    reader=None,
+) -> tuple[dict, bool]:
     """Ensure ``desired`` exists and owned fields match.
 
     Returns ``(live_object, created)`` — callers that count creations (e.g.
@@ -138,18 +215,52 @@ async def reconcile_child(kube, desired: dict, *, copier=None) -> tuple[dict, bo
     read-before-write. The per-kind copier defaults from COPIERS; unknown
     kinds copy the whole spec. Conflict → raise (the workqueue retries with
     backoff, matching the reference's requeue-on-conflict behavior).
+
+    ``reader(kind, name, namespace) -> dict | None`` reads the live object
+    from a watch cache (informer) instead of a per-reconcile apiserver GET
+    — a None return (cold cache) falls back to the GET, so correctness
+    never depends on cache warmth. A stale cached rv at worst produces a
+    Conflict the workqueue retries, same as any writer race.
+
+    ``cache`` (ApplyCache) elides the whole diff when the desired-state
+    hash AND the live rv match the last convergence — the steady-state
+    reconcile touches neither the apiserver nor the copier.
     """
     kind = desired["kind"]
     copier = copier or COPIERS.get(kind, copy_spec)
     name, namespace = name_of(desired), namespace_of(desired)
-    try:
-        live = await kube.get(kind, name, namespace)
-    except NotFound:
+    ckey = ApplyCache.key_of(desired) if cache is not None else None
+    dh = state_hash(desired) if cache is not None else None
+
+    live = reader(kind, name, namespace) if reader is not None else None
+    if live is not None:
+        if cache is not None and cache.unchanged(
+            ckey, dh, get_meta(live).get("resourceVersion")
+        ):
+            M_ELIDED.labels(kind=kind, via="hash").inc()
+            return deepcopy(live), False
+        # The copier folds fields INTO live; never mutate the informer's
+        # stored object.
+        live = deepcopy(live)
+    if live is None:
         try:
-            return await kube.create(kind, desired), True
-        except AlreadyExists:
             live = await kube.get(kind, name, namespace)
+        except NotFound:
+            try:
+                created = await kube.create(kind, desired)
+                if cache is not None:
+                    cache.record(
+                        ckey, dh, get_meta(created).get("resourceVersion"))
+                return created, True
+            except AlreadyExists:
+                live = await kube.get(kind, name, namespace)
     if copier(desired, live):
         log.debug("updating %s %s/%s (drift)", kind, namespace, name)
-        return await kube.update(kind, live), False
+        updated = await kube.update(kind, live)
+        if cache is not None:
+            cache.record(ckey, dh, get_meta(updated).get("resourceVersion"))
+        return updated, False
+    M_ELIDED.labels(kind=kind, via="diff").inc()
+    if cache is not None:
+        cache.record(ckey, dh, get_meta(live).get("resourceVersion"))
     return live, False

@@ -1,22 +1,64 @@
-"""List/watch informer with a local cache.
+"""List/watch informer with a local cache and secondary indexes.
 
 Mirrors client-go's shared informer: an initial list primes the cache, a watch
 streams deltas, and registered handlers receive (event, obj). On watch failure
 the informer relists (resync-on-error), which is all the reference stack needs
 (controller-runtime does the same under the hood).
+
+Indexes follow client-go's ``AddIndexers`` semantics: an index function maps
+an object to zero or more hashable values, the informer maintains the inverted
+value → keys mapping incrementally on every watch delta (and rebuilds it on
+relist), and ``by_index(name, value)`` answers in O(matches) instead of the
+O(cache) linear scan every per-event lookup used to pay.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Awaitable, Callable
+from typing import Awaitable, Callable, Hashable
 
-from kubeflow_tpu.runtime.objects import key_of, name_of, namespace_of
+from kubeflow_tpu.runtime.objects import (
+    controller_of,
+    get_meta,
+    key_of,
+    name_of,
+    namespace_of,
+)
 
 log = logging.getLogger(__name__)
 
 Handler = Callable[[str, dict], None]
+IndexFn = Callable[[dict], list[Hashable]]
+
+# ---- built-in index functions (the client-go "namespace" indexer and the
+# two shapes every controller here needs: owner UID and a label's value) ----
+
+OWNER_INDEX = "owner"
+NAMESPACE_INDEX = "namespace"
+
+
+def index_by_owner_uid(obj: dict) -> list[Hashable]:
+    """Index children under their controller owner's UID (unique
+    cluster-wide, so the value needs no namespace qualifier)."""
+    ref = controller_of(obj)
+    return [ref["uid"]] if ref and ref.get("uid") else []
+
+
+def index_by_namespace(obj: dict) -> list[Hashable]:
+    return [namespace_of(obj)]
+
+
+def index_by_label(label: str) -> IndexFn:
+    """Index by a label's value, namespace-qualified: values are
+    ``(namespace, label_value)`` because label values (unlike UIDs) only
+    identify an object within its namespace."""
+
+    def fn(obj: dict) -> list[Hashable]:
+        value = (get_meta(obj).get("labels") or {}).get(label)
+        return [(namespace_of(obj), value)] if value is not None else []
+
+    return fn
 
 
 class Informer:
@@ -27,6 +69,7 @@ class Informer:
         namespace: str | None = None,
         label_selector: str | dict | None = None,
         resync_backoff: float = 1.0,
+        registry=None,
     ):
         self.kube = kube
         self.kind = kind
@@ -37,12 +80,96 @@ class Informer:
         self._handlers: list[Handler] = []
         self._task: asyncio.Task | None = None
         self._synced = asyncio.Event()
+        # name → index fn; name → value → set of cache keys; key → the
+        # values it currently occupies per index (so a MODIFIED delta can
+        # leave its old buckets without re-deriving them from a stale obj).
+        self._index_fns: dict[str, IndexFn] = {}
+        self._indexes: dict[str, dict[Hashable, set]] = {}
+        self._indexed_values: dict[str, dict[tuple, list[Hashable]]] = {}
+        self._lookups = (
+            registry.counter(
+                "informer_index_lookups_total",
+                "Secondary-index lookups per informer",
+                ["kind", "index", "result"],
+            )
+            if registry is not None
+            else None
+        )
+
+    # ---- indexes ---------------------------------------------------------------
+
+    def add_indexer(self, name: str, fn: IndexFn) -> None:
+        """Register a secondary index (idempotent per name, client-go
+        AddIndexers). Safe after start: existing cache entries are indexed
+        on the spot."""
+        if name in self._index_fns:
+            return
+        self._index_fns[name] = fn
+        self._indexes[name] = {}
+        self._indexed_values[name] = {}
+        for key, obj in self.cache.items():
+            self._index_one(name, key, obj)
+
+    def has_indexer(self, name: str) -> bool:
+        return name in self._index_fns
+
+    def by_index(self, name: str, value: Hashable) -> list[dict]:
+        """Objects whose index fn emitted ``value`` — O(matches)."""
+        keys = self._indexes[name].get(value)  # KeyError for unknown index
+        if self._lookups is not None:
+            self._lookups.labels(
+                kind=self.kind, index=name, result="hit" if keys else "miss"
+            ).inc()
+        return [self.cache[k] for k in keys or () if k in self.cache]
+
+    def _index_one(self, name: str, key: tuple, obj: dict) -> None:
+        try:
+            values = list(self._index_fns[name](obj))
+        except Exception:
+            log.exception("index %s failed for %s %s", name, self.kind, key)
+            values = []
+        self._indexed_values[name][key] = values
+        for value in values:
+            self._indexes[name].setdefault(value, set()).add(key)
+
+    def _unindex_one(self, name: str, key: tuple) -> None:
+        for value in self._indexed_values[name].pop(key, ()):
+            bucket = self._indexes[name].get(value)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._indexes[name][value]
+
+    def _apply_delta(self, event: str, key: tuple, obj: dict) -> None:
+        """Single cache+index writer for watch deltas and relist diffs —
+        indexes can never drift from the cache because every mutation
+        funnels through here."""
+        for name in self._index_fns:
+            self._unindex_one(name, key)
+        if event == "DELETED":
+            self.cache.pop(key, None)
+        else:
+            self.cache[key] = obj
+            for name in self._index_fns:
+                self._index_one(name, key, obj)
+
+    # ---- handlers / lifecycle --------------------------------------------------
 
     def add_handler(self, fn: Handler) -> None:
         self._handlers.append(fn)
 
     def get(self, name: str, namespace: str | None = None) -> dict | None:
         return self.cache.get((namespace, name))
+
+    def evict(self, name: str, namespace: str | None = None) -> None:
+        """Drop one entry from the cache AND every index (controllers that
+        must not trust a possibly-stale read — e.g. after deleting the
+        object — use this instead of poking ``cache`` directly, which
+        would strand index entries). The watch repopulates it if the
+        object still exists."""
+        key = (namespace, name)
+        if key in self.cache:
+            self._apply_delta("DELETED", key, self.cache[key])
 
     def items(self) -> list[dict]:
         return list(self.cache.values())
@@ -75,11 +202,11 @@ class Informer:
                 fresh = {key_of(o): o for o in objs}
                 for key, obj in list(self.cache.items()):
                     if key not in fresh:
-                        del self.cache[key]
+                        self._apply_delta("DELETED", key, obj)
                         self._dispatch("DELETED", obj)
                 for key, obj in fresh.items():
                     existed = key in self.cache
-                    self.cache[key] = obj
+                    self._apply_delta("MODIFIED" if existed else "ADDED", key, obj)
                     self._dispatch("MODIFIED" if existed else "ADDED", obj)
                 self._synced.set()
                 # resource_version threads the list's snapshot into the watch
@@ -92,11 +219,7 @@ class Informer:
                     send_initial=False,
                     resource_version=rv,
                 ):
-                    key = (namespace_of(obj), name_of(obj))
-                    if event == "DELETED":
-                        self.cache.pop(key, None)
-                    else:
-                        self.cache[key] = obj
+                    self._apply_delta(event, (namespace_of(obj), name_of(obj)), obj)
                     self._dispatch(event, obj)
                 # watch closed cleanly → relist
             except asyncio.CancelledError:

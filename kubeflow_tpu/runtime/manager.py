@@ -22,7 +22,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
-from kubeflow_tpu.runtime.informer import Informer
+from kubeflow_tpu.runtime.informer import OWNER_INDEX, Informer, index_by_owner_uid
 from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.runtime.objects import controller_of, name_of, namespace_of
 from kubeflow_tpu.runtime.queue import RateLimitedQueue
@@ -55,6 +55,10 @@ class Controller:
     watches: list[Watch] = field(default_factory=list)
     workers: int = 2
     label_selector: str | dict | None = None
+    # Event-coalescing window (seconds) for the controller's workqueue: a
+    # burst of child events for one key triggers ONE reconcile at window
+    # close instead of one per event. 0 disables (see RateLimitedQueue).
+    coalesce_window: float = 0.0
 
 
 class Manager:
@@ -75,6 +79,11 @@ class Manager:
         self._queue_depth = self.registry.gauge(
             "controller_queue_depth", "Workqueue depth", ["controller"]
         )
+        self.reconcile_seconds = self.registry.histogram(
+            "controller_reconcile_seconds",
+            "Reconcile latency per controller",
+            ["controller"],
+        )
 
     def informer_for(
         self, kind: str, label_selector: str | dict | None = None
@@ -82,13 +91,14 @@ class Manager:
         key = (kind, str(label_selector) if label_selector else None)
         if key not in self.informers:
             self.informers[key] = Informer(
-                self.kube, kind, namespace=self.namespace, label_selector=label_selector
+                self.kube, kind, namespace=self.namespace,
+                label_selector=label_selector, registry=self.registry,
             )
         return self.informers[key]
 
     def add_controller(self, ctrl: Controller) -> None:
         self.controllers.append(ctrl)
-        queue = RateLimitedQueue()
+        queue = RateLimitedQueue(coalesce_window=ctrl.coalesce_window)
         self._queues[ctrl.name] = queue
 
         primary = self.informer_for(ctrl.kind, ctrl.label_selector)
@@ -100,7 +110,12 @@ class Manager:
                 queue.add((namespace_of(obj), ref["name"]))
 
         for child_kind in ctrl.owns:
-            self.informer_for(child_kind).add_handler(owner_handler)
+            child_inf = self.informer_for(child_kind)
+            child_inf.add_handler(owner_handler)
+            # client-go AddIndexers on every owned kind: reconcilers look
+            # children up with by_index(OWNER_INDEX, owner_uid) instead of
+            # scanning the cache (or LISTing the apiserver) per reconcile.
+            child_inf.add_indexer(OWNER_INDEX, index_by_owner_uid)
 
         for watch in ctrl.watches:
             inf = self.informer_for(watch.kind, watch.label_selector)
@@ -174,7 +189,7 @@ class Manager:
             try:
                 with self.tracer.span(
                     "reconcile", controller=ctrl.name, key=str(key)
-                ):
+                ), self.reconcile_seconds.time(controller=ctrl.name):
                     result = await ctrl.reconcile(key)
             except Exception:
                 log.exception("reconcile %s %s failed", ctrl.name, key)

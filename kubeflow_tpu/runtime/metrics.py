@@ -9,6 +9,7 @@ use: counters, gauges, histograms, labels, and text-format exposition.
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 
 
@@ -97,6 +98,21 @@ class Histogram(_Metric):
                     data["counts"][i] += 1
                     break  # collect() cumulates; counting once keeps buckets monotone
 
+    def time(self, **labels: str) -> "_Timer":
+        """``with hist.time(controller="notebook"): ...`` observes the
+        block's wall duration — the reconcile-latency idiom."""
+        return _Timer(self, labels)
+
+    def snapshot(self, **labels: str) -> dict:
+        """(count, sum) for one label set — lets the bench report mean
+        latency without parsing the exposition text."""
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            data = self._data.get(key)
+            return {"count": data["count"], "sum": data["sum"]} if data else \
+                {"count": 0, "sum": 0.0}
+
+
     def collect(self) -> list[str]:
         lines = [
             f"# HELP {self.name} {self.help}",
@@ -114,6 +130,19 @@ class Histogram(_Metric):
             lines.append(f"{self.name}_sum{_fmt_labels(labels)} {data['sum']}")
             lines.append(f"{self.name}_count{_fmt_labels(labels)} {data['count']}")
         return lines
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict):
+        self._hist = hist
+        self._labels = labels
+
+    def __enter__(self) -> "_Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._hist.observe(time.perf_counter() - self._t0, **self._labels)
 
 
 class Registry:

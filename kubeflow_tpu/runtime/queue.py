@@ -4,6 +4,12 @@ Deduplicates keys while queued, tracks in-flight keys so a key re-added during
 processing is re-queued afterwards, and applies per-item exponential backoff —
 the behaviors the reference's hot loop depends on (every pod event maps back
 to a Notebook reconcile, SURVEY.md §3.1).
+
+``coalesce_window`` adds per-key event coalescing: an immediate add
+(delay 0) is held for the window so a burst of child events for one owner
+— a slice's worth of pod status flaps, say — collapses into ONE reconcile
+at window close instead of one per event. Explicit delays (backoff,
+requeue_after) are never stretched by the window.
 """
 
 from __future__ import annotations
@@ -15,9 +21,16 @@ from typing import Hashable
 
 
 class RateLimitedQueue:
-    def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0):
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 60.0,
+        coalesce_window: float = 0.0,
+    ):
         self.base_delay = base_delay
         self.max_delay = max_delay
+        self.coalesce_window = coalesce_window
+        self.peak_depth = 0  # high-water mark of queued keys (bench telemetry)
         self._queue: list[tuple[float, int, Hashable]] = []  # (ready_at, seq, key)
         self._seq = 0
         self._queued: set[Hashable] = set()
@@ -44,6 +57,13 @@ class RateLimitedQueue:
         if key in self._in_flight:
             self._dirty.add(key)
             return
+        if delay == 0.0 and self.coalesce_window:
+            # Event-driven adds ride the coalescing window; because an add
+            # may only move a key EARLIER (below), every event inside the
+            # window lands on the first event's deadline — one reconcile
+            # per burst. Explicit delays (backoff/requeue_after) pass
+            # through untouched.
+            delay = self.coalesce_window
         ready_at = time.monotonic() + delay
         if key in self._queued:
             # Already queued: a NEW add may only move the key *earlier*
@@ -54,6 +74,7 @@ class RateLimitedQueue:
                 return
         else:
             self._queued.add(key)
+            self.peak_depth = max(self.peak_depth, len(self._queued))
         self._earliest[key] = min(ready_at, self._earliest.get(key, float("inf")))
         self._seq += 1
         heapq.heappush(self._queue, (ready_at, self._seq, key))

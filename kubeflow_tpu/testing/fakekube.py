@@ -65,6 +65,8 @@ class _Watch:
 class FakeKube:
     """The in-memory apiserver. All methods are async and deep-copy at the boundary."""
 
+    WRITE_VERBS = ("create", "update", "update_status", "patch", "delete")
+
     def __init__(self, scheme: Scheme | None = None):
         self.scheme = scheme or DEFAULT_SCHEME
         self._store: dict[str, dict[tuple[str | None, str], dict]] = defaultdict(dict)
@@ -74,6 +76,19 @@ class FakeKube:
         self._validators: list[tuple[str, Validator]] = []
         self._pod_logs: dict[tuple[str | None, str], str] = {}
         self._lock = asyncio.Lock()
+        # Per-verb request counter (client entry points only — cascade GC
+        # and admission are server-side work, not requests). Lets tests and
+        # the bench PROVE write elision: a steady-state no-op reconcile
+        # must move none of the write verbs.
+        self.requests: dict[str, int] = defaultdict(int)
+
+    def write_count(self) -> int:
+        """Mutating requests issued so far (no-op writes the server
+        swallowed still count — the client paid the round-trip)."""
+        return sum(self.requests[v] for v in self.WRITE_VERBS)
+
+    def reset_counts(self) -> None:
+        self.requests.clear()
 
     # ---- admission plugin registration ---------------------------------------
 
@@ -133,6 +148,7 @@ class FakeKube:
     # ---- KubeApi surface -----------------------------------------------------
 
     async def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
+        self.requests["get"] += 1
         bucket = self._bucket(kind)
         key = self._key(kind, name, namespace)
         obj = bucket.get(key)
@@ -160,6 +176,7 @@ class FakeKube:
         scans dominated the control-plane bench's profile otherwise.
         Callers must not mutate the returned objects.
         """
+        self.requests["list"] += 1
         selector = (
             parse_label_selector(label_selector)
             if isinstance(label_selector, str)
@@ -188,6 +205,7 @@ class FakeKube:
         return items, str(self._rv)
 
     async def create(self, kind: str, obj: dict, namespace: str | None = None) -> dict:
+        self.requests["create"] += 1
         async with self._lock:
             obj = deepcopy(obj)
             obj.setdefault("kind", kind)
@@ -214,6 +232,7 @@ class FakeKube:
             return deepcopy(obj)
 
     async def update(self, kind: str, obj: dict) -> dict:
+        self.requests["update"] += 1
         async with self._lock:
             obj = deepcopy(obj)
             bucket = self._bucket(kind)
@@ -255,6 +274,7 @@ class FakeKube:
             return deepcopy(obj)
 
     async def update_status(self, kind: str, obj: dict) -> dict:
+        self.requests["update_status"] += 1
         async with self._lock:
             bucket = self._bucket(kind)
             key = self._key(kind, obj, None)
@@ -281,6 +301,7 @@ class FakeKube:
     ) -> dict:
         """Strategic-ish merge patch: dicts merge recursively, None deletes,
         lists replace (the k8s merge-patch rule)."""
+        self.requests["patch"] += 1
         async with self._lock:
             bucket = self._bucket(kind)
             key = self._key(kind, name, namespace)
@@ -323,6 +344,7 @@ class FakeKube:
             return deepcopy(new)
 
     async def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
+        self.requests["delete"] += 1
         async with self._lock:
             key = self._key(kind, name, namespace)
             await self._delete_obj(kind, key)

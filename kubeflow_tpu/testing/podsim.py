@@ -58,6 +58,12 @@ class PodSimulator:
         # Strong refs: asyncio holds tasks weakly; un-referenced _run_pod
         # tasks can be GC'd mid-flight (pods stuck Pending, flaky tests).
         self._pod_tasks: set[asyncio.Task] = set()
+        # (namespace, owner uid) → pod names: the simulator's own owner
+        # index, updated synchronously on its own creates/deletes and from
+        # the pod watch for external actors. Replaces the per-event
+        # namespace-wide pod scans that made the kubelet sim O(pods-in-ns)
+        # per event — O(N²) across the load test's shared namespace.
+        self._owner_pods: dict[tuple, set[str]] = {}
         self._running = False
 
     async def start(self) -> None:
@@ -88,19 +94,38 @@ class PodSimulator:
             except ApiError:
                 pass
 
+    def _index_pod(self, event: str, pod: dict) -> dict | None:
+        """Fold one pod event into the owner index; returns the pod's
+        controller ownerReference (None for unowned pods)."""
+        owner = next(
+            (r for r in get_meta(pod).get("ownerReferences", [])
+             if r.get("controller")),
+            None,
+        )
+        if not owner or not owner.get("uid"):
+            return owner
+        key = (namespace_of(pod), owner["uid"])
+        if event == "DELETED":
+            names = self._owner_pods.get(key)
+            if names is not None:
+                names.discard(name_of(pod))
+                if not names:
+                    del self._owner_pods[key]
+        else:
+            self._owner_pods.setdefault(key, set()).add(name_of(pod))
+        return owner
+
     async def _watch_pods(self) -> None:
         """The real STS/Deployment controllers watch pods: an out-of-band pod
-        delete must trigger recreation from the owning workload."""
+        delete must trigger recreation from the owning workload. The same
+        stream keeps the owner index current for pods other actors
+        create/delete behind the simulator's back."""
         async for event, pod in self.kube.watch("Pod"):
             if not self._running:
                 return
+            owner = self._index_pod(event, pod)
             if event != "DELETED":
                 continue
-            owner = next(
-                (r for r in get_meta(pod).get("ownerReferences", [])
-                 if r.get("controller")),
-                None,
-            )
             if not owner or owner.get("kind") not in ("StatefulSet", "Deployment"):
                 continue
             wl = await self.kube.get_or_none(
@@ -126,20 +151,19 @@ class PodSimulator:
             pod_name = f"{name}-{i}" if kind == "StatefulSet" else f"{name}-rs-{i}"
             want[pod_name] = self._pod_from_template(pod_name, ns, template, obj)
 
-        existing = {
-            name_of(p): p
-            for p in await self.kube.list("Pod", ns, copy=False)
-            if any(
-                r.get("uid") == get_meta(obj).get("uid")
-                for r in get_meta(p).get("ownerReferences", [])
-            )
-        }
+        # Owner index, not a namespace scan; the simulator's own writes
+        # update it synchronously below, so it cannot lag its own actions
+        # (external deletes land via the pod watch; a double create hits
+        # AlreadyExists and a double delete hits NotFound — both benign).
+        owner_key = (ns, get_meta(obj).get("uid"))
+        existing = set(self._owner_pods.get(owner_key, ()))
         for pod_name, pod in want.items():
             if pod_name not in existing:
                 try:
                     created = await self.kube.create("Pod", pod)
                 except AlreadyExists:
                     continue
+                self._owner_pods.setdefault(owner_key, set()).add(pod_name)
                 task = asyncio.create_task(self._run_pod(created))
                 self._pod_tasks.add(task)
                 task.add_done_callback(self._pod_tasks.discard)
@@ -149,6 +173,9 @@ class PodSimulator:
                     await self.kube.delete("Pod", pod_name, ns)
                 except NotFound:
                     pass
+                names = self._owner_pods.get(owner_key)
+                if names is not None:
+                    names.discard(pod_name)
         await self._mirror_status(kind, obj, len(want))
 
     def _pod_from_template(self, pod_name: str, ns: str, template: dict, owner: dict) -> dict:
@@ -272,24 +299,30 @@ class PodSimulator:
             )
         except NotFound:
             return
-        owner_uid = next(
-            (r["uid"] for r in get_meta(pod).get("ownerReferences", []) if r.get("controller")),
+        # The pod's controller ref names its workload directly — no scan.
+        owner = next(
+            (r for r in get_meta(pod).get("ownerReferences", [])
+             if r.get("controller")),
             None,
         )
-        if owner_uid:
-            for kind in ("StatefulSet", "Deployment"):
-                for wl in await self.kube.list(kind, ns, copy=False):
-                    if get_meta(wl).get("uid") == owner_uid:
-                        await self._mirror_status(kind, wl, deep_get(wl, "spec", "replicas", default=1))
+        if owner and owner.get("kind") in ("StatefulSet", "Deployment"):
+            wl = await self.kube.get_or_none(owner["kind"], owner["name"], ns)
+            if wl is not None and get_meta(wl).get("uid") == owner.get("uid"):
+                await self._mirror_status(
+                    owner["kind"], wl,
+                    deep_get(wl, "spec", "replicas", default=1))
 
     async def _mirror_status(self, kind: str, obj: dict, replicas: int) -> None:
         ns = namespace_of(obj)
         ready = 0
-        for p in await self.kube.list("Pod", ns, copy=False):
-            if any(
-                r.get("uid") == get_meta(obj).get("uid")
-                for r in get_meta(p).get("ownerReferences", [])
-            ) and deep_get(p, "status", "phase") == "Running":
+        # Names from the owner index, phases from fresh GETs (a pod whose
+        # status another actor rewrote must count correctly) — O(replicas)
+        # instead of a namespace-wide scan.
+        for pod_name in list(
+            self._owner_pods.get((ns, get_meta(obj).get("uid")), ())
+        ):
+            p = await self.kube.get_or_none("Pod", pod_name, ns)
+            if p is not None and deep_get(p, "status", "phase") == "Running":
                 ready += 1
         status = {"replicas": replicas, "readyReplicas": ready}
         if kind == "Deployment":

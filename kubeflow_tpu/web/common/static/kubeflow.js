@@ -382,28 +382,44 @@ KF.renderTable = function (container, columns, rows, opts = {}) {
     // raw row fields would false-match on invisible data (ISO
     // timestamps rendered as ages, raw phase keys rendered as localized
     // labels) and never match computed cells, while action-button
-    // labels ("Delete") would match every row.
+    // labels ("Delete") would match every row. Button text is excluded
+    // STRUCTURALLY (skip the button subtree while walking) rather than
+    // by substring removal from the row's text — a row whose own data
+    // contains "Delete" must stay matchable.
     const cellText = (v) => {
       if (v == null) return "";
       if (typeof v === "string" || typeof v === "number") return String(v);
       if (Array.isArray(v)) return v.map(cellText).join(" ");
-      if (v.tagName === "BUTTON") return "";
-      if (v.querySelectorAll) {
-        let text = v.textContent || "";
-        for (const btn of v.querySelectorAll("button")) {
-          text = text.split(btn.textContent).join(" ");
-        }
+      // Text leaves FIRST: in a real browser Text nodes expose a (defined,
+      // empty) childNodes NodeList, so the element walk below would
+      // otherwise reduce every text leaf to "".
+      if (v.nodeType === 3) return v.textContent || "";
+      if (v.tagName === "BUTTON") return " ";
+      if (v.childNodes !== undefined) {
+        let text = "";
+        for (const child of v.childNodes) text += cellText(child);
         return text;
       }
       return v.textContent !== undefined ? v.textContent : "";
     };
-    filtered = rows.filter((row) =>
-      columns
-        .map((c) => cellText(c.render(row)))
-        .join(" ")
-        .toLowerCase()
-        .includes(q)
-    );
+    // Per-row filter text is computed ONCE per rows array (and locale)
+    // and reused across keystrokes — re-invoking every column's
+    // render() per keystroke scaled as rows × columns × keypresses. A
+    // data poll passes a fresh rows array, which invalidates the cache.
+    let cache = container._kfFilterText;
+    if (!cache || cache.rows !== rows || cache.locale !== KF.i18n.locale) {
+      cache = container._kfFilterText = {
+        rows,
+        locale: KF.i18n.locale,
+        text: rows.map((row) =>
+          columns
+            .map((c) => cellText(c.render(row)))
+            .join(" ")
+            .toLowerCase()
+        ),
+      };
+    }
+    filtered = rows.filter((row, i) => cache.text[i].includes(q));
   }
   const pageSize = opts.pageSize || 0;
   const pages = pageSize ? Math.max(1, Math.ceil(filtered.length / pageSize))

@@ -12,9 +12,12 @@ this repo's actual surfaces:
         tag, and prepends a changelog section generated from git history
         (subjects since the previous release tag).
 
-    python releasing/release.py check
+    python releasing/release.py check [EXPECTED_TAG]
         Exit 1 if VERSION, pyproject.toml and the manifest image tags
-        disagree — the drift gate the release workflow runs.
+        disagree — the drift gate the release workflow runs. With an
+        argument (the workflow passes "$GITHUB_REF_NAME"), also fail when
+        the pushed tag differs from VERSION — tagging a commit that was
+        never stamped (VERSION=dev expects "latest") must not release.
 
 Release-branch flow mirrors the reference: cut a branch, run set-version,
 commit, tag. `VERSION` of `dev` means manifests float on `:latest`.
@@ -110,6 +113,24 @@ def changelog_section(version: str) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _upsert_changelog_section(version: str, section: str) -> tuple[str, str]:
+    """Insert (or, when a ``## <version>`` heading already exists, replace
+    in place) the version's changelog section — re-running set-version on
+    a release branch must not stack duplicate sections."""
+    existing = open(CHANGELOG).read() if os.path.exists(CHANGELOG) else (
+        "# Changelog\n\n")
+    # (?=[ \n]) not \b: "## v1.2.3" must not match a "## v1.2.3-rc.0"
+    # heading (\b matches before the hyphen).
+    heading_re = re.compile(
+        rf"^## {re.escape(version)}(?=[ \n]).*?(?=^## |\Z)",
+        re.MULTILINE | re.DOTALL)
+    if heading_re.search(existing):
+        return heading_re.sub(lambda _m: section, existing, count=1), "replaced"
+    head, _, rest = existing.partition("\n## ")
+    body = head + "\n" + section + ("\n## " + rest if rest else "")
+    return body, "added"
+
+
 def cmd_set_version(version: str) -> int:
     if not re.fullmatch(r"v\d+\.\d+\.\d+(-[\w.]+)?", version):
         raise SystemExit(
@@ -118,17 +139,15 @@ def cmd_set_version(version: str) -> int:
     set_pyproject_version(version.lstrip("v"))
     changed = rewrite_manifest_tags(version)
     section = changelog_section(version)
-    existing = open(CHANGELOG).read() if os.path.exists(CHANGELOG) else (
-        "# Changelog\n\n")
-    head, _, rest = existing.partition("\n## ")
-    body = head + "\n" + section + ("\n## " + rest if rest else "")
+    body, action = _upsert_changelog_section(version, section)
     open(CHANGELOG, "w").write(body)
     print(f"VERSION={version}; pyproject={version.lstrip('v')}; "
-          f"manifests updated: {changed or 'none'}; changelog section added")
+          f"manifests updated: {changed or 'none'}; "
+          f"changelog section {action}")
     return 0
 
 
-def cmd_check() -> int:
+def cmd_check(expected: str | None = None) -> int:
     version = read_version()
     errors = []
     if version == "dev":
@@ -139,6 +158,14 @@ def cmd_check() -> int:
             errors.append(
                 f"pyproject version {pyproject_version()} != VERSION "
                 f"{version}")
+    if expected is not None and expected != expected_tag:
+        # The release workflow passes the pushed tag ($GITHUB_REF_NAME):
+        # a tag that doesn't match the stamped VERSION means the commit
+        # was never run through set-version (VERSION=dev expects the
+        # floating "latest") — refuse to release it.
+        errors.append(
+            f"expected tag {expected!r} != {expected_tag!r} derived from "
+            f"VERSION={version} (run set-version before tagging)")
     for image, tags in sorted(manifest_tags().items()):
         if tags != {expected_tag}:
             errors.append(
@@ -157,11 +184,14 @@ def main(argv=None) -> int:
     p_set = sub.add_parser("set-version",
                            help="stamp VERSION/pyproject/manifests")
     p_set.add_argument("version")
-    sub.add_parser("check", help="verify version/tag consistency")
+    p_check = sub.add_parser("check", help="verify version/tag consistency")
+    p_check.add_argument(
+        "expected", nargs="?", default=None,
+        help="tag being released (e.g. $GITHUB_REF_NAME); must match VERSION")
     args = parser.parse_args(argv)
     if args.cmd == "set-version":
         return cmd_set_version(args.version)
-    return cmd_check()
+    return cmd_check(args.expected)
 
 
 if __name__ == "__main__":

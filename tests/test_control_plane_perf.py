@@ -1,0 +1,282 @@
+"""Control-plane perf machinery: informer indexes, write elision, coalescing.
+
+The O(changes) contract (ISSUE 2): per-reconcile lookups are indexed cache
+reads, a no-op reconcile issues ZERO API writes (proven via the fakekube
+per-verb request counter), and event bursts coalesce into one reconcile.
+"""
+
+import asyncio
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.apply import ApplyCache, reconcile_child
+from kubeflow_tpu.runtime.informer import (
+    NAMESPACE_INDEX,
+    OWNER_INDEX,
+    Informer,
+    index_by_label,
+    index_by_namespace,
+    index_by_owner_uid,
+)
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import new_object, set_controller_owner
+from kubeflow_tpu.runtime.queue import RateLimitedQueue
+from kubeflow_tpu.testing import FakeKube
+
+
+# ---- write elision -----------------------------------------------------------
+
+
+async def test_noop_reconcile_issues_zero_api_writes():
+    """Acceptance: a second reconcile of an unchanged Notebook performs
+    ZERO API writes — the steady state costs reads only."""
+    from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+
+    kube = FakeKube()
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr)
+    await mgr.start()
+    try:
+        await kube.create("Notebook", nbapi.new("nb", "team"))
+        await mgr.wait_idle()
+        # Let every informer drain its watch queue (the reconcile's own
+        # writes — STS/Service creation, status — land as events).
+        await asyncio.sleep(0.05)
+        await mgr.wait_idle()
+
+        before = dict(kube.requests)
+        before_writes = kube.write_count()
+        mgr.enqueue("notebook", ("team", "nb"))
+        await mgr.wait_idle()
+        delta = kube.write_count() - before_writes
+        assert delta == 0, (
+            f"no-op reconcile issued {delta} API writes: {dict(kube.requests)}"
+        )
+        # The read path is informer-backed too: the only apiserver request
+        # a no-op reconcile makes is the Notebook GET at reconcile entry —
+        # every child read comes from the watch cache (this pins the
+        # reader wiring; a rebound _child_informers dict would silently
+        # fall back to per-child GETs).
+        gets = kube.requests["get"] - before.get("get", 0)
+        lists = kube.requests["list"] - before.get("list", 0)
+        assert gets <= 1 and lists == 0, dict(kube.requests)
+    finally:
+        await mgr.stop()
+        kube.close_watches()
+
+
+async def test_reconcile_child_elides_via_hash_and_reader():
+    kube = FakeKube()
+    cache = ApplyCache()
+    desired = new_object(
+        "Service", "svc", "ns",
+        spec={"ports": [{"port": 80}], "selector": {"app": "x"}},
+    )
+    live, created = await reconcile_child(kube, desired, cache=cache)
+    assert created
+
+    # Reader (informer stand-in) + unchanged desired state → zero API
+    # requests of any kind.
+    def reader(kind, name, ns):
+        return live
+
+    kube.reset_counts()
+    live2, created = await reconcile_child(
+        kube, new_object(
+            "Service", "svc", "ns",
+            spec={"ports": [{"port": 80}], "selector": {"app": "x"}},
+        ),
+        cache=cache, reader=reader,
+    )
+    assert not created
+    assert sum(kube.requests.values()) == 0
+    # The elided path hands back a copy, not the cached object itself.
+    assert live2 == live and live2 is not live
+
+    # Desired change → hash miss → real update.
+    kube.reset_counts()
+    live3, _ = await reconcile_child(
+        kube, new_object(
+            "Service", "svc", "ns",
+            spec={"ports": [{"port": 81}], "selector": {"app": "x"}},
+        ),
+        cache=cache, reader=reader,
+    )
+    assert kube.requests["update"] == 1
+    assert live3["spec"]["ports"][0]["port"] == 81
+
+
+async def test_status_elision_still_repairs_external_drift():
+    """The last-status hash must not make the controller blind: a status
+    someone else rewrote (kubectl, another client) is repaired on the next
+    reconcile even though the computed status hashes the same as before."""
+    from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+    from kubeflow_tpu.runtime.objects import deep_get
+
+    kube = FakeKube()
+    mgr = Manager(kube)
+    setup_notebook_controller(mgr)
+    await mgr.start()
+    try:
+        await kube.create("Notebook", nbapi.new("nb", "team"))
+        await mgr.wait_idle()
+        await asyncio.sleep(0.05)
+        await mgr.wait_idle()
+
+        # Clobber the status out-of-band.
+        await kube.patch(
+            "Notebook", "nb", {"status": {"readyReplicas": 99}}, "team",
+            subresource="status")
+        mgr.enqueue("notebook", ("team", "nb"))
+        await mgr.wait_idle()
+        nb = await kube.get("Notebook", "nb", "team")
+        assert deep_get(nb, "status", "readyReplicas") != 99, (
+            "externally drifted status was never repaired")
+    finally:
+        await mgr.stop()
+        kube.close_watches()
+
+
+async def test_apply_cache_is_lru_bounded():
+    cache = ApplyCache(max_entries=3)
+    for i in range(5):
+        cache.record(("Pod", "ns", f"p{i}"), f"h{i}", "1")
+    assert not cache.unchanged(("Pod", "ns", "p0"), "h0", "1")  # evicted
+    assert cache.unchanged(("Pod", "ns", "p4"), "h4", "1")
+
+
+# ---- index consistency -------------------------------------------------------
+
+
+async def test_by_index_consistent_across_deltas_and_relist():
+    """Acceptance: by_index stays consistent across ADDED / MODIFIED /
+    DELETED watch deltas AND a relist (watch close → list diff)."""
+    kube = FakeKube()
+    owner = await kube.create("Notebook", nbapi.new("own", "ns"))
+
+    inf = Informer(kube, "Pod", resync_backoff=0.01)
+    inf.add_indexer("nb", index_by_label("notebook-name"))
+    inf.add_indexer(NAMESPACE_INDEX, index_by_namespace)
+    inf.add_indexer(OWNER_INDEX, index_by_owner_uid)
+
+    pod = new_object("Pod", "p0", "ns", labels={"notebook-name": "a"}, spec={})
+    set_controller_owner(pod, owner)
+    await kube.create("Pod", pod)
+    await inf.start()
+
+    def names(index, value):
+        return sorted(o["metadata"]["name"] for o in inf.by_index(index, value))
+
+    assert names("nb", ("ns", "a")) == ["p0"]
+    assert names(NAMESPACE_INDEX, "ns") == ["p0"]
+    assert names(OWNER_INDEX, owner["metadata"]["uid"]) == ["p0"]
+
+    # ADDED
+    await kube.create(
+        "Pod", new_object("Pod", "p1", "ns", labels={"notebook-name": "a"},
+                          spec={}))
+    await asyncio.sleep(0.05)
+    assert names("nb", ("ns", "a")) == ["p0", "p1"]
+
+    # MODIFIED: label moves the pod between index buckets.
+    await kube.patch(
+        "Pod", "p1", {"metadata": {"labels": {"notebook-name": "b"}}}, "ns")
+    await asyncio.sleep(0.05)
+    assert names("nb", ("ns", "a")) == ["p0"]
+    assert names("nb", ("ns", "b")) == ["p1"]
+
+    # DELETED
+    await kube.delete("Pod", "p0", "ns")
+    await asyncio.sleep(0.05)
+    assert names("nb", ("ns", "a")) == []
+    assert names(OWNER_INDEX, owner["metadata"]["uid"]) == []
+
+    # Relist: close the watch stream; while the informer is down-stream,
+    # mutate the world so the relist diff must re-index everything.
+    kube.close_watches()
+    await kube.delete("Pod", "p1", "ns")
+    await kube.create(
+        "Pod", new_object("Pod", "p2", "ns", labels={"notebook-name": "a"},
+                          spec={}))
+    for _ in range(100):
+        await asyncio.sleep(0.01)
+        if inf.get("p2", "ns") is not None and inf.get("p1", "ns") is None:
+            break
+    assert names("nb", ("ns", "a")) == ["p2"]
+    assert names("nb", ("ns", "b")) == []
+    assert names(NAMESPACE_INDEX, "ns") == ["p2"]
+    await inf.stop()
+
+
+async def test_evict_clears_indexes():
+    kube = FakeKube()
+    inf = Informer(kube, "Pod")
+    inf.add_indexer(NAMESPACE_INDEX, index_by_namespace)
+    await kube.create("Pod", new_object("Pod", "p", "ns", spec={}))
+    await inf.start()
+    assert inf.by_index(NAMESPACE_INDEX, "ns")
+    inf.evict("p", "ns")
+    assert inf.get("p", "ns") is None
+    assert inf.by_index(NAMESPACE_INDEX, "ns") == []
+    await inf.stop()
+
+
+async def test_manager_registers_owner_index_for_owned_kinds():
+    from kubeflow_tpu.runtime.manager import Controller
+    from kubeflow_tpu.runtime.metrics import Registry
+
+    async def reconcile(key):
+        return None
+
+    kube = FakeKube()
+    mgr = Manager(kube, registry=Registry())
+    mgr.add_controller(
+        Controller("nb", "Notebook", reconcile, owns=["StatefulSet"]))
+    assert mgr.informer_for("StatefulSet").has_indexer(OWNER_INDEX)
+
+
+# ---- coalescing --------------------------------------------------------------
+
+
+async def test_queue_coalesces_event_bursts():
+    q = RateLimitedQueue(coalesce_window=0.03)
+    for _ in range(5):
+        q.add("k")  # a burst of child events for one key
+    assert len(q) == 1
+    t0 = asyncio.get_event_loop().time()
+    assert await asyncio.wait_for(q.get(), 1) == "k"
+    elapsed = asyncio.get_event_loop().time() - t0
+    assert elapsed >= 0.02, "coalescing window was not applied"
+    q.done("k")
+    # Explicit delays are not stretched by the window.
+    q.add("k2", delay=0.0)
+    q.add("k2", delay=0.5)   # later explicit delay must not move it later
+    assert q.ready_count() == 0
+    assert await asyncio.wait_for(q.get(), 1) == "k2"
+    q.done("k2")
+
+
+async def test_coalesced_burst_triggers_single_reconcile():
+    from kubeflow_tpu.runtime.manager import Controller
+    from kubeflow_tpu.runtime.metrics import Registry
+
+    calls = []
+
+    async def reconcile(key):
+        calls.append(key)
+        return None
+
+    kube = FakeKube()
+    mgr = Manager(kube, registry=Registry())
+    mgr.add_controller(
+        Controller("nb", "Notebook", reconcile, coalesce_window=0.05))
+    await mgr.start()
+    nb = await kube.create("Notebook", nbapi.new("nb", "ns"))
+    # Burst: several rapid updates, all inside the window.
+    for i in range(4):
+        await kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {"burst": str(i)}}}, "ns")
+    await mgr.wait_idle(settle=0.1)
+    assert len(calls) == 1, calls
+    await mgr.stop()
+    kube.close_watches()

@@ -737,3 +737,39 @@ def test_table_pagination_and_filter(jwa):
     b.set_value("#notebook-table .kf-table-filter", "")
     jwa.poll_ui()
     assert "1–25 of 30" in b.text("#notebook-table .kf-page-info")
+
+
+def test_filter_excludes_button_labels_structurally(jwa):
+    """Button text is excluded by skipping the button subtree, NOT by
+    substring-removing its label from the row: cell data that happens to
+    contain a button label ("Deleted by admin" vs the Delete action)
+    must stay matchable, while the button label alone matches nothing."""
+    b = jwa.browser
+    b.eval(
+        """
+        (function () {
+          const div = document.createElement("div");
+          div.id = "t-structural";
+          document.body.appendChild(div);
+          const rows = [{ msg: "Deleted by admin" }, { msg: "Running fine" }];
+          const columns = [{
+            title: "Message",
+            render: (r) =>
+              KF.el("span", {}, r.msg, KF.el("button", {}, "Delete")),
+          }];
+          KF.renderTable(div, columns, rows, { filterable: true });
+          div._kfSort.query = "deleted by";
+          div._kfRerender();
+        })()
+        """
+    )
+    text = b.text("#t-structural")
+    assert "Deleted by admin" in text, (
+        "global substring removal of the button label broke row data")
+    assert "Running fine" not in text
+    # The button label itself is not row data: no row matches it.
+    b.eval(
+        '(function () { const d = document.getElementById("t-structural");'
+        ' d._kfSort.query = "delete "; d._kfRerender(); })()'
+    )
+    assert "No rows match" in b.text("#t-structural")

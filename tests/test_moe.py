@@ -184,6 +184,49 @@ def test_switch_gate_keeps_router_gradient():
     assert float(jnp.abs(g).sum()) > 0, "router got no task-loss gradient"
 
 
+def test_kept_choice_with_zero_gate_keeps_gate_gradient():
+    """The combine path masks the gate gradient on the router's boolean
+    keep flags, NOT on ``all_scales > 0``: a kept choice whose
+    (renormalized) gate is exactly 0.0 still occupies a valid seat, and
+    its gate gradient must be the ⟨dy, expert-output⟩ inner product — a
+    zeroed gradient would freeze that gate at 0 forever."""
+    from kubeflow_tpu.parallel.moe import _combine_gather
+
+    d, n_seats = 4, 6
+    out_flat = jnp.arange(n_seats * d, dtype=jnp.float32).reshape(n_seats, d)
+    # One token, two choices: slot 1 kept with gate 0.5, slot 3 KEPT with
+    # an underflowed gate of exactly 0.0.
+    all_slots = jnp.array([[1, 3]], jnp.int32)
+    all_scales = jnp.array([[0.5, 0.0]], jnp.float32)
+    keep_mask = jnp.array([[True, True]])
+    seat_tok = jnp.zeros((n_seats,), jnp.int32)
+    seat_scale = jnp.zeros((n_seats,), jnp.float32) \
+        .at[1].set(0.5).at[3].set(0.0)
+
+    def y_sum(scales):
+        return _combine_gather(out_flat, all_slots, scales, keep_mask,
+                               seat_tok, seat_scale).sum()
+
+    dscale = jax.grad(y_sum)(all_scales)
+    # d y / d gate_j = sum(out_flat[slot_j]) for BOTH kept choices.
+    np.testing.assert_allclose(
+        np.asarray(dscale),
+        np.asarray([[float(out_flat[1].sum()), float(out_flat[3].sum())]]),
+        rtol=1e-6)
+    assert float(dscale[0, 1]) != 0.0, (
+        "kept choice with underflowed gate lost its gate gradient")
+
+    # A genuinely DROPPED choice (keep=False) stays masked to zero.
+    dropped_mask = jnp.array([[True, False]])
+
+    def y_sum_dropped(scales):
+        return _combine_gather(out_flat, all_slots, scales, dropped_mask,
+                               seat_tok, seat_scale).sum()
+
+    dscale2 = jax.grad(y_sum_dropped)(all_scales)
+    assert float(dscale2[0, 1]) == 0.0
+
+
 def test_expert_parallel_top2_matches_dense_reference():
     """The sharded top-2 path must equal the same math run unsharded —
     dispatch/combine through the two all_to_alls included."""

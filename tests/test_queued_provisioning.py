@@ -417,6 +417,12 @@ async def test_release_evicts_informer_cache():
     class FakeInformer:
         cache = {("ns", "stale-capacity"): pr}
 
+        def get(self, name, namespace=None):
+            return self.cache.get((namespace, name))
+
+        def evict(self, name, namespace=None):
+            self.cache.pop((namespace, name), None)
+
     rec._pr_informer = FakeInformer()
     nb = nbapi.new("stale", "ns", accelerator="v5e", topology="4x4",
                    queued=True)

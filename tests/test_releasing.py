@@ -73,6 +73,40 @@ def test_check_catches_drift(tmp_path):
     assert "controller" in proc.stderr
 
 
+def test_check_expected_tag_argument(tmp_path):
+    """check <tag> (the workflow passes $GITHUB_REF_NAME) fails when the
+    pushed tag differs from VERSION — including VERSION=dev, whose only
+    acceptable "tag" is the floating latest."""
+    tree = _copy_release_tree(tmp_path)
+    # VERSION=dev: a real release tag must be refused (commit not stamped).
+    proc = _run(tree, "check", "v1.2.3")
+    assert proc.returncode == 1
+    assert "expected tag" in proc.stderr
+    assert _run(tree, "check", "latest").returncode == 0
+
+    _run(tree, "set-version", "v1.2.3")
+    assert _run(tree, "check", "v1.2.3").returncode == 0
+    proc = _run(tree, "check", "v1.2.4")
+    assert proc.returncode == 1
+    assert "v1.2.4" in proc.stderr
+
+
+def test_set_version_changelog_is_idempotent(tmp_path):
+    """Re-running set-version replaces the existing ## <version> section
+    instead of stacking a duplicate."""
+    tree = _copy_release_tree(tmp_path)
+    _run(tree, "set-version", "v1.2.3")
+    _run(tree, "set-version", "v1.2.3")
+    changelog = (tree / "CHANGELOG.md").read_text()
+    assert changelog.count("## v1.2.3") == 1
+    # A distinct prerelease version is its own section, not a replacement
+    # target for the plain version (and vice versa).
+    _run(tree, "set-version", "v1.2.3-rc.0")
+    changelog = (tree / "CHANGELOG.md").read_text()
+    assert changelog.count("## v1.2.3-rc.0") == 1
+    assert changelog.count("## v1.2.3\n") + changelog.count("## v1.2.3 ") == 1
+
+
 def test_bad_version_rejected(tmp_path):
     tree = _copy_release_tree(tmp_path)
     proc = _run(tree, "set-version", "1.2.3")   # missing the v
