@@ -635,6 +635,92 @@ def _family_bench(peak_tflops: float | None) -> dict:
     return out
 
 
+def tracing_overhead() -> dict:
+    """`bench.py tracing_overhead` — prove the always-on tracing path
+    (span trees + flight recorder + API-call tagging, PR 3) costs <5% of
+    control-plane reconcile throughput vs the PR 2 baseline.
+
+    Runs the same `control_plane_scale` load test in PAIRS — each pair
+    is one traced (the shipped default) and one untraced (kill switch)
+    trial back-to-back, alternating order across pairs — and reports the
+    **median of per-pair overhead deltas**. Pairing is the point: host
+    load on a shared machine drifts between trials by more than the
+    effect size, but barely within a pair, and a load spike poisons one
+    pair instead of one whole arm (the median discards it). Two signals:
+
+    - `overhead_pct` — median per-pair throughput delta, the headline
+      and the <5% acceptance gate (`pass`);
+    - `reconcile_overhead_pct` — same pairing on the manager histogram's
+      mean reconcile latency (thousands of reconciles per trial), the
+      tighter per-reconcile signal.
+
+    Chip-free: the control plane runs on the in-process fake apiserver.
+    """
+    from kubeflow_tpu.runtime import tracing
+
+    pairs = 5
+
+    async def _run_phase(fn):
+        cp = await ControlPlane().start()
+        try:
+            return await fn(cp)
+        finally:
+            await cp.stop()
+
+    def one_trial(enabled: bool) -> dict:
+        tracing.set_enabled(enabled)
+        try:
+            return asyncio.run(_run_phase(scale_test))
+        finally:
+            tracing.set_enabled(True)
+
+    traced: list[dict] = []
+    untraced: list[dict] = []
+    deltas: list[float] = []
+    rec_deltas: list[float] = []
+    for i in range(pairs):
+        # Alternate order within the pair so warm-up/ordering effects
+        # cancel across pairs.
+        if i % 2 == 0:
+            on, off = one_trial(True), one_trial(False)
+        else:
+            off, on = one_trial(False), one_trial(True)
+        traced.append(on)
+        untraced.append(off)
+        deltas.append(
+            100.0 * (off["notebooks_per_sec"] - on["notebooks_per_sec"])
+            / off["notebooks_per_sec"])
+        if on.get("reconcile_mean_sec") and off.get("reconcile_mean_sec"):
+            rec_deltas.append(
+                100.0 * (on["reconcile_mean_sec"] - off["reconcile_mean_sec"])
+                / off["reconcile_mean_sec"])
+
+    overhead_pct = round(_median_sorted(sorted(deltas)), 2)
+    reconcile_overhead_pct = (
+        round(_median_sorted(sorted(rec_deltas)), 2) if rec_deltas else None)
+    return {
+        "metric": "tracing_overhead",
+        "value": overhead_pct,
+        "unit": "pct_throughput_regression",
+        "notebooks": SCALE_NOTEBOOKS,
+        "pairs": pairs,
+        "pair_deltas_pct": [round(d, 2) for d in deltas],
+        "traced_notebooks_per_sec": sorted(
+            t["notebooks_per_sec"] for t in traced),
+        "untraced_notebooks_per_sec": sorted(
+            t["notebooks_per_sec"] for t in untraced),
+        "traced_reconcile_mean_sec": _median_sorted(sorted(
+            t["reconcile_mean_sec"] for t in traced
+            if t.get("reconcile_mean_sec"))),
+        "untraced_reconcile_mean_sec": _median_sorted(sorted(
+            t["reconcile_mean_sec"] for t in untraced
+            if t.get("reconcile_mean_sec"))),
+        "overhead_pct": overhead_pct,
+        "reconcile_overhead_pct": reconcile_overhead_pct,
+        "pass": overhead_pct < 5.0,
+    }
+
+
 def bench() -> dict:
     from kubeflow_tpu.utils.compilecache import cache_entries, enable_persistent_cache
 
@@ -800,5 +886,7 @@ def bench() -> dict:
 if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--fresh-probe":
         _fresh_probe(float(sys.argv[2]) if len(sys.argv) > 2 else time.time())
+    elif len(sys.argv) >= 2 and sys.argv[1] == "tracing_overhead":
+        print(json.dumps(tracing_overhead()))
     else:
         print(json.dumps(bench()))

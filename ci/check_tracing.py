@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Lint: every controller registers its reconcile phases with the tracer.
+
+Grep-based by design (no imports, no event loop): a reconciler whose
+``reconcile`` body carries no ``with span(...)`` phases produces traces
+with an empty tree — /debug/traces would say "reconcile took 1.2 s" and
+nothing else, which is exactly the debugging dead-end the tracing
+subsystem exists to remove. Wired into the unit-test workflow by
+ci/pipelines.py; tests/test_ci_pipelines.py re-runs it in-process.
+
+A controller module (anything under kubeflow_tpu/controllers/ defining
+``async def reconcile``) must:
+
+- import ``span`` from kubeflow_tpu.runtime.tracing, and
+- open at least ``MIN_PHASES`` named phase spans, including the
+  ``cache_read`` phase every reconcile starts with.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONTROLLERS_DIR = os.path.join(REPO, "kubeflow_tpu", "controllers")
+
+MIN_PHASES = 2
+REQUIRED_PHASES = ("cache_read",)
+SPAN_RE = re.compile(r"with span\(\s*['\"]([a-z_]+)['\"]")
+IMPORT_RE = re.compile(
+    r"from kubeflow_tpu\.runtime\.tracing import .*\bspan\b"
+)
+
+
+def check_file(path: str) -> list[str]:
+    src = open(path).read()
+    if "async def reconcile(" not in src:
+        return []
+    rel = os.path.relpath(path, REPO)
+    problems = []
+    if not IMPORT_RE.search(src):
+        problems.append(
+            f"{rel}: defines a reconciler but never imports span from "
+            "kubeflow_tpu.runtime.tracing"
+        )
+    phases = SPAN_RE.findall(src)
+    if len(set(phases)) < MIN_PHASES:
+        problems.append(
+            f"{rel}: reconciler opens {len(set(phases))} distinct phase "
+            f"span(s) ({sorted(set(phases))}); at least {MIN_PHASES} "
+            "required — wrap the reconcile phases (cache_read/apply/"
+            "status/...) in `with span(...)`"
+        )
+    for required in REQUIRED_PHASES:
+        if required not in phases:
+            problems.append(
+                f"{rel}: missing the `{required}` phase span"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = []
+    for fname in sorted(os.listdir(CONTROLLERS_DIR)):
+        if fname.endswith(".py"):
+            problems.extend(check_file(os.path.join(CONTROLLERS_DIR, fname)))
+    for p in problems:
+        print(f"check_tracing: {p}", file=sys.stderr)
+    if not problems:
+        print("check_tracing: all controllers register reconcile phases")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

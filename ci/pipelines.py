@@ -103,6 +103,8 @@ def workflow_tests() -> dict:
                     {"uses": "actions/setup-python@v5",
                      "with": {"python-version": "${{ matrix.python }}"}},
                     run(None, PIP_INSTALL),
+                    run("Lint: controllers register reconcile phases with the tracer",
+                        "python ci/check_tracing.py"),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

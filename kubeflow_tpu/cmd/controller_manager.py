@@ -26,23 +26,61 @@ from kubeflow_tpu.runtime.metrics import global_registry
 log = logging.getLogger(__name__)
 
 
-async def serve_health_and_metrics(port: int) -> web.AppRunner:
-    """/healthz /readyz /metrics like the reference manager
-    (notebook-controller/main.go:65-66,125-133)."""
+def build_manager_app(mgr=None) -> web.Application:
+    """The manager's introspection app: probes, /metrics, and the /debug
+    surface (controller-runtime's pprof/zpages idiom rebuilt):
+
+    - ``/debug/traces[?key=ns/name&limit=N]`` — flight-recorder entries:
+      the span tree (queue wait, cache read, apply, status), API verbs,
+      events, and outcome of recent reconciles, retained per object.
+    - ``/debug/queue`` — per-controller workqueue depth, in-flight keys,
+      backoff keys with their next delay, oldest queue wait.
+    - ``/debug/informers`` — cache sync state, object counts, and
+      secondary-index hit/miss per informer.
+    """
     app = web.Application()
 
     async def ok(_request):
         return web.json_response({"status": "ok"})
 
     async def metrics(_request):
+        registry = mgr.registry if mgr is not None else global_registry
         return web.Response(
-            text=global_registry.expose(), content_type="text/plain"
+            text=registry.expose(), content_type="text/plain"
         )
 
     app.router.add_get("/healthz", ok)
     app.router.add_get("/readyz", ok)
     app.router.add_get("/metrics", metrics)
-    runner = web.AppRunner(app)
+    if mgr is not None:
+        async def debug_traces(request):
+            try:
+                limit = int(request.query.get("limit", "50"))
+            except ValueError:
+                limit = 50
+            return web.json_response({
+                "traces": mgr.debug_traces(
+                    key=request.query.get("key"), limit=limit
+                ),
+            })
+
+        async def debug_queue(_request):
+            return web.json_response({"queues": mgr.debug_queues()})
+
+        async def debug_informers(_request):
+            return web.json_response({"informers": mgr.debug_informers()})
+
+        app.router.add_get("/debug/traces", debug_traces)
+        app.router.add_get("/debug/queue", debug_queue)
+        app.router.add_get("/debug/informers", debug_informers)
+    return app
+
+
+async def serve_health_and_metrics(port: int, mgr=None) -> web.AppRunner:
+    """/healthz /readyz /metrics like the reference manager
+    (notebook-controller/main.go:65-66,125-133), plus /debug/* when a
+    manager is attached."""
+    runner = web.AppRunner(build_manager_app(mgr))
     await runner.setup()
     site = web.TCPSite(runner, "0.0.0.0", port)
     await site.start()
@@ -65,7 +103,7 @@ async def amain() -> None:
     setup_pvcviewer_controller(mgr, envconfig.pvcviewer_options())
 
     health = await serve_health_and_metrics(
-        int(os.environ.get("METRICS_PORT", "8080"))
+        int(os.environ.get("METRICS_PORT", "8080")), mgr
     )
     elector = None
     if envconfig.env_bool("LEADER_ELECT", False):

@@ -35,6 +35,7 @@ from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.runtime.objects import deep_get, get_meta
 from kubeflow_tpu.runtime.objects import fmt_iso as _fmt_time
 from kubeflow_tpu.runtime.objects import parse_iso as _parse_time
+from kubeflow_tpu.runtime.tracing import span
 
 log = logging.getLogger(__name__)
 
@@ -148,27 +149,29 @@ class CullingReconciler:
         requeue = Result(requeue_after=self.opts.check_period_seconds)
         if not self.opts.enable_culling:
             return None
-        nb = await self.kube.get_or_none("Notebook", name, ns)
+        with span("cache_read"):
+            nb = await self.kube.get_or_none("Notebook", name, ns)
         if nb is None or get_meta(nb).get("deletionTimestamp"):
             return None
         if nbapi.is_stopped(nb):
             return None  # already parked; notebook reconciler owns restart
 
         now = self.clock()
-        urls = await self._probe_urls(nb, name, ns)
-        if urls is None:
-            return requeue  # auth-proxied pod IP not known yet
-        kernels = await self.prober(urls["kernels"])
-        if kernels is None:
-            # Kernels probe unreachable/invalid (server starting, crashed, or
-            # mid-restart): without it a busy kernel is indistinguishable
-            # from idle — never make a cull decision on a failed probe
-            # (reference skips and retries, :226-239).
-            return requeue
-        # Terminals are tolerated missing (servers run with terminals
-        # disabled → 404 forever; hard-requiring it would block culling
-        # permanently). Kernels above are the authoritative busy signal.
-        terminals = await self.prober(urls["terminals"])
+        with span("probe"):
+            urls = await self._probe_urls(nb, name, ns)
+            if urls is None:
+                return requeue  # auth-proxied pod IP not known yet
+            kernels = await self.prober(urls["kernels"])
+            if kernels is None:
+                # Kernels probe unreachable/invalid (server starting,
+                # crashed, or mid-restart): without it a busy kernel is
+                # indistinguishable from idle — never make a cull decision
+                # on a failed probe (reference skips and retries, :226-239).
+                return requeue
+            # Terminals are tolerated missing (servers run with terminals
+            # disabled → 404 forever; hard-requiring it would block culling
+            # permanently). Kernels above are the authoritative busy signal.
+            terminals = await self.prober(urls["terminals"])
 
         annotations = dict(get_meta(nb).get("annotations") or {})
         last_activity = _parse_time(
@@ -189,34 +192,35 @@ class CullingReconciler:
             nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION: _fmt_time(now),
         }
 
-        if not busy and now - last_activity > self.opts.cull_idle_seconds:
-            patch_annotations[nbapi.STOP_ANNOTATION] = _fmt_time(now)
-            try:
-                await self.kube.patch(
-                    "Notebook", name,
-                    {"metadata": {"annotations": patch_annotations}}, ns,
+        with span("status"):
+            if not busy and now - last_activity > self.opts.cull_idle_seconds:
+                patch_annotations[nbapi.STOP_ANNOTATION] = _fmt_time(now)
+                try:
+                    await self.kube.patch(
+                        "Notebook", name,
+                        {"metadata": {"annotations": patch_annotations}}, ns,
+                    )
+                except ApiError:
+                    return requeue
+                idle_min = (now - last_activity) / 60
+                await self.recorder.event(
+                    nb, "Normal", "NotebookCulled",
+                    f"Notebook idle for {idle_min:.0f} min; scaled to zero",
                 )
-            except ApiError:
-                return requeue
-            idle_min = (now - last_activity) / 60
-            await self.recorder.event(
-                nb, "Normal", "NotebookCulled",
-                f"Notebook idle for {idle_min:.0f} min; scaled to zero",
-            )
-            self.m_culled.inc()
-            self.m_last_cull.labels(namespace=ns or "", notebook=name).set(now)
-            chips = deep_get(nb, "status", "tpu", "chips", default=0) or 0
-            if chips:
-                self.m_chips_culled.inc(chips)
-            return None  # parked; nothing to poll until restarted
-        if any(annotations.get(k) != v for k, v in patch_annotations.items()):
-            try:
-                await self.kube.patch(
-                    "Notebook", name,
-                    {"metadata": {"annotations": patch_annotations}}, ns,
-                )
-            except ApiError:
-                pass
+                self.m_culled.inc()
+                self.m_last_cull.labels(namespace=ns or "", notebook=name).set(now)
+                chips = deep_get(nb, "status", "tpu", "chips", default=0) or 0
+                if chips:
+                    self.m_chips_culled.inc(chips)
+                return None  # parked; nothing to poll until restarted
+            if any(annotations.get(k) != v for k, v in patch_annotations.items()):
+                try:
+                    await self.kube.patch(
+                        "Notebook", name,
+                        {"metadata": {"annotations": patch_annotations}}, ns,
+                    )
+                except ApiError:
+                    pass
         return requeue
 
 

@@ -68,6 +68,7 @@ from kubeflow_tpu.runtime.objects import (
     set_controller_owner,
     uid_of,
 )
+from kubeflow_tpu.runtime.tracing import span
 from kubeflow_tpu.tpu.topology import JAX_COORDINATOR_PORT, TpuSlice
 
 log = logging.getLogger(__name__)
@@ -270,7 +271,11 @@ class NotebookReconciler:
 
     async def reconcile(self, key) -> Result | None:
         namespace, name = key
-        nb = await self.kube.get_or_none("Notebook", name, namespace)
+        # Phase spans: every section below lands in the reconcile's trace
+        # tree (manager opens the root + queue_wait), so /debug/traces
+        # shows which phase ate the time when a Notebook sticks.
+        with span("cache_read"):
+            nb = await self.kube.get_or_none("Notebook", name, namespace)
         if nb is None or get_meta(nb).get("deletionTimestamp"):
             self._mirrored.pop((namespace, name), None)
             self._last_status.pop((namespace, name), None)
@@ -287,6 +292,26 @@ class NotebookReconciler:
             return None
         tpu = ms.slice if ms else None
 
+        with span("apply"):
+            capacity_pending, capacity_requeue = \
+                await self._apply_children(nb, ms, tpu)
+
+        with span("status"):
+            pods = await self._worker_pods(nb)  # one lookup, shared by the tail
+            requeue = await self._restart_broken_slice(nb, ms, pods)
+            await self._check_maintenance(nb, pods)
+            await self._mirror_events(nb, pods)
+            await self._update_status(nb, ms, capacity_pending=capacity_pending)
+        if capacity_pending:
+            return capacity_requeue
+        return requeue
+
+    async def _apply_children(
+        self, nb: dict, ms, tpu
+    ) -> tuple[bool, Result | None]:
+        """The child-object phase of reconcile: capacity gate, per-slice
+        StatefulSets, Services, RBAC. Returns (capacity_pending,
+        capacity_requeue)."""
         if self.opts.trusted_ca_configmap:
             await self._mirror_ca_bundle(nb)
 
@@ -298,6 +323,7 @@ class NotebookReconciler:
         # DNS is ready the moment pods land.
         capacity_pending = False
         capacity_provisioned = True
+        capacity_requeue: Result | None = None
         if (ms and nbapi.queued_provisioning(nb)
                 and self.opts.enable_queued_provisioning
                 and nbapi.is_stopped(nb)):
@@ -328,9 +354,10 @@ class NotebookReconciler:
         # name, zero churn for the common case.
         for slice_id in range(0 if capacity_pending
                               else (ms.num_slices if ms else 1)):
-            sts = self.generate_statefulset(
-                nb, tpu, multi=ms, slice_id=slice_id,
-                capacity_provisioned=capacity_provisioned)
+            with span("build_children", kind="StatefulSet", slice=slice_id):
+                sts = self.generate_statefulset(
+                    nb, tpu, multi=ms, slice_id=slice_id,
+                    capacity_provisioned=capacity_provisioned)
             if not capacity_provisioned:
                 # Sticky consume annotation: when the request is (or has
                 # become) unprovisioned over a LIVE gang — e.g. the PR was
@@ -360,14 +387,7 @@ class NotebookReconciler:
             await self._ensure(nb, self.generate_network_policy(nb, tpu))
 
         await self._ensure_pipeline_rbac(nb)
-        pods = await self._worker_pods(nb)  # one LIST, shared by the tail
-        requeue = await self._restart_broken_slice(nb, ms, pods)
-        await self._check_maintenance(nb, pods)
-        await self._mirror_events(nb, pods)
-        await self._update_status(nb, ms, capacity_pending=capacity_pending)
-        if capacity_pending:
-            return capacity_requeue
-        return requeue
+        return capacity_pending, capacity_requeue
 
     async def _live_sts(self, name: str, ns: str) -> dict | None:
         """Informer-cached StatefulSet read with apiserver fallback. The

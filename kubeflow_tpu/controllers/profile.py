@@ -36,6 +36,7 @@ from kubeflow_tpu.runtime.objects import (
     now_iso,
     set_controller_owner,
 )
+from kubeflow_tpu.runtime.tracing import span
 
 log = logging.getLogger(__name__)
 
@@ -153,7 +154,8 @@ class ProfileReconciler:
 
     async def reconcile(self, key) -> Result | None:
         _, name = key
-        profile = await self.kube.get_or_none("Profile", name)
+        with span("cache_read"):
+            profile = await self.kube.get_or_none("Profile", name)
         if profile is None:
             return None
         if get_meta(profile).get("deletionTimestamp"):
@@ -161,22 +163,25 @@ class ProfileReconciler:
             return None
 
         try:
-            await self._ensure_finalizer(profile)
-            await self._reconcile_namespace(profile)
-            await self._reconcile_service_accounts(profile)
-            await self._reconcile_role_bindings(profile)
-            if self.opts.use_istio:
-                await reconcile_child(
-                    self.kube, self._authorization_policy(profile)
-                )
-            await self._reconcile_quota(profile)
-            await self._apply_plugins(profile)
+            with span("apply"):
+                await self._ensure_finalizer(profile)
+                await self._reconcile_namespace(profile)
+                await self._reconcile_service_accounts(profile)
+                await self._reconcile_role_bindings(profile)
+                if self.opts.use_istio:
+                    await reconcile_child(
+                        self.kube, self._authorization_policy(profile)
+                    )
+                await self._reconcile_quota(profile)
+                await self._apply_plugins(profile)
         except ApiError as e:
             self.m_failure.labels(profile=name).inc()
-            await self._set_condition(profile, profileapi.FAILED, str(e))
+            with span("status"):
+                await self._set_condition(profile, profileapi.FAILED, str(e))
             raise
         self.m_update.labels(profile=name).inc()
-        await self._set_condition(profile, profileapi.SUCCEED, "")
+        with span("status"):
+            await self._set_condition(profile, profileapi.SUCCEED, "")
         return None
 
     # ---- pieces -----------------------------------------------------------------

@@ -31,6 +31,7 @@ from kubeflow_tpu.runtime.objects import (
     namespace_of,
     set_controller_owner,
 )
+from kubeflow_tpu.runtime.tracing import span
 
 log = logging.getLogger(__name__)
 
@@ -56,25 +57,29 @@ class PVCViewerReconciler:
 
     async def reconcile(self, key) -> Result | None:
         ns, name = key
-        viewer = await self.kube.get_or_none("PVCViewer", name, ns)
+        with span("cache_read"):
+            viewer = await self.kube.get_or_none("PVCViewer", name, ns)
         if viewer is None or get_meta(viewer).get("deletionTimestamp"):
             return None
         pvcapi.default(viewer)  # idempotent; covers CRs that bypassed admission
 
-        deployment = await self.generate_deployment(viewer)
-        children = [deployment, self.generate_service(viewer)]
-        if self.opts.use_istio:
-            children.append(self.generate_virtual_service(viewer))
+        with span("build_children"):
+            deployment = await self.generate_deployment(viewer)
+            children = [deployment, self.generate_service(viewer)]
+            if self.opts.use_istio:
+                children.append(self.generate_virtual_service(viewer))
         live_deployment = None
-        for desired in children:
-            set_controller_owner(desired, viewer)
-            live, _ = await reconcile_child(
-                self.kube, desired,
-                cache=self._apply_cache, reader=self._reader,
-            )
-            if desired["kind"] == "Deployment":
-                live_deployment = live
-        await self._update_status(viewer, live_deployment)
+        with span("apply"):
+            for desired in children:
+                set_controller_owner(desired, viewer)
+                live, _ = await reconcile_child(
+                    self.kube, desired,
+                    cache=self._apply_cache, reader=self._reader,
+                )
+                if desired["kind"] == "Deployment":
+                    live_deployment = live
+        with span("status"):
+            await self._update_status(viewer, live_deployment)
         return None
 
     async def generate_deployment(self, viewer: dict) -> dict:

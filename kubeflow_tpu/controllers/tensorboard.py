@@ -38,6 +38,7 @@ from kubeflow_tpu.runtime.objects import (
     namespace_of,
     set_controller_owner,
 )
+from kubeflow_tpu.runtime.tracing import span
 
 log = logging.getLogger(__name__)
 
@@ -68,26 +69,32 @@ class TensorboardReconciler:
 
     async def reconcile(self, key) -> Result | None:
         ns, name = key
-        tb = await self.kube.get_or_none("Tensorboard", name, ns)
+        with span("cache_read"):
+            tb = await self.kube.get_or_none("Tensorboard", name, ns)
         if tb is None or get_meta(tb).get("deletionTimestamp"):
             return None
-        try:
-            deployment = await self.generate_deployment(tb)
-        except Invalid as e:
-            log.warning("tensorboard %s/%s: %s", ns, name, e)
-            return None
-        live_deployment = None
-        for desired in [deployment, self.generate_service(tb)] + (
-            [self.generate_virtual_service(tb)] if self.opts.use_istio else []
-        ):
-            set_controller_owner(desired, tb)
-            live, _ = await reconcile_child(
-                self.kube, desired,
-                cache=self._apply_cache, reader=self._reader,
+        with span("build_children"):
+            try:
+                deployment = await self.generate_deployment(tb)
+            except Invalid as e:
+                log.warning("tensorboard %s/%s: %s", ns, name, e)
+                return None
+            children = [deployment, self.generate_service(tb)] + (
+                [self.generate_virtual_service(tb)]
+                if self.opts.use_istio else []
             )
-            if desired["kind"] == "Deployment":
-                live_deployment = live
-        await self._update_status(tb, live_deployment)
+        live_deployment = None
+        with span("apply"):
+            for desired in children:
+                set_controller_owner(desired, tb)
+                live, _ = await reconcile_child(
+                    self.kube, desired,
+                    cache=self._apply_cache, reader=self._reader,
+                )
+                if desired["kind"] == "Deployment":
+                    live_deployment = live
+        with span("status"):
+            await self._update_status(tb, live_deployment)
         return None
 
     async def generate_deployment(self, tb: dict) -> dict:

@@ -16,6 +16,7 @@ from collections import OrderedDict
 
 from kubeflow_tpu.runtime.errors import AlreadyExists, Conflict, NotFound
 from kubeflow_tpu.runtime.metrics import global_registry
+from kubeflow_tpu.runtime.tracing import span
 from kubeflow_tpu.runtime.objects import (
     deep_get,
     deepcopy,
@@ -232,35 +233,40 @@ async def reconcile_child(
     ckey = ApplyCache.key_of(desired) if cache is not None else None
     dh = state_hash(desired) if cache is not None else None
 
-    live = reader(kind, name, namespace) if reader is not None else None
-    if live is not None:
-        if cache is not None and cache.unchanged(
-            ckey, dh, get_meta(live).get("resourceVersion")
-        ):
-            M_ELIDED.labels(kind=kind, via="hash").inc()
-            return deepcopy(live), False
-        # The copier folds fields INTO live; never mutate the informer's
-        # stored object.
-        live = deepcopy(live)
-    if live is None:
-        try:
-            live = await kube.get(kind, name, namespace)
-        except NotFound:
+    with span("apply_child", kind=kind, name=name) as sp:
+        live = reader(kind, name, namespace) if reader is not None else None
+        if live is not None:
+            if cache is not None and cache.unchanged(
+                ckey, dh, get_meta(live).get("resourceVersion")
+            ):
+                M_ELIDED.labels(kind=kind, via="hash").inc()
+                sp.set_attribute("outcome", "elided_hash")
+                return deepcopy(live), False
+            # The copier folds fields INTO live; never mutate the informer's
+            # stored object.
+            live = deepcopy(live)
+        if live is None:
             try:
-                created = await kube.create(kind, desired)
-                if cache is not None:
-                    cache.record(
-                        ckey, dh, get_meta(created).get("resourceVersion"))
-                return created, True
-            except AlreadyExists:
                 live = await kube.get(kind, name, namespace)
-    if copier(desired, live):
-        log.debug("updating %s %s/%s (drift)", kind, namespace, name)
-        updated = await kube.update(kind, live)
+            except NotFound:
+                try:
+                    created = await kube.create(kind, desired)
+                    if cache is not None:
+                        cache.record(
+                            ckey, dh, get_meta(created).get("resourceVersion"))
+                    sp.set_attribute("outcome", "created")
+                    return created, True
+                except AlreadyExists:
+                    live = await kube.get(kind, name, namespace)
+        if copier(desired, live):
+            log.debug("updating %s %s/%s (drift)", kind, namespace, name)
+            updated = await kube.update(kind, live)
+            if cache is not None:
+                cache.record(ckey, dh, get_meta(updated).get("resourceVersion"))
+            sp.set_attribute("outcome", "updated")
+            return updated, False
+        M_ELIDED.labels(kind=kind, via="diff").inc()
+        sp.set_attribute("outcome", "elided_diff")
         if cache is not None:
-            cache.record(ckey, dh, get_meta(updated).get("resourceVersion"))
-        return updated, False
-    M_ELIDED.labels(kind=kind, via="diff").inc()
-    if cache is not None:
-        cache.record(ckey, dh, get_meta(live).get("resourceVersion"))
-    return live, False
+            cache.record(ckey, dh, get_meta(live).get("resourceVersion"))
+        return live, False

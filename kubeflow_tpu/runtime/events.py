@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import hashlib
 
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.runtime.errors import ApiError, NotFound
 from kubeflow_tpu.runtime.objects import name_of, namespace_of, uid_of
 from kubeflow_tpu.runtime.objects import now_iso as _now
@@ -22,6 +23,9 @@ class EventRecorder:
     async def event(
         self, obj: dict, event_type: str, reason: str, message: str
     ) -> None:
+        # The flight-recorder entry lists the reasons a reconcile emitted,
+        # next to the API verbs it issued.
+        tracing.note_event(reason)
         namespace = namespace_of(obj) or "default"
         ref = {
             "apiVersion": obj.get("apiVersion"),

@@ -16,6 +16,7 @@ from typing import AsyncIterator
 
 import aiohttp
 
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.runtime.errors import error_for_code
 from kubeflow_tpu.runtime.objects import name_of, namespace_of, selector_to_string
 from kubeflow_tpu.runtime.scheme import DEFAULT_SCHEME, Scheme
@@ -69,8 +70,21 @@ class HttpKube:
             url += f"/{name}"
         return url
 
-    async def _request(self, method: str, url: str, **kw) -> dict:
+    async def _request(
+        self, method: str, url: str, *, verb: str | None = None,
+        kind: str | None = None, **kw,
+    ) -> dict:
         sess = await self._sess()
+        # Correlate with the active reconcile trace: the trace id travels
+        # as X-Request-Id, so the apiserver audit log and this process's
+        # flight recorder describe the same request by the same id. The
+        # verb/kind tag lands on the trace's root span (api_calls).
+        tracing.note_api_call(verb or method.lower(), kind)
+        trace_id = tracing.current_trace_id()
+        if trace_id:
+            headers = dict(kw.pop("headers", None) or {})
+            headers.setdefault("X-Request-Id", trace_id)
+            kw["headers"] = headers
         async with sess.request(method, url, ssl=self._ssl, **kw) as resp:
             body = await resp.text()
             if resp.status >= 400:
@@ -90,7 +104,9 @@ class HttpKube:
     # ---- KubeApi surface -----------------------------------------------------
 
     async def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
-        return await self._request("GET", self._url(kind, namespace, name))
+        return await self._request(
+            "GET", self._url(kind, namespace, name), verb="get", kind=kind
+        )
 
     async def list(
         self,
@@ -114,7 +130,10 @@ class HttpKube:
         sel = selector_to_string(label_selector)
         if sel:
             params["labelSelector"] = sel
-        data = await self._request("GET", self._url(kind, namespace), params=params)
+        data = await self._request(
+            "GET", self._url(kind, namespace), verb="list", kind=kind,
+            params=params,
+        )
         items = data.get("items", [])
         gvk = self.scheme.by_kind(kind)
         for item in items:
@@ -126,16 +145,21 @@ class HttpKube:
 
     async def create(self, kind: str, obj: dict, namespace: str | None = None) -> dict:
         ns = namespace or namespace_of(obj)
-        return await self._request("POST", self._url(kind, ns), json=obj)
+        return await self._request(
+            "POST", self._url(kind, ns), verb="create", kind=kind, json=obj
+        )
 
     async def update(self, kind: str, obj: dict) -> dict:
         return await self._request(
-            "PUT", self._url(kind, namespace_of(obj), name_of(obj)), json=obj
+            "PUT", self._url(kind, namespace_of(obj), name_of(obj)),
+            verb="update", kind=kind, json=obj,
         )
 
     async def update_status(self, kind: str, obj: dict) -> dict:
         url = self._url(kind, namespace_of(obj), name_of(obj)) + "/status"
-        return await self._request("PUT", url, json=obj)
+        return await self._request(
+            "PUT", url, verb="update_status", kind=kind, json=obj
+        )
 
     async def patch(
         self,
@@ -151,6 +175,8 @@ class HttpKube:
         return await self._request(
             "PATCH",
             url,
+            verb="patch",
+            kind=kind,
             data=json.dumps(patch),
             headers={"Content-Type": "application/merge-patch+json"},
         )
@@ -159,6 +185,8 @@ class HttpKube:
         await self._request(
             "DELETE",
             self._url(kind, namespace, name),
+            verb="delete",
+            kind=kind,
             json={"propagationPolicy": "Background"},
         )
 

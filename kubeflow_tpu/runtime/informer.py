@@ -86,6 +86,11 @@ class Informer:
         self._index_fns: dict[str, IndexFn] = {}
         self._indexes: dict[str, dict[Hashable, set]] = {}
         self._indexed_values: dict[str, dict[tuple, list[Hashable]]] = {}
+        # Per-index [hits, misses] — the registry metric aggregates the
+        # same numbers fleet-wide; these local counters feed the
+        # per-informer /debug/informers view without a registry scrape.
+        self._index_stats: dict[str, list[int]] = {}
+        self._relists = 0
         self._lookups = (
             registry.counter(
                 "informer_index_lookups_total",
@@ -116,6 +121,8 @@ class Informer:
     def by_index(self, name: str, value: Hashable) -> list[dict]:
         """Objects whose index fn emitted ``value`` — O(matches)."""
         keys = self._indexes[name].get(value)  # KeyError for unknown index
+        stats = self._index_stats.setdefault(name, [0, 0])
+        stats[0 if keys else 1] += 1
         if self._lookups is not None:
             self._lookups.labels(
                 kind=self.kind, index=name, result="hit" if keys else "miss"
@@ -174,6 +181,27 @@ class Informer:
     def items(self) -> list[dict]:
         return list(self.cache.values())
 
+    def debug_info(self) -> dict:
+        """JSON-shaped snapshot for the /debug/informers endpoint."""
+        return {
+            "kind": self.kind,
+            "namespace": self.namespace,
+            "label_selector": (
+                str(self.label_selector) if self.label_selector else None
+            ),
+            "synced": self._synced.is_set(),
+            "objects": len(self.cache),
+            "relists": self._relists,
+            "indexes": {
+                name: {
+                    "values": len(self._indexes.get(name, {})),
+                    "hits": self._index_stats.get(name, [0, 0])[0],
+                    "misses": self._index_stats.get(name, [0, 0])[1],
+                }
+                for name in self._index_fns
+            },
+        }
+
     async def start(self) -> None:
         self._task = asyncio.create_task(self._run(), name=f"informer-{self.kind}")
         await self._synced.wait()
@@ -196,6 +224,7 @@ class Informer:
     async def _run(self) -> None:
         while True:
             try:
+                self._relists += 1
                 objs, rv = await self.kube.list_with_rv(
                     self.kind, self.namespace, self.label_selector
                 )

@@ -72,6 +72,9 @@ class Manager:
         self._tasks: list[asyncio.Task] = []
         from kubeflow_tpu.runtime.tracing import Tracer
 
+        # The tracer owns the flight recorder: every reconcile's span tree
+        # (queue wait, controller phases, API verbs) is retained after the
+        # reconcile ends and served by /debug/traces.
         self.tracer = Tracer(self.registry)
         self._reconcile_total = self.registry.counter(
             "controller_reconcile_total", "Reconciles per controller", ["controller", "result"]
@@ -180,16 +183,40 @@ class Manager:
             await asyncio.sleep(0.01)
         raise TimeoutError("manager queues did not drain")
 
+    # ---- /debug introspection --------------------------------------------------
+
+    def debug_traces(self, key=None, limit: int = 50) -> list[dict]:
+        """Recent flight-recorder entries (most recent first), optionally
+        for one reconcile key."""
+        return self.tracer.recorder.entries(key=key, limit=limit)
+
+    def debug_queues(self) -> dict:
+        """Per-controller workqueue state: depth, in-flight, backoff keys,
+        oldest queue wait."""
+        return {name: q.debug_info() for name, q in self._queues.items()}
+
+    def debug_informers(self) -> dict:
+        """Per-informer cache state: sync, object counts, index hit/miss."""
+        out = {}
+        for (kind, selector), inf in self.informers.items():
+            name = kind if selector is None else f"{kind}[{selector}]"
+            out[name] = inf.debug_info()
+        return out
+
     async def _worker(self, ctrl: Controller, queue: RateLimitedQueue) -> None:
         while True:
             key = await queue.get()
             if key is None:
                 return
+            queue_wait = queue.take_wait(key)
             self._queue_depth.labels(controller=ctrl.name).set(len(queue))
             try:
-                with self.tracer.span(
-                    "reconcile", controller=ctrl.name, key=str(key)
-                ), self.reconcile_seconds.time(controller=ctrl.name):
+                with self.tracer.trace(
+                    "reconcile", controller=ctrl.name, key=key
+                ) as root, self.reconcile_seconds.time(controller=ctrl.name):
+                    # The wait happened before any span context existed;
+                    # inject it so the trace covers queue→done end to end.
+                    root.add_synthetic("queue_wait", queue_wait)
                     result = await ctrl.reconcile(key)
             except Exception:
                 log.exception("reconcile %s %s failed", ctrl.name, key)

@@ -13,10 +13,24 @@ import time
 from collections import defaultdict
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus text exposition escaping for label values: backslash,
+    double-quote, and newline (a notebook name containing a quote would
+    otherwise corrupt the whole /metrics scrape)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
     return "{" + inner + "}"
 
 
@@ -74,6 +88,30 @@ class Gauge(_Metric):
     type_name = "gauge"
 
 
+class _HistogramChild:
+    """A label-bound observer. ``hist.labels(...)`` used to inherit the
+    counter/gauge child from ``_Metric`` and silently write into a dead
+    ``_children`` map that ``Histogram.collect()`` never read — data was
+    dropped. Now labels() routes to observe() and the counter/gauge verbs
+    raise instead of lying."""
+
+    def __init__(self, hist: "Histogram", labels: dict):
+        self._hist = hist
+        self._labels = labels
+
+    def observe(self, value: float) -> None:
+        self._hist.observe(value, **self._labels)
+
+    def time(self) -> "_Timer":
+        return _Timer(self._hist, self._labels)
+
+    def inc(self, amount: float = 1.0) -> None:
+        raise TypeError("histograms have no inc(); use observe()")
+
+    def set(self, value: float) -> None:
+        raise TypeError("histograms have no set(); use observe()")
+
+
 class Histogram(_Metric):
     type_name = "histogram"
 
@@ -86,6 +124,15 @@ class Histogram(_Metric):
             lambda: {"counts": [0] * len(self.buckets), "sum": 0.0, "count": 0}
         )
         self._lock = threading.Lock()
+
+    def labels(self, **labels: str) -> _HistogramChild:
+        return _HistogramChild(self, labels)
+
+    def inc(self, amount: float = 1.0) -> None:
+        raise TypeError("histograms have no inc(); use observe()")
+
+    def set(self, value: float) -> None:
+        raise TypeError("histograms have no set(); use observe()")
 
     def observe(self, value: float, **labels: str) -> None:
         key = tuple(str(labels.get(n, "")) for n in self.label_names)
@@ -152,8 +199,23 @@ class Registry:
 
     def _register(self, cls, name, help_, label_names, **kw):
         with self._lock:
-            if name in self._metrics:
-                return self._metrics[name]
+            existing = self._metrics.get(name)
+            if existing is not None:
+                # Re-registration is idempotent ONLY for an identical
+                # schema; silently returning a metric with different label
+                # names or type would make writers disagree with collect()
+                # about the label tuple and corrupt the series.
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}, not {cls.__name__}"
+                    )
+                if existing.label_names != list(label_names or []):
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{existing.label_names}, not {list(label_names or [])}"
+                    )
+                return existing
             metric = cls(name, help_, label_names or [], **kw)
             self._metrics[name] = metric
             return metric

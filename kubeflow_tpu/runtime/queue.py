@@ -38,6 +38,11 @@ class RateLimitedQueue:
         self._in_flight: set[Hashable] = set()
         self._dirty: set[Hashable] = set()  # re-added while in flight
         self._failures: dict[Hashable, int] = {}
+        # Queue-wait telemetry: after get(), how long the popped key sat
+        # READY (past its ready_at) before a worker picked it up — pure
+        # contention signal; intentional backoff/requeue_after delay is
+        # excluded. The manager turns this into the ``queue_wait`` span.
+        self._last_wait: dict[Hashable, float] = {}
         self._event = asyncio.Event()
         self._closed = False
 
@@ -100,9 +105,16 @@ class RateLimitedQueue:
     async def get(self) -> Hashable | None:
         """Next ready key, or None when the queue is shut down."""
         while True:
-            if self._closed and not self._queue:
-                return None
             now = time.monotonic()
+            if self._closed and not (
+                self._queue and self._queue[0][0] <= now
+            ):
+                # Shut down: drain entries that are ready NOW, but never
+                # wait out future-delayed ones (a 300 s capacity-retry
+                # entry would otherwise pin a worker — and its cancelled
+                # shutdown — for the full delay; shutdown() already woke
+                # us via the event precisely so this check runs).
+                return None
             if self._queue and self._queue[0][0] <= now:
                 ready_at, _, key = heapq.heappop(self._queue)
                 # Drop stale entries: from a previous queued lifetime of the
@@ -112,6 +124,9 @@ class RateLimitedQueue:
                     continue
                 self._queued.discard(key)
                 self._earliest.pop(key, None)
+                # Time past eligibility only — ready_at already folds in
+                # any intentional delay (coalesce/backoff/requeue_after).
+                self._last_wait[key] = max(0.0, now - ready_at)
                 self._in_flight.add(key)
                 return key
             timeout = (self._queue[0][0] - now) if self._queue else None
@@ -129,6 +144,40 @@ class RateLimitedQueue:
             # backoff, not immediately — otherwise a failing reconciler that
             # touches its own children retries in a hot loop.
             self.add(key, self.backoff_delay(key))
+
+    def take_wait(self, key: Hashable) -> float:
+        """Queue wait of the most recent get() of ``key`` — time the key
+        sat ready past its eligibility, consumed once (the manager
+        attaches it to the reconcile trace as the ``queue_wait`` span)."""
+        return self._last_wait.pop(key, 0.0)
+
+    def debug_info(self) -> dict:
+        """JSON-shaped snapshot for the /debug/queue endpoint: depth,
+        backoff keys, oldest wait — the "why is nothing happening"
+        questions answered without a debugger."""
+        now = time.monotonic()
+        return {
+            "depth": len(self._queued),
+            "ready": self.ready_count(),
+            "in_flight": sorted(str(k) for k in self._in_flight),
+            "dirty": len(self._dirty),
+            "peak_depth": self.peak_depth,
+            "coalesce_window_sec": self.coalesce_window,
+            "backoff_keys": {
+                str(k): {
+                    "failures": n,
+                    "next_delay_sec": round(self.backoff_delay(k), 4),
+                }
+                for k, n in sorted(self._failures.items(), key=lambda kv: str(kv[0]))
+            },
+            # Longest a currently-READY key has been waiting for a worker
+            # (keys still inside an intentional delay don't count — their
+            # "wait" is a timer, not contention).
+            "oldest_wait_sec": round(
+                max((now - t for t in self._earliest.values() if t <= now),
+                    default=0.0), 4
+            ),
+        }
 
     def shutdown(self) -> None:
         self._closed = True

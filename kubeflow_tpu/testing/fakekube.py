@@ -24,8 +24,10 @@ import asyncio
 import copy
 import fnmatch
 import uuid
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, AsyncIterator, Awaitable, Callable
+
+from kubeflow_tpu.runtime import tracing
 
 from kubeflow_tpu.runtime.errors import (
     AlreadyExists,
@@ -81,6 +83,24 @@ class FakeKube:
         # the bench PROVE write elision: a steady-state no-op reconcile
         # must move none of the write verbs.
         self.requests: dict[str, int] = defaultdict(int)
+        # Bounded request log with the headers a real client would have
+        # sent — in particular X-Request-Id carrying the active trace id,
+        # mirroring HttpKube. Tests pin controller → request-header →
+        # flight-recorder trace-id propagation against it.
+        self.request_log: deque[dict] = deque(maxlen=1000)
+
+    def _note(self, verb: str, kind: str, name: str | None = None,
+              namespace: str | None = None) -> None:
+        self.requests[verb] += 1
+        trace_id = tracing.current_trace_id()
+        self.request_log.append({
+            "verb": verb,
+            "kind": kind,
+            "name": name,
+            "namespace": namespace,
+            "headers": {"X-Request-Id": trace_id} if trace_id else {},
+        })
+        tracing.note_api_call(verb, kind)
 
     def write_count(self) -> int:
         """Mutating requests issued so far (no-op writes the server
@@ -89,6 +109,7 @@ class FakeKube:
 
     def reset_counts(self) -> None:
         self.requests.clear()
+        self.request_log.clear()
 
     # ---- admission plugin registration ---------------------------------------
 
@@ -148,7 +169,7 @@ class FakeKube:
     # ---- KubeApi surface -----------------------------------------------------
 
     async def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
-        self.requests["get"] += 1
+        self._note("get", kind, name, namespace)
         bucket = self._bucket(kind)
         key = self._key(kind, name, namespace)
         obj = bucket.get(key)
@@ -176,7 +197,7 @@ class FakeKube:
         scans dominated the control-plane bench's profile otherwise.
         Callers must not mutate the returned objects.
         """
-        self.requests["list"] += 1
+        self._note("list", kind, namespace=namespace)
         selector = (
             parse_label_selector(label_selector)
             if isinstance(label_selector, str)
@@ -205,7 +226,7 @@ class FakeKube:
         return items, str(self._rv)
 
     async def create(self, kind: str, obj: dict, namespace: str | None = None) -> dict:
-        self.requests["create"] += 1
+        self._note("create", kind, name_of(obj), namespace or namespace_of(obj))
         async with self._lock:
             obj = deepcopy(obj)
             obj.setdefault("kind", kind)
@@ -232,7 +253,7 @@ class FakeKube:
             return deepcopy(obj)
 
     async def update(self, kind: str, obj: dict) -> dict:
-        self.requests["update"] += 1
+        self._note("update", kind, name_of(obj), namespace_of(obj))
         async with self._lock:
             obj = deepcopy(obj)
             bucket = self._bucket(kind)
@@ -274,7 +295,7 @@ class FakeKube:
             return deepcopy(obj)
 
     async def update_status(self, kind: str, obj: dict) -> dict:
-        self.requests["update_status"] += 1
+        self._note("update_status", kind, name_of(obj), namespace_of(obj))
         async with self._lock:
             bucket = self._bucket(kind)
             key = self._key(kind, obj, None)
@@ -301,7 +322,7 @@ class FakeKube:
     ) -> dict:
         """Strategic-ish merge patch: dicts merge recursively, None deletes,
         lists replace (the k8s merge-patch rule)."""
-        self.requests["patch"] += 1
+        self._note("patch", kind, name, namespace)
         async with self._lock:
             bucket = self._bucket(kind)
             key = self._key(kind, name, namespace)
@@ -344,7 +365,7 @@ class FakeKube:
             return deepcopy(new)
 
     async def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
-        self.requests["delete"] += 1
+        self._note("delete", kind, name, namespace)
         async with self._lock:
             key = self._key(kind, name, namespace)
             await self._delete_obj(kind, key)

@@ -17,9 +17,11 @@ from __future__ import annotations
 
 import logging
 import secrets
+import time
 
 from aiohttp import web
 
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.runtime.errors import ApiError, Unauthorized
 from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.web.common.auth import USERID_HEADER, AllowAll, Authorizer
@@ -29,6 +31,7 @@ log = logging.getLogger(__name__)
 CSRF_COOKIE = "XSRF-TOKEN"
 CSRF_HEADER = "X-XSRF-TOKEN"
 SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+REQUEST_ID_HEADER = "X-Request-Id"
 
 
 def _is_probe_path(path: str) -> bool:
@@ -74,6 +77,47 @@ def create_base_app(
     m_requests = registry.counter(
         "web_app_requests_total", "Backend HTTP requests", ["method", "status"]
     )
+    m_duration = registry.histogram(
+        "web_request_duration_seconds",
+        "Backend HTTP request latency per route",
+        ["route", "method"],
+    )
+
+    def _route_of(request: web.Request) -> str:
+        """The matched route PATTERN (bounded label cardinality), not the
+        raw path — /api/namespaces, not whatever the client typed."""
+        resource = getattr(request.match_info.route, "resource", None)
+        canonical = getattr(resource, "canonical", None)
+        return canonical or "unmatched"
+
+    @web.middleware
+    async def request_id_middleware(request: web.Request, handler):
+        """Correlation + latency, outermost: every request runs under a
+        trace whose id comes from (or becomes) the X-Request-Id header —
+        the same header the controllers stamp on their apiserver calls —
+        and every response echoes it. The per-route duration histogram
+        observes even error responses."""
+        rid = request.headers.get(REQUEST_ID_HEADER) or tracing.new_trace_id()
+        request["request_id"] = rid
+        t0 = time.perf_counter()
+        try:
+            with tracing.span(
+                "http_request", trace_id=rid,
+                method=request.method, path=request.path,
+            ):
+                resp = await handler(request)
+            resp.headers[REQUEST_ID_HEADER] = rid
+            return resp
+        except web.HTTPException as e:
+            # aiohttp HTTP exceptions ARE responses; echo the id on them.
+            e.headers[REQUEST_ID_HEADER] = rid
+            raise
+        finally:
+            # Every request lands in the histogram — error responses and
+            # escaped exceptions included.
+            m_duration.labels(
+                route=_route_of(request), method=request.method
+            ).observe(time.perf_counter() - t0)
 
     @web.middleware
     async def error_middleware(request: web.Request, handler):
@@ -125,7 +169,12 @@ def create_base_app(
         return resp
 
     app = web.Application(
-        middlewares=[error_middleware, authn_middleware, csrf_middleware]
+        middlewares=[
+            request_id_middleware,
+            error_middleware,
+            authn_middleware,
+            csrf_middleware,
+        ]
     )
     app["kube"] = kube
     app["authorizer"] = authorizer or AllowAll()

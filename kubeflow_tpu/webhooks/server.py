@@ -26,6 +26,7 @@ from kubeflow_tpu.api import tensorboard as tbapi
 from kubeflow_tpu.runtime.errors import ApiError
 from kubeflow_tpu.runtime.metrics import global_registry
 from kubeflow_tpu.runtime.objects import deepcopy
+from kubeflow_tpu.runtime.tracing import Tracer, span
 from kubeflow_tpu.webhooks import jsonpatch
 from kubeflow_tpu.webhooks import notebook as nb_webhook
 from kubeflow_tpu.webhooks import poddefault as pd_webhook
@@ -60,10 +61,15 @@ def _deny(uid: str, message: str, code: int = 400) -> dict:
     }
 
 
-def create_webhook_app(kube, *, registry=None) -> web.Application:
+def create_webhook_app(kube, *, registry=None, tracer=None) -> web.Application:
     registry = registry or global_registry
     app = web.Application()
     app["kube"] = kube
+    # Admission spans + flight recorder: the same tracing machinery the
+    # controllers use, so /debug/traces on the webhook answers "what did
+    # admission do to kind/ns/name and how long did the mutator take".
+    tracer = tracer or Tracer(registry)
+    app["tracer"] = tracer
     # Admission observability (controller-runtime webhooks expose the same
     # shape; the reference's PodDefault server only klogs).
     m_admissions = registry.counter(
@@ -93,17 +99,45 @@ def create_webhook_app(kube, *, registry=None) -> web.Application:
         if not obj.get("metadata", {}).get("namespace") and req.get("namespace"):
             obj.setdefault("metadata", {})["namespace"] = req["namespace"]
         original = deepcopy(obj)
-        try:
-            await mutator(request.app["kube"], obj, operation, old)
-        except ApiError as e:
-            m_admissions.labels(path=request.path, allowed="false").inc()
-            return web.json_response(_deny(uid, e.message, e.code))
-        except Exception:
-            log.exception("webhook mutator failed")
-            m_admissions.labels(path=request.path, allowed="false").inc()
-            return web.json_response(_deny(uid, "internal webhook error", 500))
-        m_admissions.labels(path=request.path, allowed="true").inc()
-        return web.json_response(_allow(uid, jsonpatch.diff(original, obj)))
+        meta = obj.get("metadata") or {}
+        admission_key = (
+            obj.get("kind") or req.get("kind", {}).get("kind") or "?",
+            meta.get("namespace"),
+            meta.get("name") or meta.get("generateName") or "?",
+        )
+        # Reuse the apiserver's request id when it sent one, so the
+        # admission trace correlates with the apiserver audit log.
+        incoming_id = request.headers.get("X-Request-Id")
+        with tracer.trace(
+            "admission", key=admission_key, controller="webhook",
+            trace_id=incoming_id, path=request.path, operation=operation,
+        ) as root:
+            try:
+                with span("mutate"):
+                    await mutator(request.app["kube"], obj, operation, old)
+            except ApiError as e:
+                # The deny response swallows the exception — fail() the
+                # root explicitly or the flight recorder would file this
+                # admission as outcome ok.
+                root.fail(e.message)
+                root.set_attribute("allowed", "false")
+                m_admissions.labels(path=request.path, allowed="false").inc()
+                resp = web.json_response(_deny(uid, e.message, e.code))
+            except Exception as e:
+                log.exception("webhook mutator failed")
+                root.fail(repr(e))
+                root.set_attribute("allowed", "false")
+                m_admissions.labels(path=request.path, allowed="false").inc()
+                resp = web.json_response(
+                    _deny(uid, "internal webhook error", 500))
+            else:
+                root.set_attribute("allowed", "true")
+                m_admissions.labels(path=request.path, allowed="true").inc()
+                resp = web.json_response(
+                    _allow(uid, jsonpatch.diff(original, obj)))
+            if root.trace_id:
+                resp.headers["X-Request-Id"] = root.trace_id
+        return resp
 
     # -- Pod mutation: PodDefault injection + per-worker TPU env ------------
     async def mutate_pod(kube, pod, operation, _old):
@@ -213,8 +247,21 @@ def create_webhook_app(kube, *, registry=None) -> web.Application:
     async def metrics(_request):
         return web.Response(text=registry.expose(), content_type="text/plain")
 
+    async def debug_traces(request: web.Request) -> web.Response:
+        """Recent admission flight-recorder entries (key=Kind/ns/name)."""
+        try:
+            limit = int(request.query.get("limit", "50"))
+        except ValueError:
+            limit = 50
+        return web.json_response({
+            "traces": tracer.recorder.entries(
+                key=request.query.get("key"), limit=limit
+            ),
+        })
+
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/debug/traces", debug_traces)
     return app
 
 

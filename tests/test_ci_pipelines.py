@@ -19,6 +19,12 @@ spec = importlib.util.spec_from_file_location(
 pipelines = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(pipelines)
 
+_ct_spec = importlib.util.spec_from_file_location(
+    "ci_check_tracing", REPO / "ci" / "check_tracing.py"
+)
+check_tracing = importlib.util.module_from_spec(_ct_spec)
+_ct_spec.loader.exec_module(check_tracing)
+
 
 def test_no_drift():
     for name in pipelines.WORKFLOWS:
@@ -39,6 +45,7 @@ def test_rendered_yaml_parses_with_invariants():
     assert pytest_step["env"]["XLA_FLAGS"].endswith("device_count=8")
     assert any("dryrun_multichip" in s.get("run", "") for s in steps)
     assert any("make -C native" in s.get("run", "") for s in steps)
+    assert any("ci/check_tracing.py" in s.get("run", "") for s in steps)
 
     kind_wf = docs["kind-integration.yaml"]
     kind_steps = kind_wf["jobs"]["kind"]["steps"]
@@ -99,3 +106,21 @@ def test_webhook_install_transform():
             assert base64.b64decode(cc["caBundle"]) == b"FAKE CA PEM"
         # cert-manager injection annotation dropped (no cert-manager on host).
         assert "annotations" not in doc.get("metadata", {})
+
+
+def test_every_controller_registers_tracer_phases():
+    """The grep-based lint CI runs (ci/check_tracing.py), in-process: a
+    reconciler with no phase spans would make /debug/traces useless."""
+    assert check_tracing.main() == 0
+
+
+def test_check_tracing_catches_a_spanless_reconciler(tmp_path):
+    bad = tmp_path / "bad_controller.py"
+    bad.write_text(
+        "class R:\n"
+        "    async def reconcile(self, key):\n"
+        "        return None\n"
+    )
+    problems = check_tracing.check_file(str(bad))
+    assert problems, "spanless reconciler passed the lint"
+    assert any("span" in p for p in problems)

@@ -144,15 +144,30 @@ async def test_reconcile_child_create_then_drift_converge():
 
 
 async def test_event_recorder_aggregates():
+    """A second identical event PATCHES count/lastTimestamp on the
+    existing Event instead of creating a duplicate (client-go recorder
+    semantics); distinct reasons/messages stay separate objects."""
     kube = FakeKube()
     nb = await kube.create("Notebook", new_object("Notebook", "nb", "ns", spec={}))
     rec = EventRecorder(kube, "notebook-controller")
     await rec.event(nb, "Normal", "Created", "created sts")
+    first = (await kube.list("Event", "ns"))[0]
+    assert first["count"] == 1 and kube.requests["create"] >= 1
+    creates_before = kube.requests["create"]
     await rec.event(nb, "Normal", "Created", "created sts")
     events = await kube.list("Event", "ns")
     assert len(events) == 1
     assert events[0]["count"] == 2
     assert events[0]["involvedObject"]["name"] == "nb"
+    # The aggregation went through PATCH — no second Event was created —
+    # and lastTimestamp moved past the original while firstTimestamp held.
+    assert kube.requests["create"] == creates_before
+    assert kube.requests["patch"] >= 1
+    assert events[0]["firstTimestamp"] == first["firstTimestamp"]
+    assert events[0]["lastTimestamp"] >= first["lastTimestamp"]
+    # A different message is a different event object.
+    await rec.event(nb, "Normal", "Created", "created svc")
+    assert len(await kube.list("Event", "ns")) == 2
 
 
 def test_metrics_exposition():
@@ -247,6 +262,57 @@ async def test_error_backoff_applies_when_key_dirty():
     start = asyncio.get_event_loop().time()
     done, _pending = await asyncio.wait([asyncio.ensure_future(q.get())], timeout=0.2)
     assert not done, "key became ready immediately; backoff was bypassed"
+
+
+def test_histogram_labels_route_to_observe():
+    """Histogram used to inherit counter/gauge children from _Metric:
+    labels().inc() wrote into a dead map collect() never read, silently
+    dropping data. Now labels() binds observe() and the counter/gauge
+    verbs raise."""
+    reg = Registry()
+    h = reg.histogram("lat", "x", ["controller"], buckets=[0.1, 1])
+    h.labels(controller="nb").observe(0.05)
+    with h.labels(controller="nb").time():
+        pass
+    text = reg.expose()
+    # Both the direct observe and the (near-zero) timed block landed in
+    # the first bucket and the count — nothing was dropped.
+    assert 'lat_bucket{controller="nb",le="0.1"} 2' in text
+    assert 'lat_count{controller="nb"} 2' in text
+    for bad in (lambda: h.inc(), lambda: h.set(1.0),
+                lambda: h.labels(controller="nb").inc(),
+                lambda: h.labels(controller="nb").set(2.0)):
+        try:
+            bad()
+            raise AssertionError("histogram accepted a counter/gauge verb")
+        except TypeError:
+            pass
+
+
+def test_label_values_escaped_in_exposition():
+    """A notebook name containing a quote/backslash/newline must not
+    corrupt the whole /metrics scrape (Prometheus text format escaping)."""
+    reg = Registry()
+    c = reg.counter("evil", "x", ["name"])
+    c.labels(name='we"ird\\na\nme').inc()
+    text = reg.expose()
+    assert 'evil{name="we\\"ird\\\\na\\nme"} 1.0' in text
+    assert text.count("\n") == len(text.splitlines())  # no line got split
+
+
+def test_registry_rejects_mismatched_reregistration():
+    reg = Registry()
+    reg.counter("m", "x", ["a"])
+    assert reg.counter("m", "x", ["a"]) is not None  # same schema: idempotent
+    for bad in (lambda: reg.counter("m", "x", ["b"]),
+                lambda: reg.counter("m", "x"),
+                lambda: reg.gauge("m", "x", ["a"]),
+                lambda: reg.histogram("m", "x", ["a"])):
+        try:
+            bad()
+            raise AssertionError("mismatched re-registration accepted")
+        except ValueError:
+            pass
 
 
 def test_histogram_buckets_monotone():

@@ -1,0 +1,367 @@
+"""Flight recorder + end-to-end reconcile tracing (ISSUE 3).
+
+The acceptance path: a reconciled Notebook's flight-recorder entry (via
+GET /debug/traces on the manager app) carries ≥3 named child spans
+(queue_wait, apply, status) and the API verbs issued; the trace id the
+controller ran under appears on the fake apiserver's request headers
+(X-Request-Id), proving controller → client → recorder correlation.
+
+Everything runs on FakeKube + wait_idle — no sleeps beyond watch-drain
+ticks, keeping tier-1 fast.
+"""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.cmd.controller_manager import build_manager_app
+from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+from kubeflow_tpu.runtime import tracing
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import Registry
+from kubeflow_tpu.runtime.queue import RateLimitedQueue
+from kubeflow_tpu.runtime.tracing import FlightRecorder, Tracer, span
+from kubeflow_tpu.testing.fakekube import FakeKube
+
+
+# ---- span trees --------------------------------------------------------------
+
+
+def test_span_tree_contextvar_nesting():
+    with span("root", controller="nb") as root:
+        assert tracing.current_span() is root
+        assert tracing.current_trace_id() == root.trace_id
+        with span("child", phase="apply") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            with span("grandchild") as grand:
+                assert grand.trace_id == root.trace_id
+    assert tracing.current_span() is None
+    assert root.span_names() == ["child", "grandchild"]
+    assert root.duration is not None and root.status == "ok"
+    d = root.to_dict()
+    assert d["spans"][0]["name"] == "child"
+    assert d["spans"][0]["spans"][0]["name"] == "grandchild"
+
+
+def test_span_error_status_propagates():
+    try:
+        with span("boom") as s:
+            raise ValueError("nope")
+    except ValueError:
+        pass
+    assert s.status == "error" and "nope" in s.error
+
+
+async def test_span_context_survives_await():
+    async def inner():
+        return tracing.current_trace_id()
+
+    with span("outer") as s:
+        assert await inner() == s.trace_id
+
+
+def test_api_calls_and_events_aggregate_on_root():
+    with span("root") as root:
+        with span("apply"):
+            tracing.note_api_call("create", "StatefulSet")
+            tracing.note_api_call("create", "StatefulSet")
+            tracing.note_api_call("get", "Service")
+            tracing.note_event("CreatedStatefulSet")
+    assert root.api_calls[("create", "StatefulSet")] == 2
+    assert root.api_calls[("get", "Service")] == 1
+    assert root.events == ["CreatedStatefulSet"]
+
+
+def test_kill_switch_yields_noop_span():
+    tracing.set_enabled(False)
+    try:
+        with span("x", a=1) as s:
+            assert s is tracing.NOOP_SPAN
+            s.set_attribute("k", "v")  # all no-ops, no branch at call sites
+            tracing.note_api_call("get", "Pod")
+        assert tracing.current_trace_id() is None
+    finally:
+        tracing.set_enabled(True)
+
+
+# ---- flight recorder ---------------------------------------------------------
+
+
+def test_flight_recorder_ring_is_bounded_per_key_and_total():
+    rec = FlightRecorder(per_key=2, max_keys=3)
+    for i in range(4):
+        rec.record({"key": "ns/a", "n": i})
+    entries = rec.entries(key="ns/a", limit=10)
+    assert [e["n"] for e in entries] == [3, 2]  # newest first, ring of 2
+    for k in ("ns/b", "ns/c", "ns/d"):  # LRU-evicts ns/a
+        rec.record({"key": k, "n": 0})
+    assert rec.entries(key="ns/a") == []
+    assert rec.entries(key=("ns", "d"))  # tuple keys normalize to ns/d
+
+
+def test_tracer_records_error_outcome():
+    t = Tracer(Registry())
+    try:
+        with t.trace("reconcile", key=("ns", "nb"), controller="c"):
+            raise RuntimeError("reconcile blew up")
+    except RuntimeError:
+        pass
+    entry = t.recorder.entries(key=("ns", "nb"))[0]
+    assert entry["outcome"] == "error"
+    assert "reconcile blew up" in entry["error"]
+    assert entry["trace_id"] and entry["time"]
+
+
+# ---- end-to-end: manager → controller → fakekube → /debug --------------------
+
+
+class _Plane:
+    def __init__(self):
+        self.kube = FakeKube()
+        self.mgr = Manager(self.kube)
+        setup_notebook_controller(self.mgr)
+
+    async def __aenter__(self):
+        await self.mgr.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.mgr.stop()
+        self.kube.close_watches()
+
+    async def settle(self):
+        await self.mgr.wait_idle()
+        await asyncio.sleep(0.05)
+        await self.mgr.wait_idle()
+
+
+async def test_flight_recorder_entry_for_reconciled_notebook():
+    """Acceptance: the entry for a just-reconciled Notebook has ≥3 named
+    child spans (queue_wait, apply, status) and the API verbs issued."""
+    async with _Plane() as p:
+        await p.kube.create("Notebook", nbapi.new("nb", "team"))
+        await p.settle()
+        entries = p.mgr.debug_traces(key=("team", "nb"))
+        assert entries, "no flight-recorder entry for team/nb"
+        entry = entries[-1]  # the FIRST reconcile (creates children)
+        names = set()
+        def walk(spans):
+            for s in spans:
+                names.add(s["name"])
+                walk(s.get("spans", []))
+        walk(entry["spans"])
+        assert {"queue_wait", "apply", "status"} <= names, names
+        assert "cache_read" in names and "build_children" in names, names
+        verbs = {(c["verb"], c["kind"]) for c in entry["api_calls"]}
+        assert ("create", "StatefulSet") in verbs, verbs
+        assert entry["outcome"] == "ok"
+        assert entry["controller"] == "notebook"
+        assert entry["duration_sec"] >= 0
+
+
+async def test_trace_id_propagates_to_request_headers():
+    """Satellite: controller → fakekube request headers → flight-recorder
+    entry all carry ONE trace id."""
+    async with _Plane() as p:
+        await p.kube.create("Notebook", nbapi.new("nb", "team"))
+        await p.settle()
+        entry = p.mgr.debug_traces(key=("team", "nb"))[-1]
+        tid = entry["trace_id"]
+        tagged = [
+            r for r in p.kube.request_log
+            if r["headers"].get("X-Request-Id") == tid
+        ]
+        # Every request of that reconcile carried the id, including the
+        # writes that created the children.
+        assert any(r["verb"] == "create" and r["kind"] == "StatefulSet"
+                   for r in tagged), tagged
+        assert any(r["verb"] == "get" and r["kind"] == "Notebook"
+                   for r in tagged), tagged
+
+
+async def test_debug_endpoints_on_manager_app():
+    """GET /debug/traces|queue|informers on the controller-manager app."""
+    async with _Plane() as p:
+        await p.kube.create("Notebook", nbapi.new("nb", "team"))
+        await p.settle()
+        client = TestClient(TestServer(build_manager_app(p.mgr)))
+        await client.start_server()
+        try:
+            resp = await client.get("/debug/traces", params={"key": "team/nb"})
+            assert resp.status == 200
+            traces = (await resp.json())["traces"]
+            assert traces and traces[0]["key"] == "team/nb"
+            assert any(s["name"] == "queue_wait" for s in traces[-1]["spans"])
+
+            resp = await client.get("/debug/queue")
+            queues = (await resp.json())["queues"]
+            assert "notebook" in queues
+            q = queues["notebook"]
+            assert q["depth"] == 0 and q["in_flight"] == []
+            assert "backoff_keys" in q and "oldest_wait_sec" in q
+
+            resp = await client.get("/debug/informers")
+            informers = (await resp.json())["informers"]
+            assert informers["Notebook"]["synced"] is True
+            assert informers["Notebook"]["objects"] == 1
+            pod_indexes = informers["Pod"]["indexes"]
+            assert "notebook-name" in pod_indexes
+            assert {"values", "hits", "misses"} <= set(
+                pod_indexes["notebook-name"])
+        finally:
+            await client.close()
+
+
+async def test_failed_reconcile_recorded_with_error():
+    calls = {"n": 0}
+
+    async def reconcile(key):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient failure")
+        return None
+
+    from kubeflow_tpu.runtime.manager import Controller
+    from kubeflow_tpu.runtime.objects import new_object
+
+    kube = FakeKube()
+    mgr = Manager(kube, registry=Registry())
+    mgr.add_controller(Controller("w", "Notebook", reconcile))
+    await mgr.start()
+    try:
+        await kube.create("Notebook", new_object("Notebook", "n1", "ns", spec={}))
+        await mgr.wait_idle()
+        entries = mgr.debug_traces(key=("ns", "n1"), limit=10)
+        outcomes = [e["outcome"] for e in entries]
+        assert "error" in outcomes and "ok" in outcomes, outcomes
+        failed = [e for e in entries if e["outcome"] == "error"][0]
+        assert "transient failure" in failed["error"]
+    finally:
+        await mgr.stop()
+        kube.close_watches()
+
+
+# ---- queue debug/wait --------------------------------------------------------
+
+
+async def test_queue_wait_measured_and_debug_info():
+    q = RateLimitedQueue()
+    q.add("k")
+    key = await asyncio.wait_for(q.get(), 1)
+    assert key == "k"
+    wait = q.take_wait("k")
+    assert 0.0 <= wait < 1.0
+    assert q.take_wait("k") == 0.0  # consumed once
+    q.note_failure("k")
+    q.done(key)
+    info = q.debug_info()
+    assert info["backoff_keys"]["k"]["failures"] == 1
+    assert info["backoff_keys"]["k"]["next_delay_sec"] > 0
+    assert info["depth"] == 0 and info["dirty"] == 0
+
+
+async def test_queue_wait_excludes_intentional_delay():
+    """A backoff/requeue_after delay is a timer, not contention: the
+    queue_wait measurement starts at ELIGIBILITY, so a 0.2s-delayed key
+    picked up promptly reports ~0 wait (an operator reading the trace
+    must not mistake a scheduled retry for queue depth)."""
+    q = RateLimitedQueue()
+    q.add("k", delay=0.2)
+    assert (await asyncio.wait_for(q.get(), 2)) == "k"
+    assert q.take_wait("k") < 0.15
+    q.done("k")
+
+
+async def test_closed_queue_does_not_wait_out_delayed_entries():
+    """Regression (pre-existing): shutdown with a future-delayed entry
+    (capacity retry, backoff) used to pin get() — and test teardown —
+    for the full delay."""
+    q = RateLimitedQueue()
+    q.add("k", delay=300.0)
+    q.shutdown()
+    assert await asyncio.wait_for(q.get(), 1) is None
+
+
+# ---- webhook admission traces ------------------------------------------------
+
+
+async def test_webhook_admission_span_and_debug_traces():
+    from kubeflow_tpu.webhooks.server import create_webhook_app
+
+    kube = FakeKube()
+    app = create_webhook_app(kube, registry=Registry())
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "u1",
+                "operation": "CREATE",
+                "namespace": "ns",
+                "object": nbapi.new("nb", "ns"),
+            },
+        }
+        resp = await client.post(
+            "/mutate-notebooks", json=review,
+            headers={"X-Request-Id": "f" * 32},
+        )
+        assert resp.status == 200
+        # The admission trace reuses the caller's request id.
+        assert resp.headers["X-Request-Id"] == "f" * 32
+        resp = await client.get("/debug/traces")
+        traces = (await resp.json())["traces"]
+        assert traces
+        entry = traces[0]
+        assert entry["root"] == "admission"
+        assert entry["key"] == "Notebook/ns/nb"
+        assert entry["trace_id"] == "f" * 32
+        assert any(s["name"] == "mutate" for s in entry["spans"])
+
+        # A DENIED admission must be filed as an error outcome — the deny
+        # response swallows the exception, but the flight recorder must
+        # not report the failure as ok.
+        bad = nbapi.new("bad", "ns")
+        bad["spec"]["tpu"] = {"accelerator": "v5e", "topology": "not-a-topo"}
+        review["request"]["object"] = bad
+        resp = await client.post("/mutate-notebooks", json=review)
+        assert resp.status == 200
+        assert (await resp.json())["response"]["allowed"] is False
+        denied = (await client.get(
+            "/debug/traces", params={"key": "Notebook/ns/bad"}))
+        entry = (await denied.json())["traces"][0]
+        assert entry["outcome"] == "error" and entry["error"], entry
+        assert entry["spans"][0]["status"] == "error"  # the mutate span
+    finally:
+        await client.close()
+
+
+# ---- web request-ID middleware -----------------------------------------------
+
+
+async def test_web_request_id_middleware_and_route_histogram():
+    from kubeflow_tpu.web.common.app import create_base_app
+
+    kube = FakeKube()
+    registry = Registry()
+    app = create_base_app(kube, dev_default_user="t", registry=registry,
+                          csrf_protect=False, secure_cookies=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.get("/api/namespaces")
+        assert resp.status == 200
+        generated = resp.headers["X-Request-Id"]
+        assert len(generated) == 32
+        # An incoming id is propagated, not replaced.
+        resp = await client.get(
+            "/api/namespaces", headers={"X-Request-Id": "a" * 32})
+        assert resp.headers["X-Request-Id"] == "a" * 32
+        text = registry.expose()
+        assert 'web_request_duration_seconds_count{' in text
+        assert 'route="/api/namespaces"' in text
+    finally:
+        await client.close()
