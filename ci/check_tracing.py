@@ -32,6 +32,17 @@ IMPORT_RE = re.compile(
     r"from kubeflow_tpu\.runtime\.tracing import .*\bspan\b"
 )
 
+# Latency-hiding contract (ISSUE 4): child-applying controllers go
+# through apply_set so independent API round trips overlap; a controller
+# that silently reverts to serial reconcile_child loops regresses wall
+# time by the child count. Stage names must be literals — they land on
+# the apply_stage spans /debug/traces shows.
+APPLY_SET_RE = re.compile(r"\bapply_set\(")
+STAGE_RE = re.compile(r"\bStage\(\s*['\"]([a-z_]+)['\"]")
+APPLY_SET_REQUIRED = (
+    "notebook.py", "tensorboard.py", "pvcviewer.py", "profile.py",
+)
+
 
 def check_file(path: str) -> list[str]:
     src = open(path).read()
@@ -57,6 +68,19 @@ def check_file(path: str) -> list[str]:
             problems.append(
                 f"{rel}: missing the `{required}` phase span"
             )
+    uses_apply_set = bool(APPLY_SET_RE.search(src))
+    if uses_apply_set and not STAGE_RE.search(src):
+        problems.append(
+            f"{rel}: calls apply_set but declares no literal-named "
+            "Stage('...') — the apply_stage spans would be unnamed and "
+            "/debug/traces can't show which dependency stage ate the time"
+        )
+    if os.path.basename(path) in APPLY_SET_REQUIRED and not uses_apply_set:
+        problems.append(
+            f"{rel}: child-applying controller no longer goes through "
+            "apply_set — children apply as serial round trips (latency "
+            "hiding regression, ISSUE 4)"
+        )
     return problems
 
 

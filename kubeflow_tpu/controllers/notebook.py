@@ -44,7 +44,10 @@ from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.controllers.common import bounded_name
 from kubeflow_tpu.runtime.apply import (
     ApplyCache,
+    Stage,
+    apply_set,
     informer_reader,
+    overlap,
     reconcile_child,
     state_hash,
 )
@@ -298,9 +301,16 @@ class NotebookReconciler:
 
         with span("status"):
             pods = await self._worker_pods(nb)  # one lookup, shared by the tail
-            requeue = await self._restart_broken_slice(nb, ms, pods)
-            await self._check_maintenance(nb, pods)
-            await self._mirror_events(nb, pods)
+            # The tail's three sections are independent reads over the
+            # same pod set (slice health, node taints, event mirror) —
+            # against a real apiserver each is its own RTT chain, so
+            # overlap them; the status write waits for all three (the
+            # restart path's annotation patches must land first).
+            requeue, _, _ = await overlap(
+                self._restart_broken_slice(nb, ms, pods),
+                self._check_maintenance(nb, pods),
+                self._mirror_events(nb, pods),
+            )
             await self._update_status(nb, ms, capacity_pending=capacity_pending)
         if capacity_pending:
             return capacity_requeue
@@ -309,85 +319,162 @@ class NotebookReconciler:
     async def _apply_children(
         self, nb: dict, ms, tpu
     ) -> tuple[bool, Result | None]:
-        """The child-object phase of reconcile: capacity gate, per-slice
-        StatefulSets, Services, RBAC. Returns (capacity_pending,
-        capacity_requeue)."""
-        if self.opts.trusted_ca_configmap:
-            await self._mirror_ca_bundle(nb)
+        """The child-object phase of reconcile as a dependency DAG
+        (latency hiding, ISSUE 4): capacity gate → [all slice
+        StatefulSets] → [Service, headless Service, VirtualService,
+        NetworkPolicy, RBAC, slice GC]. Stage-mates overlap; each stage
+        waits for the previous one, so against a real apiserver the wall
+        time is the critical-path RTT depth, not the child count.
+        Returns (capacity_pending, capacity_requeue)."""
+        # Stage "capacity": the queued-provisioning gate and the CA-bundle
+        # mirror are independent round-trip chains — overlap them. The
+        # gate's verdict shapes the slices stage, so it stays control
+        # flow rather than an apply_set child.
+        with span("apply_stage", stage="capacity"):
+            (capacity_pending, capacity_provisioned, capacity_requeue), _ = \
+                await overlap(
+                    self._capacity_gate(nb, ms),
+                    self._mirror_ca_bundle(nb)
+                    if self.opts.trusted_ca_configmap else None,
+                )
 
-        # Queued provisioning: reserve the whole slice's capacity through
-        # a ProvisioningRequest BEFORE creating any worker — a partially
-        # scheduled gang on a scarce topology burns quota and wedges
-        # (every host must land together for ICI). Until Provisioned, no
-        # StatefulSet exists; the Services are still created below so
-        # DNS is ready the moment pods land.
-        capacity_pending = False
-        capacity_provisioned = True
-        capacity_requeue: Result | None = None
-        if (ms and nbapi.queued_provisioning(nb)
-                and self.opts.enable_queued_provisioning
-                and nbapi.is_stopped(nb)):
+        # One StatefulSet per slice (ICI placement is per-slice; DCN joins
+        # them — tpu/topology.py MultiSlice). Single-slice keeps the bare
+        # name, zero churn for the common case.
+        num_sts = 0 if capacity_pending else (ms.num_slices if ms else 1)
+        # Creation events ride the NEXT stage, off the gang's critical
+        # path: awaiting each best-effort emission inside its slice child
+        # would re-serialize an N-slice cold create on the (deliberately
+        # narrow) event lane.
+        created_slices: list[str] = []
+        try:
+            await self._apply_children_stages(
+                nb, ms, tpu, num_sts, capacity_provisioned, created_slices)
+        except Exception:
+            # A stage error skips the services stage — which now carries
+            # the creation events. Slices that DID create must still
+            # announce themselves (the pre-DAG code emitted each event
+            # right after its create); the retry reconcile sees them as
+            # pre-existing and would stay silent forever.
+            if created_slices:
+                try:
+                    await self._emit_created_events(nb, created_slices)
+                except Exception:
+                    pass  # events are best-effort; keep the real error
+            raise
+        return capacity_pending, capacity_requeue
+
+    async def _apply_children_stages(
+        self, nb: dict, ms, tpu, num_sts: int, capacity_provisioned: bool,
+        created_slices: list[str],
+    ) -> None:
+        await apply_set(
+            self.kube,
+            [
+                Stage("slices", [
+                    self._apply_slice_sts(nb, ms, tpu, slice_id,
+                                          capacity_provisioned,
+                                          created_slices)
+                    for slice_id in range(num_sts)
+                ]),
+                Stage("services", [
+                    self._emit_created_events(nb, created_slices),
+                    self.generate_service(nb, multi=ms),
+                    (self.generate_headless_service(nb, multi=ms)
+                     if (tpu and tpu.multi_host) or (ms and ms.multi)
+                     else None),
+                    (self.generate_virtual_service(nb)
+                     if self.opts.use_istio else None),
+                    (self.generate_network_policy(nb, tpu)
+                     if self.opts.create_network_policies else None),
+                    self._ensure_pipeline_rbac(nb),
+                    # Covers scale-in (numSlices 4→2) AND the multi→single
+                    # transition (numSlices 2→1 renames the STS to the
+                    # bare name; the stale -s* StatefulSets must not keep
+                    # burning chips). After the slices stage so a rename
+                    # creates before it deletes.
+                    self._gc_extra_slices(nb, ms) if ms else None,
+                ]),
+            ],
+            cache=self._apply_cache, reader=self._reader, owner=nb,
+        )
+
+    async def _capacity_gate(self, nb: dict, ms) -> tuple[bool, bool,
+                                                          Result | None]:
+        """Queued provisioning: reserve the whole slice's capacity through
+        a ProvisioningRequest BEFORE creating any worker — a partially
+        scheduled gang on a scarce topology burns quota and wedges
+        (every host must land together for ICI). Until Provisioned, no
+        StatefulSet exists; the Services are still created so DNS is
+        ready the moment pods land. Returns (capacity_pending,
+        capacity_provisioned, capacity_requeue)."""
+        if not (ms and nbapi.queued_provisioning(nb)
+                and self.opts.enable_queued_provisioning):
+            return False, True, None
+        if nbapi.is_stopped(nb):
             # Parked: the reservation is one-shot — its capacity was
             # consumed (or expired) when the gang went away. Delete the
             # request so a restart queues for FRESH capacity instead of
             # sailing past the gate on a spent Provisioned=True.
             await self._release_capacity(nb)
-        elif (ms and nbapi.queued_provisioning(nb)
-                and self.opts.enable_queued_provisioning):
-            provisioned, capacity_requeue = await self._ensure_capacity(nb, ms)
-            capacity_provisioned = provisioned
-            if not provisioned:
-                # The gate holds unless the gang is ACTIVELY running
-                # (flag flipped on mid-flight, or the PR deleted from
-                # under a live slice — freezing those would block spec
-                # drift and flip status to a false capacity wait). A
-                # parked STS (replicas 0, reservation released on park)
-                # still gates: restart queues for fresh capacity.
-                sts0 = ms.slice_sts_name(name_of(nb), 0)
-                existing = await self._live_sts(sts0, namespace_of(nb))
-                actively_running = existing is not None and (
-                    deep_get(existing, "spec", "replicas") or 0) > 0
-                capacity_pending = not actively_running
+            return False, True, None
+        provisioned, capacity_requeue = await self._ensure_capacity(nb, ms)
+        if provisioned:
+            return False, True, None
+        # The gate holds unless the gang is ACTIVELY running (flag
+        # flipped on mid-flight, or the PR deleted from under a live
+        # slice — freezing those would block spec drift and flip status
+        # to a false capacity wait). A parked STS (replicas 0,
+        # reservation released on park) still gates: restart queues for
+        # fresh capacity.
+        sts0 = ms.slice_sts_name(name_of(nb), 0)
+        existing = await self._live_sts(sts0, namespace_of(nb))
+        actively_running = existing is not None and (
+            deep_get(existing, "spec", "replicas") or 0) > 0
+        return (not actively_running), False, capacity_requeue
 
-        # One StatefulSet per slice (ICI placement is per-slice; DCN joins
-        # them — tpu/topology.py MultiSlice). Single-slice keeps the bare
-        # name, zero churn for the common case.
-        for slice_id in range(0 if capacity_pending
-                              else (ms.num_slices if ms else 1)):
-            with span("build_children", kind="StatefulSet", slice=slice_id):
-                sts = self.generate_statefulset(
-                    nb, tpu, multi=ms, slice_id=slice_id,
-                    capacity_provisioned=capacity_provisioned)
-            if not capacity_provisioned:
-                # Sticky consume annotation: when the request is (or has
-                # become) unprovisioned over a LIVE gang — e.g. the PR was
-                # deleted from under it and recreated — keep whatever the
-                # running StatefulSet already carries. Stripping it would
-                # diff the template and rolling-restart a healthy slice.
-                await self._preserve_consume_annotation(nb, sts)
-            created = await self._ensure(nb, sts)
-            if created:
-                self.m_create.inc()
-                await self.recorder.event(
-                    nb, "Normal", "CreatedStatefulSet",
-                    f"Created StatefulSet {name_of(sts)}"
-                )
-        if ms:
-            # Covers scale-in (numSlices 4→2) AND the multi→single
-            # transition (numSlices 2→1 renames the STS to the bare name;
-            # the stale -s* StatefulSets must not keep burning chips).
-            await self._gc_extra_slices(nb, ms)
+    async def _apply_slice_sts(
+        self, nb: dict, ms, tpu, slice_id: int, capacity_provisioned: bool,
+        created_sink: list[str],
+    ) -> bool:
+        """Build + apply one slice's StatefulSet (an apply_set child —
+        slices overlap each other inside the ``slices`` stage). Newly
+        created names land in ``created_sink``; their events are emitted
+        by the next stage (:meth:`_emit_created_events`)."""
+        with span("build_children", kind="StatefulSet", slice=slice_id):
+            sts = self.generate_statefulset(
+                nb, tpu, multi=ms, slice_id=slice_id,
+                capacity_provisioned=capacity_provisioned)
+        if not capacity_provisioned:
+            # Sticky consume annotation: when the request is (or has
+            # become) unprovisioned over a LIVE gang — e.g. the PR was
+            # deleted from under it and recreated — keep whatever the
+            # running StatefulSet already carries. Stripping it would
+            # diff the template and rolling-restart a healthy slice.
+            await self._preserve_consume_annotation(nb, sts)
+        created = await self._ensure(nb, sts)
+        if created:
+            self.m_create.inc()
+            created_sink.append(name_of(sts))
+        return created
 
-        await self._ensure(nb, self.generate_service(nb, multi=ms))
-        if (tpu and tpu.multi_host) or (ms and ms.multi):
-            await self._ensure(nb, self.generate_headless_service(nb, multi=ms))
-        if self.opts.use_istio:
-            await self._ensure(nb, self.generate_virtual_service(nb))
-        if self.opts.create_network_policies:
-            await self._ensure(nb, self.generate_network_policy(nb, tpu))
-
-        await self._ensure_pipeline_rbac(nb)
-        return capacity_pending, capacity_requeue
+    async def _emit_created_events(self, nb: dict, names: list[str]) -> None:
+        """Emit CreatedStatefulSet for every slice the previous stage
+        created — concurrently, and overlapping the services stage, so a
+        wide cold create never serializes on the event lane's width.
+        Consumes ``names``: the rescue emitter in ``_apply_children``
+        runs this again when a stage error skipped the services stage,
+        and a services-stage SIBLING failure (first-error semantics let
+        this child complete first) must not double-emit."""
+        if not names:
+            return
+        batch, names[:] = list(names), []
+        await overlap(*(
+            self.recorder.event(
+                nb, "Normal", "CreatedStatefulSet",
+                f"Created StatefulSet {n}")
+            for n in batch
+        ))
 
     async def _live_sts(self, name: str, ns: str) -> dict | None:
         """Informer-cached StatefulSet read with apiserver fallback. The
@@ -1294,24 +1381,29 @@ class NotebookReconciler:
         surfaced via status.tpu so the UI can say why nothing runs."""
         tpu = ms.slice if ms else None
         ns, name = namespace_of(nb), name_of(nb)
-        ready = 0
-        for j in range(ms.num_slices if ms else 1):
-            sts_name = ms.slice_sts_name(name, j) if ms else name
-            # Informer cache first: a 64-slice notebook would otherwise pay
-            # 64 serialized apiserver GETs per reconcile. The controller
-            # owns StatefulSets, so this informer is always running under
-            # the manager; staleness self-corrects on the next STS event.
-            sts = await self._live_sts(sts_name, ns)
-            ready += deep_get(sts or {}, "status", "readyReplicas", default=0) or 0
+        # Informer cache first: a 64-slice notebook would otherwise pay
+        # 64 apiserver GETs per reconcile. The controller owns
+        # StatefulSets, so this informer is always running under the
+        # manager; staleness self-corrects on the next STS event. The
+        # bare-reconciler fallback GETs (per-slice STS + worker-0 pod)
+        # are independent reads — overlap them so even the cold path is
+        # one RTT deep, not num_slices + 1.
+        pod0_name = f"{ms.slice_sts_name(name, 0) if ms else name}-0"
+        *stss, pod0 = await overlap(
+            *[self._live_sts(ms.slice_sts_name(name, j) if ms else name, ns)
+              for j in range(ms.num_slices if ms else 1)],
+            (None if self._pod_informer is not None
+             else self.kube.get_or_none("Pod", pod0_name, ns)),
+        )
+        ready = sum(
+            deep_get(sts or {}, "status", "readyReplicas", default=0) or 0
+            for sts in stss)
 
         container_state: dict = {}
-        pod0_name = f"{ms.slice_sts_name(name, 0) if ms else name}-0"
         # Watch cache first (staleness self-corrects on the pod's next
         # event, which re-enqueues this notebook anyway).
         if self._pod_informer is not None:
             pod0 = self._pod_informer.get(pod0_name, ns)
-        else:
-            pod0 = await self.kube.get_or_none("Pod", pod0_name, ns)
         if pod0:
             main_name = _main_container_name(nb)
             statuses = deep_get(pod0, "status", "containerStatuses", default=[])

@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 from typing import Protocol
 
 from kubeflow_tpu.api import profile as profileapi
-from kubeflow_tpu.runtime.apply import reconcile_child
+from kubeflow_tpu.runtime.apply import Stage, apply_set, reconcile_child
 from kubeflow_tpu.runtime.errors import AlreadyExists, ApiError, NotFound
 from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result
@@ -165,15 +165,26 @@ class ProfileReconciler:
         try:
             with span("apply"):
                 await self._ensure_finalizer(profile)
-                await self._reconcile_namespace(profile)
-                await self._reconcile_service_accounts(profile)
-                await self._reconcile_role_bindings(profile)
-                if self.opts.use_istio:
-                    await reconcile_child(
-                        self.kube, self._authorization_policy(profile)
-                    )
-                await self._reconcile_quota(profile)
-                await self._apply_plugins(profile)
+                # Dependency DAG (latency hiding, ISSUE 4): the Namespace
+                # must exist before anything namespaced lands in it; the
+                # RBAC/quota children are then independent of each other;
+                # plugins patch the ServiceAccounts the rbac stage made.
+                await apply_set(self.kube, [
+                    Stage("namespace", [self._namespace_obj(profile)]),
+                    Stage("rbac", [
+                        self._create_service_account(profile, DEFAULT_EDITOR),
+                        self._create_service_account(profile, DEFAULT_VIEWER),
+                        *self._role_bindings(profile),
+                        # Deliberate change from the pre-DAG code: the
+                        # policy now carries the Profile ownerReference
+                        # like every sibling, so it GC-cascades with the
+                        # tenant (one-time drift update on upgrade).
+                        (self._authorization_policy(profile)
+                         if self.opts.use_istio else None),
+                        self._reconcile_quota(profile),
+                    ]),
+                    Stage("plugins", [self._apply_plugins(profile)]),
+                ], owner=profile)
         except ApiError as e:
             self.m_failure.labels(profile=name).inc()
             with span("status"):
@@ -222,10 +233,10 @@ class ProfileReconciler:
         self._labels_cache = (mtime, labels)
         return dict(labels)
 
-    async def _reconcile_namespace(self, profile: dict) -> None:
+    def _namespace_obj(self, profile: dict) -> dict:
         name = name_of(profile)
         owner = profileapi.owner_of(profile).get("name", "")
-        ns = {
+        return {
             "apiVersion": "v1",
             "kind": "Namespace",
             "metadata": {
@@ -237,22 +248,18 @@ class ProfileReconciler:
                 },
             },
         }
-        set_controller_owner(ns, profile)
-        await reconcile_child(self.kube, ns)
 
-    async def _reconcile_service_accounts(self, profile: dict) -> None:
-        ns = name_of(profile)
-        for sa_name in (DEFAULT_EDITOR, DEFAULT_VIEWER):
-            sa = {
-                "apiVersion": "v1",
-                "kind": "ServiceAccount",
-                "metadata": {"name": sa_name, "namespace": ns},
-            }
-            set_controller_owner(sa, profile)
-            try:
-                await self.kube.create("ServiceAccount", sa)
-            except AlreadyExists:
-                pass  # plugin annotations are patched separately
+    async def _create_service_account(self, profile: dict, sa_name: str) -> None:
+        sa = {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": sa_name, "namespace": name_of(profile)},
+        }
+        set_controller_owner(sa, profile)
+        try:
+            await self.kube.create("ServiceAccount", sa)
+        except AlreadyExists:
+            pass  # plugin annotations are patched separately
 
     def _role_bindings(self, profile: dict) -> list[dict]:
         ns = name_of(profile)
@@ -275,11 +282,6 @@ class ProfileReconciler:
                 },
             ),
         ]
-
-    async def _reconcile_role_bindings(self, profile: dict) -> None:
-        for rb in self._role_bindings(profile):
-            set_controller_owner(rb, profile)
-            await reconcile_child(self.kube, rb)
 
     def _authorization_policy(self, profile: dict) -> dict:
         """Reference getAuthorizationPolicy (:419-504): owner + notebook

@@ -19,8 +19,9 @@ from kubeflow_tpu.controllers.common import (
 )
 from kubeflow_tpu.runtime.apply import (
     ApplyCache,
+    Stage,
+    apply_set,
     informer_reader,
-    reconcile_child,
 )
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result
 from kubeflow_tpu.runtime.objects import (
@@ -29,7 +30,6 @@ from kubeflow_tpu.runtime.objects import (
     get_meta,
     name_of,
     namespace_of,
-    set_controller_owner,
 )
 from kubeflow_tpu.runtime.tracing import span
 
@@ -68,16 +68,17 @@ class PVCViewerReconciler:
             children = [deployment, self.generate_service(viewer)]
             if self.opts.use_istio:
                 children.append(self.generate_virtual_service(viewer))
-        live_deployment = None
         with span("apply"):
-            for desired in children:
-                set_controller_owner(desired, viewer)
-                live, _ = await reconcile_child(
-                    self.kube, desired,
-                    cache=self._apply_cache, reader=self._reader,
-                )
-                if desired["kind"] == "Deployment":
-                    live_deployment = live
+            # Independent children — one stage, applied concurrently
+            # (latency hiding, ISSUE 4).
+            outcomes = await apply_set(
+                self.kube, [Stage("children", children)],
+                cache=self._apply_cache, reader=self._reader, owner=viewer,
+            )
+        live_deployment = next(
+            (row.result for row in outcomes[0]
+             if isinstance(row.child, dict)
+             and row.child.get("kind") == "Deployment"), None)
         with span("status"):
             await self._update_status(viewer, live_deployment)
         return None

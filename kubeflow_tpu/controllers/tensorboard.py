@@ -26,8 +26,9 @@ from kubeflow_tpu.controllers.common import (
 )
 from kubeflow_tpu.runtime.apply import (
     ApplyCache,
+    Stage,
+    apply_set,
     informer_reader,
-    reconcile_child,
 )
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result, Watch
@@ -36,7 +37,6 @@ from kubeflow_tpu.runtime.objects import (
     get_meta,
     name_of,
     namespace_of,
-    set_controller_owner,
 )
 from kubeflow_tpu.runtime.tracing import span
 
@@ -83,16 +83,17 @@ class TensorboardReconciler:
                 [self.generate_virtual_service(tb)]
                 if self.opts.use_istio else []
             )
-        live_deployment = None
         with span("apply"):
-            for desired in children:
-                set_controller_owner(desired, tb)
-                live, _ = await reconcile_child(
-                    self.kube, desired,
-                    cache=self._apply_cache, reader=self._reader,
-                )
-                if desired["kind"] == "Deployment":
-                    live_deployment = live
+            # Deployment / Service / VirtualService are independent —
+            # one stage, all children overlap (latency hiding, ISSUE 4).
+            outcomes = await apply_set(
+                self.kube, [Stage("children", children)],
+                cache=self._apply_cache, reader=self._reader, owner=tb,
+            )
+        live_deployment = next(
+            (row.result for row in outcomes[0]
+             if isinstance(row.child, dict)
+             and row.child.get("kind") == "Deployment"), None)
         with span("status"):
             await self._update_status(tb, live_deployment)
         return None

@@ -9,10 +9,13 @@ instead of fighting the apiserver.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import logging
+import os
 from collections import OrderedDict
+from dataclasses import dataclass
 
 from kubeflow_tpu.runtime.errors import AlreadyExists, Conflict, NotFound
 from kubeflow_tpu.runtime.metrics import global_registry
@@ -23,6 +26,7 @@ from kubeflow_tpu.runtime.objects import (
     get_meta,
     name_of,
     namespace_of,
+    set_controller_owner,
 )
 
 log = logging.getLogger(__name__)
@@ -270,3 +274,174 @@ async def reconcile_child(
         if cache is not None:
             cache.record(ckey, dh, get_meta(live).get("resourceVersion"))
         return live, False
+
+
+# ---- DAG-parallel child apply (latency hiding) -------------------------------
+
+# Kill switch / bench baseline: forces apply_set stages and overlap() to
+# run sequentially, restoring the pre-ISSUE-4 serial round-trip shape.
+SERIAL_ENV = "KFTPU_SERIAL_APPLY"
+
+
+def _serial() -> bool:
+    return os.environ.get(SERIAL_ENV, "") not in ("", "0", "false")
+
+
+class Stage:
+    """One dependency stage of an :func:`apply_set` DAG: a NAME (lands on
+    the ``apply_stage`` span; ci/check_tracing.py pins that converted
+    controllers declare literal stage names) plus the children that may
+    run concurrently. A child is a desired-object dict (applied through
+    :func:`reconcile_child`) or a coroutine / zero-arg async callable for
+    custom work that must still respect the stage ordering. ``None``
+    children are dropped, so option-gated children read naturally at the
+    call site."""
+
+    __slots__ = ("name", "children")
+
+    def __init__(self, name: str, children):
+        self.name = name
+        self.children = [c for c in children if c is not None]
+
+
+@dataclass
+class ChildOutcome:
+    """Per-child result of :func:`apply_set` — recorded even when a
+    stage-mate failed (first-error semantics raise only after the whole
+    stage settles)."""
+
+    child: object
+    result: object = None   # reconcile_child's live object / callable return
+    created: bool = False
+    error: Exception | None = None
+
+
+async def _run_child(kube, row: ChildOutcome, cache, reader, owner) -> None:
+    child = row.child
+    try:
+        if isinstance(child, dict):
+            if owner is not None:
+                set_controller_owner(child, owner)
+            row.result, row.created = await reconcile_child(
+                kube, child, cache=cache, reader=reader)
+        elif asyncio.iscoroutine(child):
+            row.result = await child
+        else:
+            row.result = await child()
+    except Exception as e:  # CancelledError propagates (shutdown)
+        row.error = e
+
+
+def _discard(children) -> None:
+    """Close coroutine children that will never run (stages skipped after
+    an earlier-stage error, or everything pending when a cancellation
+    tears through mid-run), so they don't warn about never being
+    awaited. Closing a finished coroutine is a no-op; a (theoretically)
+    still-running one refuses — skip it rather than mask the real
+    exception."""
+    for c in children:
+        if asyncio.iscoroutine(c):
+            try:
+                c.close()
+            except RuntimeError:
+                pass
+
+
+async def apply_set(
+    kube, stages, *, cache: ApplyCache | None = None, reader=None, owner=None,
+) -> list[list[ChildOutcome]]:
+    """Apply children as a dependency DAG of :class:`Stage` s.
+
+    Children within a stage overlap via ``asyncio.gather`` — each keeps
+    its own ``apply_child`` span and write elision — so a stage's wall
+    time is its slowest child's RTT chain, not the sum. Stage N+1 starts
+    only after every stage-N child settled (the barrier IS the dependency
+    edge: e.g. capacity → slice StatefulSets → Services).
+
+    First-error semantics: every stage-mate runs to completion and its
+    outcome is recorded, then the first error re-raises (the workqueue
+    retries with backoff). Later stages do not run; their coroutine
+    children are closed.
+
+    ``owner`` stamps the controller ownerReference on dict children;
+    ``cache``/``reader`` thread through to :func:`reconcile_child`.
+    ``KFTPU_SERIAL_APPLY=1`` forces sequential execution — the operator
+    escape hatch, and the measured serial baseline of
+    ``bench.py simulated_rtt``.
+    """
+    stages = list(stages)
+    outcomes: list[list[ChildOutcome]] = []
+    error: Exception | None = None
+    for i, stage in enumerate(stages):
+        if error is not None:
+            _discard(stage.children)
+            continue
+        rows = [ChildOutcome(c) for c in stage.children]
+        try:
+            with span("apply_stage", stage=stage.name,
+                      children=len(rows)) as sp:
+                if _serial() or len(rows) <= 1:
+                    for row in rows:
+                        await _run_child(kube, row, cache, reader, owner)
+                else:
+                    await asyncio.gather(
+                        *(_run_child(kube, row, cache, reader, owner)
+                          for row in rows))
+                failed = [r for r in rows if r.error is not None]
+                if failed:
+                    sp.fail(repr(failed[0].error))
+                    error = failed[0].error
+        except BaseException:
+            # Cancellation (or a non-Exception) tore through mid-stage:
+            # close this stage's never-started children and every later
+            # stage's, then let it propagate.
+            _discard(stage.children)
+            for later in stages[i + 1:]:
+                _discard(later.children)
+            raise
+        outcomes.append(rows)
+    if error is not None:
+        raise error
+    return outcomes
+
+
+async def overlap(*aws):
+    """Run independent reconcile steps concurrently (sequentially under
+    ``KFTPU_SERIAL_APPLY=1``) and return their results in argument order.
+    ``None`` arguments stay ``None`` in the result, so option-gated steps
+    keep positional results aligned. Same first-error semantics as an
+    apply_set stage: every step settles, then the first error re-raises.
+    """
+    async def run_one(a):
+        return None if a is None else await a
+
+    # ≤1 real awaitable or the kill switch: nothing to overlap — skip
+    # the per-coroutine Task spawns (the 0-RTT hot path keeps its cost).
+    if _serial() or sum(a is not None for a in aws) <= 1:
+        results, first = [], None
+        for i, a in enumerate(aws):
+            try:
+                results.append(await run_one(a))
+            except Exception as e:
+                results.append(None)
+                if first is None:
+                    first = e
+            except BaseException:
+                _discard(aws[i + 1:])  # cancelled mid-run
+                raise
+        if first is not None:
+            raise first
+        return results
+    try:
+        results = await asyncio.gather(
+            *(run_one(a) for a in aws), return_exceptions=True)
+    except BaseException:
+        # gather only raises here when itself cancelled; its run_one
+        # tasks were cancelled too, but one cancelled before its first
+        # step never awaited its inner coroutine — close stragglers.
+        _discard(aws)
+        raise
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
+    return results

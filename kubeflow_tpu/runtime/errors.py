@@ -46,6 +46,23 @@ class Unauthorized(ApiError):
     reason = "Unauthorized"
 
 
+class TooManyRequests(ApiError):
+    """429 — apiserver priority & fairness rejected the request. The
+    client honors Retry-After with bounded retries before raising."""
+
+    code = 429
+    reason = "TooManyRequests"
+
+
+class ServerTimeout(ApiError):
+    """No response within the client deadline (hung apiserver / dead
+    conntrack entry). Retriable: the workqueue re-queues with backoff,
+    which is exactly what a pinned-forever reconcile worker could not do."""
+
+    code = 504
+    reason = "ServerTimeout"
+
+
 def error_for_code(code: int, message: str = "", reason: str | None = None) -> ApiError:
     if code == 409:
         # Both AlreadyExists and Conflict are 409s; the apiserver's Status
@@ -61,7 +78,8 @@ def error_for_code(code: int, message: str = "", reason: str | None = None) -> A
         if "AlreadyExists" in message or "already exists" in message:
             return AlreadyExists(message)
         return Conflict(message)
-    for cls in (NotFound, Invalid, Forbidden, Unauthorized):
+    for cls in (NotFound, Invalid, Forbidden, Unauthorized, TooManyRequests,
+                ServerTimeout):
         if cls.code == code:
             return cls(message)
     err = ApiError(message)

@@ -8,17 +8,35 @@ side, with count aggregation like client-go's EventRecorder.
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 
 from kubeflow_tpu.runtime import tracing
-from kubeflow_tpu.runtime.errors import ApiError, NotFound
+from kubeflow_tpu.runtime.errors import AlreadyExists, ApiError, NotFound
 from kubeflow_tpu.runtime.objects import name_of, namespace_of, uid_of
 from kubeflow_tpu.runtime.objects import now_iso as _now
 
 
 class EventRecorder:
+    # Known-digest LRU bound: enough for every hot event series of a busy
+    # controller; an evicted digest costs one GET on its next emit.
+    CACHE_SIZE = 512
+
     def __init__(self, kube, component: str):
         self.kube = kube
         self.component = component
+        # (namespace, event-name) → last-written count. Steady-state
+        # aggregation (the overwhelmingly common case: the same reason
+        # re-emitted every reconcile) patches the count directly instead
+        # of paying a GET round trip per emit just to decide
+        # create-vs-patch. NotFound on the patch (event TTL'd/GC'd under
+        # us) invalidates the entry and falls back to create.
+        self._known: OrderedDict[tuple, int] = OrderedDict()
+
+    def _remember(self, key: tuple, count: int) -> None:
+        self._known[key] = count
+        self._known.move_to_end(key)
+        while len(self._known) > self.CACHE_SIZE:
+            self._known.popitem(last=False)
 
     async def event(
         self, obj: dict, event_type: str, reason: str, message: str
@@ -38,21 +56,27 @@ class EventRecorder:
             f"{ref['kind']}/{ref['namespace']}/{ref['name']}/{reason}/{message}".encode()
         ).hexdigest()[:10]
         name = f"{name_of(obj)}.{digest}"
-        try:
-            existing = await self.kube.get("Event", name, namespace)
-        except NotFound:
-            existing = None
-        if existing:
+        key = (namespace, name)
+        count = self._known.get(key)
+        if count is not None:
             try:
                 await self.kube.patch(
                     "Event",
                     name,
-                    {"count": existing.get("count", 1) + 1, "lastTimestamp": _now()},
+                    {"count": count + 1, "lastTimestamp": _now()},
                     namespace,
                 )
+                self._remember(key, count + 1)
                 return
+            except NotFound:
+                # The event expired between emits; create it fresh below.
+                self._known.pop(key, None)
             except ApiError:
                 return
+        # Cold miss: optimistic create — a brand-new event (the common
+        # cold case) costs ONE round trip instead of GET + create; an
+        # AlreadyExists (recorder restart over a live event, or a racing
+        # writer) falls back to read-and-aggregate.
         event = {
             "apiVersion": "v1",
             "kind": "Event",
@@ -68,5 +92,21 @@ class EventRecorder:
         }
         try:
             await self.kube.create("Event", event)
+            self._remember(key, 1)
+            return
+        except AlreadyExists:
+            pass
         except ApiError:
-            pass  # events are best-effort
+            return  # events are best-effort
+        try:
+            existing = await self.kube.get("Event", name, namespace)
+            await self.kube.patch(
+                "Event",
+                existing["metadata"]["name"],
+                {"count": existing.get("count", 1) + 1,
+                 "lastTimestamp": _now()},
+                namespace,
+            )
+            self._remember(key, existing.get("count", 1) + 1)
+        except ApiError:
+            self._known.pop(key, None)

@@ -1,0 +1,166 @@
+"""Client-side priority & fairness for apiserver traffic.
+
+The server-side APF machinery protects the apiserver from *all* clients;
+this is the client protecting itself — and the cluster — from its own
+burst shapes (ISSUE 4). Three lanes, each a bounded concurrency pool:
+
+- **read** (get/list): the informer relists and cold-cache fallbacks.
+- **write** (create/update/patch/delete of anything but Events): the
+  traffic that makes reconciles converge.
+- **event**: best-effort Event emission. Low priority by construction —
+  an event-lane request defers while the write lane is SATURATED
+  (queued-or-in-flight writes ≥ the write limit), so an event flood (a
+  cluster-wide slice restart narrating itself) can never starve the CR
+  writes that fix it. The deference is bounded (``event_patience``):
+  reconciles await their own event emissions inline, so an event must
+  never wedge the reconcile issuing the writes — after the patience
+  window it proceeds through its own (tiny) lane, which by construction
+  never consumes write capacity anyway.
+
+Watches are exempt: they are long-lived streams, and parking one in a
+semaphore slot would deadlock the informer machinery the lanes exist to
+serve. Both API clients route through this class — ``HttpKube`` on the
+wire, ``FakeKube`` in-process — so lane behavior is testable in tier-1.
+
+Limits default from env (documented in docs/operations.md):
+``KUBE_CLIENT_MAX_READS``, ``KUBE_CLIENT_MAX_WRITES``,
+``KUBE_CLIENT_EVENT_LANE``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+
+WRITE_VERBS = frozenset(
+    {"create", "update", "update_status", "patch", "delete"})
+READ_VERBS = frozenset({"get", "list"})
+
+READS_ENV = "KUBE_CLIENT_MAX_READS"
+WRITES_ENV = "KUBE_CLIENT_MAX_WRITES"
+EVENTS_ENV = "KUBE_CLIENT_EVENT_LANE"
+EVENT_PATIENCE_ENV = "KUBE_CLIENT_EVENT_PATIENCE"
+
+DEFAULT_MAX_READS = 16
+DEFAULT_MAX_WRITES = 8
+DEFAULT_EVENT_LANE = 1
+DEFAULT_EVENT_PATIENCE_SEC = 1.0
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return max(0.0, float(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
+
+
+class FlowControl:
+    """Bounded per-lane concurrency with a low-priority event lane."""
+
+    def __init__(
+        self,
+        max_reads: int | None = None,
+        max_writes: int | None = None,
+        event_lane: int | None = None,
+        event_patience: float | None = None,
+    ):
+        # Explicit 0 is clamped to 1, not silently replaced by the env
+        # default — a lane can be narrowed to serial, never to "off".
+        self.max_reads = (max(1, max_reads) if max_reads is not None
+                          else _env_int(READS_ENV, DEFAULT_MAX_READS))
+        self.max_writes = (max(1, max_writes) if max_writes is not None
+                           else _env_int(WRITES_ENV, DEFAULT_MAX_WRITES))
+        self.event_lane = (max(1, event_lane) if event_lane is not None
+                           else _env_int(EVENTS_ENV, DEFAULT_EVENT_LANE))
+        self.event_patience = (
+            event_patience if event_patience is not None
+            else _env_float(EVENT_PATIENCE_ENV, DEFAULT_EVENT_PATIENCE_SEC))
+        self._read_sem = asyncio.Semaphore(self.max_reads)
+        self._write_sem = asyncio.Semaphore(self.max_writes)
+        self._event_sem = asyncio.Semaphore(self.event_lane)
+        # Writes queued OR in flight. The event lane defers while this
+        # saturates the write limit (set() = lane has spare capacity).
+        self._writes_busy = 0
+        self._lane_open = asyncio.Event()
+        self._lane_open.set()
+        self.admitted = {"read": 0, "write": 0, "event": 0}
+
+    @staticmethod
+    def lane_of(verb: str, kind: str | None = None) -> str | None:
+        if verb in WRITE_VERBS:
+            return "event" if kind == "Event" else "write"
+        if verb in READ_VERBS:
+            return "read"
+        return None  # watch / pod_logs: long-lived or out of scope
+
+    async def acquire(self, verb: str, kind: str | None = None) -> str | None:
+        lane = self.lane_of(verb, kind)
+        if lane == "read":
+            await self._read_sem.acquire()
+        elif lane == "write":
+            self._bump_writes(+1)
+            try:
+                await self._write_sem.acquire()
+            except BaseException:
+                self._bump_writes(-1)
+                raise
+        elif lane == "event":
+            # Low priority, bounded: defer while the write lane is
+            # saturated (re-check after every wakeup — a new write may
+            # have re-closed the gate), but never past the patience
+            # window — reconciles await their own emissions inline, and
+            # the event lane never consumes write capacity anyway.
+            deadline = asyncio.get_running_loop().time() + self.event_patience
+            while self._writes_busy >= self.max_writes:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    await asyncio.wait_for(self._lane_open.wait(), remaining)
+                except asyncio.TimeoutError:
+                    break
+            await self._event_sem.acquire()
+        if lane is not None:
+            self.admitted[lane] += 1
+        return lane
+
+    def release(self, verb: str, kind: str | None = None) -> None:
+        lane = self.lane_of(verb, kind)
+        if lane == "read":
+            self._read_sem.release()
+        elif lane == "write":
+            self._write_sem.release()
+            self._bump_writes(-1)
+        elif lane == "event":
+            self._event_sem.release()
+
+    def _bump_writes(self, delta: int) -> None:
+        self._writes_busy = max(0, self._writes_busy + delta)
+        if self._writes_busy >= self.max_writes:
+            self._lane_open.clear()
+        else:
+            self._lane_open.set()
+
+    @contextlib.asynccontextmanager
+    async def slot(self, verb: str, kind: str | None = None):
+        lane = await self.acquire(verb, kind)
+        try:
+            yield lane
+        finally:
+            self.release(verb, kind)
+
+    def debug_info(self) -> dict:
+        return {
+            "limits": {"read": self.max_reads, "write": self.max_writes,
+                       "event": self.event_lane},
+            "writes_busy": self._writes_busy,
+            "admitted": dict(self.admitted),
+        }

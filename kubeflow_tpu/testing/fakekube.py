@@ -23,6 +23,8 @@ from __future__ import annotations
 import asyncio
 import copy
 import fnmatch
+import random
+import time
 import uuid
 from collections import defaultdict, deque
 from typing import Any, AsyncIterator, Awaitable, Callable
@@ -85,22 +87,92 @@ class FakeKube:
         self.requests: dict[str, int] = defaultdict(int)
         # Bounded request log with the headers a real client would have
         # sent — in particular X-Request-Id carrying the active trace id,
-        # mirroring HttpKube. Tests pin controller → request-header →
-        # flight-recorder trace-id propagation against it.
+        # mirroring HttpKube — plus per-request start/end monotonic
+        # stamps, so latency tests can prove which requests overlapped.
+        # Tests pin controller → request-header → flight-recorder
+        # trace-id propagation against it.
         self.request_log: deque[dict] = deque(maxlen=1000)
+        # Injectable per-request latency (set_latency): simulated network
+        # RTT, slept OUTSIDE the store lock so concurrent requests
+        # overlap exactly as real round trips do.
+        self.latency = 0.0
+        self.latency_jitter = 0.0
+        self._rng = random.Random(0)  # deterministic jitter for tests
+        # In-flight high-water gauge: the proof that requests actually
+        # overlap (serial clients never exceed 1).
+        self._in_flight = 0
+        self.in_flight_peak = 0
+        # Optional client-side flow control (runtime/flowcontrol.py),
+        # mirroring HttpKube so lane behavior is testable in tier-1.
+        self.flow = None
 
-    def _note(self, verb: str, kind: str, name: str | None = None,
-              namespace: str | None = None) -> None:
+    # ---- latency / concurrency instrumentation --------------------------------
+
+    def set_latency(self, seconds: float, jitter: float = 0.0) -> None:
+        """Inject per-request latency (+ uniform jitter) — the simulated
+        RTT every request pays before touching the store."""
+        self.latency = seconds
+        self.latency_jitter = jitter
+
+    def use_flow_control(self, flow) -> None:
+        """Route every request through a FlowControl lane gate, as
+        HttpKube does on the wire."""
+        self.flow = flow
+
+    def reset_in_flight_peak(self) -> None:
+        self.in_flight_peak = 0
+
+    def _log_request(self, verb: str, kind: str, name: str | None = None,
+                     namespace: str | None = None) -> dict:
         self.requests[verb] += 1
         trace_id = tracing.current_trace_id()
-        self.request_log.append({
+        entry = {
             "verb": verb,
             "kind": kind,
             "name": name,
             "namespace": namespace,
             "headers": {"X-Request-Id": trace_id} if trace_id else {},
-        })
+            # start is stamped at ADMISSION (_admit), not arrival: the
+            # [start, end] window means "being served", so overlap
+            # assertions aren't muddied by flow-lane queue wait.
+            "start": None,
+            "end": None,
+        }
+        self.request_log.append(entry)
         tracing.note_api_call(verb, kind)
+        return entry
+
+    async def _admit(self, entry: dict) -> None:
+        """Flow-control admission + RTT sleep; in-flight counts requests
+        being SERVED (a lane-queued request isn't in flight yet), and the
+        entry's ``start`` is stamped here for the same reason.
+        Balanced under cancellation: anything acquired here is undone
+        before re-raising, so callers only pair ``_finish`` with a fully
+        admitted request."""
+        verb, kind = entry["verb"], entry["kind"]
+        if self.flow is not None:
+            await self.flow.acquire(verb, kind)
+        entry["start"] = time.monotonic()
+        self._in_flight += 1
+        if self._in_flight > self.in_flight_peak:
+            self.in_flight_peak = self._in_flight
+        if self.latency > 0.0:
+            delay = self.latency
+            if self.latency_jitter:
+                delay += self._rng.uniform(0.0, self.latency_jitter)
+            try:
+                await asyncio.sleep(delay)
+            except BaseException:  # cancelled mid-RTT: undo the admission
+                self._in_flight -= 1
+                if self.flow is not None:
+                    self.flow.release(verb, kind)
+                raise
+
+    def _finish(self, entry: dict) -> None:
+        self._in_flight -= 1
+        entry["end"] = time.monotonic()
+        if self.flow is not None:
+            self.flow.release(entry["verb"], entry["kind"])
 
     def write_count(self) -> int:
         """Mutating requests issued so far (no-op writes the server
@@ -169,13 +241,18 @@ class FakeKube:
     # ---- KubeApi surface -----------------------------------------------------
 
     async def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
-        self._note("get", kind, name, namespace)
-        bucket = self._bucket(kind)
-        key = self._key(kind, name, namespace)
-        obj = bucket.get(key)
-        if obj is None:
-            raise NotFound(f"{kind} {key[0]}/{key[1]} not found")
-        return deepcopy(obj)
+        entry = self._log_request("get", kind, name, namespace)
+        await self._admit(entry)
+
+        try:
+            bucket = self._bucket(kind)
+            key = self._key(kind, name, namespace)
+            obj = bucket.get(key)
+            if obj is None:
+                raise NotFound(f"{kind} {key[0]}/{key[1]} not found")
+            return deepcopy(obj)
+        finally:
+            self._finish(entry)
 
     async def list(
         self,
@@ -197,23 +274,28 @@ class FakeKube:
         scans dominated the control-plane bench's profile otherwise.
         Callers must not mutate the returned objects.
         """
-        self._note("list", kind, namespace=namespace)
-        selector = (
-            parse_label_selector(label_selector)
-            if isinstance(label_selector, str)
-            else label_selector
-        )
-        out = []
-        for obj in self._bucket(kind).values():
-            if namespace and namespace_of(obj) != namespace:
-                continue
-            if not matches_selector(get_meta(obj).get("labels"), selector):
-                continue
-            if field_selector and not field_selector(obj):
-                continue
-            out.append(deepcopy(obj) if copy else obj)
-        out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
-        return out
+        entry = self._log_request("list", kind, namespace=namespace)
+        await self._admit(entry)
+
+        try:
+            selector = (
+                parse_label_selector(label_selector)
+                if isinstance(label_selector, str)
+                else label_selector
+            )
+            out = []
+            for obj in self._bucket(kind).values():
+                if namespace and namespace_of(obj) != namespace:
+                    continue
+                if not matches_selector(get_meta(obj).get("labels"), selector):
+                    continue
+                if field_selector and not field_selector(obj):
+                    continue
+                out.append(deepcopy(obj) if copy else obj)
+            out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
+            return out
+        finally:
+            self._finish(entry)
 
     async def list_with_rv(
         self,
@@ -226,7 +308,18 @@ class FakeKube:
         return items, str(self._rv)
 
     async def create(self, kind: str, obj: dict, namespace: str | None = None) -> dict:
-        self._note("create", kind, name_of(obj), namespace or namespace_of(obj))
+        entry = self._log_request(
+            "create", kind, name_of(obj), namespace or namespace_of(obj))
+        await self._admit(entry)
+
+        try:
+            return await self._create_locked(kind, obj, namespace)
+        finally:
+            self._finish(entry)
+
+    async def _create_locked(
+        self, kind: str, obj: dict, namespace: str | None = None
+    ) -> dict:
         async with self._lock:
             obj = deepcopy(obj)
             obj.setdefault("kind", kind)
@@ -253,7 +346,15 @@ class FakeKube:
             return deepcopy(obj)
 
     async def update(self, kind: str, obj: dict) -> dict:
-        self._note("update", kind, name_of(obj), namespace_of(obj))
+        entry = self._log_request("update", kind, name_of(obj), namespace_of(obj))
+        await self._admit(entry)
+
+        try:
+            return await self._update_locked(kind, obj)
+        finally:
+            self._finish(entry)
+
+    async def _update_locked(self, kind: str, obj: dict) -> dict:
         async with self._lock:
             obj = deepcopy(obj)
             bucket = self._bucket(kind)
@@ -295,7 +396,16 @@ class FakeKube:
             return deepcopy(obj)
 
     async def update_status(self, kind: str, obj: dict) -> dict:
-        self._note("update_status", kind, name_of(obj), namespace_of(obj))
+        entry = self._log_request(
+            "update_status", kind, name_of(obj), namespace_of(obj))
+        await self._admit(entry)
+
+        try:
+            return await self._update_status_locked(kind, obj)
+        finally:
+            self._finish(entry)
+
+    async def _update_status_locked(self, kind: str, obj: dict) -> dict:
         async with self._lock:
             bucket = self._bucket(kind)
             key = self._key(kind, obj, None)
@@ -322,7 +432,23 @@ class FakeKube:
     ) -> dict:
         """Strategic-ish merge patch: dicts merge recursively, None deletes,
         lists replace (the k8s merge-patch rule)."""
-        self._note("patch", kind, name, namespace)
+        entry = self._log_request("patch", kind, name, namespace)
+        await self._admit(entry)
+
+        try:
+            return await self._patch_locked(kind, name, patch, namespace,
+                                            subresource)
+        finally:
+            self._finish(entry)
+
+    async def _patch_locked(
+        self,
+        kind: str,
+        name: str,
+        patch: dict,
+        namespace: str | None = None,
+        subresource: str | None = None,
+    ) -> dict:
         async with self._lock:
             bucket = self._bucket(kind)
             key = self._key(kind, name, namespace)
@@ -365,10 +491,15 @@ class FakeKube:
             return deepcopy(new)
 
     async def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
-        self._note("delete", kind, name, namespace)
-        async with self._lock:
-            key = self._key(kind, name, namespace)
-            await self._delete_obj(kind, key)
+        entry = self._log_request("delete", kind, name, namespace)
+        await self._admit(entry)
+
+        try:
+            async with self._lock:
+                key = self._key(kind, name, namespace)
+                await self._delete_obj(kind, key)
+        finally:
+            self._finish(entry)
 
     async def _delete_obj(self, kind: str, key: tuple[str | None, str]) -> None:
         bucket = self._bucket(kind)

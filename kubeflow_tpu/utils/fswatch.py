@@ -130,8 +130,14 @@ class FileWatcher:
                 self._fd = fd
 
     def _mtime(self):
+        """Change signature: (inode, mtime, size), not mtime alone — a
+        ConfigMap-style symlink swap always changes the resolved inode,
+        but the old and new targets can carry the SAME mtime when they
+        were written within one filesystem timestamp tick (tmpfs clock
+        granularity), which made swap detection racy."""
         try:
-            return os.stat(self.path).st_mtime_ns
+            st = os.stat(self.path)
+            return (st.st_ino, st.st_mtime_ns, st.st_size)
         except OSError:
             return None
 

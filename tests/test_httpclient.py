@@ -10,6 +10,7 @@ past aiohttp's 64 KiB readline limit), ERROR watch events surfacing as
 ApiError, and resourceVersion continuation.
 """
 
+import asyncio
 import json
 from contextlib import asynccontextmanager
 
@@ -17,7 +18,13 @@ import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestServer
 
-from kubeflow_tpu.runtime.errors import AlreadyExists, ApiError, Conflict, NotFound
+from kubeflow_tpu.runtime.errors import (
+    AlreadyExists,
+    ApiError,
+    Conflict,
+    NotFound,
+    ServerTimeout,
+)
 from kubeflow_tpu.runtime.httpclient import HttpKube
 
 
@@ -27,7 +34,11 @@ class FakeApiServer:
     def __init__(self):
         self.requests: list[tuple[str, str, dict, bytes]] = []
         self.responses: dict[tuple[str, str], tuple[int, object]] = {}
+        # One-shot scripted responses (status, payload, headers) consumed
+        # before ``responses`` — lets a test serve 429-then-200.
+        self.once: dict[tuple[str, str], tuple[int, object, dict]] = {}
         self.watch_lines: list[bytes] = []
+        self.delay = 0.0  # per-request hang, for client-timeout tests
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self.handle)
         self.server = TestServer(app)
@@ -38,6 +49,8 @@ class FakeApiServer:
         self.requests.append(
             (request.method, path, dict(request.query),
              bytes(request.headers.get("Content-Type", ""), "utf-8") + b"|" + body))
+        if self.delay:
+            await asyncio.sleep(self.delay)
         if request.query.get("watch") == "true":
             resp = web.StreamResponse()
             await resp.prepare(request)
@@ -45,9 +58,13 @@ class FakeApiServer:
                 await resp.write(line)
             await resp.write_eof()
             return resp
-        status, payload = self.responses.get(
-            (request.method, path), (200, {"ok": True}))
-        return web.json_response(payload, status=status)
+        key = (request.method, path)
+        if key in self.once:
+            status, payload, headers = self.once.pop(key)
+            return web.json_response(payload, status=status, headers=headers)
+        status, payload, *rest = self.responses.get(key, (200, {"ok": True}))
+        return web.json_response(payload, status=status,
+                                 headers=rest[0] if rest else None)
 
     async def __aenter__(self):
         await self.server.start_server()
@@ -197,3 +214,47 @@ async def test_pod_logs_params():
         _m, path, query, _b = api.requests[-1]
         assert path == "/api/v1/namespaces/ns/pods/p/log"
         assert query == {"container": "main", "tailLines": "50"}
+
+
+async def test_hung_apiserver_surfaces_as_retriable_timeout():
+    """ISSUE 4 satellite: a session with no deadline pinned a reconcile
+    worker forever on a hung apiserver; now it raises a retriable
+    ApiError (ServerTimeout, 504) the workqueue backs off on."""
+    async with FakeApiServer() as api:
+        api.delay = 1.0
+        kube = HttpKube(base_url=api.url, timeout=0.15)
+        try:
+            with pytest.raises(ServerTimeout) as exc:
+                await kube.get("Notebook", "nb", "ns")
+            assert exc.value.code == 504
+            assert isinstance(exc.value, ApiError)
+        finally:
+            await kube.close()
+
+
+async def test_429_honors_retry_after_and_retries():
+    async with harness() as (api, kube):
+        path = "/apis/kubeflow.org/v1/namespaces/ns/notebooks/nb"
+        api.once[("GET", path)] = (
+            429, {"kind": "Status", "reason": "TooManyRequests"},
+            {"Retry-After": "0"})
+        api.responses[("GET", path)] = (
+            200, {"kind": "Notebook", "metadata": {"name": "nb"}})
+        nb = await kube.get("Notebook", "nb", "ns")
+        assert nb["metadata"]["name"] == "nb"
+        gets = [(m, p) for m, p, _q, _b in api.requests if p == path]
+        assert len(gets) == 2  # first attempt + one Retry-After retry
+
+
+async def test_429_retries_are_bounded():
+    async with harness() as (api, kube):
+        path = "/apis/kubeflow.org/v1/namespaces/ns/notebooks/nb"
+        api.responses[("GET", path)] = (
+            429, {"kind": "Status", "reason": "TooManyRequests"},
+            {"Retry-After": "0"})
+        with pytest.raises(ApiError) as exc:
+            await kube.get("Notebook", "nb", "ns")
+        assert exc.value.code == 429
+        attempts = [(m, p) for m, p, _q, _b in api.requests if p == path]
+        assert len(attempts) == kube._max_429_retries + 1
+
