@@ -43,6 +43,51 @@ APPLY_SET_REQUIRED = (
     "notebook.py", "tensorboard.py", "pvcviewer.py", "profile.py",
 )
 
+# Fleet-scheduler contract (ISSUE 5): the scheduler's runtime must
+# register its arbitration phases (schedule/admit/preempt) so
+# /debug/traces can show where an admission decision spent its time, and
+# the notebook controller's capacity stage must route through the
+# scheduler gate — a refactor that silently drops the consult would
+# reintroduce first-come/partial admission under chip pressure.
+SCHEDULER_RUNTIME = os.path.join(
+    REPO, "kubeflow_tpu", "scheduler", "runtime.py")
+SCHEDULER_PHASES = ("schedule", "admit", "preempt")
+NOTEBOOK_CONTROLLER = os.path.join(CONTROLLERS_DIR, "notebook.py")
+SCHEDULER_GATE_RE = re.compile(r"await self\._scheduler_gate\(")
+SCHEDULER_GATE_DEF_RE = re.compile(r"async def _scheduler_gate\(")
+SCHEDULER_CONSULT_RE = re.compile(r"\.(admission|release)\(")
+
+
+def check_scheduler() -> list[str]:
+    problems = []
+    rel_rt = os.path.relpath(SCHEDULER_RUNTIME, REPO)
+    try:
+        src = open(SCHEDULER_RUNTIME).read()
+    except OSError:
+        return [f"{rel_rt}: missing — the fleet scheduler runtime is the "
+                "notebook capacity stage's admission point (ISSUE 5)"]
+    phases = set(SPAN_RE.findall(src))
+    for phase in SCHEDULER_PHASES:
+        if phase not in phases:
+            problems.append(
+                f"{rel_rt}: missing the `{phase}` phase span — scheduler "
+                "decisions must land in the reconcile trace tree")
+    nb_src = open(NOTEBOOK_CONTROLLER).read()
+    rel_nb = os.path.relpath(NOTEBOOK_CONTROLLER, REPO)
+    if not SCHEDULER_GATE_RE.search(nb_src):
+        problems.append(
+            f"{rel_nb}: the capacity stage no longer awaits "
+            "_scheduler_gate — slice StatefulSets would be created "
+            "without fleet admission (silent scheduler bypass)")
+    gate_def = SCHEDULER_GATE_DEF_RE.search(nb_src)
+    gate_body = nb_src[gate_def.end():gate_def.end() + 4000] if gate_def \
+        else ""
+    if not gate_def or not SCHEDULER_CONSULT_RE.search(gate_body):
+        problems.append(
+            f"{rel_nb}: _scheduler_gate no longer consults the scheduler "
+            "(.admission()/.release()) — the gate is a stub")
+    return problems
+
 
 def check_file(path: str) -> list[str]:
     src = open(path).read()
@@ -89,6 +134,7 @@ def main() -> int:
     for fname in sorted(os.listdir(CONTROLLERS_DIR)):
         if fname.endswith(".py"):
             problems.extend(check_file(os.path.join(CONTROLLERS_DIR, fname)))
+    problems.extend(check_scheduler())
     for p in problems:
         print(f"check_tracing: {p}", file=sys.stderr)
     if not problems:

@@ -105,6 +105,10 @@ def workflow_tests() -> dict:
                     run(None, PIP_INSTALL),
                     run("Lint: controllers register reconcile phases with the tracer",
                         "python ci/check_tracing.py"),
+                    run("Fleet-scheduler smoke bench (gang admission, fairness, "
+                        "idle preemption)",
+                        "python bench.py scheduler_scale --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

@@ -81,6 +81,19 @@ UPDATE_PENDING_ANNOTATION = "notebooks.kubeflow.org/update-pending"
 # machine and by in-notebook tooling that wants to checkpoint early.
 MAINTENANCE_ANNOTATION = "notebooks.kubeflow.org/maintenance-pending"
 
+# Fleet-scheduler contract (kubeflow_tpu/scheduler/):
+# - priority class ("low"|"normal"|"high"|"critical" or an int) the user
+#   sets on the CR; read at gang admission;
+PRIORITY_ANNOTATION = "notebooks.kubeflow.org/priority"
+# - stamped by the scheduler when the gang is admitted; culling floors
+#   its idle clock on it (a notebook that queued for hours must not be
+#   culled seconds after it finally starts), and the scheduler's idle-
+#   preemption ranking reads it back;
+SCHEDULER_ADMITTED_AT_ANNOTATION = "notebooks.kubeflow.org/admitted-at"
+# - stamped (with the reason) alongside the stop annotation when the
+#   scheduler preempts the gang; cleared on re-admission.
+PREEMPTED_ANNOTATION = "notebooks.kubeflow.org/preempted"
+
 # Pod-template annotations the controller stamps so pod-level admission can
 # compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
 TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"

@@ -37,6 +37,9 @@ def build_manager_app(mgr=None) -> web.Application:
       backoff keys with their next delay, oldest queue wait.
     - ``/debug/informers`` — cache sync state, object counts, and
       secondary-index hit/miss per informer.
+    - ``/debug/scheduler`` (when the fleet scheduler is wired) — pools
+      and free slices, admitted gangs, the ranked queue, per-namespace
+      chip shares, preemption verdicts, invariant-violation counter.
     """
     app = web.Application()
 
@@ -73,6 +76,17 @@ def build_manager_app(mgr=None) -> web.Application:
         app.router.add_get("/debug/traces", debug_traces)
         app.router.add_get("/debug/queue", debug_queue)
         app.router.add_get("/debug/informers", debug_informers)
+
+        if getattr(mgr, "scheduler", None) is not None:
+            async def debug_scheduler(_request):
+                # Pools with free slices, admitted gangs with placements,
+                # the ranked queue with positions/reasons, per-namespace
+                # chip shares, and the invariant-violation counter (must
+                # read 0).
+                return web.json_response(
+                    {"scheduler": mgr.scheduler.debug_info()})
+
+            app.router.add_get("/debug/scheduler", debug_scheduler)
     return app
 
 

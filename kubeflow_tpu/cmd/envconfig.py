@@ -65,6 +65,37 @@ def notebook_options():
     )
 
 
+def scheduler_options():
+    """Fleet-scheduler env contract (docs/operations.md "TPU fleet
+    scheduler"). The on/off switch itself is KFTPU_SCHEDULER, read by
+    kubeflow_tpu.scheduler.scheduler_enabled."""
+    from kubeflow_tpu.scheduler.runtime import SchedulerOptions
+
+    weights: dict[str, float] = {}
+    for entry in env_str("KFTPU_SCHEDULER_WEIGHTS", "").split(","):
+        name, sep, value = entry.strip().partition("=")
+        if not sep or not name:
+            continue
+        try:
+            weights[name] = float(value)
+        except ValueError:
+            continue
+    return SchedulerOptions(
+        fleet_spec=env_str("KFTPU_FLEET", "").strip(),
+        fleet_configmap=os.environ.get("KFTPU_FLEET_CONFIGMAP") or None,
+        controller_namespace=controller_namespace(),
+        weights=weights,
+        aging_seconds=env_float("KFTPU_SCHEDULER_AGING_SECONDS", 300.0),
+        starvation_reserve_seconds=env_float(
+            "KFTPU_SCHEDULER_STARVATION_SECONDS", 900.0),
+        enable_preemption=env_bool("KFTPU_SCHEDULER_PREEMPTION", True),
+        idle_preempt_after_seconds=env_float(
+            "KFTPU_SCHEDULER_IDLE_AFTER_SECONDS", 1800.0),
+        queued_requeue_seconds=env_float(
+            "KFTPU_SCHEDULER_QUEUED_REQUEUE_SECONDS", 10.0),
+    )
+
+
 def culling_options():
     from kubeflow_tpu.controllers.culling import CullingOptions
 

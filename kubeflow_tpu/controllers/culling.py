@@ -177,6 +177,23 @@ class CullingReconciler:
         last_activity = _parse_time(
             annotations.get(nbapi.LAST_ACTIVITY_ANNOTATION, "")
         )
+        # Idleness clocks from when the notebook last RAN, not from its
+        # history: a gang that sat hours in the fleet scheduler's queue
+        # still carries its pre-queue last-activity annotation, and
+        # culling it seconds after admission would bounce it between
+        # queue and cull forever. The scheduler's admitted-at stamp
+        # (which it also reads back for idle-preemption ranking) floors
+        # the clock at the moment the notebook actually started running.
+        # It only RAISES an existing stale signal — a notebook with no
+        # activity record at all must fall through to the fresh-server
+        # branch below, not inherit the admission time as "activity"
+        # (admission precedes the GKE provisioning wait, so that would
+        # cull a slow-booting gang on its very first probe).
+        admitted_at = _parse_time(
+            annotations.get(nbapi.SCHEDULER_ADMITTED_AT_ANNOTATION, "")
+        )
+        if admitted_at is not None and last_activity is not None:
+            last_activity = max(last_activity, admitted_at)
 
         busy, probe_activity = _fold_activity(kernels or [], terminals or [])
         if busy:

@@ -208,6 +208,13 @@ class NotebookReconciler:
         self.kube = kube
         self.opts = options or NotebookOptions()
         self.recorder = EventRecorder(kube, "notebook-controller")
+        # Fleet scheduler (kubeflow_tpu/scheduler): the cluster-level gang
+        # arbiter the capacity stage consults before any slice StatefulSet
+        # exists. None (bare-reconciler tests, KFTPU_SCHEDULER=off) or an
+        # inactive scheduler (no fleet configured) means admission passes
+        # through — the pre-scheduler behavior. Set by
+        # setup_notebook_controller.
+        self._scheduler = None
         # (ns, name) → {pod-event-name: count} — events already mirrored, so
         # each reconcile re-emits only NEW occurrences (a plain list-driven
         # re-emit would bump the mirrored count once per reconcile, turning
@@ -286,6 +293,10 @@ class NotebookReconciler:
             # notebook's contribution now, not at the next unrelated
             # reconcile in this namespace.
             self._set_gauge_contribution(namespace, name, 0, 0)
+            if self._scheduler is not None:
+                # Admission handle dies with the CR: its chips go back to
+                # the fleet and the scheduler re-arbitrates immediately.
+                await self._scheduler.release((namespace, name))
             return None  # children die by ownerReference cascade
 
         try:
@@ -296,7 +307,7 @@ class NotebookReconciler:
         tpu = ms.slice if ms else None
 
         with span("apply"):
-            capacity_pending, capacity_requeue = \
+            capacity_pending, capacity_requeue, admission = \
                 await self._apply_children(nb, ms, tpu)
 
         with span("status"):
@@ -311,25 +322,46 @@ class NotebookReconciler:
                 self._check_maintenance(nb, pods),
                 self._mirror_events(nb, pods),
             )
-            await self._update_status(nb, ms, capacity_pending=capacity_pending)
+            await self._update_status(nb, ms, capacity_pending=capacity_pending,
+                                      admission=admission)
         if capacity_pending:
             return capacity_requeue
         return requeue
 
     async def _apply_children(
         self, nb: dict, ms, tpu
-    ) -> tuple[bool, Result | None]:
+    ) -> tuple[bool, Result | None, object | None]:
         """The child-object phase of reconcile as a dependency DAG
         (latency hiding, ISSUE 4): capacity gate → [all slice
         StatefulSets] → [Service, headless Service, VirtualService,
         NetworkPolicy, RBAC, slice GC]. Stage-mates overlap; each stage
         waits for the previous one, so against a real apiserver the wall
         time is the critical-path RTT depth, not the child count.
-        Returns (capacity_pending, capacity_requeue)."""
-        # Stage "capacity": the queued-provisioning gate and the CA-bundle
-        # mirror are independent round-trip chains — overlap them. The
-        # gate's verdict shapes the slices stage, so it stays control
-        # flow rather than an apply_set child.
+        Returns (capacity_pending, capacity_requeue, admission)."""
+        # Stage "capacity", part 1: cluster-level gang arbitration
+        # (kubeflow_tpu/scheduler). The fleet scheduler is the single
+        # admission point between the CR and its slice StatefulSets —
+        # while the gang is Queued, nothing downstream runs: no slice
+        # may exist and GKE capacity must not be reserved for a gang
+        # that lost the arbitration. In-process (no RTT), so it runs
+        # before — not overlapped with — the provisioning gate.
+        admission = await self._scheduler_gate(nb, ms)
+        if admission is not None and admission.state == "Queued":
+            # Queued ⇒ no StatefulSet AND no GKE reservation. A PR left
+            # behind on any path that still lands here would double-book
+            # the physical slice while the chips belong to another gang —
+            # drop it (informer-checked: a no-op for the common
+            # never-admitted queued gang).
+            if self.opts.enable_queued_provisioning and ms \
+                    and nbapi.queued_provisioning(nb):
+                await self._release_capacity(nb)
+            requeue = Result(requeue_after=(
+                self._scheduler.options.queued_requeue_seconds))
+            return True, requeue, admission
+        # Stage "capacity", part 2: the queued-provisioning gate and the
+        # CA-bundle mirror are independent round-trip chains — overlap
+        # them. The gate's verdict shapes the slices stage, so it stays
+        # control flow rather than an apply_set child.
         with span("apply_stage", stage="capacity"):
             (capacity_pending, capacity_provisioned, capacity_requeue), _ = \
                 await overlap(
@@ -362,7 +394,72 @@ class NotebookReconciler:
                 except Exception:
                     pass  # events are best-effort; keep the real error
             raise
-        return capacity_pending, capacity_requeue
+        return capacity_pending, capacity_requeue, admission
+
+    async def _scheduler_gate(self, nb: dict, ms):
+        """Consult the TPU fleet scheduler (the ``schedule``/``admit``/
+        ``preempt`` spans live inside it). Returns the current
+        :class:`~kubeflow_tpu.scheduler.runtime.Admission`, or None when
+        no scheduler is wired / no fleet is configured / the notebook is
+        CPU-only — all of which mean "admit unconditionally".
+
+        A stopped notebook (user stop, culling, or a preemption's stop
+        annotation) releases its admission handle here; the normal apply
+        path still runs afterwards so the gang actually parks (replicas
+        0 everywhere). A gang whose StatefulSets are already live —
+        controller restart, scheduler turned on over a running fleet —
+        is re-seated (reclaimed), never re-queued."""
+        sched = self._scheduler
+        if sched is None:
+            return None
+        key = (namespace_of(nb), name_of(nb))
+        if ms is None:
+            # Edited from TPU to CPU while Queued/Admitted (the webhook
+            # allows spec edits on queued gangs): the gang no longer
+            # exists, so drop its queue entry / allocation — otherwise
+            # the stale entry holds (or later takes) fleet chips and, if
+            # starved, blocks backfill forever. CPU notebooks carry no
+            # scheduler status, so the verdict is discarded.
+            await sched.release(key, nb)
+            return None
+        if nbapi.is_stopped(nb):
+            return await sched.release(key, nb)
+        # Liveness probed unconditionally (not just once the fleet is
+        # active) because admission() itself can activate a lazily-
+        # discovered fleet — and must then reclaim, not queue, a gang
+        # that is already running. A live ProvisioningRequest counts as
+        # running for the same reason: it is created only AFTER admission
+        # and deleted on park, so across a controller restart it is the
+        # proof of admission for a gang still waiting on GKE capacity
+        # (no StatefulSet yet) — re-queueing that gang would hand its
+        # ledger chips to another while the GKE reservation double-books
+        # the physical slice.
+        return await sched.admission(
+            nb, ms, running=(await self._gang_running(nb, ms)
+                             or await self._holds_reservation(nb)))
+
+    async def _holds_reservation(self, nb: dict) -> bool:
+        """Does this notebook hold a live GKE ProvisioningRequest?
+        Informer-checked, so the common no-PR case costs nothing."""
+        if not (self.opts.enable_queued_provisioning
+                and nbapi.queued_provisioning(nb)):
+            return False
+        name, ns = name_of(nb), namespace_of(nb)
+        cap_name = capacity_name(name)
+        if self._pr_informer is not None:
+            return self._pr_informer.get(cap_name, ns) is not None
+        return await self.kube.get_or_none(
+            "ProvisioningRequest", cap_name, ns) is not None
+
+    async def _gang_running(self, nb: dict, ms) -> bool:
+        """Is this notebook's gang actively running (slice-0 StatefulSet
+        live with replicas > 0)? Informer-cached; shared by the
+        scheduler gate (reclaim-vs-queue) and the provisioning gate
+        (hold-vs-pass on an unprovisioned request)."""
+        sts0 = ms.slice_sts_name(name_of(nb), 0)
+        existing = await self._live_sts(sts0, namespace_of(nb))
+        return existing is not None and (
+            deep_get(existing, "spec", "replicas") or 0) > 0
 
     async def _apply_children_stages(
         self, nb: dict, ms, tpu, num_sts: int, capacity_provisioned: bool,
@@ -427,11 +524,8 @@ class NotebookReconciler:
         # to a false capacity wait). A parked STS (replicas 0,
         # reservation released on park) still gates: restart queues for
         # fresh capacity.
-        sts0 = ms.slice_sts_name(name_of(nb), 0)
-        existing = await self._live_sts(sts0, namespace_of(nb))
-        actively_running = existing is not None and (
-            deep_get(existing, "spec", "replicas") or 0) > 0
-        return (not actively_running), False, capacity_requeue
+        return (not await self._gang_running(nb, ms)), False, \
+            capacity_requeue
 
     async def _apply_slice_sts(
         self, nb: dict, ms, tpu, slice_id: int, capacity_provisioned: bool,
@@ -1373,12 +1467,18 @@ class NotebookReconciler:
             )
 
     async def _update_status(self, nb: dict, ms, *,
-                             capacity_pending: bool = False) -> None:
+                             capacity_pending: bool = False,
+                             admission=None) -> None:
         """Mirror STS/pod state into the CR (reference :228-349): readyReplicas,
         containerState of worker 0's server container, condition history.
         Multislice: readyReplicas sums across every slice's StatefulSet.
         ``capacity_pending``: queued provisioning hasn't delivered yet —
-        surfaced via status.tpu so the UI can say why nothing runs."""
+        surfaced via status.tpu so the UI can say why nothing runs.
+        ``admission``: the fleet scheduler's verdict — surfaced as
+        ``status.scheduler`` (queue position, waiting chips, preemption
+        reason) plus a Queued/Admitted/Preempted condition on each
+        transition, which is what JWA's status machine and kubectl
+        watchers key off."""
         tpu = ms.slice if ms else None
         ns, name = namespace_of(nb), name_of(nb)
         # Informer cache first: a 64-slice notebook would otherwise pay
@@ -1416,10 +1516,26 @@ class NotebookReconciler:
                     container_state = statuses[0].get("state", {}) or {}
 
         conditions = list(deep_get(nb, "status", "conditions", default=[]))
+        # Scheduler transitions and container transitions interleave in
+        # one history, so each family dedups against ITS most recent
+        # entry — comparing against the list head would re-insert an
+        # unchanged container condition after every scheduler insert
+        # (and on every reconcile thereafter), churning real history
+        # out of the 8-entry cap.
+        prev_head = conditions[0].get("type") if conditions else None
+        prev_container = next(
+            (c.get("type") for c in conditions
+             if c.get("type") in _CONTAINER_CONDITION_TYPES), None)
+        sched_status = _scheduler_status_block(admission)
+        prev_sched_state = deep_get(nb, "status", "scheduler", "state")
+        if (sched_status is not None
+                and sched_status["state"] != prev_sched_state
+                and prev_head != sched_status["state"]):
+            conditions.insert(0, _scheduler_condition(sched_status))
         new_cond = _condition_from_state(container_state)
-        if new_cond and (not conditions or conditions[0].get("type") != new_cond["type"]):
+        if new_cond and new_cond["type"] != prev_container:
             conditions.insert(0, new_cond)
-            conditions = conditions[:8]
+        conditions = conditions[:8]
 
         want_hosts = 0 if nbapi.is_stopped(nb) else (
             ms.total_hosts if ms else 1)
@@ -1442,6 +1558,13 @@ class NotebookReconciler:
                     else {})),
             },
         }
+        # Same merge-patch discipline as capacityPending: present → set;
+        # stale on the live object → explicit None deletes it; neither →
+        # omit (no churn for CPU-only / scheduler-off notebooks).
+        if sched_status is not None:
+            status["scheduler"] = sched_status
+        elif deep_get(nb, "status", "scheduler") is not None:
+            status["scheduler"] = None
         # Write elision. Two gates:
         # - live status equals the computed one (covers the cold start —
         #   controller restart with an already-converged CR);
@@ -1558,6 +1681,50 @@ def _copy_configmap_data(desired: dict, live: dict) -> bool:
     return False
 
 
+def _scheduler_status_block(admission) -> dict | None:
+    """Admission verdict → the ``status.scheduler`` block. The shape is
+    the JWA contract (web/common/status.py): Queued carries position +
+    waitingChips + reason, Preempted carries the reason, Admitted is
+    bare."""
+    if admission is None:
+        return None
+    block: dict = {"state": admission.state}
+    if admission.state == "Queued":
+        block["position"] = admission.position
+        block["waitingChips"] = admission.waiting_chips
+        block["reason"] = admission.reason
+    elif admission.state == "Preempted" and admission.reason:
+        block["reason"] = admission.reason
+    return block
+
+
+def _scheduler_condition(sched_status: dict) -> dict:
+    """One condition per scheduler-state transition
+    (Queued → Admitted → Preempted), so the lifecycle is auditable from
+    the CR alone (docs/multi-host.md lifecycle diagram)."""
+    state = sched_status["state"]
+    if state == "Queued":
+        message = (f"position {sched_status.get('position', 0)}, waiting "
+                   f"for {sched_status.get('waitingChips', 0)} TPU chips")
+    elif state == "Preempted":
+        message = (f"preempted ({sched_status.get('reason', 'reclaimed')}); "
+                   "restart to re-queue")
+    else:
+        message = "admitted by the TPU fleet scheduler"
+    return {
+        "type": state,
+        "status": "True",
+        "lastProbeTime": now_iso(),
+        "reason": "TpuFleetScheduler",
+        "message": message,
+    }
+
+
+# The condition types _condition_from_state emits — the dedup in
+# _update_status scans for the most recent one of these.
+_CONTAINER_CONDITION_TYPES = frozenset({"Running", "Waiting", "Terminated"})
+
+
 def _condition_from_state(state: dict) -> dict | None:
     """ContainerState → NotebookCondition (Running|Waiting|Terminated),
     reference notebook_types.go:46-63 + status mirroring."""
@@ -1616,10 +1783,30 @@ def event_to_notebook(event: dict) -> list[tuple]:
     return [(event.get("metadata", {}).get("namespace"), base)]
 
 
+_SCHEDULER_FROM_ENV = object()  # sentinel: build from KFTPU_* env vars
+
+
 def setup_notebook_controller(
-    mgr: Manager, options: NotebookOptions | None = None
+    mgr: Manager, options: NotebookOptions | None = None,
+    *, scheduler=_SCHEDULER_FROM_ENV,
 ) -> NotebookReconciler:
     rec = NotebookReconciler(mgr.kube, options, registry=mgr.registry)
+    if scheduler is _SCHEDULER_FROM_ENV:
+        # KFTPU_SCHEDULER=off is the kill switch (ISSUE 5): the capacity
+        # stage then runs exactly the pre-scheduler gate. On (default),
+        # the scheduler stays a transparent pass-through until a fleet
+        # is configured (KFTPU_FLEET / ConfigMap / node inference).
+        from kubeflow_tpu.scheduler import scheduler_enabled
+
+        if scheduler_enabled():
+            from kubeflow_tpu.cmd.envconfig import scheduler_options
+            from kubeflow_tpu.scheduler import TpuFleetScheduler
+
+            scheduler = TpuFleetScheduler(
+                mgr.kube, scheduler_options(), registry=mgr.registry)
+        else:
+            scheduler = None
+    rec._scheduler = scheduler
     owned_kinds = ["StatefulSet", "Service"] + (
         ["VirtualService"] if rec.opts.use_istio else [])
     mgr.add_controller(
@@ -1649,6 +1836,16 @@ def setup_notebook_controller(
     rec._sts_informer = mgr.informer_for("StatefulSet")
     rec._nb_informer = mgr.informer_for("Notebook")
     rec._nb_informer.add_indexer(NAMESPACE_INDEX, index_by_namespace)
+    if rec._scheduler is not None:
+        # A freshly admitted (or preempted) gang reconciles NOW — the
+        # queued requeue_after is only the safety net. The Notebook
+        # informer saves the scheduler a GET when it events a peer, and
+        # /debug/scheduler hangs off the manager (cmd/controller_manager).
+        rec._scheduler.on_admitted(lambda key: mgr.enqueue("notebook", key))
+        rec._scheduler._nb_informer = rec._nb_informer
+        if getattr(rec._scheduler.options, "fleet_spec", "") == "auto":
+            rec._scheduler._node_informer = mgr.informer_for("Node")
+        mgr.scheduler = rec._scheduler
     rec._pod_informer = mgr.informer_for("Pod")
     rec._pod_informer.add_indexer(
         NB_POD_INDEX, index_by_label(nbapi.NOTEBOOK_NAME_LABEL))

@@ -1,0 +1,353 @@
+"""Fleet model + chip ledger for the TPU fleet scheduler.
+
+A **fleet** is the cluster's TPU inventory as node pools. Each pool hosts
+slices of exactly one shape — GKE TPU node pools are created per
+``(accelerator, topology)`` and their nodes carry the matching
+``cloud.google.com/gke-tpu-*`` labels, so a slice of shape S can only ever
+land on a pool of shape S. The schedulable unit is therefore a **slice of
+the pool's shape**, and a pool's capacity is counted in slices.
+
+The **ledger** tracks which gang (one Notebook's full MultiSlice) holds
+which slices, with two hard invariants the property tests in
+``tests/test_scheduler.py`` drive:
+
+- *capacity*: admitted slices per pool never exceed the pool's capacity;
+- *gang atomicity*: an allocation is always the request's whole slice set
+  — there is no code path that records a partial gang.
+
+Everything here is pure (no Kubernetes imports, no clock, no I/O) so the
+policy core above it stays deterministic and property-testable.
+
+Fleet sources, in the order the runtime tries them:
+
+- ``KFTPU_FLEET`` env: ``pool-a=v5e:4x4:2,pool-b=v5p:2x2x1:4``
+  (``<name>=<accelerator>:<topology>:<num-slices>``);
+- a ConfigMap with the same format under ``data["fleet"]``
+  (``KFTPU_FLEET_CONFIGMAP``, loaded by the runtime);
+- ``KFTPU_FLEET=auto``: inferred from Node objects' GKE TPU labels
+  (``from_nodes``) — one pool per ``cloud.google.com/gke-nodepool``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.tpu.topology import (
+    ACCELERATORS,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    TopologyError,
+    TpuSlice,
+)
+
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+
+# gke_accelerator label value → our short accelerator name ("v5e", ...).
+_GKE_TO_NAME = {acc.gke_accelerator: acc.name for acc in ACCELERATORS.values()}
+
+
+class FleetConfigError(ValueError):
+    """Malformed fleet specification."""
+
+
+class LedgerError(RuntimeError):
+    """A ledger invariant would be violated (admitted > capacity, double
+    admission, partial release). Raised, never swallowed — the policy layer
+    must make these impossible; the bench counts raises (must be zero)."""
+
+
+@dataclass(frozen=True)
+class NodePool:
+    """One TPU node pool: ``num_slices`` slices of one shape."""
+
+    name: str
+    accelerator: str       # short name: v4 | v5e | v5p | v6e
+    topology: str          # slice chip grid, e.g. "4x4"
+    num_slices: int
+
+    def __post_init__(self):
+        if self.num_slices < 1:
+            raise FleetConfigError(
+                f"pool {self.name}: num_slices must be >= 1, "
+                f"got {self.num_slices}")
+        # Validates accelerator/topology; raises TopologyError on garbage.
+        TpuSlice.parse(self.accelerator, self.topology)
+
+    @property
+    def slice_shape(self) -> TpuSlice:
+        return TpuSlice.parse(self.accelerator, self.topology)
+
+    @property
+    def chips_per_slice(self) -> int:
+        return self.slice_shape.num_chips
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_slices * self.chips_per_slice
+
+    @property
+    def shape_key(self) -> tuple[str, str]:
+        return (self.accelerator.lower(), self.topology.lower())
+
+
+@dataclass(frozen=True)
+class Fleet:
+    """Immutable pool inventory, keyed by pool name."""
+
+    pools: tuple[NodePool, ...] = ()
+
+    @classmethod
+    def parse(cls, spec: str) -> "Fleet":
+        """``pool-a=v5e:4x4:2,pool-b=v5p:2x2x1:4`` → Fleet. Empty/None
+        spec → empty fleet (scheduler passes everything through)."""
+        pools: list[NodePool] = []
+        seen: set[str] = set()
+        for raw in (spec or "").replace("\n", ",").split(","):
+            entry = raw.strip()
+            if not entry:
+                continue
+            name, sep, shape = entry.partition("=")
+            parts = shape.split(":")
+            if not sep or len(parts) != 3:
+                raise FleetConfigError(
+                    f"bad fleet entry {entry!r}: want "
+                    "<name>=<accelerator>:<topology>:<num-slices>")
+            acc, topo, n = (p.strip() for p in parts)
+            try:
+                num = int(n)
+            except ValueError:
+                raise FleetConfigError(
+                    f"bad fleet entry {entry!r}: slice count {n!r} is not "
+                    "an integer") from None
+            name = name.strip()
+            if name in seen:
+                raise FleetConfigError(f"duplicate pool name {name!r}")
+            seen.add(name)
+            try:
+                pools.append(NodePool(name, acc.lower(), topo.lower(), num))
+            except TopologyError as e:
+                raise FleetConfigError(f"bad fleet entry {entry!r}: {e}") \
+                    from None
+        return cls(pools=tuple(sorted(pools, key=lambda p: p.name)))
+
+    @classmethod
+    def from_nodes(cls, nodes: list[dict]) -> "Fleet":
+        """Infer pools from Node objects' GKE TPU labels: hosts sharing a
+        ``gke-nodepool`` label and a TPU shape form one pool; its slice
+        count is ``hosts // hosts_per_slice`` (partial slices can never
+        schedule a gang, so they don't count)."""
+        hosts: dict[tuple[str, str, str], int] = {}
+        for node in nodes:
+            labels = ((node.get("metadata") or {}).get("labels")) or {}
+            gke_acc = labels.get(GKE_TPU_ACCELERATOR_LABEL)
+            topo = labels.get(GKE_TPU_TOPOLOGY_LABEL)
+            acc = _GKE_TO_NAME.get(gke_acc or "")
+            if not acc or not topo:
+                continue
+            pool = labels.get(GKE_NODEPOOL_LABEL) or f"{acc}-{topo}"
+            hosts[(pool, acc, topo.lower())] = \
+                hosts.get((pool, acc, topo.lower()), 0) + 1
+        # A nodepool label carrying two TPU shapes (mid-migration label
+        # drift) must not yield two same-named pools: the ledger resolves
+        # placements by name, and the collision would make every admit of
+        # the second shape a LedgerError. Disambiguate with the shape —
+        # but count only shapes that survive the whole-slice/parse
+        # filters: a stray partial-slice or unparsable shape must not
+        # rename the real pool (the rename would look like a fleet change
+        # and rebind-churn every allocation booked on it).
+        survivors = []
+        name_shapes: dict[str, int] = {}
+        for (pool, acc, topo), count in sorted(hosts.items()):
+            try:
+                per_slice = TpuSlice.parse(acc, topo).num_hosts
+            except TopologyError:
+                continue
+            num_slices = count // per_slice
+            if num_slices >= 1:
+                survivors.append((pool, acc, topo, num_slices))
+                name_shapes[pool] = name_shapes.get(pool, 0) + 1
+        pools = []
+        for pool, acc, topo, num_slices in survivors:
+            name = (f"{pool}-{acc}-{topo}" if name_shapes[pool] > 1
+                    else pool)
+            pools.append(NodePool(name, acc, topo, num_slices))
+        return cls(pools=tuple(pools))
+
+    def by_name(self, name: str) -> NodePool | None:
+        for p in self.pools:
+            if p.name == name:
+                return p
+        return None
+
+    def matching(self, accelerator: str, topology: str) -> list[NodePool]:
+        """Pools that can host slices of this shape, name-sorted (the
+        deterministic allocation order)."""
+        key = (accelerator.lower(), topology.lower())
+        return [p for p in self.pools if p.shape_key == key]
+
+    def total_slices(self, accelerator: str, topology: str) -> int:
+        """Whole-fleet ceiling for one shape — the webhook's can-never-fit
+        check compares a gang's num_slices against this."""
+        return sum(p.num_slices for p in self.matching(accelerator, topology))
+
+    @property
+    def total_chips(self) -> int:
+        return sum(p.total_chips for p in self.pools)
+
+
+@dataclass
+class Allocation:
+    """One admitted gang: the notebook's FULL slice set, spread over
+    matching pools. ``placements`` maps pool name → slices taken there;
+    its values always sum to the request's num_slices (gang atomicity —
+    checked at admit time and by ``ChipLedger.assert_consistent``)."""
+
+    key: tuple              # (namespace, name)
+    namespace: str
+    accelerator: str
+    topology: str
+    num_slices: int
+    chips: int
+    placements: dict[str, int]
+    priority: int = 0
+    admitted_at: float = 0.0
+    # Culling's last-activity signal (idle-preemption ranking); None means
+    # "no probe data yet" and is never treated as idle.
+    last_active_at: float | None = None
+    # True for a gang force-admitted by reclaim() over a fleet that no
+    # longer has room for it (controller restart after the fleet shrank):
+    # its pods exist, so the ledger records it as a deliberate overcommit
+    # and assert_consistent exempts its pools from the capacity check.
+    forced: bool = False
+
+
+@dataclass
+class ChipLedger:
+    """Admitted-vs-free accounting over a Fleet. All mutation goes through
+    ``admit``/``release``; both enforce the invariants and raise
+    LedgerError (counted in ``violations``) rather than record a bad
+    state."""
+
+    fleet: Fleet
+    used: dict[str, int] = field(default_factory=dict)        # pool → slices
+    allocations: dict[tuple, Allocation] = field(default_factory=dict)
+    ns_chips: dict[str, int] = field(default_factory=dict)    # ns → chips
+    violations: int = 0
+
+    def free_slices(self, pool: NodePool) -> int:
+        return pool.num_slices - self.used.get(pool.name, 0)
+
+    def fit(self, accelerator: str, topology: str,
+            num_slices: int) -> dict[str, int] | None:
+        """All-or-nothing placement plan for a gang: spread num_slices
+        over matching pools in name order, or None if the whole gang
+        cannot fit right now. Never returns a partial plan."""
+        plan: dict[str, int] = {}
+        remaining = num_slices
+        for pool in self.fleet.matching(accelerator, topology):
+            if remaining == 0:
+                break
+            take = min(self.free_slices(pool), remaining)
+            if take > 0:
+                plan[pool.name] = take
+                remaining -= take
+        return plan if remaining == 0 else None
+
+    def admit(self, alloc: Allocation, *, force: bool = False) -> None:
+        """Record one whole gang. ``force=True`` is the reclaim path
+        (controller restart over a fleet that no longer has room): the
+        per-pool capacity check — and ONLY it — is skipped, because the
+        gang's pods already run; gang atomicity and no-double-admit
+        still hold. The allocation is marked ``forced`` so
+        ``assert_consistent`` treats the resulting over-capacity pools
+        as overcommit, not as ledger drift; it drains on release."""
+        if alloc.key in self.allocations:
+            self.violations += 1
+            raise LedgerError(f"{alloc.key} is already admitted")
+        if sum(alloc.placements.values()) != alloc.num_slices:
+            self.violations += 1
+            raise LedgerError(
+                f"{alloc.key}: partial gang ({alloc.placements} vs "
+                f"{alloc.num_slices} slices) — gangs admit all-or-nothing")
+        if force:
+            alloc.forced = True
+        else:
+            for pool_name, n in alloc.placements.items():
+                pool = self.fleet.by_name(pool_name)
+                if pool is None or pool.shape_key != (
+                        alloc.accelerator.lower(), alloc.topology.lower()):
+                    self.violations += 1
+                    raise LedgerError(
+                        f"{alloc.key}: placement on unknown/mismatched "
+                        f"pool {pool_name!r}")
+                if self.used.get(pool_name, 0) + n > pool.num_slices:
+                    self.violations += 1
+                    raise LedgerError(
+                        f"{alloc.key}: pool {pool_name} over capacity "
+                        f"({self.used.get(pool_name, 0)}+{n} > "
+                        f"{pool.num_slices} slices)")
+        for pool_name, n in alloc.placements.items():
+            self.used[pool_name] = self.used.get(pool_name, 0) + n
+        self.allocations[alloc.key] = alloc
+        self.ns_chips[alloc.namespace] = \
+            self.ns_chips.get(alloc.namespace, 0) + alloc.chips
+
+    def release(self, key: tuple) -> Allocation | None:
+        alloc = self.allocations.pop(key, None)
+        if alloc is None:
+            return None
+        for pool_name, n in alloc.placements.items():
+            left = self.used.get(pool_name, 0) - n
+            if left < 0:
+                self.violations += 1
+                raise LedgerError(
+                    f"{key}: releasing more slices than admitted on "
+                    f"{pool_name}")
+            if left:
+                self.used[pool_name] = left
+            else:
+                self.used.pop(pool_name, None)
+        left_chips = self.ns_chips.get(alloc.namespace, 0) - alloc.chips
+        if left_chips:
+            self.ns_chips[alloc.namespace] = left_chips
+        else:
+            self.ns_chips.pop(alloc.namespace, None)
+        return alloc
+
+    def admitted_chips_by_pool(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for pool in self.fleet.pools:
+            used = self.used.get(pool.name, 0)
+            if used:
+                out[pool.name] = used * pool.chips_per_slice
+        return out
+
+    def assert_consistent(self) -> None:
+        """Recompute used/ns_chips from the allocations and compare — the
+        property test calls this after every step."""
+        used: dict[str, int] = {}
+        ns: dict[str, int] = {}
+        for alloc in self.allocations.values():
+            if sum(alloc.placements.values()) != alloc.num_slices:
+                raise LedgerError(f"{alloc.key}: partial gang recorded")
+            for pool_name, n in alloc.placements.items():
+                used[pool_name] = used.get(pool_name, 0) + n
+            ns[alloc.namespace] = ns.get(alloc.namespace, 0) + alloc.chips
+        if used != self.used or ns != self.ns_chips:
+            raise LedgerError(
+                f"ledger drift: used {self.used} vs {used}, "
+                f"ns_chips {self.ns_chips} vs {ns}")
+        # Pools carrying a force-admitted (reclaimed-with-overcommit)
+        # gang are legitimately over capacity until it releases.
+        forced_pools = {
+            pool_name
+            for alloc in self.allocations.values() if alloc.forced
+            for pool_name in alloc.placements
+        }
+        for pool in self.fleet.pools:
+            if pool.name in forced_pools:
+                continue
+            if used.get(pool.name, 0) > pool.num_slices:
+                raise LedgerError(
+                    f"pool {pool.name} over capacity: "
+                    f"{used[pool.name]} > {pool.num_slices}")

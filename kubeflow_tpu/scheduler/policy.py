@@ -1,0 +1,471 @@
+"""Pure policy core of the TPU fleet scheduler.
+
+Kueue-style arbitration as a deterministic, clock-free state machine —
+every decision is a function of (queue state, ledger state, the ``now``
+the caller passes in), so tier-1 can property-test randomized
+arrival/completion sequences without FakeKube or an event loop.
+
+Policy, in admission order:
+
+- **Gang admission**: a request is one Notebook's full MultiSlice; it is
+  admitted with all of its slices placed or not at all (``ChipLedger.fit``
+  never returns a partial plan).
+- **Priority classes**: higher ``priority`` schedules first.
+- **Weighted fair share** (DRF on chips — chips are the single dominant
+  resource, so dominant-resource fairness reduces to admitted chips
+  divided by namespace weight): among equal priority, the namespace with
+  the smallest share goes first.
+- **Aging** (bounded starvation): every ``aging_seconds`` of queue wait
+  adds one effective priority step (capped at ``aging_max_boost``), and a
+  request starved past ``starvation_reserve_seconds`` blocks backfill —
+  smaller gangs stop jumping over it, so the capacity it needs eventually
+  drains free.
+- **Preemption**: when a request cannot fit, reclaim whole gangs (never a
+  slice subset — mid-gang preemption would leave a broken ICI mesh and a
+  half-accounted ledger) from *idle* holders (culling's last-activity
+  signal, any priority) or *strictly lower-priority* holders. Victims'
+  chips are released in-ledger immediately so the waiting gang admits in
+  the same pass; the runtime stop-annotates the victim CRs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from kubeflow_tpu.scheduler.fleet import Allocation, ChipLedger, Fleet
+
+
+@dataclass(frozen=True)
+class GangRequest:
+    """One notebook's whole MultiSlice, as the queue sees it."""
+
+    key: tuple                 # (namespace, name)
+    namespace: str
+    accelerator: str
+    topology: str
+    num_slices: int
+    chips: int                 # total chips across the gang
+    priority: int = 0
+    weight: float = 1.0        # namespace weight (fair-share divisor)
+    submitted_at: float = 0.0
+    seq: int = 0               # arrival order; the final deterministic tie-break
+
+
+@dataclass(frozen=True)
+class Preemption:
+    key: tuple                 # victim (namespace, name)
+    reason: str                # "idle" | "priority"
+    for_key: tuple             # the queued gang the chips were reclaimed for
+    chips: int
+
+
+@dataclass(frozen=True)
+class Admitted:
+    key: tuple
+    placements: dict
+    waited: float              # now - submitted_at (time-to-admission)
+
+
+@dataclass(frozen=True)
+class QueuedInfo:
+    key: tuple
+    position: int              # 1-based rank in the current queue order
+    chips: int
+    reason: str
+
+
+@dataclass(frozen=True)
+class ScheduleResult:
+    admitted: list
+    preempted: list
+    queue: list                # QueuedInfo for everything still waiting
+
+
+@dataclass(frozen=True)
+class PolicyConfig:
+    aging_seconds: float = 300.0
+    aging_max_boost: int = 4
+    starvation_reserve_seconds: float = 900.0
+    enable_preemption: bool = True
+    # A holder whose culling last-activity is older than this is fair
+    # game for any queued gang that needs its chips.
+    idle_preempt_after_seconds: float = 1800.0
+
+
+@dataclass
+class PolicyQueue:
+    """The scheduler's brain: a pending queue over a chip ledger."""
+
+    fleet: Fleet
+    config: PolicyConfig = field(default_factory=PolicyConfig)
+    ledger: ChipLedger = None  # type: ignore[assignment]
+    pending: dict = field(default_factory=dict)   # key → GangRequest
+    # Bumped on every state change (submit/release/touch/admission/
+    # preemption/reclaim): the runtime skips redundant full arbitration
+    # passes — each queued notebook's safety-net requeue would otherwise
+    # run a global O(queue) pass — when gen is unchanged.
+    gen: int = 0
+    _seq: int = 0
+
+    def __post_init__(self):
+        if self.ledger is None:
+            self.ledger = ChipLedger(self.fleet)
+
+    @property
+    def overcommitted(self) -> int:
+        """Gangs reclaim() had to force-place over a too-small fleet
+        (controller restart after a shrink, or their shape left the
+        fleet) — surfaced in debug_info. Counted live from the ledger so
+        a rebind_fleet() re-seat of a still-overcommitted gang never
+        double-counts it, and the number drains as holders release."""
+        return sum(1 for a in self.ledger.allocations.values() if a.forced)
+
+    # ---- queue mutation ---------------------------------------------------------
+
+    def submit(self, req: GangRequest) -> GangRequest:
+        """Enqueue (or refresh) a gang. An existing pending entry keeps its
+        original submitted_at/seq — a spec refresh must not reset aging —
+        unless its shape changed, in which case demand is re-declared.
+        Submitting an already-admitted key is a no-op (the holder's
+        reconcile calls this idempotently)."""
+        if req.key in self.ledger.allocations:
+            return req
+        prior = self.pending.get(req.key)
+        if prior is not None and (
+                prior.accelerator.lower(), prior.topology.lower(),
+                prior.num_slices,
+        ) == (req.accelerator.lower(), req.topology.lower(),
+              req.num_slices):
+            req = replace(req, submitted_at=prior.submitted_at,
+                          seq=prior.seq)
+        else:
+            # New demand — or a shape EDIT while queued, which re-declares
+            # it: aging/starvation credit earned as a small gang must not
+            # transfer to an arbitrarily larger one (a tenant could wedge
+            # the shape's starvation door without ever waiting as that
+            # demand).
+            self._seq += 1
+            req = replace(req, seq=self._seq)
+        if self.pending.get(req.key) != req:
+            self.gen += 1
+        self.pending[req.key] = req
+        return req
+
+    def release(self, key: tuple) -> Allocation | None:
+        """Drop a gang entirely: its queue entry (stopped while waiting)
+        and/or its allocation (stopped/deleted while running)."""
+        dropped = self.pending.pop(key, None)
+        alloc = self.ledger.release(key)
+        if dropped is not None or alloc is not None:
+            self.gen += 1
+        return alloc
+
+    def touch(self, key: tuple, last_active_at: float | None) -> None:
+        """Refresh a holder's idle signal (culling's last-activity)."""
+        alloc = self.ledger.allocations.get(key)
+        if alloc is not None and last_active_at is not None \
+                and alloc.last_active_at != last_active_at:
+            alloc.last_active_at = last_active_at
+            self.gen += 1
+
+    def is_admitted(self, key: tuple) -> bool:
+        return key in self.ledger.allocations
+
+    def reclaim(self, req: GangRequest, now: float) -> bool:
+        """Re-seat an ALREADY-RUNNING gang after a controller restart
+        (scheduler state is in-memory). Uses a normal fit when capacity
+        allows; otherwise force-places on matching pools — the pods exist,
+        so refusing would stop-annotate healthy workloads on every
+        controller restart. Forced placements may transiently exceed a
+        shrunken fleet's capacity; that is recorded as an overcommit, not
+        a ledger violation, and drains as holders release."""
+        if req.key in self.ledger.allocations:
+            return True
+        self.pending.pop(req.key, None)
+        plan = self.ledger.fit(req.accelerator, req.topology, req.num_slices)
+        overcommit = plan is None
+        if overcommit:
+            pools = self.fleet.matching(req.accelerator, req.topology)
+            if not pools:
+                # The shape left the fleet entirely but the gang's pods
+                # still run: seat it on a shape pseudo-pool as pure
+                # overcommit rather than queueing a live workload —
+                # 'Queued' would suppress its child reconcile and tell
+                # the UI nothing runs while pods serve traffic. It takes
+                # no real pool's capacity and drains on release.
+                plan = {f"{req.accelerator}:{req.topology}":
+                        req.num_slices}
+            else:
+                plan = {}
+                remaining = req.num_slices
+                for pool in pools:
+                    take = min(max(self.ledger.free_slices(pool), 0),
+                               remaining)
+                    if take:
+                        plan[pool.name] = take
+                        remaining -= take
+                if remaining:
+                    plan[pools[0].name] = \
+                        plan.get(pools[0].name, 0) + remaining
+        alloc = Allocation(
+            key=req.key, namespace=req.namespace,
+            accelerator=req.accelerator, topology=req.topology,
+            num_slices=req.num_slices, chips=req.chips,
+            placements=plan, priority=req.priority, admitted_at=now,
+        )
+        self.ledger.admit(alloc, force=overcommit)
+        self.gen += 1
+        return True
+
+    def rebind_fleet(self, fleet: Fleet) -> None:
+        """Swap the fleet under live allocations (dynamic fleet sources:
+        ConfigMap edits, node-label inference). Allocations whose
+        placements reference pools that left the fleet — or whose named
+        pool now hosts a different shape — are released and re-seated
+        via :meth:`reclaim`: a renamed pool (same hardware, new name)
+        re-books onto the new name so its capacity is not double-sold to
+        new gangs, and a shape that vanished falls back to the reclaim
+        pseudo-pool overcommit. Everything else keeps its booking."""
+        self.fleet = fleet
+        self.ledger.fleet = fleet
+        stale = []
+        for alloc in self.ledger.allocations.values():
+            ok = not alloc.forced
+            for pool_name in alloc.placements:
+                pool = fleet.by_name(pool_name)
+                if pool is None or pool.shape_key != (
+                        alloc.accelerator.lower(),
+                        alloc.topology.lower()):
+                    ok = False
+                    break
+            if not ok:
+                stale.append(alloc)
+        for alloc in stale:   # release all first: re-seating must see
+            self.ledger.release(alloc.key)        # the full free space
+        for alloc in stale:
+            self.reclaim(
+                GangRequest(
+                    key=alloc.key, namespace=alloc.namespace,
+                    accelerator=alloc.accelerator,
+                    topology=alloc.topology,
+                    num_slices=alloc.num_slices, chips=alloc.chips,
+                    priority=alloc.priority),
+                now=alloc.admitted_at)   # keep the original admission time
+            reseated = self.ledger.allocations.get(alloc.key)
+            if reseated is not None:
+                reseated.last_active_at = alloc.last_active_at
+        # A shrink that KEEPS a pool's name/shape can leave its live
+        # gangs over the new capacity. That is deliberate drain-down
+        # overcommit, not ledger drift — mark those gangs forced so
+        # assert_consistent exempts the pool and debug_info reports the
+        # overcommit (it clears when they release or a later rebind
+        # re-seats them within capacity).
+        for pool in fleet.pools:
+            used = sum(a.placements.get(pool.name, 0)
+                       for a in self.ledger.allocations.values())
+            if used > pool.num_slices:
+                for a in self.ledger.allocations.values():
+                    if a.placements.get(pool.name):
+                        a.forced = True
+        self.gen += 1
+
+    # ---- scheduling pass --------------------------------------------------------
+
+    def _effective_priority(self, req: GangRequest, now: float) -> int:
+        cfg = self.config
+        if cfg.aging_seconds <= 0:
+            return req.priority
+        boost = int(max(0.0, now - req.submitted_at) // cfg.aging_seconds)
+        return req.priority + min(boost, cfg.aging_max_boost)
+
+    def _rank_key(self, req: GangRequest, now: float):
+        share = self.ledger.ns_chips.get(req.namespace, 0) \
+            / max(req.weight, 1e-9)
+        return (-self._effective_priority(req, now), share, req.seq)
+
+    def _ordered_pending(self, now: float) -> list:
+        return sorted(self.pending.values(),
+                      key=lambda r: self._rank_key(r, now))
+
+    def _find_victims(self, req: GangRequest, now: float) -> list | None:
+        """Whole-gang victims whose release lets ``req`` fit, or None.
+        Idle holders (culling signal) are preemptible by anyone; busy
+        holders only by strictly higher BASE priority — aging boosts
+        where a gang sorts in the queue, never whom it may kill (an
+        equal-priority gang that waited long enough must not stop-
+        annotate a busy peer). Most-idle first, then lowest priority,
+        then youngest admission (LIFO), so the decision is deterministic
+        and the cheapest work dies first."""
+        cfg = self.config
+        shape = (req.accelerator.lower(), req.topology.lower())
+        matching = {p.name
+                    for p in self.fleet.matching(req.accelerator,
+                                                 req.topology)}
+        candidates = []
+        for alloc in self.ledger.allocations.values():
+            if (alloc.accelerator.lower(), alloc.topology.lower()) != shape:
+                continue  # frees no capacity this gang can use
+            # Only slices booked on REAL matching pools come back on
+            # release: a gang force-seated on a shape pseudo-pool
+            # (reclaim after the shape left the fleet) would be stopped
+            # for zero benefit — the waiter still couldn't fit.
+            reclaimable = sum(n for pool, n in alloc.placements.items()
+                              if pool in matching)
+            if reclaimable == 0:
+                continue
+            # Floored by the in-memory admitted_at: the durable
+            # admitted-at annotation usually floors the culling signal
+            # already, but its stamp patch is best-effort — if it failed,
+            # a long-queued gang would look 'idle since before it ran'
+            # seconds after admission.
+            last = (None if alloc.last_active_at is None
+                    else max(alloc.last_active_at, alloc.admitted_at))
+            idle = (last is not None
+                    and now - last >= cfg.idle_preempt_after_seconds)
+            if idle:
+                candidates.append((0, -(now - last),
+                                   alloc.priority, -alloc.admitted_at,
+                                   alloc.key, "idle", reclaimable, alloc))
+            elif alloc.priority < req.priority:
+                candidates.append((1, 0.0, alloc.priority,
+                                   -alloc.admitted_at, alloc.key,
+                                   "priority", reclaimable, alloc))
+        candidates.sort(key=lambda c: c[:5])
+        # Per-pool simulation, not one aggregate sum: an overcommitted
+        # pool's NEGATIVE free space (restart reclaim / fleet shrink)
+        # must neither mask reclaimable capacity on healthy pools (the
+        # deficit would hide a sufficient victim and wrongly refuse
+        # preemption) nor count a victim's slices as usable when they
+        # only drain that pool's deficit (over-selecting healthy gangs).
+        free_by_pool = {p.name: self.ledger.free_slices(p)
+                        for p in self.fleet.matching(req.accelerator,
+                                                     req.topology)}
+
+        def usable() -> int:
+            return sum(max(f, 0) for f in free_by_pool.values())
+
+        victims = []
+        for *_rank, _key, reason, _reclaimable, alloc in candidates:
+            if usable() >= req.num_slices:
+                break
+            victims.append((alloc, reason))
+            for pool, n in alloc.placements.items():
+                if pool in free_by_pool:
+                    free_by_pool[pool] += n
+        return victims if usable() >= req.num_slices else None
+
+    def schedule(self, now: float) -> ScheduleResult:
+        """One deterministic arbitration pass. Mutates the ledger (admits,
+        preempts) and returns everything the runtime must act on."""
+        admitted: list[Admitted] = []
+        preempted: list[Preemption] = []
+        progressed = True
+        while progressed and self.pending:
+            progressed = False
+            # Shapes a starved gang has reserved this scan: backfill of
+            # the SAME shape must not jump it, but gangs for disjoint
+            # pools take nothing it is waiting for and admit freely.
+            blocked: set = set()
+            for req in self._ordered_pending(now):
+                shape = (req.accelerator.lower(), req.topology.lower())
+                if shape in blocked:
+                    continue
+                plan = self.ledger.fit(req.accelerator, req.topology,
+                                       req.num_slices)
+                if plan is None and self.config.enable_preemption:
+                    victims = self._find_victims(req, now)
+                    if victims:
+                        for alloc, reason in victims:
+                            self.ledger.release(alloc.key)
+                            preempted.append(Preemption(
+                                key=alloc.key, reason=reason,
+                                for_key=req.key, chips=alloc.chips))
+                        plan = self.ledger.fit(req.accelerator,
+                                               req.topology, req.num_slices)
+                if plan is not None:
+                    self.ledger.admit(Allocation(
+                        key=req.key, namespace=req.namespace,
+                        accelerator=req.accelerator, topology=req.topology,
+                        num_slices=req.num_slices, chips=req.chips,
+                        placements=plan, priority=req.priority,
+                        admitted_at=now,
+                    ))
+                    del self.pending[req.key]
+                    admitted.append(Admitted(
+                        key=req.key, placements=plan,
+                        waited=max(0.0, now - req.submitted_at)))
+                    progressed = True
+                    break  # shares changed; re-rank from scratch
+                if (now - req.submitted_at
+                        >= self.config.starvation_reserve_seconds
+                        and self.fleet.total_slices(
+                            req.accelerator, req.topology)
+                        >= req.num_slices):
+                    # Starved: hold the door on this SHAPE — no backfill
+                    # jumps it, so the capacity it needs can drain free.
+                    # Only for gangs the fleet CAN eventually host: a
+                    # never-fits gang (over the shape ceiling — created
+                    # before the fleet shrank, or past the CREATE-only
+                    # webhook check) would otherwise wedge its shape
+                    # forever; it stays queued with the ceiling in its
+                    # reason instead.
+                    blocked.add(shape)
+        if admitted or preempted:
+            self.gen += 1
+        return ScheduleResult(admitted=admitted, preempted=preempted,
+                              queue=self.schedule_preview(now))
+
+    def _queue_reason(self, req: GangRequest) -> str:
+        total = self.fleet.total_slices(req.accelerator, req.topology)
+        if total == 0:
+            return (f"no pool hosts {req.accelerator}:{req.topology} slices")
+        if total < req.num_slices:
+            return (f"gang needs {req.num_slices} "
+                    f"{req.accelerator}:{req.topology} slice(s); the fleet "
+                    f"ceiling is {total}")
+        return (f"waiting for {req.chips} chips "
+                f"({req.num_slices}x {req.accelerator}:{req.topology})")
+
+    # ---- introspection ----------------------------------------------------------
+
+    def debug_info(self, now: float) -> dict:
+        return {
+            "pools": [
+                {
+                    "name": p.name, "accelerator": p.accelerator,
+                    "topology": p.topology, "slices": p.num_slices,
+                    "free_slices": self.ledger.free_slices(p),
+                    "chips": p.total_chips,
+                }
+                for p in self.fleet.pools
+            ],
+            "admitted": [
+                {
+                    "key": list(a.key), "chips": a.chips,
+                    "slices": a.num_slices, "priority": a.priority,
+                    "placements": a.placements,
+                    "admitted_at": a.admitted_at,
+                    "last_active_at": a.last_active_at,
+                }
+                for a in sorted(self.ledger.allocations.values(),
+                                key=lambda a: a.key)
+            ],
+            "queue": [
+                {
+                    "key": list(q.key), "position": q.position,
+                    "chips": q.chips, "reason": q.reason,
+                }
+                for q in self.schedule_preview(now)
+            ],
+            "ns_chips": dict(sorted(self.ledger.ns_chips.items())),
+            "violations": self.ledger.violations,
+            "overcommitted": self.overcommitted,
+        }
+
+    def schedule_preview(self, now: float) -> list:
+        """Queue snapshot without mutating anything (for /debug)."""
+        return [
+            QueuedInfo(key=req.key, position=i + 1, chips=req.chips,
+                       reason=self._queue_reason(req))
+            for i, req in enumerate(self._ordered_pending(now))
+        ]

@@ -1,0 +1,578 @@
+"""Async runtime of the TPU fleet scheduler.
+
+The single admission point between a Notebook CR and its slice
+StatefulSets: the notebook controller's capacity stage calls
+:meth:`TpuFleetScheduler.admission` before creating any slice, and
+:meth:`TpuFleetScheduler.release` on stop/delete. The pure policy core
+(:mod:`kubeflow_tpu.scheduler.policy`) makes every decision; this layer
+adds what the cluster needs around it:
+
+- fleet discovery (env spec, ConfigMap, or Node-label inference);
+- preemption actuation — victims are stop-annotated (the notebook
+  reconciler parks the whole gang, never a slice subset) and the
+  preemption is recorded so their status can say why;
+- transition side effects: ``Queued``/``Admitted``/``Preempted`` Events,
+  the admitted-at annotation culling's idle clock needs, and re-enqueues
+  so a freshly admitted notebook reconciles immediately;
+- observability: ``schedule``/``admit``/``preempt`` tracing phases,
+  Prometheus gauges/counters/histogram, and the ``/debug/scheduler``
+  payload.
+
+With no fleet configured the scheduler is a transparent no-op (every
+admission passes through, zero API writes) — exactly today's behavior,
+which is also what the ``KFTPU_SCHEDULER=off`` kill switch restores.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.errors import ApiError
+from kubeflow_tpu.runtime.events import EventRecorder
+from kubeflow_tpu.runtime.metrics import Registry, global_registry
+from kubeflow_tpu.runtime.objects import (
+    annotations_of,
+    fmt_iso,
+    name_of,
+    namespace_of,
+    parse_iso,
+)
+from kubeflow_tpu.runtime.tracing import span
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.policy import (
+    GangRequest,
+    PolicyConfig,
+    PolicyQueue,
+)
+
+log = logging.getLogger(__name__)
+
+# Priority classes from a CR annotation; plain integers are accepted too.
+PRIORITY_ANNOTATION = nbapi.PRIORITY_ANNOTATION
+PRIORITY_CLASSES = {"low": -100, "normal": 0, "high": 100, "critical": 200}
+
+FLEET_CONFIGMAP_KEY = "fleet"
+_CONFIGMAP_RETRY_SECONDS = 30.0
+
+
+async def load_fleet_from_configmap(kube, name: str,
+                                    namespace: str) -> Fleet | None:
+    """The ONE reader of the fleet ConfigMap — shared by the scheduler's
+    ``_ensure_fleet`` and the webhook's can-never-fit ceiling
+    (webhooks/notebook.py), so the spec key and the bad-spec tolerance
+    cannot drift apart between the two admission layers. Returns None
+    when the ConfigMap/key is absent or the spec is malformed (a broken
+    spec must not block admissions or wedge the scheduler); callers own
+    their caching/retry policy."""
+    cm = await kube.get_or_none("ConfigMap", name, namespace)
+    spec = ((cm or {}).get("data") or {}).get(FLEET_CONFIGMAP_KEY) or ""
+    if not spec.strip():
+        return None
+    try:
+        return Fleet.parse(spec)
+    except Exception:
+        log.exception("bad fleet spec in ConfigMap %s/%s", namespace, name)
+        return None
+
+
+def parse_priority(value: str | None) -> int:
+    if not value:
+        return 0
+    v = value.strip().lower()
+    if v in PRIORITY_CLASSES:
+        return PRIORITY_CLASSES[v]
+    try:
+        return int(v)
+    except ValueError:
+        return 0
+
+
+@dataclass(frozen=True)
+class Admission:
+    """What the capacity stage gets back."""
+
+    state: str                 # "Admitted" | "Queued" | "Preempted"
+    position: int = 0
+    reason: str = ""
+    waiting_chips: int = 0
+
+    @property
+    def admitted(self) -> bool:
+        return self.state == "Admitted"
+
+
+@dataclass
+class SchedulerOptions:
+    """Env contract (cmd/envconfig.py scheduler_options)."""
+
+    # "" → no explicit fleet; "auto" → infer from Node labels; otherwise a
+    # Fleet.parse spec ("pool-a=v5e:4x4:2,...").
+    fleet_spec: str = ""
+    # ConfigMap (controller namespace) with the same spec under
+    # data["fleet"]; tried when fleet_spec is empty. None disables.
+    fleet_configmap: str | None = None
+    controller_namespace: str = "kubeflow-tpu"
+    weights: dict = field(default_factory=dict)   # namespace → weight
+    aging_seconds: float = 300.0
+    aging_max_boost: int = 4
+    starvation_reserve_seconds: float = 900.0
+    enable_preemption: bool = True
+    idle_preempt_after_seconds: float = 1800.0
+    # Requeue cadence for queued notebooks — a safety net; admissions
+    # re-enqueue the winner immediately.
+    queued_requeue_seconds: float = 10.0
+
+
+class TpuFleetScheduler:
+    def __init__(
+        self,
+        kube,
+        options: SchedulerOptions | None = None,
+        *,
+        fleet: Fleet | None = None,
+        registry: Registry | None = None,
+    ):
+        self.kube = kube
+        self.options = options or SchedulerOptions()
+        self.recorder = EventRecorder(kube, "tpu-fleet-scheduler")
+        if fleet is None and self.options.fleet_spec and \
+                self.options.fleet_spec != "auto":
+            fleet = Fleet.parse(self.options.fleet_spec)  # fail fast
+        self.policy = PolicyQueue(
+            fleet=fleet or Fleet(),
+            config=PolicyConfig(
+                aging_seconds=self.options.aging_seconds,
+                aging_max_boost=self.options.aging_max_boost,
+                starvation_reserve_seconds=(
+                    self.options.starvation_reserve_seconds),
+                enable_preemption=self.options.enable_preemption,
+                idle_preempt_after_seconds=(
+                    self.options.idle_preempt_after_seconds),
+            ),
+        )
+        self._now = time.time
+        self._node_informer = None          # set by setup wiring
+        self._nb_informer = None
+        self._enqueue_cbs: list = []
+        # key → "Queued"|"Admitted" (last surfaced state, for transition
+        # events); key → preemption reason for stopped victims; key →
+        # reason for victims whose stop patch FAILED and must be retried
+        # on their next reconcile (the ledger already re-assigned their
+        # chips — without the retry the victim would run forever).
+        self._state: dict[tuple, str] = {}
+        self._preempted: dict[tuple, str] = {}
+        self._stop_pending: dict[tuple, str] = {}
+        self._fleet_next_try = 0.0
+        # Debounce for full arbitration passes (see Admission below).
+        self._last_pass_gen = -1
+        self._last_pass_at = float("-inf")
+        self._gauge_ns: set = set()
+        self._gauge_pools: set = set()
+        registry = registry or global_registry
+        self.m_queue_depth = registry.gauge(
+            "tpu_scheduler_queue_depth",
+            "Gangs waiting for TPU fleet admission")
+        self.m_admitted_ns = registry.gauge(
+            "tpu_scheduler_admitted_chips",
+            "TPU chips admitted by the fleet scheduler", ["namespace"])
+        self.m_admitted_pool = registry.gauge(
+            "tpu_scheduler_pool_admitted_chips",
+            "TPU chips admitted per node pool", ["pool"])
+        self.m_preemptions = registry.counter(
+            "tpu_scheduler_preemptions_total",
+            "Gangs preempted to reclaim chips", ["reason"])
+        self.m_wait = registry.histogram(
+            "tpu_scheduler_admission_wait_seconds",
+            "Queue wait from submission to admission")
+
+    # ---- wiring -----------------------------------------------------------------
+
+    def on_admitted(self, cb) -> None:
+        """Register a re-enqueue callback: cb((namespace, name))."""
+        self._enqueue_cbs.append(cb)
+
+    def _enqueue(self, key: tuple) -> None:
+        for cb in self._enqueue_cbs:
+            try:
+                cb(key)
+            except Exception:
+                log.exception("scheduler enqueue callback failed for %s", key)
+
+    @property
+    def active(self) -> bool:
+        """True once a fleet is known — until then every admission passes
+        through untouched."""
+        return bool(self.policy.fleet.pools)
+
+    async def _ensure_fleet(self) -> bool:
+        """Discover — and for dynamic sources keep refreshing — the fleet.
+
+        An explicit ``KFTPU_FLEET`` spec is immutable for the process's
+        lifetime (env can't change under a running controller), so it is
+        read once. The ConfigMap and ``auto`` (Node-label) sources are
+        *dynamic*: operators grow/shrink them live, and the webhook's
+        fast-fail ceiling re-reads the same ConfigMap on a short TTL —
+        so both are re-read here on the same ``_CONFIGMAP_RETRY_SECONDS``
+        throttle even after activation, or the admission ceiling and the
+        scheduler's ledger would diverge until a controller restart. The
+        throttle also bounds the auto path's cost while no TPU pool
+        exists yet (no per-reconcile full-cluster Node list). A
+        transiently EMPTY dynamic fleet is ignored: node pools come and
+        go, and turning the scheduler transparent mid-flight would drop
+        the queue; ``KFTPU_SCHEDULER=off`` is the deliberate off switch.
+        On a shrink, pools already over capacity simply stop fitting new
+        gangs and drain as holders release."""
+        opts = self.options
+        dynamic = opts.fleet_spec == "auto" or (
+            not opts.fleet_spec and opts.fleet_configmap)
+        if self.active and not dynamic:
+            return True
+        now = self._now()
+        if now < self._fleet_next_try:
+            return self.active
+        self._fleet_next_try = now + _CONFIGMAP_RETRY_SECONDS
+        fleet = None
+        if opts.fleet_spec == "auto":
+            if self._node_informer is not None:
+                nodes = self._node_informer.items()
+            else:
+                try:
+                    nodes = await self.kube.list("Node")
+                except ApiError:
+                    nodes = []
+            fleet = Fleet.from_nodes(nodes)
+        elif not opts.fleet_spec and opts.fleet_configmap:
+            fleet = await load_fleet_from_configmap(
+                self.kube, opts.fleet_configmap, opts.controller_namespace)
+        if fleet is not None and fleet.pools \
+                and fleet != self.policy.fleet:
+            was_active = self.active
+            # Re-seats live allocations onto the new pools (renamed pool
+            # = same hardware under a new name must not be double-sold)
+            # and bumps gen, so the next admission runs a full
+            # arbitration pass over the new capacity.
+            self.policy.rebind_fleet(fleet)
+            log.info("TPU fleet scheduler %s: %d pool(s), %d chips",
+                     "fleet updated" if was_active else "active",
+                     len(fleet.pools), fleet.total_chips)
+        return self.active
+
+    # ---- request construction ---------------------------------------------------
+
+    def _request_of(self, nb: dict, ms, now: float) -> GangRequest:
+        ns = namespace_of(nb)
+        annotations = annotations_of(nb)
+        return GangRequest(
+            key=(ns, name_of(nb)),
+            namespace=ns or "",
+            accelerator=ms.slice.accelerator.name,
+            topology=ms.slice.topology_str,
+            num_slices=ms.num_slices,
+            chips=ms.num_chips,
+            priority=parse_priority(annotations.get(PRIORITY_ANNOTATION)),
+            weight=float(self.options.weights.get(ns, 1.0)),
+            submitted_at=now,
+        )
+
+    @staticmethod
+    def _last_active(nb: dict) -> float | None:
+        """Culling's idle signal for preemption ranking. None — and
+        therefore never idle — unless the culler has actually probed the
+        server (LAST_ACTIVITY annotation present): on clusters running
+        without culling nothing refreshes activity, and treating
+        'no probe data' as 'idle since admission' would mark every busy
+        gang preemptible ``idle_preempt_after`` seconds into its run.
+        When probe data exists it is floored by the scheduler's own
+        admitted-at stamp, so a gang that waited hours in the queue is
+        not 'idle since before it ran'."""
+        annotations = annotations_of(nb)
+        last = parse_iso(
+            annotations.get(nbapi.LAST_ACTIVITY_ANNOTATION) or "")
+        if last is None:
+            return None
+        admitted = parse_iso(
+            annotations.get(nbapi.SCHEDULER_ADMITTED_AT_ANNOTATION) or "")
+        return max(last, admitted) if admitted is not None else last
+
+    # ---- admission / release ----------------------------------------------------
+
+    async def admission(self, nb: dict, ms, *,
+                        running: bool = False) -> Admission | None:
+        """Arbitrate one notebook's gang. Returns None while no fleet is
+        known (transparent pass-through), otherwise the current admission
+        state. ``running=True`` re-seats a gang whose StatefulSets are
+        already live (controller restart) instead of queueing it."""
+        if not await self._ensure_fleet():
+            return None
+        now = self._now()
+        key = (namespace_of(nb), name_of(nb))
+        if key in self._stop_pending:
+            # This gang was preempted but its stop patch failed: the
+            # ledger already gave its chips away, so retry the stop
+            # rather than re-admit/reclaim a gang that must park.
+            return await self._retry_stop(key, now)
+        result = None
+        with span("schedule", key=f"{key[0]}/{key[1]}"):
+            if self.policy.is_admitted(key):
+                self.policy.touch(key, self._last_active(nb))
+                self._state[key] = "Admitted"
+                ann = annotations_of(nb)
+                if (nbapi.SCHEDULER_ADMITTED_AT_ANNOTATION not in ann
+                        or nbapi.PREEMPTED_ANNOTATION in ann):
+                    # The admit-time stamp patch failed (or a re-admitted
+                    # victim still carries its stale Preempted verdict):
+                    # without the stamp, culling clocks idleness from a
+                    # pre-queue last-activity signal and stops the gang
+                    # seconds after it finally started. Re-stamp with the
+                    # ORIGINAL admission time until the patch lands.
+                    alloc = self.policy.ledger.allocations[key]
+                    await self._stamp_admitted(nb, alloc.admitted_at)
+                return Admission("Admitted")
+            self._preempted.pop(key, None)  # resubmission clears the verdict
+            if nbapi.PREEMPTED_ANNOTATION in annotations_of(nb):
+                # The DURABLE verdict must clear with the in-memory one:
+                # a former victim the user re-queues and later stops is a
+                # plain stop, and release() would otherwise resurrect the
+                # stale annotation as "Preempted" after a controller
+                # restart. Best-effort — release() also guards on the
+                # live queue entry.
+                try:
+                    await self.kube.patch(
+                        "Notebook", key[1],
+                        {"metadata": {"annotations": {
+                            nbapi.PREEMPTED_ANNOTATION: None}}}, key[0])
+                except ApiError:
+                    pass
+            req = self._request_of(nb, ms, now)
+            if running and self.policy.reclaim(req, now):
+                self._state[key] = "Admitted"
+                self._refresh_gauges()
+                return Admission("Admitted")
+            self.policy.submit(req)
+            # Debounce: a long queue re-runs this gate every
+            # queued_requeue_seconds per notebook; when nothing changed
+            # since the last full pass (gen unchanged) and one ran
+            # within the interval, the outcome is identical — serve the
+            # queue snapshot instead of re-arbitrating O(queue) times
+            # per interval. Aging/idle transitions are picked up by the
+            # at-least-one-pass-per-interval that still runs.
+            if (self.policy.gen == self._last_pass_gen
+                    and now - self._last_pass_at
+                    < self.options.queued_requeue_seconds):
+                queue = self.policy.schedule_preview(now)
+            else:
+                result = self.policy.schedule(now)
+                self._last_pass_gen = self.policy.gen
+                self._last_pass_at = now
+                queue = result.queue
+        if result is not None:
+            await self._apply(result, now, requester=nb)
+        if self.policy.is_admitted(key):
+            return Admission("Admitted")
+        info = next((q for q in queue if q.key == key), None)
+        position = info.position if info else 0
+        reason = info.reason if info else ""
+        chips = info.chips if info else ms.num_chips
+        if self._state.get(key) != "Queued":
+            self._state[key] = "Queued"
+            await self._event(
+                nb, "Normal", "Queued",
+                f"Queued for TPU capacity (position {position}): {reason}")
+        return Admission("Queued", position=position, reason=reason,
+                         waiting_chips=chips)
+
+    async def release(self, key: tuple,
+                      nb: dict | None = None) -> Admission | None:
+        """Drop a gang's hold (stop/delete). Frees its chips, runs an
+        arbitration pass so waiting gangs can take them, and — for a
+        stop caused by preemption — reports the ``Preempted`` state the
+        victim's status should show. ``nb`` is the live CR for the stop
+        path; None means the CR is GONE (delete), so the preemption
+        verdict has nobody left to show it to and is dropped too.
+
+        Discovers the fleet if needed (``_ensure_fleet``, not a bare
+        ``active`` check): after a controller restart with a dynamic
+        fleet source, a preempted victim's FIRST reconcile is this
+        stopped path — returning early would wipe the annotation-backed
+        Preempted verdict the end of this method restores."""
+        if not await self._ensure_fleet():
+            return None
+        key = tuple(key)
+        if nb is None:
+            self._preempted.pop(key, None)
+        self._stop_pending.pop(key, None)  # it IS stopped (or gone) now
+        now = self._now()
+        had_queue_entry = key in self.policy.pending
+        alloc = self.policy.release(key)
+        self._state.pop(key, None)
+        if alloc is not None or had_queue_entry:
+            with span("schedule", key=f"{key[0]}/{key[1]}", release=True):
+                result = self.policy.schedule(now)
+                self._last_pass_gen = self.policy.gen
+                self._last_pass_at = now
+            await self._apply(result, now)
+        if key in self._preempted:
+            return Admission("Preempted", reason=self._preempted[key])
+        if nb is not None and alloc is None and not had_queue_entry:
+            # Controller restarted since the preemption: the in-memory
+            # verdict is gone, but the annotation stamped on the victim
+            # survives — keep showing WHY it is stopped. Only a gang that
+            # was PARKED when stopped qualifies: one that was queued or
+            # admitted at stop time has been re-queued/running since the
+            # verdict, so its leftover annotation is stale and this is a
+            # plain user stop.
+            reason = annotations_of(nb).get(nbapi.PREEMPTED_ANNOTATION)
+            if reason:
+                return Admission("Preempted", reason=reason)
+        return None
+
+    # ---- decision application ---------------------------------------------------
+
+    async def _apply(self, result, now: float,
+                     requester: dict | None = None) -> None:
+        req_key = ((namespace_of(requester), name_of(requester))
+                   if requester is not None else None)
+        for p in result.preempted:
+            with span("preempt", victim=f"{p.key[0]}/{p.key[1]}",
+                      reason=p.reason):
+                await self._preempt(p, now)
+        for a in result.admitted:
+            with span("admit", key=f"{a.key[0]}/{a.key[1]}"):
+                self.m_wait.observe(a.waited)
+                self._state[a.key] = "Admitted"
+                nb = (requester if a.key == req_key
+                      else await self._get_notebook(a.key))
+                if nb is not None:
+                    await self._stamp_admitted(nb, now)
+                    await self._event(
+                        nb, "Normal", "Admitted",
+                        f"Admitted by the TPU fleet scheduler after "
+                        f"{a.waited:.0f}s "
+                        f"(slices: {_fmt_placements(a.placements)})")
+                if a.key != req_key:
+                    self._enqueue(a.key)
+        self._refresh_gauges()
+
+    async def _preempt(self, p, now: float) -> None:
+        """Stop-annotate the victim: the notebook reconciler parks the
+        whole gang (slice-atomic, replicas 0 everywhere) and its next
+        reconcile releases the admission handle. Chips were already
+        released in-ledger by the policy, so the beneficiary admits in
+        this same pass. A failed stop patch is remembered and retried on
+        the victim's next reconcile (``_retry_stop``) — the chips are
+        gone from the ledger either way, so the victim MUST park or the
+        fleet physically overcommits."""
+        ns, name = p.key
+        self._preempted[p.key] = p.reason
+        self.m_preemptions.labels(reason=p.reason).inc()
+        if not await self._stop_victim(p.key, p.reason, now):
+            self._stop_pending[p.key] = p.reason
+            log.warning("preemption stop patch failed for %s/%s; will "
+                        "retry on its next reconcile", ns, name)
+        else:
+            nb = await self._get_notebook(p.key)
+            if nb is not None:
+                await self._event(
+                    nb, "Warning", "Preempted",
+                    f"Preempted ({p.reason}) to reclaim {p.chips} TPU "
+                    f"chips for {p.for_key[0]}/{p.for_key[1]}; restart "
+                    "to re-queue")
+        self._enqueue(p.key)
+
+    async def _stop_victim(self, key: tuple, reason: str,
+                           now: float) -> bool:
+        try:
+            await self.kube.patch(
+                "Notebook", key[1],
+                {"metadata": {"annotations": {
+                    nbapi.STOP_ANNOTATION: fmt_iso(now),
+                    nbapi.PREEMPTED_ANNOTATION: reason,
+                }}}, key[0])
+            return True
+        except ApiError:
+            return False
+
+    async def _retry_stop(self, key: tuple, now: float) -> Admission:
+        reason = self._stop_pending[key]
+        if not await self._stop_victim(key, reason, now):
+            # Keep failing the reconcile until the patch lands: the
+            # workqueue's error backoff is the retry loop. Returning
+            # normally here would end retries after this attempt — the
+            # manager is event-driven, so an un-parked victim would run
+            # forever on chips the ledger already gave away.
+            raise ApiError(
+                f"preemption stop patch for {key[0]}/{key[1]} failed; "
+                "retrying with backoff")
+        self._stop_pending.pop(key, None)
+        return Admission("Preempted", reason=reason)
+
+    async def _stamp_admitted(self, nb: dict, now: float) -> None:
+        """Persist the admitted-at timestamp: culling clocks idleness from
+        it (a gang that queued for hours must not be culled seconds after
+        it finally starts), and a controller restart re-reads it."""
+        try:
+            await self.kube.patch(
+                "Notebook", name_of(nb),
+                {"metadata": {"annotations": {
+                    nbapi.SCHEDULER_ADMITTED_AT_ANNOTATION: fmt_iso(now),
+                    nbapi.PREEMPTED_ANNOTATION: None,
+                }}}, namespace_of(nb))
+        except ApiError:
+            pass  # best-effort; the in-memory admitted_at still ranks
+
+    async def _get_notebook(self, key: tuple) -> dict | None:
+        ns, name = key
+        if self._nb_informer is not None:
+            nb = self._nb_informer.get(name, ns)
+            if nb is not None:
+                return nb
+        try:
+            return await self.kube.get_or_none("Notebook", name, ns)
+        except ApiError:
+            return None
+
+    async def _event(self, nb: dict, type_: str, reason: str,
+                     message: str) -> None:
+        try:
+            await self.recorder.event(nb, type_, reason, message)
+        except Exception:
+            pass  # events are best-effort
+
+    def _refresh_gauges(self) -> None:
+        self.m_queue_depth.set(len(self.policy.pending))
+        ns_chips = self.policy.ledger.ns_chips
+        for ns in self._gauge_ns - set(ns_chips):
+            self.m_admitted_ns.labels(namespace=ns or "").set(0)
+        for ns, chips in ns_chips.items():
+            self.m_admitted_ns.labels(namespace=ns or "").set(chips)
+        self._gauge_ns = set(ns_chips)
+        by_pool = self.policy.ledger.admitted_chips_by_pool()
+        for pool in self._gauge_pools - set(by_pool):
+            self.m_admitted_pool.labels(pool=pool).set(0)
+        for pool, chips in by_pool.items():
+            self.m_admitted_pool.labels(pool=pool).set(chips)
+        self._gauge_pools = set(by_pool)
+
+    # ---- introspection ----------------------------------------------------------
+
+    def debug_info(self) -> dict:
+        now = self._now()
+        info = self.policy.debug_info(now)
+        info["active"] = self.active
+        info["fleet_source"] = (
+            "explicit" if self.options.fleet_spec
+            and self.options.fleet_spec != "auto"
+            else ("nodes" if self.options.fleet_spec == "auto"
+                  else ("configmap" if self.options.fleet_configmap
+                        else "none")))
+        info["preempted"] = {
+            f"{k[0]}/{k[1]}": reason for k, reason in self._preempted.items()
+        }
+        return info
+
+
+def _fmt_placements(placements: dict) -> str:
+    return ", ".join(f"{pool}x{n}" for pool, n in sorted(placements.items()))

@@ -129,9 +129,18 @@ ACCELERATORS: dict[str, TpuAccelerator] = {
 
 
 def parse_topology(topology: str) -> tuple[int, ...]:
-    """Parse "4x4" / "2x2x2" into an int tuple."""
+    """Parse "4x4" / "2x2x2" into an int tuple.
+
+    Strict by design: every axis must be a bare decimal integer — no
+    whitespace, signs, or floats. ``int()`` alone would accept "4 x 4"
+    (it strips whitespace), and the raw string flows into GKE node-
+    selector label values and the fleet scheduler's shape matching,
+    where "4 x 4" and "4x4" must not name two different shapes."""
+    parts = topology.lower().split("x")
     try:
-        dims = tuple(int(part) for part in topology.lower().split("x"))
+        if any(not part.isdigit() for part in parts):
+            raise ValueError
+        dims = tuple(int(part) for part in parts)
     except ValueError:
         raise TopologyError(f"malformed topology {topology!r}") from None
     if not dims or any(d < 1 for d in dims):

@@ -74,6 +74,25 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     conditions = deep_get(notebook, "status", "conditions", default=[])
     want_hosts = deep_get(notebook, "status", "tpu", "hosts", default=1) or 1
 
+    # Fleet-scheduler verdicts first (controllers/notebook.py writes
+    # status.scheduler): a Queued gang is waiting *by design*, with a
+    # position and a chip count the user can act on — more specific than
+    # the provisioning wait and any age/pod-state heuristic below.
+    sched = deep_get(notebook, "status", "scheduler", default={}) or {}
+    if sched.get("state") == "Queued":
+        return Status(
+            WAITING,
+            f"Queued for TPU capacity (position {sched.get('position', 0)},"
+            f" waiting for {sched.get('waitingChips', 0)} chips)",
+        )
+    if sched.get("state") == "Preempted" and ready == 0:
+        reason = sched.get("reason") or "capacity reclaimed"
+        return Status(
+            STOPPED,
+            f"Preempted by the TPU fleet scheduler ({reason}); "
+            "restart the server to re-queue",
+        )
+
     # Queued provisioning: nothing runs yet *by design* — more specific
     # than any age/pod-state heuristic below, so it goes first.
     if deep_get(notebook, "status", "tpu", "capacityPending"):

@@ -15,10 +15,12 @@ re-queues the whole slice through the scheduler.
 
 from __future__ import annotations
 
+import os
 import time
 import weakref
 
 from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import annotations_of, deep_get, deepcopy
 
 UPDATE_PENDING_ANNOTATION = nbapi.UPDATE_PENDING_ANNOTATION
@@ -44,9 +46,13 @@ def mutate(nb: dict, info: dict) -> None:
         nb["apiVersion"] = nbapi.STORAGE_API_VERSION
     old = info.get("old")
     if info.get("operation") == "UPDATE" and old is not None:
-        if nbapi.is_stopped(old) or nbapi.is_stopped(nb):
+        if nbapi.is_stopped(old) or nbapi.is_stopped(nb) \
+                or deep_get(old, "status", "scheduler", "state") == "Queued":
             # Stopped (or stopping) notebooks accept edits; they apply on
-            # the next start.
+            # the next start. A gang Queued by the fleet scheduler has no
+            # pods to protect either — and blocking spec.tpu edits there
+            # would trap the user out of the remediation its own queue
+            # reason suggests ("reduce spec.tpu.numSlices").
             annotations_of(nb).pop(UPDATE_PENDING_ANNOTATION, None)
         elif _pod_affecting_changed(nb, old):
             for path in _POD_AFFECTING:
@@ -59,6 +65,105 @@ def mutate(nb: dict, info: dict) -> None:
             annotations_of(nb)[UPDATE_PENDING_ANNOTATION] = "true"
     nbapi.default(nb)
     nbapi.validate(nb)
+
+
+# ---- capacity fast-fail ------------------------------------------------------
+#
+# A chip request that can NEVER be satisfied must die at admission with an
+# actionable message, not sit in the fleet scheduler's queue (or behind a
+# ResourceQuota) forever. Two ceilings are checkable synchronously:
+#
+# - the namespace's Profile ``spec.tpuQuota`` (the per-tenant chip
+#   ceiling the profile controller materialises as a ResourceQuota);
+# - the configured fleet's whole-cluster capacity for the requested slice
+#   shape (``KFTPU_FLEET`` — an auto-inferred fleet is deliberately NOT
+#   checked here: node pools come and go, and a transient empty fleet
+#   must not reject CRs that would queue and then run).
+#
+# CREATE-only: rejecting UPDATEs against a later-lowered ceiling would
+# freeze the controller's own annotation/status patches on the CR.
+
+
+async def validate_capacity(kube, nb: dict) -> None:
+    """Raise Invalid when the notebook's gang can never fit."""
+    ms = nbapi.multi_slice_of(nb)  # raises Invalid on a malformed block
+    if ms is None:
+        return
+    name = deep_get(nb, "metadata", "name")
+    ns = deep_get(nb, "metadata", "namespace")
+    chips = ms.num_chips
+    if ns and kube is not None:
+        # Profiles are cluster-scoped and named after their namespace.
+        # TTL-cached like the fleet ConfigMap: an admission burst must
+        # not GET the same Profile once per CREATE.
+        profile = await _ttl_cached(
+            _profile_cache, kube, ns,
+            lambda: kube.get_or_none("Profile", ns))
+        quota = deep_get(profile or {}, "spec", "tpuQuota")
+        if isinstance(quota, int) and not isinstance(quota, bool) \
+                and chips > quota:
+            raise Invalid(
+                f"Notebook {name}: requests {chips} TPU chips but the "
+                f"namespace ceiling (Profile {ns} spec.tpuQuota) is "
+                f"{quota} — shrink spec.tpu.topology/numSlices or raise "
+                "the quota")
+    from kubeflow_tpu.scheduler import scheduler_enabled
+
+    if not scheduler_enabled():
+        # KFTPU_SCHEDULER=off must restore the pre-scheduler behavior
+        # end to end: a stale KFTPU_FLEET left in the deployment env
+        # must not keep rejecting CRs the capacity gate would run.
+        return
+    fleet = await _declared_fleet(kube)
+    if fleet is not None and fleet.pools:
+        acc = ms.slice.accelerator.name
+        topo = ms.slice.topology_str
+        ceiling = fleet.total_slices(acc, topo)
+        if ceiling < ms.num_slices:
+            detail = (
+                f"no configured node pool hosts {acc}:{topo} slices"
+                if ceiling == 0 else
+                f"the fleet holds at most {ceiling} {acc}:{topo} "
+                f"slice(s), the gang needs {ms.num_slices}")
+            raise Invalid(
+                f"Notebook {name}: can never be scheduled — {detail}. "
+                "Pick a shape from the configured fleet (KFTPU_FLEET) "
+                "or reduce spec.tpu.numSlices")
+
+
+async def _declared_fleet(kube):
+    """The operator-declared fleet for the fast-fail ceiling: the
+    KFTPU_FLEET env spec, else the KFTPU_FLEET_CONFIGMAP ConfigMap
+    (TTL-cached — admission bursts must not GET it per CREATE). An
+    auto-inferred fleet (`KFTPU_FLEET=auto`) is deliberately excluded:
+    node pools come and go, and a transiently empty fleet must not
+    reject CRs that would queue and then run. Returns None when nothing
+    is declared or the spec is broken (a bad spec must not block
+    admissions)."""
+    from kubeflow_tpu.scheduler.fleet import Fleet, FleetConfigError
+    from kubeflow_tpu.scheduler.runtime import load_fleet_from_configmap
+
+    spec = os.environ.get("KFTPU_FLEET", "").strip()
+    if spec == "auto":
+        return None
+    if not spec:
+        configmap = os.environ.get("KFTPU_FLEET_CONFIGMAP")
+        if not configmap or kube is None:
+            return None
+        from kubeflow_tpu.runtime.deployment import controller_namespace
+
+        ns = controller_namespace()
+        return await _ttl_cached(
+            _fleet_cache, kube, (ns, configmap),
+            lambda: load_fleet_from_configmap(kube, configmap, ns))
+    try:
+        return Fleet.parse(spec)
+    except FleetConfigError:
+        return None
+
+
+_fleet_cache: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
+_profile_cache: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
 
 
 # ---- image-alias resolution --------------------------------------------------
@@ -100,31 +205,43 @@ CATALOG_CACHE_TTL = 10.0
 _catalog_cache: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
 
 
-async def _load_catalog(kube, ns: str, configmap: str) -> dict:
+async def _ttl_cached(cache, kube, key, loader):
+    """Per-client TTL memo for ConfigMap-backed admission lookups (the
+    image catalog and the declared-fleet ceiling share it). Weak client
+    keys so a test's FakeKube doesn't pin stale entries for the next
+    test; a non-weakrefable client just skips caching."""
     now = time.monotonic()
     per_kube = None
     try:
-        per_kube = _catalog_cache.setdefault(kube, {})
-        hit = per_kube.get((ns, configmap))
+        per_kube = cache.setdefault(kube, {})
+        hit = per_kube.get(key)
         if hit and now - hit[0] < CATALOG_CACHE_TTL:
             return hit[1]
-    except TypeError:  # non-weakrefable client: just skip caching
+    except TypeError:
         per_kube = None
-    cm = await kube.get_or_none("ConfigMap", configmap, ns)
-    catalog: dict = {}
-    if cm is not None:
-        try:
-            import yaml
-
-            parsed = yaml.safe_load(
-                (cm.get("data") or {}).get(IMAGE_CATALOG_KEY) or "")
-            if isinstance(parsed, dict):
-                catalog = parsed
-        except Exception:
-            catalog = {}
+    value = await loader()
     if per_kube is not None:
-        per_kube[(ns, configmap)] = (now, catalog)
-    return catalog
+        per_kube[key] = (now, value)
+    return value
+
+
+async def _load_catalog(kube, ns: str, configmap: str) -> dict:
+    async def load() -> dict:
+        cm = await kube.get_or_none("ConfigMap", configmap, ns)
+        catalog: dict = {}
+        if cm is not None:
+            try:
+                import yaml
+
+                parsed = yaml.safe_load(
+                    (cm.get("data") or {}).get(IMAGE_CATALOG_KEY) or "")
+                if isinstance(parsed, dict):
+                    catalog = parsed
+            except Exception:
+                catalog = {}
+        return catalog
+
+    return await _ttl_cached(_catalog_cache, kube, (ns, configmap), load)
 
 
 async def resolve_image_from_catalog(

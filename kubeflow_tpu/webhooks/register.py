@@ -46,8 +46,17 @@ def register_all(kube) -> None:
 
     kube.add_mutator("Profile", profile_normalizer)
 
-    # CR validation.
-    kube.add_validator("Notebook", lambda nb, _i: nbapi.validate(nb))
+    # CR validation. Notebooks additionally fast-fail (CREATE only) when
+    # the chip request can never fit the namespace tpuQuota ceiling or
+    # the configured TPU fleet (webhooks/notebook.py validate_capacity)
+    # — an impossible gang must be rejected with an actionable message,
+    # not queue forever.
+    async def notebook_validator(nb: dict, info: dict) -> None:
+        nbapi.validate(nb)
+        if info.get("operation") in (None, "CREATE"):
+            await nb_webhook.validate_capacity(kube, nb)
+
+    kube.add_validator("Notebook", notebook_validator)
     kube.add_validator("PodDefault", lambda pd, _i: pdapi.validate(pd))
     kube.add_validator("Profile", lambda p, _i: profileapi.validate(p))
     kube.add_validator("Tensorboard", lambda tb, _i: tbapi.validate(tb))

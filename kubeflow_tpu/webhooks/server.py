@@ -151,6 +151,11 @@ def create_webhook_app(kube, *, registry=None, tracer=None) -> web.Application:
         # Image-alias pinning from the catalog ConfigMap (same engine the
         # in-process chain registers; see webhooks/notebook.py).
         await nb_webhook.resolve_image_from_catalog(kube, nb)
+        # Capacity fast-fail (CREATE only): a gang that exceeds the
+        # namespace tpuQuota or the configured fleet's ceiling can never
+        # run — reject it here instead of queueing it forever.
+        if operation == "CREATE":
+            await nb_webhook.validate_capacity(kube, nb)
 
     async def mutate_pvcviewer(_kube, viewer, _op, _old):
         pvcapi.default(viewer)

@@ -1,0 +1,633 @@
+"""Pure fleet-scheduler policy tests (ISSUE 5).
+
+Everything here runs on the pure policy core — no FakeKube, no event
+loop, no wall clock — which is the point of keeping the policy pure: the
+gang/capacity invariants are property-tested under randomized
+arrival/completion sequences, and determinism is checked by replay.
+"""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.scheduler import (
+    Fleet,
+    FleetConfigError,
+    GangRequest,
+    LedgerError,
+    PolicyConfig,
+    PolicyQueue,
+    parse_priority,
+)
+from kubeflow_tpu.scheduler.fleet import Allocation, ChipLedger, NodePool
+
+
+def _req(key, ns, *, slices=1, acc="v5e", topo="4x4", priority=0,
+         weight=1.0, at=0.0):
+    chips = 16 * slices if topo == "4x4" else None
+    from kubeflow_tpu.tpu.topology import TpuSlice
+    chips = TpuSlice.parse(acc, topo).num_chips * slices
+    return GangRequest(key=key, namespace=ns, accelerator=acc,
+                       topology=topo, num_slices=slices, chips=chips,
+                       priority=priority, weight=weight, submitted_at=at)
+
+
+# ---- fleet model -------------------------------------------------------------
+
+
+def test_fleet_parse_roundtrip():
+    f = Fleet.parse("pool-b=v5p:2x2x1:4, pool-a=v5e:4x4:2")
+    assert [p.name for p in f.pools] == ["pool-a", "pool-b"]
+    assert f.by_name("pool-a").chips_per_slice == 16
+    assert f.by_name("pool-b").chips_per_slice == 4
+    assert f.total_chips == 2 * 16 + 4 * 4
+    assert f.total_slices("v5e", "4x4") == 2
+    assert f.total_slices("v5e", "8x8") == 0
+
+
+@pytest.mark.parametrize("spec", [
+    "nope",                      # no '='
+    "a=v5e:4x4",                 # missing slice count
+    "a=v5e:4x4:x",               # non-int count
+    "a=v9z:4x4:1",               # unknown accelerator
+    "a=v5e:3x5:1",               # invalid topology for the host grid
+    "a=v5e:4x4:1,a=v5e:4x4:2",   # duplicate pool name
+    "a=v5e:4x4:0",               # zero slices
+])
+def test_fleet_parse_rejects_garbage(spec):
+    with pytest.raises(FleetConfigError):
+        Fleet.parse(spec)
+
+
+def test_fleet_from_nodes_counts_whole_slices():
+    def node(name, pool, acc, topo):
+        return {"metadata": {"name": name, "labels": {
+            "cloud.google.com/gke-nodepool": pool,
+            "cloud.google.com/gke-tpu-accelerator": acc,
+            "cloud.google.com/gke-tpu-topology": topo,
+        }}}
+
+    # v5e 4x4 = 2 hosts per slice; 5 hosts → 2 whole slices.
+    nodes = [node(f"n{i}", "pool-a", "tpu-v5-lite-podslice", "4x4")
+             for i in range(5)]
+    nodes.append(node("cpu", "cpu-pool", "", ""))  # no TPU labels
+    f = Fleet.from_nodes(nodes)
+    assert len(f.pools) == 1
+    assert f.pools[0].num_slices == 2
+    assert f.pools[0].accelerator == "v5e"
+    # A single partial slice's worth of hosts → no pool at all.
+    assert Fleet.from_nodes(
+        [node("n0", "p", "tpu-v5-lite-podslice", "4x4")]).pools == ()
+
+
+def test_from_nodes_disambiguates_mixed_shape_nodepool():
+    """One gke-nodepool label carrying two TPU shapes (mid-migration
+    label drift) must yield two distinctly NAMED pools — the ledger
+    resolves placements by name, and a collision would turn every admit
+    of the second shape into a LedgerError."""
+    def node(name, acc, topo):
+        return {"metadata": {"name": name, "labels": {
+            "cloud.google.com/gke-nodepool": "drifting",
+            "cloud.google.com/gke-tpu-accelerator": acc,
+            "cloud.google.com/gke-tpu-topology": topo,
+        }}}
+
+    nodes = (
+        [node(f"a{i}", "tpu-v5-lite-podslice", "4x4") for i in range(2)]
+        + [node(f"b{i}", "tpu-v6e-slice", "4x4") for i in range(2)])
+    f = Fleet.from_nodes(nodes)
+    assert len(f.pools) == 2
+    assert len({p.name for p in f.pools}) == 2
+    assert {p.accelerator for p in f.pools} == {"v5e", "v6e"}
+    # Both shapes admit cleanly through a name-keyed ledger.
+    ledger = ChipLedger(f)
+    for pool in f.pools:
+        ledger.admit(Allocation(
+            key=("ns", pool.accelerator), namespace="ns",
+            accelerator=pool.accelerator, topology=pool.topology,
+            num_slices=1, chips=pool.chips_per_slice,
+            placements={pool.name: 1}))
+    ledger.assert_consistent()
+
+
+def test_from_nodes_stray_shape_does_not_rename_real_pool():
+    """A second shape on a nodepool label that yields NO pool (partial
+    slice, or an unparsable topology) must not trigger the mixed-shape
+    disambiguation rename: the rename would read as a fleet change and
+    rebind-churn every allocation booked on the real pool — for hardware
+    that never changed."""
+    def node(name, acc, topo):
+        return {"metadata": {"name": name, "labels": {
+            "cloud.google.com/gke-nodepool": "p",
+            "cloud.google.com/gke-tpu-accelerator": acc,
+            "cloud.google.com/gke-tpu-topology": topo,
+        }}}
+
+    real = [node(f"a{i}", "tpu-v5-lite-podslice", "4x4") for i in range(2)]
+    # One v6e host: fewer than hosts-per-slice → zero whole slices.
+    partial = [node("b0", "tpu-v6e-slice", "4x4")]
+    broken = [node("c0", "tpu-v6e-slice", "not-a-topology")]
+    for strays in ([], partial, broken, partial + broken):
+        f = Fleet.from_nodes(real + strays)
+        assert [p.name for p in f.pools] == ["p"], strays
+        assert f.pools[0].num_slices == 1
+
+
+def test_ledger_rejects_partial_gang_and_double_admit():
+    fleet = Fleet.parse("a=v5e:4x4:2")
+    ledger = ChipLedger(fleet)
+    good = Allocation(key=("ns", "x"), namespace="ns", accelerator="v5e",
+                      topology="4x4", num_slices=2, chips=32,
+                      placements={"a": 2})
+    ledger.admit(good)
+    with pytest.raises(LedgerError):
+        ledger.admit(good)  # double admit
+    ledger.release(("ns", "x"))
+    with pytest.raises(LedgerError):
+        ledger.admit(Allocation(
+            key=("ns", "y"), namespace="ns", accelerator="v5e",
+            topology="4x4", num_slices=2, chips=32,
+            placements={"a": 1}))  # partial gang
+    with pytest.raises(LedgerError):
+        ledger.admit(Allocation(
+            key=("ns", "z"), namespace="ns", accelerator="v5e",
+            topology="4x4", num_slices=3, chips=48,
+            placements={"a": 3}))  # over pool capacity
+    assert ledger.violations == 3
+
+
+# ---- gang admission ----------------------------------------------------------
+
+
+def test_gang_is_all_or_nothing_across_pools():
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2,b=v5e:4x4:1"))
+    # 3 slices spread over both pools: fits exactly.
+    q.submit(_req(("ns", "big"), "ns", slices=3))
+    r = q.schedule(0.0)
+    assert [a.key for a in r.admitted] == [("ns", "big")]
+    assert sum(r.admitted[0].placements.values()) == 3
+    # A second 1-slice gang cannot fit anywhere → queued, nothing partial.
+    q.submit(_req(("ns", "late"), "ns", slices=1))
+    r2 = q.schedule(1.0)
+    assert r2.admitted == []
+    assert [x.key for x in r2.queue] == [("ns", "late")]
+    assert ("ns", "late") not in q.ledger.allocations
+
+
+def test_wrong_shape_never_fits_and_reason_says_so():
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"))
+    q.submit(_req(("ns", "v5p"), "ns", acc="v5p", topo="2x2x1"))
+    r = q.schedule(0.0)
+    assert r.admitted == []
+    assert "no pool hosts v5p:2x2x1" in r.queue[0].reason
+    q.submit(_req(("ns", "huge"), "ns", slices=3))
+    r2 = q.schedule(1.0)
+    assert "ceiling" in [x for x in r2.queue
+                         if x.key == ("ns", "huge")][0].reason
+
+
+# ---- fair share / priority / aging -------------------------------------------
+
+
+def test_never_fits_gang_does_not_wedge_starvation_reserve():
+    """A starved gang BIGGER than the fleet's shape ceiling (created
+    before a shrink, or past the CREATE-only webhook check) must not
+    hold the backfill door shut forever — only starved gangs the fleet
+    can eventually host reserve capacity."""
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"),
+                    config=PolicyConfig(starvation_reserve_seconds=10.0,
+                                        aging_seconds=0.0))
+    q.submit(_req(("ns", "huge"), "ns", slices=3, at=0.0))   # ceiling is 2
+    q.submit(_req(("ns", "small"), "ns", slices=1, at=500.0))
+    r = q.schedule(1000.0)  # huge starved far past the reserve
+    assert [a.key for a in r.admitted] == [("ns", "small")]
+    huge = [x for x in r.queue if x.key == ("ns", "huge")][0]
+    assert "ceiling" in huge.reason
+    # A starved gang that CAN fit still holds the door: the 1-slice
+    # backfill would fit the free slice, but must not jump the starved
+    # 2-slice gang waiting for the busy holder's capacity to drain.
+    q2 = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"),
+                     config=PolicyConfig(starvation_reserve_seconds=10.0,
+                                         aging_seconds=0.0))
+    q2.submit(_req(("ns", "holder"), "ns", slices=1, at=0.0))
+    assert [a.key for a in q2.schedule(0.0).admitted] == [("ns", "holder")]
+    q2.submit(_req(("ns", "starved"), "ns", slices=2, at=1.0))
+    q2.submit(_req(("ns", "backfill"), "ns", slices=1, at=500.0))
+    r = q2.schedule(1000.0)
+    assert r.admitted == []  # door held: no backfill past the starved gang
+    assert [x.key for x in r.queue] == [("ns", "starved"),
+                                        ("ns", "backfill")]
+
+
+def test_starvation_door_blocks_only_its_shape():
+    """A starved v5e gang must not hold back a v5p gang whose pool sits
+    idle — the door reserves the starved gang's shape, not the queue."""
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1,b=v5p:2x2x1:1"),
+                    config=PolicyConfig(starvation_reserve_seconds=10.0,
+                                        aging_seconds=0.0))
+    q.submit(_req(("ns", "holder"), "ns", slices=1, at=0.0))
+    assert [a.key for a in q.schedule(0.0).admitted] == [("ns", "holder")]
+    q.submit(_req(("ns", "starved"), "ns", slices=1, at=1.0))
+    q.submit(_req(("ns", "other"), "ns", acc="v5p", topo="2x2x1",
+                  slices=1, at=500.0))
+    r = q.schedule(1000.0)
+    assert [a.key for a in r.admitted] == [("ns", "other")]
+    assert any(x.key == ("ns", "starved") for x in r.queue)
+
+
+def test_fair_share_interleaves_namespaces():
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:4"))
+    # ns-a floods the queue first; ns-b arrives later with one gang.
+    for i in range(4):
+        q.submit(_req(("ns-a", f"a{i}"), "ns-a", at=float(i)))
+    q.submit(_req(("ns-b", "b0"), "ns-b", at=10.0))
+    r = q.schedule(10.0)
+    admitted = [a.key for a in r.admitted]
+    # All five can't fit (4 slices): ns-b must get a slot even though it
+    # arrived last — DRF picks the namespace with the smaller share.
+    assert ("ns-b", "b0") in admitted
+    assert len(admitted) == 4
+
+
+def test_namespace_weight_tilts_the_share():
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:3"))
+    q.submit(_req(("heavy", "h0"), "heavy", weight=2.0, at=0.0))
+    q.submit(_req(("heavy", "h1"), "heavy", weight=2.0, at=0.1))
+    q.submit(_req(("light", "l0"), "light", weight=1.0, at=0.2))
+    q.submit(_req(("light", "l1"), "light", weight=1.0, at=0.3))
+    r = q.schedule(1.0)
+    admitted = {a.key for a in r.admitted}
+    # 3 slots: weight-2 namespace gets 2, weight-1 namespace gets 1.
+    assert admitted == {("heavy", "h0"), ("heavy", "h1"), ("light", "l0")}
+
+
+def test_priority_class_wins_and_parse_priority():
+    assert parse_priority("high") == 100
+    assert parse_priority("LOW") == -100
+    assert parse_priority("42") == 42
+    assert parse_priority("garbage") == 0
+    assert parse_priority(None) == 0
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"))
+    q.submit(_req(("ns", "norm"), "ns", at=0.0))
+    q.submit(_req(("ns", "hi"), "ns", priority=100, at=5.0))
+    r = q.schedule(5.0)
+    assert [a.key for a in r.admitted] == [("ns", "hi")]
+    assert [x.key for x in r.queue] == [("ns", "norm")]
+
+
+def test_aging_bounds_starvation_of_a_big_gang():
+    cfg = PolicyConfig(aging_seconds=10.0, aging_max_boost=4,
+                       starvation_reserve_seconds=30.0,
+                       enable_preemption=False)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"), config=cfg)
+    # A small holder takes one slice; the big gang then needs the whole
+    # fleet and can't fit while anything else runs.
+    q.submit(_req(("ns", "s_pre"), "ns", slices=1, at=0.0))
+    q.schedule(0.0)
+    q.submit(_req(("ns", "big"), "ns", slices=2, at=1.0))
+    q.submit(_req(("ns", "s0"), "ns", slices=1, at=1.0))
+    r = q.schedule(1.0)
+    # Backfill is allowed while the big gang is young: s0 takes the
+    # free slice the big gang was too large for.
+    assert [a.key for a in r.admitted] == [("ns", "s0")]
+    # Past the starvation reserve the scheduler holds the door: when a
+    # slice frees up, a fresh small gang must NOT snatch it from the
+    # starved big gang.
+    q.release(("ns", "s_pre"))
+    q.submit(_req(("ns", "s1"), "ns", slices=1, at=35.0))
+    r2 = q.schedule(35.0)
+    assert r2.admitted == []
+    assert [x.key for x in r2.queue][0] == ("ns", "big")
+    # Once the other backfiller completes, the starved gang gets the
+    # whole fleet — bounded starvation.
+    q.release(("ns", "s0"))
+    r3 = q.schedule(36.0)
+    assert [a.key for a in r3.admitted][0] == ("ns", "big")
+
+
+# ---- preemption --------------------------------------------------------------
+
+
+def test_idle_holder_is_preempted_whole_gang():
+    cfg = PolicyConfig(idle_preempt_after_seconds=100.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"), config=cfg)
+    q.submit(_req(("lo", "idler"), "lo", slices=2))
+    q.schedule(0.0)
+    q.touch(("lo", "idler"), 0.0)  # culling's last-activity signal
+    q.submit(_req(("hi", "urgent"), "hi", slices=2, at=200.0))
+    r = q.schedule(200.0)
+    assert [p.key for p in r.preempted] == [("lo", "idler")]
+    assert r.preempted[0].reason == "idle"
+    assert [a.key for a in r.admitted] == [("hi", "urgent")]
+    # The victim is fully gone — never mid-gang.
+    assert ("lo", "idler") not in q.ledger.allocations
+    q.ledger.assert_consistent()
+
+
+def test_busy_holder_only_preempted_by_higher_priority():
+    cfg = PolicyConfig(idle_preempt_after_seconds=1e9)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"), config=cfg)
+    q.submit(_req(("a", "holder"), "a", priority=0))
+    q.schedule(0.0)
+    q.touch(("a", "holder"), 0.0)  # recent activity → busy
+    # Same priority: no preemption.
+    q.submit(_req(("b", "peer"), "b", priority=0, at=1.0))
+    r = q.schedule(1.0)
+    assert r.preempted == [] and r.admitted == []
+    # Aging must not manufacture preemption rights: after eons in the
+    # queue the same-priority peer outranks everyone for ORDERING, but
+    # still may not kill a busy holder.
+    r_aged = q.schedule(1e6)
+    assert r_aged.preempted == [] and r_aged.admitted == []
+    # Strictly higher BASE priority: the busy holder dies.
+    q.submit(_req(("c", "boss"), "c", priority=100, at=2.0))
+    r2 = q.schedule(2.0)
+    assert [p.key for p in r2.preempted] == [("a", "holder")]
+    assert r2.preempted[0].reason == "priority"
+    assert [a.key for a in r2.admitted] == [("c", "boss")]
+
+
+def test_holder_without_probe_data_is_never_idle():
+    """No culling signal (last_active_at None) must read as 'unknown',
+    not 'idle since admission' — on clusters without culling every busy
+    gang would otherwise become preemptible after the idle window."""
+    cfg = PolicyConfig(idle_preempt_after_seconds=10.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"), config=cfg)
+    q.submit(_req(("a", "holder"), "a"))
+    q.schedule(0.0)  # admitted; never touched → no probe data
+    q.submit(_req(("b", "peer"), "b", at=1e6))
+    r = q.schedule(1e6)  # eons later, same priority
+    assert r.preempted == [] and r.admitted == []
+
+
+def test_preemption_disabled_respects_kill_knob():
+    cfg = PolicyConfig(enable_preemption=False,
+                       idle_preempt_after_seconds=1.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"), config=cfg)
+    q.submit(_req(("a", "idler"), "a"))
+    q.schedule(0.0)
+    q.touch(("a", "idler"), 0.0)
+    q.submit(_req(("b", "hi"), "b", priority=100, at=100.0))
+    r = q.schedule(100.0)
+    assert r.preempted == [] and r.admitted == []
+
+
+# ---- reclaim (controller restart) --------------------------------------------
+
+
+def test_reclaim_reseats_running_gang_without_queueing():
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"))
+    assert q.reclaim(_req(("ns", "alive"), "ns", slices=2), now=5.0)
+    assert q.is_admitted(("ns", "alive"))
+    q.ledger.assert_consistent()
+    # Overcommit path: fleet already full, a second live gang reseats
+    # anyway (its pods exist) and is recorded as overcommit, not as a
+    # ledger violation.
+    assert q.reclaim(_req(("ns", "alive2"), "ns", slices=2), now=6.0)
+    assert q.overcommitted == 1
+    assert q.ledger.violations == 0
+    # Deliberate overcommit is NOT ledger drift: the consistency check
+    # still passes, and draining the forced gang restores normal checks.
+    q.ledger.assert_consistent()
+    q.release(("ns", "alive2"))
+    assert q.overcommitted == 0  # drains with the forced holder
+    q.ledger.assert_consistent()
+    # A shape that left the fleet entirely STILL reseats (pods run!) —
+    # on a shape pseudo-pool, as pure overcommit taking no real pool's
+    # capacity. Queueing it would suppress its child reconcile and
+    # report 'Queued' while the workload serves traffic.
+    assert q.reclaim(_req(("ns", "odd"), "ns", acc="v5p",
+                          topo="2x2x1"), now=7.0)
+    assert q.is_admitted(("ns", "odd"))
+    assert q.overcommitted == 1
+    q.ledger.assert_consistent()
+    # It does not eat v5e capacity: the remaining slots still admit.
+    q.release(("ns", "alive"))
+    q.submit(_req(("ns", "fresh"), "ns", slices=2, at=8.0))
+    assert [a.key for a in q.schedule(8.0).admitted] == [("ns", "fresh")]
+    q.release(("ns", "odd"))
+    q.ledger.assert_consistent()
+
+
+def test_idle_floor_uses_in_memory_admitted_at():
+    """If the durable admitted-at stamp failed to land, a stale pre-queue
+    culling signal must still not make a freshly admitted gang
+    idle-preemptible — the in-memory admitted_at floors the idle clock."""
+    cfg = PolicyConfig(idle_preempt_after_seconds=100.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"), config=cfg)
+    q.submit(_req(("a", "justran"), "a", at=7200.0))
+    q.schedule(7200.0)  # admitted_at = 7200
+    q.touch(("a", "justran"), 0.0)  # stale pre-queue probe (2h old)
+    q.submit(_req(("b", "waiter"), "b", at=7210.0))
+    r = q.schedule(7210.0)  # only 10s after admission
+    assert r.preempted == [] and r.admitted == []
+    # Once the holder is genuinely idle PAST admission, it dies.
+    r2 = q.schedule(7200.0 + 200.0)
+    assert [p.key for p in r2.preempted] == [("a", "justran")]
+
+
+def test_pseudo_pool_gang_is_not_preempted_for_an_unadmittable_waiter():
+    """A gang force-seated on a shape pseudo-pool (its shape left the
+    fleet) frees nothing a waiter can use — preempting it would stop a
+    live workload for zero benefit."""
+    cfg = PolicyConfig(idle_preempt_after_seconds=10.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"), config=cfg)
+    # Restart over a fleet that dropped v5p: the live gang force-seats.
+    assert q.reclaim(_req(("ns", "survivor"), "ns", acc="v5p",
+                          topo="2x2x1"), now=0.0)
+    q.touch(("ns", "survivor"), 0.0)  # long idle — still not a victim
+    q.submit(_req(("ns", "hopeless"), "ns", acc="v5p", topo="2x2x1",
+                  priority=100, at=1000.0))
+    r = q.schedule(1000.0)
+    assert r.preempted == [] and r.admitted == []
+    assert q.is_admitted(("ns", "survivor"))  # untouched
+    assert "no pool hosts" in r.queue[0].reason
+    q.ledger.assert_consistent()
+
+
+def test_rebind_fleet_reseats_allocations_on_pool_rename():
+    """A renamed pool is the same hardware: live gangs must follow the
+    name so the new pool's capacity is not sold twice."""
+    q = PolicyQueue(fleet=Fleet.parse("pool-a=v5e:4x4:2"))
+    q.submit(_req(("ns", "one"), "ns", slices=2))
+    assert [a.key for a in q.schedule(5.0).admitted] == [("ns", "one")]
+    q.touch(("ns", "one"), 4.0)
+    q.rebind_fleet(Fleet.parse("pool-b=v5e:4x4:2"))
+    alloc = q.ledger.allocations[("ns", "one")]
+    assert alloc.placements == {"pool-b": 2}
+    assert alloc.admitted_at == 5.0      # original admission time kept
+    assert alloc.last_active_at == 4.0   # idle signal kept
+    q.ledger.assert_consistent()
+    # The renamed pool is FULL: a new gang queues instead of
+    # double-booking the same hardware.
+    q.submit(_req(("ns", "two"), "ns", slices=1, at=6.0))
+    r = q.schedule(6.0)
+    assert r.admitted == []
+    assert [x.key for x in r.queue] == [("ns", "two")]
+    # A shrink that drops the shape falls back to pseudo-pool overcommit.
+    q.rebind_fleet(Fleet.parse("pool-c=v5p:2x2x1:1"))
+    assert q.is_admitted(("ns", "one"))
+    assert q.ledger.allocations[("ns", "one")].forced
+    q.ledger.assert_consistent()
+
+
+def test_victim_search_clamps_overcommitted_pool_deficit():
+    """An overcommitted pool's negative free space must not leak into
+    the victim search: the deficit would either hide reclaimable
+    capacity on a healthy same-shape pool (preemption wrongly refused)
+    or drag extra healthy gangs into the victim set (over-kill)."""
+    cfg = PolicyConfig(idle_preempt_after_seconds=100.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2,b=v5e:4x4:4"),
+                    config=cfg)
+    # Restart overcommit: 4 slices force-seated on pool-a (cap 2 → −2).
+    q.ledger.admit(Allocation(
+        key=("ns", "over"), namespace="ns", accelerator="v5e",
+        topology="4x4", num_slices=4, chips=64, placements={"a": 4},
+        admitted_at=0.0), force=True)
+    # Healthy holder on pool-b, later idle.
+    q.submit(_req(("ns", "idler"), "ns", slices=2, at=0.0))
+    assert [a.key for a in q.schedule(0.0).admitted] == [("ns", "idler")]
+    q.touch(("ns", "idler"), 0.0)
+    # Waiter needs 4 slices: releasing JUST the idler frees pool-b to 4.
+    # The pool-a deficit must neither refuse the preemption nor pull the
+    # (busy, force-seated) gang into the victim set.
+    q.submit(_req(("ns", "big"), "ns", slices=4, priority=100, at=1000.0))
+    r = q.schedule(1000.0)
+    assert [p.key for p in r.preempted] == [("ns", "idler")]
+    assert [a.key for a in r.admitted] == [("ns", "big")]
+    assert q.is_admitted(("ns", "over"))  # not an unnecessary victim
+    q.ledger.assert_consistent()
+
+
+def test_fleet_shrink_keeps_live_pool_as_overcommit_not_drift():
+    """A fleet edit that shrinks a pool under a live gang (name and shape
+    kept) is documented drain-down: the gang stays, the invariant checker
+    must treat the over-capacity pool as deliberate overcommit — not
+    ledger drift — and the pool fits nothing new until it drains."""
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:6"))
+    q.submit(_req(("ns", "big"), "ns", slices=4))
+    assert [a.key for a in q.schedule(0.0).admitted] == [("ns", "big")]
+    q.rebind_fleet(Fleet.parse("a=v5e:4x4:2"))
+    assert q.is_admitted(("ns", "big"))
+    assert q.overcommitted == 1
+    q.ledger.assert_consistent()  # deliberate overcommit, not drift
+    # Nothing new fits the shrunken pool until the holder drains.
+    q.submit(_req(("ns", "nxt"), "ns", slices=1, at=5.0))
+    assert q.schedule(5.0).admitted == []
+    q.release(("ns", "big"))
+    assert q.overcommitted == 0
+    assert [a.key for a in q.schedule(6.0).admitted] == [("ns", "nxt")]
+    q.ledger.assert_consistent()
+
+
+def test_queued_shape_edit_resets_aging_credit():
+    """A spec edit that CHANGES the gang's shape re-declares demand: the
+    refreshed entry gets a fresh submitted_at/seq, so aging and
+    starvation credit earned as a small gang never transfers to an
+    arbitrarily larger one. A same-shape refresh keeps its credit."""
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:4"))
+    q.submit(_req(("ns", "nb"), "ns", slices=1, at=0.0))
+    # Idempotent refresh (the holder's reconcile): credit preserved.
+    q.submit(_req(("ns", "nb"), "ns", slices=1, at=500.0))
+    entry = q.pending[("ns", "nb")]
+    assert entry.submitted_at == 0.0
+    seq_before = entry.seq
+    # Shape edit while queued: demand re-declared, credit reset.
+    q.submit(_req(("ns", "nb"), "ns", slices=4, at=1000.0))
+    entry = q.pending[("ns", "nb")]
+    assert entry.submitted_at == 1000.0
+    assert entry.seq > seq_before
+
+
+def test_overcommitted_count_is_live_not_cumulative():
+    """`overcommitted` reports the gangs CURRENTLY force-seated: a
+    rebind_fleet() re-seat of a still-overcommitted gang must not count
+    it twice, and the count drains once the fleet grows its shape back
+    (or the holder releases)."""
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"))
+    assert q.reclaim(_req(("ns", "ghost"), "ns", acc="v5p", topo="2x2x1"),
+                     now=0.0)
+    assert q.overcommitted == 1
+    q.rebind_fleet(Fleet.parse("a=v5e:4x4:2"))
+    q.rebind_fleet(Fleet.parse("a=v5e:4x4:3"))
+    assert q.overcommitted == 1  # one overcommitted gang, not three
+    # The shape returns with room: the next rebind seats it for real.
+    q.rebind_fleet(Fleet.parse("a=v5e:4x4:1,p=v5p:2x2x1:1"))
+    assert q.overcommitted == 0
+    assert q.is_admitted(("ns", "ghost"))
+    q.ledger.assert_consistent()
+
+
+# ---- the property test -------------------------------------------------------
+
+
+def _run_sequence(seed: int, record: list | None = None) -> PolicyQueue:
+    """Randomized arrival/completion/touch/schedule sequence against a
+    mixed fleet; every step checks the two hard invariants."""
+    rng = random.Random(seed)
+    fleet = Fleet.parse("a=v5e:4x4:3,b=v5e:4x4:1,c=v5p:2x2x1:2,d=v5e:2x4:2")
+    shapes = [("v5e", "4x4"), ("v5p", "2x2x1"), ("v5e", "2x4"),
+              ("v5e", "8x8")]  # 8x8 matches no pool → must queue forever
+    q = PolicyQueue(fleet=fleet, config=PolicyConfig(
+        aging_seconds=50.0, starvation_reserve_seconds=200.0,
+        idle_preempt_after_seconds=300.0))
+    live: set = set()
+    now = 0.0
+    counter = 0
+    for _ in range(220):
+        now += rng.uniform(0.1, 30.0)
+        op = rng.random()
+        if op < 0.45:
+            counter += 1
+            acc, topo = rng.choice(shapes)
+            ns = f"ns{rng.randrange(4)}"
+            key = (ns, f"nb{counter}")
+            q.submit(_req(key, ns, acc=acc, topo=topo,
+                          slices=rng.randrange(1, 4),
+                          priority=rng.choice([0, 0, 0, 100, -100]),
+                          at=now))
+            live.add(key)
+        elif op < 0.70 and live:
+            key = rng.choice(sorted(live))
+            q.release(key)
+            live.discard(key)
+        elif op < 0.85 and q.ledger.allocations:
+            key = rng.choice(sorted(q.ledger.allocations))
+            q.touch(key, now - rng.uniform(0.0, 600.0))
+        result = q.schedule(now)
+        if record is not None:
+            record.append((
+                round(now, 6),
+                sorted(a.key for a in result.admitted),
+                sorted(p.key for p in result.preempted),
+                [x.key for x in result.queue],
+            ))
+        for p in result.preempted:
+            live.discard(p.key)
+        # Invariant 1+2: admitted ≤ capacity, gangs whole, books balanced.
+        q.ledger.assert_consistent()
+        # Every admitted gang holds its FULL slice set on matching pools.
+        for alloc in q.ledger.allocations.values():
+            assert sum(alloc.placements.values()) == alloc.num_slices
+            for pool_name in alloc.placements:
+                pool = fleet.by_name(pool_name)
+                assert pool.shape_key == (alloc.accelerator,
+                                          alloc.topology)
+    assert q.ledger.violations == 0
+    return q
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7, 42, 1337])
+def test_property_random_sequences_hold_invariants(seed):
+    q = _run_sequence(seed)
+    # The impossible shape (8x8) never got admitted.
+    for alloc in q.ledger.allocations.values():
+        assert (alloc.accelerator, alloc.topology) != ("v5e", "8x8")
+
+
+def test_policy_is_deterministic():
+    a: list = []
+    b: list = []
+    _run_sequence(2024, record=a)
+    _run_sequence(2024, record=b)
+    assert a == b

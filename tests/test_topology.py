@@ -133,3 +133,67 @@ def test_diagnostics_estimates():
     s = TpuSlice.parse("v5e", "2x4")
     assert s.peak_bf16_tflops() == pytest.approx(8 * 197.0)
     assert s.allreduce_algo_bandwidth_gbps() > 0
+
+
+# ---- parse/validate edges the fleet scheduler leans on (ISSUE 5) -------------
+#
+# The fleet model (kubeflow_tpu/scheduler/fleet.py) resolves every pool
+# and every gang through these paths; a string that parses differently
+# than it schedules would corrupt the chip ledger.
+
+
+def test_parse_topology_malformed_edges():
+    for bad in ("", "x", "4x", "x4", "4xx4", "-2x2", "2.5x4", "4 x 4",
+                "0x0", "2x-1x2"):
+        with pytest.raises(TopologyError):
+            parse_topology(bad)
+    # Case-insensitive on the axis separator; the parsed grid is canonical.
+    assert parse_topology("4X4") == (4, 4)
+    assert TpuSlice.parse("V5E", "4X4").topology_str == "4x4"
+
+
+def test_nondivisible_host_grids_per_accelerator():
+    # v5e hosts are 2x4: axis 0 must tile by 2, axis 1 by 4.
+    with pytest.raises(TopologyError, match="multiple"):
+        TpuSlice.parse("v5e", "2x6")
+    with pytest.raises(TopologyError, match="multiple"):
+        TpuSlice.parse("v5e", "2x10")
+    # ...but 6x4 (axis 0 = 3 hosts of 2) tiles legally, undocumented.
+    assert TpuSlice.parse("v5e", "6x4").num_hosts == 3
+    # v4 hosts are 2x2x1: 2x3x2 (12 chips > 4/host) breaks axis 1.
+    with pytest.raises(TopologyError, match="multiple"):
+        TpuSlice.parse("v4", "2x3x2")
+    # v6e shares the 2x4 host grid with v5e.
+    with pytest.raises(TopologyError, match="multiple"):
+        TpuSlice.parse("v6e", "4x6")
+
+
+def test_accelerator_type_on_single_host_v5e():
+    # Sub-host and exactly-one-host v5e slices: accelerator_type counts
+    # CORES with the v5litepod prefix (1 core/chip on v5e), and the
+    # scheduler's chips-per-slice accounting matches num_chips exactly.
+    for topo, chips in (("1x1", 1), ("2x2", 4), ("2x4", 8)):
+        s = TpuSlice.parse("v5e", topo)
+        assert s.num_hosts == 1 and not s.multi_host
+        assert s.num_chips == chips
+        assert s.accelerator_type == f"v5litepod-{chips}"
+        assert s.resource_requests() == {"google.com/tpu": str(chips)}
+
+
+def test_multislice_parse_bounds():
+    from kubeflow_tpu.tpu.topology import MultiSlice
+
+    # Inclusive bounds: 1 and 64 parse; 0, negatives, and 65 do not.
+    assert MultiSlice.parse("v5e", "4x4", 1).num_slices == 1
+    assert MultiSlice.parse("v5e", "4x4", 64).total_hosts == 128
+    for bad in (0, -1, 65):
+        with pytest.raises(TopologyError):
+            MultiSlice.parse("v5e", "4x4", bad)
+    # Booleans are ints in Python — explicitly rejected, not truthy-coerced.
+    with pytest.raises(TopologyError, match="positive int"):
+        MultiSlice.parse("v5e", "4x4", True)
+    with pytest.raises(TopologyError, match="positive int"):
+        MultiSlice.parse("v5e", "4x4", "2")
+    # A bad slice shape surfaces through MultiSlice.parse too.
+    with pytest.raises(TopologyError):
+        MultiSlice.parse("v5e", "3x4", 2)
