@@ -915,6 +915,250 @@ def scheduler_scale(smoke: bool = False) -> dict:
     }
 
 
+def migration_roundtrip(smoke: bool = False) -> dict:
+    """`bench.py migration_roundtrip [--smoke]` — the preempt-to-
+    checkpoint acceptance gate (ISSUE 7). For each gang size: an idle
+    victim holds the whole fleet, a high-priority gang arrives, and the
+    driver measures the full migration loop — drain requested →
+    checkpoint ack (simulated SDK) → victim parked → waiter admitted →
+    waiter done → victim re-admitted with its restore hint in the pod
+    env. Chip-free: FakeKube + podsim + the real manager/controller/
+    scheduler stack with migration enabled exactly as KFTPU_MIGRATION=on
+    wires it.
+
+    Reported per gang size: roundtrip p50/p95 (high-pri create → victim
+    restored), drain→ack→admit latency, and the gates: every loop
+    completes, zero ledger violations, zero grace-deadline fallbacks
+    (the simulated SDK always acks — a fallback means the protocol lost
+    an ack)."""
+    import time as _time
+
+    from kubeflow_tpu.api import notebook as nbapi
+    from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+    from kubeflow_tpu.migration import protocol as migration
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.objects import deep_get, fmt_iso
+    from kubeflow_tpu.scheduler import (
+        Fleet,
+        SchedulerOptions,
+        TpuFleetScheduler,
+    )
+    from kubeflow_tpu.testing.fakekube import FakeKube
+    from kubeflow_tpu.testing.podsim import PodSimulator
+    from kubeflow_tpu.webhooks import register_all
+
+    gang_sizes = [1, 2] if smoke else [1, 2, 4]
+    reps = 2 if smoke else 5
+    phase_timeout = 30.0
+
+    async def wait_for(predicate, what: str):
+        deadline = time.perf_counter() + phase_timeout
+        while True:
+            value = await predicate()
+            if value:
+                return value
+            if time.perf_counter() > deadline:
+                raise RuntimeError(f"migration_roundtrip: timed out "
+                                   f"waiting for {what}")
+            await asyncio.sleep(0.01)
+
+    async def sdk_ack_loop(kube, stop_flag, acked):
+        """The simulated in-pod SDK: polls every notebook's annotations
+        (the real SDK polls its own CR) and acks any un-acked drain with
+        a committed-checkpoint patch, exactly the shape
+        sdk.CheckpointGuard stamps."""
+        while not stop_flag[0]:
+            try:
+                nbs = await kube.list("Notebook")
+            except Exception:
+                nbs = []
+            for nb in nbs:
+                ann = (nb.get("metadata") or {}).get("annotations") or {}
+                name = nb["metadata"]["name"]
+                ns = nb["metadata"].get("namespace")
+                if (migration.drain_requested_at(ann) is not None
+                        and not migration.drain_acked(ann)
+                        and nbapi.STOP_ANNOTATION not in ann):
+                    step = acked.get((ns, name), 0) + 100
+                    acked[(ns, name)] = step
+                    try:
+                        await kube.patch(
+                            "Notebook", name,
+                            {"metadata": {"annotations": migration.ack_patch(
+                                f"/home/jovyan/ckpt/{name}", step,
+                                _time.time(),
+                                for_request=ann.get(
+                                    nbapi.DRAIN_REQUESTED_ANNOTATION))}},
+                            ns)
+                    except Exception:
+                        pass
+            await asyncio.sleep(0.005)
+
+    async def one_size(num_slices: int) -> dict:
+        kube = FakeKube()
+        register_all(kube)
+        mgr = Manager(kube)
+        sched = TpuFleetScheduler(
+            kube,
+            SchedulerOptions(
+                queued_requeue_seconds=0.05,
+                idle_preempt_after_seconds=0.2,
+                enable_migration=True,
+                drain_grace_seconds=15.0,
+            ),
+            fleet=Fleet.parse(f"pool-a=v5e:4x4:{num_slices}"),
+            registry=mgr.registry,
+        )
+        setup_notebook_controller(mgr, scheduler=sched)
+        sim = PodSimulator(kube)
+        await mgr.start()
+        await sim.start()
+        stop_flag = [False]
+        acked: dict = {}
+        ack_task = asyncio.create_task(sdk_ack_loop(kube, stop_flag, acked))
+        roundtrips: list[float] = []
+        drain_to_admit: list[float] = []
+        try:
+            for r in range(reps):
+                victim, urgent = f"victim-{r}", f"urgent-{r}"
+
+                async def get(name):
+                    return await kube.get_or_none("Notebook", name, "bench")
+
+                await kube.create("Notebook", nbapi.new(
+                    victim, "bench", accelerator="v5e", topology="4x4",
+                    num_slices=num_slices))
+
+                async def victim_admitted():
+                    return _admitted(sched, ("bench", victim))
+                await wait_for(victim_admitted, f"{victim} admitted")
+                await mgr.wait_idle(timeout=20)
+                # Idle signal: culling says the victim has been idle for
+                # an hour; the admitted-at floor keeps the window honest.
+                await kube.patch(
+                    "Notebook", victim,
+                    {"metadata": {"annotations": {
+                        nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(
+                            _time.time() - 3600)}}}, "bench")
+                await asyncio.sleep(0.25)
+                mgr.enqueue("notebook", ("bench", victim))
+                await mgr.wait_idle(timeout=20)
+
+                t0 = time.perf_counter()
+                await kube.create("Notebook", {
+                    **nbapi.new(urgent, "bench", accelerator="v5e",
+                                topology="4x4", num_slices=num_slices),
+                    "metadata": {"name": urgent, "namespace": "bench",
+                                 "annotations": {
+                                     nbapi.PRIORITY_ANNOTATION: "high"}},
+                })
+
+                async def drained():
+                    nb = await get(victim)
+                    ann = (nb or {}).get("metadata", {}).get(
+                        "annotations") or {}
+                    return migration.drain_requested_at(ann) is not None \
+                        or nbapi.STOP_ANNOTATION in ann
+                await wait_for(drained, f"{victim} drain request")
+                t_drain = time.perf_counter()
+
+                async def urgent_admitted():
+                    return _admitted(sched, ("bench", urgent))
+                await wait_for(urgent_admitted, f"{urgent} admitted")
+                drain_to_admit.append(time.perf_counter() - t_drain)
+
+                async def victim_parked():
+                    nb = await get(victim)
+                    ann = (nb or {}).get("metadata", {}).get(
+                        "annotations") or {}
+                    return nbapi.STOP_ANNOTATION in ann \
+                        and nbapi.CHECKPOINT_PATH_ANNOTATION in ann
+                await wait_for(victim_parked, f"{victim} parked")
+
+                # The waiter finishes; the victim comes back and restores.
+                await kube.patch(
+                    "Notebook", urgent,
+                    {"metadata": {"annotations": {
+                        nbapi.STOP_ANNOTATION: fmt_iso(_time.time())}}},
+                    "bench")
+                await mgr.wait_idle(timeout=20)
+                await kube.patch(
+                    "Notebook", victim,
+                    {"metadata": {"annotations": {
+                        nbapi.STOP_ANNOTATION: None}}}, "bench")
+
+                async def victim_restored():
+                    if not _admitted(sched, ("bench", victim)):
+                        return False
+                    sts = await kube.get_or_none(
+                        "StatefulSet",
+                        victim if num_slices == 1 else f"{victim}-s0",
+                        "bench")
+                    env = deep_get(
+                        sts or {}, "spec", "template", "spec",
+                        "containers", default=[{}])[0].get("env", [])
+                    return any(e.get("name") == migration.RESTORE_PATH_ENV
+                               for e in env)
+                await wait_for(victim_restored, f"{victim} restored")
+                roundtrips.append(time.perf_counter() - t0)
+
+                # Park before deleting: a delete racing an in-flight
+                # reconcile's child update is normal (workqueue retries),
+                # but the released-first order keeps bench logs clean.
+                await kube.patch(
+                    "Notebook", victim,
+                    {"metadata": {"annotations": {
+                        nbapi.STOP_ANNOTATION: fmt_iso(_time.time())}}},
+                    "bench")
+
+                async def fleet_empty():
+                    return not sched.policy.ledger.allocations
+                await wait_for(fleet_empty, "fleet drained between reps")
+                await mgr.wait_idle(timeout=20)
+                for name in (victim, urgent):
+                    await kube.delete("Notebook", name, "bench")
+                await mgr.wait_idle(timeout=20)
+            sched.policy.ledger.assert_consistent()
+            fallbacks = sched.m_drain_fallback.labels().value
+            return {
+                "gang_slices": num_slices,
+                "reps": reps,
+                "roundtrip_p50_sec": round(
+                    _percentile(sorted(roundtrips), 0.50), 4),
+                "roundtrip_p95_sec": round(
+                    _percentile(sorted(roundtrips), 0.95), 4),
+                "drain_to_admit_p50_sec": round(
+                    _percentile(sorted(drain_to_admit), 0.50), 4),
+                "ledger_violations": sched.policy.ledger.violations,
+                "grace_fallbacks": fallbacks,
+            }
+        finally:
+            stop_flag[0] = True
+            ack_task.cancel()
+            try:
+                await ack_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await sim.stop()
+            await mgr.stop()
+            kube.close_watches()
+
+    def _admitted(sched, key) -> bool:
+        alloc = sched.policy.ledger.allocations.get(key)
+        return alloc is not None and not alloc.draining
+
+    sizes = [asyncio.run(one_size(n)) for n in gang_sizes]
+    ok = bool(sizes) and all(
+        s["ledger_violations"] == 0 and s["grace_fallbacks"] == 0
+        for s in sizes)
+    return {
+        "metric": "migration_roundtrip",
+        "smoke": smoke,
+        "sizes": sizes,
+        "pass": ok,
+    }
+
+
 def tracing_overhead() -> dict:
     """`bench.py tracing_overhead` — prove the always-on tracing path
     (span trees + flight recorder + API-call tagging, PR 3) costs <5% of
@@ -1179,6 +1423,13 @@ if __name__ == "__main__":
         # This subcommand is a CI gate (unit-tests workflow): the
         # fairness/ledger/preemption criteria must fail the step, not
         # just flip a field in the printed JSON.
+        if not result["pass"]:
+            sys.exit(1)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "migration_roundtrip":
+        result = migration_roundtrip(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(result))
+        # CI gate like scheduler_scale: a lost ack (grace fallback) or a
+        # ledger violation must fail the step.
         if not result["pass"]:
             sys.exit(1)
     else:

@@ -57,6 +57,20 @@ SCHEDULER_GATE_RE = re.compile(r"await self\._scheduler_gate\(")
 SCHEDULER_GATE_DEF_RE = re.compile(r"async def _scheduler_gate\(")
 SCHEDULER_CONSULT_RE = re.compile(r"\.(admission|release)\(")
 
+# Migration contract (ISSUE 7): preemption must route through the drain
+# protocol when migration is enabled — a refactor that silently reverts
+# to the bare stop-annotation would lose in-flight training state on
+# every preemption. The runtime must register the migration phases so
+# /debug/traces shows the drain round trip, and the policy layer must
+# keep the deferred-preemption mode the runtime switches on.
+MIGRATION_PROTOCOL = os.path.join(
+    REPO, "kubeflow_tpu", "migration", "protocol.py")
+MIGRATION_PHASES = ("drain", "checkpoint_ack", "restore")
+REQUEST_DRAIN_RE = re.compile(r"await self\._request_drain\(")
+DRAINS_ROUTE_RE = re.compile(r"result,\s*\"drains\"|result\.drains")
+POLICY_FILE = os.path.join(REPO, "kubeflow_tpu", "scheduler", "policy.py")
+DEFERRED_RE = re.compile(r"deferred_preemption")
+
 
 def check_scheduler() -> list[str]:
     problems = []
@@ -86,6 +100,42 @@ def check_scheduler() -> list[str]:
         problems.append(
             f"{rel_nb}: _scheduler_gate no longer consults the scheduler "
             "(.admission()/.release()) — the gate is a stub")
+    return problems
+
+
+def check_migration() -> list[str]:
+    problems = []
+    rel_proto = os.path.relpath(MIGRATION_PROTOCOL, REPO)
+    if not os.path.exists(MIGRATION_PROTOCOL):
+        return [f"{rel_proto}: missing — the drain/checkpoint/restore "
+                "protocol is the migration subsystem's wire contract "
+                "(ISSUE 7)"]
+    rel_rt = os.path.relpath(SCHEDULER_RUNTIME, REPO)
+    try:
+        src = open(SCHEDULER_RUNTIME).read()
+    except OSError:
+        return [f"{rel_rt}: missing"]
+    phases = set(SPAN_RE.findall(src))
+    for phase in MIGRATION_PHASES:
+        if phase not in phases:
+            problems.append(
+                f"{rel_rt}: missing the `{phase}` migration phase span — "
+                "drain round trips must land in the reconcile trace tree")
+    if not REQUEST_DRAIN_RE.search(src) or not DRAINS_ROUTE_RE.search(src):
+        problems.append(
+            f"{rel_rt}: the preempt path no longer routes policy drain "
+            "verdicts through _request_drain — with migration enabled, "
+            "victims would be bare-stopped and lose in-flight training "
+            "state (silent migration bypass)")
+    try:
+        policy_src = open(POLICY_FILE).read()
+    except OSError:
+        policy_src = ""
+    if not DEFERRED_RE.search(policy_src):
+        problems.append(
+            f"{os.path.relpath(POLICY_FILE, REPO)}: deferred_preemption "
+            "mode is gone — the runtime has no way to hold chips while a "
+            "victim checkpoints")
     return problems
 
 
@@ -135,6 +185,7 @@ def main() -> int:
         if fname.endswith(".py"):
             problems.extend(check_file(os.path.join(CONTROLLERS_DIR, fname)))
     problems.extend(check_scheduler())
+    problems.extend(check_migration())
     for p in problems:
         print(f"check_tracing: {p}", file=sys.stderr)
     if not problems:

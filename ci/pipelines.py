@@ -109,6 +109,10 @@ def workflow_tests() -> dict:
                         "idle preemption)",
                         "python bench.py scheduler_scale --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("Migration smoke bench (drain → checkpoint → "
+                        "restore roundtrip)",
+                        "python bench.py migration_roundtrip --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

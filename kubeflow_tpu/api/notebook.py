@@ -94,6 +94,32 @@ SCHEDULER_ADMITTED_AT_ANNOTATION = "notebooks.kubeflow.org/admitted-at"
 #   scheduler preempts the gang; cleared on re-admission.
 PREEMPTED_ANNOTATION = "notebooks.kubeflow.org/preempted"
 
+# Migration contract (kubeflow_tpu/migration/protocol.py): preemption,
+# culling, and user suspend all speak one drain protocol — request a
+# checkpoint, wait for the in-pod SDK's ack, then park. The SDK reads
+# these through the same in-cluster CR fetch as MAINTENANCE_ANNOTATION.
+# - stamped (ISO time) by whoever wants the gang parked; the SDK polls
+#   it and checkpoints when it appears;
+DRAIN_REQUESTED_ANNOTATION = "notebooks.kubeflow.org/drain-requested"
+# - why the drain was requested: "preempt:idle" | "preempt:priority" |
+#   "cull" | "suspend" — the finalizer (scheduler, culler, notebook
+#   controller) only acts on its own reasons;
+DRAIN_REASON_ANNOTATION = "notebooks.kubeflow.org/drain-reason"
+# - SDK progress marks: snapshot started / committed. An ack echoes the
+#   drain request it answers (checkpointed-for = the raw drain-requested
+#   value), so ack detection never compares timestamps stamped by two
+#   different clocks (controller vs pod).
+CHECKPOINTING_AT_ANNOTATION = "notebooks.kubeflow.org/checkpointing-at"
+CHECKPOINTED_AT_ANNOTATION = "notebooks.kubeflow.org/checkpointed-at"
+CHECKPOINTED_FOR_ANNOTATION = "notebooks.kubeflow.org/checkpointed-for"
+# - the durable restore hint the controller turns into pod env
+#   (KFTPU_RESTORE_CHECKPOINT_PATH / KFTPU_RESTORE_STEP) on re-admission.
+CHECKPOINT_PATH_ANNOTATION = "notebooks.kubeflow.org/checkpoint-path"
+CHECKPOINT_STEP_ANNOTATION = "notebooks.kubeflow.org/checkpoint-step"
+# - user-facing suspend/resume: present → drain-then-park; removed →
+#   un-park and restore. Set by kubectl/JWA or sdk.suspend().
+SUSPEND_ANNOTATION = "notebooks.kubeflow.org/suspend"
+
 # Pod-template annotations the controller stamps so pod-level admission can
 # compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
 TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"

@@ -40,8 +40,14 @@ def notebook_options():
         NotebookOptions,
     )
 
+    from kubeflow_tpu.migration import protocol as migration
+
     return NotebookOptions(
         use_istio=env_bool("USE_ISTIO", False),
+        # Preempt-to-checkpoint (docs/operations.md "Migration"): drives
+        # suspend/resume, restore-hint env, and status.migration.
+        enable_migration=migration.migration_enabled(),
+        drain_grace_seconds=migration.drain_grace_seconds(),
         istio_gateway=env_str("ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"),
         istio_host=env_str("ISTIO_HOST", "*"),
         cluster_domain=env_str("CLUSTER_DOMAIN", "cluster.local"),
@@ -69,6 +75,7 @@ def scheduler_options():
     """Fleet-scheduler env contract (docs/operations.md "TPU fleet
     scheduler"). The on/off switch itself is KFTPU_SCHEDULER, read by
     kubeflow_tpu.scheduler.scheduler_enabled."""
+    from kubeflow_tpu.migration import protocol as migration
     from kubeflow_tpu.scheduler.runtime import SchedulerOptions
 
     weights: dict[str, float] = {}
@@ -93,11 +100,19 @@ def scheduler_options():
             "KFTPU_SCHEDULER_IDLE_AFTER_SECONDS", 1800.0),
         queued_requeue_seconds=env_float(
             "KFTPU_SCHEDULER_QUEUED_REQUEUE_SECONDS", 10.0),
+        # Preempt-to-checkpoint (KFTPU_MIGRATION, default on): preemption
+        # drains victims and frees chips only on the checkpoint ack or
+        # the KFTPU_DRAIN_GRACE deadline. The dataclass default is off so
+        # bare construction keeps immediate-stop semantics; production
+        # gets it from here.
+        enable_migration=migration.migration_enabled(),
+        drain_grace_seconds=migration.drain_grace_seconds(),
     )
 
 
 def culling_options():
     from kubeflow_tpu.controllers.culling import CullingOptions
+    from kubeflow_tpu.migration import protocol as migration
 
     return CullingOptions(
         enable_culling=env_bool("ENABLE_CULLING", False),
@@ -105,6 +120,12 @@ def culling_options():
         check_period_seconds=env_float("IDLENESS_CHECK_PERIOD", 1.0) * 60.0,
         cluster_domain=env_str("CLUSTER_DOMAIN", "cluster.local"),
         dev_url=os.environ.get("CULLER_DEV_URL"),
+        # Checkpoint-then-stop for idle culls: needs BOTH the master
+        # migration switch and the culling-specific KFTPU_CULL_DRAIN
+        # (default on) — =off restores the bare stop.
+        drain_on_cull=(migration.migration_enabled()
+                       and migration.cull_drain_enabled()),
+        drain_grace_seconds=migration.drain_grace_seconds(),
     )
 
 

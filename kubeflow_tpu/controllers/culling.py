@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Awaitable, Callable
 
 from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.migration import protocol as migration
 from kubeflow_tpu.runtime.errors import ApiError
 from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.manager import Controller, Manager, Result
@@ -70,6 +71,14 @@ class CullingOptions:
     cluster_domain: str = "cluster.local"
     dev_url: str | None = None                 # DEV mode: probe localhost instead
     notebook_port: int = nbapi.DEFAULT_CONTAINER_PORT  # direct pod probes
+    # Preempt-to-checkpoint reuse (kubeflow_tpu/migration): an idle cull
+    # of a TPU notebook requests checkpoint-then-stop instead of a bare
+    # stop, so culled servers resume where they left off. The DATACLASS
+    # default is off (bare construction = pre-migration behavior); the
+    # env wiring (KFTPU_CULL_DRAIN under KFTPU_MIGRATION, both default
+    # on) turns it on in production.
+    drain_on_cull: bool = False
+    drain_grace_seconds: float = migration.DEFAULT_DRAIN_GRACE_SECONDS
 
 
 class CullingReconciler:
@@ -157,6 +166,14 @@ class CullingReconciler:
             return None  # already parked; notebook reconciler owns restart
 
         now = self.clock()
+        drain_annotations = get_meta(nb).get("annotations") or {}
+        if migration.drain_requested_at(drain_annotations) is not None:
+            # A drain is in flight. Ours ("cull") is driven to its stop
+            # here; anyone else's (preemption, suspend) owns the park —
+            # probing/culling under it would race the finalizer.
+            if migration.drain_reason(drain_annotations) == "cull":
+                return await self._drive_cull_drain(nb, name, ns, now)
+            return requeue
         with span("probe"):
             urls = await self._probe_urls(nb, name, ns)
             if urls is None:
@@ -211,24 +228,37 @@ class CullingReconciler:
 
         with span("status"):
             if not busy and now - last_activity > self.opts.cull_idle_seconds:
-                patch_annotations[nbapi.STOP_ANNOTATION] = _fmt_time(now)
-                try:
-                    await self.kube.patch(
-                        "Notebook", name,
-                        {"metadata": {"annotations": patch_annotations}}, ns,
-                    )
-                except ApiError:
+                if (self.opts.drain_on_cull
+                        and nbapi.tpu_spec_of(nb) is not None):
+                    # Checkpoint-then-stop (kubeflow_tpu/migration): ask
+                    # the in-pod SDK to snapshot first, so the culled
+                    # server resumes where it left off. The stop lands in
+                    # _drive_cull_drain on the ack — or on the grace
+                    # deadline for servers that never ack (no SDK loop
+                    # running), which restores plain culling, just
+                    # delayed by the grace. KFTPU_CULL_DRAIN=off skips
+                    # this branch entirely.
+                    patch_annotations.update(
+                        migration.request_drain_patch("cull", now))
+                    try:
+                        await self.kube.patch(
+                            "Notebook", name,
+                            {"metadata": {"annotations": patch_annotations}},
+                            ns)
+                    except ApiError:
+                        return requeue
+                    await self.recorder.event(
+                        nb, "Normal", "CullDrainRequested",
+                        f"Notebook idle for "
+                        f"{(now - last_activity) / 60:.0f} min; "
+                        "checkpointing before scale-to-zero (grace "
+                        f"{self.opts.drain_grace_seconds:.0f}s)")
+                    return Result(requeue_after=min(
+                        self.opts.check_period_seconds,
+                        self.opts.drain_grace_seconds + 0.1))
+                if not await self._cull_stop(nb, name, ns, now,
+                                             patch_annotations):
                     return requeue
-                idle_min = (now - last_activity) / 60
-                await self.recorder.event(
-                    nb, "Normal", "NotebookCulled",
-                    f"Notebook idle for {idle_min:.0f} min; scaled to zero",
-                )
-                self.m_culled.inc()
-                self.m_last_cull.labels(namespace=ns or "", notebook=name).set(now)
-                chips = deep_get(nb, "status", "tpu", "chips", default=0) or 0
-                if chips:
-                    self.m_chips_culled.inc(chips)
                 return None  # parked; nothing to poll until restarted
             if any(annotations.get(k) != v for k, v in patch_annotations.items()):
                 try:
@@ -239,6 +269,93 @@ class CullingReconciler:
                 except ApiError:
                     pass
         return requeue
+
+    async def _cull_stop(self, nb: dict, name: str, ns: str, now: float,
+                         extra_annotations: dict | None = None,
+                         *, checkpoint_step: int | None = None) -> bool:
+        """The one place an idle cull actually parks a notebook — shared
+        by the bare-stop path and the drain finalizer so the bookkeeping
+        (event, counters, reclaimed-chip metric) can't drift."""
+        annotations = dict(extra_annotations or {})
+        annotations[nbapi.STOP_ANNOTATION] = _fmt_time(now)
+        try:
+            await self.kube.patch(
+                "Notebook", name,
+                {"metadata": {"annotations": annotations}}, ns)
+        except ApiError:
+            return False
+        last = _parse_time(
+            (get_meta(nb).get("annotations") or {}).get(
+                nbapi.LAST_ACTIVITY_ANNOTATION, "")) or now
+        idle_min = max(0.0, now - last) / 60
+        note = (f"; resumes from checkpoint @ step {checkpoint_step}"
+                if checkpoint_step is not None else "")
+        await self.recorder.event(
+            nb, "Normal", "NotebookCulled",
+            f"Notebook idle for {idle_min:.0f} min; scaled to zero{note}")
+        self.m_culled.inc()
+        self.m_last_cull.labels(namespace=ns or "", notebook=name).set(now)
+        chips = deep_get(nb, "status", "tpu", "chips", default=0) or 0
+        if chips:
+            self.m_chips_culled.inc(chips)
+        return True
+
+    async def _drive_cull_drain(self, nb: dict, name: str, ns: str,
+                                now: float) -> Result | None:
+        """Finalize a cull-owned drain: stop on the checkpoint ack, or on
+        the grace deadline for a server that never acks — UNLESS the user
+        came back: the grace window is exactly the span the pre-migration
+        code never had, so busyness is re-probed every pass and a busy
+        kernel cancels the drain instead of parking an actively-used
+        server. The drain marks clear with the stop; the checkpoint
+        path/step annotations stay — they are the restore hint a later
+        restart rides."""
+        urls = await self._probe_urls(nb, name, ns)
+        if urls is not None:
+            kernels = await self.prober(urls["kernels"])
+            if kernels is not None:
+                busy, _ = _fold_activity(kernels, [])
+                if busy:
+                    try:
+                        await self.kube.patch(
+                            "Notebook", name,
+                            {"metadata": {"annotations": {
+                                **migration.clear_drain_patch(),
+                                nbapi.LAST_ACTIVITY_ANNOTATION:
+                                    _fmt_time(now),
+                            }}}, ns)
+                    except ApiError:
+                        pass
+                    else:
+                        await self.recorder.event(
+                            nb, "Normal", "CullDrainCancelled",
+                            "Activity detected during the checkpoint "
+                            "grace window; cull cancelled")
+                    return Result(
+                        requeue_after=self.opts.check_period_seconds)
+        annotations = get_meta(nb).get("annotations") or {}
+        acked = migration.drain_acked(annotations)
+        expired = migration.drain_expired(
+            annotations, now, self.opts.drain_grace_seconds)
+        if not (acked or expired):
+            deadline = migration.drain_deadline(
+                annotations, self.opts.drain_grace_seconds) or now
+            return Result(requeue_after=min(
+                self.opts.check_period_seconds,
+                max(0.1, deadline - now + 0.05)))
+        step = migration.checkpoint_step(annotations) if acked else None
+        if not acked:
+            await self.recorder.event(
+                nb, "Warning", "CullDrainDeadlineExceeded",
+                f"No checkpoint ack within "
+                f"{self.opts.drain_grace_seconds:.0f}s; culling without "
+                "a fresh checkpoint")
+        if not await self._cull_stop(
+                nb, name, ns, now,
+                migration.clear_drain_patch(keep_reason=True),
+                checkpoint_step=step):
+            return Result(requeue_after=self.opts.check_period_seconds)
+        return None
 
 
 def _fold_activity(kernels: list, terminals: list) -> tuple[bool, float | None]:

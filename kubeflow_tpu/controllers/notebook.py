@@ -64,6 +64,7 @@ from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.runtime.objects import (
     annotations_of,
     deep_get,
+    fmt_iso,
     get_meta,
     name_of,
     namespace_of,
@@ -72,6 +73,7 @@ from kubeflow_tpu.runtime.objects import (
     uid_of,
 )
 from kubeflow_tpu.runtime.tracing import span
+from kubeflow_tpu.migration import protocol as migration
 from kubeflow_tpu.tpu.topology import JAX_COORDINATOR_PORT, TpuSlice
 
 log = logging.getLogger(__name__)
@@ -183,6 +185,14 @@ class NotebookOptions:
     # the window folds the burst into one reconcile. Small enough to be
     # invisible in ready-latency percentiles. 0 disables.
     coalesce_window: float = 0.005
+
+    # Preempt-to-checkpoint (kubeflow_tpu/migration): drives the
+    # annotation-driven suspend/resume flow, the restore-hint pod env,
+    # and the status.migration block. Safe on by default — all three are
+    # no-ops until a drain/checkpoint annotation exists. The scheduler's
+    # own drain path has its own switch (SchedulerOptions/KFTPU_MIGRATION).
+    enable_migration: bool = True
+    drain_grace_seconds: float = migration.DEFAULT_DRAIN_GRACE_SECONDS
 
 
 AUTH_PROXY_ANNOTATION = "notebooks.kubeflow.org/inject-auth-proxy"
@@ -306,6 +316,14 @@ class NotebookReconciler:
             return None
         tpu = ms.slice if ms else None
 
+        # User-facing suspend/resume rides the same drain protocol as
+        # scheduler preemption (kubeflow_tpu/migration). Runs before the
+        # children phase: a suspend that just acked must park THIS
+        # reconcile's StatefulSets, and a resume must un-park before the
+        # scheduler gate re-arbitrates. Patches annotations only; the
+        # resulting watch event drives the follow-up reconcile.
+        suspend_requeue = await self._check_suspend(nb, ms)
+
         with span("apply"):
             capacity_pending, capacity_requeue, admission = \
                 await self._apply_children(nb, ms, tpu)
@@ -326,7 +344,17 @@ class NotebookReconciler:
                                       admission=admission)
         if capacity_pending:
             return capacity_requeue
-        return requeue
+        if admission is not None and admission.state == "Draining" \
+                and admission.requeue_after:
+            # A draining victim must reconcile again by the grace
+            # deadline even if the SDK never acks — the scheduler's
+            # hard-stop fallback fires on that pass.
+            return _soonest(Result(requeue_after=admission.requeue_after),
+                            requeue)
+        # Soonest wins: a pending suspend drain's grace deadline must not
+        # be deferred behind a longer periodic requeue from the status
+        # tail (or vice versa).
+        return _soonest(requeue, suspend_requeue)
 
     async def _apply_children(
         self, nb: dict, ms, tpu
@@ -461,6 +489,109 @@ class NotebookReconciler:
         return existing is not None and (
             deep_get(existing, "spec", "replicas") or 0) > 0
 
+    async def _check_suspend(self, nb: dict, ms) -> Result | None:
+        """Annotation-driven suspend/resume over the drain protocol
+        (kubeflow_tpu/migration). Suspend = the SUSPEND annotation
+        appears: request a drain (reason ``suspend``), wait for the
+        in-pod SDK's checkpoint ack (bounded by the drain grace), then
+        park via the stop annotation — so "suspend" is "stop, but my
+        training state survives". Resume = the annotation is removed:
+        a parked suspend un-parks (the scheduler re-arbitrates and the
+        restore hint rides the pod env); a still-draining suspend is
+        cancelled. CPU notebooks (no slice, nothing to checkpoint) and
+        migration-off park immediately — the pre-migration stop."""
+        annotations = annotations_of(nb)
+        suspended = nbapi.SUSPEND_ANNOTATION in annotations
+        stopped = nbapi.is_stopped(nb)
+        reason = migration.drain_reason(annotations)
+        ns, name = namespace_of(nb), name_of(nb)
+        now = self._now()
+
+        async def patch(anns: dict) -> None:
+            await self.kube.patch(
+                "Notebook", name, {"metadata": {"annotations": anns}}, ns)
+
+        if suspended and not stopped:
+            if not (self.opts.enable_migration and ms
+                    and await self._gang_running(nb, ms)):
+                # Nothing to checkpoint: CPU notebook, migration off, or
+                # a gang with no running pods (queued, provisioning,
+                # parked mid-restart) — park immediately; waiting out
+                # the drain grace would only delay the stop and emit a
+                # spurious deadline warning.
+                await patch({nbapi.STOP_ANNOTATION: fmt_iso(now)})
+                await self.recorder.event(
+                    nb, "Normal", "Suspended", "Suspended (no checkpoint)")
+                return None
+            requested = migration.drain_requested_at(annotations)
+            if requested is None:
+                await patch(migration.request_drain_patch("suspend", now))
+                await self.recorder.event(
+                    nb, "Normal", "SuspendRequested",
+                    "Suspend requested; checkpointing before parking "
+                    f"(grace {self.opts.drain_grace_seconds:.0f}s)")
+                return Result(requeue_after=self.opts.drain_grace_seconds
+                              + 0.1)
+            if reason != "suspend":
+                return None  # a preemption drain owns the marks; its
+                             # park satisfies the suspend too
+            deadline = requested + self.opts.drain_grace_seconds
+            # The park keeps DRAIN_REASON="suspend" as the durable "how
+            # it parked" marker — resume (annotation removed while
+            # stopped) and derive_state's Parked gate key off it; the
+            # request/progress marks clear.
+            park_clear = migration.clear_drain_patch(keep_reason=True)
+            if migration.drain_acked(annotations):
+                await patch({nbapi.STOP_ANNOTATION: fmt_iso(now),
+                             **park_clear})
+                step = migration.checkpoint_step(annotations)
+                await self.recorder.event(
+                    nb, "Normal", "Suspended",
+                    "Suspended"
+                    + (f" (checkpoint @ step {step})"
+                       if step is not None else " (checkpoint committed)"))
+                return None
+            if now >= deadline:
+                await patch({nbapi.STOP_ANNOTATION: fmt_iso(now),
+                             **park_clear})
+                await self.recorder.event(
+                    nb, "Warning", "SuspendDeadlineExceeded",
+                    f"No checkpoint ack within "
+                    f"{self.opts.drain_grace_seconds:.0f}s; suspended "
+                    "without a fresh checkpoint")
+                return None
+            return Result(requeue_after=max(0.1, deadline - now + 0.05))
+
+        if not suspended and reason == "suspend":
+            if stopped:
+                # Resume: un-park; the scheduler gate re-arbitrates and
+                # generate_statefulset stamps the restore hint.
+                await patch({nbapi.STOP_ANNOTATION: None,
+                             **migration.clear_drain_patch()})
+                hint = migration.restore_hint(annotations)
+                await self.recorder.event(
+                    nb, "Normal", "Resuming",
+                    "Resuming"
+                    + (f" from checkpoint {hint[0]}"
+                       + (f" @ step {hint[1]}"
+                          if hint[1] is not None else "")
+                       if hint else " (no checkpoint recorded)"))
+            else:
+                # Suspend cancelled mid-drain: drop the request so the
+                # SDK stops checkpointing for a park that isn't coming.
+                await patch(migration.clear_drain_patch())
+            return None
+        if (not stopped and reason and reason != "suspend"
+                and migration.drain_requested_at(annotations) is None):
+            # Parked-marker hygiene without a scheduler: a cull/preempt
+            # park keeps its drain-reason so derive_state can tell a
+            # checkpointed park from a plain stop. The fleet scheduler
+            # clears it on re-admission; on scheduler-less clusters this
+            # is the restart path that does — otherwise a later plain
+            # stop would present as "Suspended (checkpoint @ step N)".
+            await patch({nbapi.DRAIN_REASON_ANNOTATION: None})
+        return None
+
     async def _apply_children_stages(
         self, nb: dict, ms, tpu, num_sts: int, capacity_provisioned: bool,
         created_slices: list[str],
@@ -539,6 +670,8 @@ class NotebookReconciler:
             sts = self.generate_statefulset(
                 nb, tpu, multi=ms, slice_id=slice_id,
                 capacity_provisioned=capacity_provisioned)
+        if self.opts.enable_migration:
+            await self._stabilize_restore_env(nb, sts)
         if not capacity_provisioned:
             # Sticky consume annotation: when the request is (or has
             # become) unprovisioned over a LIVE gang — e.g. the PR was
@@ -579,6 +712,30 @@ class NotebookReconciler:
         if self._sts_informer is not None:
             return self._sts_informer.get(name, ns)
         return await self.kube.get_or_none("StatefulSet", name, ns)
+
+    async def _stabilize_restore_env(self, nb: dict, sts: dict) -> None:
+        """Restore-hint env may only change across a park boundary. For a
+        LIVE gang (replicas > 0) the freshly generated template keeps
+        exactly the restore env the running pods already have — present
+        or absent, with the live values: the hint is moot while the gang
+        runs, and adding/updating it (first ack of a drain, a cancelled
+        suspend after its ack, an ack→park race) would diff the template
+        and rolling-restart pods that nothing intends to disturb. A
+        parked or not-yet-created StatefulSet takes the desired hint
+        as-is — it rides the same update as the scale-up."""
+        live = await self._live_sts(name_of(sts), namespace_of(nb))
+        if live is None or not (deep_get(live, "spec", "replicas") or 0):
+            return
+        restore_keys = (migration.RESTORE_PATH_ENV, migration.RESTORE_STEP_ENV)
+        live_env = (deep_get(live, "spec", "template", "spec", "containers",
+                             default=[{}]) or [{}])[0].get("env") or []
+        live_restore = [dict(e) for e in live_env
+                        if e.get("name") in restore_keys]
+        main = sts["spec"]["template"]["spec"]["containers"][0]
+        env = [e for e in main.get("env", [])
+               if e.get("name") not in restore_keys]
+        env.extend(live_restore)
+        main["env"] = env
 
     async def _preserve_consume_annotation(self, nb: dict, sts: dict) -> None:
         """Copy the live StatefulSet's consume-provisioning-request
@@ -814,6 +971,8 @@ class NotebookReconciler:
               "protocol": "TCP"}],
         )
         self._set_prefix_env(main, ns, name)
+        if self.opts.enable_migration:
+            self._set_restore_env(main, nb)
 
         template_annotations: dict[str, str] = {}
         template_labels: dict[str, str] = {
@@ -880,6 +1039,27 @@ class NotebookReconciler:
             },
         }
         return sts
+
+    def _set_restore_env(self, container: dict, nb: dict) -> None:
+        """Stamp the migration restore hint (checkpoint path + step) into
+        the worker env so in-pod code — sdk.CheckpointManager users, or
+        anything reading KFTPU_RESTORE_* — resumes where the drain left
+        off. User-provided values win (a notebook that manages its own
+        restore keeps doing so). The hint only changes when the SDK
+        commits a checkpoint, which is immediately followed by a park —
+        so a live gang's template stays stable between drains."""
+        hint = migration.restore_hint(annotations_of(nb))
+        if hint is None:
+            return
+        path, step = hint
+        env = [dict(e) for e in container.get("env", [])]
+        have = {e.get("name") for e in env}
+        if migration.RESTORE_PATH_ENV not in have:
+            env.append({"name": migration.RESTORE_PATH_ENV, "value": path})
+        if step is not None and migration.RESTORE_STEP_ENV not in have:
+            env.append({"name": migration.RESTORE_STEP_ENV,
+                        "value": str(step)})
+        container["env"] = env
 
     def _set_prefix_env(self, container: dict, ns: str, name: str) -> None:
         """NB_PREFIX tells the server its URL base (notebook_controller.go:392-406)."""
@@ -1515,6 +1695,8 @@ class NotebookReconciler:
                 if statuses:
                     container_state = statuses[0].get("state", {}) or {}
 
+        want_hosts = 0 if nbapi.is_stopped(nb) else (
+            ms.total_hosts if ms else 1)
         conditions = list(deep_get(nb, "status", "conditions", default=[]))
         # Scheduler transitions and container transitions interleave in
         # one history, so each family dedups against ITS most recent
@@ -1535,10 +1717,20 @@ class NotebookReconciler:
         new_cond = _condition_from_state(container_state)
         if new_cond and new_cond["type"] != prev_container:
             conditions.insert(0, new_cond)
+        # Migration lifecycle (kubeflow_tpu/migration): the block mirrors
+        # the drain/checkpoint annotations; a NEW committed checkpoint
+        # (checkpointedAt changed) earns one `Checkpointed` condition —
+        # its own dedup family, keyed on the recorded ack time, so
+        # neither scheduler nor container churn re-inserts it.
+        mig_status = (_migration_status_block(nb, ready=ready,
+                                              want_hosts=want_hosts)
+                      if self.opts.enable_migration else None)
+        prev_ckpt = deep_get(nb, "status", "migration", "checkpointedAt")
+        if (mig_status is not None and mig_status.get("checkpointedAt")
+                and mig_status["checkpointedAt"] != prev_ckpt):
+            conditions.insert(0, _checkpointed_condition(mig_status))
         conditions = conditions[:8]
 
-        want_hosts = 0 if nbapi.is_stopped(nb) else (
-            ms.total_hosts if ms else 1)
         status = {
             "readyReplicas": ready,
             "containerState": container_state,
@@ -1565,6 +1757,10 @@ class NotebookReconciler:
             status["scheduler"] = sched_status
         elif deep_get(nb, "status", "scheduler") is not None:
             status["scheduler"] = None
+        if mig_status is not None:
+            status["migration"] = mig_status
+        elif deep_get(nb, "status", "migration") is not None:
+            status["migration"] = None
         # Write elision. Two gates:
         # - live status equals the computed one (covers the cold start —
         #   controller restart with an already-converged CR);
@@ -1620,6 +1816,20 @@ class NotebookReconciler:
         totals[1] += chips - old[1]
         self.m_running.labels(namespace=ns or "").set(totals[0])
         self.m_chips.labels(namespace=ns or "").set(totals[1])
+
+
+def _soonest(*results) -> Result | None:
+    """The Result that reconciles first (smallest positive requeue_after);
+    None only when every input is None."""
+    best = None
+    for r in results:
+        if r is None or not getattr(r, "requeue_after", 0):
+            continue
+        if best is None or r.requeue_after < best.requeue_after:
+            best = r
+    if best is None:
+        return next((r for r in results if r is not None), None)
+    return best
 
 
 def _main_container_name(nb: dict) -> str:
@@ -1684,8 +1894,8 @@ def _copy_configmap_data(desired: dict, live: dict) -> bool:
 def _scheduler_status_block(admission) -> dict | None:
     """Admission verdict → the ``status.scheduler`` block. The shape is
     the JWA contract (web/common/status.py): Queued carries position +
-    waitingChips + reason, Preempted carries the reason, Admitted is
-    bare."""
+    waitingChips + reason, Preempted/Draining carry the reason, Admitted
+    is bare."""
     if admission is None:
         return None
     block: dict = {"state": admission.state}
@@ -1693,9 +1903,52 @@ def _scheduler_status_block(admission) -> dict | None:
         block["position"] = admission.position
         block["waitingChips"] = admission.waiting_chips
         block["reason"] = admission.reason
-    elif admission.state == "Preempted" and admission.reason:
+    elif admission.state in ("Preempted", "Draining") and admission.reason:
         block["reason"] = admission.reason
     return block
+
+
+def _migration_status_block(nb: dict, *, ready: int,
+                            want_hosts: int) -> dict | None:
+    """Drain/checkpoint annotations → the ``status.migration`` block
+    (JWA contract: "Checkpointing before preemption…", "Suspended
+    (checkpoint @ step N)", "Restoring from checkpoint"). None for the
+    common untouched notebook, so steady-state status stays byte-
+    identical to pre-migration."""
+    annotations = annotations_of(nb)
+    state = migration.derive_state(
+        annotations, stopped=nbapi.is_stopped(nb),
+        ready_hosts=ready, want_hosts=want_hosts)
+    hint = migration.restore_hint(annotations)
+    if (state == migration.RUNNING and hint is None
+            and migration.drain_requested_at(annotations) is None):
+        return None
+    block: dict = {"state": state}
+    if hint is not None:
+        block["checkpointPath"] = hint[0]
+        if hint[1] is not None:
+            block["checkpointStep"] = hint[1]
+    checkpointed = annotations.get(nbapi.CHECKPOINTED_AT_ANNOTATION)
+    if checkpointed:
+        block["checkpointedAt"] = checkpointed
+    reason = migration.drain_reason(annotations)
+    if reason:
+        block["reason"] = reason
+    return block
+
+
+def _checkpointed_condition(mig_status: dict) -> dict:
+    step = mig_status.get("checkpointStep")
+    path = mig_status.get("checkpointPath", "")
+    return {
+        "type": "Checkpointed",
+        "status": "True",
+        "lastProbeTime": now_iso(),
+        "reason": "Migration",
+        "message": "checkpoint"
+        + (f" @ step {step}" if step is not None else "")
+        + (f" committed to {path}" if path else " committed"),
+    }
 
 
 def _scheduler_condition(sched_status: dict) -> dict:
@@ -1709,6 +1962,9 @@ def _scheduler_condition(sched_status: dict) -> dict:
     elif state == "Preempted":
         message = (f"preempted ({sched_status.get('reason', 'reclaimed')}); "
                    "restart to re-queue")
+    elif state == "Draining":
+        message = (f"checkpointing before preemption "
+                   f"({sched_status.get('reason', 'reclaimed')})")
     else:
         message = "admitted by the TPU fleet scheduler"
     return {

@@ -1,0 +1,231 @@
+"""Pure core of the drain/checkpoint/restore protocol.
+
+Everything here is a function of (annotations, now) — no I/O, no clock
+reads — so the scheduler, the notebook controller, the culler, the SDK,
+and tier-1 can all reason about the same state machine without an event
+loop. The durable state lives in CR annotations (api/notebook.py), which
+is what makes the protocol survive controller restarts and reach the pod
+through the SDK's in-cluster CR fetch.
+
+State machine (derive_state)::
+
+    Running ──drain requested──► DrainRequested
+                                      │ SDK stamps checkpointing-at
+                                      ▼
+                                 Checkpointing
+                                      │ SDK stamps checkpointed-at (+path/step)
+                                      ▼
+                                 Checkpointed ──finalizer stops the CR──► Parked
+                                      │
+        Running ◄── all workers ready ── Restoring ◄── re-admitted with a
+                                                       restore hint
+
+A drain that outlives ``KFTPU_DRAIN_GRACE`` falls back to today's hard
+stop: the finalizer (scheduler/culler/controller — identified by the
+``drain-reason`` prefix it stamped) clears the drain marks and stops the
+CR without a checkpoint. Chips are never held hostage to a wedged pod.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.objects import fmt_iso, parse_iso
+
+# Derived lifecycle states (status.migration.state + /debug rows).
+RUNNING = "Running"
+DRAIN_REQUESTED = "DrainRequested"
+CHECKPOINTING = "Checkpointing"
+CHECKPOINTED = "Checkpointed"
+PARKED = "Parked"
+RESTORING = "Restoring"
+
+DEFAULT_DRAIN_GRACE_SECONDS = 120.0
+
+# Restore hint env the controller stamps into the pod template; the SDK's
+# CheckpointManager/notebook code reads these to resume where it left off.
+RESTORE_PATH_ENV = "KFTPU_RESTORE_CHECKPOINT_PATH"
+RESTORE_STEP_ENV = "KFTPU_RESTORE_STEP"
+
+
+def migration_enabled(environ=os.environ) -> bool:
+    """``KFTPU_MIGRATION`` master switch — anything but off/false/0/no
+    leaves the drain protocol on. Off restores the pre-migration
+    immediate stop on every path (preemption, culling, suspend)."""
+    return environ.get("KFTPU_MIGRATION", "on").strip().lower() not in (
+        "off", "false", "0", "no", "disabled",
+    )
+
+
+def cull_drain_enabled(environ=os.environ) -> bool:
+    """``KFTPU_CULL_DRAIN`` — culling-only kill switch layered under the
+    master one: off restores the bare idle-cull stop while preemption
+    keeps draining."""
+    return environ.get("KFTPU_CULL_DRAIN", "on").strip().lower() not in (
+        "off", "false", "0", "no", "disabled",
+    )
+
+
+def drain_grace_seconds(environ=os.environ) -> float:
+    """``KFTPU_DRAIN_GRACE`` — seconds a drain may hold chips before the
+    hard-stop fallback fires."""
+    raw = environ.get("KFTPU_DRAIN_GRACE")
+    try:
+        value = float(raw) if raw is not None else DEFAULT_DRAIN_GRACE_SECONDS
+    except ValueError:
+        return DEFAULT_DRAIN_GRACE_SECONDS
+    return value if value > 0 else DEFAULT_DRAIN_GRACE_SECONDS
+
+
+# ---- annotation readers --------------------------------------------------------
+
+
+def drain_requested_at(annotations: dict) -> float | None:
+    return parse_iso(
+        annotations.get(nbapi.DRAIN_REQUESTED_ANNOTATION) or "")
+
+
+def drain_reason(annotations: dict) -> str:
+    return annotations.get(nbapi.DRAIN_REASON_ANNOTATION) or ""
+
+
+def checkpointed_at(annotations: dict) -> float | None:
+    return parse_iso(
+        annotations.get(nbapi.CHECKPOINTED_AT_ANNOTATION) or "")
+
+
+def checkpoint_step(annotations: dict) -> int | None:
+    raw = annotations.get(nbapi.CHECKPOINT_STEP_ANNOTATION)
+    try:
+        return int(raw) if raw is not None else None
+    except ValueError:
+        return None
+
+
+def drain_acked(annotations: dict) -> bool:
+    """Has the SDK committed a checkpoint for the CURRENT drain? The
+    primary signal is the echo: the ack's ``checkpointed-for`` carries
+    the raw drain-requested value it answers, so the comparison never
+    involves two clocks (the controller stamps the request, the pod
+    stamps the ack — skew between them must not make acks invisible or a
+    stale checkpoint look fresh). The timestamp ordering remains as a
+    fallback for acks stamped without the echo."""
+    requested_raw = annotations.get(nbapi.DRAIN_REQUESTED_ANNOTATION)
+    if not requested_raw:
+        return False
+    echo = annotations.get(nbapi.CHECKPOINTED_FOR_ANNOTATION)
+    if echo is not None:
+        return echo == requested_raw
+    requested = drain_requested_at(annotations)
+    acked = checkpointed_at(annotations)
+    return requested is not None and acked is not None and acked >= requested
+
+
+def drain_deadline(annotations: dict, grace: float) -> float | None:
+    """When the hard-stop fallback fires (epoch seconds), or None when no
+    drain is pending."""
+    requested = drain_requested_at(annotations)
+    return None if requested is None else requested + grace
+
+
+def drain_expired(annotations: dict, now: float, grace: float) -> bool:
+    deadline = drain_deadline(annotations, grace)
+    return deadline is not None and now >= deadline and \
+        not drain_acked(annotations)
+
+
+def restore_hint(annotations: dict) -> tuple[str, int | None] | None:
+    """(checkpoint path, step) to restore from, or None. The path alone
+    is enough (CheckpointManager.restore defaults to the latest step);
+    the step is surfaced for status messages and determinism."""
+    path = annotations.get(nbapi.CHECKPOINT_PATH_ANNOTATION)
+    if not path:
+        return None
+    return path, checkpoint_step(annotations)
+
+
+# ---- state derivation ----------------------------------------------------------
+
+
+def derive_state(annotations: dict, *, stopped: bool,
+                 ready_hosts: int = 0, want_hosts: int = 0) -> str:
+    """The migration lifecycle state as a pure function of the CR. Only
+    meaningful when migration is in play (a drain mark or a checkpoint
+    exists); a plain notebook derives Running/Parked trivially.
+
+    Parked requires BOTH a committed checkpoint and the drain-reason
+    marker every drain park keeps: the checkpoint path/step annotations
+    survive re-admission as the durable restore hint, so a later plain
+    user stop — with no fresh checkpoint — must not present as a clean
+    "Suspended (checkpoint @ step N)" park. Re-admission clears the
+    reason, so only a stop that actually came from a drain qualifies."""
+    if stopped:
+        return PARKED if (checkpointed_at(annotations) is not None
+                          and drain_reason(annotations)) else RUNNING
+    if drain_requested_at(annotations) is not None:
+        if drain_acked(annotations):
+            return CHECKPOINTED
+        if annotations.get(nbapi.CHECKPOINTING_AT_ANNOTATION):
+            return CHECKPOINTING
+        return DRAIN_REQUESTED
+    if restore_hint(annotations) is not None and (
+            want_hosts == 0 or ready_hosts < want_hosts):
+        return RESTORING
+    return RUNNING
+
+
+# ---- patch shapes --------------------------------------------------------------
+# Merge-patch annotation dicts, so every participant stamps the same keys.
+
+
+def request_drain_patch(reason: str, now: float) -> dict:
+    """Ask the pod to checkpoint: starts the grace clock. Stale progress
+    marks from a PREVIOUS drain cycle are cleared so ack detection can't
+    confuse an old checkpointing-at for fresh progress."""
+    return {
+        nbapi.DRAIN_REQUESTED_ANNOTATION: fmt_iso(now),
+        nbapi.DRAIN_REASON_ANNOTATION: reason,
+        nbapi.CHECKPOINTING_AT_ANNOTATION: None,
+    }
+
+
+def ack_patch(path: str, step: int, now: float,
+              *, for_request: str | None = None) -> dict:
+    """The SDK's commit mark: checkpoint durable at (path, step).
+    ``for_request`` echoes the raw drain-requested value being answered
+    (see :func:`drain_acked` — the echo makes ack detection clock-skew
+    immune); pass the annotation value the SDK read."""
+    patch = {
+        nbapi.CHECKPOINTED_AT_ANNOTATION: fmt_iso(now),
+        nbapi.CHECKPOINT_PATH_ANNOTATION: path,
+        nbapi.CHECKPOINT_STEP_ANNOTATION: str(step),
+    }
+    if for_request is not None:
+        patch[nbapi.CHECKPOINTED_FOR_ANNOTATION] = for_request
+    return patch
+
+
+def clear_drain_patch(*, keep_checkpoint: bool = True,
+                      keep_reason: bool = False) -> dict:
+    """Drop the drain marks (re-admission, cancel, or hard-stop
+    fallback). The checkpoint path/step survive by default — they are the
+    durable restore hint; ``keep_checkpoint=False`` also drops those.
+    ``keep_reason=True`` is the PARK variant: the drain-reason stays as
+    the durable "this stop came from a drain" marker (derive_state's
+    Parked gate and the controller's resume path key off it); it clears
+    on re-admission via the default variant."""
+    patch = {
+        nbapi.DRAIN_REQUESTED_ANNOTATION: None,
+        nbapi.CHECKPOINTING_AT_ANNOTATION: None,
+        nbapi.CHECKPOINTED_FOR_ANNOTATION: None,
+    }
+    if not keep_reason:
+        patch[nbapi.DRAIN_REASON_ANNOTATION] = None
+    if not keep_checkpoint:
+        patch.update({
+            nbapi.CHECKPOINTED_AT_ANNOTATION: None,
+            nbapi.CHECKPOINT_PATH_ANNOTATION: None,
+            nbapi.CHECKPOINT_STEP_ANNOTATION: None,
+        })
+    return patch

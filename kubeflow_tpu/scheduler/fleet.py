@@ -219,6 +219,12 @@ class Allocation:
     # its pods exist, so the ledger records it as a deliberate overcommit
     # and assert_consistent exempts its pools from the capacity check.
     forced: bool = False
+    # Deferred preemption (kubeflow_tpu/migration): a drain was requested
+    # for this gang — it still holds its chips while it checkpoints, but
+    # the victim search treats its capacity as incoming-free (no second
+    # gang is drained for slices already on their way out) and never
+    # re-picks it as a victim.
+    draining: bool = False
 
 
 @dataclass

@@ -79,6 +79,10 @@ class ScheduleResult:
     admitted: list
     preempted: list
     queue: list                # QueuedInfo for everything still waiting
+    # Deferred preemption only: victims that must be ASKED to checkpoint
+    # (Preemption records; their chips stay booked until the runtime
+    # observes the ack or the grace deadline and calls release()).
+    drains: list = field(default_factory=list)
 
 
 @dataclass(frozen=True)
@@ -90,6 +94,11 @@ class PolicyConfig:
     # A holder whose culling last-activity is older than this is fair
     # game for any queued gang that needs its chips.
     idle_preempt_after_seconds: float = 1800.0
+    # Preempt-to-checkpoint (kubeflow_tpu/migration): victims are DRAIN
+    # requests, not in-pass releases — chips stay booked (alloc.draining)
+    # until the runtime sees the checkpoint ack or the grace deadline and
+    # releases them. False keeps the immediate-stop semantics.
+    deferred_preemption: bool = False
 
 
 @dataclass
@@ -170,6 +179,10 @@ class PolicyQueue:
 
     def is_admitted(self, key: tuple) -> bool:
         return key in self.ledger.allocations
+
+    def is_draining(self, key: tuple) -> bool:
+        alloc = self.ledger.allocations.get(key)
+        return alloc is not None and alloc.draining
 
     def reclaim(self, req: GangRequest, now: float) -> bool:
         """Re-seat an ALREADY-RUNNING gang after a controller restart
@@ -254,6 +267,10 @@ class PolicyQueue:
             reseated = self.ledger.allocations.get(alloc.key)
             if reseated is not None:
                 reseated.last_active_at = alloc.last_active_at
+                # A drain in flight survives the fleet swap: the victim
+                # is still checkpointing and must not become a candidate
+                # for a second preemption.
+                reseated.draining = alloc.draining
         # A shrink that KEEPS a pool's name/shape can leave its live
         # gangs over the new capacity. That is deliberate drain-down
         # overcommit, not ledger drift — mark those gangs forced so
@@ -302,7 +319,20 @@ class PolicyQueue:
                     for p in self.fleet.matching(req.accelerator,
                                                  req.topology)}
         candidates = []
+        # Capacity already on its way out (deferred preemption: gangs
+        # asked to checkpoint but still holding chips) counts as incoming
+        # free space — selecting a second victim for slices a first one
+        # is already vacating would double-kill for one waiter.
+        draining_by_pool: dict[str, int] = {}
         for alloc in self.ledger.allocations.values():
+            if alloc.draining:
+                if (alloc.accelerator.lower(),
+                        alloc.topology.lower()) == shape:
+                    for pool, n in alloc.placements.items():
+                        if pool in matching:
+                            draining_by_pool[pool] = \
+                                draining_by_pool.get(pool, 0) + n
+                continue  # never re-pick a draining gang as a victim
             if (alloc.accelerator.lower(), alloc.topology.lower()) != shape:
                 continue  # frees no capacity this gang can use
             # Only slices booked on REAL matching pools come back on
@@ -340,6 +370,8 @@ class PolicyQueue:
         free_by_pool = {p.name: self.ledger.free_slices(p)
                         for p in self.fleet.matching(req.accelerator,
                                                      req.topology)}
+        for pool, n in draining_by_pool.items():
+            free_by_pool[pool] = free_by_pool.get(pool, 0) + n
 
         def usable() -> int:
             return sum(max(f, 0) for f in free_by_pool.values())
@@ -352,6 +384,10 @@ class PolicyQueue:
             for pool, n in alloc.placements.items():
                 if pool in free_by_pool:
                     free_by_pool[pool] += n
+        # An EMPTY list is meaningful in deferred mode: enough capacity is
+        # already draining, so no new victim is needed — the caller keeps
+        # the requester queued without emitting further drains. None still
+        # means preemption cannot help at all.
         return victims if usable() >= req.num_slices else None
 
     def schedule(self, now: float) -> ScheduleResult:
@@ -359,6 +395,7 @@ class PolicyQueue:
         preempts) and returns everything the runtime must act on."""
         admitted: list[Admitted] = []
         preempted: list[Preemption] = []
+        drains: list[Preemption] = []
         progressed = True
         while progressed and self.pending:
             progressed = False
@@ -374,7 +411,22 @@ class PolicyQueue:
                                        req.num_slices)
                 if plan is None and self.config.enable_preemption:
                     victims = self._find_victims(req, now)
-                    if victims:
+                    if victims is not None and self.config.deferred_preemption:
+                        # Drain, don't kill: mark the victims draining
+                        # (chips stay booked — the fleet must not admit
+                        # anyone onto slices that still hold un-saved
+                        # state) and hand them to the runtime to ask for
+                        # a checkpoint. The requester stays queued until
+                        # the runtime observes the ack (or the grace
+                        # deadline) and releases the victims for real.
+                        # An empty list = enough capacity already
+                        # draining for this shape; just keep waiting.
+                        for alloc, reason in victims:
+                            alloc.draining = True
+                            drains.append(Preemption(
+                                key=alloc.key, reason=reason,
+                                for_key=req.key, chips=alloc.chips))
+                    elif victims:
                         for alloc, reason in victims:
                             self.ledger.release(alloc.key)
                             preempted.append(Preemption(
@@ -410,9 +462,10 @@ class PolicyQueue:
                     # forever; it stays queued with the ceiling in its
                     # reason instead.
                     blocked.add(shape)
-        if admitted or preempted:
+        if admitted or preempted or drains:
             self.gen += 1
         return ScheduleResult(admitted=admitted, preempted=preempted,
+                              drains=drains,
                               queue=self.schedule_preview(now))
 
     def _queue_reason(self, req: GangRequest) -> str:
@@ -423,6 +476,14 @@ class PolicyQueue:
             return (f"gang needs {req.num_slices} "
                     f"{req.accelerator}:{req.topology} slice(s); the fleet "
                     f"ceiling is {total}")
+        shape = (req.accelerator.lower(), req.topology.lower())
+        draining = sum(
+            1 for a in self.ledger.allocations.values()
+            if a.draining and (a.accelerator.lower(),
+                               a.topology.lower()) == shape)
+        if draining:
+            return (f"waiting for {draining} draining gang(s) to "
+                    f"checkpoint ({req.chips} chips)")
         return (f"waiting for {req.chips} chips "
                 f"({req.num_slices}x {req.accelerator}:{req.topology})")
 
@@ -446,6 +507,7 @@ class PolicyQueue:
                     "placements": a.placements,
                     "admitted_at": a.admitted_at,
                     "last_active_at": a.last_active_at,
+                    "draining": a.draining,
                 }
                 for a in sorted(self.ledger.allocations.values(),
                                 key=lambda a: a.key)

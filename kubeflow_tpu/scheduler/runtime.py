@@ -41,6 +41,7 @@ from kubeflow_tpu.runtime.objects import (
     parse_iso,
 )
 from kubeflow_tpu.runtime.tracing import span
+from kubeflow_tpu.migration import protocol as migration
 from kubeflow_tpu.scheduler.fleet import Fleet
 from kubeflow_tpu.scheduler.policy import (
     GangRequest,
@@ -94,14 +95,29 @@ def parse_priority(value: str | None) -> int:
 class Admission:
     """What the capacity stage gets back."""
 
-    state: str                 # "Admitted" | "Queued" | "Preempted"
+    state: str                 # "Admitted" | "Queued" | "Preempted" | "Draining"
     position: int = 0
     reason: str = ""
     waiting_chips: int = 0
+    # Draining only: how soon the controller must reconcile again so the
+    # grace deadline fires even if the SDK never acks.
+    requeue_after: float = 0.0
 
     @property
     def admitted(self) -> bool:
         return self.state == "Admitted"
+
+
+@dataclass
+class _Drain:
+    """In-memory side of one in-flight drain (the durable side lives in
+    the victim's annotations — migration/protocol.py)."""
+
+    reason: str                # "idle" | "priority"
+    for_key: tuple             # beneficiary waiting on the chips
+    chips: int
+    requested_at: float
+    deadline: float
 
 
 @dataclass
@@ -124,6 +140,15 @@ class SchedulerOptions:
     # Requeue cadence for queued notebooks — a safety net; admissions
     # re-enqueue the winner immediately.
     queued_requeue_seconds: float = 10.0
+    # Preempt-to-checkpoint (kubeflow_tpu/migration): preemption requests
+    # a drain and only frees the ledger once the victim acks a committed
+    # checkpoint (or the grace deadline fires — chips are never held
+    # hostage). The DATACLASS default is off so bare construction keeps
+    # the pre-migration immediate-stop semantics byte-for-byte; the
+    # production env wiring (cmd/envconfig.py, KFTPU_MIGRATION, default
+    # on) is what turns it on.
+    enable_migration: bool = False
+    drain_grace_seconds: float = migration.DEFAULT_DRAIN_GRACE_SECONDS
 
 
 class TpuFleetScheduler:
@@ -151,6 +176,7 @@ class TpuFleetScheduler:
                 enable_preemption=self.options.enable_preemption,
                 idle_preempt_after_seconds=(
                     self.options.idle_preempt_after_seconds),
+                deferred_preemption=self.options.enable_migration,
             ),
         )
         self._now = time.time
@@ -165,6 +191,10 @@ class TpuFleetScheduler:
         self._state: dict[tuple, str] = {}
         self._preempted: dict[tuple, str] = {}
         self._stop_pending: dict[tuple, str] = {}
+        # key → in-flight drain (preempt-to-checkpoint): the victim still
+        # holds its chips while it checkpoints; finalized on ack or when
+        # the grace deadline fires.
+        self._draining: dict[tuple, _Drain] = {}
         self._fleet_next_try = 0.0
         # Debounce for full arbitration passes (see Admission below).
         self._last_pass_gen = -1
@@ -187,6 +217,16 @@ class TpuFleetScheduler:
         self.m_wait = registry.histogram(
             "tpu_scheduler_admission_wait_seconds",
             "Queue wait from submission to admission")
+        self.m_drain = registry.histogram(
+            "tpu_scheduler_drain_seconds",
+            "Drain request to checkpoint-ack round trip")
+        self.m_drain_fallback = registry.counter(
+            "tpu_scheduler_drain_fallback_total",
+            "Drains that hit the grace deadline and hard-stopped "
+            "without a checkpoint")
+        self.m_draining = registry.gauge(
+            "tpu_scheduler_draining_gangs",
+            "Gangs currently checkpointing before preemption")
 
     # ---- wiring -----------------------------------------------------------------
 
@@ -314,6 +354,13 @@ class TpuFleetScheduler:
             # ledger already gave its chips away, so retry the stop
             # rather than re-admit/reclaim a gang that must park.
             return await self._retry_stop(key, now)
+        # Drains whose victims never reconcile (SDK wedged, pod gone)
+        # must still hit their grace deadline — every admission pass
+        # sweeps them. The CURRENT key is handled inline below with the
+        # live CR this reconcile already holds.
+        await self._sweep_drains(now, skip=key)
+        if key in self._draining:
+            return await self._drain_progress(key, nb, now)
         result = None
         with span("schedule", key=f"{key[0]}/{key[1]}"):
             if self.policy.is_admitted(key):
@@ -330,6 +377,23 @@ class TpuFleetScheduler:
                     # ORIGINAL admission time until the patch lands.
                     alloc = self.policy.ledger.allocations[key]
                     await self._stamp_admitted(nb, alloc.admitted_at)
+                if (migration.drain_requested_at(ann) is not None
+                        and migration.drain_reason(ann).startswith("preempt")
+                        and key not in self._draining):
+                    # Controller restarted mid-drain: the in-memory drain
+                    # (and its beneficiary) is gone and this gang was
+                    # re-seated as a plain holder. Clear the stale marks
+                    # so the SDK stops checkpointing for a preemption
+                    # that no longer exists; if the pressure persists the
+                    # next arbitration pass re-issues a fresh drain.
+                    try:
+                        await self.kube.patch(
+                            "Notebook", key[1],
+                            {"metadata": {"annotations":
+                                          migration.clear_drain_patch()}},
+                            key[0])
+                    except ApiError:
+                        pass
                 return Admission("Admitted")
             self._preempted.pop(key, None)  # resubmission clears the verdict
             if nbapi.PREEMPTED_ANNOTATION in annotations_of(nb):
@@ -414,6 +478,12 @@ class TpuFleetScheduler:
                 self._last_pass_gen = self.policy.gen
                 self._last_pass_at = now
             await self._apply(result, now)
+        if key in self._draining:
+            # Stopped (or deleted) mid-drain: the release above already
+            # freed the chips, so the drain is moot — drop it. The
+            # Preempted verdict (stamped at drain time) still reports.
+            self._draining.pop(key, None)
+            self._refresh_gauges()
         if key in self._preempted:
             return Admission("Preempted", reason=self._preempted[key])
         if nb is not None and alloc is None and not had_queue_entry:
@@ -439,6 +509,10 @@ class TpuFleetScheduler:
             with span("preempt", victim=f"{p.key[0]}/{p.key[1]}",
                       reason=p.reason):
                 await self._preempt(p, now)
+        for p in getattr(result, "drains", ()):
+            with span("drain", victim=f"{p.key[0]}/{p.key[1]}",
+                      reason=p.reason):
+                await self._request_drain(p, now)
         for a in result.admitted:
             with span("admit", key=f"{a.key[0]}/{a.key[1]}"):
                 self.m_wait.observe(a.waited)
@@ -447,6 +521,20 @@ class TpuFleetScheduler:
                       else await self._get_notebook(a.key))
                 if nb is not None:
                     await self._stamp_admitted(nb, now)
+                    hint = migration.restore_hint(annotations_of(nb))
+                    if hint is not None:
+                        # A parked-with-checkpoint gang coming back: the
+                        # notebook controller stamps the hint into the
+                        # pod env; announce the restore here so the
+                        # lifecycle is auditable from Events alone.
+                        with span("restore", key=f"{a.key[0]}/{a.key[1]}",
+                                  step=hint[1]):
+                            await self._event(
+                                nb, "Normal", "Restoring",
+                                f"Re-admitted; restoring from checkpoint "
+                                f"{hint[0]}"
+                                + (f" @ step {hint[1]}"
+                                   if hint[1] is not None else ""))
                     await self._event(
                         nb, "Normal", "Admitted",
                         f"Admitted by the TPU fleet scheduler after "
@@ -482,22 +570,180 @@ class TpuFleetScheduler:
                     "to re-queue")
         self._enqueue(p.key)
 
-    async def _stop_victim(self, key: tuple, reason: str,
-                           now: float) -> bool:
+    # ---- preempt-to-checkpoint (kubeflow_tpu/migration) ------------------------
+
+    async def _request_drain(self, p, now: float) -> None:
+        """Ask the victim to checkpoint instead of stopping it: stamp the
+        drain annotations the in-pod SDK polls, start the grace clock,
+        and keep its chips booked (policy marked the allocation draining)
+        until :meth:`_finalize_drain` sees the ack or the deadline. The
+        preemption verdict is recorded NOW so a victim the user stops
+        mid-drain still reports why it parked."""
+        ns, name = p.key
+        self._preempted[p.key] = p.reason
+        self._draining[p.key] = _Drain(
+            reason=p.reason, for_key=p.for_key, chips=p.chips,
+            requested_at=now,
+            deadline=now + self.options.drain_grace_seconds)
+        try:
+            await self.kube.patch(
+                "Notebook", name,
+                {"metadata": {"annotations": migration.request_drain_patch(
+                    f"preempt:{p.reason}", now)}}, ns)
+        except ApiError:
+            # The sweep re-patches a victim whose CR lacks the request
+            # mark; if the apiserver stays down past the grace deadline
+            # the fallback hard-stop takes over.
+            log.warning("drain request patch failed for %s/%s; will "
+                        "retry on the next scheduler pass", ns, name)
+        nb = await self._get_notebook(p.key)
+        if nb is not None:
+            await self._event(
+                nb, "Warning", "DrainRequested",
+                f"Checkpoint requested ({p.reason}) to reclaim {p.chips} "
+                f"TPU chips for {p.for_key[0]}/{p.for_key[1]}; parking "
+                f"once the checkpoint commits (grace "
+                f"{self.options.drain_grace_seconds:.0f}s)")
+        self._enqueue(p.key)
+
+    async def _drain_progress(self, key: tuple, nb: dict,
+                              now: float) -> Admission:
+        """The draining victim's own reconcile: ack → park with the
+        checkpoint; deadline → today's hard stop; otherwise report
+        Draining with a requeue that guarantees the deadline fires."""
+        drain = self._draining[key]
+        ann = annotations_of(nb)
+        if migration.drain_requested_at(ann) is None:
+            # The request patch never landed (or someone stripped it):
+            # re-stamp with the ORIGINAL request time so the grace
+            # deadline is unchanged.
+            try:
+                await self.kube.patch(
+                    "Notebook", key[1],
+                    {"metadata": {"annotations":
+                                  migration.request_drain_patch(
+                                      f"preempt:{drain.reason}",
+                                      drain.requested_at)}}, key[0])
+            except ApiError:
+                pass
+        elif migration.drain_acked(ann):
+            return await self._finalize_drain(key, nb, checkpointed=True,
+                                              now=now)
+        if now >= drain.deadline:
+            return await self._finalize_drain(key, nb, checkpointed=False,
+                                              now=now)
+        return Admission(
+            "Draining", reason=drain.reason,
+            requeue_after=max(0.1, drain.deadline - now + 0.05))
+
+    async def _finalize_drain(self, key: tuple, nb: dict | None, *,
+                              checkpointed: bool, now: float) -> Admission:
+        """End one drain exactly once: count it, stop the victim (keeping
+        the checkpoint marks — they are the restore hint), free its
+        chips, and run the arbitration pass that admits the waiter."""
+        drain = self._draining.pop(key, None)
+        if drain is None:  # raced with release()/a concurrent finalize
+            return Admission("Preempted",
+                             reason=self._preempted.get(key, ""))
+        self.m_preemptions.labels(reason=drain.reason).inc()
+        if checkpointed:
+            with span("checkpoint_ack", key=f"{key[0]}/{key[1]}",
+                      waited=round(now - drain.requested_at, 3)):
+                self.m_drain.observe(now - drain.requested_at)
+        else:
+            self.m_drain_fallback.inc()
+        if not await self._stop_victim(
+                key, drain.reason, now,
+                extra=migration.clear_drain_patch(keep_reason=True)):
+            # Same contract as an immediate preemption's failed stop:
+            # chips are released below regardless, so the victim MUST
+            # park — remember it and retry on its next reconcile.
+            self._stop_pending[key] = drain.reason
+        self.policy.release(key)
+        self._state.pop(key, None)
+        result = self.policy.schedule(now)
+        self._last_pass_gen = self.policy.gen
+        self._last_pass_at = now
+        await self._apply(result, now)
+        if nb is not None:
+            if checkpointed:
+                step = migration.checkpoint_step(annotations_of(nb))
+                await self._event(
+                    nb, "Normal", "Checkpointed",
+                    "Checkpoint committed"
+                    + (f" @ step {step}" if step is not None else "")
+                    + f"; parking ({drain.reason} preemption)")
+            else:
+                await self._event(
+                    nb, "Warning", "DrainDeadlineExceeded",
+                    f"No checkpoint ack within "
+                    f"{self.options.drain_grace_seconds:.0f}s; stopped "
+                    f"without a checkpoint ({drain.reason} preemption)")
+        return Admission("Preempted", reason=drain.reason)
+
+    async def _sweep_drains(self, now: float, skip: tuple | None = None) \
+            -> None:
+        """Advance every in-flight drain that is not being handled inline
+        by its own reconcile: finalize acks, fire expired deadlines, and
+        re-patch victims whose request annotation never landed. Runs on
+        every admission/release pass, so a waiter's safety-net requeue is
+        enough to guarantee deadlines fire."""
+        for key in list(self._draining):
+            if key == skip or key not in self._draining:
+                continue
+            drain = self._draining[key]
+            nb = await self._get_notebook(key)
+            if nb is None:
+                # CR gone mid-drain: nothing to stop; free the chips and
+                # let the waiters arbitrate.
+                self._draining.pop(key, None)
+                if self.policy.release(key) is not None:
+                    result = self.policy.schedule(now)
+                    self._last_pass_gen = self.policy.gen
+                    self._last_pass_at = now
+                    await self._apply(result, now)
+                continue
+            ann = annotations_of(nb)
+            if nbapi.STOP_ANNOTATION in ann:
+                continue  # its own release path owns the cleanup
+            if migration.drain_acked(ann):
+                await self._finalize_drain(key, nb, checkpointed=True,
+                                           now=now)
+            elif now >= drain.deadline:
+                await self._finalize_drain(key, nb, checkpointed=False,
+                                           now=now)
+            elif migration.drain_requested_at(ann) is None:
+                try:
+                    await self.kube.patch(
+                        "Notebook", key[1],
+                        {"metadata": {"annotations":
+                                      migration.request_drain_patch(
+                                          f"preempt:{drain.reason}",
+                                          drain.requested_at)}}, key[0])
+                except ApiError:
+                    pass
+
+    async def _stop_victim(self, key: tuple, reason: str, now: float,
+                           extra: dict | None = None) -> bool:
+        annotations = {
+            nbapi.STOP_ANNOTATION: fmt_iso(now),
+            nbapi.PREEMPTED_ANNOTATION: reason,
+        }
+        if extra:
+            annotations.update(extra)
         try:
             await self.kube.patch(
                 "Notebook", key[1],
-                {"metadata": {"annotations": {
-                    nbapi.STOP_ANNOTATION: fmt_iso(now),
-                    nbapi.PREEMPTED_ANNOTATION: reason,
-                }}}, key[0])
+                {"metadata": {"annotations": annotations}}, key[0])
             return True
         except ApiError:
             return False
 
     async def _retry_stop(self, key: tuple, now: float) -> Admission:
         reason = self._stop_pending[key]
-        if not await self._stop_victim(key, reason, now):
+        if not await self._stop_victim(
+                key, reason, now,
+                extra=migration.clear_drain_patch(keep_reason=True)):
             # Keep failing the reconcile until the patch lands: the
             # workqueue's error backoff is the retry loop. Returning
             # normally here would end retries after this attempt — the
@@ -512,13 +758,17 @@ class TpuFleetScheduler:
     async def _stamp_admitted(self, nb: dict, now: float) -> None:
         """Persist the admitted-at timestamp: culling clocks idleness from
         it (a gang that queued for hours must not be culled seconds after
-        it finally starts), and a controller restart re-reads it."""
+        it finally starts), and a controller restart re-reads it. Drain
+        marks — including the park's drain-reason marker — clear here:
+        an admitted gang is past its park, and a leftover reason would
+        make a later plain stop present as a checkpointed park."""
         try:
             await self.kube.patch(
                 "Notebook", name_of(nb),
                 {"metadata": {"annotations": {
                     nbapi.SCHEDULER_ADMITTED_AT_ANNOTATION: fmt_iso(now),
                     nbapi.PREEMPTED_ANNOTATION: None,
+                    **migration.clear_drain_patch(),
                 }}}, namespace_of(nb))
         except ApiError:
             pass  # best-effort; the in-memory admitted_at still ranks
@@ -543,6 +793,7 @@ class TpuFleetScheduler:
 
     def _refresh_gauges(self) -> None:
         self.m_queue_depth.set(len(self.policy.pending))
+        self.m_draining.set(len(self._draining))
         ns_chips = self.policy.ledger.ns_chips
         for ns in self._gauge_ns - set(ns_chips):
             self.m_admitted_ns.labels(namespace=ns or "").set(0)
@@ -570,6 +821,16 @@ class TpuFleetScheduler:
                         else "none")))
         info["preempted"] = {
             f"{k[0]}/{k[1]}": reason for k, reason in self._preempted.items()
+        }
+        info["migration_enabled"] = self.options.enable_migration
+        info["draining"] = {
+            f"{k[0]}/{k[1]}": {
+                "reason": d.reason,
+                "for": f"{d.for_key[0]}/{d.for_key[1]}",
+                "chips": d.chips,
+                "deadline_in_sec": round(d.deadline - now, 3),
+            }
+            for k, d in self._draining.items()
         }
         return info
 

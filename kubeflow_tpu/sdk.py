@@ -44,7 +44,12 @@ import time
 import urllib.request
 from dataclasses import dataclass
 
-from kubeflow_tpu.api.notebook import MAINTENANCE_ANNOTATION
+from kubeflow_tpu.api.notebook import (
+    DRAIN_REQUESTED_ANNOTATION,
+    MAINTENANCE_ANNOTATION,
+    SUSPEND_ANNOTATION,
+)
+from kubeflow_tpu.migration import protocol as _migration
 from kubeflow_tpu.utils.checkpoint import CheckpointManager
 
 __all__ = [
@@ -53,7 +58,9 @@ __all__ = [
     "MaintenanceWatcher",
     "SliceInfo",
     "initialize_distributed",
+    "resume",
     "start_profiler_server",
+    "suspend",
     "trace",
 ]
 
@@ -204,6 +211,70 @@ def _in_cluster_fetch(namespace: str, name: str):
     return fetch
 
 
+def _in_cluster_url(namespace: str, name: str) -> str:
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"
+    return (f"https://{host}:{port}/apis/kubeflow.org/v1"
+            f"/namespaces/{namespace}/notebooks/{name}")
+
+
+def _in_cluster_patcher(namespace: str, name: str):
+    """Build an annotations-merge-patcher for this notebook's own CR —
+    the write half of the drain protocol (checkpoint ack, suspend). Same
+    stdlib-only, ServiceAccount-credentialed transport as the fetch."""
+    url = _in_cluster_url(namespace, name)
+    ctx = ssl.create_default_context(cafile=os.path.join(_SA_DIR, "ca.crt"))
+
+    def patch_annotations(annotations: dict) -> None:
+        with open(os.path.join(_SA_DIR, "token")) as f:
+            token = f.read().strip()
+        body = json.dumps(
+            {"metadata": {"annotations": annotations}}).encode()
+        req = urllib.request.Request(
+            url, data=body, method="PATCH",
+            headers={
+                "Authorization": f"Bearer {token}",
+                "Content-Type": "application/merge-patch+json",
+            })
+        with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+            resp.read()
+
+    return patch_annotations
+
+
+def _identity_patcher(environ=os.environ):
+    info = SliceInfo.from_env(environ)
+    if not (info.namespace and info.notebook):
+        raise ValueError(
+            "not running under the controller (no NB_PREFIX); "
+            "pass patcher= explicitly")
+    return _in_cluster_patcher(info.namespace, info.notebook)
+
+
+def suspend(*, environ=os.environ, patcher=None) -> None:
+    """Ask the control plane to checkpoint-and-park this notebook: stamps
+    the suspend annotation; the notebook controller requests a drain, the
+    training loop's CheckpointGuard acks it, and the server parks with
+    "Suspended (checkpoint @ step N)". Resume with :func:`resume` (before
+    the park completes), ``kubectl annotate notebook <name>
+    notebooks.kubeflow.org/suspend-``, or the UI's start button."""
+    import datetime
+
+    patcher = patcher or _identity_patcher(environ)
+    patcher({SUSPEND_ANNOTATION: datetime.datetime.now(
+        datetime.timezone.utc).isoformat()})
+
+
+def resume(*, environ=os.environ, patcher=None) -> None:
+    """Clear the suspend annotation: cancels a drain still in flight; a
+    notebook already parked un-parks on the controller's next reconcile
+    and restores from its checkpoint hint."""
+    patcher = patcher or _identity_patcher(environ)
+    patcher({SUSPEND_ANNOTATION: None})
+
+
 class MaintenanceWatcher:
     """Polls this notebook's CR for the controller's maintenance-pending
     annotation. ``check()`` for in-loop use (CheckpointGuard), or
@@ -223,6 +294,10 @@ class MaintenanceWatcher:
         self.interval = interval
         self._last: str | None = None
         self._last_at = 0.0
+        # Full annotation snapshot from the last successful fetch: the
+        # drain protocol (CheckpointGuard) reads more than the
+        # maintenance key from the same rate-limited poll.
+        self._ann: dict = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -236,10 +311,18 @@ class MaintenanceWatcher:
         if now - self._last_at >= age_limit:
             self._last_at = now
             try:
-                self._last = self._fetch().get(MAINTENANCE_ANNOTATION) or None
+                self._ann = self._fetch() or {}
+                self._last = self._ann.get(MAINTENANCE_ANNOTATION) or None
             except Exception:  # noqa: BLE001 — a flaky apiserver read must
                 pass           # not take down the training loop
         return self._last
+
+    def annotations(self, *, max_age: float | None = None) -> dict:
+        """The CR's annotations from the same rate-limited cache as
+        ``check()`` — the drain/suspend protocol reads its request marks
+        here. Last-known-good on fetch errors, like ``check()``."""
+        self.check(max_age=max_age)
+        return self._ann
 
     def _poll(self, stop: threading.Event) -> str | None:
         """The poller thread's fetch. Commits to the shared check() cache
@@ -247,10 +330,12 @@ class MaintenanceWatcher:
         wedged fetch returning late must not poison ``_last`` for direct
         check() callers (CheckpointGuard) or a successor poller."""
         try:
-            val = self._fetch().get(MAINTENANCE_ANNOTATION) or None
+            ann = self._fetch() or {}
+            val = ann.get(MAINTENANCE_ANNOTATION) or None
         except Exception:  # noqa: BLE001 — same policy as check()
             return self._last
         if not stop.is_set():
+            self._ann = ann
             self._last = val
             self._last_at = time.monotonic()
         return val
@@ -306,9 +391,15 @@ class MaintenanceWatcher:
             self._thread = None
 
 
+# Coordinated-signal bits (one broadcast carries both verdicts).
+_MAINTENANCE_BIT = 1
+_DRAIN_BIT = 2
+
+
 class CheckpointGuard:
     """Checkpoint on the manager's schedule — and immediately when the
-    control plane says the slice is about to lose a node.
+    control plane says the slice is about to lose a node, or asks the
+    gang to drain (preemption, idle cull, user suspend).
 
     Wraps utils/checkpoint.CheckpointManager: ``step()`` defers scheduled
     saves to the manager (its ``save_interval_steps`` is the one cadence
@@ -317,41 +408,156 @@ class CheckpointGuard:
     forced save per pending-transition — a long maintenance window
     doesn't re-save every step.
 
+    **Drain protocol** (kubeflow_tpu/migration): when the drain-requested
+    annotation appears, the guard snapshots immediately, waits for the
+    commit, and **acks** by patching the checkpointed-at / path / step
+    annotations onto its own CR — the control plane then parks the gang
+    and, on re-admission, stamps the same path/step back into the pod env
+    as the restore hint. After the ack the loop may keep stepping; the
+    park arrives as a normal scale-to-zero. ``drained`` reports that an
+    ack was committed this session.
+
     **Multi-host:** an Orbax save is a collective — every process must
     save the *same* step. Per-worker watchers poll on their own clocks,
-    so the pending decision is made by process 0 alone and broadcast to
-    the others (``broadcast_one_to_all``) every ``sync_every_steps``
-    steps. Call ``step()`` from every process with the same step number
-    (the normal SPMD loop); the collective only runs on sync steps, so
-    its cost amortizes. Single-process worlds skip the collective
-    entirely."""
+    so the pending/drain decision is made by process 0 alone and
+    broadcast to the others (``broadcast_one_to_all``) every
+    ``sync_every_steps`` steps. Call ``step()`` from every process with
+    the same step number (the normal SPMD loop); the collective only
+    runs on sync steps, so its cost amortizes. Single-process worlds —
+    and workers whose coordination client is not (yet) initialized, e.g.
+    joining mid-run — skip the collective and degrade to local-only
+    checks instead of raising into the training loop."""
 
     def __init__(self, manager: CheckpointManager,
                  watcher: MaintenanceWatcher | None = None, *,
-                 sync_every_steps: int = 16, environ=os.environ):
+                 sync_every_steps: int = 16, environ=os.environ,
+                 patcher=None):
         self.manager = manager
         self.watcher = watcher or MaintenanceWatcher(environ=environ)
         self.sync_every_steps = max(1, sync_every_steps)
         self._armed = True
+        self._drain_armed = True
+        self._environ = environ
+        self._patcher = patcher
+        self._ack_pending_step: int | None = None
+        self._warned_local_only = False
+        self.drained = False
+
+    def _local_signals(self) -> int:
+        ann = self.watcher.annotations()
+        bits = 0
+        if ann.get(MAINTENANCE_ANNOTATION):
+            bits |= _MAINTENANCE_BIT
+        if (ann.get(DRAIN_REQUESTED_ANNOTATION)
+                and not _migration.drain_acked(ann)):
+            bits |= _DRAIN_BIT
+        return bits
+
+    def _signals_coordinated(self) -> int:
+        """Process 0's watcher verdict (maintenance + drain bits), agreed
+        on by every process — degrading to this process's own local check
+        when the distributed client is unavailable (single-process world,
+        or a worker that joined before ``jax.distributed`` came up): a
+        missing coordination service must never raise into the training
+        loop, and local-only checks still converge because every worker
+        polls the same CR."""
+        try:
+            import jax
+
+            count = jax.process_count()
+            index = jax.process_index() if count > 1 else 0
+        except Exception:  # noqa: BLE001 — uninitialized backend/client
+            count, index = 1, 0
+        if count == 1:
+            return self._local_signals()
+        local = self._local_signals() if index == 0 else 0
+        try:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            return int(multihost_utils.broadcast_one_to_all(np.int32(local)))
+        except Exception:  # noqa: BLE001 — coordination client not ready
+            if not self._warned_local_only:
+                self._warned_local_only = True
+                _log.warning(
+                    "multi-host coordination unavailable; degrading to "
+                    "local-only maintenance/drain checks")
+            return self._local_signals()
 
     def _pending_coordinated(self) -> bool:
-        """Process 0's watcher verdict, agreed on by every process."""
-        import jax
+        """Back-compat shim: the maintenance bit of the coordinated
+        signals."""
+        return bool(self._signals_coordinated() & _MAINTENANCE_BIT)
 
-        if jax.process_count() == 1:
-            return bool(self.watcher.check())
-        import numpy as np
-        from jax.experimental import multihost_utils
+    def _try_ack(self, step: int) -> None:
+        """Patch the checkpoint ack onto this notebook's CR (process 0
+        only — one writer). Failure re-arms the pending ack; the next
+        sync step retries without re-saving."""
+        try:
+            import jax
 
-        local = 0
-        if jax.process_index() == 0:
-            local = 1 if self.watcher.check() else 0
-        flag = multihost_utils.broadcast_one_to_all(np.int32(local))
-        return bool(int(flag))
+            if jax.process_count() > 1 and jax.process_index() != 0:
+                self._ack_pending_step = None
+                return
+        except Exception:  # noqa: BLE001 — treat as single-process
+            pass
+        if self._patcher is None:
+            try:
+                self._patcher = _identity_patcher(self._environ)
+            except ValueError:
+                _log.warning("cannot ack drain: no notebook identity and "
+                             "no patcher provided")
+                self._ack_pending_step = None
+                return
+        directory = getattr(self.manager, "directory", "") or ""
+        # Echo the request being answered: ack detection compares the
+        # echo, not timestamps from two different clocks (pod vs
+        # controller — skew must not make acks invisible).
+        for_request = self.watcher.annotations().get(
+            DRAIN_REQUESTED_ANNOTATION)
+        try:
+            self._patcher(_migration.ack_patch(
+                directory, step, time.time(), for_request=for_request))
+            self._ack_pending_step = None
+        except Exception:  # noqa: BLE001 — flaky apiserver; retry later
+            _log.warning("drain ack patch failed; retrying next sync step")
+            self._ack_pending_step = step
+
+    def _mark_checkpointing(self) -> None:
+        """Best-effort progress mark so the UI can say "Checkpointing…"
+        while a large snapshot streams out."""
+        if self._patcher is None:
+            try:
+                self._patcher = _identity_patcher(self._environ)
+            except ValueError:
+                return
+        import datetime
+
+        try:
+            self._patcher({
+                "notebooks.kubeflow.org/checkpointing-at":
+                    datetime.datetime.now(
+                        datetime.timezone.utc).isoformat()})
+        except Exception:  # noqa: BLE001
+            pass
 
     def step(self, step: int, pytree) -> bool:
         if step % self.sync_every_steps == 0:
-            if self._pending_coordinated():
+            if self._ack_pending_step is not None:
+                self._try_ack(self._ack_pending_step)
+            signals = self._signals_coordinated()
+            if signals & _DRAIN_BIT:
+                if self._drain_armed:
+                    self._drain_armed = False
+                    self._mark_checkpointing()
+                    saved = self.manager.save(step, pytree, force=True)
+                    self.manager.wait()  # the ack promises a COMMITTED save
+                    self._try_ack(step)
+                    self.drained = True
+                    return saved
+            else:
+                self._drain_armed = True
+            if signals & _MAINTENANCE_BIT:
                 if self._armed:
                     self._armed = False
                     saved = self.manager.save(step, pytree, force=True)

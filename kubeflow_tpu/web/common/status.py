@@ -79,18 +79,31 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     # position and a chip count the user can act on — more specific than
     # the provisioning wait and any age/pod-state heuristic below.
     sched = deep_get(notebook, "status", "scheduler", default={}) or {}
+    mig = deep_get(notebook, "status", "migration", default={}) or {}
     if sched.get("state") == "Queued":
         return Status(
             WAITING,
             f"Queued for TPU capacity (position {sched.get('position', 0)},"
             f" waiting for {sched.get('waitingChips', 0)} chips)",
         )
+    if sched.get("state") == "Draining":
+        reason = sched.get("reason") or "capacity reclaimed"
+        return Status(
+            WAITING,
+            f"Checkpointing before preemption ({reason})…",
+        )
     if sched.get("state") == "Preempted" and ready == 0:
         reason = sched.get("reason") or "capacity reclaimed"
+        step = mig.get("checkpointStep")
+        restore = (
+            f"; restarts resume from checkpoint @ step {step}"
+            if step is not None and mig.get("checkpointedAt")
+            else ""
+        )
         return Status(
             STOPPED,
             f"Preempted by the TPU fleet scheduler ({reason}); "
-            "restart the server to re-queue",
+            f"restart the server to re-queue{restore}",
         )
 
     # Queued provisioning: nothing runs yet *by design* — more specific
@@ -107,11 +120,30 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
 
     if nbapi.STOP_ANNOTATION in annotations:
         if ready == 0:
+            if mig.get("state") == "Parked":
+                step = mig.get("checkpointStep")
+                return Status(
+                    STOPPED,
+                    f"Suspended (checkpoint @ step {step})"
+                    if step is not None else "Suspended (checkpoint saved)",
+                )
             return Status(STOPPED, "No Pods are currently running for this Notebook Server.")
         return Status(WAITING, "Notebook Server is stopping.")
 
     if meta.get("deletionTimestamp"):
         return Status(TERMINATING, "Deleting this Notebook Server.")
+
+    # Re-admitted with a checkpoint hint: workers are coming up and will
+    # restore where the drain left off — more specific than the generic
+    # partial-readiness message below.
+    if mig.get("state") == "Restoring" and ready < want_hosts:
+        step = mig.get("checkpointStep")
+        return Status(
+            WAITING,
+            "Restoring from checkpoint"
+            + (f" (step {step})" if step is not None else "")
+            + f" ({ready}/{want_hosts} workers ready)",
+        )
 
     if ready >= want_hosts and ready > 0:
         # Impending node maintenance (controller-mirrored taint): the

@@ -1,0 +1,752 @@
+"""Preempt-to-checkpoint migration (ISSUE 7).
+
+End-to-end over FakeKube + podsim + the real manager/controller/
+scheduler stack: preemption drains instead of killing, chips free only
+on the checkpoint ack (or the grace deadline — the hard-stop fallback),
+re-admission restores with the checkpoint hint in the pod env, culling
+and user suspend ride the same protocol, and the disabled modes stay
+byte-identical to the pre-migration behavior.
+"""
+
+import asyncio
+import time
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.culling import (
+    CullingOptions,
+    CullingReconciler,
+    _fmt_time,
+)
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.objects import deep_get, fmt_iso, get_meta
+from kubeflow_tpu.scheduler import (
+    Fleet,
+    SchedulerOptions,
+    TpuFleetScheduler,
+)
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.web.common.status import process_status
+from kubeflow_tpu.webhooks import register_all
+
+
+class Harness:
+    """Manager + notebook controller + podsim with a migration-enabled
+    fleet scheduler (the env path KFTPU_MIGRATION=on wires the same
+    options through cmd/envconfig.py)."""
+
+    def __init__(self, fleet: str = "pool-a=v5e:4x4:1",
+                 options: SchedulerOptions | None = None,
+                 nb_options: NotebookOptions | None = None):
+        self.kube = FakeKube()
+        register_all(self.kube)
+        # Isolated registry: metric asserts (drain fallback count, …)
+        # must not see increments from other tests in the same process.
+        from kubeflow_tpu.runtime.metrics import Registry
+
+        self.mgr = Manager(self.kube, registry=Registry())
+        self.sched = TpuFleetScheduler(
+            self.kube,
+            options or SchedulerOptions(
+                queued_requeue_seconds=0.05,
+                idle_preempt_after_seconds=0.2,
+                enable_migration=True,
+                drain_grace_seconds=15.0,
+            ),
+            fleet=Fleet.parse(fleet), registry=self.mgr.registry,
+        )
+        setup_notebook_controller(self.mgr, nb_options, scheduler=self.sched)
+        self.sim = PodSimulator(self.kube)
+
+    async def __aenter__(self):
+        await self.mgr.start()
+        await self.sim.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.sim.stop()
+        await self.mgr.stop()
+        self.kube.close_watches()
+
+    async def settle(self, rounds=6):
+        for _ in range(rounds):
+            await self.mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.02)
+
+    async def annotations(self, name: str, ns: str = "ns") -> dict:
+        nb = await self.kube.get("Notebook", name, ns)
+        return get_meta(nb).get("annotations") or {}
+
+    async def wait_for(self, predicate, what: str, timeout: float = 15.0):
+        deadline = time.perf_counter() + timeout
+        while True:
+            value = await predicate()
+            if value:
+                return value
+            assert time.perf_counter() < deadline, f"timed out: {what}"
+            await asyncio.sleep(0.01)
+
+    async def make_idle_holder(self, name: str = "victim", ns: str = "ns",
+                               **kw):
+        """Admitted gang whose culling signal says it idled an hour ago —
+        fair game for idle preemption once the window elapses."""
+        await self.kube.create("Notebook", nbapi.new(
+            name, ns, accelerator="v5e", topology="4x4", **kw))
+        await self.settle()
+        assert (ns, name) in self.sched.policy.ledger.allocations
+        await self.kube.patch(
+            "Notebook", name,
+            {"metadata": {"annotations": {
+                nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(
+                    time.time() - 3600)}}}, ns)
+        await asyncio.sleep(0.25)  # idle_preempt_after_seconds elapses
+        self.mgr.enqueue("notebook", (ns, name))
+        await self.mgr.wait_idle(timeout=20)
+
+    async def simulate_sdk_ack(self, name: str, ns: str = "ns",
+                               step: int = 700):
+        """What sdk.CheckpointGuard does on the drain signal: commit a
+        checkpoint, then patch the ack annotations."""
+        await self.kube.patch(
+            "Notebook", name,
+            {"metadata": {"annotations": migration.ack_patch(
+                f"/home/jovyan/ckpt/{name}", step, time.time())}}, ns)
+
+
+# ---- protocol unit tests -------------------------------------------------------
+
+
+def test_derive_state_transitions():
+    ann: dict = {}
+    assert migration.derive_state(ann, stopped=False) == migration.RUNNING
+    ann.update(migration.request_drain_patch("preempt:idle", 100.0))
+    ann = {k: v for k, v in ann.items() if v is not None}
+    assert migration.derive_state(ann, stopped=False) == \
+        migration.DRAIN_REQUESTED
+    ann[nbapi.CHECKPOINTING_AT_ANNOTATION] = fmt_iso(101.0)
+    assert migration.derive_state(ann, stopped=False) == \
+        migration.CHECKPOINTING
+    ann.update(migration.ack_patch("/ckpt", 42, 102.0))
+    assert migration.drain_acked(ann)
+    assert migration.derive_state(ann, stopped=False) == \
+        migration.CHECKPOINTED
+    assert migration.derive_state(ann, stopped=True) == migration.PARKED
+    # Re-admission: drain marks cleared, hint kept → Restoring until all
+    # workers are ready, then Running.
+    for k, v in migration.clear_drain_patch().items():
+        if v is None:
+            ann.pop(k, None)
+    assert migration.restore_hint(ann) == ("/ckpt", 42)
+    assert migration.derive_state(
+        ann, stopped=False, ready_hosts=0, want_hosts=2) == \
+        migration.RESTORING
+    assert migration.derive_state(
+        ann, stopped=False, ready_hosts=2, want_hosts=2) == migration.RUNNING
+
+
+def test_stale_checkpoint_does_not_ack_a_new_drain():
+    ann = dict(migration.ack_patch("/ckpt", 10, 50.0))
+    ann.update({k: v for k, v in migration.request_drain_patch(
+        "suspend", 100.0).items() if v is not None})
+    assert not migration.drain_acked(ann)       # ack predates the request
+    assert migration.drain_expired(ann, 100.0 + 999, 120.0)
+    assert not migration.drain_expired(ann, 100.0 + 1, 120.0)
+
+
+def test_env_knobs():
+    assert migration.migration_enabled({}) is True
+    assert migration.migration_enabled({"KFTPU_MIGRATION": "off"}) is False
+    assert migration.cull_drain_enabled({"KFTPU_CULL_DRAIN": "0"}) is False
+    assert migration.drain_grace_seconds({"KFTPU_DRAIN_GRACE": "45"}) == 45.0
+    assert migration.drain_grace_seconds({"KFTPU_DRAIN_GRACE": "junk"}) == \
+        migration.DEFAULT_DRAIN_GRACE_SECONDS
+    assert migration.drain_grace_seconds({"KFTPU_DRAIN_GRACE": "-5"}) == \
+        migration.DEFAULT_DRAIN_GRACE_SECONDS
+
+
+# ---- the end-to-end loop -------------------------------------------------------
+
+
+async def test_preemption_drains_then_migrates_end_to_end():
+    """The tentpole loop: preempt → drain → simulated SDK ack → chips
+    freed + waiter admitted → victim re-admitted later and restored with
+    its checkpoint hint."""
+    async with Harness() as h:
+        await h.make_idle_holder("victim")
+        await h.kube.create("Notebook", {
+            **nbapi.new("urgent", "ns", accelerator="v5e", topology="4x4"),
+            "metadata": {"name": "urgent", "namespace": "ns",
+                         "annotations": {
+                             nbapi.PRIORITY_ANNOTATION: "high"}},
+        })
+
+        # Drain requested, NOT a bare stop — and the chips stay booked
+        # (waiter still queued) until the ack.
+        async def drain_requested():
+            ann = await h.annotations("victim")
+            return migration.drain_requested_at(ann) is not None
+        await h.wait_for(drain_requested, "drain request on the victim")
+        ann = await h.annotations("victim")
+        assert nbapi.STOP_ANNOTATION not in ann
+        assert migration.drain_reason(ann) == "preempt:idle"
+        assert ("ns", "urgent") not in h.sched.policy.ledger.allocations
+        assert h.sched.policy.is_draining(("ns", "victim"))
+
+        # Draining surfaces in status + JWA while the victim still runs.
+        await h.settle(rounds=2)
+        victim = await h.kube.get("Notebook", "victim", "ns")
+        assert deep_get(victim, "status", "scheduler", "state") == "Draining"
+        st = process_status(victim)
+        assert "Checkpointing before preemption" in st.message
+
+        # SDK acks → victim parks with its checkpoint, waiter admits.
+        await h.simulate_sdk_ack("victim")
+
+        async def victim_parked():
+            ann = await h.annotations("victim")
+            return nbapi.STOP_ANNOTATION in ann
+        await h.wait_for(victim_parked, "victim parked after ack")
+        await h.wait_for(
+            lambda: _admitted(h.sched, ("ns", "urgent")),
+            "waiter admitted")
+        await h.settle()
+        ann = await h.annotations("victim")
+        assert ann.get(nbapi.CHECKPOINT_PATH_ANNOTATION) == \
+            "/home/jovyan/ckpt/victim"
+        assert ann.get(nbapi.CHECKPOINT_STEP_ANNOTATION) == "700"
+        assert nbapi.DRAIN_REQUESTED_ANNOTATION not in ann
+        h.sched.policy.ledger.assert_consistent()
+        assert h.sched.policy.ledger.violations == 0
+
+        # The victim's status: preempted, WITH the restore promise; the
+        # Checkpointed condition landed exactly once.
+        victim = await h.kube.get("Notebook", "victim", "ns")
+        st = process_status(victim)
+        assert st.phase == "stopped"
+        assert "resume from checkpoint @ step 700" in st.message
+        conds = [c for c in deep_get(victim, "status", "conditions",
+                                     default=[])
+                 if c.get("type") == "Checkpointed"]
+        assert len(conds) == 1
+        assert "step 700" in conds[0]["message"]
+
+        # Waiter finishes; victim restarts → re-admitted, restore hint
+        # stamped into the pod env.
+        await h.kube.patch(
+            "Notebook", "urgent",
+            {"metadata": {"annotations": {
+                nbapi.STOP_ANNOTATION: fmt_iso(time.time())}}}, "ns")
+        await h.settle()
+        await h.kube.patch(
+            "Notebook", "victim",
+            {"metadata": {"annotations": {
+                nbapi.STOP_ANNOTATION: None}}}, "ns")
+        await h.wait_for(
+            lambda: _admitted(h.sched, ("ns", "victim")),
+            "victim re-admitted")
+        await h.settle()
+        sts = await h.kube.get("StatefulSet", "victim", "ns")
+        env = deep_get(sts, "spec", "template", "spec", "containers",
+                       default=[{}])[0].get("env", [])
+        env_by_name = {e.get("name"): e.get("value") for e in env}
+        assert env_by_name.get(migration.RESTORE_PATH_ENV) == \
+            "/home/jovyan/ckpt/victim"
+        assert env_by_name.get(migration.RESTORE_STEP_ENV) == "700"
+        events = await h.kube.list("Event", "ns")
+        assert any(e.get("reason") == "Restoring" for e in events)
+        ann = await h.annotations("victim")
+        assert nbapi.PREEMPTED_ANNOTATION not in ann
+
+
+async def _admitted_helper(sched, key):
+    alloc = sched.policy.ledger.allocations.get(key)
+    return alloc is not None and not alloc.draining
+
+
+def _admitted(sched, key):
+    async def check():
+        alloc = sched.policy.ledger.allocations.get(key)
+        return alloc is not None and not alloc.draining
+    return check()
+
+
+async def test_grace_deadline_falls_back_to_hard_stop():
+    """Victim never acks → hard stop after the grace, ledger frees
+    exactly once, waiter admits, and the victim's status says
+    preempted-without-checkpoint."""
+    async with Harness(options=SchedulerOptions(
+            queued_requeue_seconds=0.05,
+            idle_preempt_after_seconds=0.2,
+            enable_migration=True,
+            drain_grace_seconds=0.4)) as h:
+        await h.make_idle_holder("victim")
+        await h.kube.create("Notebook", {
+            **nbapi.new("urgent", "ns", accelerator="v5e", topology="4x4"),
+            "metadata": {"name": "urgent", "namespace": "ns",
+                         "annotations": {
+                             nbapi.PRIORITY_ANNOTATION: "high"}},
+        })
+
+        async def drain_requested():
+            ann = await h.annotations("victim")
+            return migration.drain_requested_at(ann) is not None
+        await h.wait_for(drain_requested, "drain request")
+        assert ("ns", "urgent") not in h.sched.policy.ledger.allocations
+
+        # No ack ever arrives; the deadline-driven requeue hard-stops it.
+        async def victim_stopped():
+            ann = await h.annotations("victim")
+            return nbapi.STOP_ANNOTATION in ann
+        await h.wait_for(victim_stopped, "hard stop after grace")
+        await h.wait_for(
+            lambda: _admitted(h.sched, ("ns", "urgent")), "waiter admitted")
+        await h.settle()
+
+        ann = await h.annotations("victim")
+        assert ann.get(nbapi.PREEMPTED_ANNOTATION) == "idle"
+        assert nbapi.CHECKPOINT_PATH_ANNOTATION not in ann
+        assert nbapi.DRAIN_REQUESTED_ANNOTATION not in ann
+        assert h.sched.m_drain_fallback.labels().value == 1
+        # Freed exactly once: one gang's worth of chips moved, the ledger
+        # balances, and the victim is fully out.
+        h.sched.policy.ledger.assert_consistent()
+        assert h.sched.policy.ledger.violations == 0
+        assert ("ns", "victim") not in h.sched.policy.ledger.allocations
+        assert ("ns", "victim") not in h.sched._draining
+
+        victim = await h.kube.get("Notebook", "victim", "ns")
+        st = process_status(victim)
+        assert st.phase == "stopped"
+        assert "Preempted" in st.message
+        assert "checkpoint" not in st.message  # no false restore promise
+
+
+async def test_migration_disabled_is_immediate_stop():
+    """SchedulerOptions default (enable_migration=False) = PR 5 behavior:
+    the victim is stop-annotated in the same pass, no drain marks."""
+    async with Harness(options=SchedulerOptions(
+            queued_requeue_seconds=0.05,
+            idle_preempt_after_seconds=0.2)) as h:
+        await h.make_idle_holder("victim")
+        await h.kube.create("Notebook", {
+            **nbapi.new("urgent", "ns", accelerator="v5e", topology="4x4"),
+            "metadata": {"name": "urgent", "namespace": "ns",
+                         "annotations": {
+                             nbapi.PRIORITY_ANNOTATION: "high"}},
+        })
+
+        async def victim_stopped():
+            ann = await h.annotations("victim")
+            return nbapi.STOP_ANNOTATION in ann
+        await h.wait_for(victim_stopped, "immediate stop")
+        ann = await h.annotations("victim")
+        assert nbapi.DRAIN_REQUESTED_ANNOTATION not in ann
+        assert nbapi.CHECKPOINT_PATH_ANNOTATION not in ann
+        await h.wait_for(
+            lambda: _admitted(h.sched, ("ns", "urgent")), "waiter admitted")
+
+
+async def test_suspend_resume_rides_the_drain_protocol():
+    """User-facing suspend/resume: annotation → drain → ack → parked as
+    "Suspended (checkpoint @ step N)"; removing the annotation un-parks
+    and restores."""
+    async with Harness() as h:
+        await h.kube.create("Notebook", nbapi.new(
+            "nb", "ns", accelerator="v5e", topology="4x4"))
+        await h.settle()
+
+        await h.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {
+                nbapi.SUSPEND_ANNOTATION: fmt_iso(time.time())}}}, "ns")
+
+        async def drain_requested():
+            ann = await h.annotations("nb")
+            return migration.drain_reason(ann) == "suspend"
+        await h.wait_for(drain_requested, "suspend drain request")
+        ann = await h.annotations("nb")
+        assert nbapi.STOP_ANNOTATION not in ann  # still running: snapshotting
+
+        await h.simulate_sdk_ack("nb", step=1234)
+
+        async def parked():
+            ann = await h.annotations("nb")
+            return nbapi.STOP_ANNOTATION in ann
+        await h.wait_for(parked, "suspend parked on ack")
+        await h.settle()
+        nb = await h.kube.get("Notebook", "nb", "ns")
+        assert deep_get(nb, "status", "migration", "state") == \
+            migration.PARKED
+        st = process_status(nb)
+        assert st.message == "Suspended (checkpoint @ step 1234)"
+        # Parked = scaled to zero, admission handle released.
+        assert ("ns", "nb") not in h.sched.policy.ledger.allocations
+        sts = await h.kube.get("StatefulSet", "nb", "ns")
+        assert deep_get(sts, "spec", "replicas") == 0
+
+        # Resume: drop the annotation → un-parked, restored.
+        await h.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {
+                nbapi.SUSPEND_ANNOTATION: None}}}, "ns")
+        await h.wait_for(
+            lambda: _admitted(h.sched, ("ns", "nb")), "resumed")
+        await h.settle()
+        sts = await h.kube.get("StatefulSet", "nb", "ns")
+        assert (deep_get(sts, "spec", "replicas") or 0) >= 1  # un-parked
+        env = deep_get(sts, "spec", "template", "spec", "containers",
+                       default=[{}])[0].get("env", [])
+        env_by_name = {e.get("name"): e.get("value") for e in env}
+        assert env_by_name.get(migration.RESTORE_PATH_ENV) == \
+            "/home/jovyan/ckpt/nb"
+        events = await h.kube.list("Event", "ns")
+        assert any(e.get("reason") == "Resuming" for e in events)
+
+
+async def test_suspend_cancel_mid_drain():
+    """Removing the suspend annotation before the ack cancels the drain:
+    the notebook keeps running and the request marks clear."""
+    async with Harness() as h:
+        await h.kube.create("Notebook", nbapi.new(
+            "nb", "ns", accelerator="v5e", topology="4x4"))
+        await h.settle()
+        await h.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {
+                nbapi.SUSPEND_ANNOTATION: fmt_iso(time.time())}}}, "ns")
+
+        async def drain_requested():
+            ann = await h.annotations("nb")
+            return migration.drain_reason(ann) == "suspend"
+        await h.wait_for(drain_requested, "suspend drain request")
+        await h.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {
+                nbapi.SUSPEND_ANNOTATION: None}}}, "ns")
+
+        async def cancelled():
+            ann = await h.annotations("nb")
+            return migration.drain_requested_at(ann) is None
+        await h.wait_for(cancelled, "drain cancelled")
+        ann = await h.annotations("nb")
+        assert nbapi.STOP_ANNOTATION not in ann
+        assert ("ns", "nb") in h.sched.policy.ledger.allocations
+
+
+# ---- culling reuses the drain protocol -----------------------------------------
+
+
+def _clocked_culler(kube, clock, *, drain_on_cull=True, grace=100.0):
+    from kubeflow_tpu.runtime.metrics import Registry
+    from tests.test_culling import idle_kernels, make_prober
+
+    prober = make_prober({"kernels": idle_kernels(clock.t), "terminals": []})
+    rec = CullingReconciler(
+        kube, prober,
+        CullingOptions(cull_idle_seconds=600, drain_on_cull=drain_on_cull,
+                       drain_grace_seconds=grace),
+        clock=clock, registry=Registry())  # isolated counters — the
+    # global registry accumulates across the whole tier-1 process
+    return rec
+
+
+async def test_idle_cull_drains_then_stops_with_checkpoint():
+    from tests.test_culling import FakeClock, make_prober
+
+    kube = FakeKube()
+    clock = FakeClock()
+    rec = _clocked_culler(kube, clock)
+    await kube.create("Notebook", nbapi.new(
+        "nb", "ns", accelerator="v5e", topology="2x2"))
+    await rec.reconcile(("ns", "nb"))  # seeds last-activity = now
+
+    clock.t += 601
+    rec.prober = make_prober({"kernels": [], "terminals": []})
+    result = await rec.reconcile(("ns", "nb"))
+    assert result is not None  # draining, not parked: keep reconciling
+    nb = await kube.get("Notebook", "nb", "ns")
+    anns = get_meta(nb)["annotations"]
+    assert nbapi.STOP_ANNOTATION not in anns
+    assert migration.drain_reason(anns) == "cull"
+    events = await kube.list("Event", "ns")
+    assert any(e.get("reason") == "CullDrainRequested" for e in events)
+
+    # The SDK acks → next pass parks with the checkpoint kept.
+    await kube.patch(
+        "Notebook", "nb",
+        {"metadata": {"annotations": migration.ack_patch(
+            "/ckpt/nb", 55, clock.t + 1)}}, "ns")
+    clock.t += 2
+    result = await rec.reconcile(("ns", "nb"))
+    assert result is None
+    nb = await kube.get("Notebook", "nb", "ns")
+    anns = get_meta(nb)["annotations"]
+    assert nbapi.STOP_ANNOTATION in anns
+    assert anns.get(nbapi.CHECKPOINT_PATH_ANNOTATION) == "/ckpt/nb"
+    assert nbapi.DRAIN_REQUESTED_ANNOTATION not in anns
+    events = await kube.list("Event", "ns")
+    culled = [e for e in events if e.get("reason") == "NotebookCulled"]
+    assert culled and "step 55" in culled[-1]["message"]
+    assert rec.m_culled.labels().value == 1
+
+
+async def test_cull_drain_deadline_still_culls():
+    from tests.test_culling import FakeClock, make_prober
+
+    kube = FakeKube()
+    clock = FakeClock()
+    rec = _clocked_culler(kube, clock, grace=100.0)
+    await kube.create("Notebook", nbapi.new(
+        "nb", "ns", accelerator="v5e", topology="2x2"))
+    await rec.reconcile(("ns", "nb"))
+    clock.t += 601
+    rec.prober = make_prober({"kernels": [], "terminals": []})
+    await rec.reconcile(("ns", "nb"))  # requests the drain
+    clock.t += 101  # grace expires, no ack (no SDK loop running)
+    result = await rec.reconcile(("ns", "nb"))
+    assert result is None
+    nb = await kube.get("Notebook", "nb", "ns")
+    anns = get_meta(nb)["annotations"]
+    assert nbapi.STOP_ANNOTATION in anns
+    assert nbapi.CHECKPOINT_PATH_ANNOTATION not in anns
+    events = await kube.list("Event", "ns")
+    assert any(e.get("reason") == "CullDrainDeadlineExceeded"
+               for e in events)
+
+
+async def test_cull_drain_kill_switch_restores_bare_stop():
+    from tests.test_culling import FakeClock, make_prober
+
+    kube = FakeKube()
+    clock = FakeClock()
+    rec = _clocked_culler(kube, clock, drain_on_cull=False)
+    await kube.create("Notebook", nbapi.new(
+        "nb", "ns", accelerator="v5e", topology="2x2"))
+    await rec.reconcile(("ns", "nb"))
+    clock.t += 601
+    rec.prober = make_prober({"kernels": [], "terminals": []})
+    result = await rec.reconcile(("ns", "nb"))
+    assert result is None  # parked in ONE pass — the pre-migration path
+    nb = await kube.get("Notebook", "nb", "ns")
+    anns = get_meta(nb)["annotations"]
+    assert nbapi.STOP_ANNOTATION in anns
+    assert nbapi.DRAIN_REQUESTED_ANNOTATION not in anns
+
+
+async def test_culler_leaves_foreign_drains_alone():
+    """A preemption-owned drain must not be probed, culled, or finalized
+    by the culler — the scheduler owns that park."""
+    from tests.test_culling import FakeClock, make_prober
+
+    kube = FakeKube()
+    clock = FakeClock()
+    rec = _clocked_culler(kube, clock)
+    await kube.create("Notebook", nbapi.new(
+        "nb", "ns", accelerator="v5e", topology="2x2"))
+    await kube.patch(
+        "Notebook", "nb",
+        {"metadata": {"annotations": migration.request_drain_patch(
+            "preempt:idle", clock.t)}}, "ns")
+    rec.prober = make_prober({"kernels": [], "terminals": []})
+    result = await rec.reconcile(("ns", "nb"))
+    assert result is not None
+    assert not rec.prober.calls  # no probe under someone else's drain
+    nb = await kube.get("Notebook", "nb", "ns")
+    assert nbapi.STOP_ANNOTATION not in get_meta(nb)["annotations"]
+
+
+# ---- JWA status messages (satellite) -------------------------------------------
+
+
+def test_process_status_draining_message():
+    st = process_status({
+        "metadata": {"name": "nb", "namespace": "ns"},
+        "status": {"scheduler": {"state": "Draining", "reason": "idle"},
+                   "readyReplicas": 2, "tpu": {"hosts": 2}},
+    })
+    assert st.phase == "waiting"
+    assert st.message == "Checkpointing before preemption (idle)…"
+
+
+def test_process_status_suspended_with_step():
+    st = process_status({
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "annotations": {nbapi.STOP_ANNOTATION: "t"}},
+        "status": {"migration": {"state": "Parked", "checkpointStep": 9},
+                   "readyReplicas": 0},
+    })
+    assert st.phase == "stopped"
+    assert st.message == "Suspended (checkpoint @ step 9)"
+
+
+def test_process_status_restoring():
+    st = process_status({
+        "metadata": {"name": "nb", "namespace": "ns"},
+        "status": {"migration": {"state": "Restoring", "checkpointStep": 9},
+                   "readyReplicas": 1, "tpu": {"hosts": 4},
+                   "containerState": {"running": {}}},
+    })
+    assert st.phase == "waiting"
+    assert "Restoring from checkpoint (step 9)" in st.message
+    assert "1/4" in st.message
+
+
+def test_process_status_plain_stop_unchanged():
+    st = process_status({
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "annotations": {nbapi.STOP_ANNOTATION: "t"}},
+        "status": {"readyReplicas": 0},
+    })
+    assert st.message == \
+        "No Pods are currently running for this Notebook Server."
+
+
+# ---- debug surface -------------------------------------------------------------
+
+
+async def test_debug_scheduler_reports_draining():
+    async with Harness() as h:
+        await h.make_idle_holder("victim")
+        await h.kube.create("Notebook", {
+            **nbapi.new("urgent", "ns", accelerator="v5e", topology="4x4"),
+            "metadata": {"name": "urgent", "namespace": "ns",
+                         "annotations": {
+                             nbapi.PRIORITY_ANNOTATION: "high"}},
+        })
+
+        async def draining():
+            return ("ns", "victim") in h.sched._draining
+        await h.wait_for(draining, "drain recorded")
+        info = h.sched.debug_info()
+        assert info["migration_enabled"] is True
+        row = info["draining"]["ns/victim"]
+        assert row["reason"] == "idle"
+        assert row["for"] == "ns/urgent"
+        # The waiter's queue reason names the draining gang, not bare
+        # chip-waiting.
+        queue = {tuple(q["key"]): q for q in info["queue"]}
+        assert "draining" in queue[("ns", "urgent")]["reason"]
+
+
+async def test_cull_drain_cancelled_by_activity():
+    """The user comes back during the grace window: the drain cancels
+    instead of parking an actively-used server (the pre-migration code
+    sampled busyness at the stop decision; the grace window re-probes)."""
+    from tests.test_culling import FakeClock, busy_kernels, make_prober
+
+    kube = FakeKube()
+    clock = FakeClock()
+    rec = _clocked_culler(kube, clock)
+    await kube.create("Notebook", nbapi.new(
+        "nb", "ns", accelerator="v5e", topology="2x2"))
+    await rec.reconcile(("ns", "nb"))
+    clock.t += 601
+    rec.prober = make_prober({"kernels": [], "terminals": []})
+    await rec.reconcile(("ns", "nb"))  # requests the drain
+    # Mid-grace, a kernel goes busy.
+    clock.t += 10
+    rec.prober = make_prober(
+        {"kernels": busy_kernels(clock.t), "terminals": []})
+    result = await rec.reconcile(("ns", "nb"))
+    assert result is not None
+    nb = await kube.get("Notebook", "nb", "ns")
+    anns = get_meta(nb)["annotations"]
+    assert nbapi.STOP_ANNOTATION not in anns
+    assert nbapi.DRAIN_REQUESTED_ANNOTATION not in anns  # cancelled
+    events = await kube.list("Event", "ns")
+    assert any(e.get("reason") == "CullDrainCancelled" for e in events)
+    # Even past the original deadline the server must NOT park.
+    clock.t += 200
+    await rec.reconcile(("ns", "nb"))
+    nb = await kube.get("Notebook", "nb", "ns")
+    assert nbapi.STOP_ANNOTATION not in get_meta(nb)["annotations"]
+
+
+def test_drain_ack_is_clock_skew_immune():
+    """The ack echoes the raw request value, so a pod clock lagging the
+    controller must not make the ack invisible (grace fallback) — and a
+    stale echo from a previous cycle must not satisfy a new request."""
+    ann = dict(migration.request_drain_patch("preempt:idle", 1000.0))
+    ann = {k: v for k, v in ann.items() if v is not None}
+    # Pod clock 300s BEHIND the controller: timestamp ordering would say
+    # "not acked"; the echo says acked.
+    ann.update(migration.ack_patch(
+        "/ckpt", 7, 1000.0 - 300.0,
+        for_request=ann[nbapi.DRAIN_REQUESTED_ANNOTATION]))
+    assert migration.drain_acked(ann)
+    # A NEW drain cycle: the old echo no longer matches.
+    ann.update({k: v for k, v in migration.request_drain_patch(
+        "preempt:idle", 2000.0).items() if v is not None})
+    assert not migration.drain_acked(ann)
+
+
+def test_plain_stop_after_restore_is_not_suspended():
+    """The checkpoint hint survives re-admission (it's the restore hint),
+    but a later plain user stop has no fresh checkpoint — it must show as
+    a plain stop, not 'Suspended (checkpoint @ step N)'."""
+    # After re-admission the drain-reason is cleared; only the hint stays.
+    ann = {
+        nbapi.CHECKPOINT_PATH_ANNOTATION: "/ckpt",
+        nbapi.CHECKPOINT_STEP_ANNOTATION: "200",
+        nbapi.CHECKPOINTED_AT_ANNOTATION: fmt_iso(1000.0),
+    }
+    assert migration.derive_state(ann, stopped=True) == migration.RUNNING
+    st = process_status({
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "annotations": {nbapi.STOP_ANNOTATION: "t", **ann}},
+        "status": {"readyReplicas": 0,
+                   "migration": {"state": "Running",
+                                 "checkpointStep": 200}},
+    })
+    assert st.message == \
+        "No Pods are currently running for this Notebook Server."
+
+
+async def test_suspend_of_non_running_gang_parks_immediately():
+    """A queued/provisioning gang has no pods to checkpoint — suspend
+    parks it now instead of waiting out the drain grace."""
+    async with Harness() as h:
+        # Fleet holds one gang; this second one queues.
+        await h.kube.create("Notebook", nbapi.new(
+            "holder", "ns", accelerator="v5e", topology="4x4"))
+        await h.settle()
+        await h.kube.create("Notebook", nbapi.new(
+            "waiter", "ns", accelerator="v5e", topology="4x4"))
+        await h.settle()
+        assert ("ns", "waiter") not in h.sched.policy.ledger.allocations
+        await h.kube.patch(
+            "Notebook", "waiter",
+            {"metadata": {"annotations": {
+                nbapi.SUSPEND_ANNOTATION: fmt_iso(time.time())}}}, "ns")
+
+        async def parked():
+            ann = await h.annotations("waiter")
+            return nbapi.STOP_ANNOTATION in ann
+        await h.wait_for(parked, "queued gang parked immediately")
+        ann = await h.annotations("waiter")
+        assert nbapi.DRAIN_REQUESTED_ANNOTATION not in ann  # no drain
+
+
+async def test_restore_env_never_rolls_a_live_gang():
+    """The restore hint appearing on a RUNNING gang (cancelled suspend
+    after its ack) must not change the live StatefulSet template — env
+    updates only cross a park boundary."""
+    async with Harness() as h:
+        await h.kube.create("Notebook", nbapi.new(
+            "nb", "ns", accelerator="v5e", topology="4x4"))
+        await h.settle()
+        # A checkpoint hint lands while the gang keeps running (suspend
+        # acked, then cancelled before the park).
+        await h.simulate_sdk_ack("nb", step=42)
+        h.mgr.enqueue("notebook", ("ns", "nb"))
+        await h.settle()
+        sts = await h.kube.get("StatefulSet", "nb", "ns")
+        env = deep_get(sts, "spec", "template", "spec", "containers",
+                       default=[{}])[0].get("env", [])
+        names = {e.get("name") for e in env}
+        assert migration.RESTORE_PATH_ENV not in names  # template stable

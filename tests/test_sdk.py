@@ -426,3 +426,164 @@ def test_stopped_generation_late_fetch_does_not_poison_check_cache():
     old_thread.join(timeout=5)
     assert w.check(max_age=float("inf")) is None, \
         "stale fetch poisoned the shared cache"
+
+
+# ---- drain protocol (kubeflow_tpu/migration, ISSUE 7) --------------------------
+
+
+def _drain_request(ann, t=100.0):
+    from kubeflow_tpu.migration import protocol as migration
+
+    ann.update({k: v for k, v in migration.request_drain_patch(
+        "preempt:idle", t).items() if v is not None})
+
+
+def test_guard_acks_drain_with_committed_checkpoint(monkeypatch):
+    """The drain signal forces a save, waits for the commit, then acks by
+    patching checkpointed-at/path/step onto the CR — the restore hint the
+    control plane stamps back into the pod env on re-admission."""
+    from kubeflow_tpu.api.notebook import (
+        CHECKPOINT_PATH_ANNOTATION,
+        CHECKPOINT_STEP_ANNOTATION,
+        CHECKPOINTED_AT_ANNOTATION,
+    )
+
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    ann: dict = {}
+    patches = []
+
+    def patcher(annotations):
+        patches.append(dict(annotations))
+        for k, v in annotations.items():
+            if v is None:
+                ann.pop(k, None)
+            else:
+                ann[k] = v
+
+    mgr = FakeManager(interval=1000)
+    mgr.directory = "/home/jovyan/ckpt"
+    guard = sdk.CheckpointGuard(
+        mgr, make_watcher(ann, interval=0.0), sync_every_steps=1,
+        patcher=patcher)
+
+    assert guard.step(1, {}) is False         # no drain yet
+    _drain_request(ann)
+    clock.t = 1.0
+    assert guard.step(2, {}) is True          # forced + committed
+    assert mgr.saves[-1] == (2, True)
+    assert mgr.waits == 1
+    assert guard.drained is True
+    ack = patches[-1]
+    assert ack[CHECKPOINT_PATH_ANNOTATION] == "/home/jovyan/ckpt"
+    assert ack[CHECKPOINT_STEP_ANNOTATION] == "2"
+    assert CHECKPOINTED_AT_ANNOTATION in ack
+    # The ack satisfies the drain: no re-save every step while the park
+    # is in flight.
+    clock.t = 2.0
+    assert guard.step(3, {}) is False
+    assert mgr.waits == 1
+
+
+def test_guard_retries_failed_ack_without_resaving(monkeypatch):
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    ann: dict = {}
+    state = {"fail": True}
+    patches = []
+
+    def patcher(annotations):
+        if state["fail"]:
+            raise OSError("apiserver flake")
+        patches.append(dict(annotations))
+        ann.update(annotations)
+
+    mgr = FakeManager(interval=1000)
+    guard = sdk.CheckpointGuard(
+        mgr, make_watcher(ann, interval=0.0), sync_every_steps=1,
+        patcher=patcher)
+    _drain_request(ann)
+    clock.t = 1.0
+    assert guard.step(2, {}) is True          # saved + committed, ack failed
+    forced_saves = len(mgr.saves)
+    state["fail"] = False
+    clock.t = 2.0
+    guard.step(3, {})                         # retries the ACK only
+    assert patches, "ack was not retried"
+    assert len([s for s in mgr.saves if s[1]]) == \
+        len([s for s in mgr.saves[:forced_saves] if s[1]]), \
+        "retry must not re-force a save"
+
+
+def test_pending_coordinated_degrades_without_distributed_client(monkeypatch):
+    """A worker that joins mid-run has no coordination client yet —
+    broadcast raises. The guard must degrade to local-only checks, not
+    raise into the training loop (satellite fix)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+
+    def broken_broadcast(*a, **k):
+        raise RuntimeError("distributed client not initialized")
+
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all",
+                        broken_broadcast)
+    ann = {MAINTENANCE_ANNOTATION: "node-a"}
+    guard = sdk.CheckpointGuard(
+        FakeManager(interval=1000), make_watcher(ann, interval=0.0),
+        sync_every_steps=1, patcher=lambda a: None)
+    clock.t = 1.0
+    # Degrades to this process's own watcher verdict instead of raising.
+    assert guard._pending_coordinated() is True
+    del ann[MAINTENANCE_ANNOTATION]
+    clock.t = 2.0
+    assert guard._pending_coordinated() is False
+
+
+def test_pending_coordinated_survives_process_count_raise(monkeypatch):
+    import jax
+
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+
+    def broken_count():
+        raise RuntimeError("backend not initialized")
+
+    monkeypatch.setattr(jax, "process_count", broken_count)
+    ann = {MAINTENANCE_ANNOTATION: "node-a"}
+    guard = sdk.CheckpointGuard(
+        FakeManager(interval=1000), make_watcher(ann, interval=0.0),
+        sync_every_steps=1, patcher=lambda a: None)
+    clock.t = 1.0
+    assert guard._pending_coordinated() is True
+
+
+def test_suspend_resume_patch_shapes():
+    from kubeflow_tpu.api.notebook import SUSPEND_ANNOTATION
+
+    patches = []
+    sdk.suspend(patcher=lambda a: patches.append(a))
+    assert SUSPEND_ANNOTATION in patches[-1]
+    assert patches[-1][SUSPEND_ANNOTATION]
+    sdk.resume(patcher=lambda a: patches.append(a))
+    assert patches[-1] == {SUSPEND_ANNOTATION: None}
+
+
+def test_watcher_annotations_shares_rate_limit(monkeypatch):
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    calls = []
+
+    def fetch():
+        calls.append(1)
+        return {"a": "1", MAINTENANCE_ANNOTATION: "n"}
+
+    w = sdk.MaintenanceWatcher(fetch=fetch, interval=30.0)
+    clock.t = 100.0
+    assert w.annotations() == {"a": "1", MAINTENANCE_ANNOTATION: "n"}
+    assert w.check() == "n"
+    assert len(calls) == 1  # one fetch served both reads
