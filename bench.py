@@ -1159,6 +1159,52 @@ def migration_roundtrip(smoke: bool = False) -> dict:
     }
 
 
+def chaos_soak(smoke: bool = False) -> dict:
+    """`bench.py chaos_soak [--smoke]` — the chaos/self-healing
+    acceptance gate (ISSUE 9). Per seed: notebooks churn through the
+    scheduler + migration paths under a seeded API fault storm (5xx/429/
+    409 injection, mid-stream watch resets, stale LISTs) while the
+    Manager is killed and restarted mid-reconcile ≥3 times; after every
+    convergence the global invariants must hold — zero ledger
+    violations, no orphan/duplicate slice StatefulSets, no gang both
+    Admitted and Queued, every drain terminal, every workqueue drained,
+    zero permanently-wedged keys. Separately, a deliberately poisoned CR
+    must quarantine within the retry budget, surface the Degraded
+    condition + Warning Event + /debug/queue row, and resume on the next
+    spec edit. Chip-free: FakeKube + podsim + the real manager/
+    controller/scheduler stack; the same seeds replay in tier-1
+    (tests/test_chaos.py)."""
+    from kubeflow_tpu.testing.chaos import (
+        SoakConfig,
+        poison_scenario,
+        run_soak,
+    )
+
+    seeds = list(range(2)) if smoke else list(range(5))
+    reports = []
+    for seed in seeds:
+        report = asyncio.run(run_soak(SoakConfig(
+            seed=seed,
+            rounds=3,
+            storm_seconds=0.5 if smoke else 0.8,
+        )))
+        reports.append(report.to_dict())
+    poison = asyncio.run(poison_scenario(seed=0))
+    ok = all(r["ok"] for r in reports) and poison.get("pass", False) \
+        and all(r["manager_restarts"] >= 3 for r in reports)
+    return {
+        "metric": "chaos_soak",
+        "smoke": smoke,
+        "seeds": seeds,
+        "soaks": reports,
+        "poison": poison,
+        "total_injected": {
+            k: sum(r["injected"].get(k, 0) for r in reports)
+            for k in sorted({k for r in reports for k in r["injected"]})},
+        "pass": ok,
+    }
+
+
 def tracing_overhead() -> dict:
     """`bench.py tracing_overhead` — prove the always-on tracing path
     (span trees + flight recorder + API-call tagging, PR 3) costs <5% of
@@ -1430,6 +1476,13 @@ if __name__ == "__main__":
         print(json.dumps(result))
         # CI gate like scheduler_scale: a lost ack (grace fallback) or a
         # ledger violation must fail the step.
+        if not result["pass"]:
+            sys.exit(1)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "chaos_soak":
+        result = chaos_soak(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(result))
+        # CI gate: any invariant violation, wedged key, or a poison pill
+        # that fails to quarantine/resume must fail the step.
         if not result["pass"]:
             sys.exit(1)
     else:

@@ -71,6 +71,59 @@ DRAINS_ROUTE_RE = re.compile(r"result,\s*\"drains\"|result\.drains")
 POLICY_FILE = os.path.join(REPO, "kubeflow_tpu", "scheduler", "policy.py")
 DEFERRED_RE = re.compile(r"deferred_preemption")
 
+# Quarantine contract (ISSUE 9): dead-lettering a key must be observable
+# — the manager's quarantine path opens its span (lands in
+# /debug/traces) and emits the ReconcileQuarantined Warning Event +
+# Degraded condition. A refactor that silently drops either turns the
+# poison-pill dead-letter into an invisible black hole: the object just
+# stops reconciling with nothing anywhere saying so.
+MANAGER_FILE = os.path.join(REPO, "kubeflow_tpu", "runtime", "manager.py")
+QUEUE_FILE = os.path.join(REPO, "kubeflow_tpu", "runtime", "queue.py")
+# Either shape counts: the ROOT trace (tracer.trace — what lands in the
+# flight recorder) or a nested span; the manager opens both.
+QUARANTINE_SPAN_RE = re.compile(
+    r"(?:tracer\.trace|span)\(\s*['\"]quarantine['\"]")
+QUARANTINE_EVENT_RE = re.compile(r"['\"]ReconcileQuarantined['\"]")
+DEGRADED_RE = re.compile(r"['\"]Degraded['\"]")
+QUARANTINE_CALL_RE = re.compile(r"queue\.quarantine\(")
+
+
+def check_quarantine() -> list[str]:
+    problems = []
+    rel_mgr = os.path.relpath(MANAGER_FILE, REPO)
+    try:
+        src = open(MANAGER_FILE).read()
+    except OSError:
+        return [f"{rel_mgr}: missing"]
+    if not QUARANTINE_CALL_RE.search(src):
+        problems.append(
+            f"{rel_mgr}: the worker no longer quarantines exhausted keys "
+            "— a poison pill would retry at max backoff forever "
+            "(ISSUE 9 regression)")
+    if not QUARANTINE_SPAN_RE.search(src):
+        problems.append(
+            f"{rel_mgr}: the quarantine path opens no `quarantine` span — "
+            "dead-lettering must land in /debug/traces")
+    if not QUARANTINE_EVENT_RE.search(src):
+        problems.append(
+            f"{rel_mgr}: the quarantine path no longer emits the "
+            "ReconcileQuarantined Warning Event")
+    if not DEGRADED_RE.search(src):
+        problems.append(
+            f"{rel_mgr}: the quarantine path no longer stamps the "
+            "Degraded condition — the web apps and kubectl watchers "
+            "would see a silently-frozen object")
+    rel_q = os.path.relpath(QUEUE_FILE, REPO)
+    try:
+        qsrc = open(QUEUE_FILE).read()
+    except OSError:
+        return problems + [f"{rel_q}: missing"]
+    if "def release_quarantined" not in qsrc:
+        problems.append(
+            f"{rel_q}: release_quarantined is gone — the manual "
+            "/debug/queue/requeue escape hatch has nothing to call")
+    return problems
+
 
 def check_scheduler() -> list[str]:
     problems = []
@@ -186,6 +239,7 @@ def main() -> int:
             problems.extend(check_file(os.path.join(CONTROLLERS_DIR, fname)))
     problems.extend(check_scheduler())
     problems.extend(check_migration())
+    problems.extend(check_quarantine())
     for p in problems:
         print(f"check_tracing: {p}", file=sys.stderr)
     if not problems:

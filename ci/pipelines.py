@@ -113,6 +113,11 @@ def workflow_tests() -> dict:
                         "restore roundtrip)",
                         "python bench.py migration_roundtrip --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("Chaos smoke soak (API faults + manager "
+                        "kill/restart + poison-pill quarantine; exit 1 "
+                        "on any invariant violation)",
+                        "python bench.py chaos_soak --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

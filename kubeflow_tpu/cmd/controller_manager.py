@@ -34,7 +34,11 @@ def build_manager_app(mgr=None) -> web.Application:
       the span tree (queue wait, cache read, apply, status), API verbs,
       events, and outcome of recent reconciles, retained per object.
     - ``/debug/queue`` — per-controller workqueue depth, in-flight keys,
-      backoff keys with their next delay, oldest queue wait.
+      backoff keys with their next delay, quarantined (dead-lettered)
+      keys, oldest queue wait.
+    - ``POST /debug/queue/requeue?controller=notebook&namespace=ns&name=x``
+      — manual escape hatch for a quarantined key: releases it with a
+      fresh retry budget and reconciles it immediately.
     - ``/debug/informers`` — cache sync state, object counts, and
       secondary-index hit/miss per informer.
     - ``/debug/scheduler`` (when the fleet scheduler is wired) — pools
@@ -73,8 +77,35 @@ def build_manager_app(mgr=None) -> web.Application:
         async def debug_informers(_request):
             return web.json_response({"informers": mgr.debug_informers()})
 
+        async def debug_queue_requeue(request):
+            # Params from the query string or a JSON body ({"controller":
+            # ..., "namespace": ..., "name": ...}); cluster-scoped keys
+            # pass namespace="" (stored as None).
+            params = dict(request.query)
+            if not params:
+                try:
+                    params = await request.json()
+                except Exception:
+                    params = {}
+                if not isinstance(params, dict):
+                    params = {}  # valid JSON but not an object → 400 below
+            controller = params.get("controller", "")
+            name = params.get("name", "")
+            namespace = params.get("namespace") or None
+            if not controller or not name:
+                return web.json_response(
+                    {"error": "controller and name are required"},
+                    status=400)
+            released = mgr.requeue_quarantined(controller, (namespace, name))
+            return web.json_response(
+                {"released": released,
+                 "controller": controller,
+                 "key": f"{namespace or '-'}/{name}"},
+                status=200 if released else 404)
+
         app.router.add_get("/debug/traces", debug_traces)
         app.router.add_get("/debug/queue", debug_queue)
+        app.router.add_post("/debug/queue/requeue", debug_queue_requeue)
         app.router.add_get("/debug/informers", debug_informers)
 
         if getattr(mgr, "scheduler", None) is not None:

@@ -51,7 +51,7 @@ from kubeflow_tpu.runtime.apply import (
     reconcile_child,
     state_hash,
 )
-from kubeflow_tpu.runtime.errors import ApiError, Invalid, NotFound
+from kubeflow_tpu.runtime.errors import ApiError, Conflict, Invalid, NotFound
 from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.informer import (
     NAMESPACE_INDEX,
@@ -217,7 +217,8 @@ class NotebookReconciler:
     ):
         self.kube = kube
         self.opts = options or NotebookOptions()
-        self.recorder = EventRecorder(kube, "notebook-controller")
+        self.recorder = EventRecorder(kube, "notebook-controller",
+                                      registry=registry)
         # Fleet scheduler (kubeflow_tpu/scheduler): the cluster-level gang
         # arbiter the capacity stage consults before any slice StatefulSet
         # exists. None (bare-reconciler tests, KFTPU_SCHEDULER=off) or an
@@ -1698,6 +1699,17 @@ class NotebookReconciler:
         want_hosts = 0 if nbapi.is_stopped(nb) else (
             ms.total_hosts if ms else 1)
         conditions = list(deep_get(nb, "status", "conditions", default=[]))
+        # Quarantine self-heal: reaching the status phase proves this key
+        # is reconciling again (a quarantined key never runs), so any
+        # Degraded=True the manager stamped flips to False here — the one
+        # writer that cannot race the quarantine, because a reconcile that
+        # is still failing dies before this line.
+        conditions = [
+            {**c, "status": "False"}
+            if c.get("type") == "Degraded" and c.get("status") == "True"
+            else c
+            for c in conditions
+        ]
         # Scheduler transitions and container transitions interleave in
         # one history, so each family dedups against ITS most recent
         # entry — comparing against the list head would re-insert an
@@ -1783,6 +1795,13 @@ class NotebookReconciler:
                     "Notebook", name, {"status": status}, ns, subresource="status"
                 )
                 self._last_status[key] = (h, state_hash(stored.get("status")))
+            except Conflict:
+                # A conflicting status write means this reconcile ran on a
+                # stale read — re-raise so the workqueue retries with a
+                # fresh one. Swallowing (the old behavior, exposed by the
+                # conflict-storm test) left the CR's status stale until
+                # the next unrelated event.
+                raise
             except ApiError:
                 pass
         stopped = nbapi.is_stopped(nb)

@@ -12,6 +12,7 @@ from collections import OrderedDict
 
 from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.runtime.errors import AlreadyExists, ApiError, NotFound
+from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.runtime.objects import name_of, namespace_of, uid_of
 from kubeflow_tpu.runtime.objects import now_iso as _now
 
@@ -21,9 +22,18 @@ class EventRecorder:
     # controller; an evicted digest costs one GET on its next emit.
     CACHE_SIZE = 512
 
-    def __init__(self, kube, component: str):
+    def __init__(self, kube, component: str,
+                 registry: Registry | None = None):
         self.kube = kube
         self.component = component
+        # Events are best-effort BY CONTRACT: a failed create/patch (an
+        # injected 500, a saturated apiserver) must never fail the
+        # reconcile that emitted it — swallowed here, visible there.
+        self._emit_failures = (registry or global_registry).counter(
+            "events_emit_failures_total",
+            "Event create/patch attempts swallowed as best-effort",
+            ["component"],
+        )
         # (namespace, event-name) → last-written count. Steady-state
         # aggregation (the overwhelmingly common case: the same reason
         # re-emitted every reconcile) patches the count directly instead
@@ -72,6 +82,7 @@ class EventRecorder:
                 # The event expired between emits; create it fresh below.
                 self._known.pop(key, None)
             except ApiError:
+                self._emit_failures.labels(component=self.component).inc()
                 return
         # Cold miss: optimistic create — a brand-new event (the common
         # cold case) costs ONE round trip instead of GET + create; an
@@ -97,6 +108,7 @@ class EventRecorder:
         except AlreadyExists:
             pass
         except ApiError:
+            self._emit_failures.labels(component=self.component).inc()
             return  # events are best-effort
         try:
             existing = await self.kube.get("Event", name, namespace)
@@ -109,4 +121,5 @@ class EventRecorder:
             )
             self._remember(key, existing.get("count", 1) + 1)
         except ApiError:
+            self._emit_failures.labels(component=self.component).inc()
             self._known.pop(key, None)

@@ -16,6 +16,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
+import time
+import zlib
 from typing import Awaitable, Callable, Hashable
 
 from kubeflow_tpu.runtime.objects import (
@@ -69,13 +72,29 @@ class Informer:
         namespace: str | None = None,
         label_selector: str | dict | None = None,
         resync_backoff: float = 1.0,
+        resync_backoff_max: float = 30.0,
         registry=None,
     ):
         self.kube = kube
         self.kind = kind
         self.namespace = namespace
         self.label_selector = label_selector
+        # Relist storm control: ``resync_backoff`` is the BASE delay (a
+        # cleanly-closed watch relists after it); consecutive list/watch
+        # FAILURES escalate exponentially toward ``resync_backoff_max``
+        # with jitter, and any successful list resets the streak — a
+        # flapping apiserver sees a decorrelated trickle of LISTs, not a
+        # fixed-cadence hammer from every informer at once.
         self.resync_backoff = resync_backoff
+        self.resync_backoff_max = resync_backoff_max
+        self._consecutive_failures = 0
+        self._last_sync: float | None = None   # monotonic of last good list
+        self._current_backoff = resync_backoff
+        # Deterministic per-informer jitter stream — crc32, not hash():
+        # built-in str hashing is salted per process (PYTHONHASHSEED),
+        # which would make a chaos-soak seed irreproducible across runs.
+        self._jitter_rng = random.Random(zlib.crc32(
+            f"{kind}/{namespace}/{label_selector}".encode()))
         self.cache: dict[tuple[str | None, str], dict] = {}
         self._handlers: list[Handler] = []
         self._task: asyncio.Task | None = None
@@ -96,6 +115,25 @@ class Informer:
                 "informer_index_lookups_total",
                 "Secondary-index lookups per informer",
                 ["kind", "index", "result"],
+            )
+            if registry is not None
+            else None
+        )
+        self._relists_total = (
+            registry.counter(
+                "informer_relists_total",
+                "List attempts per informer (first sync + every relist)",
+                ["kind"],
+            )
+            if registry is not None
+            else None
+        )
+        self._sync_age = (
+            registry.gauge(
+                "informer_last_sync_age_seconds",
+                "Seconds since the informer's last successful list "
+                "(refreshed on sync and on every /debug/informers read)",
+                ["kind"],
             )
             if registry is not None
             else None
@@ -183,6 +221,14 @@ class Informer:
 
     def debug_info(self) -> dict:
         """JSON-shaped snapshot for the /debug/informers endpoint."""
+        sync_age = (
+            round(time.monotonic() - self._last_sync, 3)
+            if self._last_sync is not None else None
+        )
+        if self._sync_age is not None and sync_age is not None:
+            # /debug reads double as the gauge refresh (a plain gauge
+            # can't age itself between scrapes).
+            self._sync_age.labels(kind=self.kind).set(sync_age)
         return {
             "kind": self.kind,
             "namespace": self.namespace,
@@ -192,6 +238,12 @@ class Informer:
             "synced": self._synced.is_set(),
             "objects": len(self.cache),
             "relists": self._relists,
+            # Storm-control state: a flapping watch shows up as a failure
+            # streak + growing backoff + an aging last sync, instead of a
+            # fixed-cadence LIST hammer.
+            "consecutive_failures": self._consecutive_failures,
+            "current_backoff_sec": round(self._current_backoff, 3),
+            "last_sync_age_sec": sync_age,
             "indexes": {
                 name: {
                     "values": len(self._indexes.get(name, {})),
@@ -225,9 +277,18 @@ class Informer:
         while True:
             try:
                 self._relists += 1
+                if self._relists_total is not None:
+                    self._relists_total.labels(kind=self.kind).inc()
                 objs, rv = await self.kube.list_with_rv(
                     self.kind, self.namespace, self.label_selector
                 )
+                # A successful list resets the failure streak — backoff
+                # escalation is for CONSECUTIVE failures only.
+                self._consecutive_failures = 0
+                self._current_backoff = self.resync_backoff
+                self._last_sync = time.monotonic()
+                if self._sync_age is not None:
+                    self._sync_age.labels(kind=self.kind).set(0.0)
                 fresh = {key_of(o): o for o in objs}
                 for key, obj in list(self.cache.items()):
                     if key not in fresh:
@@ -250,9 +311,27 @@ class Informer:
                 ):
                     self._apply_delta(event, (namespace_of(obj), name_of(obj)), obj)
                     self._dispatch(event, obj)
-                # watch closed cleanly → relist
+                # Watch closed cleanly → relist after the base backoff,
+                # jittered DOWN like the failure path: an apiserver restart
+                # closes every informer's watch in the same instant, and a
+                # clean close must not relist in lockstep either.
+                delay = self.resync_backoff * \
+                    (1.0 - 0.25 * self._jitter_rng.random())
             except asyncio.CancelledError:
                 raise
             except Exception:
-                log.exception("informer %s list/watch failed; relisting", self.kind)
-            await asyncio.sleep(self.resync_backoff)
+                self._consecutive_failures += 1
+                delay = min(
+                    self.resync_backoff * (2 ** (self._consecutive_failures - 1)),
+                    self.resync_backoff_max,
+                )
+                # Jitter decorrelates the relist herd: every informer of a
+                # restarting apiserver would otherwise LIST in lockstep.
+                # Jittered DOWNWARD so the configured ceiling is a real
+                # ceiling (additive jitter would overshoot it by 25%).
+                delay *= 1.0 - 0.25 * self._jitter_rng.random()
+                self._current_backoff = delay
+                log.exception(
+                    "informer %s list/watch failed (%d in a row); relist "
+                    "in %.2fs", self.kind, self._consecutive_failures, delay)
+            await asyncio.sleep(delay)

@@ -18,16 +18,56 @@ The asyncio equivalent of controller-runtime's Manager/Builder:
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
+from kubeflow_tpu.runtime.errors import ApiError, Conflict
+from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.informer import OWNER_INDEX, Informer, index_by_owner_uid
 from kubeflow_tpu.runtime.metrics import Registry, global_registry
-from kubeflow_tpu.runtime.objects import controller_of, name_of, namespace_of
+from kubeflow_tpu.runtime.objects import (
+    controller_of,
+    deep_get,
+    get_meta,
+    name_of,
+    namespace_of,
+    now_iso,
+)
 from kubeflow_tpu.runtime.queue import RateLimitedQueue
+from kubeflow_tpu.runtime.tracing import span
 
 log = logging.getLogger(__name__)
+
+# Consecutive reconcile failures before a key is dead-lettered
+# (poison-pill quarantine, runtime/queue.py). 0 disables.
+DEFAULT_QUARANTINE_AFTER = 12
+
+
+def _quarantine_after_from_env(environ=os.environ) -> int:
+    raw = environ.get("KFTPU_QUARANTINE_AFTER")
+    try:
+        value = int(raw) if raw is not None else DEFAULT_QUARANTINE_AFTER
+    except ValueError:
+        return DEFAULT_QUARANTINE_AFTER
+    return max(0, value)
+
+
+def _change_token(obj: dict | None) -> str | None:
+    """Quarantine release token: a signature of the USER-EDITABLE half of
+    the object — everything but ``status``, with resourceVersion masked
+    out of metadata. Deliberately not the raw resourceVersion: the
+    manager's own Degraded status write bumps rv, and a quarantine that
+    released on its own announcement would flap forever. Computed only
+    for quarantined keys (rare), never on the hot delta path."""
+    if obj is None:
+        return None
+    body = {k: v for k, v in obj.items() if k not in ("status", "metadata")}
+    body["metadata"] = {k: v for k, v in get_meta(obj).items()
+                        if k not in ("resourceVersion", "managedFields")}
+    return json.dumps(body, sort_keys=True, default=str)
 
 Key = tuple  # (namespace | None, name)
 ReconcileFn = Callable[[Key], Awaitable["Result | None"]]
@@ -62,7 +102,9 @@ class Controller:
 
 
 class Manager:
-    def __init__(self, kube, *, registry: Registry | None = None, namespace: str | None = None):
+    def __init__(self, kube, *, registry: Registry | None = None,
+                 namespace: str | None = None,
+                 quarantine_after: int | None = None):
         self.kube = kube
         self.namespace = namespace
         self.registry = registry or global_registry
@@ -70,6 +112,18 @@ class Manager:
         self.informers: dict[tuple[str, str | None], Informer] = {}
         self._queues: dict[str, RateLimitedQueue] = {}
         self._tasks: list[asyncio.Task] = []
+        # Poison-pill quarantine budget (KFTPU_QUARANTINE_AFTER): a key
+        # failing this many reconciles in a row is dead-lettered instead
+        # of retrying at max backoff forever.
+        self.quarantine_after = (
+            quarantine_after if quarantine_after is not None
+            else _quarantine_after_from_env())
+        # ctrl name → its primary informer: the quarantine path reads the
+        # object's change token (release signal) and current status
+        # (Degraded condition insert) from the cache, not fresh GETs.
+        self._primaries: dict[str, Informer] = {}
+        self.events = EventRecorder(kube, "controller-manager",
+                                    registry=self.registry)
         from kubeflow_tpu.runtime.tracing import Tracer
 
         # The tracer owns the flight recorder: every reconcile's span tree
@@ -87,6 +141,11 @@ class Manager:
             "Reconcile latency per controller",
             ["controller"],
         )
+        self._quarantined_gauge = self.registry.gauge(
+            "workqueue_quarantined_keys",
+            "Keys dead-lettered after exhausting their retry budget",
+            ["controller"],
+        )
 
     def informer_for(
         self, kind: str, label_selector: str | dict | None = None
@@ -101,11 +160,38 @@ class Manager:
 
     def add_controller(self, ctrl: Controller) -> None:
         self.controllers.append(ctrl)
-        queue = RateLimitedQueue(coalesce_window=ctrl.coalesce_window)
+        queue = RateLimitedQueue(coalesce_window=ctrl.coalesce_window,
+                                 quarantine_after=self.quarantine_after)
         self._queues[ctrl.name] = queue
 
         primary = self.informer_for(ctrl.kind, ctrl.label_selector)
-        primary.add_handler(lambda _e, obj: queue.add((namespace_of(obj), name_of(obj))))
+        self._primaries[ctrl.name] = primary
+
+        def primary_handler(event: str, obj: dict) -> None:
+            key = (namespace_of(obj), name_of(obj))
+            if event == "DELETED":
+                # Failure-counter hygiene: the backoff/quarantine state
+                # dies with the object (an unbounded dict would otherwise
+                # leak one entry per ever-failed key). The add still runs
+                # so the reconcile observes the deletion and cleans up.
+                queue.forget(key)
+                queue.add(key)
+                self._sync_quarantine_gauge(ctrl.name, queue)
+                return
+            if not queue.is_quarantined(key):
+                queue.add(key)
+                return
+            # Quarantined key: the delta's change token (metadata+spec
+            # signature, computed only here — never on the hot path) is
+            # the release signal. A CHANGED object gets a fresh retry
+            # budget; same-token re-deliveries (relists, status-only
+            # writes) leave the poison pill parked.
+            if queue.add(key, token=_change_token(obj)):
+                log.info("quarantine released for %s %s: object changed",
+                         ctrl.kind, key)
+                self._sync_quarantine_gauge(ctrl.name, queue)
+
+        primary.add_handler(primary_handler)
 
         def owner_handler(_event: str, obj: dict) -> None:
             ref = controller_of(obj)
@@ -183,6 +269,73 @@ class Manager:
             await asyncio.sleep(0.01)
         raise TimeoutError("manager queues did not drain")
 
+    # ---- poison-pill quarantine ------------------------------------------------
+
+    def _sync_quarantine_gauge(self, name: str, queue: RateLimitedQueue) -> None:
+        self._quarantined_gauge.labels(controller=name).set(
+            len(queue.quarantined_keys()))
+
+    async def _announce_quarantine(self, ctrl: Controller, key,
+                                   queue: RateLimitedQueue,
+                                   cached: dict | None) -> None:
+        """Surface a quarantine on the object itself: a Degraded status
+        condition (what the web apps and kubectl watchers read) and a
+        Warning Event. Best-effort — the object may be exactly what's
+        broken — and traced, so /debug/traces shows the dead-lettering."""
+        ns, name = key
+        failures = queue.poison_streak(key)
+        # A ROOT trace, not a bare span: the reconcile root that led here
+        # already closed (the exception left its `with`), and only root
+        # traces reach the flight recorder — the dead-lettering must show
+        # up under /debug/traces?key=<ns>/<name>.
+        with self.tracer.trace("quarantine", controller=ctrl.name,
+                               key=key), \
+                span("quarantine", key=f"{ns}/{name}", failures=failures):
+            obj = cached
+            if obj is None:
+                try:
+                    obj = await self.kube.get_or_none(ctrl.kind, name, ns)
+                except ApiError:
+                    obj = None
+            if obj is None:
+                return
+            message = (
+                f"reconcile failed {failures} times in a row; reconciliation "
+                "suspended until the spec changes or an operator requeues "
+                "the key (POST /debug/queue/requeue)")
+            condition = {
+                "type": "Degraded",
+                "status": "True",
+                "lastProbeTime": now_iso(),
+                "reason": "ReconcileQuarantined",
+                "message": message,
+            }
+            conditions = [condition] + [
+                c for c in deep_get(obj, "status", "conditions", default=[])
+                if c.get("type") != "Degraded"
+            ][:7]
+            try:
+                await self.kube.patch(
+                    ctrl.kind, name, {"status": {"conditions": conditions}},
+                    ns, subresource="status")
+            except ApiError:
+                pass
+            await self.events.event(
+                obj, "Warning", "ReconcileQuarantined", message)
+
+    def requeue_quarantined(self, controller_name: str, key) -> bool:
+        """Manual escape hatch behind POST /debug/queue/requeue: un-park a
+        dead-lettered key with a fresh retry budget."""
+        queue = self._queues.get(controller_name)
+        if queue is None:
+            return False
+        released = queue.release_quarantined(tuple(key))
+        if released:
+            log.info("quarantine released for %s %s: manual requeue",
+                     controller_name, key)
+            self._sync_quarantine_gauge(controller_name, queue)
+        return released
+
     # ---- /debug introspection --------------------------------------------------
 
     def debug_traces(self, key=None, limit: int = 50) -> list[dict]:
@@ -218,14 +371,39 @@ class Manager:
                     # inject it so the trace covers queue→done end to end.
                     root.add_synthetic("queue_wait", queue_wait)
                     result = await ctrl.reconcile(key)
-            except Exception:
+            except Exception as exc:
                 log.exception("reconcile %s %s failed", ctrl.name, key)
                 self._reconcile_total.labels(controller=ctrl.name, result="error").inc()
                 # Record the failure BEFORE done(): if the key went dirty in
                 # flight, done() re-queues it with this failure's backoff.
-                queue.note_failure(key)
-                queue.done(key)
-                queue.add(key, queue.backoff_delay(key))
+                # Conflicts are optimistic-concurrency noise (a stale read
+                # racing another writer), not poison — they back off but
+                # never advance the quarantine streak: a 409 storm
+                # self-heals the moment it lifts, and quarantining healthy
+                # keys through an apiserver incident would strand them
+                # until a spec edit.
+                queue.note_failure(key,
+                                   poisonous=not isinstance(exc, Conflict))
+                went_dirty = queue.done(key)
+                if queue.should_quarantine(key) and not went_dirty:
+                    # A dirty key means the object changed WHILE this
+                    # (stale) attempt was failing — quarantining now would
+                    # record the edited object's token and park the user's
+                    # fix unseen. Let the dirty re-add run; a truly
+                    # poisoned key fails that attempt too and quarantines
+                    # on the next non-dirty cycle.
+                    # Poison pill: the key exhausted its consecutive-
+                    # failure budget — park it in the dead-letter set
+                    # instead of retrying at max backoff forever, and say
+                    # so on the object (Degraded condition + Warning
+                    # Event). A spec change (new informer delta rv) or
+                    # POST /debug/queue/requeue releases it.
+                    cached = self._primaries[ctrl.name].get(key[1], key[0])
+                    queue.quarantine(key, token=_change_token(cached))
+                    self._sync_quarantine_gauge(ctrl.name, queue)
+                    await self._announce_quarantine(ctrl, key, queue, cached)
+                else:
+                    queue.add(key, queue.backoff_delay(key))
             else:
                 queue.forget(key)
                 self._reconcile_total.labels(controller=ctrl.name, result="success").inc()

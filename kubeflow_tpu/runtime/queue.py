@@ -10,6 +10,21 @@ to a Notebook reconcile, SURVEY.md §3.1).
 — a slice's worth of pod status flaps, say — collapses into ONE reconcile
 at window close instead of one per event. Explicit delays (backoff,
 requeue_after) are never stretched by the window.
+
+``quarantine_after`` adds poison-pill quarantine (dead-lettering): a key
+whose reconcile fails that many times IN A ROW is parked in a quarantine
+set instead of retrying at max backoff forever — a permanently-broken
+object must not eat a worker slot and a log line every ``max_delay``
+until the end of time. A quarantined key is released (failure budget
+reset, re-queued immediately) when its object actually CHANGES — add()
+carries an opaque change token (the manager derives it from metadata +
+spec, NOT resourceVersion: the manager's own Degraded status write bumps
+rv and must not free the pill it just parked), and a differing token is
+the release signal — or via the manual escape hatch
+(``release_quarantined``, surfaced as POST /debug/queue/requeue).
+Same-token re-deliveries (relists, status-only writes) do not release:
+the user-editable half of the object is unchanged, so the reconcile
+would only fail the same way again.
 """
 
 from __future__ import annotations
@@ -26,10 +41,13 @@ class RateLimitedQueue:
         base_delay: float = 0.005,
         max_delay: float = 60.0,
         coalesce_window: float = 0.0,
+        quarantine_after: int = 0,
     ):
         self.base_delay = base_delay
         self.max_delay = max_delay
         self.coalesce_window = coalesce_window
+        # Consecutive failures before a key is dead-lettered; 0 disables.
+        self.quarantine_after = quarantine_after
         self.peak_depth = 0  # high-water mark of queued keys (bench telemetry)
         self._queue: list[tuple[float, int, Hashable]] = []  # (ready_at, seq, key)
         self._seq = 0
@@ -38,6 +56,17 @@ class RateLimitedQueue:
         self._in_flight: set[Hashable] = set()
         self._dirty: set[Hashable] = set()  # re-added while in flight
         self._failures: dict[Hashable, int] = {}
+        # Consecutive POISONOUS failures (the quarantine budget). Tracked
+        # apart from _failures: a 409 Conflict backs off like any error
+        # but is optimistic-concurrency noise, not poison — it must
+        # neither advance this streak nor (being neutral evidence) reset
+        # it, or a conflict storm plus one trailing 5xx would dead-letter
+        # a healthy key.
+        self._poison_streak: dict[Hashable, int] = {}
+        # key → (change token at quarantine time | None, monotonic
+        # quarantined-at). Keys here are parked: add() drops them unless
+        # the delta's token proves the object changed.
+        self._quarantined: dict[Hashable, tuple[str | None, float]] = {}
         # Queue-wait telemetry: after get(), how long the popped key sat
         # READY (past its ready_at) before a worker picked it up — pure
         # contention signal; intentional backoff/requeue_after delay is
@@ -56,12 +85,27 @@ class RateLimitedQueue:
         now = time.monotonic()
         return sum(1 for t in self._earliest.values() if t <= now)
 
-    def add(self, key: Hashable, delay: float = 0.0) -> None:
+    def add(self, key: Hashable, delay: float = 0.0, *,
+            token: str | None = None) -> bool:
+        """Queue a key. ``token`` is the object's opaque change token
+        (metadata+spec signature), when the caller has one: it is ONLY
+        consulted for quarantined keys, where a changed token is the
+        release signal. Returns True iff this add released the key from
+        quarantine."""
         if self._closed:
-            return
+            return False
+        released = False
+        if key in self._quarantined:
+            held_token, _since = self._quarantined[key]
+            if token is None or token == held_token:
+                return False  # unchanged object: stay parked
+            self._quarantined.pop(key)
+            self._failures.pop(key, None)  # fresh budget for the new spec
+            self._poison_streak.pop(key, None)
+            released = True
         if key in self._in_flight:
             self._dirty.add(key)
-            return
+            return released
         if delay == 0.0 and self.coalesce_window:
             # Event-driven adds ride the coalescing window; because an add
             # may only move a key EARLIER (below), every event inside the
@@ -76,7 +120,7 @@ class RateLimitedQueue:
             # behind a long requeue_after/backoff entry). Push a second heap
             # entry; get() takes the earliest and drops stale duplicates.
             if ready_at >= self._earliest.get(key, float("inf")):
-                return
+                return released
         else:
             self._queued.add(key)
             self.peak_depth = max(self.peak_depth, len(self._queued))
@@ -84,9 +128,14 @@ class RateLimitedQueue:
         self._seq += 1
         heapq.heappush(self._queue, (ready_at, self._seq, key))
         self._event.set()
+        return released
 
-    def note_failure(self, key: Hashable) -> None:
+    def note_failure(self, key: Hashable, *, poisonous: bool = True) -> None:
+        """Record a failed reconcile. ``poisonous=False`` (409 Conflicts)
+        still grows the backoff but never the quarantine streak."""
         self._failures[key] = self._failures.get(key, 0) + 1
+        if poisonous:
+            self._poison_streak[key] = self._poison_streak.get(key, 0) + 1
 
     def backoff_delay(self, key: Hashable) -> float:
         failures = self._failures.get(key, 0)
@@ -100,7 +149,55 @@ class RateLimitedQueue:
         self.add(key, self.backoff_delay(key))
 
     def forget(self, key: Hashable) -> None:
+        """Drop the key's failure state — called on success AND on object
+        deletion (informer DELETED), so the failure map and quarantine set
+        cannot leak one entry per ever-failed key forever."""
         self._failures.pop(key, None)
+        self._poison_streak.pop(key, None)
+        self._quarantined.pop(key, None)
+        self._last_wait.pop(key, None)
+
+    # ---- poison-pill quarantine ------------------------------------------------
+
+    def poison_streak(self, key: Hashable) -> int:
+        """Consecutive poisonous failures recorded for the key — the
+        number the quarantine budget compares against (callers must not
+        reach into the internal maps)."""
+        return self._poison_streak.get(key, 0)
+
+    def should_quarantine(self, key: Hashable) -> bool:
+        """Has the key exhausted its consecutive-failure budget?"""
+        return (self.quarantine_after > 0
+                and self._poison_streak.get(key, 0) >= self.quarantine_after)
+
+    def quarantine(self, key: Hashable, token: str | None = None) -> None:
+        """Dead-letter the key: it leaves the queue entirely (any pending
+        heap entries go stale) and no add() re-queues it until its object
+        changes (token differs) or release_quarantined() is called.
+        ``token`` is the object's change token as of quarantine time."""
+        if key in self._quarantined:
+            return
+        self._quarantined[key] = (token, time.monotonic())
+        self._queued.discard(key)
+        self._earliest.pop(key, None)
+        self._dirty.discard(key)
+
+    def release_quarantined(self, key: Hashable) -> bool:
+        """Manual escape hatch (POST /debug/queue/requeue): un-park the
+        key with a fresh failure budget and queue it immediately."""
+        if key not in self._quarantined:
+            return False
+        self._quarantined.pop(key)
+        self._failures.pop(key, None)
+        self._poison_streak.pop(key, None)
+        self.add(key)
+        return True
+
+    def quarantined_keys(self) -> list[Hashable]:
+        return list(self._quarantined)
+
+    def is_quarantined(self, key: Hashable) -> bool:
+        return key in self._quarantined
 
     async def get(self) -> Hashable | None:
         """Next ready key, or None when the queue is shut down."""
@@ -136,14 +233,23 @@ class RateLimitedQueue:
             except asyncio.TimeoutError:
                 pass
 
-    def done(self, key: Hashable) -> None:
+    def done(self, key: Hashable) -> bool:
+        """Finish processing a key. Returns True iff the key had gone
+        dirty in flight (and was re-queued) — new information arrived
+        DURING the reconcile, which the manager's quarantine gate must
+        honor: dead-lettering on the stale attempt would capture the
+        already-changed object's token and park the user's fix forever."""
         self._in_flight.discard(key)
         if key in self._dirty:
             self._dirty.discard(key)
+            if key in self._quarantined:
+                return False  # parked: the dirty re-add must not resurrect it
             # A dirty key that has recorded failures re-queues with its
             # backoff, not immediately — otherwise a failing reconciler that
             # touches its own children retries in a hot loop.
             self.add(key, self.backoff_delay(key))
+            return True
+        return False
 
     def take_wait(self, key: Hashable) -> float:
         """Queue wait of the most recent get() of ``key`` — time the key
@@ -169,6 +275,17 @@ class RateLimitedQueue:
                     "next_delay_sec": round(self.backoff_delay(k), 4),
                 }
                 for k, n in sorted(self._failures.items(), key=lambda kv: str(kv[0]))
+                if k not in self._quarantined
+            },
+            # Dead-lettered keys: reconcile suspended until the object
+            # changes or an operator hits /debug/queue/requeue.
+            "quarantined": {
+                str(k): {
+                    "failures": self._failures.get(k, 0),
+                    "since_sec": round(now - since, 3),
+                }
+                for k, (_token, since) in sorted(
+                    self._quarantined.items(), key=lambda kv: str(kv[0]))
             },
             # Longest a currently-READY key has been waiting for a worker
             # (keys still inside an intentional delay don't count — their

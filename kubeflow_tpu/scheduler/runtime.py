@@ -162,7 +162,8 @@ class TpuFleetScheduler:
     ):
         self.kube = kube
         self.options = options or SchedulerOptions()
-        self.recorder = EventRecorder(kube, "tpu-fleet-scheduler")
+        self.recorder = EventRecorder(kube, "tpu-fleet-scheduler",
+                                      registry=registry)
         if fleet is None and self.options.fleet_spec and \
                 self.options.fleet_spec != "auto":
             fleet = Fleet.parse(self.options.fleet_spec)  # fail fast

@@ -9,7 +9,7 @@ TPU runtime harness for multi-host wiring tests (SURVEY.md §4 "fake TPU
 runtime").
 """
 
-from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.fakekube import FakeKube, FaultPlan
 from kubeflow_tpu.testing.podsim import PodSimulator
 
-__all__ = ["FakeKube", "PodSimulator"]
+__all__ = ["FakeKube", "FaultPlan", "PodSimulator"]

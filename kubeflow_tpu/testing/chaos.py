@@ -1,0 +1,587 @@
+"""Chaos soak harness: crash/restart + API-fault churn with invariant gates.
+
+The control plane's correctness claims — chip ledger invariants (PR 5),
+the multi-step drain/restore protocol (PR 6), DAG-parallel applies
+(PR 4) — were only ever exercised on a well-behaved FakeKube. This
+module drives the REAL manager/controller/scheduler/migration stack
+through seeded fault storms (:class:`~kubeflow_tpu.testing.fakekube.
+FaultPlan`: 5xx/429/409 injection, watch resets, stale LISTs) while
+killing and restarting the Manager mid-reconcile, then asserts the
+global invariants every convergence must restore:
+
+- zero ``ChipLedger.violations`` and a self-consistent ledger;
+- no gang both Admitted and Queued;
+- no orphaned or duplicate slice StatefulSets (and none for Queued gangs);
+- every drain terminal — Parked, restored, or hard-stopped — none wedged;
+- every workqueue fully drained, no key stuck at max backoff forever
+  (transient quarantines must release through the escape hatch).
+
+``bench.py chaos_soak [--smoke]`` runs this over ≥5 seeds as the CI
+gate; tests/test_chaos.py replays the same seeds in tier-1.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.runtime.errors import ApiError
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import Registry
+from kubeflow_tpu.runtime.objects import (
+    annotations_of,
+    deep_get,
+    fmt_iso,
+    get_meta,
+    name_of,
+    namespace_of,
+)
+from kubeflow_tpu.scheduler import (
+    Fleet,
+    SchedulerOptions,
+    TpuFleetScheduler,
+)
+from kubeflow_tpu.testing.fakekube import FakeKube, FaultPlan
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+
+
+@dataclass
+class SoakConfig:
+    """One seeded soak run. Defaults are the tier-1/smoke shape; the full
+    bench widens notebooks/rounds, not the semantics."""
+
+    seed: int = 0
+    namespaces: int = 2
+    notebooks_per_namespace: int = 2
+    # Manager kill/restart cycles; each round is storm → kill → restart
+    # under faults → repair → converge → invariant check.
+    rounds: int = 3
+    storm_seconds: float = 0.8
+    fleet: str = "pool-a=v5e:4x4:2"
+    fault_rate: float = 0.12
+    watch_reset_rate: float = 0.04
+    stale_list_rate: float = 0.15
+    quarantine_after: int = 25
+    drain_grace_seconds: float = 2.0
+    converge_timeout: float = 30.0
+
+
+@dataclass
+class SoakReport:
+    seed: int = 0
+    rounds: int = 0
+    manager_restarts: int = 0
+    actions: int = 0
+    injected: dict = field(default_factory=dict)
+    ledger_violations: int = 0
+    quarantined_transient: int = 0
+    problems: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems and self.ledger_violations == 0
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "rounds": self.rounds,
+            "manager_restarts": self.manager_restarts,
+            "actions": self.actions,
+            "injected": dict(sorted(self.injected.items())),
+            "ledger_violations": self.ledger_violations,
+            "quarantined_transient": self.quarantined_transient,
+            "problems": list(self.problems),
+            "ok": self.ok,
+        }
+
+
+# ---- invariant checks ----------------------------------------------------------
+
+
+async def check_invariants(kube: FakeKube, mgr: Manager,
+                           sched: TpuFleetScheduler) -> list[str]:
+    """The global truths every convergence must restore; returns human-
+    readable violations (empty = healthy). Reads the store and in-memory
+    scheduler state directly — no fault plan should be active."""
+    problems: list[str] = []
+    ledger = sched.policy.ledger
+    if ledger.violations:
+        problems.append(f"ledger violations counter = {ledger.violations}")
+    try:
+        ledger.assert_consistent()
+    except Exception as e:  # LedgerError
+        problems.append(f"ledger inconsistent: {e}")
+
+    admitted = set(ledger.allocations)
+    queued = set(sched.policy.pending)
+    both = admitted & queued
+    if both:
+        problems.append(f"gangs both Admitted and Queued: {sorted(both)}")
+
+    # Drain terminality: nothing mid-drain at convergence — every drain
+    # must have ended Parked (ack), restored, or hard-stopped (deadline).
+    if sched._draining:
+        problems.append(
+            f"non-terminal drains: {sorted(sched._draining)}")
+
+    notebooks = await kube.list("Notebook")
+    by_uid: dict[str, dict] = {}
+    expected_sts: dict[tuple, set] = {}
+    for nb in notebooks:
+        key = (namespace_of(nb), name_of(nb))
+        by_uid[get_meta(nb).get("uid")] = nb
+        try:
+            ms = nbapi.multi_slice_of(nb)
+        except Exception:
+            ms = None
+        expected_sts[key] = (
+            {ms.slice_sts_name(key[1], j) for j in range(ms.num_slices)}
+            if ms else {key[1]}
+        )
+        ann = annotations_of(nb)
+        if (migration.drain_requested_at(ann) is not None
+                and not nbapi.is_stopped(nb)):
+            problems.append(
+                f"{key[0]}/{key[1]}: drain-requested but neither parked "
+                "nor finalized (wedged drain)")
+
+    sts_seen: dict[tuple, list] = {}
+    for sts in await kube.list("StatefulSet"):
+        ref = next((r for r in get_meta(sts).get("ownerReferences", [])
+                    if r.get("controller") and r.get("kind") == "Notebook"),
+                   None)
+        if ref is None:
+            continue
+        owner = by_uid.get(ref.get("uid"))
+        if owner is None:
+            problems.append(
+                f"orphan StatefulSet {namespace_of(sts)}/{name_of(sts)}: "
+                "owner Notebook gone")
+            continue
+        okey = (namespace_of(owner), name_of(owner))
+        if name_of(sts) not in expected_sts.get(okey, set()):
+            problems.append(
+                f"duplicate/stale slice StatefulSet "
+                f"{namespace_of(sts)}/{name_of(sts)} for {okey}")
+        sts_seen.setdefault(okey, []).append(sts)
+
+    for key in queued:
+        # A Queued gang may keep zero-replica StatefulSet shells from an
+        # earlier parked run (stop scales to 0, it does not delete) — the
+        # violation is a Queued gang with SCALED-UP slices: pods on chips
+        # the ledger gave to someone else.
+        hot = [
+            name_of(s) for s in sts_seen.get(key, ())
+            if (deep_get(s, "spec", "replicas", default=1) or 0) > 0
+            or (deep_get(s, "status", "readyReplicas", default=0) or 0) > 0
+        ]
+        if hot:
+            problems.append(
+                f"Queued gang {key} owns scaled-up StatefulSets {hot}")
+
+    for name, queue in mgr._queues.items():
+        info = queue.debug_info()
+        if info["ready"] or info["in_flight"] or info["dirty"]:
+            problems.append(
+                f"workqueue {name} not drained: ready={info['ready']} "
+                f"in_flight={info['in_flight']} dirty={info['dirty']}")
+    return problems
+
+
+# ---- the soak ------------------------------------------------------------------
+
+
+class ChaosSoak:
+    def __init__(self, config: SoakConfig):
+        self.cfg = config
+        self.rng = random.Random(config.seed)
+        self.kube = FakeKube()
+        register_all(self.kube)
+        self.plan = FaultPlan(seed=config.seed)
+        self.report = SoakReport(seed=config.seed)
+        self.mgr: Manager | None = None
+        self.sched: TpuFleetScheduler | None = None
+        self._nb_names: list[tuple] = []
+        self._created = 0
+
+    # -- stack lifecycle -----------------------------------------------------
+
+    def _build_stack(self) -> None:
+        """Fresh Manager + scheduler over the SAME kube/store — what a
+        controller pod restart looks like to the cluster. In-memory state
+        (ledger, drains, queues, caches) starts empty and must be
+        re-derived from the API (reclaim, annotation self-heal)."""
+        mgr = Manager(self.kube, registry=Registry(),
+                      quarantine_after=self.cfg.quarantine_after)
+        sched = TpuFleetScheduler(
+            self.kube,
+            SchedulerOptions(
+                # The safety-net requeue cadence for Queued gangs; kept
+                # well above the settle sampling window — admissions
+                # re-enqueue winners immediately, so this only paces the
+                # steady "still waiting" refresh.
+                queued_requeue_seconds=0.5,
+                idle_preempt_after_seconds=0.2,
+                enable_migration=True,
+                drain_grace_seconds=self.cfg.drain_grace_seconds,
+            ),
+            fleet=Fleet.parse(self.cfg.fleet), registry=mgr.registry,
+        )
+        setup_notebook_controller(mgr, NotebookOptions(), scheduler=sched)
+        # Soak-speed clocks: tiny workqueue backoff and informer resync so
+        # a seeded run converges in seconds, not production minutes.
+        for q in mgr._queues.values():
+            q.base_delay = 0.002
+            q.max_delay = 0.05
+        for inf in mgr.informers.values():
+            inf.resync_backoff = 0.02
+            inf.resync_backoff_max = 0.2
+        self.mgr, self.sched = mgr, sched
+
+    async def _start(self) -> None:
+        self._build_stack()
+        await self.mgr.start()
+
+    async def _kill_manager(self) -> None:
+        """Mid-reconcile kill: stop() cancels every worker wherever it is
+        awaiting — half-applied child sets, un-stamped admissions and all.
+        The dying scheduler's ledger-violation count is harvested FIRST:
+        the rebuilt stack starts a fresh counter, and a violation from the
+        first half of a round must not vanish with the old instance."""
+        self.report.ledger_violations += self.sched.policy.ledger.violations
+        await self.mgr.stop()
+        self.report.manager_restarts += 1
+
+    # -- storm + churn -------------------------------------------------------
+
+    def _arm_faults(self) -> None:
+        cfg = self.cfg
+        self.plan.fail("unavailable", rate=cfg.fault_rate)
+        self.plan.fail("internal", rate=cfg.fault_rate / 2)
+        self.plan.fail("timeout", rate=cfg.fault_rate / 3)
+        self.plan.fail("throttle", rate=cfg.fault_rate / 3)
+        self.plan.fail("conflict", verbs=("update", "update_status", "patch"),
+                       rate=cfg.fault_rate / 2)
+        self.plan.reset_watch(rate=cfg.watch_reset_rate)
+        self.plan.stale_list(rate=cfg.stale_list_rate)
+        self.kube.use_faults(self.plan)
+
+    def _lift_faults(self) -> None:
+        self.plan.clear()
+        self.report.injected = dict(self.plan.injected)
+
+    async def _create_notebook(self, ns: str) -> None:
+        name = f"soak-{self._created}"
+        self._created += 1
+        nb = nbapi.new(name, ns, accelerator="v5e", topology="4x4")
+        prio = self.rng.choice(["low", "normal", "normal", "high"])
+        nb["metadata"].setdefault("annotations", {})[
+            nbapi.PRIORITY_ANNOTATION] = prio
+        try:
+            await self.kube.create("Notebook", nb)
+            self._nb_names.append((ns, name))
+        except ApiError:
+            self._created -= 1  # injected failure: retry the same name later
+
+    async def _seed_notebooks(self) -> None:
+        for n in range(self.cfg.namespaces):
+            for _ in range(self.cfg.notebooks_per_namespace):
+                await self._create_notebook(f"team-{n}")
+
+    async def _churn_once(self) -> None:
+        """One rng-driven user/operator action. Every kube call may take
+        an injected fault — the driver shrugs like kubectl's user would."""
+        if not self._nb_names:
+            return
+        key = self.rng.choice(self._nb_names)
+        ns, name = key
+        action = self.rng.choice(
+            ["stop", "start", "suspend", "resume", "idle", "active",
+             "edit", "ack"])
+        self.report.actions += 1
+        patch = None
+        if action == "stop":
+            patch = {nbapi.STOP_ANNOTATION: fmt_iso(time.time())}
+        elif action == "start":
+            patch = {nbapi.STOP_ANNOTATION: None}
+        elif action == "suspend":
+            patch = {nbapi.SUSPEND_ANNOTATION: "true"}
+        elif action == "resume":
+            patch = {nbapi.SUSPEND_ANNOTATION: None}
+        elif action == "idle":
+            patch = {nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(
+                time.time() - 3600)}
+        elif action == "active":
+            patch = {nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(time.time())}
+        elif action == "edit":
+            patch = {"chaos-edit": str(self.rng.randrange(1 << 30))}
+        elif action == "ack":
+            await self._ack_drains(only=key)
+            return
+        try:
+            await self.kube.patch(
+                "Notebook", name, {"metadata": {"annotations": patch}}, ns)
+        except ApiError:
+            pass
+
+    async def _ack_drains(self, only: tuple | None = None) -> None:
+        """The simulated in-pod SDK: answer any un-acked drain request
+        with a committed checkpoint (echoing the raw request value, as
+        CheckpointGuard does)."""
+        for ns, name in list(self._nb_names):
+            if only is not None and (ns, name) != only:
+                continue
+            try:
+                nb = await self.kube.get_or_none("Notebook", name, ns)
+            except ApiError:
+                continue
+            if nb is None:
+                continue
+            ann = annotations_of(nb)
+            raw = ann.get(nbapi.DRAIN_REQUESTED_ANNOTATION)
+            if not raw or migration.drain_acked(ann):
+                continue
+            try:
+                await self.kube.patch(
+                    "Notebook", name,
+                    {"metadata": {"annotations": migration.ack_patch(
+                        f"/ckpt/{name}", self.rng.randrange(10_000),
+                        time.time(), for_request=raw)}}, ns)
+            except ApiError:
+                pass
+
+    async def _sdk_loop(self, stop: asyncio.Event) -> None:
+        while not stop.is_set():
+            await self._ack_drains()
+            await asyncio.sleep(0.05)
+
+    # -- convergence ---------------------------------------------------------
+
+    async def _settle_streak(self, deadline: float, *,
+                             need_clear: int = 8,
+                             interval: float = 0.03) -> bool:
+        """Converged = ``need_clear`` consecutive samples with no ready/
+        in-flight/dirty workqueue entries and no in-flight drains. The
+        streak (240 ms) outlasts every soak-scale retry backoff (max
+        50 ms), so the only future-delayed entries it can miss are the
+        benign 0.5 s still-Queued refreshes."""
+        clear = 0
+        while time.monotonic() < deadline:
+            busy = any(
+                q.ready_count() or info["in_flight"] or info["dirty"]
+                for q in self.mgr._queues.values()
+                for info in (q.debug_info(),)
+            ) or bool(self.sched._draining)
+            clear = 0 if busy else clear + 1
+            if clear >= need_clear:
+                return True
+            await asyncio.sleep(interval)
+        return False
+
+    def _release_transient_quarantines(self) -> None:
+        """Storm-era quarantines are released through the manual escape
+        hatch — the operator action POST /debug/queue/requeue models. If
+        such a key re-quarantines with no faults active, it is a
+        genuinely wedged key and the invariant check reports it."""
+        for cname, queue in self.mgr._queues.items():
+            for key in queue.quarantined_keys():
+                self.report.quarantined_transient += 1
+                self.mgr.requeue_quarantined(cname, key)
+
+    async def _converge_and_check(self) -> list[str]:
+        """Lift faults, force a global watch reset (every informer relists
+        a clean view, the kubelet sim resyncs), settle, release storm-era
+        quarantines, and run the invariant checks — retrying the
+        settle+check loop until they pass or the timeout expires (a check
+        can race the final benign requeues; a REAL violation is stable
+        and survives to the timeout)."""
+        self._lift_faults()
+        self.kube.close_watches()
+        deadline = time.monotonic() + self.cfg.converge_timeout
+        released = False
+        problems = [f"no convergence within {self.cfg.converge_timeout}s"]
+        while time.monotonic() < deadline:
+            if not await self._settle_streak(deadline):
+                break
+            if not released:
+                self._release_transient_quarantines()
+                released = True
+                continue  # settle again after the requeues
+            for cname, queue in self.mgr._queues.items():
+                for key in queue.quarantined_keys():
+                    problems = [
+                        f"workqueue {cname}: key re-quarantined with no "
+                        f"faults active (permanently wedged): {key}"]
+                    return problems
+            problems = await check_invariants(self.kube, self.mgr,
+                                              self.sched)
+            if not problems:
+                return []
+            await asyncio.sleep(0.05)
+        return problems
+
+    # -- entry point ---------------------------------------------------------
+
+    async def run(self) -> SoakReport:
+        cfg = self.cfg
+        await self._start()
+        sdk_stop = asyncio.Event()
+        sdk_task = asyncio.create_task(self._sdk_loop(sdk_stop))
+        sim = PodSimulator(self.kube)
+        await sim.start()
+        try:
+            await self._seed_notebooks()
+            for p in await self._converge_and_check():
+                self.report.problems.append(f"initial: {p}")
+            for round_no in range(cfg.rounds):
+                self.report.rounds += 1
+                self._arm_faults()
+                t_end = time.monotonic() + cfg.storm_seconds
+                kill_at = time.monotonic() + cfg.storm_seconds * \
+                    self.rng.uniform(0.3, 0.7)
+                killed = False
+                while time.monotonic() < t_end:
+                    await self._churn_once()
+                    if not killed and time.monotonic() >= kill_at:
+                        # Kill mid-reconcile, restart while the fault
+                        # storm is still blowing: the new manager's first
+                        # lists/reclaims run against a faulty apiserver.
+                        await self._kill_manager()
+                        self._build_stack()
+                        await self.mgr.start()
+                        killed = True
+                    await asyncio.sleep(self.rng.uniform(0.01, 0.04))
+                if not killed:
+                    await self._kill_manager()
+                    self._build_stack()
+                    await self.mgr.start()
+                for p in await self._converge_and_check():
+                    self.report.problems.append(f"round {round_no}: {p}")
+        finally:
+            sdk_stop.set()
+            sdk_task.cancel()
+            try:
+                await sdk_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await sim.stop()
+            # Each scheduler instance's cumulative counter is harvested
+            # exactly once — at its death (_kill_manager for mid-soak
+            # instances, here for the last one); summing it per round as
+            # well would double-count a violation across round boundaries.
+            self.report.ledger_violations += \
+                self.sched.policy.ledger.violations
+            await self.mgr.stop()
+            self.kube.use_faults(None)
+            self.kube.close_watches()
+        return self.report
+
+
+async def run_soak(config: SoakConfig) -> SoakReport:
+    return await ChaosSoak(config).run()
+
+
+# ---- poison-pill scenario ------------------------------------------------------
+
+
+async def poison_scenario(seed: int = 0, *, quarantine_after: int = 6) -> dict:
+    """The deliberate poison pill (acceptance gate): a CR whose children
+    can never apply must be quarantined within the retry budget, surface
+    the Degraded condition + quarantined debug row, and resume — and
+    converge — on the next spec edit once the fault is gone."""
+    from kubeflow_tpu.web.common.status import process_status
+
+    kube = FakeKube()
+    register_all(kube)
+    plan = FaultPlan(seed=seed)
+    # The poison: every write to this notebook's StatefulSets fails — an
+    # admission webhook black-holing the child, a broken CRD, a bad node
+    # selector... the reconcile itself always errors; the CR's own status
+    # surface stays writable (as it would be in each of those cases).
+    rule = plan.fail("internal", verbs=("create", "update", "patch"),
+                    kinds="StatefulSet", names="poison*")
+    kube.use_faults(plan)
+    mgr = Manager(kube, registry=Registry(), quarantine_after=quarantine_after)
+    setup_notebook_controller(mgr, NotebookOptions(), scheduler=None)
+    for q in mgr._queues.values():
+        q.base_delay = 0.002
+        q.max_delay = 0.05
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    out: dict = {"seed": seed, "quarantine_after": quarantine_after}
+    try:
+        await kube.create("Notebook", nbapi.new(
+            "poison", "ns", accelerator="v5e", topology="4x4"))
+        queue = mgr._queues["notebook"]
+        key = ("ns", "poison")
+
+        deadline = time.monotonic() + 20
+        while not queue.is_quarantined(key):
+            if time.monotonic() > deadline:
+                out["quarantined"] = False
+                return out
+            await asyncio.sleep(0.02)
+        out["quarantined"] = True
+        out["failures_at_quarantine"] = queue.poison_streak(key)
+        out["within_budget"] = \
+            queue.poison_streak(key) == quarantine_after
+
+        await mgr.wait_idle(timeout=10)
+        nb = await kube.get("Notebook", "poison", "ns")
+        cond = next((c for c in deep_get(
+            nb, "status", "conditions", default=[])
+            if c.get("type") == "Degraded"), None)
+        out["degraded_condition"] = bool(
+            cond and cond.get("status") == "True"
+            and cond.get("reason") == "ReconcileQuarantined")
+        status = process_status(nb)
+        out["jwa_message_ok"] = (
+            status.phase == "warning"
+            and "Reconciliation suspended" in status.message)
+        events = await kube.list("Event", "ns")
+        out["warning_event"] = any(
+            e.get("reason") == "ReconcileQuarantined" for e in events)
+        dbg = mgr.debug_queues()["notebook"]
+        out["debug_row"] = "('ns', 'poison')" in dbg["quarantined"]
+
+        # The cure: fault gone + spec edit → new informer delta rv →
+        # automatic release → clean reconcile → Degraded flips False.
+        plan.drop(rule)
+        await kube.patch(
+            "Notebook", "poison",
+            {"metadata": {"annotations": {"fixed": "yes"}}}, "ns")
+        deadline = time.monotonic() + 20
+        while queue.is_quarantined(key):
+            if time.monotonic() > deadline:
+                out["released"] = False
+                return out
+            await asyncio.sleep(0.02)
+        out["released"] = True
+        await mgr.wait_idle(timeout=20)
+        sts = await kube.get_or_none("StatefulSet", "poison", "ns")
+        nb = await kube.get("Notebook", "poison", "ns")
+        cond = next((c for c in deep_get(
+            nb, "status", "conditions", default=[])
+            if c.get("type") == "Degraded"), None)
+        out["reconciled_after_release"] = sts is not None
+        out["degraded_cleared"] = bool(cond) and cond.get("status") == "False"
+        out["pass"] = all(out.get(k) for k in (
+            "quarantined", "within_budget", "degraded_condition",
+            "jwa_message_ok", "warning_event", "debug_row", "released",
+            "reconciled_after_release", "degraded_cleared"))
+        return out
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        kube.use_faults(None)
+        kube.close_watches()

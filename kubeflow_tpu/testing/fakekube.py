@@ -33,9 +33,12 @@ from kubeflow_tpu.runtime import tracing
 
 from kubeflow_tpu.runtime.errors import (
     AlreadyExists,
+    ApiError,
     Conflict,
     Invalid,
     NotFound,
+    ServerTimeout,
+    TooManyRequests,
 )
 from kubeflow_tpu.runtime.objects import (
     deep_get,
@@ -64,6 +67,196 @@ class _Watch:
         if self.namespace and namespace_of(obj) != self.namespace:
             return False
         return matches_selector(get_meta(obj).get("labels"), self.selector)
+
+
+def _injected_error(error: str) -> ApiError:
+    """Build the ApiError an injected fault surfaces as. The five flavors
+    cover the real apiserver's transient-failure taxonomy: 500 internal,
+    503 overloaded/apiserver-restarting, 504 client deadline, 409 optimistic
+    concurrency, 429 priority & fairness."""
+    if error == "timeout":
+        return ServerTimeout("injected fault: no response within deadline")
+    if error == "conflict":
+        return Conflict("injected fault: the object has been modified")
+    if error == "throttle":
+        return TooManyRequests("injected fault: too many requests")
+    err = ApiError(f"injected fault: {error}")
+    err.code = {"internal": 500, "unavailable": 503}.get(error, 500)
+    err.reason = {"internal": "InternalError",
+                  "unavailable": "ServiceUnavailable"}.get(error, error)
+    return err
+
+
+class FaultRule:
+    """One scheduled fault: which requests it matches and how it fails them.
+
+    ``verbs=None`` matches every verb; ``kinds``/``names`` are fnmatch
+    globs. ``rate`` is the per-matching-request injection probability
+    (drawn from the plan's seeded RNG — deterministic per seed + request
+    order), ``after`` skips the first N matching requests, and ``times``
+    bounds total injections (None = unlimited, e.g. a permanent poison).
+    """
+
+    ERRORS = ("internal", "unavailable", "timeout", "conflict", "throttle")
+
+    def __init__(self, error: str = "unavailable", *,
+                 verbs: tuple[str, ...] | None = None,
+                 kinds: str = "*", names: str = "*",
+                 rate: float = 1.0, times: int | None = None,
+                 after: int = 0):
+        if error not in self.ERRORS:
+            raise ValueError(f"unknown fault error {error!r}; "
+                             f"want one of {self.ERRORS}")
+        self.error = error
+        self.verbs = tuple(verbs) if verbs is not None else None
+        self.kinds = kinds
+        self.names = names
+        self.rate = rate
+        self.times = times
+        self.after = after
+        self.injected = 0
+        self._seen = 0
+
+    def matches(self, verb: str, kind: str, name: str) -> bool:
+        if self.verbs is not None and verb not in self.verbs:
+            return False
+        return fnmatch.fnmatch(kind, self.kinds) and \
+            fnmatch.fnmatch(name or "", self.names)
+
+    def consume(self, rng: random.Random, verb: str, kind: str,
+                name: str) -> bool:
+        """True if this rule injects for the matching request. The RNG is
+        consulted only for probabilistic rules, so deterministic schedules
+        (rate=1.0) never perturb the seed stream."""
+        if not self.matches(verb, kind, name):
+            return False
+        if self.times is not None and self.injected >= self.times:
+            return False
+        self._seen += 1
+        if self._seen <= self.after:
+            return False
+        if self.rate < 1.0 and rng.random() >= self.rate:
+            return False
+        self.injected += 1
+        return True
+
+
+class _WatchResetRule:
+    def __init__(self, kinds: str, rate: float, every: int | None):
+        self.kinds = kinds
+        self.rate = rate
+        self.every = every
+        self.triggered = 0
+        self._seen = 0
+
+    def consume(self, rng: random.Random, kind: str) -> bool:
+        if not fnmatch.fnmatch(kind, self.kinds):
+            return False
+        self._seen += 1
+        if self.every is not None:
+            if self._seen % self.every:
+                return False
+        elif rng.random() >= self.rate:
+            return False
+        self.triggered += 1
+        return True
+
+
+class FaultPlan:
+    """Deterministic, seeded API fault schedule for :class:`FakeKube`.
+
+    The failure paths the reference stack never exercised (SURVEY.md §5),
+    one injection point per apiserver behavior:
+
+    - request errors (``fail``): matched in ``FakeKube._admit`` after
+      flow-control admission and the RTT sleep, so faults compose with
+      the latency and priority-and-fairness mirrors;
+    - mid-stream watch resets (``reset_watch``): the server closes the
+      stream after a delivered event — informers must relist;
+    - stale LISTs (``stale_list``): the server answers from its previous
+      snapshot of the kind (an old-resourceVersion read) — informer
+      caches must self-correct on a later relist.
+
+    All randomness comes from one ``random.Random(seed)``: the same seed
+    over the same request sequence replays the same fault schedule.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.rules: list[FaultRule] = []
+        self._watch_rules: list[_WatchResetRule] = []
+        self._stale_rules: list[FaultRule] = []
+        # Per-error injection counts — the soak report and tests assert
+        # faults actually fired.
+        self.injected: dict[str, int] = defaultdict(int)
+
+    def fail(self, error: str = "unavailable", *,
+             verbs: tuple[str, ...] | None = None,
+             kinds: str = "*", names: str = "*", rate: float = 1.0,
+             times: int | None = None, after: int = 0) -> FaultRule:
+        rule = FaultRule(error, verbs=verbs, kinds=kinds, names=names,
+                         rate=rate, times=times, after=after)
+        self.rules.append(rule)
+        return rule
+
+    def reset_watch(self, kinds: str = "*", *, rate: float = 0.0,
+                    every: int | None = None) -> _WatchResetRule:
+        """Close matching watch streams mid-flight: after every ``every``-th
+        delivered event, or with probability ``rate`` per event."""
+        rule = _WatchResetRule(kinds, rate, every)
+        self._watch_rules.append(rule)
+        return rule
+
+    def stale_list(self, kinds: str = "*", *, rate: float = 1.0,
+                   times: int | None = None, after: int = 0) -> FaultRule:
+        """Serve matching LISTs from the kind's previous snapshot."""
+        rule = FaultRule("unavailable", verbs=("list",), kinds=kinds,
+                         rate=rate, times=times, after=after)
+        self._stale_rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        """Lift every fault (rules stay readable for their counters)."""
+        self.rules = []
+        self._watch_rules = []
+        self._stale_rules = []
+
+    def drop(self, rule) -> None:
+        for bucket in (self.rules, self._watch_rules, self._stale_rules):
+            if rule in bucket:
+                bucket.remove(rule)
+
+    # ---- FakeKube-facing hooks ------------------------------------------------
+
+    def error_for(self, verb: str, kind: str, name: str | None) -> ApiError | None:
+        for rule in self.rules:
+            if rule.consume(self._rng, verb, kind, name or ""):
+                self.injected[rule.error] += 1
+                return _injected_error(rule.error)
+        return None
+
+    def watch_should_reset(self, kind: str) -> bool:
+        for rule in self._watch_rules:
+            if rule.consume(self._rng, kind):
+                self.injected["watch_reset"] += 1
+                return True
+        return False
+
+    def list_is_stale(self, kind: str) -> bool:
+        for rule in self._stale_rules:
+            if rule.consume(self._rng, "list", kind, ""):
+                self.injected["stale_list"] += 1
+                return True
+        return False
+
+    def debug_info(self) -> dict:
+        return {
+            "seed": self.seed,
+            "injected": dict(sorted(self.injected.items())),
+            "active_rules": len(self.rules) + len(self._watch_rules)
+            + len(self._stale_rules),
+        }
 
 
 class FakeKube:
@@ -105,6 +298,12 @@ class FakeKube:
         # Optional client-side flow control (runtime/flowcontrol.py),
         # mirroring HttpKube so lane behavior is testable in tier-1.
         self.flow = None
+        # Optional API fault injection (use_faults): checked in _admit
+        # after flow admission + RTT, so every fault composes with the
+        # latency and flow-control mirrors.
+        self.faults: FaultPlan | None = None
+        # Previous LIST snapshot per kind — what a stale LIST serves.
+        self._list_snapshots: dict[str, tuple[list[dict], str]] = {}
 
     # ---- latency / concurrency instrumentation --------------------------------
 
@@ -118,6 +317,10 @@ class FakeKube:
         """Route every request through a FlowControl lane gate, as
         HttpKube does on the wire."""
         self.flow = flow
+
+    def use_faults(self, plan: FaultPlan | None) -> None:
+        """Attach (or with None, detach) a FaultPlan; see its docstring."""
+        self.faults = plan
 
     def reset_in_flight_peak(self) -> None:
         self.in_flight_peak = 0
@@ -167,6 +370,20 @@ class FakeKube:
                 if self.flow is not None:
                     self.flow.release(verb, kind)
                 raise
+        if self.faults is not None:
+            # Injection AFTER lane admission + RTT: the request paid the
+            # round trip, then the server failed it — exactly where a real
+            # 5xx/429/409 lands. Undo the admission before raising so the
+            # caller's _finish pairing stays balanced (same contract as a
+            # mid-RTT cancellation above).
+            err = self.faults.error_for(verb, kind, entry.get("name"))
+            if err is not None:
+                entry["fault"] = err.reason
+                entry["end"] = time.monotonic()
+                self._in_flight -= 1
+                if self.flow is not None:
+                    self.flow.release(verb, kind)
+                raise err
 
     def _finish(self, entry: dict) -> None:
         self._in_flight -= 1
@@ -278,24 +495,49 @@ class FakeKube:
         await self._admit(entry)
 
         try:
-            selector = (
-                parse_label_selector(label_selector)
-                if isinstance(label_selector, str)
-                else label_selector
-            )
-            out = []
-            for obj in self._bucket(kind).values():
-                if namespace and namespace_of(obj) != namespace:
-                    continue
-                if not matches_selector(get_meta(obj).get("labels"), selector):
-                    continue
-                if field_selector and not field_selector(obj):
-                    continue
-                out.append(deepcopy(obj) if copy else obj)
-            out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
-            return out
+            items, _rv = self._list_locked(
+                kind, namespace, label_selector, field_selector, copy)
+            return items
         finally:
             self._finish(entry)
+
+    def _list_locked(
+        self, kind, namespace, label_selector, field_selector, copy,
+    ) -> tuple[list[dict], str]:
+        selector = (
+            parse_label_selector(label_selector)
+            if isinstance(label_selector, str)
+            else label_selector
+        )
+        gvk_key = self.scheme.by_kind(kind).key
+        source = self._bucket(kind).values()
+        rv = str(self._rv)
+        stale = False
+        if (self.faults is not None and gvk_key in self._list_snapshots
+                and self.faults.list_is_stale(kind)):
+            # Stale snapshot: the previous LIST's view of the kind — an
+            # old-resourceVersion read. Served from copies, never the
+            # live store.
+            source, rv = self._list_snapshots[gvk_key]
+            stale = True
+        out = []
+        for obj in source:
+            if namespace and namespace_of(obj) != namespace:
+                continue
+            if not matches_selector(get_meta(obj).get("labels"), selector):
+                continue
+            if field_selector and not field_selector(obj):
+                continue
+            out.append(deepcopy(obj) if copy else obj)
+        out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
+        if not stale and self.faults is not None:
+            # Remember this (fresh) view so a later injected stale LIST
+            # has a genuinely older snapshot to serve. Only while a fault
+            # plan is attached — the O(bucket) copy must not tax the
+            # copy=False fast paths (kubelet sim, load test) otherwise.
+            self._list_snapshots[gvk_key] = (
+                [deepcopy(o) for o in self._bucket(kind).values()], rv)
+        return out, rv
 
     async def list_with_rv(
         self,
@@ -304,8 +546,13 @@ class FakeKube:
         label_selector: str | dict | None = None,
         field_selector: Callable[[dict], bool] | None = None,
     ) -> tuple[list[dict], str | None]:
-        items = await self.list(kind, namespace, label_selector, field_selector)
-        return items, str(self._rv)
+        entry = self._log_request("list", kind, namespace=namespace)
+        await self._admit(entry)
+        try:
+            return self._list_locked(
+                kind, namespace, label_selector, field_selector, True)
+        finally:
+            self._finish(entry)
 
     async def create(self, kind: str, obj: dict, namespace: str | None = None) -> dict:
         entry = self._log_request(
@@ -552,6 +799,13 @@ class FakeKube:
                 if item is None:
                     return
                 yield item
+                if self.faults is not None and \
+                        self.faults.watch_should_reset(w.kind):
+                    # Mid-stream reset: the server closed the stream after
+                    # this event (network blip, apiserver restart, 410
+                    # Gone). The client sees a cleanly-ended watch and must
+                    # relist to regain resourceVersion continuity.
+                    return
         finally:
             if w in self._watches:
                 self._watches.remove(w)

@@ -58,6 +58,11 @@ class PodSimulator:
         # Strong refs: asyncio holds tasks weakly; un-referenced _run_pod
         # tasks can be GC'd mid-flight (pods stuck Pending, flaky tests).
         self._pod_tasks: set[asyncio.Task] = set()
+        # (namespace, pod name) with a live _run_pod task — the stuck-pod
+        # backstop in _reconcile_workload must not double-drive a pod
+        # whose first run is still in flight (a one-shot failure injector
+        # consulted twice would lose its verdict).
+        self._pods_in_flight: set[tuple] = set()
         # (namespace, owner uid) → pod names: the simulator's own owner
         # index, updated synchronously on its own creates/deletes and from
         # the pod watch for external actors. Replaces the per-event
@@ -86,13 +91,19 @@ class PodSimulator:
         self._pod_tasks.clear()
 
     async def _watch_workloads(self, kind: str) -> None:
-        async for _event, obj in self.kube.watch(kind):
-            if not self._running:
-                return
-            try:
-                await self._reconcile_workload(kind, obj)
-            except ApiError:
-                pass
+        # Re-establish on every close (injected watch reset, apiserver
+        # restart): a kubelet whose watch dies does not stop being the
+        # kubelet. send_initial on re-watch doubles as the resync — any
+        # workload whose events were lost in the gap reconciles again.
+        while self._running:
+            async for _event, obj in self.kube.watch(kind):
+                if not self._running:
+                    return
+                try:
+                    await self._reconcile_workload(kind, obj)
+                except ApiError:
+                    pass
+            await asyncio.sleep(0.02)
 
     def _index_pod(self, event: str, pod: dict) -> dict | None:
         """Fold one pod event into the owner index; returns the pod's
@@ -120,22 +131,24 @@ class PodSimulator:
         delete must trigger recreation from the owning workload. The same
         stream keeps the owner index current for pods other actors
         create/delete behind the simulator's back."""
-        async for event, pod in self.kube.watch("Pod"):
-            if not self._running:
-                return
-            owner = self._index_pod(event, pod)
-            if event != "DELETED":
-                continue
-            if not owner or owner.get("kind") not in ("StatefulSet", "Deployment"):
-                continue
-            wl = await self.kube.get_or_none(
-                owner["kind"], owner["name"], namespace_of(pod)
-            )
-            if wl is not None:
+        while self._running:
+            async for event, pod in self.kube.watch("Pod"):
+                if not self._running:
+                    return
+                owner = self._index_pod(event, pod)
+                if event != "DELETED":
+                    continue
+                if not owner or owner.get("kind") not in ("StatefulSet", "Deployment"):
+                    continue
                 try:
-                    await self._reconcile_workload(owner["kind"], wl)
+                    wl = await self.kube.get_or_none(
+                        owner["kind"], owner["name"], namespace_of(pod)
+                    )
+                    if wl is not None:
+                        await self._reconcile_workload(owner["kind"], wl)
                 except ApiError:
                     pass
+            await asyncio.sleep(0.02)
 
     async def _reconcile_workload(self, kind: str, obj: dict) -> None:
         ns, name = namespace_of(obj), name_of(obj)
@@ -164,9 +177,19 @@ class PodSimulator:
                 except AlreadyExists:
                     continue
                 self._owner_pods.setdefault(owner_key, set()).add(pod_name)
-                task = asyncio.create_task(self._run_pod(created))
-                self._pod_tasks.add(task)
-                task.add_done_callback(self._pod_tasks.discard)
+                self._spawn_pod_task(created)
+            else:
+                # Stuck-pod backstop: a pod whose _run_pod task died under
+                # an injected fault storm (status patch never landed — no
+                # phase) gets re-driven on the next workload reconcile,
+                # exactly as a real kubelet re-syncs pods it owns. Guarded
+                # by _pods_in_flight so an in-flight first run — and its
+                # one-shot failure-injector verdict — is never doubled.
+                if (ns, pod_name) in self._pods_in_flight:
+                    continue
+                live = await self.kube.get_or_none("Pod", pod_name, ns)
+                if live is not None and not deep_get(live, "status", "phase"):
+                    self._spawn_pod_task(live)
         for pod_name in existing:
             if pod_name not in want:
                 try:
@@ -199,22 +222,48 @@ class PodSimulator:
         set_controller_owner(pod, owner)
         return pod
 
+    def _spawn_pod_task(self, pod: dict) -> None:
+        key = (namespace_of(pod), name_of(pod))
+        self._pods_in_flight.add(key)
+        task = asyncio.create_task(self._run_pod(pod))
+        self._pod_tasks.add(task)
+
+        def _done(t, key=key):
+            self._pod_tasks.discard(t)
+            self._pods_in_flight.discard(key)
+
+        task.add_done_callback(_done)
+
+    async def _patch_status_retrying(self, kind: str, name: str, ns: str,
+                                     status: dict) -> None:
+        """Kubelet-style bounded retry: a transient apiserver error (5xx/
+        429/409) must not leave a pod Pending forever — the real kubelet
+        retries status syncs until they land. NotFound ends the retry (the
+        object is gone); persistent failure gives up after ~2s and leaves
+        the stuck-pod backstop to re-drive it."""
+        delay = 0.02
+        for attempt in range(8):
+            try:
+                await self.kube.patch(kind, name, {"status": status}, ns,
+                                      subresource="status")
+                return
+            except NotFound:
+                return
+            except ApiError:
+                if attempt == 7:
+                    return
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.5)
+
     async def _run_pod(self, pod: dict) -> None:
         if self.start_latency:
             await asyncio.sleep(self.start_latency)
         ns, name = namespace_of(pod), name_of(pod)
         fault = self.failure_injector(pod) if self.failure_injector else None
         if fault == "fail":
-            try:
-                await self.kube.patch(
-                    "Pod", name,
-                    {"status": {"phase": "Failed",
-                                "reason": "Injected",
-                                "conditions": []}},
-                    ns, subresource="status",
-                )
-            except NotFound:
-                pass
+            await self._patch_status_retrying(
+                "Pod", name, ns,
+                {"phase": "Failed", "reason": "Injected", "conditions": []})
             return
         if fault == "crash" or (isinstance(fault, str) and fault.startswith("crash:")):
             only = fault.split(":", 1)[1] if ":" in fault else None
@@ -238,25 +287,16 @@ class PodSimulator:
             # kubelet restarts it in place) Ready; a whole-pod crash flips
             # the Ready condition.
             pod_ready = "True" if only is not None else "False"
-            try:
-                await self.kube.patch(
-                    "Pod", name,
-                    {
-                        "status": {
-                            "phase": "Running",
-                            "conditions": [{"type": "Ready", "status": pod_ready}],
-                            "containerStatuses": [
-                                ctr_status(c)
-                                for c in deep_get(
-                                    pod, "spec", "containers", default=[]
-                                )
-                            ],
-                        }
-                    },
-                    ns, subresource="status",
-                )
-            except NotFound:
-                pass
+            await self._patch_status_retrying(
+                "Pod", name, ns,
+                {
+                    "phase": "Running",
+                    "conditions": [{"type": "Ready", "status": pod_ready}],
+                    "containerStatuses": [
+                        ctr_status(c)
+                        for c in deep_get(pod, "spec", "containers", default=[])
+                    ],
+                })
             return
         disrupt_reason = None
         if fault == "disrupt" or (
@@ -274,31 +314,22 @@ class PodSimulator:
                 "reason": disrupt_reason,
                 "message": "injected disruption",
             })
-        try:
-            await self.kube.patch(
-                "Pod",
-                name,
-                {
-                    "status": {
-                        "phase": "Running",
-                        "podIP": _fake_pod_ip(name),
-                        "conditions": conditions,
-                        "containerStatuses": [
-                            {
-                                "name": c.get("name", "main"),
-                                "ready": True,
-                                "restartCount": 0,
-                                "state": {"running": {"startedAt": "now"}},
-                            }
-                            for c in deep_get(pod, "spec", "containers", default=[])
-                        ],
+        await self._patch_status_retrying(
+            "Pod", name, ns,
+            {
+                "phase": "Running",
+                "podIP": _fake_pod_ip(name),
+                "conditions": conditions,
+                "containerStatuses": [
+                    {
+                        "name": c.get("name", "main"),
+                        "ready": True,
+                        "restartCount": 0,
+                        "state": {"running": {"startedAt": "now"}},
                     }
-                },
-                ns,
-                subresource="status",
-            )
-        except NotFound:
-            return
+                    for c in deep_get(pod, "spec", "containers", default=[])
+                ],
+            })
         # The pod's controller ref names its workload directly — no scan.
         owner = next(
             (r for r in get_meta(pod).get("ownerReferences", [])
@@ -306,7 +337,10 @@ class PodSimulator:
             None,
         )
         if owner and owner.get("kind") in ("StatefulSet", "Deployment"):
-            wl = await self.kube.get_or_none(owner["kind"], owner["name"], ns)
+            try:
+                wl = await self.kube.get_or_none(owner["kind"], owner["name"], ns)
+            except ApiError:
+                return
             if wl is not None and get_meta(wl).get("uid") == owner.get("uid"):
                 await self._mirror_status(
                     owner["kind"], wl,
@@ -321,7 +355,10 @@ class PodSimulator:
         for pod_name in list(
             self._owner_pods.get((ns, get_meta(obj).get("uid")), ())
         ):
-            p = await self.kube.get_or_none("Pod", pod_name, ns)
+            try:
+                p = await self.kube.get_or_none("Pod", pod_name, ns)
+            except ApiError:
+                continue
             if p is not None and deep_get(p, "status", "phase") == "Running":
                 ready += 1
         status = {"replicas": replicas, "readyReplicas": ready}
@@ -332,7 +369,4 @@ class PodSimulator:
         }
         if current == status:
             return  # avoid self-amplifying MODIFIED loops on our own watch
-        try:
-            await self.kube.patch(kind, name_of(obj), {"status": status}, ns, subresource="status")
-        except NotFound:
-            pass
+        await self._patch_status_retrying(kind, name_of(obj), ns, status)

@@ -74,6 +74,24 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     conditions = deep_get(notebook, "status", "conditions", default=[])
     want_hosts = deep_get(notebook, "status", "tpu", "hosts", default=1) or 1
 
+    # Poison-pill quarantine first (runtime/manager.py stamps the
+    # Degraded condition): reconciliation is SUSPENDED, so every other
+    # signal below is frozen at quarantine time — nothing is more
+    # actionable than saying so. Conditions are newest-first history; the
+    # most recent Degraded entry wins (False = released, fall through).
+    for c in conditions:
+        if c.get("type") == "Degraded":
+            if c.get("status") == "True":
+                return Status(
+                    WARNING,
+                    "Reconciliation suspended after repeated errors "
+                    f"({c.get('reason', 'ReconcileQuarantined')}) — edit "
+                    "the notebook to retry, or ask an operator to requeue "
+                    "it (POST /debug/queue/requeue on the controller "
+                    "manager)",
+                )
+            break
+
     # Fleet-scheduler verdicts first (controllers/notebook.py writes
     # status.scheduler): a Queued gang is waiting *by design*, with a
     # position and a chip count the user can act on — more specific than

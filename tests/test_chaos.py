@@ -1,0 +1,142 @@
+"""Chaos harness + control-plane self-healing (ISSUE 9).
+
+Tier-1 replays the SAME seeds the `bench.py chaos_soak` gate runs:
+seeded API fault storms (5xx/429/409, watch resets, stale LISTs) with
+the Manager killed and restarted mid-reconcile 3× per seed, converging
+to zero ledger violations, no orphan/duplicate slice StatefulSets, no
+gang both Admitted and Queued, every drain terminal, every workqueue
+drained, zero permanently-wedged keys. Plus the poison-pill acceptance
+path: quarantined within budget → Degraded condition + Event + debug
+row → released on the next spec edit — and the manual requeue endpoint.
+"""
+
+import asyncio
+
+from kubeflow_tpu.testing.chaos import (
+    SoakConfig,
+    poison_scenario,
+    run_soak,
+)
+
+# The bench's seed set (bench.py chaos_soak, non-smoke) — the acceptance
+# criteria require the same seeds to replay in tier-1.
+BENCH_SEEDS = range(5)
+
+
+async def _assert_soak(seed: int) -> None:
+    report = await run_soak(SoakConfig(seed=seed, rounds=3,
+                                       storm_seconds=0.5))
+    d = report.to_dict()
+    assert d["ok"], f"seed {seed}: {d['problems']}"
+    assert d["ledger_violations"] == 0
+    assert d["manager_restarts"] >= 3
+    assert d["rounds"] == 3
+    # The storm actually stormed — a soak that injected nothing proves
+    # nothing.
+    assert sum(d["injected"].values()) > 0
+
+
+async def test_chaos_soak_seed_0():
+    await _assert_soak(0)
+
+
+async def test_chaos_soak_seed_1():
+    await _assert_soak(1)
+
+
+async def test_chaos_soak_seed_2():
+    await _assert_soak(2)
+
+
+async def test_chaos_soak_seed_3():
+    await _assert_soak(3)
+
+
+async def test_chaos_soak_seed_4():
+    await _assert_soak(4)
+
+
+async def test_poison_pill_quarantine_end_to_end():
+    """A CR whose children can never apply: quarantined at exactly the
+    budget, surfaced everywhere an operator looks, released by the next
+    spec edit, then converges and clears the Degraded condition."""
+    out = await poison_scenario(seed=0)
+    assert out["quarantined"], out
+    assert out["within_budget"], out
+    assert out["degraded_condition"], out
+    assert out["jwa_message_ok"], out
+    assert out["warning_event"], out
+    assert out["debug_row"], out
+    assert out["released"], out
+    assert out["reconciled_after_release"], out
+    assert out["degraded_cleared"], out
+    assert out["pass"], out
+
+
+async def test_debug_queue_requeue_endpoint():
+    """POST /debug/queue/requeue is the operator escape hatch: it
+    releases a quarantined key (200), 404s for unknown keys, and 400s
+    without the required params; /debug/queue shows the quarantined row
+    while it is parked."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.cmd.controller_manager import build_manager_app
+    from kubeflow_tpu.runtime.manager import Controller, Manager
+    from kubeflow_tpu.runtime.metrics import Registry
+    from kubeflow_tpu.runtime.objects import new_object
+    from kubeflow_tpu.testing import FakeKube
+
+    kube = FakeKube()
+    mgr = Manager(kube, registry=Registry(), quarantine_after=2)
+
+    async def reconcile(key):
+        raise RuntimeError("wedged")
+
+    mgr.add_controller(Controller("cm", "ConfigMap", reconcile))
+    for q in mgr._queues.values():
+        q.base_delay = 0.001
+        q.max_delay = 0.01
+    await mgr.start()
+    client = TestClient(TestServer(build_manager_app(mgr)))
+    await client.start_server()
+    try:
+        await kube.create("ConfigMap", new_object("ConfigMap", "bad", "ns"))
+        queue = mgr._queues["cm"]
+        for _ in range(400):
+            if queue.is_quarantined(("ns", "bad")):
+                break
+            await asyncio.sleep(0.01)
+        assert queue.is_quarantined(("ns", "bad"))
+
+        resp = await client.get("/debug/queue")
+        rows = (await resp.json())["queues"]["cm"]["quarantined"]
+        assert "('ns', 'bad')" in rows
+
+        resp = await client.post("/debug/queue/requeue")
+        assert resp.status == 400
+
+        resp = await client.post(
+            "/debug/queue/requeue",
+            params={"controller": "cm", "namespace": "ns", "name": "nope"})
+        assert resp.status == 404
+
+        resp = await client.post(
+            "/debug/queue/requeue",
+            params={"controller": "cm", "namespace": "ns", "name": "bad"})
+        assert resp.status == 200
+        assert (await resp.json())["released"] is True
+        assert not queue.is_quarantined(("ns", "bad"))
+
+        # JSON body works too (it re-quarantines while still wedged).
+        for _ in range(400):
+            if queue.is_quarantined(("ns", "bad")):
+                break
+            await asyncio.sleep(0.01)
+        resp = await client.post(
+            "/debug/queue/requeue",
+            json={"controller": "cm", "namespace": "ns", "name": "bad"})
+        assert resp.status == 200
+    finally:
+        await client.close()
+        await mgr.stop()
+        kube.close_watches()

@@ -150,3 +150,124 @@ async def test_admission_chain():
     pod = await kube.create("Pod", new_object("Pod", "p", "ns", spec={}))
     assert pod["metadata"]["labels"]["mutated"] == "yes"
     assert seen == ["CREATE"]
+
+
+# ---- FaultPlan: the API fault-injection layer (ISSUE 9) ------------------------
+
+
+async def test_fault_plan_error_mapping_and_budget():
+    from kubeflow_tpu.runtime.errors import (
+        ApiError,
+        ServerTimeout,
+        TooManyRequests,
+    )
+    from kubeflow_tpu.testing import FaultPlan
+
+    kube = FakeKube()
+    plan = FaultPlan(seed=1)
+    rule = plan.fail("throttle", verbs=("get",), kinds="Notebook", times=2)
+    kube.use_faults(plan)
+    await kube.create("Notebook", new_object(
+        "Notebook", "nb", "ns", spec={"template": {"spec": {}}}))
+    for _ in range(2):
+        with pytest.raises(TooManyRequests):
+            await kube.get("Notebook", "nb", "ns")
+    # Budget exhausted: the same request now succeeds.
+    assert (await kube.get("Notebook", "nb", "ns"))["metadata"]["name"] == "nb"
+    assert rule.injected == 2
+    assert plan.injected["throttle"] == 2
+    # Request log carries the fault reason for postmortems.
+    faulted = [e for e in kube.request_log if e.get("fault")]
+    assert len(faulted) == 2
+
+    # Error taxonomy: each flavor surfaces as the right ApiError.
+    plan.clear()
+    plan.fail("timeout", verbs=("get",))
+    with pytest.raises(ServerTimeout):
+        await kube.get("Notebook", "nb", "ns")
+    plan.clear()
+    plan.fail("conflict", verbs=("patch",))
+    with pytest.raises(Conflict):
+        await kube.patch("Notebook", "nb", {"metadata": {}}, "ns")
+    plan.clear()
+    plan.fail("unavailable", verbs=("get",))
+    try:
+        await kube.get("Notebook", "nb", "ns")
+        raise AssertionError("expected injected 503")
+    except ApiError as e:
+        assert e.code == 503 and e.reason == "ServiceUnavailable"
+
+
+async def test_fault_plan_name_glob_and_after():
+    from kubeflow_tpu.testing import FaultPlan
+
+    kube = FakeKube()
+    plan = FaultPlan()
+    plan.fail("internal", verbs=("create",), kinds="StatefulSet",
+              names="poison*", after=1)
+    kube.use_faults(plan)
+    # Non-matching name: untouched.
+    await kube.create("StatefulSet", new_object("StatefulSet", "fine", "ns"))
+    # First matching request rides through (after=1), second fails.
+    await kube.create("StatefulSet", new_object("StatefulSet", "poison-a", "ns"))
+    from kubeflow_tpu.runtime.errors import ApiError
+    with pytest.raises(ApiError):
+        await kube.create("StatefulSet", new_object("StatefulSet", "poison-b", "ns"))
+
+
+def test_fault_plan_rate_decisions_replay_deterministically():
+    """Same seed + same request order → identical injection decisions —
+    the property the chaos soak's seed replay rests on."""
+    from kubeflow_tpu.testing import FaultPlan
+
+    def decisions(seed):
+        plan = FaultPlan(seed=seed)
+        plan.fail("internal", rate=0.3)
+        return [plan.error_for("get", "Notebook", f"nb-{i}") is not None
+                for i in range(200)]
+
+    a, b = decisions(7), decisions(7)
+    assert a == b
+    assert any(a) and not all(a)
+    assert decisions(8) != a  # a different seed reshuffles the schedule
+
+
+async def test_stale_list_serves_previous_snapshot():
+    from kubeflow_tpu.testing import FaultPlan
+
+    kube = FakeKube()
+    plan = FaultPlan()
+    kube.use_faults(plan)
+    await kube.create("ConfigMap", new_object("ConfigMap", "a", "ns"))
+    # Fresh list records the snapshot {a}.
+    assert [o["metadata"]["name"] for o in await kube.list("ConfigMap")] == ["a"]
+    await kube.create("ConfigMap", new_object("ConfigMap", "b", "ns"))
+    plan.stale_list(kinds="ConfigMap", times=1)
+    stale = await kube.list("ConfigMap")
+    assert [o["metadata"]["name"] for o in stale] == ["a"]  # b missing
+    fresh = await kube.list("ConfigMap")
+    assert [o["metadata"]["name"] for o in fresh] == ["a", "b"]
+
+
+async def test_watch_reset_mid_stream_ends_iterator():
+    from kubeflow_tpu.testing import FaultPlan
+
+    kube = FakeKube()
+    plan = FaultPlan()
+    plan.reset_watch(kinds="ConfigMap", every=2)
+    kube.use_faults(plan)
+
+    seen = []
+
+    async def consume():
+        async for event, obj in kube.watch("ConfigMap", send_initial=False):
+            seen.append((event, obj["metadata"]["name"]))
+        seen.append(("CLOSED", None))
+
+    task = asyncio.create_task(consume())
+    await asyncio.sleep(0.01)
+    for name in ("a", "b", "c"):
+        await kube.create("ConfigMap", new_object("ConfigMap", name, "ns"))
+    await asyncio.wait_for(task, timeout=2)
+    # The stream delivered two events then reset; the third never arrived.
+    assert seen == [("ADDED", "a"), ("ADDED", "b"), ("CLOSED", None)]

@@ -188,3 +188,102 @@ async def test_backoff_counter_resets_once_slice_is_healthy():
     annotations = nb["metadata"].get("annotations") or {}
     assert SLICE_RESTART_ATTEMPTS_ANNOTATION not in annotations
     assert SLICE_RESTART_AT_ANNOTATION not in annotations
+
+
+# ---- API fault injection (FaultPlan, ISSUE 9) ----------------------------------
+
+
+async def test_conflict_storm_converges_without_churn():
+    """Every Notebook write answered 409 for a bounded storm: the
+    reconcile retries with backoff and converges once the storm lifts —
+    one child set, no duplicate StatefulSets, no condition churn."""
+    from kubeflow_tpu.runtime.manager import Manager as Mgr
+    from kubeflow_tpu.runtime.metrics import Registry
+    from kubeflow_tpu.testing.fakekube import FaultPlan
+
+    kube = FakeKube()
+    register_all(kube)
+    plan = FaultPlan(seed=3)
+    plan.fail("conflict", verbs=("patch", "update", "update_status"),
+              kinds="Notebook", times=40)
+    kube.use_faults(plan)
+    mgr = Mgr(kube, registry=Registry())
+    setup_notebook_controller(mgr)
+    for q in mgr._queues.values():
+        q.base_delay = 0.002
+        q.max_delay = 0.05
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    try:
+        await kube.create("Notebook", nbapi.new(
+            "stormy", "ns", accelerator="v5e", topology="4x4"))
+        deadline = 200
+        while deadline:
+            nb = await kube.get("Notebook", "stormy", "ns")
+            if deep_get(nb, "status", "readyReplicas") == 2 \
+                    and plan.rules[0].injected >= 40:
+                break
+            deadline -= 1
+            await asyncio.sleep(0.05)
+        assert deadline, "did not converge after the conflict storm"
+        assert plan.rules[0].injected == 40  # the storm actually hit
+        # No duplicate children: exactly the one slice StatefulSet.
+        stss = await kube.list("StatefulSet", "ns")
+        assert [name_of(s) for s in stss] == ["stormy"]
+        # No condition churn: the bounded history holds ONE Running entry,
+        # not one per retry.
+        nb = await kube.get("Notebook", "stormy", "ns")
+        conditions = deep_get(nb, "status", "conditions", default=[])
+        assert len(conditions) <= 8
+        assert sum(1 for c in conditions if c.get("type") == "Running") == 1
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        kube.use_faults(None)
+        kube.close_watches()
+
+
+async def test_event_emission_failures_never_fail_the_reconcile():
+    """Injected 500s on every Event create/patch: the reconcile that
+    emitted them must still converge (events are best-effort by
+    contract), and the drops are visible in events_emit_failures_total."""
+    from kubeflow_tpu.runtime.manager import Manager as Mgr
+    from kubeflow_tpu.runtime.metrics import Registry
+    from kubeflow_tpu.testing.fakekube import FaultPlan
+
+    kube = FakeKube()
+    register_all(kube)
+    plan = FaultPlan()
+    rule = plan.fail("internal", verbs=("create", "patch", "update"),
+                     kinds="Event")
+    kube.use_faults(plan)
+    registry = Registry()
+    mgr = Mgr(kube, registry=registry)
+    setup_notebook_controller(mgr)
+    sim = PodSimulator(kube)
+    await mgr.start()
+    await sim.start()
+    try:
+        await kube.create("Notebook", nbapi.new(
+            "quiet", "ns", accelerator="v5e", topology="4x4"))
+        for _ in range(200):
+            nb = await kube.get("Notebook", "quiet", "ns")
+            if deep_get(nb, "status", "readyReplicas") == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert deep_get(nb, "status", "readyReplicas") == 2
+        assert rule.injected > 0  # emissions were attempted and failed
+        assert await kube.list("Event", "ns") == []  # none ever landed
+        text = registry.expose()
+        assert "events_emit_failures_total" in text
+        failures = [
+            line for line in text.splitlines()
+            if line.startswith("events_emit_failures_total{")
+        ]
+        assert any(float(line.rsplit(" ", 1)[1]) > 0 for line in failures)
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        kube.use_faults(None)
+        kube.close_watches()

@@ -750,3 +750,49 @@ async def test_restore_env_never_rolls_a_live_gang():
                        default=[{}])[0].get("env", [])
         names = {e.get("name") for e in env}
         assert migration.RESTORE_PATH_ENV not in names  # template stable
+
+
+async def test_watch_reset_mid_drain_still_finalizes():
+    """The drain ack lands during a watch gap (every live watch closed,
+    the MODIFIED delta unobserved): the informers' relist must still
+    deliver the ack, park the victim with its checkpoint, and admit the
+    waiter — a drain must never wedge on one lost watch event (ISSUE 9
+    satellite)."""
+    async with Harness() as h:
+        # Fast relists: the gap heals via resync, not via luck.
+        for inf in h.mgr.informers.values():
+            inf.resync_backoff = 0.05
+        await h.make_idle_holder("victim")
+        await h.kube.create("Notebook", {
+            **nbapi.new("urgent", "ns", accelerator="v5e", topology="4x4"),
+            "metadata": {"name": "urgent", "namespace": "ns",
+                         "annotations": {
+                             nbapi.PRIORITY_ANNOTATION: "high"}},
+        })
+
+        async def drain_requested():
+            ann = await h.annotations("victim")
+            return migration.drain_requested_at(ann) is not None
+        await h.wait_for(drain_requested, "drain request on the victim")
+
+        # The gap: every watch stream dies, THEN the SDK acks — the
+        # MODIFIED event for the ack has no watcher to go to.
+        h.kube.close_watches()
+        await h.simulate_sdk_ack("victim")
+
+        async def victim_parked():
+            ann = await h.annotations("victim")
+            return nbapi.STOP_ANNOTATION in ann
+        await h.wait_for(victim_parked, "victim parked via relist repair")
+        await h.wait_for(
+            lambda: _admitted(h.sched, ("ns", "urgent")),
+            "waiter admitted after the gap")
+        ann = await h.annotations("victim")
+        assert ann.get(nbapi.CHECKPOINT_PATH_ANNOTATION) == \
+            "/home/jovyan/ckpt/victim"
+        assert nbapi.DRAIN_REQUESTED_ANNOTATION not in ann
+        h.sched.policy.ledger.assert_consistent()
+        assert h.sched.policy.ledger.violations == 0
+        # No grace-deadline fallback: the ack was recovered, not lost.
+        assert h.mgr.registry._metrics[
+            "tpu_scheduler_drain_fallback_total"].labels().value == 0

@@ -339,3 +339,290 @@ def test_selector_double_equals_and_to_string():
     }
     assert selector_to_string(sel) == "app=nb,env in (dev,prod),!gone"
     assert selector_to_string("a=b") == "a=b"
+
+
+# ---- poison-pill quarantine + hygiene (ISSUE 9) --------------------------------
+
+
+def test_queue_quarantine_parks_and_releases_on_rv_change():
+    q = RateLimitedQueue(quarantine_after=3)
+    key = ("ns", "nb")
+    for _ in range(3):
+        q.note_failure(key)
+    assert q.should_quarantine(key)
+    q.quarantine(key, token="sig-a")
+    assert q.is_quarantined(key)
+    # Same-rv re-deliveries (relists) keep the pill parked.
+    assert q.add(key, token="sig-a") is False
+    assert q.add(key) is False  # rv-less adds (child events) too
+    assert len(q) == 0
+    # A CHANGED object releases with a fresh failure budget.
+    assert q.add(key, token="sig-b") is True
+    assert not q.is_quarantined(key)
+    assert q.backoff_delay(key) == 0.0
+    assert len(q) == 1
+
+
+async def test_queue_quarantine_manual_release_and_dirty_guard():
+    q = RateLimitedQueue(quarantine_after=2)
+    key = ("ns", "nb")
+    q.add(key)
+    assert await q.get() == key
+    q.add(key)  # goes dirty while in flight
+    q.note_failure(key)
+    q.note_failure(key)
+    q.done(key)  # dirty re-add fires first (not yet quarantined)...
+    q.quarantine(key, token="sig")
+    # ...but quarantine() purges the queued state: nothing is ready.
+    await asyncio.sleep(0.02)
+    assert q.ready_count() == 0
+    info = q.debug_info()
+    assert "('ns', 'nb')" in info["quarantined"]
+    assert info["quarantined"]["('ns', 'nb')"]["failures"] == 2
+    assert info["backoff_keys"] == {}  # quarantined keys leave the backoff view
+    # The escape hatch requeues immediately with a clean budget.
+    assert q.release_quarantined(key) is True
+    assert q.release_quarantined(key) is False
+    assert await q.get() == key
+    assert q.backoff_delay(key) == 0.0
+
+
+def test_queue_forget_prunes_failures_and_quarantine():
+    """Informer DELETED → forget: the failure map must not leak one entry
+    per ever-failed key (satellite: _failures hygiene)."""
+    q = RateLimitedQueue(quarantine_after=2)
+    for i in range(50):
+        key = ("ns", f"nb-{i}")
+        q.note_failure(key)
+        q.note_failure(key)
+        if i % 2:
+            q.quarantine(key, token="t")
+    assert len(q._failures) == 50
+    for i in range(50):
+        q.forget(("ns", f"nb-{i}"))
+    assert q._failures == {}
+    assert q.quarantined_keys() == []
+
+
+async def test_manager_quarantines_poison_key_and_emits_degraded():
+    kube = FakeKube()
+    registry = Registry()
+    mgr = Manager(kube, registry=registry, quarantine_after=4)
+    boom = {"n": 0}
+
+    async def reconcile(key):
+        boom["n"] += 1
+        cm = await kube.get("ConfigMap", key[1], key[0])
+        if not (cm.get("data") or {}).get("fixed"):
+            raise RuntimeError("poisoned")
+
+    mgr.add_controller(Controller("cm", "ConfigMap", reconcile))
+    for q in mgr._queues.values():
+        q.base_delay = 0.001
+        q.max_delay = 0.01
+    await mgr.start()
+    try:
+        await kube.create("ConfigMap", new_object("ConfigMap", "bad", "ns"))
+        queue = mgr._queues["cm"]
+        for _ in range(400):
+            if queue.is_quarantined(("ns", "bad")):
+                break
+            await asyncio.sleep(0.01)
+        assert queue.is_quarantined(("ns", "bad"))
+        assert boom["n"] == 4  # exactly the budget, then dead-lettered
+        await asyncio.sleep(0.05)
+        assert boom["n"] == 4  # ...and no retries while parked
+        # Degraded condition + Warning Event landed on the object.
+        cm = await kube.get("ConfigMap", "bad", "ns")
+        conds = cm.get("status", {}).get("conditions", [])
+        assert conds and conds[0]["type"] == "Degraded"
+        assert conds[0]["reason"] == "ReconcileQuarantined"
+        events = await kube.list("Event", "ns")
+        assert any(e.get("reason") == "ReconcileQuarantined" for e in events)
+        # Gauge exposes the dead-letter count.
+        assert 'workqueue_quarantined_keys{controller="cm"} 1' in \
+            registry.expose()
+        # An object CHANGE releases it (informer delta with a new rv).
+        await kube.patch("ConfigMap", "bad", {"data": {"fixed": "1"}}, "ns")
+        for _ in range(400):
+            if not queue.is_quarantined(("ns", "bad")):
+                break
+            await asyncio.sleep(0.01)
+        assert not queue.is_quarantined(("ns", "bad"))
+        assert boom["n"] > 4
+    finally:
+        await mgr.stop()
+        kube.close_watches()
+
+
+async def test_manager_requeue_quarantined_escape_hatch():
+    kube = FakeKube()
+    mgr = Manager(kube, registry=Registry(), quarantine_after=2)
+    calls = {"n": 0}
+
+    async def reconcile(key):
+        calls["n"] += 1
+        raise RuntimeError("still poisoned")
+
+    mgr.add_controller(Controller("cm", "ConfigMap", reconcile))
+    for q in mgr._queues.values():
+        q.base_delay = 0.001
+        q.max_delay = 0.01
+    await mgr.start()
+    try:
+        await kube.create("ConfigMap", new_object("ConfigMap", "bad", "ns"))
+        queue = mgr._queues["cm"]
+        for _ in range(400):
+            if queue.is_quarantined(("ns", "bad")):
+                break
+            await asyncio.sleep(0.01)
+        assert queue.is_quarantined(("ns", "bad"))
+        assert mgr.requeue_quarantined("cm", ("ns", "bad")) is True
+        assert mgr.requeue_quarantined("cm", ("ns", "missing")) is False
+        assert mgr.requeue_quarantined("nope", ("ns", "bad")) is False
+        # Still failing → it re-quarantines after another full budget.
+        for _ in range(400):
+            if queue.is_quarantined(("ns", "bad")):
+                break
+            await asyncio.sleep(0.01)
+        assert queue.is_quarantined(("ns", "bad"))
+        assert calls["n"] == 4
+    finally:
+        await mgr.stop()
+        kube.close_watches()
+
+
+def test_quarantine_after_env_parsing():
+    from kubeflow_tpu.runtime.manager import _quarantine_after_from_env
+
+    assert _quarantine_after_from_env({}) == 12
+    assert _quarantine_after_from_env({"KFTPU_QUARANTINE_AFTER": "5"}) == 5
+    assert _quarantine_after_from_env({"KFTPU_QUARANTINE_AFTER": "0"}) == 0
+    assert _quarantine_after_from_env({"KFTPU_QUARANTINE_AFTER": "-3"}) == 0
+    assert _quarantine_after_from_env({"KFTPU_QUARANTINE_AFTER": "x"}) == 12
+
+
+# ---- informer relist storm control (ISSUE 9 satellite) -------------------------
+
+
+async def test_informer_backoff_escalates_on_consecutive_failures():
+    """A flapping LIST escalates the relist delay exponentially (with
+    jitter) instead of hammering at a fixed cadence, and one success
+    resets the streak."""
+    from kubeflow_tpu.runtime.errors import ApiError
+
+    class FlakyKube(FakeKube):
+        def __init__(self):
+            super().__init__()
+            self.fail_lists = 0
+            self.list_calls = 0
+
+        async def list_with_rv(self, *a, **kw):
+            self.list_calls += 1
+            if self.fail_lists > 0:
+                self.fail_lists -= 1
+                raise ApiError("injected list failure")
+            return await super().list_with_rv(*a, **kw)
+
+    kube = FlakyKube()
+    registry = Registry()
+    inf = Informer(kube, "ConfigMap", resync_backoff=0.01,
+                   resync_backoff_max=0.08, registry=registry)
+    kube.fail_lists = 4
+    await inf.start()  # blocks until the first SUCCESSFUL list
+    try:
+        assert inf._consecutive_failures == 0  # reset on success
+        info = inf.debug_info()
+        assert info["consecutive_failures"] == 0
+        assert info["last_sync_age_sec"] is not None
+        assert info["relists"] == 5
+        # The escalation actually happened: delays 0.01, 0.02, 0.04, 0.08
+        # (plus jitter) — metrics counted every attempt.
+        text = registry.expose()
+        assert 'informer_relists_total{kind="ConfigMap"} 5.0' in text
+        assert "informer_last_sync_age_seconds" in text
+    finally:
+        await inf.stop()
+
+
+async def test_informer_clean_watch_close_relists_at_base_backoff():
+    kube = FakeKube()
+    inf = Informer(kube, "ConfigMap", resync_backoff=0.01)
+    await inf.start()
+    try:
+        relists_before = inf._relists
+        kube.close_watches()  # clean close → relist, no failure streak
+        for _ in range(100):
+            if inf._relists > relists_before:
+                break
+            await asyncio.sleep(0.01)
+        assert inf._relists > relists_before
+        assert inf._consecutive_failures == 0
+    finally:
+        await inf.stop()
+
+
+def test_conflict_failures_never_advance_the_quarantine_streak():
+    """409s back off but are not poison: a conflict storm plus one
+    trailing transient 5xx must NOT dead-letter a healthy key — only
+    consecutive POISONOUS failures count toward the budget."""
+    q = RateLimitedQueue(quarantine_after=3)
+    key = ("ns", "nb")
+    for _ in range(10):
+        q.note_failure(key, poisonous=False)  # the conflict storm
+    q.note_failure(key)                       # one trailing 500
+    assert q.backoff_delay(key) > 0           # conflicts DO back off
+    assert not q.should_quarantine(key)       # ...but don't dead-letter
+    q.note_failure(key)
+    q.note_failure(key)                       # third poisonous in a row
+    assert q.should_quarantine(key)
+    q.forget(key)
+    assert not q.should_quarantine(key)
+    assert q._poison_streak == {}
+
+
+async def test_mid_flight_edit_preempts_quarantine():
+    """A spec edit that lands WHILE the final failing reconcile is in
+    flight must win: quarantining on that stale attempt would capture the
+    edited object's token and park the fix unseen. The dirty re-add gets
+    one more try — and since the edit fixed the object, it converges."""
+    kube = FakeKube()
+    mgr = Manager(kube, registry=Registry(), quarantine_after=2)
+    gate = asyncio.Event()
+    calls = {"n": 0}
+
+    async def reconcile(key):
+        calls["n"] += 1
+        cm = await kube.get("ConfigMap", key[1], key[0])
+        if calls["n"] == 2:
+            # Attempt #2 (the one that would exhaust the budget): the
+            # user's fixing edit lands while we are still failing.
+            await kube.patch("ConfigMap", "racy", {"data": {"fixed": "1"}},
+                             "ns")
+            gate.set()
+        if not (cm.get("data") or {}).get("fixed"):
+            raise RuntimeError("poisoned")
+
+    mgr.add_controller(Controller("cm", "ConfigMap", reconcile))
+    for q in mgr._queues.values():
+        q.base_delay = 0.001
+        q.max_delay = 0.01
+    await mgr.start()
+    try:
+        await kube.create("ConfigMap", new_object("ConfigMap", "racy", "ns"))
+        await asyncio.wait_for(gate.wait(), timeout=5)
+        queue = mgr._queues["cm"]
+        for _ in range(400):
+            cm = await kube.get("ConfigMap", "racy", "ns")
+            degraded = any(
+                c.get("type") == "Degraded" and c.get("status") == "True"
+                for c in cm.get("status", {}).get("conditions", []))
+            if not queue.is_quarantined(("ns", "racy")) \
+                    and not degraded and calls["n"] >= 3:
+                break
+            await asyncio.sleep(0.01)
+        assert not queue.is_quarantined(("ns", "racy"))
+        assert calls["n"] >= 3  # the dirty re-add ran and succeeded
+    finally:
+        await mgr.stop()
+        kube.close_watches()

@@ -480,3 +480,37 @@ def test_status_surfaces_blocked_live_edit():
     assert status.phase == "ready"
     assert "blocked" in status.message
     assert "stop" in status.message
+
+
+def test_status_quarantined_notebook_is_actionable():
+    """A quarantined notebook (Degraded=True condition, stamped by the
+    manager's poison-pill dead-lettering) tells the user reconciliation
+    is SUSPENDED and what to do — it outranks every other signal, which
+    is frozen at quarantine time (ISSUE 9)."""
+    nb = nbapi.new("wedged", "ns")
+    nb["metadata"]["creationTimestamp"] = "2020-01-01T00:00:00Z"
+    nb["status"] = {
+        "readyReplicas": 1,
+        "tpu": {"hosts": 1},
+        "conditions": [{
+            "type": "Degraded", "status": "True",
+            "reason": "ReconcileQuarantined",
+            "message": "reconcile failed 12 times in a row",
+        }],
+    }
+    s = process_status(nb)
+    assert s.phase == "warning"
+    assert "Reconciliation suspended after repeated errors" in s.message
+    assert "ReconcileQuarantined" in s.message
+    assert "/debug/queue/requeue" in s.message
+
+    # Released (most recent Degraded is False): the normal state machine
+    # resumes — even with an older True entry deeper in the history.
+    nb["status"]["conditions"] = [
+        {"type": "Degraded", "status": "False",
+         "reason": "ReconcileQuarantined"},
+        {"type": "Degraded", "status": "True",
+         "reason": "ReconcileQuarantined"},
+    ]
+    s = process_status(nb)
+    assert s.phase == "ready"
