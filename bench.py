@@ -1205,6 +1205,381 @@ def chaos_soak(smoke: bool = False) -> dict:
     }
 
 
+def elastic_fleet(smoke: bool = False) -> dict:
+    """`bench.py elastic_fleet [--smoke]` — the elastic-fleet acceptance
+    gate (ISSUE 10). Three scenarios on FakeKube + podsim + the real
+    manager/controller/scheduler stack with KFTPU_ELASTIC semantics on:
+
+    - **wedge/defrag**: four 4-chip (v5e:2x2) notebooks flex-borrow
+      hosts on the big-slice pool, breaking both of its 4x4 slices; a
+      16-chip (v5e:4x4) gang then starves even after the small pool
+      frees up — until the defragmenter migrates the idle borrowers to
+      their pack pool. Measured: the large gang's time-to-admission
+      with defrag on; with defrag OFF it must still be starved at the
+      end of the window (the before/after the ROADMAP asks for).
+    - **scale-up round trip**: a gang that fits no pool even fully
+      drained raises a ProvisioningRequest-shaped intent; the driver
+      grants it by growing the fleet ConfigMap (the dynamic source) and
+      measures intent→admission latency; the intent must withdraw as
+      granted.
+    - **reclaim storm**: spot pools revoked on a seeded FaultPlan
+      schedule while a simulated SDK acks every drain; gates on zero
+      ledger violations, zero lost gangs, and zero grace fallbacks —
+      every reclaim with a live SDK routed through checkpoint-drain.
+      A final ack-less victim must hard-stop via the grace fallback
+      (the counter increments exactly once) so chips are never held
+      hostage.
+    """
+    import time as _time
+
+    from kubeflow_tpu.api import notebook as nbapi
+    from kubeflow_tpu.controllers.notebook import (
+        NotebookOptions,
+        setup_notebook_controller,
+    )
+    from kubeflow_tpu.migration import protocol as migration
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.metrics import Registry
+    from kubeflow_tpu.runtime.objects import annotations_of, fmt_iso
+    from kubeflow_tpu.scheduler import (
+        Fleet,
+        SchedulerOptions,
+        TpuFleetScheduler,
+    )
+    from kubeflow_tpu.testing.fakekube import FakeKube, FaultPlan
+    from kubeflow_tpu.testing.podsim import PodSimulator
+    from kubeflow_tpu.webhooks import register_all
+
+    async def sdk_ack_loop(kube, stop_flag, skip=()):
+        """Simulated in-pod SDK: ack any un-acked drain (except gangs in
+        ``skip`` — the deliberately ack-less victims)."""
+        while not stop_flag[0]:
+            try:
+                nbs = await kube.list("Notebook")
+            except Exception:
+                nbs = []
+            for nb in nbs:
+                ann = annotations_of(nb)
+                key = (nb["metadata"].get("namespace"),
+                       nb["metadata"]["name"])
+                if key in skip:
+                    continue
+                if (migration.drain_requested_at(ann) is not None
+                        and not migration.drain_acked(ann)
+                        and nbapi.STOP_ANNOTATION not in ann):
+                    try:
+                        await kube.patch(
+                            "Notebook", key[1],
+                            {"metadata": {"annotations":
+                                          migration.ack_patch(
+                                              f"/ckpt/{key[1]}", 1000,
+                                              _time.time(),
+                                              for_request=ann.get(
+                                                  nbapi.DRAIN_REQUESTED_ANNOTATION))}},
+                            key[0])
+                    except Exception:
+                        pass
+            await asyncio.sleep(0.005)
+
+    def build(fleet_spec=None, *, configmap=False, defrag=True,
+              grace=10.0):
+        kube = FakeKube()
+        register_all(kube)
+        mgr = Manager(kube, registry=Registry())
+        opts = SchedulerOptions(
+            queued_requeue_seconds=0.05,
+            enable_migration=True, drain_grace_seconds=grace,
+            enable_elastic=True, enable_defrag=defrag,
+            defrag_interval_seconds=0.05, defrag_idle_seconds=0.2,
+            scale_up_ttl_seconds=30.0,
+            fleet_refresh_seconds=0.05,
+            **({"fleet_configmap": "kftpu-fleet",
+                "controller_namespace": "kubeflow-tpu"}
+               if configmap else {}),
+        )
+        sched = TpuFleetScheduler(
+            kube, opts,
+            fleet=Fleet.parse(fleet_spec) if fleet_spec else None,
+            registry=mgr.registry)
+        setup_notebook_controller(mgr, NotebookOptions(), scheduler=sched)
+        return kube, mgr, sched
+
+    async def wait_until(predicate, timeout, what):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if predicate():
+                return True
+            await asyncio.sleep(0.01)
+        raise RuntimeError(f"elastic_fleet: timed out waiting for {what}")
+
+    async def wedge_scenario(defrag: bool) -> dict:
+        kube, mgr, sched = build("pack=v5e:4x4:2,small=v5e:2x2:2",
+                                 defrag=defrag)
+        sim = PodSimulator(kube)
+        await mgr.start()
+        await sim.start()
+        stop_flag = [False]
+        ack = asyncio.create_task(sdk_ack_loop(kube, stop_flag))
+        try:
+            # Two native small gangs fill the small pool, then the four
+            # 4-chip gangs of the wedge flex-borrow every pack host.
+            for i in range(2):
+                await kube.create("Notebook", nbapi.new(
+                    f"native-{i}", "bench", accelerator="v5e",
+                    topology="2x2"))
+            await mgr.wait_idle(timeout=20)
+            for i in range(4):
+                await kube.create("Notebook", nbapi.new(
+                    f"wedge-{i}", "bench", accelerator="v5e",
+                    topology="2x2"))
+            await mgr.wait_idle(timeout=20)
+            borrowed = dict(sched.policy.ledger.borrowed)
+            # The 16-chip gang starves: both pack slices are broken.
+            t0 = time.perf_counter()
+            await kube.create("Notebook", nbapi.new(
+                "big16", "bench", accelerator="v5e", topology="4x4"))
+            await mgr.wait_idle(timeout=20)
+            # The native small gangs complete — pack homes open up; the
+            # wedge gangs go idle (culling's probe signal).
+            for i in range(2):
+                await kube.patch(
+                    "Notebook", f"native-{i}",
+                    {"metadata": {"annotations": {
+                        nbapi.STOP_ANNOTATION: fmt_iso(_time.time())}}},
+                    "bench")
+            for i in range(4):
+                await kube.patch(
+                    "Notebook", f"wedge-{i}",
+                    {"metadata": {"annotations": {
+                        nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(
+                            _time.time() - 3600)}}}, "bench")
+            admitted = False
+            try:
+                await wait_until(
+                    lambda: ("bench", "big16") in
+                    sched.policy.ledger.allocations
+                    and not sched.policy.ledger.allocations[
+                        ("bench", "big16")].draining,
+                    10.0 if defrag else 3.0, "big16 admission")
+                admitted = True
+            except RuntimeError:
+                pass
+            wall = time.perf_counter() - t0
+            await mgr.wait_idle(timeout=20)
+            sched.policy.ledger.assert_consistent()
+            return {
+                "defrag": defrag,
+                "borrowed_hosts_at_wedge": borrowed,
+                "large_gang_admitted": admitted,
+                "time_to_admission_sec": round(wall, 4) if admitted
+                else None,
+                "defrag_moves": sched._defrag_moves,
+                "ledger_violations": sched.policy.ledger.violations,
+            }
+        finally:
+            stop_flag[0] = True
+            ack.cancel()
+            try:
+                await ack
+            except (asyncio.CancelledError, Exception):
+                pass
+            await sim.stop()
+            await mgr.stop()
+            kube.close_watches()
+
+    async def scale_up_scenario() -> dict:
+        kube, mgr, sched = build(configmap=True)
+        await kube.create("ConfigMap", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "kftpu-fleet",
+                         "namespace": "kubeflow-tpu"},
+            "data": {"fleet": "pool-a=v5e:4x4:1"},
+        })
+        sim = PodSimulator(kube)
+        await mgr.start()
+        await sim.start()
+        try:
+            t0 = time.perf_counter()
+            await kube.create("Notebook", nbapi.new(
+                "needs-three", "bench", accelerator="v5e",
+                topology="4x4", num_slices=3))
+            await wait_until(
+                lambda: sched._intent_book is not None
+                and sched._intent_book.intents,
+                10.0, "scale-up intent")
+            t_intent = time.perf_counter()
+            intent = next(iter(sched._intent_book.intents.values()))
+            pr = await kube.get_or_none("ProvisioningRequest",
+                                        intent.name, "kubeflow-tpu")
+            # Grant: the operator/autoscaler grows the pool; the dynamic
+            # fleet source reflects it and the gang admits.
+            await kube.patch(
+                "ConfigMap", "kftpu-fleet",
+                {"data": {"fleet": "pool-a=v5e:4x4:3"}}, "kubeflow-tpu")
+            await wait_until(
+                lambda: ("bench", "needs-three") in
+                sched.policy.ledger.allocations,
+                15.0, "admission against granted capacity")
+            t_admit = time.perf_counter()
+            await mgr.wait_idle(timeout=20)
+            granted = sched.m_scale_up_events.labels(
+                event="granted").value
+            pr_after = await kube.get_or_none(
+                "ProvisioningRequest", intent.name, "kubeflow-tpu")
+            sched.policy.ledger.assert_consistent()
+            return {
+                "intent_latency_sec": round(t_intent - t0, 4),
+                "grant_roundtrip_sec": round(t_admit - t_intent, 4),
+                "intent_pr_created": pr is not None,
+                "intent_withdrawn_granted": granted >= 1
+                and not sched._intent_book.intents,
+                "intent_pr_deleted": pr_after is None,
+                "ledger_violations": sched.policy.ledger.violations,
+            }
+        finally:
+            await sim.stop()
+            await mgr.stop()
+            kube.close_watches()
+
+    async def reclaim_storm(rounds: int) -> dict:
+        kube, mgr, sched = build(
+            "res=v5e:4x4:2,spot-a=v5e:4x4:2:spot,spot-b=v5e:4x4:2:spot",
+            grace=8.0)
+        plan = FaultPlan(seed=7)
+        plan.reclaim_spot(rate=1.0)   # the schedule below paces itself
+        sim = PodSimulator(kube)
+        await mgr.start()
+        await sim.start()
+        stop_flag = [False]
+        ack = asyncio.create_task(sdk_ack_loop(kube, stop_flag))
+        nodes = {}
+        try:
+            for pool in ("spot-a", "spot-b"):
+                for i in range(2):
+                    node = f"{pool}-node-{i}"
+                    nodes.setdefault(pool, []).append(node)
+                    await kube.create("Node", {
+                        "apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": node, "labels": {
+                            "cloud.google.com/gke-nodepool": pool,
+                            "cloud.google.com/gke-spot": "true"}},
+                    })
+            for i in range(6):
+                await kube.create("Notebook", nbapi.new(
+                    f"gang-{i}", "bench", accelerator="v5e",
+                    topology="4x4"))
+            await mgr.wait_idle(timeout=20)
+            revocations = 0
+            for _ in range(rounds):
+                for pool, pool_nodes in nodes.items():
+                    if plan.should_reclaim_spot(pool):
+                        revocations += 1
+                        for node in pool_nodes:
+                            await kube.patch(
+                                "Node", node, {"spec": {"taints": [{
+                                    "key": "cloud.google.com/"
+                                    "gke-spot-termination",
+                                    "effect": "NoSchedule"}]}})
+                await asyncio.sleep(0.3)
+                # Revocation completes; replacement capacity arrives.
+                for pool_nodes in nodes.values():
+                    for node in pool_nodes:
+                        await kube.patch("Node", node,
+                                         {"spec": {"taints": None}})
+                await asyncio.sleep(0.2)
+            await wait_until(
+                lambda: not sched._draining and not sched._spot_reclaims,
+                30.0, "storm drains to finish")
+            await mgr.wait_idle(timeout=30)
+            sched.policy.ledger.assert_consistent()
+            lost = []
+            for nb in await kube.list("Notebook"):
+                key = (nb["metadata"].get("namespace"),
+                       nb["metadata"]["name"])
+                if nbapi.STOP_ANNOTATION in annotations_of(nb):
+                    continue
+                if key not in sched.policy.ledger.allocations \
+                        and key not in sched.policy.pending:
+                    lost.append(key)
+            storm = {
+                "rounds": rounds,
+                "revocations": revocations,
+                "reclaim_drains": sched.m_spot_reclaims.labels().value,
+                "grace_fallbacks_during_storm":
+                    sched.m_drain_fallback.labels().value,
+                "lost_gangs": [f"{k[0]}/{k[1]}" for k in lost],
+                "ledger_violations": sched.policy.ledger.violations,
+            }
+            # Ack-less arm: a victim whose SDK never answers must
+            # hard-stop via the grace fallback — chips never hostage.
+            stop_flag[0] = True
+            before = sched.m_drain_fallback.labels().value
+            victim = next(
+                (k for k, a in sched.policy.ledger.allocations.items()
+                 if any(p.startswith("spot") for p in a.placements)),
+                None)
+            residents = 0
+            if victim is not None:
+                pool = next(p for p in sched.policy.ledger.allocations[
+                    victim].placements if p.startswith("spot"))
+                residents = sum(
+                    1 for a in sched.policy.ledger.allocations.values()
+                    if a.placements.get(pool))
+                for node in nodes[pool]:
+                    await kube.patch(
+                        "Node", node, {"spec": {"taints": [{
+                            "key": "cloud.google.com/gke-spot-termination",
+                            "effect": "NoSchedule"}]}})
+                await wait_until(
+                    lambda: sched.m_drain_fallback.labels().value
+                    >= before + residents, 30.0,
+                    "grace fallback for ack-less victims")
+            await mgr.wait_idle(timeout=20)
+            storm["ackless_fallbacks"] = (
+                sched.m_drain_fallback.labels().value - before)
+            storm["ackless_residents"] = residents
+            storm["ackless_victim_tested"] = victim is not None
+            return storm
+        finally:
+            stop_flag[0] = True
+            ack.cancel()
+            try:
+                await ack
+            except (asyncio.CancelledError, Exception):
+                pass
+            await sim.stop()
+            await mgr.stop()
+            kube.close_watches()
+
+    wedge_off = asyncio.run(wedge_scenario(defrag=False))
+    wedge_on = asyncio.run(wedge_scenario(defrag=True))
+    scale_up = asyncio.run(scale_up_scenario())
+    storm = asyncio.run(reclaim_storm(rounds=2 if smoke else 5))
+    ok = (
+        wedge_on["large_gang_admitted"]
+        and not wedge_off["large_gang_admitted"]
+        and wedge_on["ledger_violations"] == 0
+        and wedge_off["ledger_violations"] == 0
+        and scale_up["intent_pr_created"]
+        and scale_up["intent_withdrawn_granted"]
+        and scale_up["ledger_violations"] == 0
+        and storm["ledger_violations"] == 0
+        and not storm["lost_gangs"]
+        and storm["grace_fallbacks_during_storm"] == 0
+        and (not storm["ackless_victim_tested"]
+             or storm["ackless_fallbacks"] == storm["ackless_residents"])
+    )
+    return {
+        "metric": "elastic_fleet",
+        "smoke": smoke,
+        "wedge_defrag_off": wedge_off,
+        "wedge_defrag_on": wedge_on,
+        "scale_up": scale_up,
+        "reclaim_storm": storm,
+        "pass": ok,
+    }
+
+
 def tracing_overhead() -> dict:
     """`bench.py tracing_overhead` — prove the always-on tracing path
     (span trees + flight recorder + API-call tagging, PR 3) costs <5% of
@@ -1483,6 +1858,14 @@ if __name__ == "__main__":
         print(json.dumps(result))
         # CI gate: any invariant violation, wedged key, or a poison pill
         # that fails to quarantine/resume must fail the step.
+        if not result["pass"]:
+            sys.exit(1)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "elastic_fleet":
+        result = elastic_fleet(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(result))
+        # CI gate: the wedge must resolve via defrag (and starve without
+        # it), scale-up must round-trip, and the reclaim storm must end
+        # with zero ledger violations / lost gangs / live-SDK fallbacks.
         if not result["pass"]:
             sys.exit(1)
     else:

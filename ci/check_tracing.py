@@ -71,6 +71,20 @@ DRAINS_ROUTE_RE = re.compile(r"result,\s*\"drains\"|result\.drains")
 POLICY_FILE = os.path.join(REPO, "kubeflow_tpu", "scheduler", "policy.py")
 DEFERRED_RE = re.compile(r"deferred_preemption")
 
+# Elastic-fleet contract (ISSUE 10): the scheduler runtime must register
+# the elastic phases (scale_up/reclaim/defrag) so intents, spot reclaims
+# and defrag migrations land in /debug/traces — and spot reclaim must
+# route through the drain protocol (_request_drain), never a bare stop:
+# a refactor that stop-annotates spot victims directly would lose
+# in-flight training state on every revocation.
+ELASTIC_FILE = os.path.join(REPO, "kubeflow_tpu", "scheduler", "elastic.py")
+ELASTIC_PHASES = ("scale_up", "reclaim", "defrag")
+SWEEP_RECLAIM_RE = re.compile(
+    r"async def _sweep_spot_reclaims\(.*?(?=\n    (?:async )?def |\nclass )",
+    re.DOTALL)
+BARE_STOP_RE = re.compile(r"_stop_victim\(|STOP_ANNOTATION")
+
+
 # Quarantine contract (ISSUE 9): dead-lettering a key must be observable
 # — the manager's quarantine path opens its span (lands in
 # /debug/traces) and emits the ReconcileQuarantined Warning Event +
@@ -192,6 +206,52 @@ def check_migration() -> list[str]:
     return problems
 
 
+def check_elastic() -> list[str]:
+    problems = []
+    rel_el = os.path.relpath(ELASTIC_FILE, REPO)
+    if not os.path.exists(ELASTIC_FILE):
+        return [f"{rel_el}: missing — the elastic fleet policy core "
+                "(scale-up intents, spot reclaim, defrag) is gone "
+                "(ISSUE 10)"]
+    el_src = open(ELASTIC_FILE).read()
+    for needed in ("def plan_defrag", "def compute_shortfalls",
+                   "class IntentBook"):
+        if needed not in el_src:
+            problems.append(
+                f"{rel_el}: `{needed}` is gone — the elastic policy "
+                "core lost a capability the runtime depends on")
+    rel_rt = os.path.relpath(SCHEDULER_RUNTIME, REPO)
+    try:
+        src = open(SCHEDULER_RUNTIME).read()
+    except OSError:
+        return problems + [f"{rel_rt}: missing"]
+    phases = set(SPAN_RE.findall(src))
+    for phase in ELASTIC_PHASES:
+        if phase not in phases:
+            problems.append(
+                f"{rel_rt}: missing the `{phase}` elastic phase span — "
+                "scale-up/reclaim/defrag decisions must land in "
+                "/debug/traces")
+    sweep = SWEEP_RECLAIM_RE.search(src)
+    if sweep is None:
+        problems.append(
+            f"{rel_rt}: _sweep_spot_reclaims is gone — spot revocations "
+            "would kill work in flight instead of draining it")
+    else:
+        body = sweep.group(0)
+        if "_request_drain(" not in body:
+            problems.append(
+                f"{rel_rt}: spot reclaim no longer routes through "
+                "_request_drain — a revocation would bypass the "
+                "checkpoint drain protocol")
+        if BARE_STOP_RE.search(body):
+            problems.append(
+                f"{rel_rt}: _sweep_spot_reclaims stops victims directly "
+                "(bare-stop bypass) — reclaim must checkpoint first; "
+                "the grace-deadline fallback lives in _finalize_drain")
+    return problems
+
+
 def check_file(path: str) -> list[str]:
     src = open(path).read()
     if "async def reconcile(" not in src:
@@ -240,6 +300,7 @@ def main() -> int:
     problems.extend(check_scheduler())
     problems.extend(check_migration())
     problems.extend(check_quarantine())
+    problems.extend(check_elastic())
     for p in problems:
         print(f"check_tracing: {p}", file=sys.stderr)
     if not problems:

@@ -118,6 +118,11 @@ def workflow_tests() -> dict:
                         "on any invariant violation)",
                         "python bench.py chaos_soak --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("Elastic-fleet smoke bench (defrag wedge, "
+                        "scale-up round trip, spot reclaim storm; exit "
+                        "1 on gate failure)",
+                        "python bench.py elastic_fleet --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

@@ -93,6 +93,12 @@ SCHEDULER_ADMITTED_AT_ANNOTATION = "notebooks.kubeflow.org/admitted-at"
 # - stamped (with the reason) alongside the stop annotation when the
 #   scheduler preempts the gang; cleared on re-admission.
 PREEMPTED_ANNOTATION = "notebooks.kubeflow.org/preempted"
+# - elastic flex placement (scheduler/elastic.py): the foreign pool this
+#   gang borrows a host from, stamped at admission and cleared on a
+#   native admission/release. A controller restart reads it to restore
+#   the BORROW booking (re-seating natively would resell the host its
+#   pods still occupy and flip their node selectors).
+FLEX_POOL_ANNOTATION = "notebooks.kubeflow.org/flex-pool"
 
 # Migration contract (kubeflow_tpu/migration/protocol.py): preemption,
 # culling, and user suspend all speak one drain protocol — request a
@@ -102,8 +108,9 @@ PREEMPTED_ANNOTATION = "notebooks.kubeflow.org/preempted"
 #   it and checkpoints when it appears;
 DRAIN_REQUESTED_ANNOTATION = "notebooks.kubeflow.org/drain-requested"
 # - why the drain was requested: "preempt:idle" | "preempt:priority" |
-#   "cull" | "suspend" — the finalizer (scheduler, culler, notebook
-#   controller) only acts on its own reasons;
+#   "spot-reclaim" | "defrag" | "cull" | "suspend" — the finalizer
+#   (scheduler, elastic runtime, culler, notebook controller) only acts
+#   on its own reasons;
 DRAIN_REASON_ANNOTATION = "notebooks.kubeflow.org/drain-reason"
 # - SDK progress marks: snapshot started / committed. An ack echoes the
 #   drain request it answers (checkpointed-for = the raw drain-requested

@@ -73,9 +73,12 @@ def notebook_options():
 
 def scheduler_options():
     """Fleet-scheduler env contract (docs/operations.md "TPU fleet
-    scheduler"). The on/off switch itself is KFTPU_SCHEDULER, read by
-    kubeflow_tpu.scheduler.scheduler_enabled."""
+    scheduler" + "Elastic fleet"). The on/off switch itself is
+    KFTPU_SCHEDULER, read by kubeflow_tpu.scheduler.scheduler_enabled;
+    the elastic subsystem has its own KFTPU_ELASTIC (and KFTPU_DEFRAG)
+    underneath it."""
     from kubeflow_tpu.migration import protocol as migration
+    from kubeflow_tpu.scheduler import elastic
     from kubeflow_tpu.scheduler.runtime import SchedulerOptions
 
     weights: dict[str, float] = {}
@@ -107,6 +110,24 @@ def scheduler_options():
         # gets it from here.
         enable_migration=migration.migration_enabled(),
         drain_grace_seconds=migration.drain_grace_seconds(),
+        # Elastic fleet (KFTPU_ELASTIC, default on): scale-up intents,
+        # flex placement, spot reclaim, defrag. =off restores PR 5–7
+        # scheduler behavior byte-for-byte; KFTPU_DEFRAG=off disables
+        # only the defragmenter.
+        enable_elastic=elastic.elastic_enabled(),
+        enable_defrag=elastic.defrag_enabled(),
+        scale_up_ttl_seconds=env_float(
+            "KFTPU_SCALE_UP_TTL", elastic.DEFAULT_SCALE_UP_TTL_SECONDS),
+        defrag_interval_seconds=env_float(
+            "KFTPU_DEFRAG_INTERVAL",
+            elastic.DEFAULT_DEFRAG_INTERVAL_SECONDS),
+        defrag_idle_seconds=env_float(
+            "KFTPU_DEFRAG_IDLE_SECONDS",
+            elastic.DEFAULT_DEFRAG_IDLE_SECONDS),
+        defrag_max_moves=int(env_float(
+            "KFTPU_DEFRAG_MAX_MOVES", elastic.DEFAULT_DEFRAG_MAX_MOVES)),
+        fleet_refresh_seconds=env_float("KFTPU_FLEET_REFRESH_SECONDS",
+                                        30.0),
     )
 
 

@@ -384,6 +384,13 @@ class NotebookReconciler:
             if self.opts.enable_queued_provisioning and ms \
                     and nbapi.queued_provisioning(nb):
                 await self._release_capacity(nb)
+            # Same contract for slices: a gang that slipped through the
+            # scheduler's pre-activation pass-through window (fresh
+            # restart, dynamic fleet source still loading, possibly a
+            # partial DAG apply under API faults) can own live
+            # StatefulSets by the time arbitration lands Queued — scale
+            # them to 0; their chips belong to whoever wins.
+            await self._park_queued_slices(nb)
             requeue = Result(requeue_after=(
                 self._scheduler.options.queued_requeue_seconds))
             return True, requeue, admission
@@ -466,6 +473,34 @@ class NotebookReconciler:
         return await sched.admission(
             nb, ms, running=(await self._gang_running(nb, ms)
                              or await self._holds_reservation(nb)))
+
+    async def _park_queued_slices(self, nb: dict) -> None:
+        """Scale a Queued gang's leftover slice StatefulSets to zero
+        (see the caller for how a Queued gang can own any). Informer
+        owner-index first; zero work for the common no-STS queued gang.
+        A stale cache at worst defers the park one STS event — the
+        queued requeue re-runs this every pass."""
+        name, ns = name_of(nb), namespace_of(nb)
+        if (self._sts_informer is not None
+                and self._sts_informer.has_indexer(OWNER_INDEX)):
+            owned = self._sts_informer.by_index(OWNER_INDEX, uid_of(nb))
+        else:
+            try:
+                owned = await self.kube.list(
+                    "StatefulSet", ns,
+                    label_selector={
+                        "matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name}},
+                )
+            except ApiError:
+                return
+        for sts in owned:
+            if (deep_get(sts, "spec", "replicas") or 0) > 0:
+                try:
+                    await self.kube.patch(
+                        "StatefulSet", name_of(sts),
+                        {"spec": {"replicas": 0}}, ns)
+                except (NotFound, ApiError):
+                    pass
 
     async def _holds_reservation(self, nb: dict) -> bool:
         """Does this notebook hold a live GKE ProvisioningRequest?
@@ -671,6 +706,17 @@ class NotebookReconciler:
             sts = self.generate_statefulset(
                 nb, tpu, multi=ms, slice_id=slice_id,
                 capacity_provisioned=capacity_provisioned)
+        if self._scheduler is not None:
+            flex = self._scheduler.flex_node_selectors(
+                (namespace_of(nb), name_of(nb)))
+            if flex:
+                # Flex (borrowed-host) placement: the workers must land
+                # on the HOST pool's nodes — the gang's own shape labels
+                # select nothing (that's why it borrowed). Chip request
+                # stays the gang's own (sub-host allocation).
+                selectors = sts["spec"]["template"]["spec"].setdefault(
+                    "nodeSelector", {})
+                selectors.update(flex)
         if self.opts.enable_migration:
             await self._stabilize_restore_env(nb, sts)
         if not capacity_provisioned:
@@ -1913,8 +1959,10 @@ def _copy_configmap_data(desired: dict, live: dict) -> bool:
 def _scheduler_status_block(admission) -> dict | None:
     """Admission verdict → the ``status.scheduler`` block. The shape is
     the JWA contract (web/common/status.py): Queued carries position +
-    waitingChips + reason, Preempted/Draining carry the reason, Admitted
-    is bare."""
+    waitingChips + reason — plus, elastic, the reclaim marker ("this
+    gang is re-queued because its spot capacity was revoked / it is
+    migrating pools") and any pending scale-up intent for its shape;
+    Preempted/Draining carry the reason, Admitted is bare."""
     if admission is None:
         return None
     block: dict = {"state": admission.state}
@@ -1922,6 +1970,13 @@ def _scheduler_status_block(admission) -> dict | None:
         block["position"] = admission.position
         block["waitingChips"] = admission.waiting_chips
         block["reason"] = admission.reason
+        if getattr(admission, "reclaimed", ""):
+            block["reclaimed"] = admission.reclaimed
+        if getattr(admission, "scale_up_chips", 0):
+            block["scaleUp"] = {
+                "chips": admission.scale_up_chips,
+                "pendingSeconds": admission.scale_up_pending_sec,
+            }
     elif admission.state in ("Preempted", "Draining") and admission.reason:
         block["reason"] = admission.reason
     return block
@@ -2120,6 +2175,24 @@ def setup_notebook_controller(
         rec._scheduler._nb_informer = rec._nb_informer
         if getattr(rec._scheduler.options, "fleet_spec", "") == "auto":
             rec._scheduler._node_informer = mgr.informer_for("Node")
+        if getattr(rec._scheduler.options, "enable_elastic", False):
+            # Elastic fleet: spot pools are reclaim-aware — the
+            # revocation signal is a Node taint, so the scheduler needs
+            # node events even for env/ConfigMap fleets (the auto
+            # informer above only exists for label inference). The
+            # informer handle also lets a lazily-activated fleet
+            # re-scan cached nodes for signals its handler dropped
+            # pre-activation.
+            rec._scheduler._node_informer = mgr.informer_for("Node")
+            sched_ref = rec._scheduler
+
+            def spot_node_handler(event: str, node: dict) -> None:
+                if event == "DELETED":
+                    sched_ref.note_node_gone(node)
+                else:
+                    sched_ref.note_node_event(node)
+
+            mgr.informer_for("Node").add_handler(spot_node_handler)
         mgr.scheduler = rec._scheduler
     rec._pod_informer = mgr.informer_for("Pod")
     rec._pod_informer.add_indexer(

@@ -24,6 +24,12 @@ A drain that outlives ``KFTPU_DRAIN_GRACE`` falls back to today's hard
 stop: the finalizer (scheduler/culler/controller — identified by the
 ``drain-reason`` prefix it stamped) clears the drain marks and stops the
 CR without a checkpoint. Chips are never held hostage to a wedged pod.
+
+Drain reasons and their finalizers: ``preempt:idle``/``preempt:priority``
+(scheduler preemption — park until user restart), ``spot-reclaim`` and
+``defrag`` (elastic fleet, kubeflow_tpu/scheduler/elastic.py — park,
+then auto-re-queue at original priority with aging credit), ``cull``
+(idle culler), ``suspend`` (user/controller).
 """
 
 from __future__ import annotations
@@ -110,13 +116,21 @@ def drain_acked(annotations: dict) -> bool:
     involves two clocks (the controller stamps the request, the pod
     stamps the ack — skew between them must not make acks invisible or a
     stale checkpoint look fresh). The timestamp ordering remains as a
-    fallback for acks stamped without the echo."""
+    fallback for acks stamped without the echo — but only alongside a
+    ``checkpointing-at`` progress mark, which every drain request CLEARS
+    and the SDK re-stamps when it starts saving for that drain: the
+    checkpoint path/step/commit-time survive re-admission as the restore
+    hint, and with second-granularity timestamps a surviving old commit
+    could otherwise instant-"ack" a new drain issued in the same second
+    (rapid spot-reclaim cycles hit exactly this)."""
     requested_raw = annotations.get(nbapi.DRAIN_REQUESTED_ANNOTATION)
     if not requested_raw:
         return False
     echo = annotations.get(nbapi.CHECKPOINTED_FOR_ANNOTATION)
     if echo is not None:
         return echo == requested_raw
+    if not annotations.get(nbapi.CHECKPOINTING_AT_ANNOTATION):
+        return False
     requested = drain_requested_at(annotations)
     acked = checkpointed_at(annotations)
     return requested is not None and acked is not None and acked >= requested
@@ -195,8 +209,13 @@ def ack_patch(path: str, step: int, now: float,
     """The SDK's commit mark: checkpoint durable at (path, step).
     ``for_request`` echoes the raw drain-requested value being answered
     (see :func:`drain_acked` — the echo makes ack detection clock-skew
-    immune); pass the annotation value the SDK read."""
+    immune); pass the annotation value the SDK read. The patch also
+    (re)stamps ``checkpointing-at``: a commit implies a started save,
+    and echo-less acks are only honored alongside that progress mark
+    (every drain request clears it, so a pre-park checkpoint cannot
+    instant-ack the next cycle's drain)."""
     patch = {
+        nbapi.CHECKPOINTING_AT_ANNOTATION: fmt_iso(now),
         nbapi.CHECKPOINTED_AT_ANNOTATION: fmt_iso(now),
         nbapi.CHECKPOINT_PATH_ANNOTATION: path,
         nbapi.CHECKPOINT_STEP_ANNOTATION: str(step),

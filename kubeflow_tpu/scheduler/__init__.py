@@ -37,6 +37,14 @@ from kubeflow_tpu.scheduler.policy import (  # noqa: F401
     PolicyQueue,
     ScheduleResult,
 )
+from kubeflow_tpu.scheduler.elastic import (  # noqa: F401
+    DefragMove,
+    ElasticConfig,
+    IntentBook,
+    ScaleUpIntent,
+    defrag_enabled,
+    elastic_enabled,
+)
 from kubeflow_tpu.scheduler.runtime import (  # noqa: F401
     Admission,
     SchedulerOptions,

@@ -21,15 +21,29 @@ policy core above it stays deterministic and property-testable.
 Fleet sources, in the order the runtime tries them:
 
 - ``KFTPU_FLEET`` env: ``pool-a=v5e:4x4:2,pool-b=v5p:2x2x1:4``
-  (``<name>=<accelerator>:<topology>:<num-slices>``);
+  (``<name>=<accelerator>:<topology>:<num-slices>[:spot]`` — the
+  optional 4th field marks a reclaimable spot/preemptible pool);
 - a ConfigMap with the same format under ``data["fleet"]``
   (``KFTPU_FLEET_CONFIGMAP``, loaded by the runtime);
 - ``KFTPU_FLEET=auto``: inferred from Node objects' GKE TPU labels
-  (``from_nodes``) — one pool per ``cloud.google.com/gke-nodepool``.
+  (``from_nodes``) — one pool per ``cloud.google.com/gke-nodepool``;
+  nodes carrying ``cloud.google.com/gke-spot=true`` mark their pool
+  spot.
+
+Elastic extension (kubeflow_tpu/scheduler/elastic.py): with
+``KFTPU_ELASTIC`` on, a single-host gang that fits no pool of its own
+shape may *borrow* a host from a same-accelerator pool of a larger
+shape. Borrowed hosts are tracked host-granular (``ChipLedger.
+borrowed``); each pool's borrowed hosts break ``ceil(borrowed /
+hosts_per_slice)`` whole slices out of its native capacity — that is
+the fragmentation the defragmenter exists to undo. With no borrows the
+accounting below is bit-identical to the pre-elastic ledger.
 """
 
 from __future__ import annotations
 
+import math
+import re
 from dataclasses import dataclass, field
 
 from kubeflow_tpu.tpu.topology import (
@@ -41,6 +55,15 @@ from kubeflow_tpu.tpu.topology import (
 )
 
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+# GKE's well-known spot/preemptible marker on Nodes.
+GKE_SPOT_LABEL = "cloud.google.com/gke-spot"
+
+# Pool names feed metric labels, debug rows, and (auto mode) come from
+# nodepool names — hold them to the same DNS-1123-ish contract so a typo
+# like "pool a" or an empty name fails at parse time, not as a confusing
+# ledger key later.
+_POOL_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9._]*[a-z0-9])?$",
+                           re.IGNORECASE)
 
 # gke_accelerator label value → our short accelerator name ("v5e", ...).
 _GKE_TO_NAME = {acc.gke_accelerator: acc.name for acc in ACCELERATORS.values()}
@@ -58,14 +81,24 @@ class LedgerError(RuntimeError):
 
 @dataclass(frozen=True)
 class NodePool:
-    """One TPU node pool: ``num_slices`` slices of one shape."""
+    """One TPU node pool: ``num_slices`` slices of one shape. ``spot``
+    marks reclaimable (preemptible) capacity: the elastic runtime drains
+    its gangs through the checkpoint protocol when a revocation signal
+    lands, instead of letting the node teardown kill work in flight."""
 
     name: str
     accelerator: str       # short name: v4 | v5e | v5p | v6e
     topology: str          # slice chip grid, e.g. "4x4"
     num_slices: int
+    spot: bool = False
 
     def __post_init__(self):
+        if not self.name or not _POOL_NAME_RE.match(self.name):
+            raise FleetConfigError(
+                f"bad pool name {self.name!r}: pool names must be "
+                "non-empty and use only letters, digits, '-', '_', '.' "
+                "(they become ledger keys, metric labels and nodepool "
+                "references)")
         if self.num_slices < 1:
             raise FleetConfigError(
                 f"pool {self.name}: num_slices must be >= 1, "
@@ -80,6 +113,14 @@ class NodePool:
     @property
     def chips_per_slice(self) -> int:
         return self.slice_shape.num_chips
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return self.slice_shape.num_hosts
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.slice_shape.chips_per_host
 
     @property
     def total_chips(self) -> int:
@@ -98,21 +139,40 @@ class Fleet:
 
     @classmethod
     def parse(cls, spec: str) -> "Fleet":
-        """``pool-a=v5e:4x4:2,pool-b=v5p:2x2x1:4`` → Fleet. Empty/None
-        spec → empty fleet (scheduler passes everything through)."""
+        """``pool-a=v5e:4x4:2,pool-b=v5p:2x2x1:4:spot`` → Fleet. Empty/
+        None spec → empty fleet (scheduler passes everything through).
+        The optional 4th field marks a spot (reclaimable) pool.
+
+        Duplicate pool names are a hard error, not last-wins: the ledger
+        resolves placements by name, so two entries under one name would
+        silently sell one pool's capacity twice. The error names both
+        entry positions so the operator can find the clash in a long
+        spec."""
         pools: list[NodePool] = []
-        seen: set[str] = set()
+        seen: dict[str, int] = {}   # pool name → 1-based entry position
+        position = 0
         for raw in (spec or "").replace("\n", ",").split(","):
             entry = raw.strip()
             if not entry:
                 continue
+            position += 1
             name, sep, shape = entry.partition("=")
             parts = shape.split(":")
-            if not sep or len(parts) != 3:
+            if not sep or len(parts) not in (3, 4):
                 raise FleetConfigError(
                     f"bad fleet entry {entry!r}: want "
-                    "<name>=<accelerator>:<topology>:<num-slices>")
-            acc, topo, n = (p.strip() for p in parts)
+                    "<name>=<accelerator>:<topology>:<num-slices>[:spot]")
+            acc, topo, n = (p.strip() for p in parts[:3])
+            spot = False
+            if len(parts) == 4:
+                flag = parts[3].strip().lower()
+                if flag == "spot":
+                    spot = True
+                elif flag not in ("", "reserved", "on-demand"):
+                    raise FleetConfigError(
+                        f"bad fleet entry {entry!r}: unknown pool flag "
+                        f"{parts[3].strip()!r} — the 4th field is 'spot' "
+                        "(reclaimable capacity) or omitted")
             try:
                 num = int(n)
             except ValueError:
@@ -121,10 +181,15 @@ class Fleet:
                     "an integer") from None
             name = name.strip()
             if name in seen:
-                raise FleetConfigError(f"duplicate pool name {name!r}")
-            seen.add(name)
+                raise FleetConfigError(
+                    f"duplicate pool name {name!r} (entries {seen[name]} "
+                    f"and {position}): each pool must appear exactly once "
+                    "— merge the slice counts into one entry or rename "
+                    "one of the pools")
+            seen[name] = position
             try:
-                pools.append(NodePool(name, acc.lower(), topo.lower(), num))
+                pools.append(NodePool(name, acc.lower(), topo.lower(), num,
+                                      spot=spot))
             except TopologyError as e:
                 raise FleetConfigError(f"bad fleet entry {entry!r}: {e}") \
                     from None
@@ -137,6 +202,7 @@ class Fleet:
         count is ``hosts // hosts_per_slice`` (partial slices can never
         schedule a gang, so they don't count)."""
         hosts: dict[tuple[str, str, str], int] = {}
+        spot_pools: set[str] = set()
         for node in nodes:
             labels = ((node.get("metadata") or {}).get("labels")) or {}
             gke_acc = labels.get(GKE_TPU_ACCELERATOR_LABEL)
@@ -147,6 +213,11 @@ class Fleet:
             pool = labels.get(GKE_NODEPOOL_LABEL) or f"{acc}-{topo}"
             hosts[(pool, acc, topo.lower())] = \
                 hosts.get((pool, acc, topo.lower()), 0) + 1
+            if labels.get(GKE_SPOT_LABEL) == "true":
+                # ANY spot node marks the pool spot: treating a mixed
+                # pool as reclaimable errs toward draining through the
+                # checkpoint protocol — the safe direction.
+                spot_pools.add(pool)
         # A nodepool label carrying two TPU shapes (mid-migration label
         # drift) must not yield two same-named pools: the ledger resolves
         # placements by name, and the collision would make every admit of
@@ -170,7 +241,13 @@ class Fleet:
         for pool, acc, topo, num_slices in survivors:
             name = (f"{pool}-{acc}-{topo}" if name_shapes[pool] > 1
                     else pool)
-            pools.append(NodePool(name, acc, topo, num_slices))
+            try:
+                pools.append(NodePool(name, acc, topo, num_slices,
+                                      spot=pool in spot_pools))
+            except FleetConfigError:
+                # A garbage nodepool label must not wedge fleet
+                # inference for the healthy pools.
+                continue
         return cls(pools=tuple(pools))
 
     def by_name(self, name: str) -> NodePool | None:
@@ -200,7 +277,13 @@ class Allocation:
     """One admitted gang: the notebook's FULL slice set, spread over
     matching pools. ``placements`` maps pool name → slices taken there;
     its values always sum to the request's num_slices (gang atomicity —
-    checked at admit time and by ``ChipLedger.assert_consistent``)."""
+    checked at admit time and by ``ChipLedger.assert_consistent``).
+
+    Elastic flex placement (``borrow``): a single-host gang seated on a
+    same-accelerator pool of a DIFFERENT shape occupies whole hosts, not
+    slices — ``borrow`` maps pool name → hosts and ``placements`` is
+    empty. Gang atomicity then means the borrow hosts sum to the gang's
+    host count."""
 
     key: tuple              # (namespace, name)
     namespace: str
@@ -225,6 +308,13 @@ class Allocation:
     # gang is drained for slices already on their way out) and never
     # re-picks it as a victim.
     draining: bool = False
+    # Elastic flex placement: pool → borrowed hosts (see class docstring).
+    # None/empty for every native (slice-granular) allocation.
+    borrow: dict[str, int] | None = None
+
+    @property
+    def borrowed(self) -> bool:
+        return bool(self.borrow)
 
 
 @dataclass
@@ -238,10 +328,39 @@ class ChipLedger:
     used: dict[str, int] = field(default_factory=dict)        # pool → slices
     allocations: dict[tuple, Allocation] = field(default_factory=dict)
     ns_chips: dict[str, int] = field(default_factory=dict)    # ns → chips
+    # Elastic flex placement: pool → hosts borrowed by foreign-shape
+    # single-host gangs. Empty (and the accounting below bit-identical
+    # to pre-elastic) unless the elastic pass admits borrows.
+    borrowed: dict[str, int] = field(default_factory=dict)
+    # Pools that must sell NOTHING right now: a spot pool mid-reclaim
+    # (its nodes carry a revocation signal) offers zero free slices and
+    # zero borrowable hosts until the signal clears or the fleet source
+    # drops the pool. Existing holders keep their booking — the drain
+    # protocol vacates them. Empty unless the elastic runtime marks it.
+    unavailable: set = field(default_factory=set)
     violations: int = 0
 
+    def broken_slices(self, pool: NodePool) -> int:
+        """Whole native slices a pool's borrowed hosts put out of
+        service. Borrowers are packed onto the fewest slices, so the
+        breakage is the ceiling, not one slice per borrower."""
+        hosts = self.borrowed.get(pool.name, 0)
+        return math.ceil(hosts / pool.hosts_per_slice) if hosts else 0
+
     def free_slices(self, pool: NodePool) -> int:
-        return pool.num_slices - self.used.get(pool.name, 0)
+        if pool.name in self.unavailable:
+            return 0
+        return pool.num_slices - self.used.get(pool.name, 0) \
+            - self.broken_slices(pool)
+
+    def free_hosts(self, pool: NodePool) -> int:
+        """Hosts available for elastic borrowing: everything not under a
+        native slice allocation and not already borrowed."""
+        if pool.name in self.unavailable:
+            return 0
+        native_hosts = self.used.get(pool.name, 0) * pool.hosts_per_slice
+        return pool.num_slices * pool.hosts_per_slice - native_hosts \
+            - self.borrowed.get(pool.name, 0)
 
     def fit(self, accelerator: str, topology: str,
             num_slices: int) -> dict[str, int] | None:
@@ -259,6 +378,46 @@ class ChipLedger:
                 remaining -= take
         return plan if remaining == 0 else None
 
+    def borrow_fit(self, accelerator: str, topology: str,
+                   *, avoid_new_break_shapes: frozenset = frozenset(),
+                   prefer: str | None = None) -> dict | None:
+        """Host-borrow plan (``{pool: 1}``) for ONE single-host slice of
+        this shape — the elastic flex unit. Same-accelerator pools of a
+        DIFFERENT shape with a free host and enough chips per host;
+        prefers a pool where the borrow breaks no NEW slice (pack
+        borrowers together), then name order. Pools whose native shape
+        is in ``avoid_new_break_shapes`` accept no new breakage. None
+        for multi-host or multi-slice shapes — a foreign pool can host a
+        whole single-host slice, never a split ICI mesh."""
+        try:
+            shape = TpuSlice.parse(accelerator, topology)
+        except TopologyError:
+            return None
+        if shape.num_hosts != 1:
+            return None
+        candidates = []
+        for pool in self.fleet.pools:
+            if pool.shape_key == (accelerator.lower(), topology.lower()):
+                continue
+            if pool.accelerator.lower() != accelerator.lower():
+                continue
+            if pool.chips_per_host < shape.chips_per_host:
+                continue
+            if self.free_hosts(pool) < 1:
+                continue
+            borrowed = self.borrowed.get(pool.name, 0)
+            breaks = math.ceil((borrowed + 1) / pool.hosts_per_slice) \
+                > math.ceil(borrowed / pool.hosts_per_slice)
+            if breaks and pool.shape_key in avoid_new_break_shapes:
+                continue
+            # ``prefer`` (a restart's durable flex-pool hint) outranks
+            # the no-new-break preference: the pods are already THERE.
+            candidates.append((pool.name != prefer, breaks, pool.name))
+        if not candidates:
+            return None
+        candidates.sort()
+        return {candidates[0][-1]: 1}
+
     def admit(self, alloc: Allocation, *, force: bool = False) -> None:
         """Record one whole gang. ``force=True`` is the reclaim path
         (controller restart over a fleet that no longer has room): the
@@ -270,6 +429,9 @@ class ChipLedger:
         if alloc.key in self.allocations:
             self.violations += 1
             raise LedgerError(f"{alloc.key} is already admitted")
+        if alloc.borrowed:
+            self._admit_borrow(alloc)
+            return
         if sum(alloc.placements.values()) != alloc.num_slices:
             self.violations += 1
             raise LedgerError(
@@ -286,14 +448,49 @@ class ChipLedger:
                     raise LedgerError(
                         f"{alloc.key}: placement on unknown/mismatched "
                         f"pool {pool_name!r}")
-                if self.used.get(pool_name, 0) + n > pool.num_slices:
+                if self.used.get(pool_name, 0) + n > \
+                        pool.num_slices - self.broken_slices(pool):
                     self.violations += 1
                     raise LedgerError(
                         f"{alloc.key}: pool {pool_name} over capacity "
                         f"({self.used.get(pool_name, 0)}+{n} > "
-                        f"{pool.num_slices} slices)")
+                        f"{pool.num_slices} slices, "
+                        f"{self.broken_slices(pool)} broken by borrows)")
         for pool_name, n in alloc.placements.items():
             self.used[pool_name] = self.used.get(pool_name, 0) + n
+        self.allocations[alloc.key] = alloc
+        self.ns_chips[alloc.namespace] = \
+            self.ns_chips.get(alloc.namespace, 0) + alloc.chips
+
+    def _admit_borrow(self, alloc: Allocation) -> None:
+        """Record an elastic flex (host-borrowing) gang. The invariants
+        mirror the native path at host granularity: the borrow set must
+        cover the gang's whole host count (atomicity), land on known
+        same-accelerator pools, and fit the pools' free hosts."""
+        shape = TpuSlice.parse(alloc.accelerator, alloc.topology)
+        want_hosts = shape.num_hosts * alloc.num_slices
+        if sum(alloc.borrow.values()) != want_hosts:
+            self.violations += 1
+            raise LedgerError(
+                f"{alloc.key}: partial borrow ({alloc.borrow} vs "
+                f"{want_hosts} host(s)) — gangs admit all-or-nothing")
+        for pool_name, hosts in alloc.borrow.items():
+            pool = self.fleet.by_name(pool_name)
+            if pool is None \
+                    or pool.accelerator.lower() != alloc.accelerator.lower():
+                self.violations += 1
+                raise LedgerError(
+                    f"{alloc.key}: borrow on unknown/mismatched pool "
+                    f"{pool_name!r}")
+            if hosts > self.free_hosts(pool):
+                self.violations += 1
+                raise LedgerError(
+                    f"{alloc.key}: pool {pool_name} has "
+                    f"{self.free_hosts(pool)} free host(s), borrow wants "
+                    f"{hosts}")
+        for pool_name, hosts in alloc.borrow.items():
+            self.borrowed[pool_name] = \
+                self.borrowed.get(pool_name, 0) + hosts
         self.allocations[alloc.key] = alloc
         self.ns_chips[alloc.namespace] = \
             self.ns_chips.get(alloc.namespace, 0) + alloc.chips
@@ -302,6 +499,17 @@ class ChipLedger:
         alloc = self.allocations.pop(key, None)
         if alloc is None:
             return None
+        for pool_name, hosts in (alloc.borrow or {}).items():
+            left = self.borrowed.get(pool_name, 0) - hosts
+            if left < 0:
+                self.violations += 1
+                raise LedgerError(
+                    f"{key}: releasing more borrowed hosts than admitted "
+                    f"on {pool_name}")
+            if left:
+                self.borrowed[pool_name] = left
+            else:
+                self.borrowed.pop(pool_name, None)
         for pool_name, n in alloc.placements.items():
             left = self.used.get(pool_name, 0) - n
             if left < 0:
@@ -323,25 +531,35 @@ class ChipLedger:
     def admitted_chips_by_pool(self) -> dict[str, int]:
         out: dict[str, int] = {}
         for pool in self.fleet.pools:
-            used = self.used.get(pool.name, 0)
-            if used:
-                out[pool.name] = used * pool.chips_per_slice
+            chips = self.used.get(pool.name, 0) * pool.chips_per_slice \
+                + self.borrowed.get(pool.name, 0) * pool.chips_per_host
+            if chips:
+                out[pool.name] = chips
         return out
 
     def assert_consistent(self) -> None:
-        """Recompute used/ns_chips from the allocations and compare — the
-        property test calls this after every step."""
+        """Recompute used/borrowed/ns_chips from the allocations and
+        compare — the property test calls this after every step."""
         used: dict[str, int] = {}
+        borrowed: dict[str, int] = {}
         ns: dict[str, int] = {}
         for alloc in self.allocations.values():
+            if alloc.borrowed:
+                for pool_name, hosts in alloc.borrow.items():
+                    borrowed[pool_name] = borrowed.get(pool_name, 0) + hosts
+                ns[alloc.namespace] = \
+                    ns.get(alloc.namespace, 0) + alloc.chips
+                continue
             if sum(alloc.placements.values()) != alloc.num_slices:
                 raise LedgerError(f"{alloc.key}: partial gang recorded")
             for pool_name, n in alloc.placements.items():
                 used[pool_name] = used.get(pool_name, 0) + n
             ns[alloc.namespace] = ns.get(alloc.namespace, 0) + alloc.chips
-        if used != self.used or ns != self.ns_chips:
+        if used != self.used or ns != self.ns_chips \
+                or borrowed != self.borrowed:
             raise LedgerError(
-                f"ledger drift: used {self.used} vs {used}, "
+                f"ledger drift: used {self.used} vs {used}, borrowed "
+                f"{self.borrowed} vs {borrowed}, "
                 f"ns_chips {self.ns_chips} vs {ns}")
         # Pools carrying a force-admitted (reclaimed-with-overcommit)
         # gang are legitimately over capacity until it releases.
@@ -353,7 +571,10 @@ class ChipLedger:
         for pool in self.fleet.pools:
             if pool.name in forced_pools:
                 continue
-            if used.get(pool.name, 0) > pool.num_slices:
+            if used.get(pool.name, 0) + self.broken_slices(pool) \
+                    > pool.num_slices:
                 raise LedgerError(
                     f"pool {pool.name} over capacity: "
-                    f"{used[pool.name]} > {pool.num_slices}")
+                    f"{used.get(pool.name, 0)} native + "
+                    f"{self.broken_slices(pool)} borrow-broken > "
+                    f"{pool.num_slices}")

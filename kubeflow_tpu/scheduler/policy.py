@@ -184,18 +184,51 @@ class PolicyQueue:
         alloc = self.ledger.allocations.get(key)
         return alloc is not None and alloc.draining
 
-    def reclaim(self, req: GangRequest, now: float) -> bool:
+    def reclaim(self, req: GangRequest, now: float, *,
+                borrow_first: bool = False,
+                prefer_pool: str | None = None) -> bool:
         """Re-seat an ALREADY-RUNNING gang after a controller restart
         (scheduler state is in-memory). Uses a normal fit when capacity
         allows; otherwise force-places on matching pools — the pods exist,
         so refusing would stop-annotate healthy workloads on every
         controller restart. Forced placements may transiently exceed a
         shrunken fleet's capacity; that is recorded as an overcommit, not
-        a ledger violation, and drains as holders release."""
+        a ledger violation, and drains as holders release.
+
+        ``borrow_first`` (with ``prefer_pool``, the durable flex-pool
+        annotation): the gang was flex-placed before the restart, so its
+        pods run on a FOREIGN pool's host — restore the borrow even when
+        a native fit now exists, or the host pool's capacity is resold
+        under the running pods and the gang's node selectors flip."""
         if req.key in self.ledger.allocations:
             return True
         self.pending.pop(req.key, None)
-        plan = self.ledger.fit(req.accelerator, req.topology, req.num_slices)
+        # Borrow re-seat (one shared block, two triggers): with the
+        # durable flex hint, BEFORE the native fit — the gang's pods run
+        # on a foreign pool's host, and seating it natively would
+        # un-break that pool's slice, resell the occupied host, and flip
+        # the gang's node selectors; without the hint, only as the
+        # fallback when no native fit exists (an ex-native single-host
+        # gang whose slice was resold is better borrowed than
+        # force-overcommitted).
+        borrow = (self.ledger.borrow_fit(req.accelerator, req.topology,
+                                         prefer=prefer_pool)
+                  if borrow_first else None)
+        plan = (None if borrow is not None else
+                self.ledger.fit(req.accelerator, req.topology,
+                                req.num_slices))
+        if borrow is None and plan is None:
+            borrow = self.ledger.borrow_fit(req.accelerator, req.topology)
+        if borrow is not None:
+            self.ledger.admit(Allocation(
+                key=req.key, namespace=req.namespace,
+                accelerator=req.accelerator, topology=req.topology,
+                num_slices=req.num_slices, chips=req.chips,
+                placements={}, borrow=borrow,
+                priority=req.priority, admitted_at=now,
+            ))
+            self.gen += 1
+            return True
         overcommit = plan is None
         if overcommit:
             pools = self.fleet.matching(req.accelerator, req.topology)
@@ -251,6 +284,15 @@ class PolicyQueue:
                         alloc.topology.lower()):
                     ok = False
                     break
+            # A borrower's pool must still exist with the same
+            # accelerator (its shape differs from the pool's by design);
+            # gone → re-seat like any stale placement.
+            for pool_name in (alloc.borrow or {}):
+                pool = fleet.by_name(pool_name)
+                if pool is None or pool.accelerator.lower() != \
+                        alloc.accelerator.lower():
+                    ok = False
+                    break
             if not ok:
                 stale.append(alloc)
         for alloc in stale:   # release all first: re-seating must see
@@ -263,7 +305,10 @@ class PolicyQueue:
                     topology=alloc.topology,
                     num_slices=alloc.num_slices, chips=alloc.chips,
                     priority=alloc.priority),
-                now=alloc.admitted_at)   # keep the original admission time
+                now=alloc.admitted_at,   # keep the original admission time
+                # An ex-borrower re-seats as a borrow (its pods live on
+                # a foreign pool's host, likely the renamed survivor).
+                borrow_first=alloc.borrowed)
             reseated = self.ledger.allocations.get(alloc.key)
             if reseated is not None:
                 reseated.last_active_at = alloc.last_active_at
@@ -367,9 +412,14 @@ class PolicyQueue:
         # deficit would hide a sufficient victim and wrongly refuse
         # preemption) nor count a victim's slices as usable when they
         # only drain that pool's deficit (over-selecting healthy gangs).
-        free_by_pool = {p.name: self.ledger.free_slices(p)
-                        for p in self.fleet.matching(req.accelerator,
-                                                     req.topology)}
+        # An unavailable pool (spot mid-reclaim) can never satisfy the
+        # waiter: -inf keeps it unusable no matter how many of its
+        # holders a victim search would free.
+        free_by_pool = {
+            p.name: (float("-inf")
+                     if p.name in self.ledger.unavailable
+                     else self.ledger.free_slices(p))
+            for p in self.fleet.matching(req.accelerator, req.topology)}
         for pool, n in draining_by_pool.items():
             free_by_pool[pool] = free_by_pool.get(pool, 0) + n
 
@@ -490,6 +540,26 @@ class PolicyQueue:
     # ---- introspection ----------------------------------------------------------
 
     def debug_info(self, now: float) -> dict:
+        # Per-pool chip attribution for the /debug/scheduler rows:
+        # draining chips are still booked (the victim is checkpointing)
+        # but on their way out — operators watching a reclaim want to
+        # see them apart from plain used.
+        draining_by_pool: dict[str, int] = {}
+        for a in self.ledger.allocations.values():
+            if not a.draining:
+                continue
+            for pool_name, n in a.placements.items():
+                pool = self.fleet.by_name(pool_name)
+                if pool is not None:
+                    draining_by_pool[pool_name] = \
+                        draining_by_pool.get(pool_name, 0) \
+                        + n * pool.chips_per_slice
+            for pool_name, hosts in (a.borrow or {}).items():
+                pool = self.fleet.by_name(pool_name)
+                if pool is not None:
+                    draining_by_pool[pool_name] = \
+                        draining_by_pool.get(pool_name, 0) \
+                        + hosts * pool.chips_per_host
         return {
             "pools": [
                 {
@@ -497,6 +567,16 @@ class PolicyQueue:
                     "topology": p.topology, "slices": p.num_slices,
                     "free_slices": self.ledger.free_slices(p),
                     "chips": p.total_chips,
+                    "used_chips":
+                        self.ledger.used.get(p.name, 0)
+                        * p.chips_per_slice
+                        + self.ledger.borrowed.get(p.name, 0)
+                        * p.chips_per_host,
+                    "draining_chips": draining_by_pool.get(p.name, 0),
+                    "free_chips": self.ledger.free_slices(p)
+                    * p.chips_per_slice,
+                    "borrowed_hosts": self.ledger.borrowed.get(p.name, 0),
+                    "spot": p.spot,
                 }
                 for p in self.fleet.pools
             ],
@@ -505,6 +585,7 @@ class PolicyQueue:
                     "key": list(a.key), "chips": a.chips,
                     "slices": a.num_slices, "priority": a.priority,
                     "placements": a.placements,
+                    "borrow": a.borrow or {},
                     "admitted_at": a.admitted_at,
                     "last_active_at": a.last_active_at,
                     "draining": a.draining,

@@ -27,14 +27,15 @@ from __future__ import annotations
 
 import logging
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 from kubeflow_tpu.api import notebook as nbapi
-from kubeflow_tpu.runtime.errors import ApiError
+from kubeflow_tpu.runtime.errors import ApiError, NotFound
 from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.runtime.objects import (
     annotations_of,
+    deep_get,
     fmt_iso,
     name_of,
     namespace_of,
@@ -42,11 +43,13 @@ from kubeflow_tpu.runtime.objects import (
 )
 from kubeflow_tpu.runtime.tracing import span
 from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.scheduler import elastic
 from kubeflow_tpu.scheduler.fleet import Fleet
 from kubeflow_tpu.scheduler.policy import (
     GangRequest,
     PolicyConfig,
     PolicyQueue,
+    Preemption,
 )
 
 log = logging.getLogger(__name__)
@@ -102,6 +105,14 @@ class Admission:
     # Draining only: how soon the controller must reconcile again so the
     # grace deadline fires even if the SDK never acks.
     requeue_after: float = 0.0
+    # Queued only, elastic: why this gang is BACK in the queue
+    # ("spot-reclaim" after its capacity was revoked, "defrag" after a
+    # migration park) — JWA keys its message off it.
+    reclaimed: str = ""
+    # Queued only, elastic: a pool-scale-up intent is pending for this
+    # gang's shape (chips asked for, and how long the ask has waited).
+    scale_up_chips: int = 0
+    scale_up_pending_sec: float = -1.0
 
     @property
     def admitted(self) -> bool:
@@ -113,11 +124,18 @@ class _Drain:
     """In-memory side of one in-flight drain (the durable side lives in
     the victim's annotations — migration/protocol.py)."""
 
-    reason: str                # "idle" | "priority"
+    reason: str                # "idle" | "priority" | "spot-reclaim" | "defrag"
     for_key: tuple             # beneficiary waiting on the chips
     chips: int
     requested_at: float
     deadline: float
+    # The drain-reason annotation value (the protocol's finalizer
+    # contract): "preempt:<reason>" for scheduler preemption,
+    # "spot-reclaim"/"defrag" for the elastic paths.
+    annotation: str = ""
+    # Elastic drains: once parked, un-park and re-queue the victim with
+    # its aging credit instead of waiting for a user restart.
+    requeue: bool = False
 
 
 @dataclass
@@ -149,6 +167,22 @@ class SchedulerOptions:
     # on) is what turns it on.
     enable_migration: bool = False
     drain_grace_seconds: float = migration.DEFAULT_DRAIN_GRACE_SECONDS
+    # Elastic fleet (kubeflow_tpu/scheduler/elastic.py): scale-up
+    # intents, flex (host-borrowing) placement, spot reclaim, defrag.
+    # The DATACLASS default is off — bare construction keeps PR 5–7
+    # semantics byte-for-byte; production gets it from KFTPU_ELASTIC
+    # (default on) via cmd/envconfig.py.
+    enable_elastic: bool = False
+    scale_up_ttl_seconds: float = elastic.DEFAULT_SCALE_UP_TTL_SECONDS
+    # Defrag rides under enable_elastic; KFTPU_DEFRAG=off clears it.
+    enable_defrag: bool = True
+    defrag_interval_seconds: float = \
+        elastic.DEFAULT_DEFRAG_INTERVAL_SECONDS
+    defrag_idle_seconds: float = elastic.DEFAULT_DEFRAG_IDLE_SECONDS
+    defrag_max_moves: int = elastic.DEFAULT_DEFRAG_MAX_MOVES
+    # Dynamic fleet sources (ConfigMap / node inference) re-read on this
+    # throttle; also paces how quickly a granted scale-up is noticed.
+    fleet_refresh_seconds: float = _CONFIGMAP_RETRY_SECONDS
 
 
 class TpuFleetScheduler:
@@ -202,6 +236,43 @@ class TpuFleetScheduler:
         self._last_pass_at = float("-inf")
         self._gauge_ns: set = set()
         self._gauge_pools: set = set()
+        # ---- elastic fleet state (None/"empty" with elastic off) ----
+        # Pending scale-up intents (pure book; the CR mirror + metrics
+        # live here in the runtime).
+        self._intent_book = (
+            elastic.IntentBook(self.options.scale_up_ttl_seconds)
+            if self.options.enable_elastic else None)
+        self._elastic_cfg = elastic.ElasticConfig(
+            scale_up_ttl_seconds=self.options.scale_up_ttl_seconds,
+            enable_defrag=self.options.enable_defrag,
+            defrag_interval_seconds=self.options.defrag_interval_seconds,
+            defrag_idle_seconds=self.options.defrag_idle_seconds,
+            defrag_max_moves=self.options.defrag_max_moves,
+        )
+        self._last_defrag_at = float("-inf")
+        self._defrag_moves = 0
+        # Debounce for the elastic post-pass (intents/eviction are
+        # O(queue) scans — same rationale as the arbitration debounce).
+        self._last_elastic_gen = -1
+        self._last_elastic_at = float("-inf")
+        # pool name → {"since": t, "nodes": set}: in-progress spot
+        # reclaims. While an entry exists the pool is marked unavailable
+        # in the ledger (sells nothing); the entry clears when the
+        # signaling nodes recover/disappear AND every resident gang has
+        # drained out.
+        self._spot_reclaims: dict[str, dict] = {}
+        # key → (reason, park stop-stamp): elastic drains that
+        # auto-requeue after the park — release() un-parks them, and the
+        # recorded stamp (a nonce'd stop value) lets it tell the
+        # scheduler's own park from a user's racing stop. Alongside:
+        # key → reason, the surviving "why am I queued again" marker JWA
+        # reads until re-admission.
+        self._auto_resume: dict[tuple, tuple] = {}
+        self._reclaim_verdict: dict[tuple, str] = {}
+        # key → submitted_at credit carried across a reclaim/defrag
+        # re-queue (seniority from the gang's original admission — a
+        # reclaimed gang must not age from zero behind newcomers).
+        self._requeue_credit: dict[tuple, float] = {}
         registry = registry or global_registry
         self.m_queue_depth = registry.gauge(
             "tpu_scheduler_queue_depth",
@@ -228,6 +299,24 @@ class TpuFleetScheduler:
         self.m_draining = registry.gauge(
             "tpu_scheduler_draining_gangs",
             "Gangs currently checkpointing before preemption")
+        self.m_scale_up = registry.gauge(
+            "tpu_scheduler_scale_up_intents",
+            "Pool scale-up intents currently pending")
+        self.m_scale_up_events = registry.counter(
+            "tpu_scheduler_scale_up_events_total",
+            "Scale-up intent lifecycle events",
+            ["event"])  # created | renewed | granted | moot | denied
+        self.m_spot_reclaims = registry.counter(
+            "tpu_scheduler_spot_reclaims_total",
+            "Gangs drained off revoked spot capacity")
+        self.m_defrag = registry.counter(
+            "tpu_scheduler_defrag_moves_total",
+            "Gangs migrated off pack-breaking pools by the defragmenter")
+        self.m_borrowed = registry.gauge(
+            "tpu_scheduler_borrowed_hosts",
+            "Hosts borrowed from foreign-shape pools (flex placement)",
+            ["pool"])
+        self._gauge_borrow_pools: set = set()
 
     # ---- wiring -----------------------------------------------------------------
 
@@ -274,7 +363,13 @@ class TpuFleetScheduler:
         now = self._now()
         if now < self._fleet_next_try:
             return self.active
-        self._fleet_next_try = now + _CONFIGMAP_RETRY_SECONDS
+        refresh = self.options.fleet_refresh_seconds
+        if self._intent_book is not None and self._intent_book.intents:
+            # A scale-up ask is out: poll the fleet source faster so
+            # granted capacity admits promptly, not a full throttle
+            # interval later.
+            refresh = min(refresh, max(refresh / 6.0, 1.0))
+        self._fleet_next_try = now + refresh
         fleet = None
         if opts.fleet_spec == "auto":
             if self._node_informer is not None:
@@ -299,6 +394,24 @@ class TpuFleetScheduler:
             log.info("TPU fleet scheduler %s: %d pool(s), %d chips",
                      "fleet updated" if was_active else "active",
                      len(fleet.pools), fleet.total_chips)
+            # Every known notebook re-arbitrates NOW: gangs whose last
+            # reconcile ran during the pre-activation pass-through
+            # window (fresh restart, dynamic source still loading) are
+            # in neither book and may hold chips the new ledger is
+            # about to sell — waiting for their next organic event
+            # leaves that double-booking window open indefinitely.
+            if self._nb_informer is not None:
+                for nb in self._nb_informer.items():
+                    self._enqueue((namespace_of(nb), name_of(nb)))
+            # Same for reclaim signals: a revocation taint dispatched by
+            # the Node informer's initial sync BEFORE the fleet loaded
+            # mapped onto no pool and was dropped — and a healthy watch
+            # never re-delivers it. Re-scan the cached nodes against the
+            # fleet that now exists.
+            if self._intent_book is not None \
+                    and self._node_informer is not None:
+                for node in self._node_informer.items():
+                    self.note_node_event(node)
         return self.active
 
     # ---- request construction ---------------------------------------------------
@@ -360,6 +473,10 @@ class TpuFleetScheduler:
         # sweeps them. The CURRENT key is handled inline below with the
         # live CR this reconcile already holds.
         await self._sweep_drains(now, skip=key)
+        # Spot revocations signaled since the last pass start their
+        # drains here — including the CURRENT key's (no skip: the
+        # drain-progress branch right below then handles it inline).
+        await self._sweep_spot_reclaims(now)
         if key in self._draining:
             return await self._drain_progress(key, nb, now)
         result = None
@@ -378,8 +495,14 @@ class TpuFleetScheduler:
                     # ORIGINAL admission time until the patch lands.
                     alloc = self.policy.ledger.allocations[key]
                     await self._stamp_admitted(nb, alloc.admitted_at)
+                self._requeue_credit.pop(key, None)
+                self._reclaim_verdict.pop(key, None)
+                reason_ann = migration.drain_reason(ann)
                 if (migration.drain_requested_at(ann) is not None
-                        and migration.drain_reason(ann).startswith("preempt")
+                        and (reason_ann.startswith("preempt")
+                             or reason_ann in (
+                                 elastic.SPOT_RECLAIM_REASON,
+                                 elastic.DEFRAG_REASON))
                         and key not in self._draining):
                     # Controller restarted mid-drain: the in-memory drain
                     # (and its beneficiary) is gone and this gang was
@@ -412,7 +535,17 @@ class TpuFleetScheduler:
                 except ApiError:
                     pass
             req = self._request_of(nb, ms, now)
-            if running and self.policy.reclaim(req, now):
+            credit = self._requeue_credit.get(key)
+            if credit is not None:
+                # Re-queued reclaim/defrag victim: seniority from its
+                # original admission — it must not age from zero behind
+                # gangs that arrived while it was running.
+                req = replace(req, submitted_at=min(credit, now))
+            flex_hint = annotations_of(nb).get(
+                nbapi.FLEX_POOL_ANNOTATION)
+            if running and self.policy.reclaim(
+                    req, now, borrow_first=bool(flex_hint),
+                    prefer_pool=flex_hint):
                 self._state[key] = "Admitted"
                 self._refresh_gauges()
                 return Admission("Admitted")
@@ -429,12 +562,13 @@ class TpuFleetScheduler:
                     < self.options.queued_requeue_seconds):
                 queue = self.policy.schedule_preview(now)
             else:
-                result = self.policy.schedule(now)
+                result = self._arbitrate(now)
                 self._last_pass_gen = self.policy.gen
                 self._last_pass_at = now
                 queue = result.queue
         if result is not None:
             await self._apply(result, now, requester=nb)
+        await self._elastic_post(now)
         if self.policy.is_admitted(key):
             return Admission("Admitted")
         info = next((q for q in queue if q.key == key), None)
@@ -446,8 +580,19 @@ class TpuFleetScheduler:
             await self._event(
                 nb, "Normal", "Queued",
                 f"Queued for TPU capacity (position {position}): {reason}")
-        return Admission("Queued", position=position, reason=reason,
-                         waiting_chips=chips)
+        intent = (self._intent_book.for_shape(
+            ms.slice.accelerator.name, ms.slice.topology_str)
+            if self._intent_book is not None else None)
+        if intent is not None and key not in intent.for_keys:
+            intent = None
+        return Admission(
+            "Queued", position=position, reason=reason,
+            waiting_chips=chips,
+            reclaimed=self._reclaim_verdict.get(key, ""),
+            scale_up_chips=intent.chips if intent is not None else 0,
+            scale_up_pending_sec=(
+                round(intent.pending_seconds(now), 3)
+                if intent is not None else -1.0))
 
     async def release(self, key: tuple,
                       nb: dict | None = None) -> Admission | None:
@@ -468,6 +613,9 @@ class TpuFleetScheduler:
         key = tuple(key)
         if nb is None:
             self._preempted.pop(key, None)
+            self._auto_resume.pop(key, None)
+            self._reclaim_verdict.pop(key, None)
+            self._requeue_credit.pop(key, None)
         self._stop_pending.pop(key, None)  # it IS stopped (or gone) now
         now = self._now()
         had_queue_entry = key in self.policy.pending
@@ -475,10 +623,56 @@ class TpuFleetScheduler:
         self._state.pop(key, None)
         if alloc is not None or had_queue_entry:
             with span("schedule", key=f"{key[0]}/{key[1]}", release=True):
-                result = self.policy.schedule(now)
+                result = self._arbitrate(now)
                 self._last_pass_gen = self.policy.gen
                 self._last_pass_at = now
             await self._apply(result, now)
+        await self._elastic_post(now)
+        if nb is not None and key in self._auto_resume:
+            # An elastic (spot-reclaim/defrag) park: the gang is released
+            # and its pods are parking under the stop annotation this
+            # reconcile already read — un-park it now so the NEXT
+            # reconcile re-queues it (with its aging credit) instead of
+            # waiting for a user restart.
+            reason, stamp = self._auto_resume[key]
+            live_stop = annotations_of(nb).get(nbapi.STOP_ANNOTATION)
+            if live_stop != stamp:
+                # The stop on the CR is not OURS: the user (or another
+                # actor) stopped the gang between the park and this
+                # release — an explicit stop the auto-resume must not
+                # silently revert. The gang stays parked, and the
+                # DURABLE elastic verdict clears too: a controller
+                # restart would otherwise read it back and un-park the
+                # gang against the user's decision.
+                self._auto_resume.pop(key, None)
+                self._reclaim_verdict.pop(key, None)
+                self._requeue_credit.pop(key, None)
+                try:
+                    await self.kube.patch(
+                        "Notebook", key[1],
+                        {"metadata": {"annotations": {
+                            nbapi.PREEMPTED_ANNOTATION: None}}}, key[0])
+                except ApiError:
+                    pass
+            else:
+                try:
+                    await self.kube.patch(
+                        "Notebook", key[1],
+                        {"metadata": {"annotations": {
+                            nbapi.STOP_ANNOTATION: None,
+                            nbapi.PREEMPTED_ANNOTATION: None,
+                        }}}, key[0])
+                    self._auto_resume.pop(key, None)
+                    self._enqueue(key)
+                except ApiError:
+                    # Keep the entry and re-raise into workqueue backoff
+                    # (the _retry_stop contract): nothing else ever
+                    # reconciles a parked gang, so one transient
+                    # apiserver error must not silently turn "re-queued
+                    # with aging credit" into a permanent park.
+                    raise ApiError(
+                        f"elastic re-queue un-park for {key[0]}/{key[1]} "
+                        f"({reason}) failed; retrying with backoff")
         if key in self._draining:
             # Stopped (or deleted) mid-drain: the release above already
             # freed the chips, so the drain is moot — drop it. The
@@ -496,6 +690,29 @@ class TpuFleetScheduler:
             # verdict, so its leftover annotation is stale and this is a
             # plain user stop.
             reason = annotations_of(nb).get(nbapi.PREEMPTED_ANNOTATION)
+            if reason in (elastic.SPOT_RECLAIM_REASON,
+                          elastic.DEFRAG_REASON):
+                # An elastic park interrupted by a restart: the
+                # auto-requeue lived only in memory, but the durable
+                # verdict says this stop was a reclaim/defrag — finish
+                # the migration now instead of leaving the gang parked
+                # forever. (The aging credit is lost with the process;
+                # the re-queue itself must not be.)
+                self._reclaim_verdict[key] = reason
+                try:
+                    await self.kube.patch(
+                        "Notebook", key[1],
+                        {"metadata": {"annotations": {
+                            nbapi.STOP_ANNOTATION: None,
+                            nbapi.PREEMPTED_ANNOTATION: None,
+                        }}}, key[0])
+                    self._enqueue(key)
+                except ApiError:
+                    raise ApiError(
+                        f"elastic re-queue un-park for "
+                        f"{key[0]}/{key[1]} ({reason}) failed after "
+                        "restart; retrying with backoff")
+                return Admission("Preempted", reason=reason)
             if reason:
                 return Admission("Preempted", reason=reason)
         return None
@@ -518,6 +735,8 @@ class TpuFleetScheduler:
             with span("admit", key=f"{a.key[0]}/{a.key[1]}"):
                 self.m_wait.observe(a.waited)
                 self._state[a.key] = "Admitted"
+                self._requeue_credit.pop(a.key, None)
+                self._reclaim_verdict.pop(a.key, None)
                 nb = (requester if a.key == req_key
                       else await self._get_notebook(a.key))
                 if nb is not None:
@@ -573,24 +792,35 @@ class TpuFleetScheduler:
 
     # ---- preempt-to-checkpoint (kubeflow_tpu/migration) ------------------------
 
-    async def _request_drain(self, p, now: float) -> None:
+    async def _request_drain(self, p, now: float, *,
+                             requeue: bool = False,
+                             annotation: str | None = None,
+                             message: str | None = None) -> None:
         """Ask the victim to checkpoint instead of stopping it: stamp the
         drain annotations the in-pod SDK polls, start the grace clock,
         and keep its chips booked (policy marked the allocation draining)
         until :meth:`_finalize_drain` sees the ack or the deadline. The
         preemption verdict is recorded NOW so a victim the user stops
-        mid-drain still reports why it parked."""
+        mid-drain still reports why it parked.
+
+        The elastic paths ride the SAME protocol — ``annotation`` is
+        their drain-reason ("spot-reclaim"/"defrag" instead of
+        "preempt:<reason>") and ``requeue`` makes the eventual park
+        un-park and re-queue the victim instead of waiting for a user
+        restart."""
         ns, name = p.key
+        annotation = annotation or f"preempt:{p.reason}"
         self._preempted[p.key] = p.reason
         self._draining[p.key] = _Drain(
             reason=p.reason, for_key=p.for_key, chips=p.chips,
             requested_at=now,
-            deadline=now + self.options.drain_grace_seconds)
+            deadline=now + self.options.drain_grace_seconds,
+            annotation=annotation, requeue=requeue)
         try:
             await self.kube.patch(
                 "Notebook", name,
                 {"metadata": {"annotations": migration.request_drain_patch(
-                    f"preempt:{p.reason}", now)}}, ns)
+                    annotation, now)}}, ns)
         except ApiError:
             # The sweep re-patches a victim whose CR lacks the request
             # mark; if the apiserver stays down past the grace deadline
@@ -601,10 +831,12 @@ class TpuFleetScheduler:
         if nb is not None:
             await self._event(
                 nb, "Warning", "DrainRequested",
-                f"Checkpoint requested ({p.reason}) to reclaim {p.chips} "
-                f"TPU chips for {p.for_key[0]}/{p.for_key[1]}; parking "
-                f"once the checkpoint commits (grace "
-                f"{self.options.drain_grace_seconds:.0f}s)")
+                message or (
+                    f"Checkpoint requested ({p.reason}) to reclaim "
+                    f"{p.chips} TPU chips for "
+                    f"{p.for_key[0]}/{p.for_key[1]}; parking once the "
+                    f"checkpoint commits (grace "
+                    f"{self.options.drain_grace_seconds:.0f}s)"))
         self._enqueue(p.key)
 
     async def _drain_progress(self, key: tuple, nb: dict,
@@ -623,7 +855,8 @@ class TpuFleetScheduler:
                     "Notebook", key[1],
                     {"metadata": {"annotations":
                                   migration.request_drain_patch(
-                                      f"preempt:{drain.reason}",
+                                      drain.annotation
+                                      or f"preempt:{drain.reason}",
                                       drain.requested_at)}}, key[0])
             except ApiError:
                 pass
@@ -647,14 +880,32 @@ class TpuFleetScheduler:
             return Admission("Preempted",
                              reason=self._preempted.get(key, ""))
         self.m_preemptions.labels(reason=drain.reason).inc()
+        if drain.reason == elastic.SPOT_RECLAIM_REASON:
+            self.m_spot_reclaims.inc()
         if checkpointed:
             with span("checkpoint_ack", key=f"{key[0]}/{key[1]}",
                       waited=round(now - drain.requested_at, 3)):
                 self.m_drain.observe(now - drain.requested_at)
         else:
             self.m_drain_fallback.inc()
+        park_stamp = None
+        if drain.requeue:
+            # Elastic park: once the victim's release path observes the
+            # stop, un-park it so it re-queues with its aging credit —
+            # the reclaim/defrag took its CAPACITY, not its place in
+            # line. The park's stop stamp carries a unique nonce (no
+            # consumer parses the value; presence is the contract) so
+            # the un-park can tell OUR park from a user's own stop even
+            # within the same fmt_iso second.
+            self._park_seq = getattr(self, "_park_seq", 0) + 1
+            park_stamp = f"{fmt_iso(now)}+park{self._park_seq}"
+            alloc = self.policy.ledger.allocations.get(key)
+            self._auto_resume[key] = (drain.reason, park_stamp)
+            self._reclaim_verdict[key] = drain.reason
+            self._requeue_credit[key] = (
+                alloc.admitted_at if alloc is not None else now)
         if not await self._stop_victim(
-                key, drain.reason, now,
+                key, drain.reason, now, stop_value=park_stamp,
                 extra=migration.clear_drain_patch(keep_reason=True)):
             # Same contract as an immediate preemption's failed stop:
             # chips are released below regardless, so the victim MUST
@@ -662,7 +913,7 @@ class TpuFleetScheduler:
             self._stop_pending[key] = drain.reason
         self.policy.release(key)
         self._state.pop(key, None)
-        result = self.policy.schedule(now)
+        result = self._arbitrate(now)
         self._last_pass_gen = self.policy.gen
         self._last_pass_at = now
         await self._apply(result, now)
@@ -698,8 +949,9 @@ class TpuFleetScheduler:
                 # CR gone mid-drain: nothing to stop; free the chips and
                 # let the waiters arbitrate.
                 self._draining.pop(key, None)
+                self._auto_resume.pop(key, None)
                 if self.policy.release(key) is not None:
-                    result = self.policy.schedule(now)
+                    result = self._arbitrate(now)
                     self._last_pass_gen = self.policy.gen
                     self._last_pass_at = now
                     await self._apply(result, now)
@@ -719,15 +971,406 @@ class TpuFleetScheduler:
                         "Notebook", key[1],
                         {"metadata": {"annotations":
                                       migration.request_drain_patch(
-                                          f"preempt:{drain.reason}",
+                                          drain.annotation
+                                          or f"preempt:{drain.reason}",
                                           drain.requested_at)}}, key[0])
                 except ApiError:
                     pass
 
+    # ---- elastic fleet (kubeflow_tpu/scheduler/elastic.py) ----------------------
+
+    @property
+    def elastic_active(self) -> bool:
+        return self._intent_book is not None and self.active
+
+    def _arbitrate(self, now: float):
+        """One full arbitration pass: (elastic) flex overflow first —
+        a waiter a free borrowed host can seat must not cost a running
+        gang a preemption drain — then the native schedule, then a
+        second overflow for gangs whose options the schedule pass just
+        changed. Flex admissions ride the result's ``admitted`` list so
+        every downstream side effect (stamp, events, re-enqueue) is
+        identical to a native admission."""
+        flex_pre = (elastic.overflow_pass(self.policy, now)
+                    if self.elastic_active else [])
+        result = self.policy.schedule(now)
+        if flex_pre:
+            result.admitted.extend(flex_pre)
+        if self.elastic_active:
+            flex = elastic.overflow_pass(self.policy, now)
+            if flex:
+                result.admitted.extend(flex)
+        return result
+
+    async def _elastic_post(self, now: float) -> None:
+        """The elastic bookkeeping that follows an arbitration pass:
+        sync scale-up intents against the queue's shortfalls (create /
+        renew / withdraw, mirrored to ProvisioningRequest CRs) and run
+        the interval-gated defrag planner. Cheap no-op with elastic off
+        or no fleet."""
+        if not self.elastic_active:
+            return
+        # Same debounce as the arbitration pass: shortfall computation
+        # and idle-borrower scans are O(queue)/O(allocations) — a long
+        # queue's safety-net requeues must not each pay them when
+        # nothing changed. TTL renewals and the defrag interval still
+        # tick through the one pass per interval this allows.
+        if (self.policy.gen == self._last_elastic_gen
+                and now - self._last_elastic_at
+                < self.options.queued_requeue_seconds):
+            return
+        self._last_elastic_gen = self.policy.gen
+        self._last_elastic_at = now
+        await self._sync_intents(now)
+        await self._maybe_defrag(now)
+        await self._evict_idle_borrowers(now)
+
+    async def _evict_idle_borrowers(self, now: float) -> None:
+        """Idle preemption at host granularity: a queued flexible gang
+        with no free host to borrow drains the idlest borrower squatting
+        on usable hosts (reason ``idle`` — the victim parks like any
+        idle-preemption victim; no auto-requeue). One eviction per pass;
+        requires migration (the drain path) — with it off, the idle
+        culler remains the squatter remedy."""
+        if not self.options.enable_preemption \
+                or not self.options.enable_migration:
+            return
+        for req in self.policy._ordered_pending(now):
+            victim = elastic.plan_idle_borrower_eviction(
+                self.policy, req, now,
+                idle_after=self.options.idle_preempt_after_seconds)
+            if victim is None or victim.key in self._draining:
+                continue
+            victim.draining = True
+            self.policy.gen += 1
+            with span("drain", victim=f"{victim.key[0]}/{victim.key[1]}",
+                      reason="idle", flex=True):
+                await self._request_drain(
+                    Preemption(key=victim.key, reason="idle",
+                               for_key=req.key, chips=victim.chips),
+                    now)
+            return
+
+    async def _sync_intents(self, now: float) -> None:
+        book = self._intent_book
+        shortfalls = elastic.compute_shortfalls(self.policy, now)
+        events = book.sync(shortfalls, self.policy.fleet, now)
+        ns = self.options.controller_namespace
+        for intent in events.created:
+            with span("scale_up", event="created", name=intent.name,
+                      slices=intent.slices, chips=intent.chips):
+                self.m_scale_up_events.labels(event="created").inc()
+                log.info("scale-up intent %s: %d slice(s) / %d chips for "
+                         "%s", intent.name, intent.slices, intent.chips,
+                         [f"{k[0]}/{k[1]}" for k in intent.for_keys])
+                try:
+                    await self.kube.create(
+                        "ProvisioningRequest",
+                        intent.to_provisioning_request(ns), ns)
+                except ApiError:
+                    pass  # best-effort mirror; the book is the truth
+                for key in intent.for_keys:
+                    nb = await self._get_notebook(key)
+                    if nb is not None:
+                        await self._event(
+                            nb, "Normal", "ScaleUpRequested",
+                            f"No pool can host this gang even if fully "
+                            f"drained; asked for {intent.slices} more "
+                            f"{intent.accelerator}:{intent.topology} "
+                            f"slice(s) ({intent.chips} chips) via "
+                            f"ProvisioningRequest {intent.name}")
+        for intent in events.renewed:
+            with span("scale_up", event="renewed", name=intent.name,
+                      renewals=intent.renewals):
+                self.m_scale_up_events.labels(event="renewed").inc()
+                log.warning(
+                    "scale-up intent %s unanswered for %.0fs (renewal "
+                    "#%d) — is the pool autoscaler watching?",
+                    intent.name, intent.pending_seconds(now),
+                    intent.renewals)
+                if intent.denied:
+                    # "Re-asserts on its TTL" is a promise: replace the
+                    # Failed CR with a fresh ask and re-arm denial
+                    # detection — otherwise the denial is terminal and
+                    # the autoscaler never hears from us again.
+                    intent.denied = False
+                    try:
+                        await self.kube.delete("ProvisioningRequest",
+                                               intent.name, ns)
+                    except (NotFound, ApiError):
+                        pass
+                    try:
+                        await self.kube.create(
+                            "ProvisioningRequest",
+                            intent.to_provisioning_request(ns), ns)
+                    except ApiError:
+                        pass
+        for intent in events.updated:
+            # Keep the CR mirror honest about the current ask size.
+            try:
+                await self.kube.patch(
+                    "ProvisioningRequest", intent.name,
+                    {"spec": intent.to_provisioning_request(ns)["spec"]},
+                    ns)
+            except (NotFound, ApiError):
+                pass  # denial probe / TTL renewal recreate it
+        for intent, reason in events.withdrawn:
+            with span("scale_up", event=reason, name=intent.name):
+                self.m_scale_up_events.labels(event=reason).inc()
+                try:
+                    await self.kube.delete("ProvisioningRequest",
+                                           intent.name, ns)
+                except (NotFound, ApiError):
+                    pass
+        if book.intents:
+            await self._probe_intent_denials(now)
+        elif now >= getattr(self, "_intent_gc_next", 0.0):
+            # Stray-intent janitor: the book is in-memory, so a restart
+            # can orphan pool-scale-up CRs whose demand died with the
+            # old process. With no live intents, sweep ours away
+            # (throttled — this is a LIST).
+            self._intent_gc_next = now + max(
+                5.0, self.options.fleet_refresh_seconds)
+            try:
+                prs = await self.kube.list("ProvisioningRequest", ns)
+            except ApiError:
+                prs = []
+            for pr in prs:
+                labels = ((pr.get("metadata") or {}).get("labels")) or {}
+                # OUR intents only — a notebook named pool-scale-up-*
+                # has a capacity PR with a matching prefix but no
+                # scale-up label; it must not be janitored.
+                if "tpu.kubeflow.org/scale-up-accelerator" not in labels:
+                    continue
+                try:
+                    await self.kube.delete("ProvisioningRequest",
+                                           name_of(pr), ns)
+                except (NotFound, ApiError):
+                    pass
+        self.m_scale_up.set(len(book.intents))
+
+    async def _probe_intent_denials(self, now: float) -> None:
+        """Surface a denial: the autoscaler (or an operator) stamped
+        Failed=True on an intent's ProvisioningRequest. The intent stays
+        in the book — the demand is real — but is marked, evented once,
+        and re-asserted on its TTL. Throttled with the fleet refresh so
+        pending intents don't add a GET per reconcile."""
+        if now < getattr(self, "_denial_next_probe", 0.0):
+            return
+        self._denial_next_probe = now + max(
+            1.0, min(self.options.fleet_refresh_seconds, 5.0))
+        ns = self.options.controller_namespace
+        for intent in list(self._intent_book.intents.values()):
+            if intent.denied:
+                continue
+            try:
+                pr = await self.kube.get_or_none(
+                    "ProvisioningRequest", intent.name, ns)
+            except ApiError:
+                continue
+            conditions = deep_get(pr or {}, "status", "conditions",
+                                  default=[]) or []
+            failed = next((c for c in conditions
+                           if c.get("type") == "Failed"
+                           and c.get("status") == "True"), None)
+            if failed is None:
+                continue
+            intent.denied = True
+            self.m_scale_up_events.labels(event="denied").inc()
+            log.warning("scale-up intent %s denied: %s %s", intent.name,
+                        failed.get("reason", ""),
+                        failed.get("message", ""))
+            for key in intent.for_keys:
+                nb = await self._get_notebook(key)
+                if nb is not None:
+                    await self._event(
+                        nb, "Warning", "ScaleUpDenied",
+                        f"Pool scale-up {intent.name} was denied "
+                        f"({failed.get('reason', '')}); the gang keeps "
+                        "waiting and the ask re-asserts on its TTL")
+
+    def flex_node_selectors(self, key: tuple) -> dict | None:
+        """Node selectors for a flex (borrowed-host) gang: the HOST
+        pool's GKE shape labels, not the gang's own — its own shape has
+        no schedulable nodes (that is why it borrowed), so pods carrying
+        the native selector would sit Pending while the ledger books the
+        borrow. The chip request stays the gang's own (sub-host
+        allocation: its chips ≤ the foreign pool's chips per host — the
+        flex_plan admission precondition). None for native placements,
+        so the common path is untouched."""
+        alloc = self.policy.ledger.allocations.get(tuple(key))
+        if alloc is None or not alloc.borrowed:
+            return None
+        pool = self.policy.fleet.by_name(next(iter(alloc.borrow)))
+        if pool is None:
+            return None
+        # Shape labels alone are ambiguous across same-shape pools (the
+        # pods could land on a spot pool the ledger didn't book) — pin
+        # the exact pool with the nodepool label. Operators name fleet
+        # pools after their nodepools; `Fleet.from_nodes` keeps the
+        # label value except for shape-disambiguated mixed pools.
+        from kubeflow_tpu.scheduler.fleet import GKE_NODEPOOL_LABEL
+
+        return {**pool.slice_shape.node_selectors(),
+                GKE_NODEPOOL_LABEL: pool.name}
+
+    def note_node_event(self, node: dict) -> None:
+        """Node-informer hook (sync): a reclaim taint on a spot pool's
+        node starts that pool's reclaim; the taint clearing withdraws
+        that node's signal. Non-spot pools ignore the signal — their
+        teardown path is maintenance (the notebook controller's taint
+        mirror), not capacity revocation."""
+        if self._intent_book is None:
+            return
+        pool = elastic.pool_of_node(self.policy.fleet, node)
+        if pool is None or not pool.spot:
+            return
+        signal = elastic.node_reclaim_signal(node)
+        if signal is not None:
+            self.note_spot_reclaim(pool.name, node=name_of(node),
+                                   signal=signal)
+        else:
+            self._clear_node_signal(pool.name, name_of(node))
+
+    def note_node_gone(self, node: dict) -> None:
+        """A signaling node was deleted: its revocation is complete.
+        The pool re-opens once every signaling node is gone AND the
+        residents drained — with a dynamic fleet source the pool itself
+        shrinks shortly after."""
+        if self._intent_book is None:
+            return
+        pool = elastic.pool_of_node(self.policy.fleet, node)
+        if pool is not None:
+            self._clear_node_signal(pool.name, name_of(node))
+
+    def _clear_node_signal(self, pool_name: str, node_name: str) -> None:
+        episode = self._spot_reclaims.get(pool_name)
+        if episode is None or node_name not in episode["nodes"]:
+            return
+        episode["nodes"].discard(node_name)
+        if not episode["nodes"]:
+            log.info("spot pool %s: revocation signal cleared", pool_name)
+
+    def note_spot_reclaim(self, pool_name: str, *, node: str = "manual",
+                          signal: str = "reclaim") -> None:
+        """Begin (or extend) one spot pool's reclaim — idempotent per
+        signaling node. While in progress the pool is UNAVAILABLE (the
+        ledger sells none of its capacity, so drained gangs cannot
+        bounce straight back onto dying nodes). The actual drains start
+        on the next scheduler pass (:meth:`_sweep_spot_reclaims`); every
+        resident gang is enqueued so those passes happen now, not at the
+        next periodic requeue."""
+        pool = self.policy.fleet.by_name(pool_name)
+        if pool is None or not pool.spot:
+            log.info("ignoring reclaim signal for non-spot pool %r",
+                     pool_name)
+            return
+        episode = self._spot_reclaims.get(pool_name)
+        if episode is None:
+            episode = {"since": self._now(), "nodes": set()}
+            self._spot_reclaims[pool_name] = episode
+            self.policy.ledger.unavailable.add(pool_name)
+            self.policy.gen += 1
+            log.warning("spot pool %s: revocation signal (%s); draining "
+                        "resident gangs through checkpoint", pool_name,
+                        signal)
+        episode["nodes"].add(node)
+        for alloc in elastic.reclaimable(self.policy.ledger, pool_name):
+            self._enqueue(alloc.key)
+
+    async def _sweep_spot_reclaims(self, now: float) -> None:
+        """Start a checkpoint drain for every gang still holding revoked
+        spot capacity. Routed through :meth:`_request_drain` — NEVER a
+        bare stop — so a revocation is a migration: checkpoint → park →
+        re-queue at original priority with aging credit; the drain-grace
+        hard stop remains the fallback for ack-less victims."""
+        if not self._spot_reclaims:
+            return
+        for pool_name in list(self._spot_reclaims):
+            episode = self._spot_reclaims[pool_name]
+            victims = elastic.reclaimable(self.policy.ledger, pool_name)
+            drains_out = not any(d.for_key == ("pool", pool_name)
+                                 for d in self._draining.values())
+            if self.policy.fleet.by_name(pool_name) is None or (
+                    not victims and drains_out
+                    and not episode["nodes"]):
+                # Episode over: the pool left the fleet, or the
+                # revocation signal cleared with every resident drained.
+                # Re-open what remains of the pool.
+                self._spot_reclaims.pop(pool_name, None)
+                if pool_name in self.policy.ledger.unavailable:
+                    self.policy.ledger.unavailable.discard(pool_name)
+                    self.policy.gen += 1
+                continue
+            if not victims:
+                continue  # drained; waiting for the signal to clear
+            for alloc in victims:
+                if alloc.key in self._draining:
+                    continue
+                # Chips stay booked while the victim checkpoints, but
+                # marked draining: the victim search credits them as
+                # incoming-free and never double-picks the gang.
+                alloc.draining = True
+                self.policy.gen += 1
+                with span("reclaim", pool=pool_name,
+                          victim=f"{alloc.key[0]}/{alloc.key[1]}"):
+                    await self._request_drain(
+                        Preemption(key=alloc.key,
+                                   reason=elastic.SPOT_RECLAIM_REASON,
+                                   for_key=("pool", pool_name),
+                                   chips=alloc.chips),
+                        now, requeue=True,
+                        annotation=elastic.SPOT_RECLAIM_REASON,
+                        message=(
+                            f"Spot capacity on pool {pool_name} is being "
+                            f"revoked; checkpointing now — the gang "
+                            f"re-queues at its original priority (grace "
+                            f"{self.options.drain_grace_seconds:.0f}s)"))
+
+    async def _maybe_defrag(self, now: float) -> None:
+        """Interval-gated defrag pass: migrate idle borrowers off
+        pack-breaking pools so a waiting native gang's slices come
+        free. Disabled by ``KFTPU_DEFRAG=off``; rate-limited by the
+        interval and the per-pass move cap."""
+        cfg = self._elastic_cfg
+        if not cfg.enable_defrag:
+            return
+        if now - self._last_defrag_at < cfg.defrag_interval_seconds:
+            return
+        self._last_defrag_at = now
+        moves = elastic.plan_defrag(self.policy, cfg, now)
+        for move in moves:
+            if move.key in self._draining:
+                continue
+            alloc = self.policy.ledger.allocations.get(move.key)
+            if alloc is None:
+                continue
+            alloc.draining = True
+            self.policy.gen += 1
+            with span("defrag", victim=f"{move.key[0]}/{move.key[1]}",
+                      source=move.source_pool,
+                      waiter=f"{move.for_key[0]}/{move.for_key[1]}"):
+                self.m_defrag.inc()
+                self._defrag_moves += 1
+                await self._request_drain(
+                    Preemption(key=move.key,
+                               reason=elastic.DEFRAG_REASON,
+                               for_key=move.for_key, chips=move.chips),
+                    now, requeue=True,
+                    annotation=elastic.DEFRAG_REASON,
+                    message=(
+                        f"Migrating to a pack pool: this notebook's "
+                        f"borrowed host on {move.source_pool} blocks a "
+                        f"whole slice "
+                        f"{move.for_key[0]}/{move.for_key[1]} is waiting "
+                        f"for; checkpointing, then re-queueing onto a "
+                        f"pool of its own shape"))
+
     async def _stop_victim(self, key: tuple, reason: str, now: float,
-                           extra: dict | None = None) -> bool:
+                           extra: dict | None = None,
+                           stop_value: str | None = None) -> bool:
         annotations = {
-            nbapi.STOP_ANNOTATION: fmt_iso(now),
+            nbapi.STOP_ANNOTATION: stop_value or fmt_iso(now),
             nbapi.PREEMPTED_ANNOTATION: reason,
         }
         if extra:
@@ -742,8 +1385,12 @@ class TpuFleetScheduler:
 
     async def _retry_stop(self, key: tuple, now: float) -> Admission:
         reason = self._stop_pending[key]
+        # A retried elastic park re-stamps the SAME recorded nonce, so
+        # the un-park's user-stop guard still recognizes it as ours.
+        recorded = self._auto_resume.get(key)
         if not await self._stop_victim(
                 key, reason, now,
+                stop_value=recorded[1] if recorded else None,
                 extra=migration.clear_drain_patch(keep_reason=True)):
             # Keep failing the reconcile until the patch lands: the
             # workqueue's error backoff is the retry loop. Returning
@@ -763,12 +1410,19 @@ class TpuFleetScheduler:
         marks — including the park's drain-reason marker — clear here:
         an admitted gang is past its park, and a leftover reason would
         make a later plain stop present as a checkpointed park."""
+        key = (namespace_of(nb), name_of(nb))
+        alloc = self.policy.ledger.allocations.get(key)
+        flex_pool = (next(iter(alloc.borrow))
+                     if alloc is not None and alloc.borrowed else None)
         try:
             await self.kube.patch(
                 "Notebook", name_of(nb),
                 {"metadata": {"annotations": {
                     nbapi.SCHEDULER_ADMITTED_AT_ANNOTATION: fmt_iso(now),
                     nbapi.PREEMPTED_ANNOTATION: None,
+                    # Durable borrow marker: a restart must re-seat a
+                    # flex gang as a BORROW, not natively.
+                    nbapi.FLEX_POOL_ANNOTATION: flex_pool,
                     **migration.clear_drain_patch(),
                 }}}, namespace_of(nb))
         except ApiError:
@@ -807,6 +1461,12 @@ class TpuFleetScheduler:
         for pool, chips in by_pool.items():
             self.m_admitted_pool.labels(pool=pool).set(chips)
         self._gauge_pools = set(by_pool)
+        borrowed = self.policy.ledger.borrowed
+        for pool in self._gauge_borrow_pools - set(borrowed):
+            self.m_borrowed.labels(pool=pool).set(0)
+        for pool, hosts in borrowed.items():
+            self.m_borrowed.labels(pool=pool).set(hosts)
+        self._gauge_borrow_pools = set(borrowed)
 
     # ---- introspection ----------------------------------------------------------
 
@@ -824,6 +1484,26 @@ class TpuFleetScheduler:
             f"{k[0]}/{k[1]}": reason for k, reason in self._preempted.items()
         }
         info["migration_enabled"] = self.options.enable_migration
+        info["elastic"] = {
+            "enabled": self._intent_book is not None,
+            "defrag_enabled": (self._intent_book is not None
+                               and self._elastic_cfg.enable_defrag),
+            "scale_up_intents": (
+                self._intent_book.debug_rows(now)
+                if self._intent_book is not None else []),
+            "spot_reclaims_in_progress": {
+                pool: {
+                    "for_sec": round(now - episode["since"], 3),
+                    "signaling_nodes": sorted(episode["nodes"]),
+                }
+                for pool, episode in sorted(self._spot_reclaims.items())
+            },
+            "defrag_moves_total": self._defrag_moves,
+            "requeued": {
+                f"{k[0]}/{k[1]}": reason
+                for k, reason in sorted(self._reclaim_verdict.items())
+            },
+        }
         info["draining"] = {
             f"{k[0]}/{k[1]}": {
                 "reason": d.reason,

@@ -66,13 +66,28 @@ class SoakConfig:
     # under faults → repair → converge → invariant check.
     rounds: int = 3
     storm_seconds: float = 0.8
-    fleet: str = "pool-a=v5e:4x4:2"
+    # Served through a fleet ConfigMap (a DYNAMIC source) so the elastic
+    # scale-up grant action can actually grow it mid-soak; pool-spot is
+    # reclaim-aware spot capacity.
+    fleet: str = "pool-a=v5e:4x4:2,pool-spot=v5e:4x4:2:spot"
     fault_rate: float = 0.12
     watch_reset_rate: float = 0.04
     stale_list_rate: float = 0.15
+    # Per-probe chance a "spot" churn action revokes a spot node
+    # (FaultPlan.reclaim_spot — same seeded RNG stream as the API
+    # faults, so a seed replays the same revocation schedule).
+    spot_reclaim_rate: float = 0.5
+    # One never-fits gang per soak drives the scale-up intent path;
+    # churn actions then grant (grow the ConfigMap) or deny (stamp
+    # Failed on the intent's ProvisioningRequest).
+    big_gang_slices: int = 6
     quarantine_after: int = 25
     drain_grace_seconds: float = 2.0
     converge_timeout: float = 30.0
+
+    @property
+    def controller_namespace(self) -> str:
+        return "kubeflow-tpu"
 
 
 @dataclass
@@ -84,6 +99,9 @@ class SoakReport:
     injected: dict = field(default_factory=dict)
     ledger_violations: int = 0
     quarantined_transient: int = 0
+    spot_revocations: int = 0
+    scale_up_grants: int = 0
+    scale_up_denials: int = 0
     problems: list = field(default_factory=list)
 
     @property
@@ -99,6 +117,9 @@ class SoakReport:
             "injected": dict(sorted(self.injected.items())),
             "ledger_violations": self.ledger_violations,
             "quarantined_transient": self.quarantined_transient,
+            "spot_revocations": self.spot_revocations,
+            "scale_up_grants": self.scale_up_grants,
+            "scale_up_denials": self.scale_up_denials,
             "problems": list(self.problems),
             "ok": self.ok,
         }
@@ -153,6 +174,23 @@ async def check_invariants(kube: FakeKube, mgr: Manager,
             problems.append(
                 f"{key[0]}/{key[1]}: drain-requested but neither parked "
                 "nor finalized (wedged drain)")
+        # No gang lost across a reclaim (ISSUE 10): every live TPU
+        # notebook must still be IN the scheduler — admitted, queued, or
+        # draining. A reclaim/defrag that parked a gang and then dropped
+        # it (auto-requeue lost) would leave it stopped-less yet absent
+        # from both books.
+        try:
+            has_tpu = nbapi.multi_slice_of(nb) is not None
+        except Exception:
+            has_tpu = False
+        if (has_tpu and sched.active and not nbapi.is_stopped(nb)
+                and not get_meta(nb).get("deletionTimestamp")
+                and key not in sched.policy.ledger.allocations
+                and key not in sched.policy.pending
+                and key not in sched._draining):
+            problems.append(
+                f"{key[0]}/{key[1]}: live gang lost by the scheduler "
+                "(neither admitted nor queued nor draining)")
 
     sts_seen: dict[tuple, list] = {}
     for sts in await kube.list("StatefulSet"):
@@ -212,6 +250,10 @@ class ChaosSoak:
         self.sched: TpuFleetScheduler | None = None
         self._nb_names: list[tuple] = []
         self._created = 0
+        # Live fleet spec (the ConfigMap's data["fleet"]); scale-up
+        # grants rewrite it.
+        self._fleet_spec = config.fleet
+        self._spot_nodes: list[str] = []
 
     # -- stack lifecycle -----------------------------------------------------
 
@@ -233,8 +275,19 @@ class ChaosSoak:
                 idle_preempt_after_seconds=0.2,
                 enable_migration=True,
                 drain_grace_seconds=self.cfg.drain_grace_seconds,
+                # Elastic fleet under chaos: the spec comes from the
+                # fleet ConfigMap (a DYNAMIC source — grants grow it,
+                # and a restarted manager re-discovers it through the
+                # fault storm), refreshed at soak speed.
+                fleet_configmap="kftpu-fleet",
+                controller_namespace=self.cfg.controller_namespace,
+                fleet_refresh_seconds=0.05,
+                enable_elastic=True,
+                scale_up_ttl_seconds=5.0,
+                defrag_interval_seconds=0.2,
+                defrag_idle_seconds=0.3,
             ),
-            fleet=Fleet.parse(self.cfg.fleet), registry=mgr.registry,
+            registry=mgr.registry,
         )
         setup_notebook_controller(mgr, NotebookOptions(), scheduler=sched)
         # Soak-speed clocks: tiny workqueue backoff and informer resync so
@@ -265,6 +318,7 @@ class ChaosSoak:
 
     def _arm_faults(self) -> None:
         cfg = self.cfg
+        self.plan.reclaim_spot(rate=cfg.spot_reclaim_rate)
         self.plan.fail("unavailable", rate=cfg.fault_rate)
         self.plan.fail("internal", rate=cfg.fault_rate / 2)
         self.plan.fail("timeout", rate=cfg.fault_rate / 3)
@@ -292,6 +346,40 @@ class ChaosSoak:
         except ApiError:
             self._created -= 1  # injected failure: retry the same name later
 
+    async def _seed_cluster(self) -> None:
+        """Pre-storm cluster state: the fleet ConfigMap (the scheduler's
+        dynamic source), one Node per spot-pool slice (the revocation
+        signal's carrier), and — elastic — one never-fits gang whose
+        shortfall keeps a scale-up intent alive for the grant/deny
+        churn actions to answer."""
+        await self.kube.create("ConfigMap", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "kftpu-fleet",
+                         "namespace": self.cfg.controller_namespace},
+            "data": {"fleet": self._fleet_spec},
+        })
+        for pool in Fleet.parse(self._fleet_spec).pools:
+            if not pool.spot:
+                continue
+            for i in range(pool.num_slices):
+                node_name = f"{pool.name}-node-{i}"
+                self._spot_nodes.append(node_name)
+                await self.kube.create("Node", {
+                    "apiVersion": "v1", "kind": "Node",
+                    "metadata": {"name": node_name, "labels": {
+                        "cloud.google.com/gke-nodepool": pool.name,
+                        "cloud.google.com/gke-spot": "true",
+                    }},
+                })
+        big = nbapi.new("soak-big", "team-0", accelerator="v5e",
+                        topology="4x4",
+                        num_slices=self.cfg.big_gang_slices)
+        try:
+            await self.kube.create("Notebook", big)
+            self._nb_names.append(("team-0", "soak-big"))
+        except ApiError:
+            pass
+
     async def _seed_notebooks(self) -> None:
         for n in range(self.cfg.namespaces):
             for _ in range(self.cfg.notebooks_per_namespace):
@@ -306,7 +394,7 @@ class ChaosSoak:
         ns, name = key
         action = self.rng.choice(
             ["stop", "start", "suspend", "resume", "idle", "active",
-             "edit", "ack"])
+             "edit", "ack", "spot", "scale_up"])
         self.report.actions += 1
         patch = None
         if action == "stop":
@@ -327,11 +415,123 @@ class ChaosSoak:
         elif action == "ack":
             await self._ack_drains(only=key)
             return
+        elif action == "spot":
+            await self._spot_action()
+            return
+        elif action == "scale_up":
+            await self._scale_up_action()
+            return
         try:
             await self.kube.patch(
                 "Notebook", name, {"metadata": {"annotations": patch}}, ns)
         except ApiError:
             pass
+
+    async def _kick_elastic(self) -> None:
+        """Deterministic elastic exercise, once per soak: revoke one
+        spot node and deny the standing scale-up intent (the never-fits
+        gang keeps its demand alive, so later churn can still grant).
+        The wall-clock-paced churn alone could miss both paths on a
+        slow host, and the tier-1 seeds assert they ran."""
+        if self._spot_nodes:
+            self.report.spot_revocations += 1
+            try:
+                await self.kube.patch(
+                    "Node", self._spot_nodes[0],
+                    {"spec": {"taints": [{
+                        "key": "cloud.google.com/gke-spot-termination",
+                        "effect": "NoSchedule"}]}})
+            except ApiError:
+                pass
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            intents = (self.sched._intent_book.intents
+                       if self.sched is not None
+                       and self.sched._intent_book is not None else {})
+            if intents:
+                intent = sorted(intents.values(),
+                                key=lambda i: i.name)[0]
+                try:
+                    await self.kube.patch(
+                        "ProvisioningRequest", intent.name,
+                        {"status": {"conditions": [{
+                            "type": "Failed", "status": "True",
+                            "reason": "ChaosDenied",
+                            "message": "injected scale-up denial",
+                        }]}},
+                        self.cfg.controller_namespace,
+                        subresource="status")
+                    self.report.scale_up_denials += 1
+                    return
+                except ApiError:
+                    pass  # CR mirror not created yet — retry
+            await asyncio.sleep(0.05)
+
+    async def _spot_action(self) -> None:
+        """Revoke — or give back — spot capacity. The revocation
+        schedule comes from the FaultPlan (seeded, deterministic); the
+        signal itself travels as the real GKE taint on the pool's Node,
+        through the normal API."""
+        if not self._spot_nodes:
+            return
+        node = self.rng.choice(self._spot_nodes)
+        pool = node.rsplit("-node-", 1)[0]
+        try:
+            if self.plan.should_reclaim_spot(pool):
+                self.report.spot_revocations += 1
+                await self.kube.patch("Node", node, {"spec": {"taints": [{
+                    "key": "cloud.google.com/gke-spot-termination",
+                    "effect": "NoSchedule",
+                }]}})
+            else:
+                await self.kube.patch("Node", node,
+                                      {"spec": {"taints": None}})
+        except ApiError:
+            pass
+
+    async def _scale_up_action(self) -> None:
+        """Answer a pending scale-up intent: grant (grow the fleet
+        ConfigMap — the dynamic source the scheduler re-reads) or deny
+        (stamp Failed on the intent's ProvisioningRequest)."""
+        intents = (self.sched._intent_book.intents
+                   if self.sched is not None
+                   and self.sched._intent_book is not None else {})
+        if not intents:
+            return
+        intent = self.rng.choice(sorted(intents.values(),
+                                        key=lambda i: i.name))
+        if self.rng.random() < 0.5:
+            # Grant: +2 slices on pool-a (bounded so a grant-happy seed
+            # cannot grow the fleet without limit).
+            try:
+                parts = self._fleet_spec.split(",")
+                name, shape = parts[0].split("=")
+                acc, topo, n, *rest = shape.split(":")
+                if int(n) >= 8:
+                    return
+                parts[0] = f"{name}={acc}:{topo}:{int(n) + 2}" + (
+                    ":" + ":".join(rest) if rest else "")
+                self._fleet_spec = ",".join(parts)
+                await self.kube.patch(
+                    "ConfigMap", "kftpu-fleet",
+                    {"data": {"fleet": self._fleet_spec}},
+                    self.cfg.controller_namespace)
+                self.report.scale_up_grants += 1
+            except (ApiError, ValueError):
+                pass
+        else:
+            try:
+                await self.kube.patch(
+                    "ProvisioningRequest", intent.name,
+                    {"status": {"conditions": [{
+                        "type": "Failed", "status": "True",
+                        "reason": "ChaosDenied",
+                        "message": "injected scale-up denial",
+                    }]}},
+                    self.cfg.controller_namespace, subresource="status")
+                self.report.scale_up_denials += 1
+            except ApiError:
+                pass
 
     async def _ack_drains(self, only: tuple | None = None) -> None:
         """The simulated in-pod SDK: answer any un-acked drain request
@@ -405,6 +605,15 @@ class ChaosSoak:
         can race the final benign requeues; a REAL violation is stable
         and survives to the timeout)."""
         self._lift_faults()
+        # Revocations complete between storms: the dying spot nodes are
+        # replaced (taints clear), so reclaimed pools re-open and the
+        # drained gangs can re-admit.
+        for node in self._spot_nodes:
+            try:
+                await self.kube.patch("Node", node,
+                                      {"spec": {"taints": None}})
+            except ApiError:
+                pass
         self.kube.close_watches()
         deadline = time.monotonic() + self.cfg.converge_timeout
         released = False
@@ -433,7 +642,8 @@ class ChaosSoak:
 
     async def run(self) -> SoakReport:
         cfg = self.cfg
-        await self._start()
+        await self._seed_cluster()   # fleet source exists before the
+        await self._start()          # first admission pass runs
         sdk_stop = asyncio.Event()
         sdk_task = asyncio.create_task(self._sdk_loop(sdk_stop))
         sim = PodSimulator(self.kube)
@@ -442,6 +652,7 @@ class ChaosSoak:
             await self._seed_notebooks()
             for p in await self._converge_and_check():
                 self.report.problems.append(f"initial: {p}")
+            await self._kick_elastic()
             for round_no in range(cfg.rounds):
                 self.report.rounds += 1
                 self._arm_faults()

@@ -187,6 +187,7 @@ class FaultPlan:
         self.rules: list[FaultRule] = []
         self._watch_rules: list[_WatchResetRule] = []
         self._stale_rules: list[FaultRule] = []
+        self._reclaim_rules: list[_WatchResetRule] = []
         # Per-error injection counts — the soak report and tests assert
         # faults actually fired.
         self.injected: dict[str, int] = defaultdict(int)
@@ -216,14 +217,36 @@ class FaultPlan:
         self._stale_rules.append(rule)
         return rule
 
+    def reclaim_spot(self, pools: str = "*", *, rate: float = 0.0,
+                     every: int | None = None) -> _WatchResetRule:
+        """Seeded spot-revocation schedule for harnesses (chaos soak,
+        bench reclaim storm): each :meth:`should_reclaim_spot` probe
+        consults it — after every ``every``-th probe of a matching pool,
+        or with probability ``rate`` per probe. The harness acts on a
+        True by tainting the pool's Node through the normal API, so the
+        control plane sees exactly what GKE would send; determinism
+        comes from the plan's one seeded RNG."""
+        rule = _WatchResetRule(pools, rate, every)
+        self._reclaim_rules.append(rule)
+        return rule
+
+    def should_reclaim_spot(self, pool: str) -> bool:
+        for rule in self._reclaim_rules:
+            if rule.consume(self._rng, pool):
+                self.injected["spot_reclaim"] += 1
+                return True
+        return False
+
     def clear(self) -> None:
         """Lift every fault (rules stay readable for their counters)."""
         self.rules = []
         self._watch_rules = []
         self._stale_rules = []
+        self._reclaim_rules = []
 
     def drop(self, rule) -> None:
-        for bucket in (self.rules, self._watch_rules, self._stale_rules):
+        for bucket in (self.rules, self._watch_rules, self._stale_rules,
+                       self._reclaim_rules):
             if rule in bucket:
                 bucket.remove(rule)
 
@@ -255,7 +278,7 @@ class FaultPlan:
             "seed": self.seed,
             "injected": dict(sorted(self.injected.items())),
             "active_rules": len(self.rules) + len(self._watch_rules)
-            + len(self._stale_rules),
+            + len(self._stale_rules) + len(self._reclaim_rules),
         }
 
 

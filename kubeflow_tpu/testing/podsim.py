@@ -12,6 +12,7 @@ configurable latency, and mirroring readiness into workload status.
 from __future__ import annotations
 
 import asyncio
+import time
 
 from kubeflow_tpu.runtime.errors import AlreadyExists, ApiError, NotFound
 from kubeflow_tpu.runtime.objects import (
@@ -69,6 +70,12 @@ class PodSimulator:
         # namespace-wide pod scans that made the kubelet sim O(pods-in-ns)
         # per event — O(N²) across the load test's shared namespace.
         self._owner_pods: dict[tuple, set[str]] = {}
+        # Short-TTL Node cache for scheduler-like node assignment: pods
+        # with a nodeSelector get spec.nodeName stamped from a matching
+        # Node (round-robin by ordinal), so node-level signals — spot
+        # revocation taints, maintenance — map to real pods in the sim.
+        # Clusters with no Node objects behave exactly as before.
+        self._nodes_cache: tuple[float, list] = (-1.0, [])
         self._running = False
 
     async def start(self) -> None:
@@ -159,10 +166,13 @@ class PodSimulator:
             return
         replicas = deep_get(obj, "spec", "replicas", default=1)
         template = deep_get(obj, "spec", "template", default={})
+        nodes = (await self._list_nodes()
+                 if deep_get(template, "spec", "nodeSelector") else [])
         want: dict[str, dict] = {}
         for i in range(replicas):
             pod_name = f"{name}-{i}" if kind == "StatefulSet" else f"{name}-rs-{i}"
-            want[pod_name] = self._pod_from_template(pod_name, ns, template, obj)
+            want[pod_name] = self._pod_from_template(
+                pod_name, ns, template, obj, ordinal=i, nodes=nodes)
 
         # Owner index, not a namespace scan; the simulator's own writes
         # update it synchronously below, so it cannot lag its own actions
@@ -201,7 +211,23 @@ class PodSimulator:
                     names.discard(pod_name)
         await self._mirror_status(kind, obj, len(want))
 
-    def _pod_from_template(self, pod_name: str, ns: str, template: dict, owner: dict) -> dict:
+    async def _list_nodes(self) -> list:
+        """Node objects for pod placement, cached briefly — one LIST per
+        cache window instead of one per workload reconcile."""
+        stamp, nodes = self._nodes_cache
+        now = time.monotonic()
+        if now - stamp < 0.5 and stamp >= 0:
+            return nodes
+        try:
+            nodes = await self.kube.list("Node", copy=False)
+        except ApiError:
+            nodes = []
+        self._nodes_cache = (now, nodes)
+        return nodes
+
+    def _pod_from_template(self, pod_name: str, ns: str, template: dict,
+                           owner: dict, *, ordinal: int = 0,
+                           nodes: list | None = None) -> dict:
         labels = dict(deep_get(template, "metadata", "labels", default={}))
         if owner.get("kind") == "StatefulSet":
             # The real STS controller stamps the stable pod identity label
@@ -219,6 +245,19 @@ class PodSimulator:
             },
             "spec": deepcopy(template.get("spec", {})),
         }
+        selector = pod["spec"].get("nodeSelector") or {}
+        if nodes and selector and not pod["spec"].get("nodeName"):
+            # Scheduler stand-in: bind to a matching node, round-robin by
+            # ordinal, so node taints/deletions reach the right pods.
+            matching = [
+                name_of(n) for n in nodes
+                if all((deep_get(n, "metadata", "labels",
+                                 default={}) or {}).get(k) == v
+                       for k, v in selector.items())
+            ]
+            if matching:
+                matching.sort()
+                pod["spec"]["nodeName"] = matching[ordinal % len(matching)]
         set_controller_owner(pod, owner)
         return pod
 

@@ -99,6 +99,31 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     sched = deep_get(notebook, "status", "scheduler", default={}) or {}
     mig = deep_get(notebook, "status", "migration", default={}) or {}
     if sched.get("state") == "Queued":
+        # Elastic-fleet refinements first — each is more specific than
+        # the generic queue position:
+        if sched.get("reclaimed") == "spot-reclaim":
+            step = mig.get("checkpointStep")
+            ckpt = (f"checkpoint @ step {step}" if step is not None
+                    else "checkpoint saved")
+            return Status(
+                WAITING,
+                f"Reclaimed from spot capacity ({ckpt}, re-queued at "
+                f"position {sched.get('position', 0)})",
+            )
+        if sched.get("reclaimed") == "defrag":
+            return Status(
+                WAITING,
+                f"Migrating to pack pool (re-queued at position "
+                f"{sched.get('position', 0)})",
+            )
+        scale_up = sched.get("scaleUp") or {}
+        if scale_up.get("chips"):
+            pending = scale_up.get("pendingSeconds", 0) or 0
+            return Status(
+                WAITING,
+                f"Waiting for pool scale-up ({scale_up['chips']} chips "
+                f"requested, intent pending {pending:.0f}s)",
+            )
         return Status(
             WAITING,
             f"Queued for TPU capacity (position {sched.get('position', 0)},"
@@ -106,6 +131,16 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
         )
     if sched.get("state") == "Draining":
         reason = sched.get("reason") or "capacity reclaimed"
+        if reason == "defrag":
+            return Status(
+                WAITING,
+                "Migrating to pack pool (checkpointing)…",
+            )
+        if reason == "spot-reclaim":
+            return Status(
+                WAITING,
+                "Checkpointing before spot capacity is reclaimed…",
+            )
         return Status(
             WAITING,
             f"Checkpointing before preemption ({reason})…",

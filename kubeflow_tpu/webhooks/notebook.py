@@ -119,7 +119,7 @@ async def validate_capacity(kube, nb: dict) -> None:
         acc = ms.slice.accelerator.name
         topo = ms.slice.topology_str
         ceiling = fleet.total_slices(acc, topo)
-        if ceiling < ms.num_slices:
+        if ceiling < ms.num_slices and not _flex_schedulable(fleet, ms):
             detail = (
                 f"no configured node pool hosts {acc}:{topo} slices"
                 if ceiling == 0 else
@@ -129,6 +129,20 @@ async def validate_capacity(kube, nb: dict) -> None:
                 f"Notebook {name}: can never be scheduled — {detail}. "
                 "Pick a shape from the configured fleet (KFTPU_FLEET) "
                 "or reduce spec.tpu.numSlices")
+
+
+def _flex_schedulable(fleet, ms) -> bool:
+    """With the elastic fleet on, a single-host gang can borrow a host
+    from a same-accelerator pool (scheduler/elastic.py flex placement) —
+    the shape ceiling alone must not fast-fail it. One shared predicate
+    (elastic.flex_capable) keeps this aligned with the scheduler's own
+    eligibility rule."""
+    from kubeflow_tpu.scheduler import elastic
+
+    if not elastic.elastic_enabled():
+        return False
+    return elastic.flex_capable(fleet, ms.slice,
+                                num_slices=ms.num_slices)
 
 
 async def _declared_fleet(kube):

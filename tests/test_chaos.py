@@ -23,7 +23,7 @@ from kubeflow_tpu.testing.chaos import (
 BENCH_SEEDS = range(5)
 
 
-async def _assert_soak(seed: int) -> None:
+async def _assert_soak(seed: int) -> dict:
     report = await run_soak(SoakConfig(seed=seed, rounds=3,
                                        storm_seconds=0.5))
     d = report.to_dict()
@@ -34,10 +34,17 @@ async def _assert_soak(seed: int) -> None:
     # The storm actually stormed — a soak that injected nothing proves
     # nothing.
     assert sum(d["injected"].values()) > 0
+    return d
 
 
 async def test_chaos_soak_seed_0():
-    await _assert_soak(0)
+    d = await _assert_soak(0)
+    # Seed 0's schedule is known to exercise the elastic-fleet actions
+    # (ISSUE 10): spot revocations and scale-up grant/denial answers —
+    # and the no-gang-lost-across-a-reclaim invariant held through them
+    # (it is part of every convergence check above).
+    assert d["spot_revocations"] > 0
+    assert d["scale_up_grants"] + d["scale_up_denials"] > 0
 
 
 async def test_chaos_soak_seed_1():

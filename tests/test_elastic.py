@@ -1,0 +1,1058 @@
+"""Elastic fleet (ISSUE 10): scale-up intents, spot pools with
+reclaim-safe drains, flex placement and slice defragmentation.
+
+Pure-policy tests drive kubeflow_tpu/scheduler/elastic.py directly;
+integration tests run the real manager/controller/scheduler stack on
+FakeKube + podsim, including the KFTPU_ELASTIC=off kill-switch proof
+that PR 5–7 behavior is untouched.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import Registry
+from kubeflow_tpu.runtime.objects import annotations_of, deep_get, fmt_iso
+from kubeflow_tpu.scheduler import (
+    Fleet,
+    FleetConfigError,
+    SchedulerOptions,
+    TpuFleetScheduler,
+)
+from kubeflow_tpu.scheduler import elastic
+from kubeflow_tpu.scheduler.fleet import Allocation, ChipLedger
+from kubeflow_tpu.scheduler.policy import GangRequest, PolicyQueue
+from kubeflow_tpu.testing.fakekube import FakeKube, FaultPlan
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+
+RECLAIM_TAINT = {"key": "cloud.google.com/gke-spot-termination",
+                 "effect": "NoSchedule"}
+
+
+def req(name, acc="v5e", topo="2x2", slices=1, chips=None, ns="ns",
+        prio=0, submitted=0.0):
+    from kubeflow_tpu.tpu.topology import TpuSlice
+
+    return GangRequest(
+        key=(ns, name), namespace=ns, accelerator=acc, topology=topo,
+        num_slices=slices,
+        chips=chips or TpuSlice.parse(acc, topo).num_chips * slices,
+        priority=prio, submitted_at=submitted)
+
+
+# ---- fleet spec parse edges (satellite: duplicate pools et al.) ---------------
+
+
+def test_parse_spot_flag_and_roundtrip():
+    f = Fleet.parse("pack=v5e:4x4:2,cheap=v5e:2x2:3:spot")
+    assert [(p.name, p.spot) for p in f.pools] == \
+        [("cheap", True), ("pack", False)]
+    assert f.by_name("cheap").num_slices == 3
+
+
+def test_parse_duplicate_pool_names_actionable():
+    with pytest.raises(FleetConfigError) as e:
+        Fleet.parse("a=v5e:4x4:1, b=v5e:2x2:1 ,a=v5e:4x4:2")
+    msg = str(e.value)
+    assert "duplicate pool name 'a'" in msg
+    assert "entries 1 and 3" in msg          # which entries clash
+    assert "merge the slice counts" in msg   # what to do about it
+
+
+def test_parse_duplicate_across_newlines_and_spot_variants():
+    # Newlines are entry separators like commas; a spot/non-spot pair
+    # under one name is still a duplicate (one pool cannot be both).
+    with pytest.raises(FleetConfigError, match="duplicate pool name"):
+        Fleet.parse("a=v5e:4x4:1\na=v5e:4x4:1:spot")
+
+
+@pytest.mark.parametrize("spec", [
+    "=v5e:4x4:1",                  # empty pool name
+    "bad pool=v5e:4x4:1",          # whitespace in the name
+    "-lead=v5e:4x4:1",             # invalid leading char
+    "a=v5e:4x4:1:fast",            # unknown 4th field
+    "a=v5e:4x4:1:spot:extra",      # too many fields
+])
+def test_parse_rejects_bad_entries(spec):
+    with pytest.raises(FleetConfigError):
+        Fleet.parse(spec)
+
+
+def test_from_nodes_marks_spot_pools():
+    def node(name, pool, spot):
+        labels = {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "2x2",
+            "cloud.google.com/gke-nodepool": pool,
+        }
+        if spot:
+            labels["cloud.google.com/gke-spot"] = "true"
+        return {"metadata": {"name": name, "labels": labels}}
+
+    fleet = Fleet.from_nodes([
+        node("n0", "cheap", True), node("n1", "cheap", True),
+        node("n2", "steady", False),
+    ])
+    assert fleet.by_name("cheap").spot
+    assert not fleet.by_name("steady").spot
+
+
+# ---- borrow (flex) ledger accounting ------------------------------------------
+
+
+def test_borrow_breaks_whole_slices_and_releases():
+    fleet = Fleet.parse("pack=v5e:4x4:2,small=v5e:2x2:1")
+    ledger = ChipLedger(fleet)
+    pack = fleet.by_name("pack")
+    assert pack.hosts_per_slice == 2
+    a1 = Allocation(key=("ns", "b1"), namespace="ns", accelerator="v5e",
+                    topology="2x2", num_slices=1, chips=4, placements={},
+                    borrow={"pack": 1})
+    ledger.admit(a1)
+    # One borrowed host breaks one whole slice.
+    assert ledger.broken_slices(pack) == 1
+    assert ledger.free_slices(pack) == 1
+    assert ledger.free_hosts(pack) == 3
+    a2 = Allocation(key=("ns", "b2"), namespace="ns", accelerator="v5e",
+                    topology="2x2", num_slices=1, chips=4, placements={},
+                    borrow={"pack": 1})
+    ledger.admit(a2)
+    # The second borrower packs into the SAME broken slice.
+    assert ledger.broken_slices(pack) == 1
+    assert ledger.free_slices(pack) == 1
+    ledger.assert_consistent()
+    # Native admission sees only the unbroken slice.
+    assert ledger.fit("v5e", "4x4", 2) is None
+    assert ledger.fit("v5e", "4x4", 1) == {"pack": 1}
+    ledger.release(("ns", "b1"))
+    ledger.release(("ns", "b2"))
+    assert ledger.borrowed == {}
+    assert ledger.fit("v5e", "4x4", 2) == {"pack": 2}
+    ledger.assert_consistent()
+
+
+def test_borrow_atomicity_and_capacity_enforced():
+    fleet = Fleet.parse("pack=v5e:4x4:1")
+    ledger = ChipLedger(fleet)
+    from kubeflow_tpu.scheduler.fleet import LedgerError
+
+    with pytest.raises(LedgerError):   # partial borrow (needs 1 host)
+        ledger.admit(Allocation(
+            key=("ns", "x"), namespace="ns", accelerator="v5e",
+            topology="2x2", num_slices=1, chips=4, placements={},
+            borrow={}))
+    assert ledger.violations == 1
+    for i in range(2):
+        ledger.admit(Allocation(
+            key=("ns", f"b{i}"), namespace="ns", accelerator="v5e",
+            topology="2x2", num_slices=1, chips=4, placements={},
+            borrow={"pack": 1}))
+    with pytest.raises(LedgerError):   # pool out of hosts
+        ledger.admit(Allocation(
+            key=("ns", "b2"), namespace="ns", accelerator="v5e",
+            topology="2x2", num_slices=1, chips=4, placements={},
+            borrow={"pack": 1}))
+    assert ledger.violations == 2
+    ledger.assert_consistent()
+
+
+def test_flex_plan_prefers_already_broken_slice_and_protects_waiters():
+    fleet = Fleet.parse("a=v5e:4x4:1,b=v5e:4x4:1")
+    ledger = ChipLedger(fleet)
+    ledger.admit(Allocation(
+        key=("ns", "b0"), namespace="ns", accelerator="v5e",
+        topology="2x2", num_slices=1, chips=4, placements={},
+        borrow={"b": 1}))
+    # Pool b's slice is already broken — pack the next borrower there,
+    # even though name order would pick a.
+    assert elastic.flex_plan(ledger, req("n1")) == {"b": 1}
+    # With a native 4x4 waiter pending, a NEW break is forbidden; the
+    # spare host on b's already-broken slice is still fair game.
+    protected = frozenset({("v5e", "4x4")})
+    assert elastic.flex_plan(ledger, req("n1"),
+                             protected_shapes=protected) == {"b": 1}
+    ledger.admit(Allocation(
+        key=("ns", "b1"), namespace="ns", accelerator="v5e",
+        topology="2x2", num_slices=1, chips=4, placements={},
+        borrow={"b": 1}))
+    assert elastic.flex_plan(ledger, req("n2"),
+                             protected_shapes=protected) is None
+
+
+def test_flex_plan_rejects_multihost_and_small_hosts():
+    fleet = Fleet.parse("small=v5e:2x2:4")
+    ledger = ChipLedger(fleet)
+    # 2x4 (one 8-chip host) cannot borrow a 4-chip 2x2 host.
+    assert elastic.flex_plan(ledger, req("big", topo="2x4")) is None
+    # A multi-host gang is never flex-placed.
+    fleet2 = Fleet.parse("pack=v5e:4x4:4")
+    assert elastic.flex_plan(ChipLedger(fleet2),
+                             req("ms", topo="4x4", slices=2)) is None
+
+
+def test_overflow_pass_seats_flexible_gangs():
+    pq = PolicyQueue(fleet=Fleet.parse("pack=v5e:4x4:2,small=v5e:2x2:2"))
+    for i in range(4):
+        pq.submit(req(f"s{i}"))
+    pq.schedule(1.0)
+    admitted = elastic.overflow_pass(pq, 1.0)
+    assert sorted(a.key for a in admitted) == \
+        [("ns", "s2"), ("ns", "s3")]
+    assert pq.ledger.borrowed == {"pack": 2}
+    assert not pq.pending
+    pq.ledger.assert_consistent()
+
+
+# ---- scale-up intents ----------------------------------------------------------
+
+
+def test_shortfalls_only_for_gangs_that_fit_nowhere_even_drained():
+    pq = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"))
+    pq.submit(req("fits-when-drained", topo="4x4"))          # ceiling 1
+    pq.submit(req("too-big", topo="4x4", slices=3))          # needs 3
+    pq.submit(req("flexible", topo="2x2"))                   # can borrow
+    pq.submit(req("alien", acc="v5p", topo="2x2x1", slices=2))
+    pq.schedule(0.0)
+    shorts = elastic.compute_shortfalls(pq, 0.0)
+    assert set(shorts) == {("v5e", "4x4"), ("v5p", "2x2x1")}
+    assert shorts[("v5e", "4x4")].slices == 2     # 3 wanted, ceiling 1
+    assert shorts[("v5p", "2x2x1")].slices == 2   # no pool at all
+    # Flex off (elastic disabled semantics): the flexible single-host
+    # gang becomes a shortfall too.
+    shorts = elastic.compute_shortfalls(pq, 0.0, flex=False)
+    assert ("v5e", "2x2") in shorts
+
+
+def test_intent_book_lifecycle_dedup_ttl_withdraw():
+    fleet = Fleet.parse("a=v5e:4x4:1")
+    pq = PolicyQueue(fleet=fleet)
+    pq.submit(req("big", topo="4x4", slices=3))
+    pq.submit(req("big2", topo="4x4", slices=4))
+    book = elastic.IntentBook(ttl_seconds=10.0)
+    sync = book.sync(elastic.compute_shortfalls(pq, 0.0), fleet, 0.0)
+    assert len(sync.created) == 1                 # deduped per shape
+    intent = sync.created[0]
+    assert intent.name == "pool-scale-up-v5e-4x4"
+    assert intent.slices == 3                     # sized for the LARGEST
+    assert intent.chips == 48
+    assert set(intent.for_keys) == {("ns", "big"), ("ns", "big2")}
+    # Still needed past the TTL → renewed (the alert signal), not duped.
+    sync = book.sync(elastic.compute_shortfalls(pq, 11.0), fleet, 11.0)
+    assert not sync.created and len(sync.renewed) == 1
+    assert intent.renewals == 1
+    # Demand evaporates → withdrawn as moot.
+    pq.release(("ns", "big"))
+    pq.release(("ns", "big2"))
+    sync = book.sync(elastic.compute_shortfalls(pq, 12.0), fleet, 12.0)
+    assert [(i.name, r) for i, r in sync.withdrawn] == \
+        [("pool-scale-up-v5e-4x4", "moot")]
+    assert not book.intents
+
+
+def test_intent_withdrawn_as_granted_when_fleet_grows():
+    fleet = Fleet.parse("a=v5e:4x4:1")
+    pq = PolicyQueue(fleet=fleet)
+    pq.submit(req("big", topo="4x4", slices=3))
+    book = elastic.IntentBook()
+    book.sync(elastic.compute_shortfalls(pq, 0.0), fleet, 0.0)
+    grown = Fleet.parse("a=v5e:4x4:3")
+    pq.rebind_fleet(grown)
+    pq.schedule(1.0)
+    assert pq.is_admitted(("ns", "big"))
+    sync = book.sync(elastic.compute_shortfalls(pq, 1.0), grown, 1.0)
+    assert [r for _, r in sync.withdrawn] == ["granted"]
+
+
+# ---- defrag planning -----------------------------------------------------------
+
+
+def _wedged_queue():
+    """Two pack slices broken by four borrowers; a 2-slice 4x4 gang
+    waits; the small (pack) pool has room for every migrant."""
+    pq = PolicyQueue(fleet=Fleet.parse("pack=v5e:4x4:2,small=v5e:2x2:4"))
+    for i in range(4):
+        pq.ledger.admit(Allocation(
+            key=("ns", f"b{i}"), namespace="ns", accelerator="v5e",
+            topology="2x2", num_slices=1, chips=4, placements={},
+            borrow={"pack": 1}, last_active_at=-10_000.0))
+    pq.submit(req("big", topo="4x4", slices=2))
+    pq.schedule(0.0)
+    return pq
+
+
+def test_plan_defrag_migrates_idle_borrowers_with_pack_homes():
+    pq = _wedged_queue()
+    cfg = elastic.ElasticConfig(defrag_idle_seconds=1.0,
+                                defrag_max_moves=4)
+    moves = elastic.plan_defrag(pq, cfg, now=100.0)
+    assert len(moves) == 4
+    assert {m.key for m in moves} == {("ns", f"b{i}") for i in range(4)}
+    assert all(m.for_key == ("ns", "big") for m in moves)
+
+
+def test_plan_defrag_respects_idle_and_rate_limit():
+    pq = _wedged_queue()
+    # Busy borrowers (fresh activity) are never migrated.
+    for a in pq.ledger.allocations.values():
+        a.last_active_at = 99.9
+    cfg = elastic.ElasticConfig(defrag_idle_seconds=60.0)
+    assert elastic.plan_defrag(pq, cfg, now=100.0) == []
+    # Idle again but capped at 2 moves/pass: freeing one slice (2 of 4
+    # borrowers) does not admit the 2-slice waiter, so the planner
+    # refuses a pointless partial migration.
+    for a in pq.ledger.allocations.values():
+        a.last_active_at = -10_000.0
+    cfg = elastic.ElasticConfig(defrag_idle_seconds=1.0,
+                                defrag_max_moves=2)
+    assert elastic.plan_defrag(pq, cfg, now=100.0) == []
+
+
+def test_plan_defrag_requires_pack_homes():
+    pq = PolicyQueue(fleet=Fleet.parse("pack=v5e:4x4:2,small=v5e:2x2:1"))
+    for i in range(4):
+        pq.ledger.admit(Allocation(
+            key=("ns", f"b{i}"), namespace="ns", accelerator="v5e",
+            topology="2x2", num_slices=1, chips=4, placements={},
+            borrow={"pack": 1}, last_active_at=-10_000.0))
+    pq.submit(req("big", topo="4x4", slices=2))
+    pq.schedule(0.0)
+    # Only ONE pack home for four migrants: moving one borrower frees no
+    # whole slice, so no moves are planned.
+    cfg = elastic.ElasticConfig(defrag_idle_seconds=1.0,
+                                defrag_max_moves=4)
+    assert elastic.plan_defrag(pq, cfg, now=100.0) == []
+
+
+def test_plan_idle_borrower_eviction_host_granular_idle_preemption():
+    pq = PolicyQueue(fleet=Fleet.parse("pack=v5e:4x4:1"))
+    for i, idle_at in enumerate((-10_000.0, -20_000.0)):
+        pq.ledger.admit(Allocation(
+            key=("ns", f"b{i}"), namespace="ns", accelerator="v5e",
+            topology="2x2", num_slices=1, chips=4, placements={},
+            borrow={"pack": 1}, last_active_at=idle_at,
+            admitted_at=-30_000.0))
+    waiter = req("w")
+    pq.submit(waiter)
+    victim = elastic.plan_idle_borrower_eviction(pq, waiter, now=0.0,
+                                                 idle_after=60.0)
+    assert victim is not None and victim.key == ("ns", "b1")  # idlest
+    # A draining borrower on a usable pool = capacity already incoming:
+    # never double-kill for a one-host waiter.
+    victim.draining = True
+    assert elastic.plan_idle_borrower_eviction(
+        pq, waiter, now=0.0, idle_after=60.0) is None
+    victim.draining = False
+    # Busy borrowers (or probe-less ones) are never evicted.
+    for a in pq.ledger.allocations.values():
+        a.last_active_at = -1.0
+        a.admitted_at = -1.0
+    assert elastic.plan_idle_borrower_eviction(
+        pq, waiter, now=0.0, idle_after=60.0) is None
+    for a in pq.ledger.allocations.values():
+        a.last_active_at = None
+    assert elastic.plan_idle_borrower_eviction(
+        pq, waiter, now=0.0, idle_after=60.0) is None
+
+
+def test_reclaim_reseats_borrower_as_borrow_not_native():
+    """Controller restart: a flex gang re-seats as a BORROW (its pods
+    run on the foreign pool's host) — a native/pseudo-pool re-seat
+    would un-break the host pool's slice and resell occupied hosts."""
+    fleet = Fleet.parse("pack=v5e:4x4:1")
+    pq = PolicyQueue(fleet=fleet)       # the fresh post-restart brain
+    assert pq.reclaim(req("b0"), now=5.0)
+    alloc = pq.ledger.allocations[("ns", "b0")]
+    assert alloc.borrowed and alloc.borrow == {"pack": 1}
+    assert not alloc.forced
+    assert pq.ledger.broken_slices(fleet.by_name("pack")) == 1
+    pq.ledger.assert_consistent()
+    # With every host resold already, the overcommit fallback remains.
+    pq2 = PolicyQueue(fleet=fleet)
+    for i in range(2):
+        assert pq2.reclaim(req(f"c{i}"), now=5.0)
+    assert pq2.reclaim(req("c2"), now=5.0)
+    assert pq2.ledger.allocations[("ns", "c2")].forced
+
+
+def test_reclaim_borrow_first_restores_borrow_over_native_fit():
+    """The durable flex-pool hint wins even when a native fit now
+    exists: the gang's pods run on the FOREIGN pool's host — seating it
+    natively would resell that host and rolling-restart the gang onto
+    a pool nobody asked it to move to."""
+    fleet = Fleet.parse("pack=v5e:4x4:1,small=v5e:2x2:1")
+    pq = PolicyQueue(fleet=fleet)
+    assert pq.reclaim(req("flex"), now=5.0, borrow_first=True,
+                      prefer_pool="pack")
+    alloc = pq.ledger.allocations[("ns", "flex")]
+    assert alloc.borrow == {"pack": 1}
+    assert pq.ledger.free_slices(fleet.by_name("small")) == 1
+    pq.ledger.assert_consistent()
+    # Without the hint a native fit wins (plain restart of a native
+    # gang) — unchanged PR 5 semantics.
+    pq2 = PolicyQueue(fleet=fleet)
+    assert pq2.reclaim(req("native"), now=5.0)
+    assert pq2.ledger.allocations[("ns", "native")].placements == \
+        {"small": 1}
+
+
+def test_rebind_fleet_reseats_borrower_onto_renamed_pool():
+    pq = PolicyQueue(fleet=Fleet.parse("pack=v5e:4x4:1"))
+    pq.ledger.admit(Allocation(
+        key=("ns", "b0"), namespace="ns", accelerator="v5e",
+        topology="2x2", num_slices=1, chips=4, placements={},
+        borrow={"pack": 1}, last_active_at=77.0))
+    pq.rebind_fleet(Fleet.parse("pack-two=v5e:4x4:1"))
+    alloc = pq.ledger.allocations[("ns", "b0")]
+    assert alloc.borrow == {"pack-two": 1}
+    assert alloc.last_active_at == 77.0
+    assert pq.ledger.borrowed == {"pack-two": 1}
+    pq.ledger.assert_consistent()
+
+
+def test_unavailable_pool_sells_nothing():
+    pq = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"))
+    pq.ledger.unavailable.add("a")
+    assert pq.ledger.fit("v5e", "4x4", 1) is None
+    assert pq.ledger.free_hosts(pq.fleet.by_name("a")) == 0
+    # An idle holder on the unavailable pool is NOT worth preempting —
+    # its release frees nothing a waiter can use.
+    pq.ledger.unavailable.clear()
+    pq.ledger.admit(Allocation(
+        key=("ns", "idle"), namespace="ns", accelerator="v5e",
+        topology="4x4", num_slices=2, chips=32, placements={"a": 2},
+        last_active_at=-10_000.0, admitted_at=-10_000.0))
+    pq.ledger.unavailable.add("a")
+    pq.submit(req("waiter", topo="4x4", slices=1))
+    result = pq.schedule(0.0)
+    assert not result.admitted and not result.preempted \
+        and not result.drains
+    pq.ledger.assert_consistent()
+
+
+# ---- integration: the full stack ----------------------------------------------
+
+
+class Stack:
+    def __init__(self, fleet_spec=None, *, elastic_on=True, defrag=True,
+                 configmap=False, grace=6.0):
+        self.kube = FakeKube()
+        register_all(self.kube)
+        self.mgr = Manager(self.kube, registry=Registry())
+        self.sched = TpuFleetScheduler(
+            self.kube,
+            SchedulerOptions(
+                queued_requeue_seconds=0.05,
+                enable_migration=True, drain_grace_seconds=grace,
+                enable_elastic=elastic_on, enable_defrag=defrag,
+                defrag_interval_seconds=0.05, defrag_idle_seconds=0.2,
+                scale_up_ttl_seconds=30.0, fleet_refresh_seconds=0.05,
+                **({"fleet_configmap": "kftpu-fleet",
+                    "controller_namespace": "kubeflow-tpu"}
+                   if configmap else {}),
+            ),
+            fleet=Fleet.parse(fleet_spec) if fleet_spec else None,
+            registry=self.mgr.registry)
+        setup_notebook_controller(self.mgr, NotebookOptions(),
+                                  scheduler=self.sched)
+        self.sim = PodSimulator(self.kube)
+        self._ack_task = None
+        self._ack_stop = [False]
+
+    async def __aenter__(self):
+        await self.mgr.start()
+        await self.sim.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        self._ack_stop[0] = True
+        if self._ack_task is not None:
+            self._ack_task.cancel()
+            try:
+                await self._ack_task
+            except BaseException:
+                pass
+        await self.sim.stop()
+        await self.mgr.stop()
+        self.kube.close_watches()
+
+    def start_sdk(self):
+        """Simulated in-pod SDK: echo-acks every drain request."""
+        async def acker():
+            while not self._ack_stop[0]:
+                try:
+                    nbs = await self.kube.list("Notebook")
+                except Exception:
+                    nbs = []
+                for nb in nbs:
+                    ann = annotations_of(nb)
+                    key = (nb["metadata"].get("namespace"),
+                           nb["metadata"]["name"])
+                    if (migration.drain_requested_at(ann) is not None
+                            and not migration.drain_acked(ann)
+                            and nbapi.STOP_ANNOTATION not in ann):
+                        try:
+                            await self.kube.patch(
+                                "Notebook", key[1],
+                                {"metadata": {"annotations":
+                                 migration.ack_patch(
+                                     f"/ckpt/{key[1]}", 123, time.time(),
+                                     for_request=ann.get(
+                                         nbapi.DRAIN_REQUESTED_ANNOTATION
+                                     ))}}, key[0])
+                        except Exception:
+                            pass
+                await asyncio.sleep(0.005)
+        self._ack_task = asyncio.create_task(acker())
+
+    async def wait_for(self, predicate, what, timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    async def spot_node(self, name, pool):
+        await self.kube.create("Node", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {
+                "cloud.google.com/gke-nodepool": pool,
+                "cloud.google.com/gke-spot": "true"}},
+        })
+
+
+async def test_spot_reclaim_checkpoints_requeues_and_readmits():
+    """The full reclaim cycle: revocation taint → checkpoint drain →
+    park → auto-re-queue with the reclaim verdict and aging credit →
+    pool closed while the signal lasts → signal clears → re-admission
+    with the restore hint in the pod env. Zero grace fallbacks (the SDK
+    acked) and zero ledger violations."""
+    async with Stack("res=v5e:4x4:1,cheap=v5e:4x4:1:spot") as s:
+        s.start_sdk()
+        await s.spot_node("cheap-node-0", "cheap")
+        for name in ("a", "b"):
+            await s.kube.create("Notebook", nbapi.new(
+                name, "ns", accelerator="v5e", topology="4x4"))
+        await s.mgr.wait_idle(timeout=20)
+        allocs = s.sched.policy.ledger.allocations
+        victim = next(k for k, v in allocs.items()
+                      if "cheap" in v.placements)
+        await s.kube.patch("Node", "cheap-node-0",
+                           {"spec": {"taints": [RECLAIM_TAINT]}})
+        await s.wait_for(lambda: victim in s.sched.policy.pending,
+                         "victim re-queued after reclaim")
+        await s.mgr.wait_idle(timeout=20)
+        nb = await s.kube.get("Notebook", victim[1], victim[0])
+        ann = annotations_of(nb)
+        # Checkpointed, un-parked, and the pool sells nothing.
+        assert nbapi.CHECKPOINT_PATH_ANNOTATION in ann
+        assert nbapi.STOP_ANNOTATION not in ann
+        assert "cheap" in s.sched.policy.ledger.unavailable
+        sched_block = deep_get(nb, "status", "scheduler")
+        assert sched_block["state"] == "Queued"
+        assert sched_block["reclaimed"] == "spot-reclaim"
+        # Aging credit: seniority from the original admission.
+        assert s.sched.policy.pending[victim].submitted_at <= \
+            allocs[("ns", "a")].admitted_at if ("ns", "a") in allocs \
+            else True
+        # Revocation completes (node replaced): the pool re-opens and
+        # the gang restores from its checkpoint.
+        await s.kube.patch("Node", "cheap-node-0",
+                           {"spec": {"taints": None}})
+        await s.wait_for(
+            lambda: victim in s.sched.policy.ledger.allocations
+            and not s.sched.policy.ledger.allocations[victim].draining,
+            "victim re-admitted")
+        await s.mgr.wait_idle(timeout=20)
+        sts = await s.kube.get_or_none("StatefulSet", victim[1],
+                                       victim[0])
+        env = deep_get(sts, "spec", "template", "spec", "containers",
+                       default=[{}])[0].get("env", [])
+        assert any(e.get("name") == migration.RESTORE_PATH_ENV
+                   for e in env)
+        assert s.sched.m_drain_fallback.labels().value == 0
+        assert s.sched.policy.ledger.violations == 0
+        s.sched.policy.ledger.assert_consistent()
+
+
+async def test_spot_reclaim_grace_fallback_for_ackless_victim():
+    """No SDK ack → the drain-grace hard stop fires, chips free, and the
+    gang still re-queues (never lost, never holding the pool hostage)."""
+    async with Stack("cheap=v5e:4x4:1:spot", grace=1.0) as s:
+        await s.spot_node("cheap-node-0", "cheap")
+        await s.kube.create("Notebook", nbapi.new(
+            "mute", "ns", accelerator="v5e", topology="4x4"))
+        await s.mgr.wait_idle(timeout=20)
+        assert ("ns", "mute") in s.sched.policy.ledger.allocations
+        await s.kube.patch("Node", "cheap-node-0",
+                           {"spec": {"taints": [RECLAIM_TAINT]}})
+        await s.wait_for(
+            lambda: s.sched.m_drain_fallback.labels().value >= 1,
+            "grace fallback")
+        await s.wait_for(lambda: ("ns", "mute") in s.sched.policy.pending,
+                         "ack-less victim re-queued")
+        await s.mgr.wait_idle(timeout=20)
+        assert s.sched.policy.ledger.violations == 0
+
+
+async def test_restart_mid_elastic_park_still_requeues():
+    """The auto-requeue must survive a manager crash between the park
+    and the un-park: the durable Preempted=spot-reclaim annotation is
+    enough to finish the migration after a restart."""
+    async with Stack("cheap=v5e:4x4:1:spot") as s:
+        # The CR as a crashed manager left it: parked by a spot-reclaim
+        # finalize, checkpoint kept — and this Stack's scheduler has no
+        # memory of any of it.
+        nb = nbapi.new("orphan", "ns", accelerator="v5e",
+                       topology="4x4")
+        nb["metadata"]["annotations"] = {
+            nbapi.STOP_ANNOTATION: "2026-01-01T00:00:00Z",
+            nbapi.PREEMPTED_ANNOTATION: "spot-reclaim",
+            nbapi.DRAIN_REASON_ANNOTATION: "spot-reclaim",
+            nbapi.CHECKPOINT_PATH_ANNOTATION: "/ckpt/orphan",
+            nbapi.CHECKPOINT_STEP_ANNOTATION: "41",
+            nbapi.CHECKPOINTED_AT_ANNOTATION: "2026-01-01T00:00:00Z",
+        }
+        await s.kube.create("Notebook", nb)
+        await s.wait_for(
+            lambda: ("ns", "orphan") in s.sched.policy.ledger.allocations
+            or ("ns", "orphan") in s.sched.policy.pending,
+            "orphaned elastic park re-queued after restart")
+        await s.mgr.wait_idle(timeout=20)
+        live = await s.kube.get("Notebook", "orphan", "ns")
+        assert nbapi.STOP_ANNOTATION not in annotations_of(live)
+        s.sched.policy.ledger.assert_consistent()
+
+
+async def test_retried_park_stamp_keeps_auto_resume():
+    """A failed first stop patch retries with a NEW stamp — the
+    recorded auto-resume stamp must follow it, or the un-park guard
+    mistakes the scheduler's own retried park for a user stop."""
+    async with Stack("cheap=v5e:4x4:1:spot") as s:
+        await s.kube.create("Notebook", nbapi.new(
+            "nb", "ns", accelerator="v5e", topology="4x4"))
+        await s.mgr.wait_idle(timeout=20)
+        key = ("ns", "nb")
+        s.sched._auto_resume[key] = ("spot-reclaim",
+                                     "2026-01-01T00:00:00Z")
+        s.sched._stop_pending[key] = "spot-reclaim"
+        out = await s.sched._retry_stop(key, 1_700_000_000.0)
+        assert out.state == "Preempted"
+        reason, stamp = s.sched._auto_resume[key]
+        live = await s.kube.get("Notebook", "nb", "ns")
+        assert annotations_of(live)[nbapi.STOP_ANNOTATION] == stamp
+        s.sched._auto_resume.pop(key, None)  # don't leak into teardown
+
+
+async def test_user_stop_during_elastic_park_is_not_reverted():
+    """A user stop landing between the reclaim park and the release
+    reconcile must WIN: the auto-resume un-park only clears the stop
+    stamp the scheduler itself wrote."""
+    async with Stack("cheap=v5e:4x4:1:spot") as s:
+        await s.kube.create("Notebook", nbapi.new(
+            "nb", "ns", accelerator="v5e", topology="4x4"))
+        await s.mgr.wait_idle(timeout=20)
+        key = ("ns", "nb")
+        # Simulate the park the finalize stamps, then the user's own
+        # stop racing in with a different value before release() runs.
+        s.sched._auto_resume[key] = ("spot-reclaim",
+                                     "2026-01-01T00:00:00Z")
+        s.sched._reclaim_verdict[key] = "spot-reclaim"
+        s.sched._requeue_credit[key] = 0.0
+        user_stop = "2026-02-02T00:00:00Z"
+        await s.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {
+                nbapi.STOP_ANNOTATION: user_stop}}}, "ns")
+        await s.mgr.wait_idle(timeout=20)
+        nb = await s.kube.get("Notebook", "nb", "ns")
+        assert annotations_of(nb).get(nbapi.STOP_ANNOTATION) == user_stop
+        assert key not in s.sched._auto_resume
+        assert key not in s.sched.policy.pending   # stays parked
+
+
+async def test_scale_up_intent_roundtrip_grant_and_deny():
+    """A never-fits gang raises one ProvisioningRequest-shaped intent;
+    denial marks it (and events) without dropping the demand; a grant
+    through the fleet ConfigMap admits the gang and withdraws the intent
+    as granted (CR deleted)."""
+    async with Stack(configmap=True) as s:
+        await s.kube.create("ConfigMap", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "kftpu-fleet",
+                         "namespace": "kubeflow-tpu"},
+            "data": {"fleet": "pool-a=v5e:4x4:1"},
+        })
+        await s.kube.create("Notebook", nbapi.new(
+            "needs-three", "ns", accelerator="v5e", topology="4x4",
+            num_slices=3))
+        await s.wait_for(lambda: s.sched._intent_book.intents,
+                         "scale-up intent")
+        intent = next(iter(s.sched._intent_book.intents.values()))
+        assert intent.name == "pool-scale-up-v5e-4x4"
+        pr = await s.kube.get_or_none("ProvisioningRequest", intent.name,
+                                      "kubeflow-tpu")
+        assert pr is not None
+        assert deep_get(pr, "spec", "provisioningClassName") == \
+            "queued-provisioning.gke.io"
+        # The queued gang's status carries the scale-up wait.
+        await s.mgr.wait_idle(timeout=20)
+        nb = await s.kube.get("Notebook", "needs-three", "ns")
+        block = deep_get(nb, "status", "scheduler")
+        assert block["state"] == "Queued"
+        assert block["scaleUp"]["chips"] == intent.chips
+        # Denial: the intent stays (demand is real) but is marked.
+        await s.kube.patch(
+            "ProvisioningRequest", intent.name,
+            {"status": {"conditions": [{
+                "type": "Failed", "status": "True",
+                "reason": "QuotaExhausted", "message": "no capacity"}]}},
+            "kubeflow-tpu", subresource="status")
+        await s.wait_for(lambda: intent.denied, "denial noticed")
+        events = await s.kube.list("Event", "ns")
+        assert any(e.get("reason") == "ScaleUpDenied" for e in events)
+        # The TTL re-asserts a denied ask: fresh CR without the Failed
+        # condition, denial detection re-armed.
+        s.sched._intent_book.ttl = 0.2
+        intent.expires_at = time.time() + 0.2
+        await s.wait_for(lambda: not intent.denied,
+                         "denied intent re-asserted on TTL")
+        pr = await s.kube.get_or_none("ProvisioningRequest", intent.name,
+                                      "kubeflow-tpu")
+        assert pr is not None
+        assert not deep_get(pr, "status", "conditions", default=[])
+        # Grant: the operator grows the pool; the dynamic source
+        # reflects it and the gang admits.
+        await s.kube.patch(
+            "ConfigMap", "kftpu-fleet",
+            {"data": {"fleet": "pool-a=v5e:4x4:3"}}, "kubeflow-tpu")
+        await s.wait_for(
+            lambda: ("ns", "needs-three")
+            in s.sched.policy.ledger.allocations,
+            "admission against granted capacity")
+        await s.wait_for(lambda: not s.sched._intent_book.intents,
+                         "intent withdrawn")
+        assert s.sched.m_scale_up_events.labels(
+            event="granted").value >= 1
+        await s.mgr.wait_idle(timeout=20)
+        assert await s.kube.get_or_none(
+            "ProvisioningRequest", intent.name, "kubeflow-tpu") is None
+        s.sched.policy.ledger.assert_consistent()
+
+
+async def test_defrag_migrates_borrowers_and_admits_the_wedged_gang():
+    """The ISSUE wedge: 4-chip gangs borrow big-pool hosts; a 16-chip
+    gang starves until the defragmenter drains the idle borrowers
+    (reason=defrag) to their pack pool; everyone ends up admitted."""
+    async with Stack("pack=v5e:4x4:2,small=v5e:2x2:2") as s:
+        s.start_sdk()
+        for i in range(2):
+            await s.kube.create("Notebook", nbapi.new(
+                f"native-{i}", "ns", accelerator="v5e", topology="2x2"))
+        await s.mgr.wait_idle(timeout=20)
+        for i in range(4):
+            await s.kube.create("Notebook", nbapi.new(
+                f"wedge-{i}", "ns", accelerator="v5e", topology="2x2"))
+        await s.mgr.wait_idle(timeout=20)
+        assert s.sched.policy.ledger.borrowed == {"pack": 4}
+        await s.kube.create("Notebook", nbapi.new(
+            "big16", "ns", accelerator="v5e", topology="4x4"))
+        await s.mgr.wait_idle(timeout=20)
+        assert ("ns", "big16") in s.sched.policy.pending
+        # Natives complete → pack homes open; borrowers go idle.
+        for i in range(2):
+            await s.kube.patch(
+                "Notebook", f"native-{i}",
+                {"metadata": {"annotations": {
+                    nbapi.STOP_ANNOTATION: fmt_iso(time.time())}}}, "ns")
+        for i in range(4):
+            await s.kube.patch(
+                "Notebook", f"wedge-{i}",
+                {"metadata": {"annotations": {
+                    nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(
+                        time.time() - 3600)}}}, "ns")
+        await s.wait_for(
+            lambda: ("ns", "big16") in s.sched.policy.ledger.allocations
+            and not s.sched.policy.ledger.allocations[
+                ("ns", "big16")].draining,
+            "wedged gang admitted via defrag")
+        await s.mgr.wait_idle(timeout=20)
+        assert s.sched._defrag_moves >= 2
+        # Every migrated borrower landed (or is queued) — none lost, and
+        # the drain went through the protocol (checkpoint kept).
+        for i in range(4):
+            key = ("ns", f"wedge-{i}")
+            nb = await s.kube.get("Notebook", key[1], key[0])
+            assert nbapi.STOP_ANNOTATION not in annotations_of(nb)
+            assert key in s.sched.policy.ledger.allocations \
+                or key in s.sched.policy.pending
+        assert s.sched.m_drain_fallback.labels().value == 0
+        assert s.sched.policy.ledger.violations == 0
+        s.sched.policy.ledger.assert_consistent()
+
+
+async def test_defrag_off_leaves_the_wedge_starved():
+    """KFTPU_DEFRAG=off semantics: identical wedge, no migrations — the
+    large gang stays queued (the defragmenter is the only remedy)."""
+    async with Stack("pack=v5e:4x4:2,small=v5e:2x2:2",
+                     defrag=False) as s:
+        s.start_sdk()
+        for i in range(2):
+            await s.kube.create("Notebook", nbapi.new(
+                f"native-{i}", "ns", accelerator="v5e", topology="2x2"))
+        await s.mgr.wait_idle(timeout=20)
+        for i in range(4):
+            await s.kube.create("Notebook", nbapi.new(
+                f"wedge-{i}", "ns", accelerator="v5e", topology="2x2"))
+        await s.mgr.wait_idle(timeout=20)
+        assert s.sched.policy.ledger.borrowed == {"pack": 4}
+        await s.kube.create("Notebook", nbapi.new(
+            "big16", "ns", accelerator="v5e", topology="4x4"))
+        for i in range(2):
+            await s.kube.patch(
+                "Notebook", f"native-{i}",
+                {"metadata": {"annotations": {
+                    nbapi.STOP_ANNOTATION: fmt_iso(time.time())}}}, "ns")
+        for i in range(4):
+            await s.kube.patch(
+                "Notebook", f"wedge-{i}",
+                {"metadata": {"annotations": {
+                    nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(
+                        time.time() - 3600)}}}, "ns")
+        await asyncio.sleep(1.0)
+        await s.mgr.wait_idle(timeout=20)
+        assert ("ns", "big16") in s.sched.policy.pending
+        assert s.sched._defrag_moves == 0
+
+
+async def test_elastic_off_restores_pr5_behavior_byte_for_byte():
+    """The KFTPU_ELASTIC=off kill switch: the same cluster state drives
+    ZERO elastic behavior — no borrows, no intents, no
+    ProvisioningRequest writes, spot taints ignored, the status block
+    carries no elastic keys — i.e. exactly the PR 5–7 scheduler."""
+    async with Stack("pack=v5e:4x4:1,cheap=v5e:2x2:1:spot",
+                     elastic_on=False) as s:
+        await s.spot_node("cheap-node-0", "cheap")
+        # A flexible single-host gang beyond its shape's pools: PR 5
+        # queues it forever (no borrowing).
+        await s.kube.create("Notebook", nbapi.new(
+            "native", "ns", accelerator="v5e", topology="2x2"))
+        await s.kube.create("Notebook", nbapi.new(
+            "over", "ns", accelerator="v5e", topology="2x2"))
+        # A never-fits gang: PR 5 queues it with the ceiling reason —
+        # no scale-up intent.
+        await s.kube.create("Notebook", nbapi.new(
+            "huge", "ns", accelerator="v5e", topology="4x4",
+            num_slices=5))
+        await s.mgr.wait_idle(timeout=20)
+        # Spot revocation signal: ignored entirely with elastic off.
+        await s.kube.patch("Node", "cheap-node-0",
+                           {"spec": {"taints": [RECLAIM_TAINT]}})
+        await asyncio.sleep(0.3)
+        await s.mgr.wait_idle(timeout=20)
+        ledger = s.sched.policy.ledger
+        assert ("ns", "native") in ledger.allocations
+        assert ("ns", "over") in s.sched.policy.pending
+        assert ("ns", "huge") in s.sched.policy.pending
+        assert ledger.borrowed == {}
+        assert ledger.unavailable == set()
+        assert s.sched._intent_book is None
+        assert s.sched._spot_reclaims == {}
+        assert s.sched._draining == {}
+        # No elastic API traffic: zero ProvisioningRequest writes, zero
+        # drain annotations anywhere.
+        assert not any(
+            e["kind"] == "ProvisioningRequest"
+            for e in s.kube.request_log
+            if e["verb"] in FakeKube.WRITE_VERBS)
+        for name in ("native", "over", "huge"):
+            nb = await s.kube.get("Notebook", name, "ns")
+            ann = annotations_of(nb)
+            assert nbapi.DRAIN_REQUESTED_ANNOTATION not in ann
+            block = deep_get(nb, "status", "scheduler") or {}
+            assert "reclaimed" not in block and "scaleUp" not in block
+        # The debug payload says so, in one glance.
+        dbg = s.sched.debug_info()
+        assert dbg["elastic"]["enabled"] is False
+        assert dbg["elastic"]["scale_up_intents"] == []
+
+
+async def test_flex_gang_pods_target_the_host_pools_nodes():
+    """A borrow-placed gang's StatefulSet must select the HOST pool's
+    GKE shape labels (its own shape has no nodes — that's why it
+    borrowed), with its own chip request (sub-host allocation)."""
+    async with Stack("pack=v5e:4x4:1,small=v5e:2x2:1") as s:
+        await s.kube.create("Notebook", nbapi.new(
+            "native", "ns", accelerator="v5e", topology="2x2"))
+        await s.mgr.wait_idle(timeout=20)
+        await s.kube.create("Notebook", nbapi.new(
+            "borrower", "ns", accelerator="v5e", topology="2x2"))
+        await s.mgr.wait_idle(timeout=20)
+        assert s.sched.policy.ledger.borrowed == {"pack": 1}
+        sts = await s.kube.get("StatefulSet", "borrower", "ns")
+        sel = deep_get(sts, "spec", "template", "spec", "nodeSelector")
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+        chips = deep_get(sts, "spec", "template", "spec", "containers",
+                         default=[{}])[0]["resources"]["requests"]
+        assert chips["google.com/tpu"] == "4"   # the gang's own chips
+        # The NATIVE gang keeps its own selectors untouched.
+        sts = await s.kube.get("StatefulSet", "native", "ns")
+        sel = deep_get(sts, "spec", "template", "spec", "nodeSelector")
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+
+
+async def test_idle_borrower_evicted_for_flex_waiter():
+    """Idle borrowers must not squat hosts forever against a same-shape
+    waiter: the runtime drains the idlest one (reason=idle, parks like
+    any idle preemption — no auto-requeue) and seats the waiter."""
+    async with Stack("pack=v5e:4x4:1") as s:
+        s.start_sdk()
+        for i in range(2):
+            await s.kube.create("Notebook", nbapi.new(
+                f"squatter-{i}", "ns", accelerator="v5e",
+                topology="2x2"))
+        await s.mgr.wait_idle(timeout=20)
+        assert s.sched.policy.ledger.borrowed == {"pack": 2}
+        for i in range(2):
+            await s.kube.patch(
+                "Notebook", f"squatter-{i}",
+                {"metadata": {"annotations": {
+                    nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(
+                        time.time() - 3600)}}}, "ns")
+        # Let the idle window elapse past idle_preempt_after (shrunk).
+        s.sched.options.idle_preempt_after_seconds = 0.2
+        await asyncio.sleep(0.25)
+        await s.kube.create("Notebook", nbapi.new(
+            "waiter", "ns", accelerator="v5e", topology="2x2"))
+        await s.wait_for(
+            lambda: ("ns", "waiter") in s.sched.policy.ledger.allocations,
+            "waiter seated after idle-borrower eviction")
+        await s.mgr.wait_idle(timeout=20)
+        stopped = 0
+        for i in range(2):
+            nb = await s.kube.get("Notebook", f"squatter-{i}", "ns")
+            if nbapi.STOP_ANNOTATION in annotations_of(nb):
+                stopped += 1
+                assert annotations_of(nb).get(
+                    nbapi.PREEMPTED_ANNOTATION) == "idle"
+                assert nbapi.CHECKPOINT_PATH_ANNOTATION in \
+                    annotations_of(nb)
+        assert stopped == 1    # exactly one eviction, no double-kill
+        assert s.sched.policy.ledger.violations == 0
+        s.sched.policy.ledger.assert_consistent()
+
+
+async def test_reclaim_signal_before_fleet_activation_is_recovered():
+    """A revocation taint dispatched by the Node informer's initial sync
+    BEFORE the (dynamic) fleet loads must not be lost: activation
+    re-scans the cached nodes and starts the reclaim."""
+    async with Stack(configmap=True) as s:
+        s.start_sdk()
+        # Taint exists BEFORE the fleet ConfigMap: the node handler maps
+        # it over an empty fleet and drops it.
+        await s.spot_node("cheap-node-0", "cheap")
+        await s.kube.patch("Node", "cheap-node-0",
+                           {"spec": {"taints": [RECLAIM_TAINT]}})
+        await asyncio.sleep(0.1)
+        await s.kube.create("ConfigMap", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "kftpu-fleet",
+                         "namespace": "kubeflow-tpu"},
+            "data": {"fleet": "cheap=v5e:4x4:1:spot"},
+        })
+        await s.kube.create("Notebook", nbapi.new(
+            "nb", "ns", accelerator="v5e", topology="4x4"))
+        # Activation re-scan finds the pre-existing taint: the pool is
+        # reclaiming, so the gang queues instead of landing on it.
+        await s.wait_for(
+            lambda: "cheap" in s.sched._spot_reclaims,
+            "reclaim recovered at fleet activation")
+        await s.mgr.wait_idle(timeout=20)
+        assert "cheap" in s.sched.policy.ledger.unavailable
+        assert ("ns", "nb") in s.sched.policy.pending
+
+
+async def test_stray_scale_up_pr_is_janitored_after_restart():
+    """Intents are in-memory: a controller restart can orphan a
+    pool-scale-up CR whose demand died with the old process. The
+    janitor sweeps OURS (by the scale-up label) — and never a user
+    notebook's capacity PR, even under a colliding name prefix."""
+    async with Stack("a=v5e:4x4:1", configmap=False) as s:
+        stray = {
+            "apiVersion": "autoscaling.x-k8s.io/v1beta1",
+            "kind": "ProvisioningRequest",
+            "metadata": {
+                "name": "pool-scale-up-v5p-2x2x1",
+                "namespace": "kubeflow-tpu",
+                "labels": {"tpu.kubeflow.org/scale-up-accelerator":
+                           "v5p"},
+            },
+            "spec": {},
+        }
+        await s.kube.create("ProvisioningRequest", stray, "kubeflow-tpu")
+        bystander = {
+            "apiVersion": "autoscaling.x-k8s.io/v1beta1",
+            "kind": "ProvisioningRequest",
+            "metadata": {"name": "pool-scale-up-x-capacity",
+                         "namespace": "kubeflow-tpu",
+                         "labels": {"notebook-name": "pool-scale-up-x"}},
+            "spec": {},
+        }
+        await s.kube.create("ProvisioningRequest", bystander,
+                            "kubeflow-tpu")
+        # Any admission pass with an empty book triggers the sweep.
+        await s.kube.create("Notebook", nbapi.new(
+            "nb", "ns", accelerator="v5e", topology="4x4"))
+        await s.wait_for(
+            lambda: True, "reconcile")  # let the pass run
+        await s.mgr.wait_idle(timeout=20)
+        assert await s.kube.get_or_none(
+            "ProvisioningRequest", "pool-scale-up-v5p-2x2x1",
+            "kubeflow-tpu") is None
+        assert await s.kube.get_or_none(
+            "ProvisioningRequest", "pool-scale-up-x-capacity",
+            "kubeflow-tpu") is not None
+
+
+async def test_envconfig_reads_elastic_knobs(monkeypatch):
+    from kubeflow_tpu.cmd.envconfig import scheduler_options
+
+    monkeypatch.setenv("KFTPU_ELASTIC", "off")
+    monkeypatch.setenv("KFTPU_DEFRAG", "off")
+    opts = scheduler_options()
+    assert opts.enable_elastic is False and opts.enable_defrag is False
+    monkeypatch.setenv("KFTPU_ELASTIC", "on")
+    monkeypatch.delenv("KFTPU_DEFRAG")
+    monkeypatch.setenv("KFTPU_SCALE_UP_TTL", "42")
+    monkeypatch.setenv("KFTPU_DEFRAG_IDLE_SECONDS", "33")
+    monkeypatch.setenv("KFTPU_FLEET_REFRESH_SECONDS", "7")
+    opts = scheduler_options()
+    assert opts.enable_elastic and opts.enable_defrag
+    assert opts.scale_up_ttl_seconds == 42.0
+    assert opts.defrag_idle_seconds == 33.0
+    assert opts.fleet_refresh_seconds == 7.0
+
+
+async def test_fault_plan_spot_reclaim_schedule_is_deterministic():
+    def draw(seed):
+        plan = FaultPlan(seed=seed)
+        plan.reclaim_spot(rate=0.5)
+        return [plan.should_reclaim_spot("cheap") for _ in range(32)]
+
+    assert draw(3) == draw(3)
+    assert draw(3) != draw(4)
+    plan = FaultPlan(seed=3)
+    plan.reclaim_spot(pools="cheap", every=2)
+    hits = [plan.should_reclaim_spot(p)
+            for p in ("cheap", "cheap", "other", "cheap")]
+    assert hits == [False, True, False, False]
+    assert plan.injected["spot_reclaim"] == 1

@@ -325,6 +325,57 @@ def test_status_state_machine_pure():
     assert filter_events(nb, [fresh]) == [fresh]
 
 
+def test_status_elastic_fleet_messages():
+    """Elastic-fleet JWA surface (ISSUE 10): spot-reclaim re-queue,
+    pack-pool migration, and pool scale-up waits each get a message the
+    user can act on, outranking the generic queue position."""
+    nb = nbapi.new("x", "ns")
+    nb["metadata"]["creationTimestamp"] = "2020-01-01T00:00:00Z"
+    # Reclaimed from spot capacity, checkpoint saved, back in line.
+    nb["status"] = {
+        "scheduler": {"state": "Queued", "position": 2,
+                      "waitingChips": 16, "reclaimed": "spot-reclaim"},
+        "migration": {"state": "Running", "checkpointStep": 700,
+                      "checkpointedAt": "t"},
+    }
+    s = process_status(nb)
+    assert s.phase == "waiting"
+    assert s.message == ("Reclaimed from spot capacity (checkpoint @ "
+                         "step 700, re-queued at position 2)")
+    # No step recorded → still actionable.
+    del nb["status"]["migration"]["checkpointStep"]
+    assert "checkpoint saved" in process_status(nb).message
+    # Defrag re-queue.
+    nb["status"]["scheduler"] = {"state": "Queued", "position": 1,
+                                 "reclaimed": "defrag"}
+    s = process_status(nb)
+    assert s.phase == "waiting"
+    assert s.message == "Migrating to pack pool (re-queued at position 1)"
+    # Defrag drain in flight.
+    nb["status"]["scheduler"] = {"state": "Draining", "reason": "defrag"}
+    assert process_status(nb).message == \
+        "Migrating to pack pool (checkpointing)…"
+    # Spot drain in flight.
+    nb["status"]["scheduler"] = {"state": "Draining",
+                                 "reason": "spot-reclaim"}
+    assert process_status(nb).message == \
+        "Checkpointing before spot capacity is reclaimed…"
+    # Waiting on a pool scale-up intent.
+    nb["status"]["scheduler"] = {
+        "state": "Queued", "position": 1, "waitingChips": 48,
+        "scaleUp": {"chips": 48, "pendingSeconds": 12.4},
+    }
+    s = process_status(nb)
+    assert s.phase == "waiting"
+    assert s.message == ("Waiting for pool scale-up (48 chips "
+                         "requested, intent pending 12s)")
+    # Plain queue without elastic markers: the PR 5 message, unchanged.
+    nb["status"]["scheduler"] = {"state": "Queued", "position": 3,
+                                 "waitingChips": 32}
+    assert process_status(nb).message == \
+        "Queued for TPU capacity (position 3, waiting for 32 chips)"
+
+
 async def test_spa_served_with_csrf_cookie():
     from kubeflow_tpu.web.dashboard import create_app as create_dash
 
