@@ -102,6 +102,83 @@ DEGRADED_RE = re.compile(r"['\"]Degraded['\"]")
 QUARANTINE_CALL_RE = re.compile(r"queue\.quarantine\(")
 
 
+# Serving contract (ISSUE 11): the InferenceService controller must
+# register the serving phases (autoscale/warm_restore/park) and the
+# engine its serve span, so scaling decisions and the serve loop land in
+# /debug/traces — and scale-to-zero must route through the park drain
+# (_drain_to_park → checkpoint ack or grace → _park_all), never a bare
+# replicas-0 stop: a refactor that parks without the checkpoint request
+# would silently turn warm standbys into cold starts and lose the
+# engine's state on every idle window. The policy layer must keep the
+# workload-class guard that excludes serving replicas from the victim
+# search (no activity signal ⇒ "idle forever" ⇒ the service would be
+# preempted precisely under load).
+SERVING_CONTROLLER = os.path.join(
+    REPO, "kubeflow_tpu", "serving", "controller.py")
+SERVING_ENGINE = os.path.join(REPO, "kubeflow_tpu", "serving", "engine.py")
+SERVING_PHASES = ("autoscale", "warm_restore", "park")
+DRAIN_TO_PARK_CALL_RE = re.compile(r"await self\._drain_to_park\(")
+PARK_ALL_CALL_RE = re.compile(r"await self\._park_all\(")
+WORKLOAD_GUARD_RE = re.compile(
+    r"workload\s*!=\s*['\"]notebook['\"]")
+
+
+def check_serving() -> list[str]:
+    problems = []
+    rel_ctl = os.path.relpath(SERVING_CONTROLLER, REPO)
+    try:
+        src = open(SERVING_CONTROLLER).read()
+    except OSError:
+        return [f"{rel_ctl}: missing — the serving workload class "
+                "(ISSUE 11) lost its controller"]
+    phases = set(SPAN_RE.findall(src))
+    for phase in SERVING_PHASES:
+        if phase not in phases:
+            problems.append(
+                f"{rel_ctl}: missing the `{phase}` serving phase span — "
+                "autoscaling/park/restore decisions must land in "
+                "/debug/traces")
+    if not DRAIN_TO_PARK_CALL_RE.search(src) \
+            or "def _drain_to_park" not in src:
+        problems.append(
+            f"{rel_ctl}: scale-to-zero no longer routes through "
+            "_drain_to_park — parking without a checkpoint request is a "
+            "bare-stop bypass of the drain protocol for serving replicas")
+    else:
+        drain_body = src.split("def _drain_to_park", 1)[1]
+        drain_body = drain_body.split("\n    async def ", 1)[0]
+        if "park_acked" not in drain_body \
+                or "park_grace_seconds" not in drain_body:
+            problems.append(
+                f"{rel_ctl}: _drain_to_park no longer waits for the "
+                "checkpoint ack (or the grace deadline) before parking")
+        park_calls = PARK_ALL_CALL_RE.findall(src)
+        if len(park_calls) != 1 or "_park_all" not in drain_body:
+            problems.append(
+                f"{rel_ctl}: _park_all must be called exactly once, from "
+                "_drain_to_park — any other caller is a bare-stop bypass "
+                "of the park drain")
+    rel_eng = os.path.relpath(SERVING_ENGINE, REPO)
+    try:
+        eng_src = open(SERVING_ENGINE).read()
+    except OSError:
+        return problems + [f"{rel_eng}: missing"]
+    if "serve" not in set(SPAN_RE.findall(eng_src)):
+        problems.append(
+            f"{rel_eng}: missing the `serve` span — the serving loop "
+            "must land in /debug/traces")
+    try:
+        policy_src = open(POLICY_FILE).read()
+    except OSError:
+        policy_src = ""
+    if not WORKLOAD_GUARD_RE.search(policy_src):
+        problems.append(
+            f"{os.path.relpath(POLICY_FILE, REPO)}: the workload-class "
+            "guard is gone from the victim search — serving replicas "
+            "(no activity signal) would be preempted as idle notebooks")
+    return problems
+
+
 def check_quarantine() -> list[str]:
     problems = []
     rel_mgr = os.path.relpath(MANAGER_FILE, REPO)
@@ -301,6 +378,7 @@ def main() -> int:
     problems.extend(check_migration())
     problems.extend(check_quarantine())
     problems.extend(check_elastic())
+    problems.extend(check_serving())
     for p in problems:
         print(f"check_tracing: {p}", file=sys.stderr)
     if not problems:

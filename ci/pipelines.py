@@ -123,6 +123,12 @@ def workflow_tests() -> dict:
                         "1 on gate failure)",
                         "python bench.py elastic_fleet --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("Inference-serving smoke bench (open-loop "
+                        "tokens/sec + p99, warm standby vs cold start, "
+                        "serving/notebook admission collision; exit 1 "
+                        "on gate failure)",
+                        "python bench.py inference_serving --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

@@ -146,6 +146,15 @@ async def amain() -> None:
     setup_profile_controller(mgr, envconfig.profile_options())
     setup_tensorboard_controller(mgr, envconfig.tensorboard_options())
     setup_pvcviewer_controller(mgr, envconfig.pvcviewer_options())
+    serving = envconfig.serving_options()
+    if serving.enabled:
+        # Serving workload class (KFTPU_SERVING, default on): the
+        # InferenceService controller shares the notebook controller's
+        # fleet scheduler — one chip ledger for both workload classes.
+        from kubeflow_tpu.serving.controller import setup_serving_controller
+
+        setup_serving_controller(
+            mgr, serving, scheduler=getattr(mgr, "scheduler", None))
 
     health = await serve_health_and_metrics(
         int(os.environ.get("METRICS_PORT", "8080")), mgr

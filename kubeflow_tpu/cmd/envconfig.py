@@ -131,6 +131,42 @@ def scheduler_options():
     )
 
 
+def serving_options():
+    """Inference-serving env contract (docs/operations.md "Inference
+    serving"). The master switch is KFTPU_SERVING (default on), read by
+    kubeflow_tpu.serving.serving_enabled; the ServingOptions dataclass
+    default is off so bare construction keeps the notebook-only control
+    plane byte-for-byte."""
+    from kubeflow_tpu.migration import protocol as migration
+    from kubeflow_tpu.serving import serving_enabled
+    from kubeflow_tpu.serving.controller import ServingOptions
+
+    return ServingOptions(
+        enabled=serving_enabled(),
+        cluster_domain=env_str("CLUSTER_DOMAIN", "cluster.local"),
+        controller_namespace=controller_namespace(),
+        serving_port=int(env_float("KFTPU_SERVING_PORT", 8000)),
+        # "low"|"normal"|"high"|"critical" or an int; default high — a
+        # serving burst preempts idle notebooks, never the reverse.
+        priority=_serving_priority(),
+        autoscale_period_seconds=env_float(
+            "KFTPU_SERVING_AUTOSCALE_PERIOD", 5.0),
+        # The park drain rides the migration grace knob by default.
+        park_grace_seconds=env_float(
+            "KFTPU_SERVING_PARK_GRACE", migration.drain_grace_seconds()),
+        default_target_rate=env_float("KFTPU_SERVING_TARGET_RATE", 8.0),
+        default_idle_window=env_float("KFTPU_SERVING_IDLE_WINDOW", 300.0),
+        default_stabilization=env_float(
+            "KFTPU_SERVING_STABILIZATION", 60.0),
+    )
+
+
+def _serving_priority() -> int:
+    from kubeflow_tpu.scheduler import parse_priority
+
+    return parse_priority(env_str("KFTPU_SERVING_PRIORITY", "high"))
+
+
 def culling_options():
     from kubeflow_tpu.controllers.culling import CullingOptions
     from kubeflow_tpu.migration import protocol as migration

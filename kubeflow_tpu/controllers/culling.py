@@ -162,6 +162,14 @@ class CullingReconciler:
             nb = await self.kube.get_or_none("Notebook", name, ns)
         if nb is None or get_meta(nb).get("deletionTimestamp"):
             return None
+        if _is_serving_workload(nb):
+            # Workload-class guard (kubeflow_tpu/serving): a serving
+            # workload exposes no Jupyter kernels, so every probe below
+            # would read "idle forever" and the culler would stop the
+            # service precisely when it is busiest. Serving capacity is
+            # the InferenceService autoscaler's to reclaim (scale-to-
+            # zero after ITS idle window), never the culler's.
+            return None
         if nbapi.is_stopped(nb):
             return None  # already parked; notebook reconciler owns restart
 
@@ -356,6 +364,13 @@ class CullingReconciler:
                 checkpoint_step=step):
             return Result(requeue_after=self.opts.check_period_seconds)
         return None
+
+
+def _is_serving_workload(nb: dict) -> bool:
+    """The culler's workload-class guard (see reconcile)."""
+    from kubeflow_tpu.api import inferenceservice as isvcapi
+
+    return isvcapi.is_serving_class(nb)
 
 
 def _fold_activity(kernels: list, terminals: list) -> tuple[bool, float | None]:

@@ -59,7 +59,13 @@ from kubeflow_tpu.runtime.informer import (
     index_by_label,
     index_by_namespace,
 )
-from kubeflow_tpu.runtime.manager import Controller, Manager, Result, Watch
+from kubeflow_tpu.runtime.manager import (
+    Controller,
+    Manager,
+    Result,
+    Watch,
+    soonest,
+)
 from kubeflow_tpu.runtime.metrics import Registry, global_registry
 from kubeflow_tpu.runtime.objects import (
     annotations_of,
@@ -1883,18 +1889,7 @@ class NotebookReconciler:
         self.m_chips.labels(namespace=ns or "").set(totals[1])
 
 
-def _soonest(*results) -> Result | None:
-    """The Result that reconciles first (smallest positive requeue_after);
-    None only when every input is None."""
-    best = None
-    for r in results:
-        if r is None or not getattr(r, "requeue_after", 0):
-            continue
-        if best is None or r.requeue_after < best.requeue_after:
-            best = r
-    if best is None:
-        return next((r for r in results if r is not None), None)
-    return best
+_soonest = soonest  # shared helper (runtime/manager.py), old local name
 
 
 def _main_container_name(nb: dict) -> str:

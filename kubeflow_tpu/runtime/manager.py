@@ -79,6 +79,22 @@ class Result:
     requeue_after: float | None = None
 
 
+def soonest(*results) -> "Result | None":
+    """The Result that reconciles first (smallest positive
+    requeue_after); None only when every input is None. Shared by the
+    notebook and serving reconcilers — a drain/park grace deadline must
+    not be deferred behind a longer periodic requeue (or vice versa)."""
+    best = None
+    for r in results:
+        if r is None or not getattr(r, "requeue_after", 0):
+            continue
+        if best is None or r.requeue_after < best.requeue_after:
+            best = r
+    if best is None:
+        return next((r for r in results if r is not None), None)
+    return best
+
+
 @dataclass
 class Watch:
     kind: str

@@ -89,6 +89,7 @@ _CORE = [
     GVK("security.istio.io", "v1beta1", "AuthorizationPolicy", "authorizationpolicies"),
     # Our CRDs (kubeflow.org group for drop-in familiarity)
     GVK("kubeflow.org", "v1", "Notebook", "notebooks"),
+    GVK("kubeflow.org", "v1", "InferenceService", "inferenceservices"),
     GVK("kubeflow.org", "v1", "Profile", "profiles", namespaced=False),
     GVK("kubeflow.org", "v1alpha1", "PodDefault", "poddefaults"),
     GVK("tensorboard.kubeflow.org", "v1alpha1", "Tensorboard", "tensorboards"),

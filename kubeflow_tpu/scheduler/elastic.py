@@ -195,7 +195,7 @@ def overflow_pass(policy: PolicyQueue, now: float) -> list:
             accelerator=req.accelerator, topology=req.topology,
             num_slices=req.num_slices, chips=req.chips,
             placements={}, borrow=dict(plan), priority=req.priority,
-            admitted_at=now,
+            admitted_at=now, workload=req.workload,
         ))
         del policy.pending[req.key]
         policy.gen += 1
@@ -448,7 +448,8 @@ def plan_defrag(policy: PolicyQueue, config: ElasticConfig,
     def idle_borrowers(pool_name: str) -> list:
         out = []
         for alloc in ledger.allocations.values():
-            if not alloc.borrowed or alloc.draining:
+            if not alloc.borrowed or alloc.draining \
+                    or alloc.workload != "notebook":
                 continue
             if pool_name not in alloc.borrow:
                 continue
@@ -523,7 +524,10 @@ def plan_idle_borrower_eviction(policy: PolicyQueue, req: GangRequest,
         return None  # a free host exists; no eviction needed
     candidates = []
     for alloc in policy.ledger.allocations.values():
-        if not alloc.borrowed:
+        if not alloc.borrowed or alloc.workload != "notebook":
+            # Serving replicas are never eviction victims (workload-class
+            # guard, kubeflow_tpu/serving) — and they carry no activity
+            # probe, so the idle rule below could never clear them anyway.
             continue
         if alloc.accelerator.lower() != req.accelerator.lower():
             continue

@@ -311,6 +311,13 @@ class Allocation:
     # Elastic flex placement: pool → borrowed hosts (see class docstring).
     # None/empty for every native (slice-granular) allocation.
     borrow: dict[str, int] | None = None
+    # Workload class ("notebook" | "serving", kubeflow_tpu/serving):
+    # serving replicas are never preemption victims — no notebook
+    # activity signal exists for them, so the idle heuristic would
+    # misread a loaded service as idle, and their capacity is the
+    # serving autoscaler's to give back. Default keeps the pre-serving
+    # ledger bit-identical.
+    workload: str = "notebook"
 
     @property
     def borrowed(self) -> bool:

@@ -49,6 +49,15 @@ class GangRequest:
     weight: float = 1.0        # namespace weight (fair-share divisor)
     submitted_at: float = 0.0
     seq: int = 0               # arrival order; the final deterministic tie-break
+    # Workload class ("notebook" | "serving", kubeflow_tpu/serving): a
+    # serving replica admits exactly like a notebook gang but is NEVER a
+    # preemption victim — it has no notebook activity signal (no Jupyter
+    # kernels), so the idle heuristic would misread a service under load
+    # as idle forever, and its capacity is managed by its own autoscaler
+    # (scale-down releases chips; killing one replica would just make
+    # the service re-request it). Default keeps PR 5–8 behavior
+    # bit-identical.
+    workload: str = "notebook"
 
 
 @dataclass(frozen=True)
@@ -226,6 +235,7 @@ class PolicyQueue:
                 num_slices=req.num_slices, chips=req.chips,
                 placements={}, borrow=borrow,
                 priority=req.priority, admitted_at=now,
+                workload=req.workload,
             ))
             self.gen += 1
             return True
@@ -258,6 +268,7 @@ class PolicyQueue:
             accelerator=req.accelerator, topology=req.topology,
             num_slices=req.num_slices, chips=req.chips,
             placements=plan, priority=req.priority, admitted_at=now,
+            workload=req.workload,
         )
         self.ledger.admit(alloc, force=overcommit)
         self.gen += 1
@@ -304,7 +315,7 @@ class PolicyQueue:
                     accelerator=alloc.accelerator,
                     topology=alloc.topology,
                     num_slices=alloc.num_slices, chips=alloc.chips,
-                    priority=alloc.priority),
+                    priority=alloc.priority, workload=alloc.workload),
                 now=alloc.admitted_at,   # keep the original admission time
                 # An ex-borrower re-seats as a borrow (its pods live on
                 # a foreign pool's host, likely the renamed survivor).
@@ -378,6 +389,14 @@ class PolicyQueue:
                             draining_by_pool[pool] = \
                                 draining_by_pool.get(pool, 0) + n
                 continue  # never re-pick a draining gang as a victim
+            if alloc.workload != "notebook":
+                # Workload-class guard (kubeflow_tpu/serving): a serving
+                # replica has no activity probe — "no kernels" must not
+                # read as idle — and stopping one would not free capacity
+                # for long (its autoscaler would re-bid immediately).
+                # Serving capacity comes back through scale-down /
+                # scale-to-zero, never through preemption.
+                continue
             if (alloc.accelerator.lower(), alloc.topology.lower()) != shape:
                 continue  # frees no capacity this gang can use
             # Only slices booked on REAL matching pools come back on
@@ -490,7 +509,7 @@ class PolicyQueue:
                         accelerator=req.accelerator, topology=req.topology,
                         num_slices=req.num_slices, chips=req.chips,
                         placements=plan, priority=req.priority,
-                        admitted_at=now,
+                        admitted_at=now, workload=req.workload,
                     ))
                     del self.pending[req.key]
                     admitted.append(Admitted(
@@ -589,6 +608,7 @@ class PolicyQueue:
                     "admitted_at": a.admitted_at,
                     "last_active_at": a.last_active_at,
                     "draining": a.draining,
+                    "workload": a.workload,
                 }
                 for a in sorted(self.ledger.allocations.values(),
                                 key=lambda a: a.key)

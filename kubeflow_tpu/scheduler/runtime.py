@@ -29,6 +29,7 @@ import logging
 import time
 from dataclasses import dataclass, field, replace
 
+from kubeflow_tpu.api import inferenceservice as isvcapi
 from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.runtime.errors import ApiError, NotFound
 from kubeflow_tpu.runtime.events import EventRecorder
@@ -218,6 +219,17 @@ class TpuFleetScheduler:
         self._node_informer = None          # set by setup wiring
         self._nb_informer = None
         self._enqueue_cbs: list = []
+        # Serving workload class (kubeflow_tpu/serving): replica gang
+        # keys admitted through serving_admission(). Their side effects
+        # differ from notebooks' — no CR annotation stamps (the key
+        # names no Notebook), no drain protocol (the engine's parked
+        # checkpoint is the state), and re-enqueues route to the
+        # serving controller's callbacks, never the notebook workqueue
+        # (a notebook reconcile of a nonexistent key would RELEASE the
+        # serving allocation). Empty — and every path below byte-
+        # identical to PR 5–8 — until a serving controller registers.
+        self._serving_keys: set = set()
+        self._serving_cbs: list = []
         # key → "Queued"|"Admitted" (last surfaced state, for transition
         # events); key → preemption reason for stopped victims; key →
         # reason for victims whose stop patch FAILED and must be retried
@@ -324,8 +336,17 @@ class TpuFleetScheduler:
         """Register a re-enqueue callback: cb((namespace, name))."""
         self._enqueue_cbs.append(cb)
 
+    def on_serving_admitted(self, cb) -> None:
+        """Register the serving controller's re-enqueue callback:
+        cb(replica_key) — called with the (namespace, "<svc>#r<i>")
+        replica key whenever a serving replica's admission state may
+        have changed (admitted, or its capacity reclaimed)."""
+        self._serving_cbs.append(cb)
+
     def _enqueue(self, key: tuple) -> None:
-        for cb in self._enqueue_cbs:
+        cbs = (self._serving_cbs if key in self._serving_keys
+               else self._enqueue_cbs)
+        for cb in cbs:
             try:
                 cb(key)
             except Exception:
@@ -429,6 +450,12 @@ class TpuFleetScheduler:
             priority=parse_priority(annotations.get(PRIORITY_ANNOTATION)),
             weight=float(self.options.weights.get(ns, 1.0)),
             submitted_at=now,
+            # A Notebook labeled workload-class=serving (a serving pod
+            # deployed through the notebook CR) gets the same victim
+            # protection as a real InferenceService replica: no Jupyter
+            # activity probe means the idle heuristic would misread it.
+            workload=("serving" if isvcapi.is_serving_class(nb)
+                      else "notebook"),
         )
 
     @staticmethod
@@ -717,6 +744,117 @@ class TpuFleetScheduler:
                 return Admission("Preempted", reason=reason)
         return None
 
+    # ---- serving workload class (kubeflow_tpu/serving) --------------------------
+
+    async def serving_admission(self, key: tuple, ms, *, namespace: str,
+                                priority: int = 100, running: bool = False,
+                                flex_pool: str | None = None,
+                                ) -> Admission | None:
+        """Arbitrate one serving replica's gang against the SAME ledger
+        and policy queue as every notebook — one chip ledger, one fair
+        order, one preemption path (a queued serving replica drains idle
+        notebooks through the existing protocol; it is never a victim
+        itself — Allocation.workload). Returns None while no fleet is
+        known (transparent pass-through, like notebook admission);
+        ``running=True`` re-seats a replica whose StatefulSet is already
+        live (controller restart) instead of queueing it."""
+        if not await self._ensure_fleet():
+            return None
+        key = tuple(key)
+        self._serving_keys.add(key)
+        now = self._now()
+        await self._sweep_drains(now)
+        await self._sweep_spot_reclaims(now)
+        result = None
+        with span("schedule", key=f"{key[0]}/{key[1]}", workload="serving"):
+            if self.policy.is_admitted(key):
+                self._state[key] = "Admitted"
+                return Admission("Admitted")
+            req = GangRequest(
+                key=key, namespace=namespace or "",
+                accelerator=ms.slice.accelerator.name,
+                topology=ms.slice.topology_str,
+                num_slices=ms.num_slices, chips=ms.num_chips,
+                priority=priority,
+                weight=float(self.options.weights.get(namespace, 1.0)),
+                submitted_at=now, workload="serving")
+            # ``flex_pool`` is the controller's durable borrow marker
+            # (stamped per replica on the CR): a flex-placed replica
+            # must re-seat as a BORROW across a restart — seating it
+            # natively would resell the foreign host under its running
+            # pods and flip their node selectors (same contract as the
+            # notebook FLEX_POOL_ANNOTATION).
+            if running and self.policy.reclaim(
+                    req, now, borrow_first=bool(flex_pool),
+                    prefer_pool=flex_pool):
+                alloc = self.policy.ledger.allocations.get(key)
+                if alloc is not None and (
+                        alloc.forced
+                        or set(alloc.placements)
+                        & self.policy.ledger.unavailable):
+                    # reclaim() never refuses — but a serving replica
+                    # re-seated as overcommit, or back onto a revoked
+                    # spot pool, must QUEUE instead: it restores from
+                    # its checkpoint wherever capacity really exists,
+                    # and pinning it to a dying pool would loop the
+                    # spot sweep (release → force-re-admit → release)
+                    # forever. Notebooks keep force-reclaim semantics —
+                    # their pods hold un-checkpointed state.
+                    self.policy.release(key)
+                else:
+                    self._state[key] = "Admitted"
+                    self._refresh_gauges()
+                    return Admission("Admitted")
+            self.policy.submit(req)
+            # Same debounce as notebook admission: identical queue state
+            # within the interval serves the snapshot instead of paying
+            # another O(queue) arbitration pass.
+            if (self.policy.gen == self._last_pass_gen
+                    and now - self._last_pass_at
+                    < self.options.queued_requeue_seconds):
+                queue = self.policy.schedule_preview(now)
+            else:
+                result = self._arbitrate(now)
+                self._last_pass_gen = self.policy.gen
+                self._last_pass_at = now
+                queue = result.queue
+        if result is not None:
+            await self._apply(result, now)
+        await self._elastic_post(now)
+        if self.policy.is_admitted(key):
+            return Admission("Admitted")
+        info = next((q for q in queue if q.key == key), None)
+        self._state[key] = "Queued"
+        return Admission(
+            "Queued",
+            position=info.position if info else 0,
+            reason=info.reason if info else "",
+            waiting_chips=info.chips if info else ms.num_chips)
+
+    async def serving_release(self, key: tuple) -> None:
+        """Give a serving replica's chips back (scale-down, park-to-zero,
+        or service deletion) and run the arbitration pass that hands
+        them to whoever queues. No preemption verdict bookkeeping — a
+        serving replica's lifecycle lives in its controller's status."""
+        key = tuple(key)
+        if not self.active:
+            self._serving_keys.discard(key)
+            return
+        now = self._now()
+        had_queue_entry = key in self.policy.pending
+        alloc = self.policy.release(key)
+        self._state.pop(key, None)
+        if alloc is not None or had_queue_entry:
+            with span("schedule", key=f"{key[0]}/{key[1]}", release=True,
+                      workload="serving"):
+                result = self._arbitrate(now)
+                self._last_pass_gen = self.policy.gen
+                self._last_pass_at = now
+            await self._apply(result, now)
+        await self._elastic_post(now)
+        self._refresh_gauges()
+        self._serving_keys.discard(key)
+
     # ---- decision application ---------------------------------------------------
 
     async def _apply(self, result, now: float,
@@ -737,7 +875,12 @@ class TpuFleetScheduler:
                 self._state[a.key] = "Admitted"
                 self._requeue_credit.pop(a.key, None)
                 self._reclaim_verdict.pop(a.key, None)
-                nb = (requester if a.key == req_key
+                # Serving replicas: no Notebook CR exists under this key
+                # — skip the annotation/Event side effects; the enqueue
+                # below routes to the serving controller, which owns its
+                # own status surface.
+                nb = (None if a.key in self._serving_keys
+                      else requester if a.key == req_key
                       else await self._get_notebook(a.key))
                 if nb is not None:
                     await self._stamp_admitted(nb, now)
@@ -1306,6 +1449,28 @@ class TpuFleetScheduler:
                 continue  # drained; waiting for the signal to clear
             for alloc in victims:
                 if alloc.key in self._draining:
+                    continue
+                if alloc.key in self._serving_keys \
+                        or isvcapi.parse_replica_key(alloc.key) is not None:
+                    # InferenceService REPLICAS (their key carries the
+                    # impossible-CR-name "#r" marker, so this never
+                    # matches a real Notebook) don't speak the notebook
+                    # drain protocol — their durable state is the parked
+                    # checkpoint the engine keeps, so a revocation just
+                    # releases the booking; the serving controller's
+                    # next pass re-admits the replica off the revoked
+                    # pool (the ledger already marks it unavailable).
+                    # A serving-class NOTEBOOK (workload="serving" but a
+                    # real CR) deliberately falls through to the normal
+                    # checkpoint drain below — it has state to save and
+                    # a CR that speaks the protocol.
+                    with span("reclaim", pool=pool_name,
+                              victim=f"{alloc.key[0]}/{alloc.key[1]}",
+                              workload="serving"):
+                        self.m_spot_reclaims.inc()
+                        self.policy.release(alloc.key)
+                        self._state.pop(alloc.key, None)
+                        self._enqueue(alloc.key)
                     continue
                 # Chips stay booked while the victim checkpoints, but
                 # marked draining: the victim search credits them as

@@ -1,0 +1,43 @@
+"""TPU inference serving: the second workload class (ISSUE 11).
+
+Everything the control plane scheduled before this package was a
+notebook — interactive, one user each. An
+:class:`~kubeflow_tpu.api.inferenceservice` CR is the other shape the
+north star needs: always-on model serving under bursty traffic from many
+users. The pieces, least pure on top:
+
+- :mod:`kubeflow_tpu.serving.autoscaler` — pure replica-count policy
+  (request-rate/concurrency driven, min/max bounds, scale-to-zero after
+  an idle window, scale-down stabilization). Property-tested clock-free.
+- :mod:`kubeflow_tpu.serving.engine` — the JAX serving loop: batched
+  ``jit`` forward with continuous batching on the
+  ``parallel/mesh.py`` substrate, plus the park/warm-restore state the
+  scale-to-zero story rides (parked weights + retained compiled fn make
+  scale-from-zero a device transfer, not a cold compile).
+- :mod:`kubeflow_tpu.serving.loadgen` — seeded, trace-driven open-loop
+  load generator (arrivals don't wait for completions — queueing shows
+  up in p99, exactly like production traffic).
+- :mod:`kubeflow_tpu.serving.controller` — the InferenceService
+  reconciler: per-replica slice StatefulSets + a Service, each replica
+  admitted through the fleet scheduler as a gang
+  (``TpuFleetScheduler.serving_admission`` — one chip ledger with the
+  notebooks), scale-to-zero parking through a checkpoint drain (the PR 6
+  park idiom), and warm restore on the first burst.
+
+Kill switch: ``KFTPU_SERVING=off`` (:func:`serving_enabled`) restores
+the PR 5–8 notebook-only control plane byte-for-byte — no serving
+controller, no serving webhooks, no serving routes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def serving_enabled(environ=os.environ) -> bool:
+    """The ``KFTPU_SERVING`` master switch — anything but off/false/0/no
+    leaves the serving workload class on (it is inert until an
+    InferenceService CR exists)."""
+    return environ.get("KFTPU_SERVING", "on").strip().lower() not in (
+        "off", "false", "0", "no", "disabled",
+    )

@@ -1,0 +1,247 @@
+"""JAX serving loop: batched ``jit`` forward with continuous batching.
+
+The data-plane half of the serving workload class. One engine is one
+replica's model server:
+
+- **Batched forward**: requests are packed into a fixed ``[max_batch,
+  seq_len]`` token buffer and scored by ONE jitted forward per decode
+  step — static shapes, so XLA compiles exactly once (the burn-in
+  transformer from ``models/burnin.py``, sharded over a
+  ``parallel/mesh.py`` mesh when more than one device is attached).
+- **Continuous batching**: a request occupies a batch slot only for its
+  own ``tokens_out`` decode steps; the moment it finishes, the next
+  queued request takes the slot mid-flight — no head-of-line blocking
+  on the longest request in a static batch.
+- **Park / warm restore** (the scale-to-zero substrate): ``park()``
+  moves the weights to host memory and keeps the compiled step — the
+  checkpoint the controller's park protocol records. ``warm_restore()``
+  is then a device transfer, not an init + compile: that delta is
+  exactly why a parked warm standby restores measurably faster than a
+  cold replica create (``bench.py inference_serving`` gates on it).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.runtime.tracing import span
+
+
+@dataclass(frozen=True)
+class Request:
+    """One inference request of the open-loop trace."""
+
+    rid: int
+    arrival: float             # seconds from trace start
+    tokens_out: int = 8        # decode steps this request needs
+
+
+@dataclass
+class Completion:
+    rid: int
+    arrival: float
+    started: float             # when it got a batch slot
+    finished: float
+    tokens: int
+
+    @property
+    def latency(self) -> float:
+        return self.finished - self.arrival
+
+    @property
+    def queue_wait(self) -> float:
+        return self.started - self.arrival
+
+
+@dataclass
+class ServeReport:
+    completions: list = field(default_factory=list)
+    wall_sec: float = 0.0
+    steps: int = 0
+    batch_occupancy: float = 0.0   # mean filled slots per step
+
+    @property
+    def tokens(self) -> int:
+        return sum(c.tokens for c in self.completions)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.tokens / self.wall_sec if self.wall_sec > 0 else 0.0
+
+    def latency_percentile(self, q: float) -> float:
+        lats = sorted(c.latency for c in self.completions)
+        if not lats:
+            return 0.0
+        idx = min(len(lats) - 1, max(0, int(round(q * (len(lats) - 1)))))
+        return lats[idx]
+
+
+class ServingEngine:
+    """One replica's model server over the burn-in transformer."""
+
+    def __init__(self, cfg=None, *, max_batch: int = 8, use_mesh: bool = True):
+        from kubeflow_tpu.models.burnin import BurninConfig
+
+        self.cfg = cfg or BurninConfig()
+        self.max_batch = max_batch
+        self.use_mesh = use_mesh
+        self._params = None          # device weights while serving
+        self._host_params = None     # host weights while parked
+        self._step_fn = None         # compiled forward (survives a park)
+        self._mesh = None
+        self.parked = False
+        self.cold_start_sec: float | None = None
+        self.warm_restore_sec: float | None = None
+        self.park_step = 0           # monotonically counts decode steps
+
+    # ---- lifecycle -----------------------------------------------------------
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models.burnin import forward
+
+        cfg = self.cfg
+
+        def score(params, tokens):
+            # One decode step: score the batch, return each sequence's
+            # next-token logits argmax (the cheapest useful output — the
+            # bench measures throughput, not sampling quality).
+            logits = forward(params, tokens, cfg)
+            return jnp.argmax(logits[:, -1, :], axis=-1)
+
+        return jax.jit(score)
+
+    def cold_start(self, seed: int = 0) -> float:
+        """Full cold bring-up: init weights, (optionally) shard them
+        over the device mesh, compile the batched forward, run one
+        warm-up step. Returns (and records) the wall seconds — the
+        number the warm restore is measured against."""
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.models.burnin import init_params, shard_params
+
+        t0 = time.perf_counter()
+        params = init_params(jax.random.key(seed), self.cfg)
+        if self.use_mesh and len(jax.devices()) > 1:
+            from kubeflow_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh()
+            params = shard_params(params, self._mesh, self.cfg)
+        self._params = params
+        self._step_fn = self._build_step()
+        tokens = np.zeros((self.max_batch, self.cfg.seq_len), np.int32)
+        np.asarray(self._step_fn(self._params, tokens))  # compile + sync
+        self.parked = False
+        self.cold_start_sec = time.perf_counter() - t0
+        return self.cold_start_sec
+
+    def park(self) -> dict:
+        """Scale-to-zero park: weights off the device into host memory,
+        compiled step retained. Returns the checkpoint descriptor the
+        controller stamps onto the CR (path is symbolic here — a real
+        deployment points it at the Orbax directory the engine's
+        CheckpointManager commits to)."""
+        import jax
+
+        if self._params is None:
+            raise RuntimeError("cannot park an engine that never started")
+        self._host_params = jax.device_get(self._params)
+        self._params = None
+        self.parked = True
+        return {"path": f"mem://parked/{id(self):x}", "step": self.park_step}
+
+    def warm_restore(self) -> float:
+        """Scale-from-zero restore of a parked standby: device-put the
+        host weights back and run one warm-up step through the RETAINED
+        compiled fn. No init, no compile — the measured delta vs
+        :meth:`cold_start` is the warm-standby win."""
+        import jax
+        import numpy as np
+
+        if not self.parked or self._host_params is None:
+            raise RuntimeError("warm_restore() needs a parked engine")
+        t0 = time.perf_counter()
+        if self._mesh is not None:
+            from kubeflow_tpu.models.burnin import shard_params
+
+            self._params = shard_params(self._host_params, self._mesh,
+                                        self.cfg)
+        else:
+            self._params = jax.device_put(self._host_params)
+        self._host_params = None
+        tokens = np.zeros((self.max_batch, self.cfg.seq_len), np.int32)
+        np.asarray(self._step_fn(self._params, tokens))
+        self.parked = False
+        self.warm_restore_sec = time.perf_counter() - t0
+        return self.warm_restore_sec
+
+    # ---- serving loop --------------------------------------------------------
+
+    def serve(self, requests: list, *, time_scale: float = 1.0) -> ServeReport:
+        """Run one open-loop trace to completion with continuous
+        batching. ``requests`` arrive at ``arrival * time_scale`` on the
+        engine's own clock whether or not slots are free (open loop —
+        the backlog shows up as queue wait in the latency percentiles).
+        The trace clock never waits for the model: if the model is the
+        bottleneck, arrivals pile up, exactly like production."""
+        import numpy as np
+
+        if self._params is None or self._step_fn is None:
+            raise RuntimeError("engine not started (cold_start/warm_restore)")
+        queue = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        pending = list(queue)
+        slots: list = [None] * self.max_batch      # Request | None
+        remaining = [0] * self.max_batch
+        started = [0.0] * self.max_batch
+        tokens = np.zeros((self.max_batch, self.cfg.seq_len), np.int32)
+        report = ServeReport()
+        occupancy = 0
+        t0 = time.perf_counter()
+
+        def now() -> float:
+            return time.perf_counter() - t0
+
+        with span("serve", requests=len(queue), max_batch=self.max_batch):
+            while pending or any(s is not None for s in slots):
+                clock = now()
+                # Admit arrivals into free slots, earliest arrival first.
+                while pending and pending[0].arrival * time_scale <= clock:
+                    try:
+                        free = slots.index(None)
+                    except ValueError:
+                        break  # batch full; the backlog queues (open loop)
+                    req = pending.pop(0)
+                    slots[free] = req
+                    remaining[free] = req.tokens_out
+                    started[free] = clock
+                active = [i for i, s in enumerate(slots) if s is not None]
+                if not active:
+                    # Idle until the next arrival (scaled trace time).
+                    if pending:
+                        wait = pending[0].arrival * time_scale - now()
+                        if wait > 0:
+                            time.sleep(min(wait, 0.05))
+                    continue
+                # One decode step for the whole batch (static shape).
+                np.asarray(self._step_fn(self._params, tokens))
+                self.park_step += 1
+                report.steps += 1
+                occupancy += len(active)
+                clock = now()
+                for i in active:
+                    remaining[i] -= 1
+                    if remaining[i] <= 0:
+                        req = slots[i]
+                        report.completions.append(Completion(
+                            rid=req.rid, arrival=req.arrival * time_scale,
+                            started=started[i], finished=clock,
+                            tokens=req.tokens_out))
+                        slots[i] = None
+        report.wall_sec = now()
+        report.batch_occupancy = (occupancy / report.steps
+                                  if report.steps else 0.0)
+        return report

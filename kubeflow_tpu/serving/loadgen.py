@@ -1,0 +1,77 @@
+"""Trace-driven open-loop load generator for the serving bench.
+
+Open loop means arrivals are scheduled by the trace alone — a slow
+server does not slow the generator down, so overload shows up as
+queueing in the latency percentiles instead of silently throttling the
+offered load (the closed-loop fallacy). Seeded end to end: the same
+seed always produces the same trace, so bench rounds are comparable and
+tests are deterministic.
+
+A trace is a list of phases, each an (duration, rate) pair; arrivals
+inside a phase are Poisson (exponential gaps) at that rate. The default
+``burst_trace`` is the scale-from-zero story: silence → burst → cool —
+exactly the shape that exercises park, warm restore, and scale-down.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from kubeflow_tpu.serving.engine import Request
+
+
+@dataclass(frozen=True)
+class Phase:
+    duration: float            # seconds of trace time
+    rate: float                # requests/sec (0 = silence)
+
+
+def generate_trace(phases: list, *, seed: int = 0,
+                   tokens_out: int = 8,
+                   tokens_jitter: int = 0) -> list:
+    """Phases → arrival-sorted ``Request`` list. ``tokens_jitter`` adds
+    uniform spread around ``tokens_out`` (continuous batching only pays
+    off when request lengths differ — a jitter of 0 degenerates to
+    static batching)."""
+    rng = random.Random(seed)
+    requests: list = []
+    t = 0.0
+    rid = 0
+    for phase in phases:
+        end = t + phase.duration
+        if phase.rate <= 0:
+            t = end
+            continue
+        while True:
+            t += rng.expovariate(phase.rate)
+            if t >= end:
+                t = end
+                break
+            toks = tokens_out
+            if tokens_jitter:
+                toks = max(1, tokens_out + rng.randint(-tokens_jitter,
+                                                       tokens_jitter))
+            requests.append(Request(rid=rid, arrival=t, tokens_out=toks))
+            rid += 1
+    return requests
+
+
+def burst_trace(*, seed: int = 0, warm_rate: float = 2.0,
+                burst_rate: float = 20.0, warm_sec: float = 2.0,
+                burst_sec: float = 3.0, cool_sec: float = 1.0,
+                tokens_out: int = 8, tokens_jitter: int = 4) -> list:
+    """The canonical bench trace: a trickle, a burst, a cool-down."""
+    return generate_trace(
+        [Phase(warm_sec, warm_rate), Phase(burst_sec, burst_rate),
+         Phase(cool_sec, warm_rate / 2)],
+        seed=seed, tokens_out=tokens_out, tokens_jitter=tokens_jitter)
+
+
+def observed_rate(requests: list, now: float, *,
+                  window: float = 1.0) -> float:
+    """Trailing-window request rate at trace time ``now`` — what a
+    serving gateway would stamp as the observed-rate annotation."""
+    lo = now - window
+    n = sum(1 for r in requests if lo < r.arrival <= now)
+    return n / window if window > 0 else 0.0

@@ -252,6 +252,62 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     )
 
 
+def process_serving_status(isvc: dict) -> Status:
+    """InferenceService status state machine for the UI — the serving
+    analogue of :func:`process_status`. Priority order mirrors the
+    controller's state derivation (serving/controller.py): quarantine,
+    park lifecycle, fleet queueing, readiness."""
+    meta = get_meta(isvc)
+    serving = deep_get(isvc, "status", "serving", default={}) or {}
+    state = serving.get("state") or ""
+    for c in deep_get(isvc, "status", "conditions", default=[]):
+        if c.get("type") == "Degraded":
+            if c.get("status") == "True":
+                return Status(
+                    WARNING,
+                    "Reconciliation suspended after repeated errors "
+                    f"({c.get('reason', 'ReconcileQuarantined')})")
+            break
+    if meta.get("deletionTimestamp"):
+        return Status(TERMINATING, "Deleting this InferenceService.")
+    if state == "Parked":
+        ckpt = serving.get("parkedCheckpoint") or {}
+        step = ckpt.get("step")
+        return Status(
+            STOPPED,
+            "Scaled to zero — parked warm standby"
+            + (f" (checkpoint @ step {step})" if step is not None
+               else " (checkpoint saved)" if ckpt else "")
+            + "; the first request restores it")
+    if state == "Parking":
+        return Status(WAITING, "Idle — checkpointing before scale-to-zero…")
+    if state == "Queued":
+        return Status(
+            WAITING,
+            f"All {serving.get('queuedReplicas', 0)} replica(s) queued "
+            "for TPU capacity")
+    if state == "Scaling":
+        queued = serving.get("queuedReplicas", 0)
+        note = (f"; {queued} replica(s) queued for TPU capacity"
+                if queued else "")
+        ready = deep_get(isvc, "status", "readyReplicas", default=0) or 0
+        return Status(
+            WAITING,
+            f"Scaling to {serving.get('desiredReplicas', 0)} replica(s) "
+            f"({ready} worker(s) ready{note})")
+    if state == "Ready":
+        n = serving.get("admittedReplicas", 0)
+        return Status(READY,
+                      f"Serving ({n} replica(s), "
+                      f"{deep_get(isvc, 'status', 'readyReplicas', default=0) or 0} "
+                      "worker(s) ready)")
+    if _age_seconds(isvc) <= 10:
+        return Status(WAITING, "Waiting for the serving controller.")
+    return Status(WARNING,
+                  "Couldn't find any information for the status of this "
+                  "InferenceService.")
+
+
 async def events_for(kube, namespace: str, name: str, kinds: tuple) -> list[dict]:
     """One Event list call filtered to the involved object — shared by the
     per-app events routes (JWA pod/CR events, VWA pvc_events, TWA

@@ -30,6 +30,13 @@ def create_app(kube, *, config: dict | None = None, config_path: str | None = No
     app = create_base_app(kube, **kwargs)
     app["config"] = config or load_config(config_path)
     app.add_routes(routes)
+    # Serving workload class (KFTPU_SERVING, kubeflow_tpu/serving): the
+    # InferenceService routes register only with the switch on, so =off
+    # keeps the JWA HTTP surface byte-for-byte notebook-only.
+    from kubeflow_tpu.serving import serving_enabled
+
+    if serving_enabled():
+        app.add_routes(serving_routes)
     add_spa(app, __file__)
     return app
 
@@ -272,3 +279,58 @@ async def delete_notebook(request):
     await ensure(authz, user, "delete", "Notebook", ns)
     await kube.delete("Notebook", name, ns)
     return json_success({"message": f"Notebook {name} deleted"})
+
+
+# ---- serving workload class (registered only with KFTPU_SERVING on) ----------
+
+serving_routes = web.RouteTableDef()
+
+
+def _summarize_serving(isvc: dict) -> dict:
+    from kubeflow_tpu.web.common.status import process_serving_status
+
+    meta = get_meta(isvc)
+    status = process_serving_status(isvc)
+    return {
+        "name": meta.get("name"),
+        "namespace": meta.get("namespace"),
+        "age": meta.get("creationTimestamp"),
+        "tpu": deep_get(isvc, "spec", "tpu"),
+        "scaling": deep_get(isvc, "spec", "scaling"),
+        "serving": deep_get(isvc, "status", "serving"),
+        "readyReplicas": deep_get(isvc, "status", "readyReplicas"),
+        "status": {"phase": status.phase, "message": status.message},
+    }
+
+
+@serving_routes.get("/api/namespaces/{namespace}/inferenceservices")
+async def list_inferenceservices(request):
+    kube, authz, user, ns = _ctx(request)
+    await ensure(authz, user, "list", "InferenceService", ns)
+    services = [
+        _summarize_serving(isvc)
+        for isvc in await kube.list("InferenceService", ns)
+    ]
+    return json_success({"inferenceservices": services})
+
+
+@serving_routes.get("/api/namespaces/{namespace}/inferenceservices/{name}")
+async def get_inferenceservice(request):
+    from kubeflow_tpu.web.common.status import process_serving_status
+
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "get", "InferenceService", ns)
+    isvc = await kube.get("InferenceService", name, ns)
+    return json_success(
+        {"inferenceservice": isvc,
+         "processedStatus": process_serving_status(isvc).__dict__})
+
+
+@serving_routes.delete("/api/namespaces/{namespace}/inferenceservices/{name}")
+async def delete_inferenceservice(request):
+    kube, authz, user, ns = _ctx(request)
+    name = request.match_info["name"]
+    await ensure(authz, user, "delete", "InferenceService", ns)
+    await kube.delete("InferenceService", name, ns)
+    return json_success({"message": f"InferenceService {name} deleted"})

@@ -57,6 +57,27 @@ def register_all(kube) -> None:
             await nb_webhook.validate_capacity(kube, nb)
 
     kube.add_validator("Notebook", notebook_validator)
+
+    # Serving workload class (KFTPU_SERVING, kubeflow_tpu/serving): the
+    # InferenceService mutator/validator register only with the switch
+    # on, so =off restores the notebook-only admission chain
+    # byte-for-byte. Capacity fast-fail mirrors the Notebook gate
+    # (CREATE only) through the same TTL-cached Profile/fleet loaders.
+    from kubeflow_tpu.serving import serving_enabled
+
+    if serving_enabled():
+        from kubeflow_tpu.webhooks import inferenceservice as isvc_webhook
+
+        kube.add_mutator("InferenceService", isvc_webhook.mutate)
+
+        async def isvc_validator(isvc: dict, info: dict) -> None:
+            from kubeflow_tpu.api import inferenceservice as isvcapi
+
+            isvcapi.validate(isvc)
+            if info.get("operation") in (None, "CREATE"):
+                await isvc_webhook.validate_capacity(kube, isvc)
+
+        kube.add_validator("InferenceService", isvc_validator)
     kube.add_validator("PodDefault", lambda pd, _i: pdapi.validate(pd))
     kube.add_validator("Profile", lambda p, _i: profileapi.validate(p))
     kube.add_validator("Tensorboard", lambda tb, _i: tbapi.validate(tb))

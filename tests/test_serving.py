@@ -1,0 +1,604 @@
+"""Serving workload class end-to-end (ISSUE 11): the InferenceService
+controller against the real manager/scheduler/podsim stack, the
+admission-collision story, the workload-class guards (culler + victim
+search), the webhook fast-fail, and the JWA status machine.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from kubeflow_tpu.api import inferenceservice as isvcapi
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.culling import (
+    CullingOptions,
+    CullingReconciler,
+)
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.runtime.errors import Invalid
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import Registry
+from kubeflow_tpu.runtime.objects import annotations_of, deep_get, fmt_iso
+from kubeflow_tpu.scheduler import Fleet, SchedulerOptions, TpuFleetScheduler
+from kubeflow_tpu.scheduler.fleet import Allocation
+from kubeflow_tpu.scheduler.policy import GangRequest, PolicyConfig, PolicyQueue
+from kubeflow_tpu.serving.controller import (
+    ServingOptions,
+    setup_serving_controller,
+)
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.web.common.status import process_serving_status
+from kubeflow_tpu.webhooks import register_all
+
+
+class Harness:
+    """FakeKube + manager + shared scheduler + serving controller."""
+
+    def __init__(self, fleet="pool-a=v5e:2x2:2", elastic=False,
+                 **serving_kw):
+        self.kube = FakeKube()
+        register_all(self.kube)
+        self.mgr = Manager(self.kube, registry=Registry())
+        self.sched = TpuFleetScheduler(
+            self.kube,
+            SchedulerOptions(queued_requeue_seconds=0.05,
+                             enable_migration=True,
+                             drain_grace_seconds=5.0,
+                             idle_preempt_after_seconds=0.3,
+                             enable_elastic=elastic),
+            fleet=Fleet.parse(fleet), registry=self.mgr.registry)
+        setup_notebook_controller(self.mgr, NotebookOptions(),
+                                  scheduler=self.sched)
+        kw = dict(enabled=True, autoscale_period_seconds=0.05,
+                  park_grace_seconds=1.0, default_stabilization=0.1)
+        kw.update(serving_kw)
+        self.serving = setup_serving_controller(
+            self.mgr, ServingOptions(**kw), scheduler=self.sched)
+        self.sim = PodSimulator(self.kube)
+
+    async def __aenter__(self):
+        await self.mgr.start()
+        await self.sim.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.sim.stop()
+        await self.mgr.stop()
+        self.kube.close_watches()
+
+    async def stamp_load(self, rate, *, fresh=True, name="svc", ns="user"):
+        await self.kube.patch(
+            "InferenceService", name,
+            {"metadata": {"annotations": {
+                isvcapi.OBSERVED_RATE_ANNOTATION: str(rate),
+                isvcapi.LAST_REQUEST_AT_ANNOTATION:
+                    fmt_iso(time.time() if fresh else time.time() - 3600),
+            }}}, ns)
+
+    async def wait_for(self, predicate, timeout=15.0, what="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def replica_admitted(self, i, name="svc", ns="user"):
+        return isvcapi.replica_key(ns, name, i) in \
+            self.sched.policy.ledger.allocations
+
+
+async def test_serving_scales_up_and_becomes_ready():
+    async with Harness() as h:
+        await h.kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=0, max_replicas=2, target_rate=5.0))
+        await h.stamp_load(12.0)
+        await h.wait_for(lambda: h.replica_admitted(0)
+                         and h.replica_admitted(1), what="2 replicas")
+        await h.mgr.wait_idle(timeout=20)
+        isvc = await h.kube.get("InferenceService", "svc", "user")
+        serving = deep_get(isvc, "status", "serving")
+        assert serving["state"] == "Ready"
+        assert serving["admittedReplicas"] == 2
+        # One StatefulSet per replica, serving-labeled, TPU-wired.
+        sts = await h.kube.get("StatefulSet", "svc-r0", "user")
+        labels = deep_get(sts, "spec", "template", "metadata", "labels")
+        assert labels[isvcapi.SERVICE_LABEL] == "svc"
+        assert labels[isvcapi.WORKLOAD_CLASS_LABEL] == isvcapi.SERVING_CLASS
+        assert labels[nbapi.TPU_SLICE_LABEL] == "true"
+        env = {e["name"] for e in deep_get(
+            sts, "spec", "template", "spec", "containers")[0]["env"]}
+        assert "TPU_WORKER_HOSTNAMES" in env
+        # The Service selects every replica's workers.
+        svc = await h.kube.get("Service", "svc", "user")
+        assert deep_get(svc, "spec", "selector") == \
+            {isvcapi.SERVICE_LABEL: "svc"}
+        h.sched.policy.ledger.assert_consistent()
+        assert h.sched.policy.ledger.violations == 0
+
+
+async def test_scale_to_zero_parks_and_warm_restores():
+    async with Harness() as h:
+        await h.kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=0, max_replicas=1, target_rate=5.0,
+            scale_to_zero_after=0.3))
+        await h.stamp_load(4.0)
+        await h.wait_for(lambda: h.replica_admitted(0), what="replica 0")
+        await h.mgr.wait_idle(timeout=20)
+
+        # Engine-sim: ack the park request with a committed checkpoint
+        # (echoing the request — park_acked correlates on it).
+        async def ack_park(step="77"):
+            while True:
+                isvc = await h.kube.get_or_none("InferenceService",
+                                                "svc", "user")
+                ann = annotations_of(isvc or {})
+                requested = ann.get(isvcapi.PARK_REQUESTED_ANNOTATION)
+                if requested and ann.get(
+                        isvcapi.PARK_CHECKPOINT_FOR_ANNOTATION) \
+                        != requested:
+                    await h.kube.patch(
+                        "InferenceService", "svc",
+                        {"metadata": {"annotations": {
+                            isvcapi.PARK_CHECKPOINT_PATH_ANNOTATION:
+                                "/ckpt/svc",
+                            isvcapi.PARK_CHECKPOINT_STEP_ANNOTATION: step,
+                            isvcapi.PARK_CHECKPOINT_FOR_ANNOTATION:
+                                requested,
+                        }}}, "user")
+                    return
+                await asyncio.sleep(0.01)
+
+        acker = asyncio.create_task(ack_park())
+        await h.stamp_load(0.0, fresh=False)
+        await h.wait_for(lambda: not h.replica_admitted(0),
+                         what="park release")
+        await h.mgr.wait_idle(timeout=20)
+        acker.cancel()
+        isvc = await h.kube.get("InferenceService", "svc", "user")
+        ann = annotations_of(isvc)
+        assert isvcapi.PARKED_AT_ANNOTATION in ann
+        assert isvcapi.parked_checkpoint(ann) == ("/ckpt/svc", 77)
+        assert deep_get(isvc, "status", "serving", "state") == "Parked"
+        # The warm standby: replica 0's StatefulSet kept at 0 replicas.
+        sts = await h.kube.get("StatefulSet", "svc-r0", "user")
+        assert deep_get(sts, "spec", "replicas") == 0
+
+        # First burst after the park: warm restore with the checkpoint
+        # stamped into the pod env.
+        await h.stamp_load(4.0)
+        await h.wait_for(lambda: h.replica_admitted(0),
+                         what="warm re-admission")
+        await h.mgr.wait_idle(timeout=20)
+        sts = await h.kube.get("StatefulSet", "svc-r0", "user")
+        assert deep_get(sts, "spec", "replicas") == 1
+        env = {e["name"]: e.get("value") for e in deep_get(
+            sts, "spec", "template", "spec", "containers")[0]["env"]}
+        assert env.get(migration.RESTORE_PATH_ENV) == "/ckpt/svc"
+        assert env.get(migration.RESTORE_STEP_ENV) == "77"
+        assert h.serving.m_warm_restores.labels().value >= 1
+        isvc = await h.kube.get("InferenceService", "svc", "user")
+        assert isvcapi.PARKED_AT_ANNOTATION not in annotations_of(isvc)
+        h.sched.policy.ledger.assert_consistent()
+        assert h.sched.policy.ledger.violations == 0
+
+
+def test_park_ack_requires_echo_of_current_request():
+    """Regression (review): the checkpoint path/step survive a warm
+    restore as the restore hint — a SECOND idle spell must not
+    instant-park off that stale checkpoint. Only an ack echoing the
+    current park request counts."""
+    ann = {isvcapi.PARK_REQUESTED_ANNOTATION: "t1",
+           isvcapi.PARK_CHECKPOINT_PATH_ANNOTATION: "/c",
+           isvcapi.PARK_CHECKPOINT_STEP_ANNOTATION: "5"}
+    assert not isvcapi.park_acked(ann)          # stale, no echo
+    ann[isvcapi.PARK_CHECKPOINT_FOR_ANNOTATION] = "t0"
+    assert not isvcapi.park_acked(ann)          # echo of an OLD request
+    ann[isvcapi.PARK_CHECKPOINT_FOR_ANNOTATION] = "t1"
+    assert isvcapi.park_acked(ann)
+    assert not isvcapi.park_acked(
+        {isvcapi.PARK_CHECKPOINT_FOR_ANNOTATION: "t1"})  # no request
+
+
+async def test_spot_reclaim_of_serving_replica_requeues_off_pool():
+    """Regression (review): a spot revocation under a serving replica
+    releases its booking and the replica QUEUES for real capacity — it
+    must not be force-re-seated back onto the revoked pool (which would
+    loop the sweep release/re-admit forever and pin the pool
+    unavailable)."""
+    async with Harness(fleet="spot-a=v5e:2x2:1:spot",
+                       elastic=True) as h:
+        await h.kube.create("Node", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "spot-node", "labels": {
+                "cloud.google.com/gke-nodepool": "spot-a",
+                "cloud.google.com/gke-spot": "true"}}})
+        await h.kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=1, max_replicas=1))
+        await h.wait_for(lambda: h.replica_admitted(0),
+                         what="replica on the spot pool")
+        await h.mgr.wait_idle(timeout=20)
+        # Revocation signal lands.
+        await h.kube.patch("Node", "spot-node", {"spec": {"taints": [{
+            "key": "cloud.google.com/gke-spot-termination",
+            "effect": "NoSchedule"}]}})
+        await h.wait_for(
+            lambda: not h.replica_admitted(0)
+            and ("user", "svc#r0") in h.sched.policy.pending,
+            what="replica released and queued off the revoked pool",
+            timeout=20)
+        # Let several sweep/admission cycles run: the booking must STAY
+        # released (no force-re-seat churn back onto the dying pool).
+        await asyncio.sleep(0.4)
+        assert not h.replica_admitted(0)
+        assert "spot-a" in h.sched.policy.ledger.unavailable
+        # Revocation completes: the signal clears, the pool re-opens,
+        # and the queued replica re-admits.
+        await h.kube.patch("Node", "spot-node",
+                           {"spec": {"taints": None}})
+        await h.wait_for(lambda: h.replica_admitted(0),
+                         what="re-admission after the signal clears",
+                         timeout=20)
+        await h.mgr.wait_idle(timeout=20)
+        h.sched.policy.ledger.assert_consistent()
+        assert h.sched.policy.ledger.violations == 0
+
+
+async def test_park_grace_fallback_without_ack():
+    """An engine that never acks must not hold chips hostage: the park
+    lands on the grace deadline, without a fresh checkpoint."""
+    async with Harness(park_grace_seconds=0.2) as h:
+        await h.kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=0, max_replicas=1, scale_to_zero_after=0.2))
+        await h.stamp_load(4.0)
+        await h.wait_for(lambda: h.replica_admitted(0), what="replica 0")
+        await h.stamp_load(0.0, fresh=False)
+        await h.wait_for(lambda: not h.replica_admitted(0),
+                         what="grace-deadline park", timeout=20)
+        await h.mgr.wait_idle(timeout=20)
+        isvc = await h.kube.get("InferenceService", "svc", "user")
+        ann = annotations_of(isvc)
+        assert isvcapi.PARKED_AT_ANNOTATION in ann
+        assert isvcapi.parked_checkpoint(ann) is None
+
+
+async def test_admission_collision_serving_burst_vs_notebook_gang():
+    """A serving burst and a notebook gang contend for the same pool:
+    the serving class wins the free capacity, the notebook queues (the
+    ledger is never oversold), and the chips flow back to the notebook
+    the moment the service scales back down."""
+    async with Harness(fleet="pool-a=v5e:2x2:2") as h:
+        await h.kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=0, max_replicas=2, target_rate=5.0))
+        await h.stamp_load(30.0)  # burst: wants both slices
+        await h.kube.create("Notebook", nbapi.new(
+            "nb", "user", accelerator="v5e", topology="2x2"))
+        await h.wait_for(lambda: h.replica_admitted(0)
+                         and h.replica_admitted(1), what="serving burst")
+        await h.mgr.wait_idle(timeout=20)
+        assert ("user", "nb") in h.sched.policy.pending
+        h.sched.policy.ledger.assert_consistent()
+        assert h.sched.policy.ledger.violations == 0
+        nb = await h.kube.get("Notebook", "nb", "user")
+        assert deep_get(nb, "status", "scheduler", "state") == "Queued"
+        # Cool down → one replica → the notebook takes the freed slice.
+        await h.stamp_load(2.0)
+        await h.wait_for(
+            lambda: ("user", "nb") in h.sched.policy.ledger.allocations,
+            what="notebook admission after scale-down", timeout=20)
+        h.sched.policy.ledger.assert_consistent()
+        assert h.sched.policy.ledger.violations == 0
+
+
+async def test_serving_burst_drains_idle_notebook():
+    async with Harness(fleet="pool-a=v5e:2x2:1") as h:
+        await h.kube.create("Notebook", nbapi.new(
+            "idle-nb", "user", accelerator="v5e", topology="2x2"))
+        await h.mgr.wait_idle(timeout=20)
+        await h.kube.patch(
+            "Notebook", "idle-nb",
+            {"metadata": {"annotations": {
+                nbapi.LAST_ACTIVITY_ANNOTATION:
+                    fmt_iso(time.time() - 3600)}}}, "user")
+        await asyncio.sleep(0.4)  # age past idle_preempt_after (0.3 s)
+
+        async def ack_nb_drains():
+            while True:
+                nb = await h.kube.get_or_none("Notebook", "idle-nb",
+                                              "user")
+                ann = annotations_of(nb or {})
+                if migration.drain_requested_at(ann) is not None \
+                        and not migration.drain_acked(ann):
+                    await h.kube.patch(
+                        "Notebook", "idle-nb",
+                        {"metadata": {"annotations": migration.ack_patch(
+                            "/ckpt/idle-nb", 9, time.time(),
+                            for_request=ann.get(
+                                nbapi.DRAIN_REQUESTED_ANNOTATION))}},
+                        "user")
+                    return
+                await asyncio.sleep(0.01)
+
+        acker = asyncio.create_task(ack_nb_drains())
+        await h.kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=1, max_replicas=1))
+        await h.stamp_load(4.0)
+        await h.wait_for(lambda: h.replica_admitted(0),
+                         what="replica admitted over drained notebook",
+                         timeout=20)
+        acker.cancel()
+        await h.mgr.wait_idle(timeout=20)
+        nb = await h.kube.get("Notebook", "idle-nb", "user")
+        assert nbapi.STOP_ANNOTATION in annotations_of(nb)  # parked
+        assert h.sched.m_preemptions.labels(reason="idle").value >= 1
+        h.sched.policy.ledger.assert_consistent()
+
+
+async def test_restart_gcs_replicas_above_desired():
+    """Regression (review): the scale-down GC floor must come from
+    cluster truth, not the in-memory high-water — a restarted
+    controller that computes a lower desired count must still delete
+    (and release) the old replicas' StatefulSets."""
+    kube = FakeKube()
+    register_all(kube)
+
+    async def run_manager(rate):
+        mgr = Manager(kube, registry=Registry())
+        sched = TpuFleetScheduler(
+            kube, SchedulerOptions(queued_requeue_seconds=0.05),
+            fleet=Fleet.parse("pool-a=v5e:2x2:4"), registry=mgr.registry)
+        setup_notebook_controller(mgr, NotebookOptions(),
+                                  scheduler=sched)
+        setup_serving_controller(
+            mgr, ServingOptions(enabled=True,
+                                autoscale_period_seconds=0.05,
+                                default_stabilization=0.1),
+            scheduler=sched)
+        sim = PodSimulator(kube)
+        await mgr.start()
+        await sim.start()
+        await kube.patch(
+            "InferenceService", "svc",
+            {"metadata": {"annotations": {
+                isvcapi.OBSERVED_RATE_ANNOTATION: str(rate),
+                isvcapi.LAST_REQUEST_AT_ANNOTATION:
+                    fmt_iso(time.time())}}}, "user")
+        await mgr.wait_idle(timeout=20)
+        await asyncio.sleep(0.3)
+        await mgr.wait_idle(timeout=20)
+        await sim.stop()
+        await mgr.stop()
+        return sched
+
+    await kube.create("InferenceService", isvcapi.new(
+        "svc", "user", accelerator="v5e", topology="2x2",
+        min_replicas=0, max_replicas=3, target_rate=5.0))
+    sched = await run_manager(14.0)  # 3 replicas
+    assert sum(1 for k in sched.policy.ledger.allocations
+               if "#r" in k[1]) == 3
+    # "Restart": a FRESH manager/scheduler (empty in-memory high-water)
+    # over the same cluster state, now with low demand.
+    sched2 = await run_manager(2.0)  # 1 replica
+    booked = [k for k in sched2.policy.ledger.allocations
+              if "#r" in k[1]]
+    assert booked == [("user", "svc#r0")], booked
+    assert await kube.get_or_none("StatefulSet", "svc-r1", "user") is None
+    assert await kube.get_or_none("StatefulSet", "svc-r2", "user") is None
+    sched2.policy.ledger.assert_consistent()
+    assert sched2.policy.ledger.violations == 0
+    kube.close_watches()
+
+
+async def test_service_delete_releases_all_replicas():
+    async with Harness() as h:
+        await h.kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=2, max_replicas=2))
+        await h.wait_for(lambda: h.replica_admitted(0)
+                         and h.replica_admitted(1), what="2 replicas")
+        await h.kube.delete("InferenceService", "svc", "user")
+        await h.wait_for(
+            lambda: not h.sched.policy.ledger.allocations,
+            what="all chips released on delete")
+        await h.mgr.wait_idle(timeout=20)
+
+
+# ---- workload-class guards -----------------------------------------------------
+
+
+async def test_culler_never_culls_serving_class():
+    """Regression (ISSUE 11 satellite): a serving-class workload exposes
+    no Jupyter kernels — the culler must skip it entirely, probes and
+    all, instead of reading 'no kernels' as idle."""
+    kube = FakeKube()
+    probes = []
+
+    async def prober(url):
+        probes.append(url)
+        return []  # "no kernels" — reads as idle for a notebook
+
+    rec = CullingReconciler(
+        kube, prober,
+        CullingOptions(enable_culling=True, cull_idle_seconds=0.0,
+                       check_period_seconds=0.01))
+    nb = nbapi.new("served-model", "user", accelerator="v5e",
+                   topology="2x2")
+    nb["metadata"].setdefault("labels", {})[
+        isvcapi.WORKLOAD_CLASS_LABEL] = isvcapi.SERVING_CLASS
+    nb["metadata"]["annotations"] = {
+        nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(time.time() - 9999)}
+    await kube.create("Notebook", nb)
+    result = await rec.reconcile(("user", "served-model"))
+    assert result is None
+    assert not probes  # never even probed
+    live = await kube.get("Notebook", "served-model", "user")
+    assert nbapi.STOP_ANNOTATION not in annotations_of(live)
+    # The SAME shape without the label IS culled (the guard is the
+    # label, not an accident of the spec).
+    nb2 = nbapi.new("plain-nb", "user", accelerator="v5e", topology="2x2")
+    nb2["metadata"]["annotations"] = {
+        nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(time.time() - 9999)}
+    await kube.create("Notebook", nb2)
+    await rec.reconcile(("user", "plain-nb"))
+    live = await kube.get("Notebook", "plain-nb", "user")
+    ann = annotations_of(live)
+    assert nbapi.STOP_ANNOTATION in ann \
+        or migration.drain_requested_at(ann) is not None
+
+
+def test_victim_search_never_picks_serving_allocations():
+    """Regression (ISSUE 11 satellite): a serving replica — even one
+    that LOOKS idle by timestamp — is never a preemption victim; a
+    notebook holder in the same pool still is."""
+    q = PolicyQueue(fleet=Fleet.parse("pool-a=v5e:2x2:2"),
+                    config=PolicyConfig(idle_preempt_after_seconds=10.0))
+    q.ledger.admit(Allocation(
+        key=("u", "svc#r0"), namespace="u", accelerator="v5e",
+        topology="2x2", num_slices=1, chips=4,
+        placements={"pool-a": 1}, priority=100, admitted_at=0.0,
+        last_active_at=0.0, workload="serving"))
+    q.ledger.admit(Allocation(
+        key=("u", "nb"), namespace="u", accelerator="v5e",
+        topology="2x2", num_slices=1, chips=4,
+        placements={"pool-a": 1}, priority=0, admitted_at=0.0,
+        last_active_at=0.0, workload="notebook"))
+    q.submit(GangRequest(
+        key=("u", "big"), namespace="u", accelerator="v5e",
+        topology="2x2", num_slices=2, chips=8, priority=200,
+        submitted_at=0.0))
+    result = q.schedule(now=10_000.0)
+    # Even a critical-priority 2-slice waiter gets at most the notebook:
+    # one slice is reclaimable, the serving slice never is, so the gang
+    # stays queued and NO victim list formed (all-or-nothing).
+    assert not result.admitted
+    preempted = {p.key for p in result.preempted} | \
+        {p.key for p in result.drains}
+    assert ("u", "svc#r0") not in preempted
+    # A 1-slice waiter reclaims the idle notebook, never the replica.
+    q2 = PolicyQueue(fleet=Fleet.parse("pool-a=v5e:2x2:2"),
+                     config=PolicyConfig(idle_preempt_after_seconds=10.0))
+    for alloc in (
+        Allocation(key=("u", "svc#r0"), namespace="u", accelerator="v5e",
+                   topology="2x2", num_slices=1, chips=4,
+                   placements={"pool-a": 1}, priority=100,
+                   admitted_at=0.0, last_active_at=0.0,
+                   workload="serving"),
+        Allocation(key=("u", "nb"), namespace="u", accelerator="v5e",
+                   topology="2x2", num_slices=1, chips=4,
+                   placements={"pool-a": 1}, priority=0, admitted_at=0.0,
+                   last_active_at=0.0, workload="notebook"),
+    ):
+        q2.ledger.admit(alloc)
+    q2.submit(GangRequest(
+        key=("u", "one"), namespace="u", accelerator="v5e",
+        topology="2x2", num_slices=1, chips=4, priority=200,
+        submitted_at=0.0))
+    result = q2.schedule(now=10_000.0)
+    victims = {p.key for p in result.preempted}
+    assert victims == {("u", "nb")}
+    assert [a.key for a in result.admitted] == [("u", "one")]
+
+
+# ---- webhook fast-fail ---------------------------------------------------------
+
+
+async def test_webhook_rejects_over_quota_and_over_ceiling(monkeypatch):
+    monkeypatch.setenv("KFTPU_FLEET", "pool-a=v5e:2x2:2")
+    kube = FakeKube()
+    register_all(kube)
+    await kube.create("Profile", {
+        "apiVersion": "kubeflow.org/v1", "kind": "Profile",
+        "metadata": {"name": "user"},
+        "spec": {"owner": {"kind": "User", "name": "user@example.com"},
+                 "tpuQuota": 8},
+    })
+    # One replica over the namespace quota.
+    with pytest.raises(Invalid, match="tpuQuota"):
+        await kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="4x4"))
+    # Replica fits, but the minReplicas floor exceeds the quota.
+    with pytest.raises(Invalid, match="scaling floor"):
+        await kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=3, max_replicas=3))
+    # Shape the declared fleet can never host.
+    with pytest.raises(Invalid, match="ever be scheduled"):
+        await kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5p", topology="2x2x1"))
+    # Valid service admits — and maxReplicas above the ceiling is fine
+    # (surplus replicas queue by design; scale-up intents exist).
+    await kube.create("InferenceService", isvcapi.new(
+        "ok", "user", accelerator="v5e", topology="2x2",
+        min_replicas=1, max_replicas=8))
+    # UPDATEs are never capacity-checked (controller status patches
+    # must not freeze under a later-lowered ceiling).
+    await kube.patch("InferenceService", "ok",
+                     {"metadata": {"annotations": {"x": "y"}}}, "user")
+
+
+async def test_webhook_validates_scaling_shape():
+    kube = FakeKube()
+    register_all(kube)
+    bad = isvcapi.new("svc", "user", accelerator="v5e", topology="2x2")
+    bad["spec"]["scaling"] = {"minReplicas": 2, "maxReplicas": 1}
+    with pytest.raises(Invalid, match="maxReplicas"):
+        await kube.create("InferenceService", bad)
+    bad2 = isvcapi.new("svc", "user")
+    bad2["spec"]["template"]["spec"]["containers"] = []
+    with pytest.raises(Invalid, match="containers"):
+        await kube.create("InferenceService", bad2)
+
+
+# ---- status machine ------------------------------------------------------------
+
+
+def _isvc_with(state, **serving):
+    return {
+        "metadata": {"name": "svc", "namespace": "u",
+                     "creationTimestamp": "2020-01-01T00:00:00Z"},
+        "status": {"readyReplicas": serving.pop("ready", 0),
+                   "serving": {"state": state, **serving}},
+    }
+
+
+def test_process_serving_status_phases():
+    s = process_serving_status(_isvc_with(
+        "Ready", admittedReplicas=2, ready=2))
+    assert s.phase == "ready"
+    s = process_serving_status(_isvc_with(
+        "Parked", parkedCheckpoint={"path": "/c", "step": 7}))
+    assert s.phase == "stopped" and "step 7" in s.message
+    s = process_serving_status(_isvc_with("Parking"))
+    assert s.phase == "waiting" and "checkpoint" in s.message.lower()
+    s = process_serving_status(_isvc_with("Queued", queuedReplicas=2))
+    assert s.phase == "waiting" and "queued" in s.message.lower()
+    s = process_serving_status(_isvc_with(
+        "Scaling", desiredReplicas=3, queuedReplicas=1))
+    assert s.phase == "waiting"
+    deg = _isvc_with("Ready")
+    deg["status"]["conditions"] = [
+        {"type": "Degraded", "status": "True",
+         "reason": "ReconcileQuarantined"}]
+    assert process_serving_status(deg).phase == "warning"
+
+
+def test_replica_key_roundtrip():
+    key = isvcapi.replica_key("ns", "my-svc", 3)
+    assert key == ("ns", "my-svc#r3")
+    assert isvcapi.parse_replica_key(key) == ("my-svc", 3)
+    assert isvcapi.parse_replica_key(("ns", "a-notebook")) is None
+    assert isvcapi.replica_sts_name("svc", 1) == "svc-r1"
+    assert isvcapi.replica_sts_name("svc", 1, slice_id=2,
+                                    num_slices=4) == "svc-r1-s2"

@@ -1,0 +1,188 @@
+"""Autoscaler policy tests (ISSUE 11): the pure module's invariants
+under seeded random traffic, plus the ledger-composition property — the
+autoscaler's recommendations, driven through the fleet policy queue,
+can never oversell chips.
+"""
+
+import math
+import random
+
+import pytest
+
+from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.policy import GangRequest, PolicyConfig, PolicyQueue
+from kubeflow_tpu.serving.autoscaler import (
+    AutoscalerConfig,
+    AutoscalerState,
+    Signals,
+    config_from_spec,
+    desired_replicas,
+)
+
+CFG = AutoscalerConfig(
+    min_replicas=0, max_replicas=4, target_rate_per_replica=8.0,
+    target_inflight_per_replica=4.0, scale_to_zero_after_seconds=300.0,
+    scale_down_stabilization_seconds=60.0)
+
+
+def test_demand_bounds_and_ceil():
+    state = AutoscalerState(created_at=0.0)
+    d = desired_replicas(CFG, Signals(rate=8.1), 1, 10.0, state)
+    assert d.replicas == 2  # ceil(8.1/8)
+    d = desired_replicas(CFG, Signals(rate=1000.0), 2, 11.0, state)
+    assert d.replicas == 4  # clamped to max
+    cfg = AutoscalerConfig(min_replicas=2, max_replicas=4)
+    d = desired_replicas(cfg, Signals(), 2, 0.0,
+                         AutoscalerState(created_at=0.0))
+    assert d.replicas == 2  # never below min
+
+
+def test_any_demand_keeps_one_replica_even_at_min_zero():
+    state = AutoscalerState(created_at=0.0)
+    d = desired_replicas(CFG, Signals(rate=0.01), 0, 10.0, state)
+    assert d.replicas == 1
+
+
+def test_scale_to_zero_only_after_idle_window():
+    state = AutoscalerState(created_at=0.0)
+    # Quiet but inside the window: hold at one replica.
+    d = desired_replicas(CFG, Signals(rate=0.0, last_request_at=900.0),
+                         1, 1000.0, state)
+    assert d.replicas == 1 and "idle window" in d.reason
+    # Past the window (and past the stabilization hold): park.
+    state2 = AutoscalerState(created_at=0.0)
+    d = desired_replicas(CFG, Signals(rate=0.0, last_request_at=600.0),
+                         1, 1000.0, state2)
+    assert d.replicas == 0 and "scale-to-zero" in d.reason
+
+
+def test_never_seen_a_request_idles_from_creation():
+    state = AutoscalerState(created_at=100.0)
+    d = desired_replicas(CFG, Signals(), 1, 150.0, state)
+    assert d.replicas == 1  # 50s < 300s window
+    d = desired_replicas(CFG, Signals(), 1, 500.0,
+                         AutoscalerState(created_at=100.0))
+    assert d.replicas == 0
+
+
+def test_inflight_blocks_scale_to_zero():
+    state = AutoscalerState(created_at=0.0)
+    d = desired_replicas(CFG, Signals(inflight=0.5, last_request_at=0.0),
+                         1, 10_000.0, state)
+    assert d.replicas >= 1
+
+
+def test_scale_down_is_stabilized_scale_up_is_immediate():
+    cfg = AutoscalerConfig(min_replicas=1, max_replicas=4,
+                           scale_down_stabilization_seconds=60.0)
+    state = AutoscalerState(created_at=0.0)
+    assert desired_replicas(cfg, Signals(rate=30.0), 1, 0.0,
+                            state).replicas == 4  # up: immediate
+    # One quiet sample 10s later must NOT drop below the window's max.
+    d = desired_replicas(cfg, Signals(rate=2.0), 4, 10.0, state)
+    assert d.replicas == 4
+    # Quiet past the window: the drop lands.
+    d = desired_replicas(cfg, Signals(rate=2.0), 4, 100.0, state)
+    assert d.replicas == 1
+
+
+def test_monotone_in_rate():
+    """For fixed everything else, more rate never means fewer replicas."""
+    rng = random.Random(7)
+    for _ in range(50):
+        rates = sorted(rng.uniform(0, 60) for _ in range(2))
+        current = rng.randint(0, 4)
+        lo = desired_replicas(CFG, Signals(rate=rates[0]), current, 50.0,
+                              AutoscalerState(created_at=0.0)).replicas
+        hi = desired_replicas(CFG, Signals(rate=rates[1]), current, 50.0,
+                              AutoscalerState(created_at=0.0)).replicas
+        assert lo <= hi, (rates, current, lo, hi)
+
+
+def test_monotone_response_to_rate_steps():
+    """A rate STEP up never lowers the running recommendation, and the
+    recommendation tracks the step within one decision."""
+    state = AutoscalerState(created_at=0.0)
+    prev = 0
+    t = 0.0
+    for rate in (0.0, 4.0, 9.0, 17.0, 33.0):
+        t += 1.0
+        d = desired_replicas(CFG, Signals(rate=rate, last_request_at=t),
+                             prev, t, state)
+        assert d.replicas >= prev
+        assert d.replicas >= min(CFG.max_replicas,
+                                 math.ceil(rate / CFG.target_rate_per_replica))
+        prev = d.replicas
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7, 42, 1337])
+def test_property_random_traffic_holds_invariants(seed):
+    """Seeded random traffic: bounds always hold, zero only ever happens
+    after the idle window, and the ledger composition below never
+    oversells (each wanted replica bids through the policy queue over a
+    2-slice fleet; surplus replicas must queue, not overbook)."""
+    rng = random.Random(seed)
+    cfg = AutoscalerConfig(
+        min_replicas=rng.randint(0, 1), max_replicas=rng.randint(2, 5),
+        target_rate_per_replica=rng.uniform(2, 10),
+        scale_to_zero_after_seconds=rng.uniform(5, 50),
+        scale_down_stabilization_seconds=rng.uniform(1, 10))
+    state = AutoscalerState(created_at=0.0)
+    fleet = Fleet.parse("pool-a=v5e:2x2:2")
+    q = PolicyQueue(fleet=fleet,
+                    config=PolicyConfig(enable_preemption=False))
+    current = 0
+    admitted: set = set()
+    now = 0.0
+    last_request = None
+    for step in range(200):
+        now += rng.uniform(0.5, 3.0)
+        rate = rng.choice([0.0, 0.0, rng.uniform(0.1, 40.0)])
+        if rate > 0:
+            last_request = now
+        d = desired_replicas(cfg, Signals(rate=rate,
+                                          last_request_at=last_request),
+                             current, now, state)
+        # -- bounds --
+        assert cfg.min_replicas <= d.replicas <= cfg.max_replicas \
+            or d.replicas == 0
+        assert d.replicas >= cfg.min_replicas or d.replicas == 0
+        # -- zero only after the idle window --
+        if d.replicas == 0 and current > 0:
+            idle_since = last_request if last_request is not None else 0.0
+            assert now - idle_since >= cfg.scale_to_zero_after_seconds
+            assert rate == 0.0
+        # -- drive the ledger like the controller would --
+        for i in range(d.replicas):
+            key = ("ns", f"svc#r{i}")
+            if key not in admitted:
+                q.submit(GangRequest(
+                    key=key, namespace="ns", accelerator="v5e",
+                    topology="2x2", num_slices=1, chips=4,
+                    priority=100, submitted_at=now, workload="serving"))
+        for i in range(d.replicas, cfg.max_replicas + 1):
+            q.release(("ns", f"svc#r{i}"))
+            admitted.discard(("ns", f"svc#r{i}"))
+        result = q.schedule(now)
+        for a in result.admitted:
+            admitted.add(a.key)
+        # The ledger can never oversell: admit() raises (and counts a
+        # violation) rather than record over-capacity — and the full
+        # recomputation must agree.
+        q.ledger.assert_consistent()
+        assert q.ledger.violations == 0
+        assert len(admitted) <= fleet.total_slices("v5e", "2x2")
+        current = d.replicas
+
+
+def test_config_from_spec_defaults_and_garbage():
+    cfg = config_from_spec({})
+    assert cfg.min_replicas == 0 and cfg.max_replicas == 1
+    cfg = config_from_spec(
+        {"minReplicas": 2, "maxReplicas": 1,  # floor wins
+         "targetRequestsPerReplica": "garbage",
+         "scaleToZeroAfterSeconds": -5},
+        default_target_rate=6.0, default_idle_window=120.0)
+    assert cfg.max_replicas == 2
+    assert cfg.target_rate_per_replica == 6.0
+    assert cfg.scale_to_zero_after_seconds == 120.0
