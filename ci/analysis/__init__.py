@@ -1,0 +1,20 @@
+"""AST static analysis for the control plane — ``python -m ci.analysis``.
+
+See ``ci/analysis/core.py`` for the framework, ``ci/analysis/passes/``
+for the rules, and ``docs/static-analysis.md`` for the rule table and
+suppression syntax.
+"""
+
+from ci.analysis.core import (  # noqa: F401
+    REGISTRY,
+    Finding,
+    Project,
+    Report,
+    SourceFile,
+    all_rules,
+    analysis_pass,
+    load_baseline,
+    load_project,
+    run_passes,
+    write_baseline,
+)

@@ -1,0 +1,104 @@
+"""CLI: ``python -m ci.analysis [paths...]`` — exit 1 on findings.
+
+Wired into the unit-tests workflow by ci/pipelines.py (findings JSON
+uploaded as a build artifact) and re-run in-process by
+tests/test_static_analysis.py so tier-1 holds the tree at zero
+unsuppressed findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ci.analysis.core import (
+    REGISTRY,
+    REPO,
+    all_rules,
+    load_baseline,
+    load_project,
+    run_passes,
+    write_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ci.analysis",
+        description="AST static analysis for the control plane "
+                    "(docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to scan (default: kubeflow_tpu/)")
+    parser.add_argument("--root", default=REPO,
+                        help="repo root paths are relative to")
+    parser.add_argument("--json", metavar="FILE",
+                        help="write machine-readable findings JSON")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="filter findings fingerprinted in FILE "
+                             "(introduce a pass warn-only before it gates)")
+    parser.add_argument("--write-baseline", metavar="FILE",
+                        help="write the current findings as a baseline "
+                             "and exit 0")
+    parser.add_argument("--select", metavar="PASS_OR_RULE[,..]",
+                        help="run only these passes / rule ids")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    import ci.analysis.passes  # noqa: F401 — register before listing
+
+    if args.list_rules:
+        for name, p in sorted(REGISTRY.items()):
+            print(f"{name}: {p.doc}")
+            for rule in p.rules:
+                print(f"  - {rule}")
+        return 0
+
+    try:
+        project = load_project(root=args.root, paths=args.paths or None)
+    except FileNotFoundError as exc:
+        print(f"ci.analysis: error: {exc}", file=sys.stderr)
+        return 2
+    select = set(args.select.split(",")) if args.select else None
+    if select:
+        # A typo'd selector must not silently run zero passes and report
+        # clean — same hardening as the missing-path check above.
+        known = set(REGISTRY) | set(all_rules())
+        unknown = select - known
+        if unknown:
+            print(f"ci.analysis: error: unknown pass/rule selector(s): "
+                  f"{', '.join(sorted(unknown))} — see --list-rules",
+                  file=sys.stderr)
+            return 2
+    baseline = load_baseline(args.baseline) if args.baseline else None
+    report = run_passes(project, select=select, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, project, report)
+        print(f"ci.analysis: baseline of "
+              f"{len(report.findings) + len(report.baselined)} finding(s) "
+              f"written to {args.write_baseline}")
+        return 0
+
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(report.to_json(), fh, indent=2)
+            fh.write("\n")
+
+    for f in report.findings:
+        print(f"ci.analysis: {f.render()}", file=sys.stderr)
+    live = len(report.findings)
+    summary = (f"ci.analysis: {live} finding(s) over "
+               f"{len(project.files)} file(s)"
+               f" ({len(report.suppressed)} suppressed"
+               f", {len(report.baselined)} baselined)" if live else
+               f"ci.analysis: clean — {len(project.files)} file(s), "
+               f"{len(report.suppressed)} suppression(s), "
+               f"{len(report.baselined)} baselined")
+    print(summary, file=sys.stderr if live else sys.stdout)
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

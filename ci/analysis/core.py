@@ -1,0 +1,412 @@
+"""AST static-analysis framework for the control plane (ISSUE 12).
+
+The control plane is one asyncio event loop shared by five controllers,
+a fleet scheduler, migration drains, and a serving autoscaler — the bug
+classes that degrade *every* tenant at once (a blocking call on the
+loop, an annotation-key typo, a swallowed exception, an undocumented
+env knob) are exactly the ones a compiler-style pass catches for free.
+This module is the framework: passes register against it, ``__main__``
+is the CLI, ``ci/check_tracing.py`` is a thin legacy shim over the
+contract passes.
+
+Vocabulary:
+
+- a **pass** is a registered function ``fn(project) -> Iterable[Finding]``
+  owning one or more **rule ids** (kebab-case, e.g. ``exception-swallow``);
+- a **finding** anchors a rule violation to ``path:line`` with a message;
+- a **suppression** is the per-line escape hatch::
+
+      time.sleep(0.05)  # kftpu: ignore[no-blocking-in-async] worker thread
+
+  valid on the offending line or alone on the line above; the reason is
+  mandatory (an ignore without one is itself a finding), and an ignore
+  that suppresses nothing is reported as ``unused-suppression`` so stale
+  escapes can't accumulate;
+- a **baseline** (``--baseline file.json``) filters known findings by
+  fingerprint so a new pass can land warn-only before it gates.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_SCAN = "kubeflow_tpu"
+
+SUPPRESS_RE = re.compile(
+    r"#\s*kftpu:\s*ignore\[([a-z0-9-]+)\]\s*(.*?)\s*$")
+
+
+def _comment_tokens(text: str):
+    """(lineno, comment-text) for every actual COMMENT token; on
+    tokenize errors (the file already gets a syntax-error finding) fall
+    back to a line scan so suppressions still parse best-effort."""
+    import io
+    import tokenize
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for idx, line in enumerate(text.splitlines(), start=1):
+            if "#" in line:
+                yield idx, line
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation anchored to a source line."""
+
+    rule: str
+    path: str                       # repo-relative
+    line: int
+    message: str
+
+    def fingerprint(self, project: "Project") -> str:
+        """Line-number-free identity for baseline matching: the rule,
+        the file, and the TEXT of the offending line — stable across
+        unrelated edits above it."""
+        sf = project.by_path.get(self.path)
+        text = ""
+        if sf is not None and 1 <= self.line <= len(sf.lines):
+            text = sf.lines[self.line - 1].strip()
+        return f"{self.rule}::{self.path}::{text}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Suppression:
+    rule: str
+    reason: str
+    line: int                       # the comment's own line
+    used: bool = False
+
+
+@dataclass
+class SourceFile:
+    """One parsed file: text, AST, per-line suppressions."""
+
+    path: str                       # repo-relative, '/'-separated
+    abspath: str
+    text: str
+    lines: list[str]
+    tree: ast.AST | None            # None ⇒ syntax error (its own finding)
+    parse_error: str | None
+    suppressions: dict[int, list[Suppression]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, abspath: str, relpath: str) -> "SourceFile":
+        text = open(abspath, encoding="utf-8").read()
+        tree, err = None, None
+        try:
+            tree = ast.parse(text, filename=relpath)
+        except SyntaxError as exc:
+            err = f"{exc.msg} (line {exc.lineno})"
+        sf = cls(path=relpath.replace(os.sep, "/"), abspath=abspath,
+                 text=text, lines=text.splitlines(), tree=tree,
+                 parse_error=err)
+        # Tokenize so only REAL comments carry suppressions — an ignore-
+        # syntax example quoted in a docstring must be neither a phantom
+        # (unused-suppression) nor a silent mask over the next line.
+        for lineno, comment in _comment_tokens(text):
+            m = SUPPRESS_RE.search(comment)
+            if m:
+                sf.suppressions.setdefault(lineno, []).append(
+                    Suppression(rule=m.group(1), reason=m.group(2),
+                                line=lineno))
+        return sf
+
+    def suppression_for(self, rule: str, line: int) -> Suppression | None:
+        """An ignore applies to its own line, or — when the comment
+        stands alone — to the next line."""
+        for cand in (line, line - 1):
+            for sup in self.suppressions.get(cand, ()):
+                if sup.rule != rule:
+                    continue
+                if cand == line - 1 and \
+                        not self.lines[cand - 1].lstrip().startswith("#"):
+                    continue        # trailing comment binds to ITS line only
+                return sup
+        return None
+
+    def docstring_linenos(self) -> set[int]:
+        """Lines covered by module/class/function docstrings — prose, not
+        code; the literal-registry passes skip them."""
+        covered: set[int] = set()
+        if self.tree is None:
+            return covered
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.Module, ast.ClassDef,
+                                     ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                doc = body[0].value
+                covered.update(range(doc.lineno, (doc.end_lineno or doc.lineno) + 1))
+        return covered
+
+
+@dataclass
+class Project:
+    """The parsed scan set. ``full_tree`` is True for the default
+    whole-package scan — whole-tree contracts (file X must exist, every
+    knob documented) only fire then; a single-file scan still gets the
+    per-file rules."""
+
+    root: str
+    files: list[SourceFile]
+    full_tree: bool = True
+    by_path: dict[str, SourceFile] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.by_path = {sf.path: sf for sf in self.files}
+
+    def get(self, relpath: str) -> SourceFile | None:
+        return self.by_path.get(relpath)
+
+
+def load_project(root: str = REPO, paths: list[str] | None = None,
+                 full_tree: bool | None = None) -> Project:
+    """Parse ``paths`` (files or directories, relative to ``root``;
+    default: the whole ``kubeflow_tpu`` package)."""
+    scan = paths or [DEFAULT_SCAN]
+    if full_tree is None:
+        # normpath so `kubeflow_tpu/` (shell tab-completion) still counts
+        # as the whole-tree scan — a trailing slash must not silently
+        # skip every whole-tree contract while printing "clean".
+        full_tree = [os.path.normpath(e) for e in scan] == [DEFAULT_SCAN]
+    files: list[SourceFile] = []
+    seen: set[str] = set()
+    for entry in scan:
+        abspath = entry if os.path.isabs(entry) else os.path.join(root, entry)
+        if not os.path.exists(abspath):
+            # A typo'd path must not silently disable the gate ("clean —
+            # 0 file(s)", exit 0): fail loudly instead.
+            raise FileNotFoundError(f"scan path does not exist: {entry}")
+        if os.path.isfile(abspath):
+            candidates = [abspath]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(abspath):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__")
+                candidates.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py"))
+        for cand in candidates:
+            rel = os.path.relpath(cand, root)
+            if rel in seen:
+                continue
+            seen.add(rel)
+            files.append(SourceFile.load(cand, rel))
+    return Project(root=root, files=files, full_tree=full_tree)
+
+
+# ---- pass registry -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Pass:
+    name: str
+    rules: tuple[str, ...]          # rule ids this pass may emit
+    doc: str
+    fn: object                      # fn(project) -> Iterable[Finding]
+
+
+REGISTRY: dict[str, Pass] = {}
+
+
+def analysis_pass(name: str, rules: tuple[str, ...], doc: str):
+    """Register ``fn(project) -> Iterable[Finding]`` under ``name``."""
+    def deco(fn):
+        REGISTRY[name] = Pass(name=name, rules=tuple(rules), doc=doc, fn=fn)
+        return fn
+    return deco
+
+
+def all_rules() -> dict[str, str]:
+    return {rule: p.name for p in REGISTRY.values() for rule in p.rules}
+
+
+# ---- run + suppression + baseline --------------------------------------------
+
+
+@dataclass
+class Report:
+    findings: list[Finding]                 # live, unsuppressed, unbaselined
+    suppressed: list[tuple[Finding, Suppression]]
+    baselined: list[Finding]
+
+    def to_json(self) -> dict:
+        return {
+            "findings": [vars(f) for f in self.findings],
+            "suppressed": [
+                {**vars(f), "reason": s.reason}
+                for f, s in self.suppressed],
+            "baselined": [vars(f) for f in self.baselined],
+            "counts": {
+                "live": len(self.findings),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+            },
+        }
+
+
+def run_passes(project: Project, select: set[str] | None = None,
+               baseline: set[str] | None = None) -> Report:
+    """Run every registered pass (or the ``select``ed ones), apply
+    per-line suppressions, then the baseline filter, and finally flag
+    bad/unused ignores."""
+    import ci.analysis.passes  # noqa: F401 — registers on import
+
+    raw: list[Finding] = []
+    ran_rules: set[str] = set()
+    for p in REGISTRY.values():
+        if select and p.name not in select \
+                and not (select & set(p.rules)):
+            continue
+        ran_rules.update(p.rules)
+        raw.extend(p.fn(project))
+    for sf in project.files:
+        if sf.parse_error is not None:
+            raw.append(Finding(
+                rule="syntax-error", path=sf.path, line=1,
+                message=f"file does not parse: {sf.parse_error}"))
+
+    live: list[Finding] = []
+    suppressed: list[tuple[Finding, Suppression]] = []
+    reasonless_reported: set[int] = set()
+    for f in raw:
+        sf = project.get(f.path)
+        sup = sf.suppression_for(f.rule, f.line) if sf else None
+        if sup is not None:
+            sup.used = True
+            # once per SUPPRESSION, not per finding it masks
+            if not sup.reason and id(sup) not in reasonless_reported:
+                reasonless_reported.add(id(sup))
+                live.append(Finding(
+                    rule="bad-suppression", path=f.path, line=sup.line,
+                    message=f"ignore[{f.rule}] carries no reason — say WHY "
+                            "the rule does not apply here"))
+            suppressed.append((f, sup))
+        else:
+            live.append(f)
+
+    known_rules = set(all_rules()) | {"syntax-error"}
+    for sf in project.files:
+        for sups in sf.suppressions.values():
+            for sup in sups:
+                if sup.rule not in known_rules:
+                    live.append(Finding(
+                        rule="unknown-rule", path=sf.path, line=sup.line,
+                        message=f"ignore[{sup.rule}] names no registered "
+                                f"rule — known: {', '.join(sorted(known_rules))}"))
+                elif not sup.used and sup.rule in ran_rules:
+                    live.append(Finding(
+                        rule="unused-suppression", path=sf.path,
+                        line=sup.line,
+                        message=f"ignore[{sup.rule}] suppresses nothing — "
+                                "the violation is gone; delete the escape "
+                                "hatch"))
+
+    baselined: list[Finding] = []
+    if baseline:
+        still_live = []
+        for f in live:
+            if f.fingerprint(project) in baseline:
+                baselined.append(f)
+            else:
+                still_live.append(f)
+        live = still_live
+    live.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(findings=live, suppressed=suppressed, baselined=baselined)
+
+
+def load_baseline(path: str) -> set[str]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return set(data.get("fingerprints", []))
+
+
+def write_baseline(path: str, project: Project, report: Report) -> None:
+    fingerprints = sorted(
+        f.fingerprint(project)
+        for f in report.findings + report.baselined)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"comment": "ci.analysis baseline — findings grand-"
+                              "fathered while their pass runs warn-only",
+                   "fingerprints": fingerprints}, fh, indent=2)
+        fh.write("\n")
+
+
+# ---- shared AST helpers (used by the pass modules) ---------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of the called thing: ``f`` for ``f(...)``,
+    ``sleep`` for ``time.sleep(...)`` / ``a.b.sleep(...)``."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def dotted_name(node: ast.expr) -> str:
+    """Best-effort dotted rendering: ``time.sleep``,
+    ``urllib.request.urlopen``, ``self.kube.get``."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif isinstance(cur, ast.Call):
+        parts.append(dotted_name(cur.func) + "()")
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def str_const(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor tracking the enclosing function stack; passes that
+    care whether code runs on the event loop ask :meth:`in_async` —
+    the INNERMOST enclosing def decides (a sync closure inside an async
+    def is not itself loop-bound)."""
+
+    def __init__(self) -> None:
+        self.func_stack: list[ast.AST] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def in_async(self) -> bool:
+        return bool(self.func_stack) and isinstance(
+            self.func_stack[-1], ast.AsyncFunctionDef)
+
+    def enclosing_function(self) -> ast.AST | None:
+        return self.func_stack[-1] if self.func_stack else None

@@ -1,0 +1,17 @@
+"""Pass modules — importing this package registers every pass.
+
+Adding a pass: create a module here, decorate a generator with
+``@analysis_pass(name, (rule-ids...), doc)``, import it below, and add
+fixture tests (one true-positive, one false-positive, one suppression)
+to ``tests/test_static_analysis.py``. New passes can land warn-only by
+shipping a ``--baseline`` file (docs/static-analysis.md).
+"""
+
+from ci.analysis.passes import (  # noqa: F401
+    blocking,
+    contracts,
+    coroutines,
+    envknobs,
+    keys,
+    swallow,
+)

@@ -1,0 +1,142 @@
+"""``no-blocking-in-async``: the event loop must never block.
+
+The whole control plane — five controllers, the fleet scheduler,
+migration drains, the serving autoscaler — shares ONE asyncio loop; a
+single ``time.sleep`` or sync HTTP round trip inside it stalls every
+tenant's reconciles at once. Three shapes are flagged:
+
+1. a known blocking call (``time.sleep``, sync subprocess / HTTP /
+   file IO) whose INNERMOST enclosing function is ``async def``;
+2. ``time.sleep`` anywhere in the package, any scope — sync helpers in
+   an asyncio codebase run on the loop unless explicitly threaded, so
+   code that really runs in a worker thread documents itself with a
+   suppression (``serving/engine.py`` is the canonical one);
+3. a sync ``with <lock>:`` whose body awaits — holding a threading lock
+   across a suspension point deadlocks the loop the moment a second
+   task wants the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.analysis.core import (
+    Finding,
+    Project,
+    ScopedVisitor,
+    analysis_pass,
+    dotted_name,
+)
+
+RULE = "no-blocking-in-async"
+
+# dotted-name suffixes that block the thread they run on
+BLOCKING_CALLS = {
+    "time.sleep": "sleeps the whole event loop — use `await asyncio.sleep`",
+    "subprocess.run": "sync subprocess blocks the loop — use "
+                      "`asyncio.create_subprocess_exec`",
+    "subprocess.call": "sync subprocess blocks the loop",
+    "subprocess.check_call": "sync subprocess blocks the loop",
+    "subprocess.check_output": "sync subprocess blocks the loop",
+    "subprocess.Popen": "sync subprocess management blocks the loop",
+    "os.system": "sync subprocess blocks the loop",
+    "urllib.request.urlopen": "sync HTTP blocks the loop — use the shared "
+                              "aiohttp client",
+    "socket.create_connection": "sync connect blocks the loop",
+}
+# requests.<verb>(...) — the sync HTTP client
+REQUESTS_VERBS = {"get", "post", "put", "patch", "delete", "head", "request"}
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    dn = dotted_name(call.func)
+    for suffix, why in BLOCKING_CALLS.items():
+        if dn == suffix or dn.endswith("." + suffix):
+            return why
+    if isinstance(call.func, ast.Attribute) \
+            and isinstance(call.func.value, ast.Name) \
+            and call.func.value.id == "requests" \
+            and call.func.attr in REQUESTS_VERBS:
+        return "sync HTTP (requests) blocks the loop — use aiohttp"
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        return "sync file IO blocks the loop — read it before entering " \
+               "async code or hand it to a thread"
+    return None
+
+
+def _mentions_lock(expr: ast.expr) -> bool:
+    """Heuristic: the context manager names a lock (``self._lock``,
+    ``threading.Lock()``, ``store.lock``)."""
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and "lock" in name.lower():
+            return True
+    return False
+
+
+def _awaits_in_scope(node: ast.AST) -> bool:
+    """A suspension point (``await`` / ``async with`` / ``async for``)
+    in THIS function's scope — a nested def merely *defined* inside the
+    with-body runs later, off the lock."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return False
+    if isinstance(node, (ast.Await, ast.AsyncWith, ast.AsyncFor)):
+        return True
+    return any(_awaits_in_scope(child) for child in ast.iter_child_nodes(node))
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        why = _blocking_reason(node)
+        if why is not None:
+            dn = dotted_name(node.func)
+            if self.in_async():
+                self.findings.append(Finding(
+                    rule=RULE, path=self.path, line=node.lineno,
+                    message=f"`{dn}(...)` inside `async def`: {why}"))
+            elif dn.endswith("time.sleep") or dn == "time.sleep":
+                # Sync scope, but still the loop's process: only an
+                # explicitly-threaded worker may sleep, and it says so
+                # with a suppression.
+                self.findings.append(Finding(
+                    rule=RULE, path=self.path, line=node.lineno,
+                    message="`time.sleep(...)` in an asyncio control "
+                            "plane: sync helpers run on the loop unless "
+                            "explicitly threaded — if this provably runs "
+                            "in a worker thread, suppress with the thread "
+                            "named in the reason"))
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        if self.in_async() and any(
+                _mentions_lock(item.context_expr) for item in node.items) \
+                and any(_awaits_in_scope(stmt) for stmt in node.body):
+            self.findings.append(Finding(
+                rule=RULE, path=self.path, line=node.lineno,
+                message="sync `with <lock>:` held across `await` — "
+                        "every other task wanting this lock deadlocks "
+                        "the loop; use `asyncio.Lock` with `async with`"))
+        self.generic_visit(node)
+
+
+@analysis_pass(
+    "blocking", (RULE,),
+    "blocking calls (time.sleep, sync HTTP/subprocess/file IO, lock-held "
+    "awaits) on the shared event loop")
+def check_blocking(project: Project):
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        visitor = _Visitor(sf.path)
+        visitor.visit(sf.tree)
+        yield from visitor.findings

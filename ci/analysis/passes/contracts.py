@@ -1,0 +1,489 @@
+"""Contract passes: the architectural invariants CI refuses to lose.
+
+AST port of the regex contracts that grew in ``ci/check_tracing.py``
+over PRs 3–11 (tracing phases, apply_set stages, scheduler gate,
+migration drains, quarantine observability, elastic reclaim, serving
+park) — now scope-aware (a ``_stop_victim`` call is only a bare-stop
+bypass when it is *inside* ``_sweep_spot_reclaims``; ``_park_all`` must
+be called exactly once and the AST knows from where) and
+rename-tolerant (identifiers, not source-text shapes). Each contract
+guards a refactor trap: the invariant a later rewrite would most
+plausibly drop without noticing, named in the message.
+
+``ci/check_tracing.py`` remains the legacy entrypoint as a thin shim
+over :func:`file_tracing_problems` / the ``contracts`` pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ci.analysis.core import (
+    Finding,
+    Project,
+    SourceFile,
+    analysis_pass,
+    call_name,
+    str_const,
+)
+
+RULES = (
+    "contract-tracing", "contract-apply-set", "contract-scheduler",
+    "contract-migration", "contract-quarantine", "contract-elastic",
+    "contract-serving",
+)
+
+CONTROLLERS_DIR = "kubeflow_tpu/controllers"
+MIN_PHASES = 2
+REQUIRED_PHASES = ("cache_read",)
+APPLY_SET_REQUIRED = (
+    "notebook.py", "tensorboard.py", "pvcviewer.py", "profile.py",
+)
+
+SCHEDULER_RUNTIME = "kubeflow_tpu/scheduler/runtime.py"
+SCHEDULER_PHASES = ("schedule", "admit", "preempt")
+NOTEBOOK_CONTROLLER = "kubeflow_tpu/controllers/notebook.py"
+POLICY_FILE = "kubeflow_tpu/scheduler/policy.py"
+MIGRATION_PROTOCOL = "kubeflow_tpu/migration/protocol.py"
+MIGRATION_PHASES = ("drain", "checkpoint_ack", "restore")
+ELASTIC_FILE = "kubeflow_tpu/scheduler/elastic.py"
+ELASTIC_PHASES = ("scale_up", "reclaim", "defrag")
+MANAGER_FILE = "kubeflow_tpu/runtime/manager.py"
+QUEUE_FILE = "kubeflow_tpu/runtime/queue.py"
+SERVING_CONTROLLER = "kubeflow_tpu/serving/controller.py"
+SERVING_ENGINE = "kubeflow_tpu/serving/engine.py"
+SERVING_PHASES = ("autoscale", "warm_restore", "park")
+
+
+# ---- AST query helpers -------------------------------------------------------
+
+
+def span_names(tree: ast.AST) -> set[str]:
+    """Literal first args of ``span("...")`` opened as context managers —
+    the phase names /debug/traces shows."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                call = item.context_expr
+                if isinstance(call, ast.Call) and call_name(call) == "span":
+                    s = str_const(call.args[0]) if call.args else None
+                    if s:
+                        names.add(s)
+    return names
+
+
+def trace_names(tree: ast.AST) -> set[str]:
+    """Literal first args of ``tracer.trace("...")`` / ``span("...")``
+    calls in ANY position (the quarantine announcement opens its own
+    root trace)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) in ("trace",
+                                                              "span"):
+            s = str_const(node.args[0]) if node.args else None
+            if s:
+                names.add(s)
+    return names
+
+
+def calls_to(tree: ast.AST, name: str) -> list[ast.Call]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, ast.Call) and call_name(n) == name]
+
+
+def find_def(tree: ast.AST, name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def has_identifier(tree: ast.AST, name: str) -> bool:
+    """Rename-tolerant presence: any Name / attribute / parameter /
+    keyword-arg / def with this identifier."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id == name:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == name:
+            return True
+        if isinstance(node, ast.arg) and node.arg == name:
+            return True
+        if isinstance(node, ast.keyword) and node.arg == name:
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node.name == name:
+            return True
+    return False
+
+
+def has_str_literal(tree: ast.AST, value: str) -> bool:
+    return any(isinstance(n, ast.Constant) and n.value == value
+               for n in ast.walk(tree))
+
+
+def imports_span_from_tracing(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.endswith("tracing") \
+                and any(a.name == "span" for a in node.names):
+            return True
+    return False
+
+
+def _missing(project: Project, relpath: str, why: str,
+             rule: str) -> list[Finding]:
+    if not project.full_tree:
+        return []
+    anchor = project.files[0].path if project.files else relpath
+    return [Finding(rule=rule, path=anchor, line=1,
+                    message=f"{relpath}: missing — {why}")]
+
+
+# ---- per-file tracing + apply_set (shared with the check_tracing shim) -------
+
+
+def file_tracing_problems(sf: SourceFile, *,
+                          apply_set_required: bool = False) -> list[Finding]:
+    """ISSUE 3/4 contracts for one controller module: a reconciler
+    registers its phases; a child-applying controller stays on
+    apply_set with literal-named stages."""
+    if sf.tree is None:
+        return []
+    reconcile = find_def(sf.tree, "reconcile")
+    findings: list[Finding] = []
+    phases = span_names(sf.tree)
+    if reconcile is not None and isinstance(reconcile, ast.AsyncFunctionDef):
+        if not imports_span_from_tracing(sf.tree):
+            findings.append(Finding(
+                rule="contract-tracing", path=sf.path, line=reconcile.lineno,
+                message="defines a reconciler but never imports span from "
+                        "kubeflow_tpu.runtime.tracing"))
+        if len(phases) < MIN_PHASES:
+            findings.append(Finding(
+                rule="contract-tracing", path=sf.path, line=reconcile.lineno,
+                message=f"reconciler opens {len(phases)} distinct phase "
+                        f"span(s) ({sorted(phases)}); at least {MIN_PHASES} "
+                        "required — wrap the reconcile phases (cache_read/"
+                        "apply/status/...) in `with span(...)`"))
+        for required in REQUIRED_PHASES:
+            if required not in phases:
+                findings.append(Finding(
+                    rule="contract-tracing", path=sf.path,
+                    line=reconcile.lineno,
+                    message=f"missing the `{required}` phase span"))
+    apply_calls = calls_to(sf.tree, "apply_set")
+    if apply_calls:
+        stage_literals = [c for c in calls_to(sf.tree, "Stage")
+                          if c.args and str_const(c.args[0])]
+        if not stage_literals:
+            findings.append(Finding(
+                rule="contract-apply-set", path=sf.path,
+                line=apply_calls[0].lineno,
+                message="calls apply_set but declares no literal-named "
+                        "Stage('...') — the apply_stage spans would be "
+                        "unnamed and /debug/traces can't show which "
+                        "dependency stage ate the time"))
+    elif apply_set_required and reconcile is not None:
+        findings.append(Finding(
+            rule="contract-apply-set", path=sf.path, line=reconcile.lineno,
+            message="child-applying controller no longer goes through "
+                    "apply_set — children apply as serial round trips "
+                    "(latency hiding regression, ISSUE 4)"))
+    return findings
+
+
+# ---- whole-tree contracts ----------------------------------------------------
+
+
+def _check_controllers(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if os.path.dirname(sf.path) != CONTROLLERS_DIR:
+            continue
+        findings.extend(file_tracing_problems(
+            sf, apply_set_required=(
+                os.path.basename(sf.path) in APPLY_SET_REQUIRED)))
+    return findings
+
+
+def _check_scheduler(project: Project) -> list[Finding]:
+    rt = project.get(SCHEDULER_RUNTIME)
+    if rt is None or rt.tree is None:
+        return _missing(project, SCHEDULER_RUNTIME,
+                        "the fleet scheduler runtime is the notebook "
+                        "capacity stage's admission point (ISSUE 5)",
+                        "contract-scheduler")
+    findings = []
+    phases = span_names(rt.tree)
+    for phase in SCHEDULER_PHASES:
+        if phase not in phases:
+            findings.append(Finding(
+                rule="contract-scheduler", path=rt.path, line=1,
+                message=f"missing the `{phase}` phase span — scheduler "
+                        "decisions must land in the reconcile trace tree"))
+    nb = project.get(NOTEBOOK_CONTROLLER)
+    if nb is None or nb.tree is None:
+        findings.extend(_missing(
+            project, NOTEBOOK_CONTROLLER,
+            "the notebook controller hosts the scheduler gate",
+            "contract-scheduler"))
+    else:
+        gate_calls = calls_to(nb.tree, "_scheduler_gate")
+        if not gate_calls:
+            findings.append(Finding(
+                rule="contract-scheduler", path=nb.path, line=1,
+                message="the capacity stage no longer awaits "
+                        "_scheduler_gate — slice StatefulSets would be "
+                        "created without fleet admission (silent "
+                        "scheduler bypass)"))
+        gate_def = find_def(nb.tree, "_scheduler_gate")
+        if gate_def is None or not (calls_to(gate_def, "admission")
+                                    or calls_to(gate_def, "release")):
+            findings.append(Finding(
+                rule="contract-scheduler", path=nb.path,
+                line=gate_def.lineno if gate_def else 1,
+                message="_scheduler_gate no longer consults the scheduler "
+                        "(.admission()/.release()) — the gate is a stub"))
+    return findings
+
+
+def _check_migration(project: Project) -> list[Finding]:
+    if project.full_tree and project.get(MIGRATION_PROTOCOL) is None:
+        return _missing(project, MIGRATION_PROTOCOL,
+                        "the drain/checkpoint/restore protocol is the "
+                        "migration subsystem's wire contract (ISSUE 7)",
+                        "contract-migration")
+    rt = project.get(SCHEDULER_RUNTIME)
+    if rt is None or rt.tree is None:
+        return []
+    findings = []
+    phases = span_names(rt.tree)
+    for phase in MIGRATION_PHASES:
+        if phase not in phases:
+            findings.append(Finding(
+                rule="contract-migration", path=rt.path, line=1,
+                message=f"missing the `{phase}` migration phase span — "
+                        "drain round trips must land in the reconcile "
+                        "trace tree"))
+    # the drains route is either `result.drains` or the defensive
+    # `getattr(result, "drains", ())` — identifier or string literal
+    if not calls_to(rt.tree, "_request_drain") \
+            or not (has_identifier(rt.tree, "drains")
+                    or has_str_literal(rt.tree, "drains")):
+        findings.append(Finding(
+            rule="contract-migration", path=rt.path, line=1,
+            message="the preempt path no longer routes policy drain "
+                    "verdicts through _request_drain — with migration "
+                    "enabled, victims would be bare-stopped and lose "
+                    "in-flight training state (silent migration bypass)"))
+    policy = project.get(POLICY_FILE)
+    if policy is None or policy.tree is None:
+        findings.extend(_missing(
+            project, POLICY_FILE,
+            "the policy layer owns deferred_preemption",
+            "contract-migration"))
+    elif not has_identifier(policy.tree, "deferred_preemption"):
+        findings.append(Finding(
+            rule="contract-migration", path=policy.path, line=1,
+            message="deferred_preemption mode is gone — the runtime has "
+                    "no way to hold chips while a victim checkpoints"))
+    return findings
+
+
+def _check_quarantine(project: Project) -> list[Finding]:
+    mgr = project.get(MANAGER_FILE)
+    if mgr is None or mgr.tree is None:
+        return _missing(project, MANAGER_FILE,
+                        "the manager owns the poison-pill quarantine path "
+                        "(ISSUE 9)", "contract-quarantine")
+    findings = []
+    if not calls_to(mgr.tree, "quarantine"):
+        findings.append(Finding(
+            rule="contract-quarantine", path=mgr.path, line=1,
+            message="the worker no longer quarantines exhausted keys — a "
+                    "poison pill would retry at max backoff forever "
+                    "(ISSUE 9 regression)"))
+    if "quarantine" not in trace_names(mgr.tree):
+        findings.append(Finding(
+            rule="contract-quarantine", path=mgr.path, line=1,
+            message="the quarantine path opens no `quarantine` span — "
+                    "dead-lettering must land in /debug/traces"))
+    if not has_str_literal(mgr.tree, "ReconcileQuarantined"):
+        findings.append(Finding(
+            rule="contract-quarantine", path=mgr.path, line=1,
+            message="the quarantine path no longer emits the "
+                    "ReconcileQuarantined Warning Event"))
+    if not has_str_literal(mgr.tree, "Degraded"):
+        findings.append(Finding(
+            rule="contract-quarantine", path=mgr.path, line=1,
+            message="the quarantine path no longer stamps the Degraded "
+                    "condition — the web apps and kubectl watchers would "
+                    "see a silently-frozen object"))
+    queue = project.get(QUEUE_FILE)
+    if queue is None or queue.tree is None:
+        findings.extend(_missing(
+            project, QUEUE_FILE,
+            "the workqueue owns the quarantine release escape hatch",
+            "contract-quarantine"))
+    elif find_def(queue.tree, "release_quarantined") is None:
+        findings.append(Finding(
+            rule="contract-quarantine", path=queue.path, line=1,
+            message="release_quarantined is gone — the manual "
+                    "/debug/queue/requeue escape hatch has nothing to "
+                    "call"))
+    return findings
+
+
+def _check_elastic(project: Project) -> list[Finding]:
+    el = project.get(ELASTIC_FILE)
+    if el is None or el.tree is None:
+        return _missing(project, ELASTIC_FILE,
+                        "the elastic fleet policy core (scale-up intents, "
+                        "spot reclaim, defrag) is gone (ISSUE 10)",
+                        "contract-elastic")
+    findings = []
+    for needed in ("plan_defrag", "compute_shortfalls", "IntentBook"):
+        if not has_identifier(el.tree, needed):
+            findings.append(Finding(
+                rule="contract-elastic", path=el.path, line=1,
+                message=f"`{needed}` is gone — the elastic policy core "
+                        "lost a capability the runtime depends on"))
+    rt = project.get(SCHEDULER_RUNTIME)
+    if rt is None or rt.tree is None:
+        return findings
+    phases = span_names(rt.tree)
+    for phase in ELASTIC_PHASES:
+        if phase not in phases:
+            findings.append(Finding(
+                rule="contract-elastic", path=rt.path, line=1,
+                message=f"missing the `{phase}` elastic phase span — "
+                        "scale-up/reclaim/defrag decisions must land in "
+                        "/debug/traces"))
+    sweep = find_def(rt.tree, "_sweep_spot_reclaims")
+    if sweep is None:
+        findings.append(Finding(
+            rule="contract-elastic", path=rt.path, line=1,
+            message="_sweep_spot_reclaims is gone — spot revocations "
+                    "would kill work in flight instead of draining it"))
+    else:
+        if not calls_to(sweep, "_request_drain"):
+            findings.append(Finding(
+                rule="contract-elastic", path=rt.path, line=sweep.lineno,
+                message="spot reclaim no longer routes through "
+                        "_request_drain — a revocation would bypass the "
+                        "checkpoint drain protocol"))
+        if calls_to(sweep, "_stop_victim") \
+                or has_identifier(sweep, "STOP_ANNOTATION"):
+            findings.append(Finding(
+                rule="contract-elastic", path=rt.path, line=sweep.lineno,
+                message="_sweep_spot_reclaims stops victims directly "
+                        "(bare-stop bypass) — reclaim must checkpoint "
+                        "first; the grace-deadline fallback lives in "
+                        "_finalize_drain"))
+    return findings
+
+
+def _check_serving(project: Project) -> list[Finding]:
+    ctl = project.get(SERVING_CONTROLLER)
+    if ctl is None or ctl.tree is None:
+        return _missing(project, SERVING_CONTROLLER,
+                        "the serving workload class (ISSUE 11) lost its "
+                        "controller", "contract-serving")
+    findings = []
+    phases = span_names(ctl.tree)
+    for phase in SERVING_PHASES:
+        if phase not in phases:
+            findings.append(Finding(
+                rule="contract-serving", path=ctl.path, line=1,
+                message=f"missing the `{phase}` serving phase span — "
+                        "autoscaling/park/restore decisions must land in "
+                        "/debug/traces"))
+    drain_def = find_def(ctl.tree, "_drain_to_park")
+    if drain_def is None or not calls_to(ctl.tree, "_drain_to_park"):
+        findings.append(Finding(
+            rule="contract-serving", path=ctl.path, line=1,
+            message="scale-to-zero no longer routes through "
+                    "_drain_to_park — parking without a checkpoint "
+                    "request is a bare-stop bypass of the drain protocol "
+                    "for serving replicas"))
+    else:
+        if not has_identifier(drain_def, "park_acked") \
+                or not has_identifier(drain_def, "park_grace_seconds"):
+            findings.append(Finding(
+                rule="contract-serving", path=ctl.path,
+                line=drain_def.lineno,
+                message="_drain_to_park no longer waits for the "
+                        "checkpoint ack (or the grace deadline) before "
+                        "parking"))
+        park_calls = calls_to(ctl.tree, "_park_all")
+        park_in_drain = calls_to(drain_def, "_park_all")
+        if len(park_calls) != 1 or not park_in_drain:
+            findings.append(Finding(
+                rule="contract-serving", path=ctl.path,
+                line=park_calls[0].lineno if park_calls
+                else drain_def.lineno,
+                message="_park_all must be called exactly once, from "
+                        "_drain_to_park — any other caller is a bare-stop "
+                        "bypass of the park drain"))
+    eng = project.get(SERVING_ENGINE)
+    if eng is None or eng.tree is None:
+        findings.extend(_missing(project, SERVING_ENGINE,
+                                 "the serving engine is gone",
+                                 "contract-serving"))
+    elif "serve" not in span_names(eng.tree):
+        findings.append(Finding(
+            rule="contract-serving", path=eng.path, line=1,
+            message="missing the `serve` span — the serving loop must "
+                    "land in /debug/traces"))
+    policy = project.get(POLICY_FILE)
+    if policy is None or policy.tree is None:
+        findings.extend(_missing(
+            project, POLICY_FILE,
+            "the policy layer owns the serving workload-class guard",
+            "contract-serving"))
+    elif not _has_workload_guard(policy.tree):
+        findings.append(Finding(
+            rule="contract-serving", path=policy.path, line=1,
+            message="the workload-class guard is gone from the victim "
+                    "search — serving replicas (no activity signal) "
+                    "would be preempted as idle notebooks"))
+    return findings
+
+
+def _has_workload_guard(tree: ast.AST) -> bool:
+    """A ``workload != "notebook"``-shaped compare (either operand
+    order) — the victim-search exclusion for serving allocations."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not isinstance(node.ops[0], (ast.NotEq, ast.Eq)):
+            continue
+        operands = [node.left] + node.comparators
+        has_notebook = any(
+            isinstance(o, ast.Constant) and o.value == "notebook"
+            for o in operands)
+        mentions_workload = any(
+            (isinstance(o, ast.Name) and "workload" in o.id)
+            or (isinstance(o, ast.Attribute) and "workload" in o.attr)
+            for o in operands)
+        if has_notebook and mentions_workload:
+            return True
+    return False
+
+
+@analysis_pass(
+    "contracts", RULES,
+    "architectural invariants from PRs 3-11: tracing phases, apply_set "
+    "stages, scheduler gate, migration drains, quarantine observability, "
+    "elastic reclaim-safety, serving park protocol")
+def check_contracts(project: Project):
+    yield from _check_controllers(project)
+    if project.full_tree:
+        yield from _check_scheduler(project)
+        yield from _check_migration(project)
+        yield from _check_quarantine(project)
+        yield from _check_elastic(project)
+        yield from _check_serving(project)

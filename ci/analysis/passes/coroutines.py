@@ -1,0 +1,99 @@
+"""``unawaited-coroutine`` / ``orphan-task``: async results must land.
+
+A coroutine called without ``await`` never runs — the reconcile that
+"emitted an event" or "released a gang" silently did neither, and
+asyncio's only tell is a GC-time warning nobody reads in production.
+``create_task``/``ensure_future`` without a held reference is the
+sibling bug: the task can be garbage-collected mid-flight and its
+exception is swallowed with it.
+
+Detection is scope-aware and deliberately low-false-positive: a bare
+statement call is only flagged when the callee resolves to an ``async
+def`` *in the same module* (module function, or ``self.method`` /
+``cls.method`` against methods defined in the file) — cross-module
+resolution without types would guess, and a wrong guess trains people
+to ignore the pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.analysis.core import (
+    Finding,
+    Project,
+    ScopedVisitor,
+    analysis_pass,
+    call_name,
+)
+
+RULE_UNAWAITED = "unawaited-coroutine"
+RULE_ORPHAN = "orphan-task"
+
+TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _collect_defs(tree: ast.AST) -> tuple[set[str], set[str]]:
+    """(async def names, sync def names) defined anywhere in the module.
+    A name defined both ways is ambiguous and excluded by the caller."""
+    async_names: set[str] = set()
+    sync_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            async_names.add(node.name)
+        elif isinstance(node, ast.FunctionDef):
+            sync_names.add(node.name)
+    return async_names, sync_names
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, path: str, async_names: set[str]) -> None:
+        super().__init__()
+        self.path = path
+        self.async_names = async_names
+        self.findings: list[Finding] = []
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call):
+            name = call_name(call)
+            if name in TASK_SPAWNERS:
+                self.findings.append(Finding(
+                    rule=RULE_ORPHAN, path=self.path, line=node.lineno,
+                    message=f"`{name}(...)` result discarded — an "
+                            "unreferenced task can be GC'd mid-flight and "
+                            "its exception vanishes; hold the reference "
+                            "and handle/log its outcome"))
+            elif self._is_local_coroutine_call(call):
+                self.findings.append(Finding(
+                    rule=RULE_UNAWAITED, path=self.path, line=node.lineno,
+                    message=f"`{name}(...)` is an `async def` in this "
+                            "module called without `await` — the coroutine "
+                            "is created and dropped; it never runs"))
+        self.generic_visit(node)
+
+    def _is_local_coroutine_call(self, call: ast.Call) -> bool:
+        name = call_name(call)
+        if name not in self.async_names:
+            return False
+        func = call.func
+        if isinstance(func, ast.Name):
+            return True
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("self", "cls"):
+            return True
+        return False
+
+
+@analysis_pass(
+    "coroutines", (RULE_UNAWAITED, RULE_ORPHAN),
+    "coroutines called without await; create_task results discarded")
+def check_coroutines(project: Project):
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        async_names, sync_names = _collect_defs(sf.tree)
+        visitor = _Visitor(sf.path, async_names - sync_names)
+        visitor.visit(sf.tree)
+        yield from visitor.findings

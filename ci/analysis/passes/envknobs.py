@@ -1,0 +1,146 @@
+"""``env-knob-registry`` / ``env-knob-docs``: every knob declared + documented.
+
+The control plane's config surface is env vars (``KFTPU_*`` switches,
+``KUBE_CLIENT_*`` flow-control tuning). Two drift classes:
+
+- **registry drift**: ``os.environ.get("KFTPU_X")`` inline at a call
+  site — the knob exists only as a buried literal, invisible to
+  operators and to this analysis. A knob read routes through
+  ``kubeflow_tpu/cmd/envconfig.py`` (the unified env→Options layer) or
+  reads a module-level declared constant (``FOO_ENV = "KFTPU_X"`` — the
+  established idiom of flowcontrol/httpclient/apply/compilecache).
+- **docs drift**: a knob in code but not in ``docs/operations.md`` is a
+  production switch nobody can find (36 in code vs 32 documented when
+  this pass first ran).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ci.analysis.core import (
+    Finding,
+    Project,
+    analysis_pass,
+    call_name,
+    str_const,
+)
+
+RULE_REGISTRY = "env-knob-registry"
+RULE_DOCS = "env-knob-docs"
+
+KNOB_RE = re.compile(r"^(KFTPU_|KUBE_CLIENT_)[A-Z0-9_]+$")
+ENVCONFIG = "kubeflow_tpu/cmd/envconfig.py"
+DOCS = os.path.join("docs", "operations.md")
+# envconfig's typed accessors — calling them IS routing through the
+# registry, wherever the call site lives.
+ENV_ACCESSORS = {"env_str", "env_bool", "env_float", "env_int"}
+
+
+def _environ_receiver(func: ast.expr) -> bool:
+    """``<recv>.get(...)`` where recv smells like an environ mapping:
+    ``os.environ``, a bare/self ``environ`` / ``_environ`` (the
+    repo's testable-accessor idiom passes ``environ=os.environ``)."""
+    if not isinstance(func, ast.Attribute) or func.attr != "get":
+        return False
+    recv = func.value
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in ("environ", "_environ")
+    if isinstance(recv, ast.Name):
+        return recv.id in ("environ", "_environ")
+    return False
+
+
+def _module_constants(tree: ast.AST) -> set[str]:
+    """String values bound by module-level (or class-level) Assign /
+    AnnAssign — the 'declared constant' shapes."""
+    consts: set[str] = set()
+    for node in ast.walk(tree):
+        value = None
+        if isinstance(node, ast.Assign):
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):
+            value = node.value
+        if value is None:
+            continue
+        s = str_const(value)
+        if s is not None:
+            consts.add(s)
+    return consts
+
+
+@analysis_pass(
+    "env-knobs", (RULE_REGISTRY, RULE_DOCS),
+    "KFTPU_*/KUBE_CLIENT_* reads must route through cmd/envconfig.py or "
+    "a declared constant, and every knob must appear in docs/operations.md")
+def check_env_knobs(project: Project):
+    documented: set[str] = set()
+    docs_path = os.path.join(project.root, DOCS)
+    docs_exists = os.path.exists(docs_path)
+    if docs_exists:
+        text = open(docs_path, encoding="utf-8").read()
+        documented = set(re.findall(r"(?:KFTPU_|KUBE_CLIENT_)[A-Z0-9_]+",
+                                    text))
+
+    seen_doc_findings: set[str] = set()
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        declared = _module_constants(sf.tree)
+        docstrings = sf.docstring_linenos()
+        for node in ast.walk(sf.tree):
+            knob, line, is_read = None, None, False
+            if isinstance(node, ast.Call):
+                s = str_const(node.args[0]) if node.args else None
+                if s is None or not KNOB_RE.match(s):
+                    continue
+                if _environ_receiver(node.func) \
+                        or call_name(node) in ("getenv",):
+                    knob, line, is_read = s, node.lineno, True
+                elif call_name(node) in ENV_ACCESSORS:
+                    knob, line = s, node.lineno   # routed read — registry ok
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and _environ_subscript(node):
+                s = str_const(node.slice)
+                if s is not None and KNOB_RE.match(s):
+                    knob, line, is_read = s, node.lineno, True
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and KNOB_RE.match(node.value) \
+                    and node.lineno not in docstrings:
+                # Any other appearance (declared constant, written into a
+                # pod env block): counts for docs coverage only.
+                knob, line = node.value, node.lineno
+
+            if knob is None:
+                continue
+            if is_read and sf.path != ENVCONFIG and knob not in declared:
+                yield Finding(
+                    rule=RULE_REGISTRY, path=sf.path, line=line,
+                    message=f"inline env read of {knob!r} — route it "
+                            "through kubeflow_tpu/cmd/envconfig.py or "
+                            "bind the name to a module-level constant "
+                            "(FOO_ENV = \"...\") so the knob is "
+                            "discoverable")
+            if project.full_tree and docs_exists \
+                    and knob not in documented \
+                    and knob not in seen_doc_findings:
+                seen_doc_findings.add(knob)
+                yield Finding(
+                    rule=RULE_DOCS, path=sf.path, line=line,
+                    message=f"env knob {knob!r} is not documented in "
+                            "docs/operations.md — an undocumented "
+                            "production switch might as well not exist; "
+                            "document it or delete the dead knob")
+
+
+def _environ_subscript(node: ast.Subscript) -> bool:
+    recv = node.value
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in ("environ", "_environ")
+    if isinstance(recv, ast.Name):
+        return recv.id in ("environ", "_environ")
+    return False

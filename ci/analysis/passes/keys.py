@@ -1,0 +1,59 @@
+"""``annotation-keys``: one source of truth for the wire contract.
+
+Annotation and label keys ARE the control plane's wire protocol —
+migration drains, scheduler verdicts, serving park states all ride CR
+annotations. A literal typo'd in one consumer (the drift class behind
+several PR 6/8 hardening fixes: ``migration/protocol.py`` vs its
+consumers) silently breaks the handshake with no error anywhere.
+
+The rule: every ``*.kubeflow.org/...``-domain string literal lives in
+``kubeflow_tpu/api/keys.py`` and nowhere else; consumers import the
+constant. A rename then changes one line, and a typo is an
+``ImportError`` instead of a protocol drift. Docstrings are prose and
+exempt; f-string fragments count (building a key inline is the same
+drift with extra steps).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.analysis.core import Finding, Project, analysis_pass
+
+RULE = "annotation-keys"
+
+KEYS_MODULE = "kubeflow_tpu/api/keys.py"
+DOMAIN = "kubeflow.org/"
+
+
+@analysis_pass(
+    "annotation-keys", (RULE,),
+    "kubeflow.org-domain string literals outside the single-source "
+    "constants module kubeflow_tpu/api/keys.py")
+def check_annotation_keys(project: Project):
+    if project.full_tree and project.get(KEYS_MODULE) is None:
+        anchor = project.files[0].path if project.files else KEYS_MODULE
+        yield Finding(
+            rule=RULE, path=anchor, line=1,
+            message=f"{KEYS_MODULE} is missing — the annotation-key "
+                    "single-source module is the registry this pass "
+                    "checks against")
+    for sf in project.files:
+        if sf.tree is None or sf.path == KEYS_MODULE:
+            continue
+        docstrings = sf.docstring_linenos()
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if DOMAIN not in node.value:
+                continue
+            if node.lineno in docstrings:
+                continue
+            yield Finding(
+                rule=RULE, path=sf.path, line=node.lineno,
+                message=f"literal {node.value!r} — kubeflow.org-domain "
+                        "keys are the wire contract and live ONLY in "
+                        "kubeflow_tpu/api/keys.py; import the constant "
+                        "(typos become ImportErrors, renames touch one "
+                        "line)")

@@ -1,0 +1,74 @@
+"""``exception-swallow``: broad catches must leave a trace.
+
+``except Exception: pass`` is how a control plane rots: the drop is
+invisible until an operator asks why events stopped appearing or a
+drain never finalized. The PR 7 convention is "best-effort BY
+CONTRACT" — a deliberate swallow routes into a ``*_failures_total``
+counter or a log line so the drop is visible in metrics even when the
+reconcile keeps going.
+
+Flagged: an ``except`` catching ``Exception`` / ``BaseException`` (or
+bare) whose body performs no call, no raise, and no return-with-value —
+i.e. nothing that could count, log, or surface the error. Narrow
+catches (``except (NotFound, ApiError)``) are a stated contract with
+specific errors and stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.analysis.core import Finding, Project, analysis_pass
+
+RULE = "exception-swallow"
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True                 # bare except
+    names: list[ast.expr] = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD:
+            return True
+    return False
+
+
+def _body_surfaces_error(handler: ast.ExceptHandler) -> bool:
+    """True when the handler does SOMETHING deliberate with the error:
+    any call (logger, metrics counter, event), a raise, a
+    return-with-value, or an assignment (converting the failure into a
+    stated fallback value is a contract, not a swallow)."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Call, ast.Raise)):
+            return True
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                             ast.NamedExpr)):
+            return True
+        if isinstance(node, ast.Return) and node.value is not None:
+            return True
+    return False
+
+
+@analysis_pass(
+    "swallow", (RULE,),
+    "broad `except Exception` whose body neither counts, logs, raises "
+    "nor returns a value")
+def check_swallow(project: Project):
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and not _body_surfaces_error(node):
+                yield Finding(
+                    rule=RULE, path=sf.path, line=node.lineno,
+                    message="broad exception swallowed with no counter, "
+                            "log, or raise — route the drop into a "
+                            "*_failures_total counter (best-effort by "
+                            "contract) or narrow the except")

@@ -1,384 +1,53 @@
 #!/usr/bin/env python3
-"""Lint: every controller registers its reconcile phases with the tracer.
+"""Legacy entrypoint for the control-plane contract checks — now a thin
+shim over the AST framework in ``ci/analysis`` (ISSUE 12).
 
-Grep-based by design (no imports, no event loop): a reconciler whose
-``reconcile`` body carries no ``with span(...)`` phases produces traces
-with an empty tree — /debug/traces would say "reconcile took 1.2 s" and
-nothing else, which is exactly the debugging dead-end the tracing
-subsystem exists to remove. Wired into the unit-test workflow by
-ci/pipelines.py; tests/test_ci_pipelines.py re-runs it in-process.
+This file grew 390 lines of regex contracts across PRs 3–11 (tracing
+phases, apply_set stages, scheduler gate, migration drains, quarantine
+observability, elastic reclaim-safety, serving park protocol). Those
+contracts now live as scope-aware, rename-tolerant AST passes in
+``ci/analysis/passes/contracts.py`` — run them (plus the async-safety
+and registry passes) with ``python -m ci.analysis``; rule table and
+suppression syntax in docs/static-analysis.md.
 
-A controller module (anything under kubeflow_tpu/controllers/ defining
-``async def reconcile``) must:
+The shim keeps the historical surface working unchanged:
 
-- import ``span`` from kubeflow_tpu.runtime.tracing, and
-- open at least ``MIN_PHASES`` named phase spans, including the
-  ``cache_read`` phase every reconcile starts with.
+- ``python ci/check_tracing.py`` exits nonzero listing contract
+  problems (the CI step and tests/test_ci_pipelines.py call it);
+- ``check_file(path)`` lints one controller module and returns problem
+  strings (the fixture tests call it).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CONTROLLERS_DIR = os.path.join(REPO, "kubeflow_tpu", "controllers")
+if REPO not in sys.path:  # direct script invocation: `python ci/check_tracing.py`
+    sys.path.insert(0, REPO)
 
-MIN_PHASES = 2
-REQUIRED_PHASES = ("cache_read",)
-SPAN_RE = re.compile(r"with span\(\s*['\"]([a-z_]+)['\"]")
-IMPORT_RE = re.compile(
-    r"from kubeflow_tpu\.runtime\.tracing import .*\bspan\b"
-)
-
-# Latency-hiding contract (ISSUE 4): child-applying controllers go
-# through apply_set so independent API round trips overlap; a controller
-# that silently reverts to serial reconcile_child loops regresses wall
-# time by the child count. Stage names must be literals — they land on
-# the apply_stage spans /debug/traces shows.
-APPLY_SET_RE = re.compile(r"\bapply_set\(")
-STAGE_RE = re.compile(r"\bStage\(\s*['\"]([a-z_]+)['\"]")
-APPLY_SET_REQUIRED = (
-    "notebook.py", "tensorboard.py", "pvcviewer.py", "profile.py",
-)
-
-# Fleet-scheduler contract (ISSUE 5): the scheduler's runtime must
-# register its arbitration phases (schedule/admit/preempt) so
-# /debug/traces can show where an admission decision spent its time, and
-# the notebook controller's capacity stage must route through the
-# scheduler gate — a refactor that silently drops the consult would
-# reintroduce first-come/partial admission under chip pressure.
-SCHEDULER_RUNTIME = os.path.join(
-    REPO, "kubeflow_tpu", "scheduler", "runtime.py")
-SCHEDULER_PHASES = ("schedule", "admit", "preempt")
-NOTEBOOK_CONTROLLER = os.path.join(CONTROLLERS_DIR, "notebook.py")
-SCHEDULER_GATE_RE = re.compile(r"await self\._scheduler_gate\(")
-SCHEDULER_GATE_DEF_RE = re.compile(r"async def _scheduler_gate\(")
-SCHEDULER_CONSULT_RE = re.compile(r"\.(admission|release)\(")
-
-# Migration contract (ISSUE 7): preemption must route through the drain
-# protocol when migration is enabled — a refactor that silently reverts
-# to the bare stop-annotation would lose in-flight training state on
-# every preemption. The runtime must register the migration phases so
-# /debug/traces shows the drain round trip, and the policy layer must
-# keep the deferred-preemption mode the runtime switches on.
-MIGRATION_PROTOCOL = os.path.join(
-    REPO, "kubeflow_tpu", "migration", "protocol.py")
-MIGRATION_PHASES = ("drain", "checkpoint_ack", "restore")
-REQUEST_DRAIN_RE = re.compile(r"await self\._request_drain\(")
-DRAINS_ROUTE_RE = re.compile(r"result,\s*\"drains\"|result\.drains")
-POLICY_FILE = os.path.join(REPO, "kubeflow_tpu", "scheduler", "policy.py")
-DEFERRED_RE = re.compile(r"deferred_preemption")
-
-# Elastic-fleet contract (ISSUE 10): the scheduler runtime must register
-# the elastic phases (scale_up/reclaim/defrag) so intents, spot reclaims
-# and defrag migrations land in /debug/traces — and spot reclaim must
-# route through the drain protocol (_request_drain), never a bare stop:
-# a refactor that stop-annotates spot victims directly would lose
-# in-flight training state on every revocation.
-ELASTIC_FILE = os.path.join(REPO, "kubeflow_tpu", "scheduler", "elastic.py")
-ELASTIC_PHASES = ("scale_up", "reclaim", "defrag")
-SWEEP_RECLAIM_RE = re.compile(
-    r"async def _sweep_spot_reclaims\(.*?(?=\n    (?:async )?def |\nclass )",
-    re.DOTALL)
-BARE_STOP_RE = re.compile(r"_stop_victim\(|STOP_ANNOTATION")
-
-
-# Quarantine contract (ISSUE 9): dead-lettering a key must be observable
-# — the manager's quarantine path opens its span (lands in
-# /debug/traces) and emits the ReconcileQuarantined Warning Event +
-# Degraded condition. A refactor that silently drops either turns the
-# poison-pill dead-letter into an invisible black hole: the object just
-# stops reconciling with nothing anywhere saying so.
-MANAGER_FILE = os.path.join(REPO, "kubeflow_tpu", "runtime", "manager.py")
-QUEUE_FILE = os.path.join(REPO, "kubeflow_tpu", "runtime", "queue.py")
-# Either shape counts: the ROOT trace (tracer.trace — what lands in the
-# flight recorder) or a nested span; the manager opens both.
-QUARANTINE_SPAN_RE = re.compile(
-    r"(?:tracer\.trace|span)\(\s*['\"]quarantine['\"]")
-QUARANTINE_EVENT_RE = re.compile(r"['\"]ReconcileQuarantined['\"]")
-DEGRADED_RE = re.compile(r"['\"]Degraded['\"]")
-QUARANTINE_CALL_RE = re.compile(r"queue\.quarantine\(")
-
-
-# Serving contract (ISSUE 11): the InferenceService controller must
-# register the serving phases (autoscale/warm_restore/park) and the
-# engine its serve span, so scaling decisions and the serve loop land in
-# /debug/traces — and scale-to-zero must route through the park drain
-# (_drain_to_park → checkpoint ack or grace → _park_all), never a bare
-# replicas-0 stop: a refactor that parks without the checkpoint request
-# would silently turn warm standbys into cold starts and lose the
-# engine's state on every idle window. The policy layer must keep the
-# workload-class guard that excludes serving replicas from the victim
-# search (no activity signal ⇒ "idle forever" ⇒ the service would be
-# preempted precisely under load).
-SERVING_CONTROLLER = os.path.join(
-    REPO, "kubeflow_tpu", "serving", "controller.py")
-SERVING_ENGINE = os.path.join(REPO, "kubeflow_tpu", "serving", "engine.py")
-SERVING_PHASES = ("autoscale", "warm_restore", "park")
-DRAIN_TO_PARK_CALL_RE = re.compile(r"await self\._drain_to_park\(")
-PARK_ALL_CALL_RE = re.compile(r"await self\._park_all\(")
-WORKLOAD_GUARD_RE = re.compile(
-    r"workload\s*!=\s*['\"]notebook['\"]")
-
-
-def check_serving() -> list[str]:
-    problems = []
-    rel_ctl = os.path.relpath(SERVING_CONTROLLER, REPO)
-    try:
-        src = open(SERVING_CONTROLLER).read()
-    except OSError:
-        return [f"{rel_ctl}: missing — the serving workload class "
-                "(ISSUE 11) lost its controller"]
-    phases = set(SPAN_RE.findall(src))
-    for phase in SERVING_PHASES:
-        if phase not in phases:
-            problems.append(
-                f"{rel_ctl}: missing the `{phase}` serving phase span — "
-                "autoscaling/park/restore decisions must land in "
-                "/debug/traces")
-    if not DRAIN_TO_PARK_CALL_RE.search(src) \
-            or "def _drain_to_park" not in src:
-        problems.append(
-            f"{rel_ctl}: scale-to-zero no longer routes through "
-            "_drain_to_park — parking without a checkpoint request is a "
-            "bare-stop bypass of the drain protocol for serving replicas")
-    else:
-        drain_body = src.split("def _drain_to_park", 1)[1]
-        drain_body = drain_body.split("\n    async def ", 1)[0]
-        if "park_acked" not in drain_body \
-                or "park_grace_seconds" not in drain_body:
-            problems.append(
-                f"{rel_ctl}: _drain_to_park no longer waits for the "
-                "checkpoint ack (or the grace deadline) before parking")
-        park_calls = PARK_ALL_CALL_RE.findall(src)
-        if len(park_calls) != 1 or "_park_all" not in drain_body:
-            problems.append(
-                f"{rel_ctl}: _park_all must be called exactly once, from "
-                "_drain_to_park — any other caller is a bare-stop bypass "
-                "of the park drain")
-    rel_eng = os.path.relpath(SERVING_ENGINE, REPO)
-    try:
-        eng_src = open(SERVING_ENGINE).read()
-    except OSError:
-        return problems + [f"{rel_eng}: missing"]
-    if "serve" not in set(SPAN_RE.findall(eng_src)):
-        problems.append(
-            f"{rel_eng}: missing the `serve` span — the serving loop "
-            "must land in /debug/traces")
-    try:
-        policy_src = open(POLICY_FILE).read()
-    except OSError:
-        policy_src = ""
-    if not WORKLOAD_GUARD_RE.search(policy_src):
-        problems.append(
-            f"{os.path.relpath(POLICY_FILE, REPO)}: the workload-class "
-            "guard is gone from the victim search — serving replicas "
-            "(no activity signal) would be preempted as idle notebooks")
-    return problems
-
-
-def check_quarantine() -> list[str]:
-    problems = []
-    rel_mgr = os.path.relpath(MANAGER_FILE, REPO)
-    try:
-        src = open(MANAGER_FILE).read()
-    except OSError:
-        return [f"{rel_mgr}: missing"]
-    if not QUARANTINE_CALL_RE.search(src):
-        problems.append(
-            f"{rel_mgr}: the worker no longer quarantines exhausted keys "
-            "— a poison pill would retry at max backoff forever "
-            "(ISSUE 9 regression)")
-    if not QUARANTINE_SPAN_RE.search(src):
-        problems.append(
-            f"{rel_mgr}: the quarantine path opens no `quarantine` span — "
-            "dead-lettering must land in /debug/traces")
-    if not QUARANTINE_EVENT_RE.search(src):
-        problems.append(
-            f"{rel_mgr}: the quarantine path no longer emits the "
-            "ReconcileQuarantined Warning Event")
-    if not DEGRADED_RE.search(src):
-        problems.append(
-            f"{rel_mgr}: the quarantine path no longer stamps the "
-            "Degraded condition — the web apps and kubectl watchers "
-            "would see a silently-frozen object")
-    rel_q = os.path.relpath(QUEUE_FILE, REPO)
-    try:
-        qsrc = open(QUEUE_FILE).read()
-    except OSError:
-        return problems + [f"{rel_q}: missing"]
-    if "def release_quarantined" not in qsrc:
-        problems.append(
-            f"{rel_q}: release_quarantined is gone — the manual "
-            "/debug/queue/requeue escape hatch has nothing to call")
-    return problems
-
-
-def check_scheduler() -> list[str]:
-    problems = []
-    rel_rt = os.path.relpath(SCHEDULER_RUNTIME, REPO)
-    try:
-        src = open(SCHEDULER_RUNTIME).read()
-    except OSError:
-        return [f"{rel_rt}: missing — the fleet scheduler runtime is the "
-                "notebook capacity stage's admission point (ISSUE 5)"]
-    phases = set(SPAN_RE.findall(src))
-    for phase in SCHEDULER_PHASES:
-        if phase not in phases:
-            problems.append(
-                f"{rel_rt}: missing the `{phase}` phase span — scheduler "
-                "decisions must land in the reconcile trace tree")
-    nb_src = open(NOTEBOOK_CONTROLLER).read()
-    rel_nb = os.path.relpath(NOTEBOOK_CONTROLLER, REPO)
-    if not SCHEDULER_GATE_RE.search(nb_src):
-        problems.append(
-            f"{rel_nb}: the capacity stage no longer awaits "
-            "_scheduler_gate — slice StatefulSets would be created "
-            "without fleet admission (silent scheduler bypass)")
-    gate_def = SCHEDULER_GATE_DEF_RE.search(nb_src)
-    gate_body = nb_src[gate_def.end():gate_def.end() + 4000] if gate_def \
-        else ""
-    if not gate_def or not SCHEDULER_CONSULT_RE.search(gate_body):
-        problems.append(
-            f"{rel_nb}: _scheduler_gate no longer consults the scheduler "
-            "(.admission()/.release()) — the gate is a stub")
-    return problems
-
-
-def check_migration() -> list[str]:
-    problems = []
-    rel_proto = os.path.relpath(MIGRATION_PROTOCOL, REPO)
-    if not os.path.exists(MIGRATION_PROTOCOL):
-        return [f"{rel_proto}: missing — the drain/checkpoint/restore "
-                "protocol is the migration subsystem's wire contract "
-                "(ISSUE 7)"]
-    rel_rt = os.path.relpath(SCHEDULER_RUNTIME, REPO)
-    try:
-        src = open(SCHEDULER_RUNTIME).read()
-    except OSError:
-        return [f"{rel_rt}: missing"]
-    phases = set(SPAN_RE.findall(src))
-    for phase in MIGRATION_PHASES:
-        if phase not in phases:
-            problems.append(
-                f"{rel_rt}: missing the `{phase}` migration phase span — "
-                "drain round trips must land in the reconcile trace tree")
-    if not REQUEST_DRAIN_RE.search(src) or not DRAINS_ROUTE_RE.search(src):
-        problems.append(
-            f"{rel_rt}: the preempt path no longer routes policy drain "
-            "verdicts through _request_drain — with migration enabled, "
-            "victims would be bare-stopped and lose in-flight training "
-            "state (silent migration bypass)")
-    try:
-        policy_src = open(POLICY_FILE).read()
-    except OSError:
-        policy_src = ""
-    if not DEFERRED_RE.search(policy_src):
-        problems.append(
-            f"{os.path.relpath(POLICY_FILE, REPO)}: deferred_preemption "
-            "mode is gone — the runtime has no way to hold chips while a "
-            "victim checkpoints")
-    return problems
-
-
-def check_elastic() -> list[str]:
-    problems = []
-    rel_el = os.path.relpath(ELASTIC_FILE, REPO)
-    if not os.path.exists(ELASTIC_FILE):
-        return [f"{rel_el}: missing — the elastic fleet policy core "
-                "(scale-up intents, spot reclaim, defrag) is gone "
-                "(ISSUE 10)"]
-    el_src = open(ELASTIC_FILE).read()
-    for needed in ("def plan_defrag", "def compute_shortfalls",
-                   "class IntentBook"):
-        if needed not in el_src:
-            problems.append(
-                f"{rel_el}: `{needed}` is gone — the elastic policy "
-                "core lost a capability the runtime depends on")
-    rel_rt = os.path.relpath(SCHEDULER_RUNTIME, REPO)
-    try:
-        src = open(SCHEDULER_RUNTIME).read()
-    except OSError:
-        return problems + [f"{rel_rt}: missing"]
-    phases = set(SPAN_RE.findall(src))
-    for phase in ELASTIC_PHASES:
-        if phase not in phases:
-            problems.append(
-                f"{rel_rt}: missing the `{phase}` elastic phase span — "
-                "scale-up/reclaim/defrag decisions must land in "
-                "/debug/traces")
-    sweep = SWEEP_RECLAIM_RE.search(src)
-    if sweep is None:
-        problems.append(
-            f"{rel_rt}: _sweep_spot_reclaims is gone — spot revocations "
-            "would kill work in flight instead of draining it")
-    else:
-        body = sweep.group(0)
-        if "_request_drain(" not in body:
-            problems.append(
-                f"{rel_rt}: spot reclaim no longer routes through "
-                "_request_drain — a revocation would bypass the "
-                "checkpoint drain protocol")
-        if BARE_STOP_RE.search(body):
-            problems.append(
-                f"{rel_rt}: _sweep_spot_reclaims stops victims directly "
-                "(bare-stop bypass) — reclaim must checkpoint first; "
-                "the grace-deadline fallback lives in _finalize_drain")
-    return problems
+from ci.analysis.core import SourceFile, load_project  # noqa: E402
+from ci.analysis.passes import contracts  # noqa: E402
 
 
 def check_file(path: str) -> list[str]:
-    src = open(path).read()
-    if "async def reconcile(" not in src:
-        return []
-    rel = os.path.relpath(path, REPO)
-    problems = []
-    if not IMPORT_RE.search(src):
-        problems.append(
-            f"{rel}: defines a reconciler but never imports span from "
-            "kubeflow_tpu.runtime.tracing"
-        )
-    phases = SPAN_RE.findall(src)
-    if len(set(phases)) < MIN_PHASES:
-        problems.append(
-            f"{rel}: reconciler opens {len(set(phases))} distinct phase "
-            f"span(s) ({sorted(set(phases))}); at least {MIN_PHASES} "
-            "required — wrap the reconcile phases (cache_read/apply/"
-            "status/...) in `with span(...)`"
-        )
-    for required in REQUIRED_PHASES:
-        if required not in phases:
-            problems.append(
-                f"{rel}: missing the `{required}` phase span"
-            )
-    uses_apply_set = bool(APPLY_SET_RE.search(src))
-    if uses_apply_set and not STAGE_RE.search(src):
-        problems.append(
-            f"{rel}: calls apply_set but declares no literal-named "
-            "Stage('...') — the apply_stage spans would be unnamed and "
-            "/debug/traces can't show which dependency stage ate the time"
-        )
-    if os.path.basename(path) in APPLY_SET_REQUIRED and not uses_apply_set:
-        problems.append(
-            f"{rel}: child-applying controller no longer goes through "
-            "apply_set — children apply as serial round trips (latency "
-            "hiding regression, ISSUE 4)"
-        )
-    return problems
+    """Lint one controller module (tracing + apply_set contracts only —
+    the per-file half of the ``contracts`` pass). Returns human-readable
+    problem strings, `` rel:`` -prefixed like the historical output,
+    including the legacy basename-keyed apply_set requirement."""
+    sf = SourceFile.load(os.path.abspath(path),
+                         os.path.relpath(path, REPO))
+    required = os.path.basename(path) in contracts.APPLY_SET_REQUIRED
+    return [f"{f.path}: {f.message}"
+            for f in contracts.file_tracing_problems(
+                sf, apply_set_required=required)]
 
 
 def main() -> int:
-    problems = []
-    for fname in sorted(os.listdir(CONTROLLERS_DIR)):
-        if fname.endswith(".py"):
-            problems.extend(check_file(os.path.join(CONTROLLERS_DIR, fname)))
-    problems.extend(check_scheduler())
-    problems.extend(check_migration())
-    problems.extend(check_quarantine())
-    problems.extend(check_elastic())
-    problems.extend(check_serving())
+    project = load_project(root=REPO)
+    problems = [f"{f.path}: {f.message}"
+                for f in contracts.check_contracts(project)]
     for p in problems:
         print(f"check_tracing: {p}", file=sys.stderr)
     if not problems:

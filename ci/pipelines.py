@@ -105,6 +105,16 @@ def workflow_tests() -> dict:
                     run(None, PIP_INSTALL),
                     run("Lint: controllers register reconcile phases with the tracer",
                         "python ci/check_tracing.py"),
+                    run("Static analysis (AST): async-safety, registry "
+                        "drift, contract passes — exit 1 on findings "
+                        "(docs/static-analysis.md)",
+                        "python -m ci.analysis --json analysis-findings.json"),
+                    {"name": "Upload static-analysis findings JSON",
+                     "if": "always()",
+                     "uses": "actions/upload-artifact@v4",
+                     "with": {"name": "static-analysis-findings-${{ matrix.python }}",
+                              "path": "analysis-findings.json",
+                              "if-no-files-found": "ignore"}},
                     run("Fleet-scheduler smoke bench (gang admission, fairness, "
                         "idle preemption)",
                         "python bench.py scheduler_scale --smoke",

@@ -33,13 +33,14 @@ or ``<name>-r<i>-s<j>``.
 
 from __future__ import annotations
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
 from kubeflow_tpu.tpu.topology import MultiSlice, TopologyError
 
-GROUP = "kubeflow.org"
+GROUP = keys.GROUP
 KIND = "InferenceService"
-API_VERSION = "kubeflow.org/v1"
+API_VERSION = keys.API_V1
 
 # ---- workload-class contract ---------------------------------------------------
 # The one label every layer keys the notebook/serving distinction on. The
@@ -47,21 +48,21 @@ API_VERSION = "kubeflow.org/v1"
 # workload as an idle notebook: serving pods expose no Jupyter activity
 # signal, so "no kernels" would read as "idle forever" and the service
 # would be culled/preempted precisely when it is busiest.
-WORKLOAD_CLASS_LABEL = "kubeflow.org/workload-class"
+WORKLOAD_CLASS_LABEL = keys.WORKLOAD_CLASS_LABEL
 SERVING_CLASS = "serving"
 NOTEBOOK_CLASS = "notebook"
 
 # Replica STS/pod label (the Service selects on it).
-SERVICE_LABEL = "serving.kubeflow.org/inference-service"
+SERVICE_LABEL = keys.SERVING_SERVICE_LABEL
 
 # ---- annotation contract -------------------------------------------------------
 # Observed-load signals, stamped by the serving gateway / load generator
 # (or the bench driver); the autoscaler reads them — the CR is the wire
 # between the data plane and the control plane, same pattern as the
 # culler's last-activity annotation.
-OBSERVED_RATE_ANNOTATION = "serving.kubeflow.org/observed-rate"
-OBSERVED_INFLIGHT_ANNOTATION = "serving.kubeflow.org/observed-inflight"
-LAST_REQUEST_AT_ANNOTATION = "serving.kubeflow.org/last-request-at"
+OBSERVED_RATE_ANNOTATION = keys.SERVING_OBSERVED_RATE
+OBSERVED_INFLIGHT_ANNOTATION = keys.SERVING_OBSERVED_INFLIGHT
+LAST_REQUEST_AT_ANNOTATION = keys.SERVING_LAST_REQUEST_AT
 
 # Park protocol (scale-to-zero over the PR 6 drain idiom): the controller
 # requests a checkpoint, the serving engine acks with the committed
@@ -69,30 +70,30 @@ LAST_REQUEST_AT_ANNOTATION = "serving.kubeflow.org/last-request-at"
 # checkpoint is the warm-standby restore hint — scale-from-zero stamps it
 # back into the pod env (KFTPU_RESTORE_*) so the first burst restores
 # instead of cold-starting.
-PARK_REQUESTED_ANNOTATION = "serving.kubeflow.org/park-requested"
-PARKED_AT_ANNOTATION = "serving.kubeflow.org/parked-at"
-PARK_CHECKPOINT_PATH_ANNOTATION = "serving.kubeflow.org/parked-checkpoint-path"
-PARK_CHECKPOINT_STEP_ANNOTATION = "serving.kubeflow.org/parked-checkpoint-step"
+PARK_REQUESTED_ANNOTATION = keys.SERVING_PARK_REQUESTED
+PARKED_AT_ANNOTATION = keys.SERVING_PARKED_AT
+PARK_CHECKPOINT_PATH_ANNOTATION = keys.SERVING_PARK_CHECKPOINT_PATH
+PARK_CHECKPOINT_STEP_ANNOTATION = keys.SERVING_PARK_CHECKPOINT_STEP
 # The ack's echo of the park request it answers (the raw park-requested
 # value) — same clock-skew-immune correlation as the migration
 # protocol's checkpointed-for: the checkpoint path/step survive as the
 # warm-restore hint across cycles, so WITHOUT the echo a second idle
 # spell would instant-park off the previous cycle's stale checkpoint
 # and silently drop everything served since.
-PARK_CHECKPOINT_FOR_ANNOTATION = "serving.kubeflow.org/parked-checkpoint-for"
+PARK_CHECKPOINT_FOR_ANNOTATION = keys.SERVING_PARK_CHECKPOINT_FOR
 
 # Per-replica durable flex marker (the serving analogue of the notebook
 # FLEX_POOL_ANNOTATION): `<prefix><i>` names the foreign pool replica i
 # borrows a host from. A controller restart reads it to restore the
 # BORROW booking instead of re-seating the replica natively under its
 # running pods.
-FLEX_POOL_ANNOTATION_PREFIX = "serving.kubeflow.org/flex-pool-r"
+FLEX_POOL_ANNOTATION_PREFIX = keys.SERVING_FLEX_POOL_PREFIX
 
 # Serving-class priority for fleet admission ("low"|"normal"|"high"|
 # "critical" or an int; default "high" — an always-on service outranks
 # interactive notebooks and reclaims idle ones through the drain
 # protocol, never the other way around).
-PRIORITY_ANNOTATION = "serving.kubeflow.org/priority"
+PRIORITY_ANNOTATION = keys.SERVING_PRIORITY
 
 SERVICE_PORT = 80
 DEFAULT_CONTAINER_PORT = 8000

@@ -21,13 +21,14 @@ ours is one typed block).
 
 from __future__ import annotations
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, get_meta, name_of
 from kubeflow_tpu.tpu.topology import MultiSlice, TopologyError, TpuSlice
 
-GROUP = "kubeflow.org"
+GROUP = keys.GROUP
 KIND = "Notebook"
-API_VERSION = "kubeflow.org/v1"
+API_VERSION = keys.API_V1
 
 # Version lineage, mirroring the reference which serves v1 (storage),
 # v1beta1, and v1alpha1 with structurally identical schemas
@@ -38,9 +39,9 @@ API_VERSION = "kubeflow.org/v1"
 # work unchanged (docs/migration.md's wire-compat claim).
 STORAGE_API_VERSION = API_VERSION
 SERVED_API_VERSIONS = (
-    "kubeflow.org/v1",
-    "kubeflow.org/v1beta1",
-    "kubeflow.org/v1alpha1",
+    keys.API_V1,
+    keys.API_V1BETA1,
+    keys.API_V1ALPHA1,
 )
 
 
@@ -56,49 +57,49 @@ def convert(notebook: dict, to_api_version: str) -> dict:
 # Annotation/label contract — kept wire-compatible with the reference so
 # existing tooling (and muscle memory) carries over:
 STOP_ANNOTATION = "kubeflow-resource-stopped"          # notebook_controller.go:410
-LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+LAST_ACTIVITY_ANNOTATION = keys.NOTEBOOK_LAST_ACTIVITY
 LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = (
-    "notebooks.kubeflow.org/last_activity_check_timestamp"
+    keys.NOTEBOOK_LAST_ACTIVITY_CHECK_TIMESTAMP
 )
 NOTEBOOK_NAME_LABEL = "notebook-name"                  # notebook_controller.go:430
-ANNOTATION_REWRITE_URI = "notebooks.kubeflow.org/http-rewrite-uri"
-ANNOTATION_HEADERS_REQUEST_SET = "notebooks.kubeflow.org/http-headers-request-set"
-SERVER_TYPE_ANNOTATION = "notebooks.kubeflow.org/server-type"
-CREATOR_ANNOTATION = "notebooks.kubeflow.org/creator"
+ANNOTATION_REWRITE_URI = keys.NOTEBOOK_HTTP_REWRITE_URI
+ANNOTATION_HEADERS_REQUEST_SET = keys.NOTEBOOK_HTTP_HEADERS_REQUEST_SET
+SERVER_TYPE_ANNOTATION = keys.NOTEBOOK_SERVER_TYPE
+CREATOR_ANNOTATION = keys.NOTEBOOK_CREATOR
 # Spawner's image pick, resolved to a pinned reference at admission by the
 # catalog ConfigMap (odh's last-image-selection, notebook_webhook.go:556).
-IMAGE_SELECTION_ANNOTATION = "notebooks.kubeflow.org/last-image-selection"
+IMAGE_SELECTION_ANNOTATION = keys.NOTEBOOK_LAST_IMAGE_SELECTION
 
 # Restart protocol (reference: culler pkg + odh webhook "update-pending"):
-RESTART_ANNOTATION = "notebooks.kubeflow.org/restart"
+RESTART_ANNOTATION = keys.NOTEBOOK_RESTART
 # Stamped by the restart-blocking webhook when a live pod-affecting edit
 # was reverted (webhooks/notebook.py); read by the status machine.
-UPDATE_PENDING_ANNOTATION = "notebooks.kubeflow.org/update-pending"
+UPDATE_PENDING_ANNOTATION = keys.NOTEBOOK_UPDATE_PENDING
 
 # Controller-mirrored impending-maintenance signal: comma-joined nodes
 # hosting this notebook's TPU workers that carry a maintenance taint
 # (controllers/notebook.py _check_maintenance). Read by the status
 # machine and by in-notebook tooling that wants to checkpoint early.
-MAINTENANCE_ANNOTATION = "notebooks.kubeflow.org/maintenance-pending"
+MAINTENANCE_ANNOTATION = keys.NOTEBOOK_MAINTENANCE_PENDING
 
 # Fleet-scheduler contract (kubeflow_tpu/scheduler/):
 # - priority class ("low"|"normal"|"high"|"critical" or an int) the user
 #   sets on the CR; read at gang admission;
-PRIORITY_ANNOTATION = "notebooks.kubeflow.org/priority"
+PRIORITY_ANNOTATION = keys.NOTEBOOK_PRIORITY
 # - stamped by the scheduler when the gang is admitted; culling floors
 #   its idle clock on it (a notebook that queued for hours must not be
 #   culled seconds after it finally starts), and the scheduler's idle-
 #   preemption ranking reads it back;
-SCHEDULER_ADMITTED_AT_ANNOTATION = "notebooks.kubeflow.org/admitted-at"
+SCHEDULER_ADMITTED_AT_ANNOTATION = keys.NOTEBOOK_ADMITTED_AT
 # - stamped (with the reason) alongside the stop annotation when the
 #   scheduler preempts the gang; cleared on re-admission.
-PREEMPTED_ANNOTATION = "notebooks.kubeflow.org/preempted"
+PREEMPTED_ANNOTATION = keys.NOTEBOOK_PREEMPTED
 # - elastic flex placement (scheduler/elastic.py): the foreign pool this
 #   gang borrows a host from, stamped at admission and cleared on a
 #   native admission/release. A controller restart reads it to restore
 #   the BORROW booking (re-seating natively would resell the host its
 #   pods still occupy and flip their node selectors).
-FLEX_POOL_ANNOTATION = "notebooks.kubeflow.org/flex-pool"
+FLEX_POOL_ANNOTATION = keys.NOTEBOOK_FLEX_POOL
 
 # Migration contract (kubeflow_tpu/migration/protocol.py): preemption,
 # culling, and user suspend all speak one drain protocol — request a
@@ -106,39 +107,39 @@ FLEX_POOL_ANNOTATION = "notebooks.kubeflow.org/flex-pool"
 # these through the same in-cluster CR fetch as MAINTENANCE_ANNOTATION.
 # - stamped (ISO time) by whoever wants the gang parked; the SDK polls
 #   it and checkpoints when it appears;
-DRAIN_REQUESTED_ANNOTATION = "notebooks.kubeflow.org/drain-requested"
+DRAIN_REQUESTED_ANNOTATION = keys.NOTEBOOK_DRAIN_REQUESTED
 # - why the drain was requested: "preempt:idle" | "preempt:priority" |
 #   "spot-reclaim" | "defrag" | "cull" | "suspend" — the finalizer
 #   (scheduler, elastic runtime, culler, notebook controller) only acts
 #   on its own reasons;
-DRAIN_REASON_ANNOTATION = "notebooks.kubeflow.org/drain-reason"
+DRAIN_REASON_ANNOTATION = keys.NOTEBOOK_DRAIN_REASON
 # - SDK progress marks: snapshot started / committed. An ack echoes the
 #   drain request it answers (checkpointed-for = the raw drain-requested
 #   value), so ack detection never compares timestamps stamped by two
 #   different clocks (controller vs pod).
-CHECKPOINTING_AT_ANNOTATION = "notebooks.kubeflow.org/checkpointing-at"
-CHECKPOINTED_AT_ANNOTATION = "notebooks.kubeflow.org/checkpointed-at"
-CHECKPOINTED_FOR_ANNOTATION = "notebooks.kubeflow.org/checkpointed-for"
+CHECKPOINTING_AT_ANNOTATION = keys.NOTEBOOK_CHECKPOINTING_AT
+CHECKPOINTED_AT_ANNOTATION = keys.NOTEBOOK_CHECKPOINTED_AT
+CHECKPOINTED_FOR_ANNOTATION = keys.NOTEBOOK_CHECKPOINTED_FOR
 # - the durable restore hint the controller turns into pod env
 #   (KFTPU_RESTORE_CHECKPOINT_PATH / KFTPU_RESTORE_STEP) on re-admission.
-CHECKPOINT_PATH_ANNOTATION = "notebooks.kubeflow.org/checkpoint-path"
-CHECKPOINT_STEP_ANNOTATION = "notebooks.kubeflow.org/checkpoint-step"
+CHECKPOINT_PATH_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_PATH
+CHECKPOINT_STEP_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_STEP
 # - user-facing suspend/resume: present → drain-then-park; removed →
 #   un-park and restore. Set by kubectl/JWA or sdk.suspend().
-SUSPEND_ANNOTATION = "notebooks.kubeflow.org/suspend"
+SUSPEND_ANNOTATION = keys.NOTEBOOK_SUSPEND
 
 # Pod-template annotations the controller stamps so pod-level admission can
 # compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
-TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"
-TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
+TPU_ACCELERATOR_ANNOTATION = keys.TPU_ACCELERATOR
+TPU_TOPOLOGY_ANNOTATION = keys.TPU_TOPOLOGY
 # Multislice: stamped per-StatefulSet so the pod webhook can compute the
 # global JAX_PROCESS_ID (= sliceId·hostsPerSlice + ordinal) at admission.
-TPU_SLICE_ID_ANNOTATION = "tpu.kubeflow.org/slice-id"
-TPU_NUM_SLICES_ANNOTATION = "tpu.kubeflow.org/num-slices"
+TPU_SLICE_ID_ANNOTATION = keys.TPU_SLICE_ID
+TPU_NUM_SLICES_ANNOTATION = keys.TPU_NUM_SLICES
 # Pod-template label marking slice workers; the admission registration keys
 # a failurePolicy:Fail objectSelector on it (labels, not annotations, are
 # what objectSelector can match).
-TPU_SLICE_LABEL = "tpu.kubeflow.org/slice"
+TPU_SLICE_LABEL = keys.TPU_SLICE_LABEL
 
 PREFIX_ENV_VAR = "NB_PREFIX"                           # notebook_controller.go:56
 DEFAULT_CONTAINER_PORT = 8888

@@ -15,11 +15,12 @@ TPU injection bundle.
 
 from __future__ import annotations
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, name_of
 
 KIND = "PodDefault"
-API_VERSION = "kubeflow.org/v1alpha1"
+API_VERSION = keys.API_V1ALPHA1
 
 LIST_FIELDS = (
     "env",

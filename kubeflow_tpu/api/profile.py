@@ -11,12 +11,13 @@ ResourceQuota (SURVEY.md §2.4: quota on TPU chips replaces GPU quota).
 
 from __future__ import annotations
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, name_of
 from kubeflow_tpu.tpu.topology import TPU_RESOURCE
 
 KIND = "Profile"
-API_VERSION = "kubeflow.org/v1"
+API_VERSION = keys.API_V1
 
 # Version lineage, mirroring the reference which serves Profile at v1
 # (storage) and v1beta1 with structurally identical schemas
@@ -24,8 +25,8 @@ API_VERSION = "kubeflow.org/v1"
 # package name and kubebuilder markers).
 STORAGE_API_VERSION = API_VERSION
 SERVED_API_VERSIONS = (
-    "kubeflow.org/v1",
-    "kubeflow.org/v1beta1",
+    keys.API_V1,
+    keys.API_V1BETA1,
 )
 
 

@@ -10,11 +10,12 @@ from __future__ import annotations
 
 import copy
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, name_of
 
 KIND = "PVCViewer"
-API_VERSION = "kubeflow.org/v1alpha1"
+API_VERSION = keys.API_V1ALPHA1
 
 DEFAULT_TARGET_PORT = 8080
 DEFAULT_BASE_PREFIX = "/pvcviewer"

@@ -15,11 +15,12 @@ profile plugin so XLA traces written by ``jax.profiler`` are browsable.
 
 from __future__ import annotations
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.runtime.errors import Invalid
 from kubeflow_tpu.runtime.objects import deep_get, name_of
 
 KIND = "Tensorboard"
-API_VERSION = "tensorboard.kubeflow.org/v1alpha1"
+API_VERSION = keys.TENSORBOARD_API_V1ALPHA1
 
 SCHEME_PVC = "pvc"
 SCHEME_GCS = "gs"

@@ -40,6 +40,7 @@ import logging
 import time
 from dataclasses import dataclass
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.controllers.common import bounded_name
 from kubeflow_tpu.runtime.apply import (
@@ -201,14 +202,14 @@ class NotebookOptions:
     drain_grace_seconds: float = migration.DEFAULT_DRAIN_GRACE_SECONDS
 
 
-AUTH_PROXY_ANNOTATION = "notebooks.kubeflow.org/inject-auth-proxy"
+AUTH_PROXY_ANNOTATION = keys.NOTEBOOK_INJECT_AUTH_PROXY
 CA_BUNDLE_CONFIGMAP = "kubeflow-tpu-ca-bundle"
 CA_BUNDLE_KEY = "ca-bundle.crt"
 
 # Slice-restart backoff state (annotations so damping survives controller
 # restarts) + schedule: attempt N waits base·2^(N-1) seconds, capped.
-SLICE_RESTART_ATTEMPTS_ANNOTATION = "notebooks.kubeflow.org/slice-restart-attempts"
-SLICE_RESTART_AT_ANNOTATION = "notebooks.kubeflow.org/slice-restart-at"
+SLICE_RESTART_ATTEMPTS_ANNOTATION = keys.NOTEBOOK_SLICE_RESTART_ATTEMPTS
+SLICE_RESTART_AT_ANNOTATION = keys.NOTEBOOK_SLICE_RESTART_AT
 SLICE_RESTART_BASE_SECONDS = 10.0
 SLICE_RESTART_MAX_SECONDS = 300.0
 
@@ -434,7 +435,9 @@ class NotebookReconciler:
                 try:
                     await self._emit_created_events(nb, created_slices)
                 except Exception:
-                    pass  # events are best-effort; keep the real error
+                    # Best-effort by contract: keep the real (stage)
+                    # error, but the drop must land in the counter.
+                    self.recorder.count_drop()
             raise
         return capacity_pending, capacity_requeue, admission
 

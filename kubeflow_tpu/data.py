@@ -465,7 +465,7 @@ class _Prefetcher:
     def __del__(self):
         try:
             self.close()
-        except Exception:  # noqa: BLE001 — a destructor must never raise
+        except Exception:  # kftpu: ignore[exception-swallow] destructor during interpreter teardown — logging/metrics may already be torn down and raising is fatal
             pass
 
 

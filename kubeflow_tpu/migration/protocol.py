@@ -54,12 +54,17 @@ DEFAULT_DRAIN_GRACE_SECONDS = 120.0
 RESTORE_PATH_ENV = "KFTPU_RESTORE_CHECKPOINT_PATH"
 RESTORE_STEP_ENV = "KFTPU_RESTORE_STEP"
 
+# Knobs (docs/operations.md "Preempt-to-checkpoint migration"):
+MIGRATION_ENV = "KFTPU_MIGRATION"
+CULL_DRAIN_ENV = "KFTPU_CULL_DRAIN"
+DRAIN_GRACE_ENV = "KFTPU_DRAIN_GRACE"
+
 
 def migration_enabled(environ=os.environ) -> bool:
     """``KFTPU_MIGRATION`` master switch — anything but off/false/0/no
     leaves the drain protocol on. Off restores the pre-migration
     immediate stop on every path (preemption, culling, suspend)."""
-    return environ.get("KFTPU_MIGRATION", "on").strip().lower() not in (
+    return environ.get(MIGRATION_ENV, "on").strip().lower() not in (
         "off", "false", "0", "no", "disabled",
     )
 
@@ -68,7 +73,7 @@ def cull_drain_enabled(environ=os.environ) -> bool:
     """``KFTPU_CULL_DRAIN`` — culling-only kill switch layered under the
     master one: off restores the bare idle-cull stop while preemption
     keeps draining."""
-    return environ.get("KFTPU_CULL_DRAIN", "on").strip().lower() not in (
+    return environ.get(CULL_DRAIN_ENV, "on").strip().lower() not in (
         "off", "false", "0", "no", "disabled",
     )
 
@@ -76,7 +81,7 @@ def cull_drain_enabled(environ=os.environ) -> bool:
 def drain_grace_seconds(environ=os.environ) -> float:
     """``KFTPU_DRAIN_GRACE`` — seconds a drain may hold chips before the
     hard-stop fallback fires."""
-    raw = environ.get("KFTPU_DRAIN_GRACE")
+    raw = environ.get(DRAIN_GRACE_ENV)
     try:
         value = float(raw) if raw is not None else DEFAULT_DRAIN_GRACE_SECONDS
     except ValueError:

@@ -154,7 +154,7 @@ def state_sharding_rules(params_rules: Any, params: Any, optimizer) -> dict:
         try:
             if jax.tree.structure(node) == params_struct:
                 return params_rules
-        except Exception:  # non-pytree leaf containers
+        except Exception:  # kftpu: ignore[exception-swallow] structure probe as conditional — a non-pytree leaf container falls through to the per-node rules below
             pass
         if isinstance(node, tuple):
             children = [rules_for(child) for child in node]

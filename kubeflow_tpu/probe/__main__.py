@@ -10,12 +10,16 @@ import argparse
 import json
 import os
 
+# Defaults for off-cluster runs; on-cluster the controller injects both
+# (docs/operations.md "Probe / burn-in env").
+ACCELERATOR_ENV = "KFTPU_ACCELERATOR"
+
 
 def main() -> None:
     parser = argparse.ArgumentParser(description="TPU slice burn-in probe")
     parser.add_argument("--mbytes", type=float, default=64.0)
     parser.add_argument("--iters", type=int, default=10)
-    parser.add_argument("--accelerator", default=os.environ.get("KFTPU_ACCELERATOR"))
+    parser.add_argument("--accelerator", default=os.environ.get(ACCELERATOR_ENV))
     parser.add_argument("--topology", default=os.environ.get("TPU_TOPOLOGY"))
     parser.add_argument("--skip-dcn", action="store_true")
     args = parser.parse_args()

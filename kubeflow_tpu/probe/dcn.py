@@ -17,6 +17,10 @@ from pathlib import Path
 
 NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
 
+# Cross-slice ring peers, injected by the controller as worker env
+# (tpu/topology.py worker_env; docs/operations.md "Probe / burn-in env").
+SLICE_PEERS_ENV = "KFTPU_SLICE_PEERS"
+
 
 @dataclass(frozen=True)
 class DcnReport:
@@ -192,7 +196,7 @@ def slice_env_config() -> tuple[int, int, list[str]] | None:
     Returns None off-multislice or on a non-zero worker (only worker 0 of
     each slice participates; the others would collide on ports).
     """
-    peers = os.environ.get("KFTPU_SLICE_PEERS", "")
+    peers = os.environ.get(SLICE_PEERS_ENV, "")
     slice_id = os.environ.get("MEGASCALE_SLICE_ID", "")
     worker_id = os.environ.get("TPU_WORKER_ID", "0")
     if not peers or not slice_id.isdigit() or worker_id != "0":

@@ -42,6 +42,15 @@ class EventRecorder:
         # us) invalidates the entry and falls back to create.
         self._known: OrderedDict[tuple, int] = OrderedDict()
 
+    def count_drop(self) -> None:
+        """Count an emission dropped OUTSIDE the recorder (a caller's
+        best-effort guard around :meth:`event` — non-API failures the
+        recorder itself can't see). Same ``events_emit_failures_total``
+        series as the recorder's own swallows, so 'events stopped
+        appearing' always has one metric to alert on (the
+        ``exception-swallow`` pass rejects uncounted drops)."""
+        self._emit_failures.labels(component=self.component).inc()
+
     def _remember(self, key: tuple, count: int) -> None:
         self._known[key] = count
         self._known.move_to_end(key)

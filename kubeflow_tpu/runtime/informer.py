@@ -21,6 +21,7 @@ import time
 import zlib
 from typing import Awaitable, Callable, Hashable
 
+from kubeflow_tpu.runtime.aiotasks import reap
 from kubeflow_tpu.runtime.objects import (
     controller_of,
     get_meta,
@@ -261,10 +262,7 @@ class Informer:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap(self._task)
 
     def _dispatch(self, event: str, obj: dict) -> None:
         for fn in self._handlers:

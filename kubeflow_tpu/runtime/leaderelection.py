@@ -13,6 +13,7 @@ import asyncio
 import logging
 import uuid
 
+from kubeflow_tpu.runtime.aiotasks import reap
 from kubeflow_tpu.runtime.errors import ApiError, NotFound
 from kubeflow_tpu.runtime.objects import deep_get, fmt_iso, parse_iso
 
@@ -123,10 +124,7 @@ class LeaderElector:
     async def release(self) -> None:
         if self._renew_task:
             self._renew_task.cancel()
-            try:
-                await self._renew_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap(self._renew_task)
         if self.is_leader:
             try:
                 lease = await self.kube.get(

@@ -24,6 +24,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
+from kubeflow_tpu.runtime.aiotasks import reap
 from kubeflow_tpu.runtime.errors import ApiError, Conflict
 from kubeflow_tpu.runtime.events import EventRecorder
 from kubeflow_tpu.runtime.informer import OWNER_INDEX, Informer, index_by_owner_uid
@@ -44,10 +45,11 @@ log = logging.getLogger(__name__)
 # Consecutive reconcile failures before a key is dead-lettered
 # (poison-pill quarantine, runtime/queue.py). 0 disables.
 DEFAULT_QUARANTINE_AFTER = 12
+QUARANTINE_AFTER_ENV = "KFTPU_QUARANTINE_AFTER"
 
 
 def _quarantine_after_from_env(environ=os.environ) -> int:
-    raw = environ.get("KFTPU_QUARANTINE_AFTER")
+    raw = environ.get(QUARANTINE_AFTER_ENV)
     try:
         value = int(raw) if raw is not None else DEFAULT_QUARANTINE_AFTER
     except ValueError:
@@ -260,11 +262,7 @@ class Manager:
             queue.shutdown()
         for task in self._tasks:
             task.cancel()
-        for task in self._tasks:
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+        await reap(*self._tasks)
         for informer in self.informers.values():
             await informer.stop()
 

@@ -53,9 +53,12 @@ from kubeflow_tpu.scheduler.runtime import (  # noqa: F401
 )
 
 
+SCHEDULER_ENV = "KFTPU_SCHEDULER"
+
+
 def scheduler_enabled() -> bool:
     """The ``KFTPU_SCHEDULER`` kill switch: anything but off/false/0/no
     leaves the scheduler on (it is inert until a fleet is configured)."""
-    return os.environ.get("KFTPU_SCHEDULER", "on").strip().lower() not in (
+    return os.environ.get(SCHEDULER_ENV, "on").strip().lower() not in (
         "off", "false", "0", "no", "disabled",
     )

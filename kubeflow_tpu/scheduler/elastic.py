@@ -53,6 +53,7 @@ import math
 import os
 from dataclasses import dataclass, field
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.runtime.objects import deep_get
 from kubeflow_tpu.scheduler.fleet import (
     GKE_NODEPOOL_LABEL,
@@ -83,13 +84,17 @@ DEFAULT_DEFRAG_INTERVAL_SECONDS = 30.0
 DEFAULT_DEFRAG_IDLE_SECONDS = 600.0
 DEFAULT_DEFRAG_MAX_MOVES = 2
 
+# Kill switches (docs/operations.md "Elastic fleet"):
+ELASTIC_ENV = "KFTPU_ELASTIC"
+DEFRAG_ENV = "KFTPU_DEFRAG"
+
 
 def elastic_enabled(environ=os.environ) -> bool:
     """``KFTPU_ELASTIC`` master switch — anything but off/false/0/no
     leaves the elastic subsystem on. Off restores PR 5–7 scheduler
     behavior byte-for-byte (no borrows, no intents, no defrag, spot
     pools inert)."""
-    return environ.get("KFTPU_ELASTIC", "on").strip().lower() not in (
+    return environ.get(ELASTIC_ENV, "on").strip().lower() not in (
         "off", "false", "0", "no", "disabled",
     )
 
@@ -97,7 +102,7 @@ def elastic_enabled(environ=os.environ) -> bool:
 def defrag_enabled(environ=os.environ) -> bool:
     """``KFTPU_DEFRAG`` — defragmenter-only kill switch layered under
     the master one."""
-    return environ.get("KFTPU_DEFRAG", "on").strip().lower() not in (
+    return environ.get(DEFRAG_ENV, "on").strip().lower() not in (
         "off", "false", "0", "no", "disabled",
     )
 
@@ -263,9 +268,8 @@ class ScaleUpIntent:
                 "name": self.name,
                 "namespace": namespace,
                 "labels": {
-                    "tpu.kubeflow.org/scale-up-accelerator":
-                        self.accelerator,
-                    "tpu.kubeflow.org/scale-up-topology": self.topology,
+                    keys.TPU_SCALE_UP_ACCELERATOR: self.accelerator,
+                    keys.TPU_SCALE_UP_TOPOLOGY: self.topology,
                 },
             },
             "spec": {

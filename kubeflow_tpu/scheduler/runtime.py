@@ -29,6 +29,7 @@ import logging
 import time
 from dataclasses import dataclass, field, replace
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.api import inferenceservice as isvcapi
 from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.runtime.errors import ApiError, NotFound
@@ -1283,7 +1284,7 @@ class TpuFleetScheduler:
                 # OUR intents only — a notebook named pool-scale-up-*
                 # has a capacity PR with a matching prefix but no
                 # scale-up label; it must not be janitored.
-                if "tpu.kubeflow.org/scale-up-accelerator" not in labels:
+                if keys.TPU_SCALE_UP_ACCELERATOR not in labels:
                     continue
                 try:
                     await self.kube.delete("ProvisioningRequest",
@@ -1609,7 +1610,9 @@ class TpuFleetScheduler:
         try:
             await self.recorder.event(nb, type_, reason, message)
         except Exception:
-            pass  # events are best-effort
+            # Events are best-effort BY CONTRACT; the recorder only
+            # counts API-level swallows, so count this one ourselves.
+            self.recorder.count_drop()
 
     def _refresh_gauges(self) -> None:
         self.m_queue_depth.set(len(self.policy.pending))

@@ -44,6 +44,7 @@ import time
 import urllib.request
 from dataclasses import dataclass
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.api.notebook import (
     DRAIN_REQUESTED_ANNOTATION,
     MAINTENANCE_ANNOTATION,
@@ -195,8 +196,8 @@ def _in_cluster_fetch(namespace: str, name: str):
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
     if ":" in host and not host.startswith("["):
         host = f"[{host}]"  # bare IPv6 apiserver address (IPv6-only clusters)
-    url = (f"https://{host}:{port}/apis/kubeflow.org/v1"
-           f"/namespaces/{namespace}/notebooks/{name}")
+    url = (f"https://{host}:{port}{keys.NOTEBOOKS_API_PATH_PREFIX}"
+           f"{namespace}/notebooks/{name}")
     ctx = ssl.create_default_context(cafile=os.path.join(_SA_DIR, "ca.crt"))
 
     def fetch() -> dict:
@@ -216,8 +217,8 @@ def _in_cluster_url(namespace: str, name: str) -> str:
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
     if ":" in host and not host.startswith("["):
         host = f"[{host}]"
-    return (f"https://{host}:{port}/apis/kubeflow.org/v1"
-            f"/namespaces/{namespace}/notebooks/{name}")
+    return (f"https://{host}:{port}{keys.NOTEBOOKS_API_PATH_PREFIX}"
+            f"{namespace}/notebooks/{name}")
 
 
 def _in_cluster_patcher(namespace: str, name: str):
@@ -314,7 +315,9 @@ class MaintenanceWatcher:
                 self._ann = self._fetch() or {}
                 self._last = self._ann.get(MAINTENANCE_ANNOTATION) or None
             except Exception:  # noqa: BLE001 — a flaky apiserver read must
-                pass           # not take down the training loop
+                # not take down the training loop; serve the cached view.
+                _log.debug("maintenance poll failed; keeping cached "
+                           "annotations", exc_info=True)
         return self._last
 
     def annotations(self, *, max_age: float | None = None) -> dict:
@@ -499,7 +502,7 @@ class CheckpointGuard:
             if jax.process_count() > 1 and jax.process_index() != 0:
                 self._ack_pending_step = None
                 return
-        except Exception:  # noqa: BLE001 — treat as single-process
+        except Exception:  # kftpu: ignore[exception-swallow] uninitialized jax backend/client ⇒ treat as single-process and fall through to the local ack path
             pass
         if self._patcher is None:
             try:
@@ -535,11 +538,12 @@ class CheckpointGuard:
 
         try:
             self._patcher({
-                "notebooks.kubeflow.org/checkpointing-at":
+                keys.NOTEBOOK_CHECKPOINTING_AT:
                     datetime.datetime.now(
                         datetime.timezone.utc).isoformat()})
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — purely a UI progress mark
+            _log.debug("checkpointing-at progress mark failed "
+                       "(best-effort)", exc_info=True)
 
     def step(self, step: int, pytree) -> bool:
         if step % self.sync_every_steps == 0:

@@ -33,11 +33,13 @@ from __future__ import annotations
 
 import os
 
+SERVING_ENV = "KFTPU_SERVING"
+
 
 def serving_enabled(environ=os.environ) -> bool:
     """The ``KFTPU_SERVING`` master switch — anything but off/false/0/no
     leaves the serving workload class on (it is inert until an
     InferenceService CR exists)."""
-    return environ.get("KFTPU_SERVING", "on").strip().lower() not in (
+    return environ.get(SERVING_ENV, "on").strip().lower() not in (
         "off", "false", "0", "no", "disabled",
     )

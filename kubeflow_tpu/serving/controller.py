@@ -32,6 +32,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.api import inferenceservice as isvcapi
 from kubeflow_tpu.migration import protocol as migration
 from kubeflow_tpu.runtime.apply import ApplyCache, informer_reader, reconcile_child
@@ -62,7 +63,7 @@ from kubeflow_tpu.serving.autoscaler import (
 
 log = logging.getLogger(__name__)
 
-STS_LABEL = "serving.kubeflow.org/replica-sts"
+STS_LABEL = keys.SERVING_REPLICA_STS_LABEL
 WORKERS_SERVICE_SUFFIX = "-workers"
 
 # Replica index from a replica StatefulSet name (`<svc>-r<i>[-s<j>]`).
@@ -840,7 +841,9 @@ class InferenceServiceReconciler:
         try:
             await self.recorder.event(isvc, type_, reason, message)
         except Exception:
-            pass
+            # Events are best-effort BY CONTRACT; the recorder only
+            # counts API-level swallows, so count this one ourselves.
+            self.recorder.count_drop()
 
 
 def _safe_float(raw) -> float:

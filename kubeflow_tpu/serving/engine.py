@@ -224,6 +224,7 @@ class ServingEngine:
                     if pending:
                         wait = pending[0].arrival * time_scale - now()
                         if wait > 0:
+                            # kftpu: ignore[no-blocking-in-async] serve() runs off-loop — bench.py / a dedicated serving worker thread drives it; the sleep paces the open-loop trace clock
                             time.sleep(min(wait, 0.05))
                     continue
                 # One decode step for the whole batch (static shape).

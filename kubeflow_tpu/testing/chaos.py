@@ -33,6 +33,7 @@ from kubeflow_tpu.controllers.notebook import (
     setup_notebook_controller,
 )
 from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.runtime.aiotasks import reap
 from kubeflow_tpu.runtime.errors import ApiError
 from kubeflow_tpu.runtime.manager import Manager
 from kubeflow_tpu.runtime.metrics import Registry
@@ -680,10 +681,7 @@ class ChaosSoak:
         finally:
             sdk_stop.set()
             sdk_task.cancel()
-            try:
-                await sdk_task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap(sdk_task)
             await sim.stop()
             # Each scheduler instance's cumulative counter is harvested
             # exactly once — at its death (_kill_manager for mid-soak

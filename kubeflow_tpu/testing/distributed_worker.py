@@ -19,6 +19,9 @@ from __future__ import annotations
 import os
 from functools import partial
 
+# Optional 2D-mesh mode (docs/operations.md "Probe / burn-in env").
+WORKER_MESH_ENV = "KFTPU_WORKER_MESH"
+
 
 def main() -> None:
     import jax
@@ -67,7 +70,7 @@ def main() -> None:
     # collectives a real dp x tp training step issues, across PROCESS
     # boundaries — psum on the model axis and pmean on data must both
     # cross the DCN bootstrap, not just a single 1D all-reduce.
-    mesh_spec = os.environ.get("KFTPU_WORKER_MESH")
+    mesh_spec = os.environ.get(WORKER_MESH_ENV)
     if mesh_spec:
         import math
 

@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 
 from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime.errors import ApiError
 from kubeflow_tpu.runtime.objects import deep_get
 
 
@@ -100,8 +101,8 @@ async def run_load_test(
         for name in names:
             try:
                 await kube.delete("Notebook", name, namespace)
-            except Exception:
-                pass
+            except ApiError:  # NotFound included — it subclasses ApiError
+                pass  # cleanup is best-effort; the report already exists
 
     return LoadTestReport(
         notebooks=count,

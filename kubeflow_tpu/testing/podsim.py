@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from kubeflow_tpu.runtime.aiotasks import reap
 from kubeflow_tpu.runtime.errors import AlreadyExists, ApiError, NotFound
 from kubeflow_tpu.runtime.objects import (
     deep_get,
@@ -90,11 +91,7 @@ class PodSimulator:
         self._running = False
         for t in [*self._tasks, *self._pod_tasks]:
             t.cancel()
-        for t in [*self._tasks, *self._pod_tasks]:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+        await reap(*self._tasks, *self._pod_tasks)
         self._pod_tasks.clear()
 
     async def _watch_workloads(self, kind: str) -> None:

@@ -25,6 +25,12 @@ from kubeflow_tpu.runtime.objects import annotations_of, deep_get, deepcopy
 
 UPDATE_PENDING_ANNOTATION = nbapi.UPDATE_PENDING_ANNOTATION
 
+# Fleet source knobs, shared with scheduler_options()/the scheduler
+# runtime (docs/operations.md "TPU fleet scheduler"): the webhook reads
+# them directly because admission runs in its own process.
+FLEET_ENV = "KFTPU_FLEET"
+FLEET_CONFIGMAP_ENV = "KFTPU_FLEET_CONFIGMAP"
+
 # Spec paths whose change forces a pod restart (the template IS the pod;
 # the tpu block changes replicas/selectors/env).
 _POD_AFFECTING = (("spec", "template"), ("spec", "tpu"))
@@ -157,11 +163,11 @@ async def _declared_fleet(kube):
     from kubeflow_tpu.scheduler.fleet import Fleet, FleetConfigError
     from kubeflow_tpu.scheduler.runtime import load_fleet_from_configmap
 
-    spec = os.environ.get("KFTPU_FLEET", "").strip()
+    spec = os.environ.get(FLEET_ENV, "").strip()
     if spec == "auto":
         return None
     if not spec:
-        configmap = os.environ.get("KFTPU_FLEET_CONFIGMAP")
+        configmap = os.environ.get(FLEET_CONFIGMAP_ENV)
         if not configmap or kube is None:
             return None
         from kubeflow_tpu.runtime.deployment import controller_namespace

@@ -46,6 +46,15 @@ def test_rendered_yaml_parses_with_invariants():
     assert any("dryrun_multichip" in s.get("run", "") for s in steps)
     assert any("make -C native" in s.get("run", "") for s in steps)
     assert any("ci/check_tracing.py" in s.get("run", "") for s in steps)
+    # The AST static-analysis gate (ISSUE 12): runs before the suite,
+    # exit 1 on findings, findings JSON uploaded as a build artifact.
+    analysis_step = next(
+        s for s in steps if "python -m ci.analysis" in s.get("run", ""))
+    assert "--json" in analysis_step["run"]
+    upload = next(s for s in steps
+                  if s.get("uses", "").startswith("actions/upload-artifact"))
+    assert upload["if"] == "always()"
+    assert "analysis-findings.json" in upload["with"]["path"]
 
     kind_wf = docs["kind-integration.yaml"]
     kind_steps = kind_wf["jobs"]["kind"]["steps"]
