@@ -104,7 +104,7 @@ async def spawn_notebook(cp: ControlPlane) -> dict:
     raise RuntimeError("notebook never became Ready")
 
 
-async def scale_test(cp: ControlPlane) -> dict:
+async def scale_test(cp: ControlPlane, count: int = SCALE_NOTEBOOKS) -> dict:
     """The N-notebook load test (testing/loadtest.py — the harness the
     reference ships without ever recording numbers, SURVEY.md §6). Runs
     AFTER the cold-start measurement so its wall time never pollutes
@@ -122,12 +122,12 @@ async def scale_test(cp: ControlPlane) -> dict:
     # histogram around the run so each trial reports its own reconciles.
     rec_before = cp.mgr.reconcile_seconds.snapshot(controller="notebook")
     report = await run_load_test(
-        cp.kube, count=SCALE_NOTEBOOKS, accelerator="v5e", topology="2x2",
+        cp.kube, count=count, accelerator="v5e", topology="2x2",
         timeout=120,
     )
-    if report.ready != SCALE_NOTEBOOKS:
+    if report.ready != count:
         raise RuntimeError(
-            f"load test: only {report.ready}/{SCALE_NOTEBOOKS} ready "
+            f"load test: only {report.ready}/{count} ready "
             f"(failures: {report.failures[:3]})"
         )
     rec_after = cp.mgr.reconcile_seconds.snapshot(controller="notebook")
@@ -307,6 +307,37 @@ def _longctx_bench() -> dict:
     }
 
 
+# Per-phase classification rules for the cold-start waterfall: every
+# phase names the signal that tells environment drift from a repo
+# regression, so r06+ artifacts classify a cold-start move from JSON
+# alone (the ROADMAP cold-start item's groundwork).
+COLDSTART_PHASE_RULES = {
+    "interpreter_spawn_sec": (
+        "environment: fork + CPython start + site init; compare "
+        "coldstart_canary.interpreter_spawn_sec — canary moved too -> "
+        "environment drift, canary flat -> probe-harness regression"),
+    "imports_sec": (
+        "import graph: compare coldstart_canary.import_jax_sec — canary "
+        "flat while this grew -> repo import regression (heavier "
+        "kubeflow_tpu import path)"),
+    "jax_init_sec": (
+        "backend attach: device client init / relay contention; grows "
+        "when another process holds the chip or the TPU runtime "
+        "restarts, never with cache state"),
+    "compile_sec": (
+        "XLA compile (param-init jit + train-step lower+compile): the "
+        "warm-cache run should collapse this toward ~0 — a warm run "
+        "paying cold-level compile is a cache miss (key churn: "
+        "jax/model version bump, shape change)"),
+    "first_step_sec": (
+        "first execution: weight allocation + host->device transfer; "
+        "scales with model size, independent of cache state"),
+    "unattributed_sec": (
+        "residual outside the instrumented phases; growth means a phase "
+        "boundary is missing from the probe"),
+}
+
+
 def _fresh_probe(t0_epoch: float) -> None:
     """Fresh-process start-to-first-step: everything a user's notebook
     start pays — interpreter + imports + device-client attach + init +
@@ -314,7 +345,20 @@ def _fresh_probe(t0_epoch: float) -> None:
     ``KFTPU_BENCH_CACHE_DIR`` env: pointed at the populated repo cache
     this measures the WARM start; pointed at an empty temp dir it
     measures the TRUE COLD start (nothing reusable on disk). Prints one
-    JSON line; the parent folds it into the main output."""
+    JSON line; the parent folds it into the main output.
+
+    Besides the headline, emits the PHASE-ATTRIBUTED waterfall
+    (``phases``): interpreter spawn / imports / jax init / compile /
+    first step, each classifiable via :data:`COLDSTART_PHASE_RULES` —
+    "where do the 43s go" answered from the artifact alone. The
+    standalone ``compile_sec`` keeps its historical meaning (train-step
+    lower+compile only) for cross-round comparability; the waterfall's
+    ``compile_sec`` phase also covers the param-init jit."""
+    proc_start = time.time()
+    phases: dict = {
+        "interpreter_spawn_sec": round(max(0.0, proc_start - t0_epoch), 3)}
+
+    t = time.perf_counter()
     from kubeflow_tpu.utils.compilecache import enable_persistent_cache
 
     enable_persistent_cache(os.environ.get("KFTPU_BENCH_CACHE_DIR", CACHE_DIR))
@@ -323,7 +367,13 @@ def _fresh_probe(t0_epoch: float) -> None:
     import jax
 
     from kubeflow_tpu.models import BurninConfig, init_params, make_train_step
+    phases["imports_sec"] = round(time.perf_counter() - t, 3)
 
+    t = time.perf_counter()
+    jax.devices()  # force the backend/device-client attach eagerly
+    phases["jax_init_sec"] = round(time.perf_counter() - t, 3)
+
+    t_phase = time.perf_counter()
     cfg = BurninConfig(**BENCH_MODEL)
     params = jax.jit(partial(init_params, cfg=cfg))(jax.random.key(0))
     tokens = jax.random.randint(
@@ -333,11 +383,20 @@ def _fresh_probe(t0_epoch: float) -> None:
     t0 = time.perf_counter()
     compiled = step.lower(params, tokens).compile()
     compile_sec = time.perf_counter() - t0
+    phases["compile_sec"] = round(time.perf_counter() - t_phase, 3)
+
+    t = time.perf_counter()
     params, loss = compiled(params, tokens)
     float(loss)
+    phases["first_step_sec"] = round(time.perf_counter() - t, 3)
+
+    total = round(time.time() - t0_epoch, 3)
+    phases["unattributed_sec"] = round(
+        max(0.0, total - sum(phases.values())), 3)
     print(json.dumps({
-        "coldstart_sec": round(time.time() - t0_epoch, 3),
+        "coldstart_sec": total,
         "compile_sec": round(compile_sec, 3),
+        "phases": phases,
     }))
 
 
@@ -438,6 +497,14 @@ def _coldstart_probes() -> dict:
         "cold_compile_sec": cold.get("compile_sec") if cold else None,
         "coldstart_warm_cache_sec": warm.get("coldstart_sec") if warm else None,
         "warm_compile_sec": warm.get("compile_sec") if warm else None,
+        # Phase-attributed waterfall (ISSUE 13): WHERE the cold/warm
+        # seconds go, with a per-phase classification rule — the
+        # ROADMAP cold-start war's attribution groundwork.
+        "coldstart_waterfall": {
+            "cold": cold.get("phases") if cold else None,
+            "warm": warm.get("phases") if warm else None,
+            "classification": COLDSTART_PHASE_RULES,
+        },
         # Environment canary alongside the numbers it classifies (the
         # r03→r05 warm-cache drift was unattributable from artifacts
         # alone; this block fixes that going forward).
@@ -2047,6 +2114,82 @@ def tracing_overhead() -> dict:
     }
 
 
+def slo_overhead(smoke: bool = False) -> dict:
+    """`bench.py slo_overhead [--smoke]` — prove the SLO engine +
+    durable lifecycle timelines (ISSUE 13: per-reconcile SLI scoring,
+    per-transition journal annotation patches) cost <5% of control-plane
+    reconcile throughput. Same paired-trial protocol as
+    `tracing_overhead` (PR 3): each pair runs one enabled and one
+    disabled `control_plane_scale` trial back-to-back with alternating
+    order, the headline is the MEDIAN per-pair throughput delta, and the
+    <5% gate fails the CI step. Chip-free."""
+    from kubeflow_tpu.runtime import slo as slo_mod
+    from kubeflow_tpu.runtime import timeline as timeline_mod
+
+    pairs = 3 if smoke else 5
+    count = 120 if smoke else SCALE_NOTEBOOKS
+
+    async def _run_phase():
+        cp = await ControlPlane().start()
+        try:
+            return await scale_test(cp, count=count)
+        finally:
+            await cp.stop()
+
+    def one_trial(enabled: bool) -> dict:
+        slo_mod.set_enabled(enabled)
+        timeline_mod.set_enabled(enabled)
+        try:
+            return asyncio.run(_run_phase())
+        finally:
+            slo_mod.set_enabled(True)
+            timeline_mod.set_enabled(True)
+
+    enabled_trials: list[dict] = []
+    disabled_trials: list[dict] = []
+    deltas: list[float] = []
+    rec_deltas: list[float] = []
+    for i in range(pairs):
+        if i % 2 == 0:
+            on, off = one_trial(True), one_trial(False)
+        else:
+            off, on = one_trial(False), one_trial(True)
+        enabled_trials.append(on)
+        disabled_trials.append(off)
+        deltas.append(
+            100.0 * (off["notebooks_per_sec"] - on["notebooks_per_sec"])
+            / off["notebooks_per_sec"])
+        if on.get("reconcile_mean_sec") and off.get("reconcile_mean_sec"):
+            rec_deltas.append(
+                100.0 * (on["reconcile_mean_sec"] - off["reconcile_mean_sec"])
+                / off["reconcile_mean_sec"])
+
+    overhead_pct = round(_median_sorted(sorted(deltas)), 2)
+    return {
+        "metric": "slo_overhead",
+        "value": overhead_pct,
+        "unit": "pct_throughput_regression",
+        "notebooks": count,
+        "pairs": pairs,
+        "pair_deltas_pct": [round(d, 2) for d in deltas],
+        "enabled_notebooks_per_sec": sorted(
+            t["notebooks_per_sec"] for t in enabled_trials),
+        "disabled_notebooks_per_sec": sorted(
+            t["notebooks_per_sec"] for t in disabled_trials),
+        # Timeline writes are real API patches: surface the write-count
+        # delta so a regression is attributable (journal churn vs CPU).
+        "enabled_api_writes": sorted(
+            t["api_writes"] for t in enabled_trials),
+        "disabled_api_writes": sorted(
+            t["api_writes"] for t in disabled_trials),
+        "overhead_pct": overhead_pct,
+        "reconcile_overhead_pct": (
+            round(_median_sorted(sorted(rec_deltas)), 2)
+            if rec_deltas else None),
+        "pass": overhead_pct < 5.0,
+    }
+
+
 def bench() -> dict:
     from kubeflow_tpu.utils.compilecache import cache_entries, enable_persistent_cache
 
@@ -2217,6 +2360,13 @@ if __name__ == "__main__":
         _fresh_probe(float(sys.argv[2]) if len(sys.argv) > 2 else time.time())
     elif len(sys.argv) >= 2 and sys.argv[1] == "tracing_overhead":
         print(json.dumps(tracing_overhead()))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "slo_overhead":
+        result = slo_overhead(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(result))
+        # CI gate like tracing_overhead: the SLO engine + timeline
+        # journal must stay under 5% of control-plane throughput.
+        if not result["pass"]:
+            sys.exit(1)
     elif len(sys.argv) >= 2 and sys.argv[1] == "simulated_rtt":
         print(json.dumps(simulated_rtt()))
     elif len(sys.argv) >= 2 and sys.argv[1] == "scheduler_scale":

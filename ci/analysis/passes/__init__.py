@@ -13,5 +13,6 @@ from ci.analysis.passes import (  # noqa: F401
     coroutines,
     envknobs,
     keys,
+    sloreg,
     swallow,
 )

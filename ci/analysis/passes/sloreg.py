@@ -1,0 +1,151 @@
+"""``slo-registry`` / ``debug-route-docs``: the SLO surface cannot drift
+from the runbook.
+
+Two drift classes this pass kills (ISSUE 13):
+
+- **SLI registry drift**: every SLI registered in
+  ``kubeflow_tpu/runtime/slo.py``'s ``SLI_SPECS`` must be a pure literal
+  (name, env knob, threshold, target, description) whose objective knob
+  AND name appear in ``docs/operations.md`` — an SLI whose objective an
+  operator cannot find (or tune) is a promise nobody can keep.
+- **debug-route drift**: every ``/debug/*`` route registered anywhere in
+  the package (``router.add_get/add_post`` with a literal path) must
+  appear in the docs route table. The PR 3–12 debug surface is the
+  operator's front door; an undocumented door might as well be locked.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ci.analysis.core import (
+    Finding,
+    Project,
+    analysis_pass,
+    call_name,
+    str_const,
+)
+
+RULE_SLO = "slo-registry"
+RULE_ROUTES = "debug-route-docs"
+
+SLO_MODULE = "kubeflow_tpu/runtime/slo.py"
+DOCS = os.path.join("docs", "operations.md")
+
+
+def _sli_specs_node(tree: ast.AST) -> ast.AST | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and \
+                        target.id == "SLI_SPECS":
+                    return node.value
+    return None
+
+
+@analysis_pass(
+    "slo-registry", (RULE_SLO, RULE_ROUTES),
+    "every SLI in runtime/slo.py SLI_SPECS must have its objective knob "
+    "and name documented in docs/operations.md, and every /debug/* route "
+    "must appear in the docs route table")
+def check_slo_registry(project: Project):
+    if not project.full_tree:
+        # Whole-tree contract: a single-file scan cannot judge the
+        # registry or the route table.
+        return
+
+    docs_path = os.path.join(project.root, DOCS)
+    docs_text = (open(docs_path, encoding="utf-8").read()
+                 if os.path.exists(docs_path) else "")
+    if not docs_text:
+        # The runbook being GONE is the worst drift case — the pass must
+        # not go green by vacuity (every doc check below is docs-gated).
+        yield Finding(
+            rule=RULE_SLO, path=SLO_MODULE, line=1,
+            message="docs/operations.md is missing or empty — the SLI "
+                    "table and /debug route table live there; the "
+                    "registry cannot be checked against a runbook that "
+                    "does not exist")
+
+    slo_sf = project.get(SLO_MODULE)
+    if slo_sf is None or slo_sf.tree is None:
+        yield Finding(
+            rule=RULE_SLO, path=SLO_MODULE, line=1,
+            message="SLI registry module missing or unparsable — the "
+                    "SLO engine's declarative registry lives here")
+    else:
+        specs = _sli_specs_node(slo_sf.tree)
+        if specs is None or not isinstance(specs, (ast.Tuple, ast.List)):
+            yield Finding(
+                rule=RULE_SLO, path=SLO_MODULE, line=1,
+                message="SLI_SPECS literal not found — the registry must "
+                        "be a module-level tuple of (name, env, "
+                        "threshold, target, description) literals")
+        else:
+            for entry in specs.elts:
+                line = entry.lineno
+                if not isinstance(entry, (ast.Tuple, ast.List)) \
+                        or len(entry.elts) != 5:
+                    yield Finding(
+                        rule=RULE_SLO, path=SLO_MODULE, line=line,
+                        message="SLI spec must be a 5-tuple literal "
+                                "(name, env knob, default threshold, "
+                                "default target, description)")
+                    continue
+                name = str_const(entry.elts[0])
+                env = str_const(entry.elts[1])
+                desc = str_const(entry.elts[4])
+                if not name or not env or not desc:
+                    yield Finding(
+                        rule=RULE_SLO, path=SLO_MODULE, line=line,
+                        message="SLI spec name/env/description must be "
+                                "string literals (the registry is read "
+                                "from the AST by this pass)")
+                    continue
+                if not env.startswith("KFTPU_SLO_"):
+                    yield Finding(
+                        rule=RULE_SLO, path=SLO_MODULE, line=line,
+                        message=f"SLI {name!r}: objective knob {env!r} "
+                                "must live under the KFTPU_SLO_ prefix")
+                if docs_text and env not in docs_text:
+                    yield Finding(
+                        rule=RULE_SLO, path=SLO_MODULE, line=line,
+                        message=f"SLI {name!r}: objective knob {env!r} "
+                                "is not documented in "
+                                "docs/operations.md — add it to the "
+                                "SLI table in \"SLOs & burn-rate "
+                                "alerting\"")
+                if docs_text and name not in docs_text:
+                    yield Finding(
+                        rule=RULE_SLO, path=SLO_MODULE, line=line,
+                        message=f"SLI {name!r} is not documented in "
+                                "docs/operations.md — every registered "
+                                "SLI needs a row in the SLI table")
+
+    # ---- /debug route table ----------------------------------------------------
+    seen_prefixes: set[str] = set()
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) \
+                    or call_name(node) not in ("add_get", "add_post") \
+                    or not node.args:
+                continue
+            path = str_const(node.args[0])
+            if not path or not path.startswith("/debug"):
+                continue
+            # "/debug/timeline/{ns}/{name}" documents as its static
+            # prefix — the docs table names routes, not match params.
+            prefix = path.split("{")[0].rstrip("/") or path
+            if prefix in seen_prefixes:
+                continue
+            seen_prefixes.add(prefix)
+            if docs_text and prefix not in docs_text:
+                yield Finding(
+                    rule=RULE_ROUTES, path=sf.path, line=node.lineno,
+                    message=f"debug route {path!r} is not in the "
+                            "docs/operations.md route table — every "
+                            "/debug/* endpoint must be documented "
+                            f"(add a row naming {prefix!r})")

@@ -139,6 +139,12 @@ def workflow_tests() -> dict:
                         "on gate failure)",
                         "python bench.py inference_serving --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("SLO-engine overhead gate (paired A/B trials: "
+                        "SLO + lifecycle-timeline on vs off must cost "
+                        "<5% of control-plane throughput; exit 1 on "
+                        "gate failure)",
+                        "python bench.py slo_overhead --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

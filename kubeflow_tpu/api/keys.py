@@ -70,6 +70,10 @@ NOTEBOOK_CHECKPOINT_PATH = "notebooks.kubeflow.org/checkpoint-path"
 NOTEBOOK_CHECKPOINT_STEP = "notebooks.kubeflow.org/checkpoint-step"
 NOTEBOOK_SUSPEND = "notebooks.kubeflow.org/suspend"
 
+# Durable lifecycle timeline (PR 13, runtime/timeline.py): the compact
+# capped journal of lifecycle transitions that survives manager restarts.
+NOTEBOOK_TIMELINE = "notebooks.kubeflow.org/timeline"
+
 # ---- tpu.kubeflow.org: pod-template TPU wiring -------------------------------
 
 TPU_ACCELERATOR = "tpu.kubeflow.org/accelerator"

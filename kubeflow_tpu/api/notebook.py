@@ -128,6 +128,11 @@ CHECKPOINT_STEP_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_STEP
 #   un-park and restore. Set by kubectl/JWA or sdk.suspend().
 SUSPEND_ANNOTATION = keys.NOTEBOOK_SUSPEND
 
+# Durable lifecycle timeline (runtime/timeline.py): compact capped
+# journal of lifecycle transitions (Queued→Admitted→Ready→…), persisted
+# on the CR so it survives manager restarts; /debug/timeline reads it.
+TIMELINE_ANNOTATION = keys.NOTEBOOK_TIMELINE
+
 # Pod-template annotations the controller stamps so pod-level admission can
 # compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
 TPU_ACCELERATOR_ANNOTATION = keys.TPU_ACCELERATOR

@@ -44,6 +44,16 @@ def build_manager_app(mgr=None) -> web.Application:
     - ``/debug/scheduler`` (when the fleet scheduler is wired) — pools
       and free slices, admitted gangs, the ranked queue, per-namespace
       chip shares, preemption verdicts, invariant-violation counter.
+    - ``/debug/slo`` — the SLO engine's per-SLI status: objective,
+      window counts, 5m/1h/6h burn rates, budget remaining, health, and
+      the worst offenders with exemplar trace ids.
+    - ``/debug/timeline/<ns>/<name>`` — the object's durable lifecycle
+      timeline (Queued→Admitted→Ready→Draining→Parked→…), replayed from
+      the capped CR annotation so it survives manager restarts.
+    - ``/debug/scheduler/explain/<ns>/<name>`` — scheduler
+      explainability: why a gang is queued (position, rank, blocking
+      shape, feasible-if-drained candidates, scale-up intent age,
+      starvation-door state) plus the timeline tail.
     """
     app = web.Application()
 
@@ -52,6 +62,11 @@ def build_manager_app(mgr=None) -> web.Application:
 
     async def metrics(_request):
         registry = mgr.registry if mgr is not None else global_registry
+        if mgr is not None and getattr(mgr, "slo", None) is not None:
+            # Burn-rate/budget gauges are recomputed at scrape time, not
+            # per observation — the windows slide whether or not events
+            # arrive, so a scrape must never serve stale burn.
+            mgr.slo.refresh()
         return web.Response(
             text=registry.expose(), content_type="text/plain"
         )
@@ -103,10 +118,28 @@ def build_manager_app(mgr=None) -> web.Application:
                  "key": f"{namespace or '-'}/{name}"},
                 status=200 if released else 404)
 
+        async def debug_slo(_request):
+            # Per-SLI objective, window counts, multi-window burn rates,
+            # budget remaining, health verdict, and the worst offenders
+            # with exemplar trace ids (join them against /debug/traces).
+            mgr.slo.refresh()
+            return web.json_response({"slo": mgr.slo.debug_info()})
+
+        async def debug_timeline(request):
+            ns = request.match_info["ns"]
+            name = request.match_info["name"]
+            entries = mgr.debug_timeline((ns or None, name))
+            return web.json_response({
+                "key": f"{ns}/{name}",
+                "timeline": entries,
+            }, status=200 if entries else 404)
+
         app.router.add_get("/debug/traces", debug_traces)
         app.router.add_get("/debug/queue", debug_queue)
         app.router.add_post("/debug/queue/requeue", debug_queue_requeue)
         app.router.add_get("/debug/informers", debug_informers)
+        app.router.add_get("/debug/slo", debug_slo)
+        app.router.add_get("/debug/timeline/{ns}/{name}", debug_timeline)
 
         if getattr(mgr, "scheduler", None) is not None:
             async def debug_scheduler(_request):
@@ -117,7 +150,25 @@ def build_manager_app(mgr=None) -> web.Application:
                 return web.json_response(
                     {"scheduler": mgr.scheduler.debug_info()})
 
+            async def debug_scheduler_explain(request):
+                # The machine answer to "why is this gang still queued":
+                # queue position + rank components, blocking shape,
+                # feasible-if-drained victim candidates, pending
+                # scale-up intent age, starvation-door state, and the
+                # object's lifecycle timeline tail.
+                ns = request.match_info["ns"]
+                name = request.match_info["name"]
+                explanation = mgr.scheduler.explain((ns or None, name))
+                explanation["timeline"] = mgr.debug_timeline(
+                    (ns or None, name))[-8:]
+                return web.json_response(
+                    {"explain": explanation},
+                    status=404 if explanation.get("state") == "Unknown"
+                    else 200)
+
             app.router.add_get("/debug/scheduler", debug_scheduler)
+            app.router.add_get("/debug/scheduler/explain/{ns}/{name}",
+                               debug_scheduler_explain)
     return app
 
 

@@ -79,7 +79,9 @@ from kubeflow_tpu.runtime.objects import (
     set_controller_owner,
     uid_of,
 )
-from kubeflow_tpu.runtime.tracing import span
+from kubeflow_tpu.runtime import slo
+from kubeflow_tpu.runtime import timeline as timeline_mod
+from kubeflow_tpu.runtime.tracing import current_trace_id, span
 from kubeflow_tpu.migration import protocol as migration
 from kubeflow_tpu.tpu.topology import JAX_COORDINATOR_PORT, TpuSlice
 
@@ -257,6 +259,13 @@ class NotebookReconciler:
         self._nb_informer = None
         self._pr_informer = None
         self._pod_informer = None
+        # Durable lifecycle timeline recorder (runtime/timeline.py) —
+        # the manager's, shared across controllers; None in bare
+        # reconciler tests. This reconciler is the SINGLE timeline
+        # writer per notebook key (the workqueue serializes reconciles
+        # per key), so every layer's transition lands through
+        # _update_status exactly once.
+        self._timeline = None
         # kind → informer for owned children: reconcile_child reads the
         # live object from the watch cache instead of a per-child GET.
         # (Populated by setup_notebook_controller; the reader reads the
@@ -307,6 +316,8 @@ class NotebookReconciler:
         if nb is None or get_meta(nb).get("deletionTimestamp"):
             self._mirrored.pop((namespace, name), None)
             self._last_status.pop((namespace, name), None)
+            if self._timeline is not None:
+                self._timeline.forget((namespace, name))
             # The namespace's running/chip gauges must drop the deleted
             # notebook's contribution now, not at the next unrelated
             # reconcile in this namespace.
@@ -1868,6 +1879,40 @@ class NotebookReconciler:
             else 0,
             chips=0 if stopped else (ms.num_chips if ms else 0),
         )
+        await self._record_timeline(nb, ms, sched_status, mig_status,
+                                    ready=ready, want_hosts=want_hosts)
+
+    async def _record_timeline(self, nb: dict, ms, sched_status,
+                               mig_status, *, ready: int,
+                               want_hosts: int) -> None:
+        """Fold this reconcile's derived state into the durable lifecycle
+        timeline (runtime/timeline.py) and, on a NEW Ready transition,
+        score the startup episode against the time-to-ready SLO. One
+        record per reconcile; a no-transition call costs a dict lookup."""
+        if self._timeline is None:
+            return
+        sched = sched_status or {}
+        mig = mig_status or {}
+        state = timeline_mod.derive_lifecycle(
+            sched_state=sched.get("state"),
+            mig_state=mig.get("state"),
+            stopped=nbapi.is_stopped(nb),
+            ready=ready, want_hosts=want_hosts,
+            reclaimed=sched.get("reclaimed", ""))
+        reason = (sched.get("reclaimed") or sched.get("reason")
+                  or mig.get("reason") or "")
+        shape = (f"{ms.num_slices}x{ms.slice.accelerator.name}:"
+                 f"{ms.slice.topology_str}" if ms else "")
+        key = (namespace_of(nb), name_of(nb))
+        entries = await self._timeline.record(
+            key, state, at=self._now(), reason=reason,
+            trace_id=current_trace_id(), shape=shape,
+            annotations=annotations_of(nb))
+        if entries is not None and state == timeline_mod.READY:
+            ttr = timeline_mod.time_to_ready(entries)
+            if ttr is not None:
+                slo.observe("notebook_time_to_ready", ttr, key=key,
+                            trace_id=current_trace_id())
 
     def _set_gauge_contribution(
         self, ns: str | None, name: str, running: int, chips: int
@@ -2119,6 +2164,9 @@ def setup_notebook_controller(
     *, scheduler=_SCHEDULER_FROM_ENV,
 ) -> NotebookReconciler:
     rec = NotebookReconciler(mgr.kube, options, registry=mgr.registry)
+    # Durable lifecycle timelines + SLO feeds (runtime/{timeline,slo}.py)
+    # ride the manager's shared recorder/engine.
+    rec._timeline = getattr(mgr, "timeline", None)
     if scheduler is _SCHEDULER_FROM_ENV:
         # KFTPU_SCHEDULER=off is the kill switch (ISSUE 5): the capacity
         # stage then runs exactly the pre-scheduler gate. On (default),

@@ -21,6 +21,7 @@ import asyncio
 import json
 import logging
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
@@ -38,6 +39,8 @@ from kubeflow_tpu.runtime.objects import (
     now_iso,
 )
 from kubeflow_tpu.runtime.queue import RateLimitedQueue
+from kubeflow_tpu.runtime import slo as slo_mod
+from kubeflow_tpu.runtime import timeline as timeline_mod
 from kubeflow_tpu.runtime.tracing import span
 
 log = logging.getLogger(__name__)
@@ -148,6 +151,15 @@ class Manager:
         # (queue wait, controller phases, API verbs) is retained after the
         # reconcile ends and served by /debug/traces.
         self.tracer = Tracer(self.registry)
+        # SLO engine (runtime/slo.py): the manager owns one and installs
+        # it as the process-wide feed target, so scattered producers
+        # (scheduler admission wait, drain finalize, serving completions)
+        # observe without constructor threading. Serves /debug/slo.
+        self.slo = slo_mod.install(slo_mod.SloEngine(self.registry))
+        # Durable lifecycle timelines (runtime/timeline.py): journal of
+        # per-object lifecycle transitions persisted as a capped CR
+        # annotation — survives manager restarts, serves /debug/timeline.
+        self.timeline = timeline_mod.TimelineRecorder(kube)
         self._reconcile_total = self.registry.counter(
             "controller_reconcile_total", "Reconciles per controller", ["controller", "result"]
         )
@@ -362,6 +374,21 @@ class Manager:
         oldest queue wait."""
         return {name: q.debug_info() for name, q in self._queues.items()}
 
+    def debug_timeline(self, key) -> list[dict]:
+        """One object's lifecycle timeline (/debug/timeline/<ns>/<name>):
+        the recorder's cache merged with the durable annotation read from
+        the primary informer — a rebuilt manager serves the journal its
+        predecessor persisted."""
+        key = tuple(key)
+        annotations = None
+        informer = self._primaries.get("notebook")
+        if informer is not None:
+            obj = informer.get(key[1], key[0])
+            if obj is not None:
+                annotations = (get_meta(obj).get("annotations") or {})
+        return timeline_mod.render(
+            self.timeline.entries(key, annotations=annotations))
+
     def debug_informers(self) -> dict:
         """Per-informer cache state: sync, object counts, index hit/miss."""
         out = {}
@@ -377,6 +404,8 @@ class Manager:
                 return
             queue_wait = queue.take_wait(key)
             self._queue_depth.labels(controller=ctrl.name).set(len(queue))
+            t0 = time.perf_counter()
+            trace_id = None
             try:
                 with self.tracer.trace(
                     "reconcile", controller=ctrl.name, key=key
@@ -384,6 +413,7 @@ class Manager:
                     # The wait happened before any span context existed;
                     # inject it so the trace covers queue→done end to end.
                     root.add_synthetic("queue_wait", queue_wait)
+                    trace_id = root.trace_id
                     result = await ctrl.reconcile(key)
             except Exception as exc:
                 log.exception("reconcile %s %s failed", ctrl.name, key)
@@ -427,6 +457,13 @@ class Manager:
                 queue.done(key)
                 if result and result.requeue_after:
                     queue.add(key, result.requeue_after)
+            # Reconcile-latency SLI: the histogram above is the raw
+            # signal; this is the same number scored against the
+            # objective (success and failure alike — a failing reconcile
+            # still spent the operator's latency budget).
+            self.slo.observe("reconcile_latency",
+                             time.perf_counter() - t0, key=key,
+                             trace_id=trace_id)
             # Fairness: FakeKube awaits are often non-blocking, so guarantee
             # the event loop runs between reconciles even in a hot loop.
             await asyncio.sleep(0)

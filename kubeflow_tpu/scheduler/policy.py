@@ -351,10 +351,25 @@ class PolicyQueue:
         boost = int(max(0.0, now - req.submitted_at) // cfg.aging_seconds)
         return req.priority + min(boost, cfg.aging_max_boost)
 
-    def _rank_key(self, req: GangRequest, now: float):
-        share = self.ledger.ns_chips.get(req.namespace, 0) \
+    def _share(self, req: GangRequest) -> float:
+        """Weighted fair-share term (admitted chips / namespace weight)
+        — one definition for ranking AND the explain mirror."""
+        return self.ledger.ns_chips.get(req.namespace, 0) \
             / max(req.weight, 1e-9)
-        return (-self._effective_priority(req, now), share, req.seq)
+
+    def _starved(self, req: GangRequest, now: float) -> bool:
+        """Does this gang hold the starvation door for its shape? One
+        predicate shared by schedule()'s backfill block and explain() —
+        the explanation must mirror what admission actually enforces
+        (incl. the never-fits ceiling exemption)."""
+        return (now - req.submitted_at
+                >= self.config.starvation_reserve_seconds
+                and self.fleet.total_slices(req.accelerator, req.topology)
+                >= req.num_slices)
+
+    def _rank_key(self, req: GangRequest, now: float):
+        return (-self._effective_priority(req, now), self._share(req),
+                req.seq)
 
     def _ordered_pending(self, now: float) -> list:
         return sorted(self.pending.values(),
@@ -517,11 +532,7 @@ class PolicyQueue:
                         waited=max(0.0, now - req.submitted_at)))
                     progressed = True
                     break  # shares changed; re-rank from scratch
-                if (now - req.submitted_at
-                        >= self.config.starvation_reserve_seconds
-                        and self.fleet.total_slices(
-                            req.accelerator, req.topology)
-                        >= req.num_slices):
+                if self._starved(req, now):
                     # Starved: hold the door on this SHAPE — no backfill
                     # jumps it, so the capacity it needs can drain free.
                     # Only for gangs the fleet CAN eventually host: a
@@ -632,3 +643,86 @@ class PolicyQueue:
                        reason=self._queue_reason(req))
             for i, req in enumerate(self._ordered_pending(now))
         ]
+
+    def explain(self, key: tuple, now: float) -> dict:
+        """The machine answer to "why is this gang where it is" —
+        read-only (``fit`` plans, ``_find_victims`` simulates; neither
+        mutates the ledger). Three shapes: an Admitted/Draining holder,
+        a Queued gang with its full rank breakdown, or Unknown."""
+        key = tuple(key)
+        alloc = self.ledger.allocations.get(key)
+        if alloc is not None:
+            return {
+                "state": "Draining" if alloc.draining else "Admitted",
+                "chips": alloc.chips,
+                "slices": alloc.num_slices,
+                "priority": alloc.priority,
+                "placements": dict(alloc.placements),
+                "borrow": dict(alloc.borrow or {}),
+                "admitted_at": alloc.admitted_at,
+                "forced_overcommit": alloc.forced,
+                "workload": alloc.workload,
+            }
+        req = self.pending.get(key)
+        if req is None:
+            return {"state": "Unknown",
+                    "reason": "not admitted, queued, or draining — the "
+                              "scheduler does not track this key"}
+        ordered = self._ordered_pending(now)
+        position = next(i + 1 for i, r in enumerate(ordered)
+                        if r.key == key)
+        cfg = self.config
+        waited = max(0.0, now - req.submitted_at)
+        shape = (req.accelerator.lower(), req.topology.lower())
+        fits_now = self.ledger.fit(req.accelerator, req.topology,
+                                   req.num_slices) is not None
+        victims = (self._find_victims(req, now)
+                   if cfg.enable_preemption and not fits_now else None)
+        total = self.fleet.total_slices(req.accelerator, req.topology)
+        starved = self._starved(req, now)
+        # Is an EARLIER starved gang holding this shape's door shut?
+        door_holder = None
+        for i, r in enumerate(ordered):
+            if i >= position - 1:
+                break
+            if (r.accelerator.lower(), r.topology.lower()) == shape \
+                    and self._starved(r, now):
+                door_holder = r.key
+                break
+        draining_same_shape = [
+            list(a.key) for a in self.ledger.allocations.values()
+            if a.draining and (a.accelerator.lower(),
+                               a.topology.lower()) == shape]
+        return {
+            "state": "Queued",
+            "position": position,
+            "of": len(ordered),
+            "reason": self._queue_reason(req),
+            "blocking_shape": f"{req.accelerator}:{req.topology}",
+            "chips": req.chips,
+            "slices": req.num_slices,
+            "rank": {
+                "priority": req.priority,
+                "aging_boost": (self._effective_priority(req, now)
+                                - req.priority),
+                "effective_priority": self._effective_priority(req, now),
+                "namespace_share": round(self._share(req), 3),
+                "arrival_seq": req.seq,
+            },
+            "waited_seconds": round(waited, 3),
+            "fits_now": fits_now,
+            "feasible_if_drained": victims is not None,
+            "drain_candidates": [
+                {"key": list(a.key), "reason": reason, "chips": a.chips}
+                for a, reason in (victims or [])
+            ],
+            "already_draining": draining_same_shape,
+            "fleet_ceiling_slices": total,
+            "over_ceiling": total < req.num_slices,
+            "starvation": {
+                "reserve_seconds": cfg.starvation_reserve_seconds,
+                "holds_door": starved,
+                "blocked_by_starved": (list(door_holder)
+                                       if door_holder else None),
+            },
+        }

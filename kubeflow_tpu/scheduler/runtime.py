@@ -43,7 +43,8 @@ from kubeflow_tpu.runtime.objects import (
     namespace_of,
     parse_iso,
 )
-from kubeflow_tpu.runtime.tracing import span
+from kubeflow_tpu.runtime import slo
+from kubeflow_tpu.runtime.tracing import current_trace_id, span
 from kubeflow_tpu.migration import protocol as migration
 from kubeflow_tpu.scheduler import elastic
 from kubeflow_tpu.scheduler.fleet import Fleet
@@ -873,6 +874,10 @@ class TpuFleetScheduler:
         for a in result.admitted:
             with span("admit", key=f"{a.key[0]}/{a.key[1]}"):
                 self.m_wait.observe(a.waited)
+                # Time-to-admission SLI (runtime/slo.py): the same wait
+                # the histogram records, scored against the objective.
+                slo.observe("scheduler_time_to_admission", a.waited,
+                            key=a.key, trace_id=current_trace_id())
                 self._state[a.key] = "Admitted"
                 self._requeue_credit.pop(a.key, None)
                 self._reclaim_verdict.pop(a.key, None)
@@ -1032,6 +1037,12 @@ class TpuFleetScheduler:
                 self.m_drain.observe(now - drain.requested_at)
         else:
             self.m_drain_fallback.inc()
+        # Drain-roundtrip SLI: ack-less grace fallbacks count as bad
+        # events at the full elapsed time — a fleet whose drains always
+        # hard-stop is failing its migration promise even though chips
+        # were never held hostage.
+        slo.observe("drain_roundtrip", now - drain.requested_at,
+                    key=key, trace_id=current_trace_id())
         park_stamp = None
         if drain.requeue:
             # Elastic park: once the victim's release path observes the
@@ -1682,6 +1693,55 @@ class TpuFleetScheduler:
             for k, d in self._draining.items()
         }
         return info
+
+    def explain(self, key: tuple) -> dict:
+        """Scheduler explainability (/debug/scheduler/explain): the pure
+        policy explanation (queue position, rank breakdown, blocking
+        shape, feasible-if-drained candidates, starvation-door state)
+        plus the runtime-only context — the in-flight drain, the pending
+        scale-up intent's age, the elastic re-queue verdict, and any
+        failed-stop retry. Read-only."""
+        key = tuple(key)
+        now = self._now()
+        if not self.active:
+            return {"state": "Inactive",
+                    "reason": "no fleet configured — every admission "
+                              "passes through"}
+        out = self.policy.explain(key, now)
+        out["key"] = f"{key[0]}/{key[1]}"
+        drain = self._draining.get(key)
+        if drain is not None:
+            out["drain"] = {
+                "reason": drain.reason,
+                "for": f"{drain.for_key[0]}/{drain.for_key[1]}",
+                "requested_at": drain.requested_at,
+                "deadline_in_sec": round(drain.deadline - now, 3),
+                "auto_requeue": drain.requeue,
+            }
+        if key in self._stop_pending:
+            out["stop_pending"] = self._stop_pending[key]
+        if key in self._preempted:
+            out["preempted_reason"] = self._preempted[key]
+        if key in self._reclaim_verdict:
+            out["reclaimed"] = self._reclaim_verdict[key]
+        if key in self._requeue_credit:
+            out["requeue_credit_seconds"] = round(
+                now - self._requeue_credit[key], 3)
+        if self._intent_book is not None and out.get("blocking_shape"):
+            acc, _, topo = out["blocking_shape"].partition(":")
+            intent = self._intent_book.for_shape(acc, topo)
+            if intent is not None:
+                out["scale_up_intent"] = {
+                    "name": intent.name,
+                    "chips": intent.chips,
+                    "slices": intent.slices,
+                    "pending_seconds": round(
+                        intent.pending_seconds(now), 3),
+                    "renewals": intent.renewals,
+                    "denied": intent.denied,
+                    "for_this_gang": key in intent.for_keys,
+                }
+        return out
 
 
 def _fmt_placements(placements: dict) -> str:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+from kubeflow_tpu.runtime import slo
 from kubeflow_tpu.runtime.tracing import span
 
 
@@ -237,10 +238,16 @@ class ServingEngine:
                     remaining[i] -= 1
                     if remaining[i] <= 0:
                         req = slots[i]
-                        report.completions.append(Completion(
+                        done = Completion(
                             rid=req.rid, arrival=req.arrival * time_scale,
                             started=started[i], finished=clock,
-                            tokens=req.tokens_out))
+                            tokens=req.tokens_out)
+                        report.completions.append(done)
+                        # Serving-latency SLI (runtime/slo.py): arrival
+                        # → completion, queue wait included — the p99
+                        # promise covers the backlog, not just compute.
+                        slo.observe("serving_latency", done.latency,
+                                    key=("serving", f"req-{req.rid}"))
                         slots[i] = None
         report.wall_sec = now()
         report.batch_occupancy = (occupancy / report.steps

@@ -33,6 +33,7 @@ from kubeflow_tpu.controllers.notebook import (
     setup_notebook_controller,
 )
 from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.runtime import timeline as timeline_mod
 from kubeflow_tpu.runtime.aiotasks import reap
 from kubeflow_tpu.runtime.errors import ApiError
 from kubeflow_tpu.runtime.manager import Manager
@@ -175,6 +176,20 @@ async def check_invariants(kube: FakeKube, mgr: Manager,
             problems.append(
                 f"{key[0]}/{key[1]}: drain-requested but neither parked "
                 "nor finalized (wedged drain)")
+        # Unbroken lifecycle timeline (ISSUE 13): the durable journal is
+        # written as one whole capped list per transition, so across
+        # every manager kill/rebuild the retained window must replay
+        # with consecutive seqs, no duplicate transitions, and monotone
+        # timestamps — and every surviving object must HAVE one (a
+        # rebuilt manager re-derives and persists the current state on
+        # its first clean reconcile).
+        tl = timeline_mod.decode(ann)
+        for p in timeline_mod.continuity_problems(tl):
+            problems.append(f"{key[0]}/{key[1]}: timeline {p}")
+        if not tl and not get_meta(nb).get("deletionTimestamp"):
+            problems.append(
+                f"{key[0]}/{key[1]}: empty lifecycle timeline after "
+                "convergence")
         # No gang lost across a reclaim (ISSUE 10): every live TPU
         # notebook must still be IN the scheduler — admitted, queued, or
         # draining. A reclaim/defrag that parked a gang and then dropped

@@ -15,6 +15,8 @@ import time
 from dataclasses import dataclass
 
 from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.runtime import slo
+from kubeflow_tpu.runtime import timeline as timeline_mod
 from kubeflow_tpu.runtime.objects import deep_get, get_meta, parse_iso
 
 READY = "ready"
@@ -64,6 +66,48 @@ def filter_events(notebook: dict, events: list[dict]) -> list[dict]:
         if ts is None or ts >= created_ts:
             out.append(ev)
     return out
+
+
+def _pending_since(notebook: dict) -> float | None:
+    """Start of the current startup episode, from the durable lifecycle
+    timeline's episode boundary (survives re-queues and manager
+    restarts). Deliberately timeline-only — age since creation would
+    misread a long-RUNNING server that was later re-queued, and a
+    pre-timeline CR has no trustworthy episode start; None = never
+    guess a breach."""
+    entries = timeline_mod.decode(
+        get_meta(notebook).get("annotations") or {})
+    start = timeline_mod.episode_start(entries)
+    return start["at"] if start is not None else None
+
+
+def _time_to_ready_breach(notebook: dict) -> dict | None:
+    """The JWA "waiting longer than expected" signal: the pending episode
+    has outlived the ``notebook_time_to_ready`` objective
+    (KFTPU_SLO_NOTEBOOK_TIME_TO_READY). Returns the message pieces, or
+    None inside the objective."""
+    threshold, target = slo.objective_for("notebook_time_to_ready")
+    since = _pending_since(notebook)
+    if since is None:
+        return None
+    waited = time.time() - since
+    if waited <= threshold:
+        return None
+    meta = get_meta(notebook)
+    return {
+        "percentile": f"p{target * 100:g}",
+        "threshold": threshold,
+        "waited": waited,
+        "explain": (f"/debug/scheduler/explain/"
+                    f"{meta.get('namespace', '')}/{meta.get('name', '')}"),
+    }
+
+
+def _breach_message(breach: dict, reason: str) -> str:
+    return (f"Waiting longer than expected "
+            f"({breach['percentile']} objective {breach['threshold']:g}s, "
+            f"waiting {breach['waited']:.0f}s) — {reason}; "
+            f"explain: {breach['explain']} on the controller manager")
 
 
 def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
@@ -123,6 +167,19 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
                 WAITING,
                 f"Waiting for pool scale-up ({scale_up['chips']} chips "
                 f"requested, intent pending {pending:.0f}s)",
+            )
+        breach = _time_to_ready_breach(notebook)
+        if breach is not None:
+            # Past the time-to-ready objective: escalate to a warning
+            # whose reason is the SAME machine answer the explain
+            # endpoint serves (status.scheduler.reason comes from
+            # schedule_preview, the explain endpoint's source).
+            return Status(
+                WARNING,
+                _breach_message(
+                    breach,
+                    f"{sched.get('reason') or 'queued for TPU capacity'} "
+                    f"(position {sched.get('position', 0)})"),
             )
         return Status(
             WAITING,
@@ -237,6 +294,15 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
 
     # Partially-ready slice: surface progress rather than a generic warning.
     if 0 < ready < want_hosts:
+        breach = _time_to_ready_breach(notebook)
+        if breach is not None:
+            return Status(
+                WARNING,
+                _breach_message(
+                    breach,
+                    f"waiting for TPU workers ({ready}/{want_hosts} "
+                    "ready)"),
+            )
         return Status(WAITING, f"Waiting for TPU workers ({ready}/{want_hosts} ready)")
 
     for ev in sorted(

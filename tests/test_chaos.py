@@ -13,9 +13,9 @@ row → released on the next spec edit — and the manual requeue endpoint.
 import asyncio
 
 from kubeflow_tpu.testing.chaos import (
+    ChaosSoak,
     SoakConfig,
     poison_scenario,
-    run_soak,
 )
 
 # The bench's seed set (bench.py chaos_soak, non-smoke) — the acceptance
@@ -23,9 +23,9 @@ from kubeflow_tpu.testing.chaos import (
 BENCH_SEEDS = range(5)
 
 
-async def _assert_soak(seed: int) -> dict:
-    report = await run_soak(SoakConfig(seed=seed, rounds=3,
-                                       storm_seconds=0.5))
+async def _assert_soak(seed: int) -> tuple:
+    soak = ChaosSoak(SoakConfig(seed=seed, rounds=3, storm_seconds=0.5))
+    report = await soak.run()
     d = report.to_dict()
     assert d["ok"], f"seed {seed}: {d['problems']}"
     assert d["ledger_violations"] == 0
@@ -34,17 +34,36 @@ async def _assert_soak(seed: int) -> dict:
     # The storm actually stormed — a soak that injected nothing proves
     # nothing.
     assert sum(d["injected"].values()) > 0
-    return d
+    return d, soak
 
 
 async def test_chaos_soak_seed_0():
-    d = await _assert_soak(0)
+    d, soak = await _assert_soak(0)
     # Seed 0's schedule is known to exercise the elastic-fleet actions
     # (ISSUE 10): spot revocations and scale-up grant/denial answers —
     # and the no-gang-lost-across-a-reclaim invariant held through them
     # (it is part of every convergence check above).
     assert d["spot_revocations"] > 0
     assert d["scale_up_grants"] + d["scale_up_denials"] > 0
+    # Durable lifecycle timelines (ISSUE 13): every surviving object's
+    # journal replays across the 3+ manager kill/rebuild cycles with no
+    # gap or duplicate transition — re-asserted explicitly here on the
+    # final store (the same invariant also ran inside every convergence
+    # check above), and the storm's churn must have produced real
+    # multi-transition journals, not one state per object.
+    from kubeflow_tpu.runtime import timeline as timeline_mod
+    from kubeflow_tpu.runtime.objects import annotations_of, name_of
+
+    notebooks = await soak.kube.list("Notebook")
+    assert notebooks
+    journals = []
+    for nb in notebooks:
+        entries = timeline_mod.decode(annotations_of(nb))
+        assert entries, f"{name_of(nb)}: empty lifecycle timeline"
+        problems = timeline_mod.continuity_problems(entries)
+        assert problems == [], f"{name_of(nb)}: {problems}"
+        journals.append(entries)
+    assert any(len(j) >= 3 for j in journals)
 
 
 async def test_chaos_soak_seed_1():

@@ -636,3 +636,108 @@ def test_fixture_violation_makes_cli_exit_nonzero(tmp_path, capsys):
         rc = cli_main(["--root", str(tmp_path), name])
         capsys.readouterr()
         assert rc == 1, name
+
+
+# ---- slo-registry / debug-route-docs (ISSUE 13) ------------------------------
+
+
+GOOD_SLO_MODULE = '''\
+SLI_SPECS = (
+    ("my_sli", "KFTPU_SLO_MY_SLI", 1.0, 0.99, "a promise"),
+)
+'''
+
+
+def _slo_tree(tmp_path, *, slo_src=GOOD_SLO_MODULE, docs=None,
+              route_src=None):
+    """A scratch whole-tree project: slo.py at its real path, an
+    optional route-registering module, and docs/operations.md."""
+    (tmp_path / "kubeflow_tpu" / "runtime").mkdir(parents=True)
+    (tmp_path / "kubeflow_tpu" / "runtime" / "slo.py").write_text(slo_src)
+    if route_src is not None:
+        (tmp_path / "kubeflow_tpu" / "routes.py").write_text(route_src)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "operations.md").write_text(
+        docs if docs is not None
+        else "`KFTPU_SLO_MY_SLI` | `my_sli` row\n")
+    project = load_project(root=str(tmp_path), paths=["kubeflow_tpu"])
+    assert project.full_tree
+    return run_passes(project, select={"slo-registry"})
+
+
+def test_sloreg_clean_tree(tmp_path):
+    report = _slo_tree(tmp_path)
+    assert report.findings == []
+
+
+def test_sloreg_undocumented_knob_and_name(tmp_path):
+    report = _slo_tree(tmp_path, docs="nothing documented here\n")
+    msgs = [f.message for f in report.findings]
+    assert any("KFTPU_SLO_MY_SLI" in m and "not documented" in m
+               for m in msgs)
+    assert any("'my_sli' is not documented" in m for m in msgs)
+    assert all(f.rule == "slo-registry" for f in report.findings)
+
+
+def test_sloreg_malformed_spec_and_bad_prefix(tmp_path):
+    report = _slo_tree(tmp_path, slo_src=(
+        'SLI_SPECS = (\n'
+        '    ("short", "KFTPU_SLO_SHORT"),\n'
+        '    ("badpfx", "KFTPU_OTHER_KNOB", 1.0, 0.99, "d"),\n'
+        ')\n'),
+        docs="KFTPU_SLO_SHORT KFTPU_OTHER_KNOB short badpfx\n")
+    msgs = [f.message for f in report.findings]
+    assert any("5-tuple" in m for m in msgs)
+    assert any("KFTPU_SLO_ prefix" in m for m in msgs)
+
+
+def test_sloreg_missing_registry_module(tmp_path):
+    (tmp_path / "kubeflow_tpu").mkdir(parents=True)
+    (tmp_path / "kubeflow_tpu" / "other.py").write_text("x = 1\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "operations.md").write_text("docs\n")
+    project = load_project(root=str(tmp_path), paths=["kubeflow_tpu"])
+    report = run_passes(project, select={"slo-registry"})
+    assert any("registry module missing" in f.message
+               for f in report.findings)
+
+
+def test_debug_route_docs_drift(tmp_path):
+    route_src = (
+        "def build(app):\n"
+        '    app.router.add_get("/debug/newthing", h)\n'
+        '    app.router.add_get("/debug/timeline/{ns}/{name}", h)\n'
+        '    app.router.add_post("/debug/queue/requeue", h)\n'
+        '    app.router.add_get("/healthz", h)\n')
+    # Documented routes stay quiet (param routes match by static
+    # prefix); the undocumented one is the only finding.
+    report = _slo_tree(
+        tmp_path, route_src=route_src,
+        docs=("`KFTPU_SLO_MY_SLI` my_sli\n"
+              "| `/debug/timeline/<ns>/<name>` | timelines |\n"
+              "| `POST /debug/queue/requeue` | requeue |\n"))
+    findings = [f for f in report.findings if f.rule == "debug-route-docs"]
+    assert len(findings) == 1
+    assert "/debug/newthing" in findings[0].message
+
+
+def test_debug_route_docs_suppression(tmp_path):
+    route_src = (
+        "def build(app):\n"
+        '    app.router.add_get("/debug/hidden", h)  '
+        "# kftpu: ignore[debug-route-docs] internal-only probe route\n")
+    report = _slo_tree(tmp_path, route_src=route_src)
+    assert [f.rule for f in report.findings] == []
+    assert any(s.rule == "debug-route-docs"
+               for _, s in report.suppressed)
+
+
+def test_sloreg_missing_docs_is_itself_a_finding(tmp_path):
+    """The runbook being GONE must not turn the pass green by vacuity."""
+    (tmp_path / "kubeflow_tpu" / "runtime").mkdir(parents=True)
+    (tmp_path / "kubeflow_tpu" / "runtime" / "slo.py").write_text(
+        GOOD_SLO_MODULE)
+    project = load_project(root=str(tmp_path), paths=["kubeflow_tpu"])
+    report = run_passes(project, select={"slo-registry"})
+    assert any("docs/operations.md is missing" in f.message
+               for f in report.findings)

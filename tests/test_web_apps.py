@@ -565,3 +565,78 @@ def test_status_quarantined_notebook_is_actionable():
     ]
     s = process_status(nb)
     assert s.phase == "ready"
+
+
+def test_status_waiting_longer_than_expected(monkeypatch):
+    """A pending notebook past its time-to-ready objective (ISSUE 13)
+    escalates to a warning sourced from the same machine answer the
+    explain endpoint serves, with the explain link in the message. The
+    episode clock comes from the durable lifecycle timeline — never
+    guessed from CR age."""
+    import time as _time
+
+    from kubeflow_tpu.runtime import timeline as timeline_mod
+
+    def queued_nb(episode_age: float | None):
+        nb = nbapi.new("slow", "team")
+        nb["metadata"]["creationTimestamp"] = "2020-01-01T00:00:00Z"
+        if episode_age is not None:
+            entries: list = []
+            timeline_mod.append(entries, "Queued",
+                                at=_time.time() - episode_age)
+            nb["metadata"].setdefault("annotations", {})[
+                timeline_mod.TIMELINE_ANNOTATION] = \
+                timeline_mod.encode(entries)
+        nb["status"] = {"scheduler": {
+            "state": "Queued", "position": 2, "waitingChips": 16,
+            "reason": "waiting for 16 chips (1x v5e:4x4)"}}
+        return nb
+
+    # Breaching: queued for 120s against the default 30s objective.
+    s = process_status(queued_nb(120.0))
+    assert s.phase == "warning"
+    assert "Waiting longer than expected" in s.message
+    assert "p99" in s.message and "30s" in s.message
+    assert "waiting for 16 chips (1x v5e:4x4)" in s.message
+    assert "/debug/scheduler/explain/team/slow" in s.message
+
+    # Inside the objective: the plain queued message, phase unchanged.
+    s = process_status(queued_nb(5.0))
+    assert s.phase == "waiting"
+    assert s.message == \
+        "Queued for TPU capacity (position 2, waiting for 16 chips)"
+
+    # No timeline (pre-timeline CR, however old): never guess a breach.
+    s = process_status(queued_nb(None))
+    assert s.phase == "waiting"
+
+    # The objective knob moves the threshold.
+    monkeypatch.setenv("KFTPU_SLO_NOTEBOOK_TIME_TO_READY", "600")
+    s = process_status(queued_nb(120.0))
+    assert s.phase == "waiting"
+    monkeypatch.setenv("KFTPU_SLO_NOTEBOOK_TIME_TO_READY", "60:0.999")
+    s = process_status(queued_nb(120.0))
+    assert s.phase == "warning" and "p99.9" in s.message
+    monkeypatch.delenv("KFTPU_SLO_NOTEBOOK_TIME_TO_READY")
+
+    # Partially-ready breach: same signal on the worker-wait path.
+    nb = nbapi.new("slow2", "team")
+    nb["metadata"]["creationTimestamp"] = "2020-01-01T00:00:00Z"
+    entries = []
+    timeline_mod.append(entries, "Admitted", at=_time.time() - 300)
+    nb["metadata"].setdefault("annotations", {})[
+        timeline_mod.TIMELINE_ANNOTATION] = timeline_mod.encode(entries)
+    nb["status"] = {"readyReplicas": 1, "tpu": {"hosts": 4}}
+    s = process_status(nb)
+    assert s.phase == "warning"
+    assert "Waiting longer than expected" in s.message
+    assert "1/4" in s.message
+    assert "/debug/scheduler/explain/team/slow2" in s.message
+
+    # A READY tail is an episode boundary: a long-running server that
+    # just went partial (a worker restart) is not "starting slowly".
+    timeline_mod.append(entries, "Ready", at=_time.time() - 200)
+    nb["metadata"]["annotations"][timeline_mod.TIMELINE_ANNOTATION] = \
+        timeline_mod.encode(entries)
+    s = process_status(nb)
+    assert s.phase == "waiting" and "1/4" in s.message
