@@ -359,9 +359,19 @@ def _fresh_probe(t0_epoch: float) -> None:
         "interpreter_spawn_sec": round(max(0.0, proc_start - t0_epoch), 3)}
 
     t = time.perf_counter()
-    from kubeflow_tpu.utils.compilecache import enable_persistent_cache
+    from kubeflow_tpu.utils.compilecache import (
+        cache_entries,
+        enable_persistent_cache,
+        note_compile,
+        seed_cache,
+    )
 
-    enable_persistent_cache(os.environ.get("KFTPU_BENCH_CACHE_DIR", CACHE_DIR))
+    probe_cache_dir = enable_persistent_cache(
+        os.environ.get("KFTPU_BENCH_CACHE_DIR", CACHE_DIR))
+    # Warm-pool seeding path (no-op without KFTPU_COMPILE_CACHE_SEED_DIR):
+    # the same seed_cache the warm-idle loop runs, so the probe measures
+    # exactly what a seeded warm pod's first compile pays.
+    seeded = seed_cache(cache_dir=probe_cache_dir)
     from functools import partial
 
     import jax
@@ -374,6 +384,7 @@ def _fresh_probe(t0_epoch: float) -> None:
     phases["jax_init_sec"] = round(time.perf_counter() - t, 3)
 
     t_phase = time.perf_counter()
+    entries_before = cache_entries(probe_cache_dir)
     cfg = BurninConfig(**BENCH_MODEL)
     params = jax.jit(partial(init_params, cfg=cfg))(jax.random.key(0))
     tokens = jax.random.randint(
@@ -384,6 +395,16 @@ def _fresh_probe(t0_epoch: float) -> None:
     compiled = step.lower(params, tokens).compile()
     compile_sec = time.perf_counter() - t0
     phases["compile_sec"] = round(time.perf_counter() - t_phase, 3)
+    entries_after = cache_entries(probe_cache_dir)
+    # Per-phase cache attribution (ISSUE 14): an unchanged entry count
+    # across the compile phase = served from the persistent cache.
+    compile_cache = {
+        "entries_before": entries_before,
+        "entries_after": entries_after,
+        "result": note_compile(entries_before, entries_after),
+        "seeded": seeded["seeded"],
+        "cache_dir_ready": seeded["ready"],
+    }
 
     t = time.perf_counter()
     params, loss = compiled(params, tokens)
@@ -397,6 +418,7 @@ def _fresh_probe(t0_epoch: float) -> None:
         "coldstart_sec": total,
         "compile_sec": round(compile_sec, 3),
         "phases": phases,
+        "compile_cache": compile_cache,
     }))
 
 
@@ -503,6 +525,11 @@ def _coldstart_probes() -> dict:
         "coldstart_waterfall": {
             "cold": cold.get("phases") if cold else None,
             "warm": warm.get("phases") if warm else None,
+            # Hit/miss attribution per probe (ISSUE 14): the warm run's
+            # compile phase must be a HIT — a warm run paying a miss is
+            # the cache-key-churn regression the rules name.
+            "cold_compile_cache": cold.get("compile_cache") if cold else None,
+            "warm_compile_cache": warm.get("compile_cache") if warm else None,
             "classification": COLDSTART_PHASE_RULES,
         },
         # Environment canary alongside the numbers it classifies (the
@@ -1318,6 +1345,292 @@ def chaos_soak(smoke: bool = False) -> dict:
             k: sum(r["injected"].get(k, 0) for r in reports)
             for k in sorted({k for r in reports for k in r["injected"]})},
         "pass": ok,
+    }
+
+
+def _load_bench_artifact(path: str) -> dict | None:
+    """A BENCH_r0x.json is either the raw bench JSON or a driver wrapper
+    whose ``tail`` holds the JSON line (and sometimes a ``parsed``
+    copy). Returns the bench dict, or None."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    if "coldstart_warm_cache_sec" in data or "metric" in data:
+        return data
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and parsed:
+        return parsed
+    tail = data.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                return obj
+        # Clipped tail (the driver keeps only the END of the output):
+        # fish the cold-start fields out by key — enough for the canary
+        # classification even when the JSON line was truncated.
+        import re
+
+        out: dict = {}
+        m = re.search(r'"coldstart_warm_cache_sec":\s*([0-9.]+)', tail)
+        if m:
+            out["coldstart_warm_cache_sec"] = float(m.group(1))
+        m = re.search(r'"fixed_overhead_sec":\s*([0-9.]+)', tail)
+        if m:
+            out["coldstart_canary"] = {
+                "fixed_overhead_sec": float(m.group(1))}
+        if out:
+            return out
+    return None
+
+
+def classify_coldstart_drift(current: dict, baseline: dict, *,
+                             threshold_pct: float = 10.0) -> dict:
+    """The PR 13 ``coldstart_canary`` classification rule as an
+    ACTIONABLE verdict (ISSUE 14 satellite): compare two rounds'
+    warm-cache cold starts and attribute any drift with the canary —
+    canary moved too → "environment" (warn only: slower disk/CPU,
+    fatter site-packages); canary flat while the warm number moved →
+    "repo regression" (the gate's exit-1 case: cache-key churn or a
+    heavier import graph this repo owns). Pure: callers feed bench
+    JSON dicts."""
+    cur = (current or {}).get("coldstart_warm_cache_sec")
+    base = (baseline or {}).get("coldstart_warm_cache_sec")
+    if not isinstance(cur, (int, float)) \
+            or not isinstance(base, (int, float)) or base <= 0:
+        return {"classification": "insufficient-data",
+                "detail": "both rounds need coldstart_warm_cache_sec",
+                "warn_only": True}
+    drift_pct = round(100.0 * (cur - base) / base, 2)
+    verdict = {"warm_cache_sec": [base, cur], "drift_pct": drift_pct,
+               "threshold_pct": threshold_pct}
+    if drift_pct <= threshold_pct:
+        return {**verdict, "classification": "ok", "warn_only": False}
+    cur_can = ((current or {}).get("coldstart_canary")
+               or {}).get("fixed_overhead_sec")
+    base_can = ((baseline or {}).get("coldstart_canary")
+                or {}).get("fixed_overhead_sec")
+    if not isinstance(cur_can, (int, float)) \
+            or not isinstance(base_can, (int, float)) or base_can <= 0:
+        return {**verdict, "classification": "insufficient-canary",
+                "detail": "drift unattributable: a round predates the "
+                          "coldstart_canary block",
+                "warn_only": True}
+    canary_drift_pct = round(
+        100.0 * (cur_can - base_can) / base_can, 2)
+    verdict["canary_fixed_overhead_sec"] = [base_can, cur_can]
+    verdict["canary_drift_pct"] = canary_drift_pct
+    if canary_drift_pct >= threshold_pct / 2.0:
+        # The fixed-overhead probes (interpreter spawn + import jax)
+        # moved with the warm number: the HOST drifted, not this repo.
+        return {**verdict, "classification": "environment",
+                "warn_only": True}
+    return {**verdict, "classification": "repo regression",
+            "warn_only": False}
+
+
+def coldstart_canary_gate() -> dict:
+    """Classify the two newest BENCH_r*.json artifacts in the repo.
+    Environment-classified (and unattributable) drift stays warn-only;
+    only a canary-confirmed repo regression fails the gate."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifacts = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if len(artifacts) < 2:
+        return {"classification": "insufficient-data",
+                "detail": "need two BENCH_r*.json rounds", "pass": True}
+    baseline = _load_bench_artifact(artifacts[-2])
+    current = _load_bench_artifact(artifacts[-1])
+    verdict = classify_coldstart_drift(current or {}, baseline or {})
+    verdict["rounds"] = [os.path.basename(artifacts[-2]),
+                         os.path.basename(artifacts[-1])]
+    verdict["pass"] = verdict["classification"] != "repo regression"
+    return verdict
+
+
+async def _coldstart_warmpool_bench(smoke: bool) -> dict:
+    """Warm-pool claim path vs cold path, measured on the podsim-modeled
+    control plane: podsim charges image-pull latency once per
+    (node, image) and runtime-start latency per fresh pod — the two
+    costs a claim skips entirely. Also proves the reserve contract: a
+    real gang arriving against a fully-reserved fleet takes warm-pool
+    chips (instantly, no drain) before any real gang is touched."""
+    from kubeflow_tpu.api import notebook as nbapi
+    from kubeflow_tpu.controllers.notebook import (
+        NotebookOptions,
+        setup_notebook_controller,
+    )
+    from kubeflow_tpu.controllers.warmpool import (
+        WarmPoolManager,
+        WarmPoolOptions,
+    )
+    from kubeflow_tpu.runtime import timeline as timeline_mod
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.metrics import Registry
+    from kubeflow_tpu.runtime.objects import annotations_of, deep_get
+    from kubeflow_tpu.scheduler import SchedulerOptions, TpuFleetScheduler
+    from kubeflow_tpu.testing.fakekube import FakeKube
+    from kubeflow_tpu.testing.podsim import PodSimulator
+    from kubeflow_tpu.webhooks import register_all
+
+    n = 3 if smoke else 6
+    pull, start = (0.25, 0.12) if smoke else (0.6, 0.3)
+    warm_image = "kubeflow-tpu/jupyter-jax:bench"
+
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube, registry=Registry())
+    # 3n+1 slices: n cold + n warm-claimed gangs + n replenished warm
+    # slots fit with ONE slice spare, so the pressure phase's three real
+    # gangs must take at least two from the warm reserve.
+    sched = TpuFleetScheduler(
+        kube, SchedulerOptions(fleet_spec=f"pool-a=v5e:2x2:{3 * n + 1}"),
+        registry=mgr.registry)
+    warmpool = WarmPoolManager(
+        kube,
+        WarmPoolOptions(spec=f"bench/{warm_image}@v5e:2x2:{n}",
+                        replenish_seconds=0.05),
+        registry=mgr.registry)
+    setup_notebook_controller(mgr, NotebookOptions(), scheduler=sched,
+                              warmpool=warmpool)
+    sim = PodSimulator(kube, image_pull_latency=pull,
+                       runtime_start_latency=start)
+    await mgr.start()
+    await sim.start()
+
+    async def time_to_ready(name: str, image: str) -> float:
+        t0 = time.perf_counter()
+        await kube.create("Notebook", nbapi.new(
+            name, "bench", image=image, accelerator="v5e",
+            topology="2x2"))
+        deadline = t0 + 60
+        while time.perf_counter() < deadline:
+            nb = await kube.get("Notebook", name, "bench")
+            if deep_get(nb, "status", "readyReplicas", default=0):
+                return time.perf_counter() - t0
+            await asyncio.sleep(0.002)
+        raise RuntimeError(f"notebook {name} never became Ready")
+
+    async def pool_ready(count: int, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = await warmpool.debug_info()
+            if info["pools"] and info["pools"][0]["ready"] >= count:
+                return True
+            await asyncio.sleep(0.02)
+        return False
+
+    try:
+        # Cold path first: unique images so EVERY cold start pays the
+        # image pull (distinct user images — the realistic worst case
+        # warm pools exist to beat).
+        cold = sorted([
+            await time_to_ready(f"cold-{i}", f"user-img:{i}")
+            for i in range(n)])
+        pool_filled = await pool_ready(n)
+        warm = []
+        claims_attributed = 0
+        for i in range(n):
+            warm.append(await time_to_ready(f"warm-{i}", warm_image))
+            nb = await kube.get("Notebook", f"warm-{i}", "bench")
+            ann = annotations_of(nb)
+            states = [e["state"]
+                      for e in timeline_mod.decode(ann)]
+            if ann.get(nbapi.WARM_CLAIMED_ANNOTATION) \
+                    and timeline_mod.CLAIMED in states:
+                claims_attributed += 1
+        warm.sort()
+        replenished = await pool_ready(n)
+
+        # Reserve contract: the fleet is now tight (n cold + n warm
+        # gangs + n fresh warm slots on 2n+2 slices → 2 free). Three
+        # real gangs arrive: at least one's chips must come from the
+        # warm reserve — instantly, with every pre-existing REAL gang
+        # still admitted afterwards (warm slots die first, real gangs
+        # never).
+        pool_slugs = tuple(p.slug for p in warmpool.pools)
+        real_before = {k for k in sched.policy.ledger.allocations
+                       if not str(k[1]).startswith(pool_slugs)}
+        real_gangs = {f"pressure-{i}" for i in range(3)}
+        for name in sorted(real_gangs):
+            await kube.create("Notebook", nbapi.new(
+                name, "bench", image="pressure:1", accelerator="v5e",
+                topology="2x2"))
+        deadline = time.monotonic() + 30
+        pressure_admitted = False
+        while time.monotonic() < deadline:
+            allocs = sched.policy.ledger.allocations
+            if all(("bench", g) in allocs for g in real_gangs):
+                pressure_admitted = True
+                break
+            await asyncio.sleep(0.02)
+        no_real_gang_preempted = all(
+            k in sched.policy.ledger.allocations for k in real_before)
+        warm_reclaims = int(warmpool.m_reclaimed.labels().value)
+    finally:
+        warmpool.stop()
+        await sim.stop()
+        await mgr.stop()
+        kube.close_watches()
+
+    cold_p50 = _median_sorted(cold)
+    warm_p50 = _median_sorted(warm)
+    speedup = cold_p50 / max(warm_p50, 1e-9)
+    return {
+        "notebooks": n,
+        "image_pull_latency_sec": pull,
+        "runtime_start_latency_sec": start,
+        "cold_ready_secs": [round(s, 4) for s in cold],
+        "warm_ready_secs": [round(s, 4) for s in warm],
+        "cold_p50_sec": round(cold_p50, 4),
+        "warm_p50_sec": round(warm_p50, 4),
+        "speedup": round(speedup, 2),
+        "pool_filled": pool_filled,
+        "claims_attributed": claims_attributed,
+        "pool_replenished_after_claims": replenished,
+        "pressure_admitted": pressure_admitted,
+        "no_real_gang_preempted": no_real_gang_preempted,
+        "warm_reserve_reclaims": warm_reclaims,
+        "ledger_violations": sched.policy.ledger.violations,
+        "sim_pass": bool(
+            pool_filled and replenished and claims_attributed == n
+            and speedup >= 3.0 and pressure_admitted
+            and no_real_gang_preempted and warm_reclaims >= 1
+            and sched.policy.ledger.violations == 0),
+    }
+
+
+def coldstart(smoke: bool = False) -> dict:
+    """`bench.py coldstart [--smoke]` — the cold-start war's acceptance
+    gate (ISSUE 14). Two parts, both enforced (exit 1 via __main__):
+
+    - **warm-pool sim**: podsim models image-pull + runtime-start
+      latency; the warm-pool claim path must be ≥3× faster to Ready
+      than the cold path, every claim must attribute through the
+      timeline's Claimed transition, the pool must replenish after
+      claims, and a real gang under pressure must take warm-reserve
+      chips (instantly) with no real gang preempted — 0 ledger
+      violations throughout.
+    - **canary gate**: the PR 13 coldstart_canary classification over
+      the two newest BENCH_r*.json rounds — a canary-confirmed repo
+      regression of the warm-cache number fails; environment-classified
+      (or unattributable) drift stays warn-only."""
+    sim = asyncio.run(_coldstart_warmpool_bench(smoke))
+    canary = coldstart_canary_gate()
+    return {
+        "metric": "coldstart",
+        "smoke": smoke,
+        **sim,
+        "canary_gate": canary,
+        "pass": bool(sim["sim_pass"] and canary["pass"]),
     }
 
 
@@ -2389,6 +2702,17 @@ if __name__ == "__main__":
         print(json.dumps(result))
         # CI gate: any invariant violation, wedged key, or a poison pill
         # that fails to quarantine/resume must fail the step.
+        if not result["pass"]:
+            sys.exit(1)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "coldstart":
+        result = coldstart(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(result))
+        # CI gate: the warm-pool claim path must beat the cold path ≥3×
+        # in the podsim-modeled bench (claims attributed via the
+        # timeline, pool replenished, real gangs never preempted for the
+        # reserve, 0 ledger violations), and a canary-confirmed repo
+        # regression of the warm-cache cold start fails here too
+        # (environment-classified drift stays warn-only).
         if not result["pass"]:
             sys.exit(1)
     elif len(sys.argv) >= 2 and sys.argv[1] == "elastic_fleet":

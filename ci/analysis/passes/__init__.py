@@ -15,4 +15,5 @@ from ci.analysis.passes import (  # noqa: F401
     keys,
     sloreg,
     swallow,
+    warmpool,
 )

@@ -1,0 +1,141 @@
+"""``warm-pool-contract``: the claim protocol is the only door (ISSUE 14).
+
+Warm pod pools hand RUNNING pods between owners — the one operation in
+the control plane where two reconcilers racing each other corrupts real
+user state (a double-adopted pod serves two notebooks). The contract
+this pass refuses to lose:
+
+- every claim routes through :meth:`WarmPoolManager.claim`, whose CAS
+  (the ``TPU_WARM_CLAIM`` annotation, written then read back) is what
+  makes concurrent claimers safe — the notebook controller must call
+  ``claim`` from its ``_warm_claim_gate`` and never re-label a pool pod
+  itself;
+- adoption (``_adopt`` — the bare re-label) is called exactly once,
+  from ``claim``, inside the claim lock;
+- every slot registers its chips with the fleet ledger
+  (``warm_reserve`` from the replenisher) and the scheduler keeps the
+  warm-pool victim tier (policy's ``"warmpool"`` workload ordering) —
+  losing either silently turns the pool into unaccounted capacity that
+  pressure can no longer cannibalize.
+"""
+
+from __future__ import annotations
+
+from ci.analysis.core import Finding, Project, analysis_pass
+from ci.analysis.passes.contracts import (
+    calls_to,
+    find_def,
+    has_identifier,
+    span_names,
+)
+
+RULE = "warm-pool-contract"
+
+WARMPOOL_FILE = "kubeflow_tpu/controllers/warmpool.py"
+NOTEBOOK_FILE = "kubeflow_tpu/controllers/notebook.py"
+SCHEDULER_RUNTIME = "kubeflow_tpu/scheduler/runtime.py"
+POLICY_FILE = "kubeflow_tpu/scheduler/policy.py"
+
+
+def _missing(project: Project, relpath: str, why: str) -> list[Finding]:
+    if not project.full_tree:
+        return []
+    anchor = project.files[0].path if project.files else relpath
+    return [Finding(rule=RULE, path=anchor, line=1,
+                    message=f"{relpath}: missing — {why}")]
+
+
+@analysis_pass(
+    "warm-pool", (RULE,),
+    "warm-pod claims must route through the CAS claim protocol (no bare "
+    "re-label of pool pods) and pool slots must register their chips "
+    "with the fleet ledger")
+def check_warm_pool(project: Project):
+    wp = project.get(WARMPOOL_FILE)
+    if wp is None or wp.tree is None:
+        yield from _missing(project, WARMPOOL_FILE,
+                            "the warm-pool manager owns the claim "
+                            "protocol (ISSUE 14)")
+        return
+    claim_def = find_def(wp.tree, "claim")
+    if claim_def is None:
+        yield Finding(
+            rule=RULE, path=wp.path, line=1,
+            message="WarmPoolManager.claim is gone — the CAS claim "
+                    "protocol has no entry point")
+    else:
+        if not has_identifier(claim_def, "_cas_claim"):
+            yield Finding(
+                rule=RULE, path=wp.path, line=claim_def.lineno,
+                message="claim() no longer routes through _cas_claim — "
+                        "without the write-then-read-back CAS, two "
+                        "reconcilers can adopt the same pod")
+        adopt_in_claim = calls_to(claim_def, "_adopt")
+        adopt_everywhere = calls_to(wp.tree, "_adopt")
+        if not adopt_in_claim or len(adopt_everywhere) != 1:
+            yield Finding(
+                rule=RULE, path=wp.path,
+                line=(adopt_everywhere[0].lineno if adopt_everywhere
+                      else claim_def.lineno),
+                message="_adopt (the bare re-label) must be called "
+                        "exactly once, from claim() — any other caller "
+                        "bypasses the CAS and the claim lock")
+    cas_def = find_def(wp.tree, "_cas_claim")
+    if cas_def is None or not has_identifier(cas_def, "TPU_WARM_CLAIM"):
+        yield Finding(
+            rule=RULE, path=wp.path,
+            line=cas_def.lineno if cas_def else 1,
+            message="the CAS no longer stamps/verifies the "
+                    "keys.TPU_WARM_CLAIM annotation — cross-process "
+                    "claim safety is gone")
+    replenish = find_def(wp.tree, "_replenish_pool")
+    if replenish is None or not has_identifier(replenish, "_reserve"):
+        yield Finding(
+            rule=RULE, path=wp.path,
+            line=replenish.lineno if replenish else 1,
+            message="the replenisher no longer reserves slot chips "
+                    "(_reserve/warm_reserve) — warm pods would squat on "
+                    "capacity the ledger cannot see or cannibalize")
+    phases = span_names(wp.tree)
+    for phase in ("warm_claim", "warm_replenish"):
+        if phase not in phases:
+            yield Finding(
+                rule=RULE, path=wp.path, line=1,
+                message=f"missing the `{phase}` phase span — claim/"
+                        "replenish decisions must land in /debug/traces")
+
+    nb = project.get(NOTEBOOK_FILE)
+    if nb is not None and nb.tree is not None:
+        gate = find_def(nb.tree, "_warm_claim_gate")
+        if gate is None or not calls_to(gate, "claim"):
+            yield Finding(
+                rule=RULE, path=nb.path,
+                line=gate.lineno if gate else 1,
+                message="the notebook controller no longer routes warm "
+                        "adoption through _warm_claim_gate → "
+                        "WarmPoolManager.claim — a bare re-label of pool "
+                        "pods bypasses the CAS protocol")
+    elif project.full_tree:
+        yield from _missing(project, NOTEBOOK_FILE,
+                            "the notebook controller hosts the claim gate")
+
+    rt = project.get(SCHEDULER_RUNTIME)
+    if rt is not None and rt.tree is not None:
+        for needed in ("warm_reserve", "warm_release"):
+            if find_def(rt.tree, needed) is None:
+                yield Finding(
+                    rule=RULE, path=rt.path, line=1,
+                    message=f"TpuFleetScheduler.{needed} is gone — pool "
+                            "reservations can no longer register with "
+                            "the chip ledger")
+    policy = project.get(POLICY_FILE)
+    if policy is not None and policy.tree is not None:
+        from ci.analysis.passes.contracts import has_str_literal
+
+        if not has_str_literal(policy.tree, "warmpool"):
+            yield Finding(
+                rule=RULE, path=policy.path, line=1,
+                message="the policy layer lost the \"warmpool\" workload "
+                        "tier — warm reservations would no longer be the "
+                        "first preemption victims (or worse, never be "
+                        "victims at all)")

@@ -145,6 +145,12 @@ def workflow_tests() -> dict:
                         "gate failure)",
                         "python bench.py slo_overhead --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("Cold-start smoke bench (warm-pool claim ≥3x "
+                        "faster than cold in podsim, pool replenish + "
+                        "reserve-first preemption, coldstart-canary "
+                        "repo-regression gate; exit 1 on gate failure)",
+                        "python bench.py coldstart --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

@@ -74,6 +74,14 @@ NOTEBOOK_SUSPEND = "notebooks.kubeflow.org/suspend"
 # capped journal of lifecycle transitions that survives manager restarts.
 NOTEBOOK_TIMELINE = "notebooks.kubeflow.org/timeline"
 
+# Warm pod pools (ISSUE 14, controllers/warmpool.py): the claim verdict
+# stamped on a Notebook that adopted a pre-warmed pod instead of paying
+# the cold pod+runtime start — pod name, when, and how long the claim
+# took from the startup episode's start (JWA's "claimed in Xs").
+NOTEBOOK_WARM_CLAIMED = "notebooks.kubeflow.org/warm-claimed"
+NOTEBOOK_WARM_CLAIMED_AT = "notebooks.kubeflow.org/warm-claimed-at"
+NOTEBOOK_WARM_CLAIMED_IN = "notebooks.kubeflow.org/warm-claimed-in"
+
 # ---- tpu.kubeflow.org: pod-template TPU wiring -------------------------------
 
 TPU_ACCELERATOR = "tpu.kubeflow.org/accelerator"
@@ -86,6 +94,14 @@ TPU_SLICE_LABEL = "tpu.kubeflow.org/slice"
 # capacity PR with a matching name prefix but no scale-up label).
 TPU_SCALE_UP_ACCELERATOR = "tpu.kubeflow.org/scale-up-accelerator"
 TPU_SCALE_UP_TOPOLOGY = "tpu.kubeflow.org/scale-up-topology"
+
+# Warm pod pools (ISSUE 14): the pool label every warm slot StatefulSet
+# and pod carries (value = pool slug), and the CAS-style claim annotation
+# the claim protocol stamps on a warm pod — value "<ns>/<name>/<nonce>";
+# a claimer that reads back a value it did not write LOST the race and
+# must pick another pod, so two reconcilers can never adopt one pod.
+TPU_WARM_POOL_LABEL = "tpu.kubeflow.org/warm-pool"
+TPU_WARM_CLAIM = "tpu.kubeflow.org/warm-claim"
 
 # ---- serving.kubeflow.org: InferenceService contract (PR 11) -----------------
 

@@ -133,6 +133,16 @@ SUSPEND_ANNOTATION = keys.NOTEBOOK_SUSPEND
 # on the CR so it survives manager restarts; /debug/timeline reads it.
 TIMELINE_ANNOTATION = keys.NOTEBOOK_TIMELINE
 
+# Warm pod pools (controllers/warmpool.py): stamped by the claim protocol
+# when this notebook adopted a pre-warmed pod instead of creating slice
+# StatefulSets — the claimed pod's name, the claim time, and how many
+# seconds the claim took from the startup episode's start. Cleared on
+# stop (a restart claims fresh) and when the claimed pod is lost (the
+# reconcile falls back to the cold path transparently).
+WARM_CLAIMED_ANNOTATION = keys.NOTEBOOK_WARM_CLAIMED
+WARM_CLAIMED_AT_ANNOTATION = keys.NOTEBOOK_WARM_CLAIMED_AT
+WARM_CLAIMED_IN_ANNOTATION = keys.NOTEBOOK_WARM_CLAIMED_IN
+
 # Pod-template annotations the controller stamps so pod-level admission can
 # compute per-worker TPU env as a pure function of the pod (webhooks/tpu.py).
 TPU_ACCELERATOR_ANNOTATION = keys.TPU_ACCELERATOR

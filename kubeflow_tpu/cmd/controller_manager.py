@@ -54,6 +54,9 @@ def build_manager_app(mgr=None) -> web.Application:
       explainability: why a gang is queued (position, rank, blocking
       shape, feasible-if-drained candidates, scale-up intent age,
       starvation-door state) plus the timeline tail.
+    - ``/debug/warmpool`` (when warm pools are configured) — per-pool
+      target/ready/slot counts and the slots pending teardown after a
+      scheduler reclaim.
     """
     app = web.Application()
 
@@ -169,6 +172,16 @@ def build_manager_app(mgr=None) -> web.Application:
             app.router.add_get("/debug/scheduler", debug_scheduler)
             app.router.add_get("/debug/scheduler/explain/{ns}/{name}",
                                debug_scheduler_explain)
+
+        if getattr(mgr, "warmpool", None) is not None:
+            async def debug_warmpool(_request):
+                # Per-pool target/ready/slots plus reclaimed slots
+                # pending teardown — the pool-exhaustion runbook's
+                # first stop (docs/operations.md "Warm pools").
+                return web.json_response(
+                    {"warmpool": await mgr.warmpool.debug_info()})
+
+            app.router.add_get("/debug/warmpool", debug_warmpool)
     return app
 
 

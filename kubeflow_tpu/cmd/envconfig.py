@@ -131,6 +131,25 @@ def scheduler_options():
     )
 
 
+def warm_pool_options():
+    """Warm pod pools env contract (docs/operations.md "Warm pools &
+    cold-start"). No KFTPU_WARM_POOLS spec and no ConfigMap source means
+    the whole subsystem is off — the cold path byte-for-byte."""
+    from kubeflow_tpu.controllers.warmpool import (
+        DEFAULT_REPLENISH_SECONDS,
+        WarmPoolOptions,
+    )
+
+    return WarmPoolOptions(
+        spec=env_str("KFTPU_WARM_POOLS", "").strip(),
+        configmap=os.environ.get("KFTPU_WARM_POOLS_CONFIGMAP") or None,
+        controller_namespace=controller_namespace(),
+        replenish_seconds=env_float("KFTPU_WARM_REPLENISH_SECONDS",
+                                    DEFAULT_REPLENISH_SECONDS),
+        refresh_seconds=env_float("KFTPU_FLEET_REFRESH_SECONDS", 30.0),
+    )
+
+
 def serving_options():
     """Inference-serving env contract (docs/operations.md "Inference
     serving"). The master switch is KFTPU_SERVING (default on), read by

@@ -76,6 +76,7 @@ from kubeflow_tpu.runtime.objects import (
     name_of,
     namespace_of,
     now_iso,
+    parse_iso,
     set_controller_owner,
     uid_of,
 )
@@ -235,6 +236,11 @@ class NotebookReconciler:
         # through — the pre-scheduler behavior. Set by
         # setup_notebook_controller.
         self._scheduler = None
+        # Warm pod pools (controllers/warmpool.py, ISSUE 14): the claim
+        # gate adopts a pre-warmed pod for eligible notebooks instead of
+        # creating slice StatefulSets. None (no KFTPU_WARM_POOLS) keeps
+        # the cold path byte-for-byte.
+        self._warmpool = None
         # (ns, name) → {pod-event-name: count} — events already mirrored, so
         # each reconcile re-emits only NEW occurrences (a plain list-driven
         # re-emit would bump the mirrored count once per reconcile, turning
@@ -344,7 +350,7 @@ class NotebookReconciler:
         suspend_requeue = await self._check_suspend(nb, ms)
 
         with span("apply"):
-            capacity_pending, capacity_requeue, admission = \
+            capacity_pending, capacity_requeue, admission, warm = \
                 await self._apply_children(nb, ms, tpu)
 
         with span("status"):
@@ -360,7 +366,8 @@ class NotebookReconciler:
                 self._mirror_events(nb, pods),
             )
             await self._update_status(nb, ms, capacity_pending=capacity_pending,
-                                      admission=admission)
+                                      admission=admission, pods=pods,
+                                      warm=warm)
         if capacity_pending:
             return capacity_requeue
         if admission is not None and admission.state == "Draining" \
@@ -377,14 +384,14 @@ class NotebookReconciler:
 
     async def _apply_children(
         self, nb: dict, ms, tpu
-    ) -> tuple[bool, Result | None, object | None]:
+    ) -> tuple[bool, Result | None, object | None, dict | None]:
         """The child-object phase of reconcile as a dependency DAG
         (latency hiding, ISSUE 4): capacity gate → [all slice
         StatefulSets] → [Service, headless Service, VirtualService,
         NetworkPolicy, RBAC, slice GC]. Stage-mates overlap; each stage
         waits for the previous one, so against a real apiserver the wall
         time is the critical-path RTT depth, not the child count.
-        Returns (capacity_pending, capacity_requeue, admission)."""
+        Returns (capacity_pending, capacity_requeue, admission, warm)."""
         # Stage "capacity", part 1: cluster-level gang arbitration
         # (kubeflow_tpu/scheduler). The fleet scheduler is the single
         # admission point between the CR and its slice StatefulSets —
@@ -411,23 +418,39 @@ class NotebookReconciler:
             await self._park_queued_slices(nb)
             requeue = Result(requeue_after=(
                 self._scheduler.options.queued_requeue_seconds))
-            return True, requeue, admission
-        # Stage "capacity", part 2: the queued-provisioning gate and the
-        # CA-bundle mirror are independent round-trip chains — overlap
-        # them. The gate's verdict shapes the slices stage, so it stays
-        # control flow rather than an apply_set child.
-        with span("apply_stage", stage="capacity"):
-            (capacity_pending, capacity_provisioned, capacity_requeue), _ = \
-                await overlap(
-                    self._capacity_gate(nb, ms),
-                    self._mirror_ca_bundle(nb)
-                    if self.opts.trusted_ca_configmap else None,
-                )
+            return True, requeue, admission, None
+        # Warm-pool claim gate (ISSUE 14): an admitted (or pass-through)
+        # eligible notebook adopts a pre-warmed pod INSTEAD of creating
+        # slice StatefulSets — the whole pod+runtime start collapses to
+        # a re-label. An empty pool (state "warming") falls through to
+        # the cold path transparently.
+        warm = await self._warm_claim_gate(nb, ms)
+        claimed = warm is not None and warm.get("state") == "claimed"
+        if claimed:
+            # The adopted pod IS the slice: no ProvisioningRequest (its
+            # capacity already exists under the running pod) and no
+            # slice StatefulSets.
+            capacity_pending, capacity_provisioned, capacity_requeue = \
+                False, True, None
+        else:
+            # Stage "capacity", part 2: the queued-provisioning gate and
+            # the CA-bundle mirror are independent round-trip chains —
+            # overlap them. The gate's verdict shapes the slices stage,
+            # so it stays control flow rather than an apply_set child.
+            with span("apply_stage", stage="capacity"):
+                (capacity_pending, capacity_provisioned,
+                 capacity_requeue), _ = \
+                    await overlap(
+                        self._capacity_gate(nb, ms),
+                        self._mirror_ca_bundle(nb)
+                        if self.opts.trusted_ca_configmap else None,
+                    )
 
         # One StatefulSet per slice (ICI placement is per-slice; DCN joins
         # them — tpu/topology.py MultiSlice). Single-slice keeps the bare
         # name, zero churn for the common case.
-        num_sts = 0 if capacity_pending else (ms.num_slices if ms else 1)
+        num_sts = 0 if (capacity_pending or claimed) \
+            else (ms.num_slices if ms else 1)
         # Creation events ride the NEXT stage, off the gang's critical
         # path: awaiting each best-effort emission inside its slice child
         # would re-serialize an N-slice cold create on the (deliberately
@@ -450,7 +473,7 @@ class NotebookReconciler:
                     # error, but the drop must land in the counter.
                     self.recorder.count_drop()
             raise
-        return capacity_pending, capacity_requeue, admission
+        return capacity_pending, capacity_requeue, admission, warm
 
     async def _scheduler_gate(self, nb: dict, ms):
         """Consult the TPU fleet scheduler (the ``schedule``/``admit``/
@@ -647,6 +670,124 @@ class NotebookReconciler:
             # stop would present as "Suspended (checkpoint @ step N)".
             await patch({nbapi.DRAIN_REASON_ANNOTATION: None})
         return None
+
+    async def _warm_claim_gate(self, nb: dict, ms) -> dict | None:
+        """Warm pod pools (controllers/warmpool.py): adopt a pre-warmed
+        pod for this notebook instead of paying the cold pod + runtime
+        start. Returns the warm verdict for status/timeline:
+        ``{"state": "claimed", "pod": ...}`` (skip slice StatefulSets —
+        the adopted pod IS the slice), ``{"state": "warming", ...}`` (a
+        matching pool exists but is EMPTY: the cold path proceeds while
+        the pool replenishes, and the miss is surfaced), or None (no
+        pool / ineligible / already running cold). Claims route through
+        the manager's CAS claim protocol EXCLUSIVELY — enforced by the
+        ``warm-pool-contract`` analysis pass."""
+        wp = self._warmpool
+        annotations = annotations_of(nb)
+        claimed_name = annotations.get(nbapi.WARM_CLAIMED_ANNOTATION)
+        stopped = nbapi.is_stopped(nb)
+        ns, name = namespace_of(nb), name_of(nb)
+        clear = {nbapi.WARM_CLAIMED_ANNOTATION: None,
+                 nbapi.WARM_CLAIMED_AT_ANNOTATION: None,
+                 nbapi.WARM_CLAIMED_IN_ANNOTATION: None}
+        if claimed_name:
+            pod = await self._claimed_pod(nb, claimed_name)
+            adopted = pod is not None and (
+                get_meta(pod).get("labels") or {}).get(
+                    nbapi.NOTEBOOK_NAME_LABEL) == name
+            if stopped or wp is None or ms is None:
+                # Park (or the subsystem turned off, or the notebook was
+                # edited TPU→CPU): the adopted pod dies with the stop —
+                # a restart claims fresh or goes cold; a stale claim
+                # must not wedge either path. Only an ADOPTED pod is ours
+                # to delete: a stale intent (hand-off never completed)
+                # names a pod that is still pool property — or by now
+                # another notebook's — so it is cleared without touching
+                # the pod.
+                if adopted:
+                    try:
+                        await self.kube.delete("Pod", claimed_name, ns)
+                    except (NotFound, ApiError):
+                        pass
+                await self.kube.patch(
+                    "Notebook", name,
+                    {"metadata": {"annotations": clear}}, ns)
+                return None
+            if not adopted:
+                # Intent without a completed hand-off (a fault landed
+                # between the CR stamp and the pod patch): the pod — if
+                # it even exists — is still POOL property; clear the
+                # stale intent and go cold without touching it.
+                await self.kube.patch(
+                    "Notebook", name,
+                    {"metadata": {"annotations": clear}}, ns)
+                return None
+            # Broken-pod check against the POD's own container name —
+            # an adopted warm pod keeps the pool template's container
+            # ("warm"), not the CR's; checking the CR name would let a
+            # crashlooping claimed pod wedge readiness forever.
+            pod_main = (deep_get(pod, "spec", "containers",
+                                 default=[{}]) or [{}])[0].get("name") \
+                or _main_container_name(nb)
+            if _worker_is_broken(pod, pod_main):
+                # Claimed pod broken: transparent cold fallback — THIS
+                # reconcile already creates the slice StatefulSets.
+                try:
+                    await self.kube.delete("Pod", claimed_name, ns)
+                except (NotFound, ApiError):
+                    pass
+                await self.kube.patch(
+                    "Notebook", name,
+                    {"metadata": {"annotations": clear}}, ns)
+                await self.recorder.event(
+                    nb, "Warning", "WarmClaimLost",
+                    f"Warm-claimed pod {claimed_name} is broken; "
+                    "falling back to the cold start path")
+                return None
+            return {"state": "claimed", "pod": pod}
+        if wp is None or stopped or ms is None \
+                or wp.pool_for(nb, ms) is None:
+            return None
+        if await self._gang_running(nb, ms):
+            # Already live on the cold path (restart, scheduler reclaim):
+            # claiming now would double-provision the slice.
+            return None
+        since = self._episode_start(nb)
+        pod = await wp.claim(nb, ms, since=since)
+        if pod is not None:
+            await self.recorder.event(
+                nb, "Normal", "WarmClaimed",
+                f"Claimed warm pod {name_of(pod)} from the warm pool; "
+                "skipping the cold StatefulSet start")
+            return {"state": "claimed", "pod": pod,
+                    "claimed_in": round(max(0.0, self._now() - since), 3)}
+        return {"state": "warming",
+                "replenishing": await wp.replenishing_status(nb, ms)}
+
+    async def _claimed_pod(self, nb: dict, pod_name: str) -> dict | None:
+        ns = namespace_of(nb)
+        if self._pod_informer is not None:
+            pod = self._pod_informer.get(pod_name, ns)
+            if pod is not None:
+                return pod
+        return await self.kube.get_or_none("Pod", pod_name, ns)
+
+    def _episode_start(self, nb: dict) -> float:
+        """When this startup episode began — the timeline's episode
+        boundary (survives re-queues and restarts), falling back to the
+        CR's creation time. Feeds the "claimed in Xs" attribution."""
+        annotations = annotations_of(nb)
+        if self._timeline is not None:
+            entries = self._timeline.entries(
+                (namespace_of(nb), name_of(nb)), annotations=annotations)
+        else:
+            entries = timeline_mod.decode(annotations)
+        start = timeline_mod.episode_start(entries)
+        if start is not None:
+            return start["at"]
+        created = get_meta(nb).get("creationTimestamp")
+        ts = parse_iso(created) if created else None
+        return ts if ts is not None else self._now()
 
     async def _apply_children_stages(
         self, nb: dict, ms, tpu, num_sts: int, capacity_provisioned: bool,
@@ -1715,7 +1856,8 @@ class NotebookReconciler:
 
     async def _update_status(self, nb: dict, ms, *,
                              capacity_pending: bool = False,
-                             admission=None) -> None:
+                             admission=None, pods: list[dict] | None = None,
+                             warm: dict | None = None) -> None:
         """Mirror STS/pod state into the CR (reference :228-349): readyReplicas,
         containerState of worker 0's server container, condition history.
         Multislice: readyReplicas sums across every slice's StatefulSet.
@@ -1735,21 +1877,39 @@ class NotebookReconciler:
         # bare-reconciler fallback GETs (per-slice STS + worker-0 pod)
         # are independent reads — overlap them so even the cold path is
         # one RTT deep, not num_slices + 1.
+        warm_state = (warm or {}).get("state")
+        claimed = warm_state == "claimed"
         pod0_name = f"{ms.slice_sts_name(name, 0) if ms else name}-0"
-        *stss, pod0 = await overlap(
-            *[self._live_sts(ms.slice_sts_name(name, j) if ms else name, ns)
-              for j in range(ms.num_slices if ms else 1)],
-            (None if self._pod_informer is not None
-             else self.kube.get_or_none("Pod", pod0_name, ns)),
-        )
-        ready = sum(
-            deep_get(sts or {}, "status", "readyReplicas", default=0) or 0
-            for sts in stss)
+        if claimed:
+            # Warm-claimed notebooks own no StatefulSet — the adopted
+            # pod IS the slice; readiness and container state come from
+            # it directly (it keeps its warm-pool NAME, so the
+            # <sts0>-0 lookup below would miss it).
+            if pods is None:
+                pods = await self._worker_pods(nb)
+            ready = sum(
+                1 for p in pods
+                if any(c.get("type") == "Ready"
+                       and c.get("status") == "True"
+                       for c in deep_get(p, "status", "conditions",
+                                         default=[])))
+            pod0 = (warm or {}).get("pod") or (pods[0] if pods else None)
+        else:
+            *stss, pod0 = await overlap(
+                *[self._live_sts(
+                    ms.slice_sts_name(name, j) if ms else name, ns)
+                  for j in range(ms.num_slices if ms else 1)],
+                (None if self._pod_informer is not None
+                 else self.kube.get_or_none("Pod", pod0_name, ns)),
+            )
+            ready = sum(
+                deep_get(sts or {}, "status", "readyReplicas", default=0)
+                or 0 for sts in stss)
 
         container_state: dict = {}
         # Watch cache first (staleness self-corrects on the pod's next
         # event, which re-enqueues this notebook anyway).
-        if self._pod_informer is not None:
+        if self._pod_informer is not None and not claimed:
             pod0 = self._pod_informer.get(pod0_name, ns)
         if pod0:
             main_name = _main_container_name(nb)
@@ -1809,6 +1969,27 @@ class NotebookReconciler:
             conditions.insert(0, _checkpointed_condition(mig_status))
         conditions = conditions[:8]
 
+        # Warm-pool surface (JWA contract, web/common/status.py): claimed
+        # carries the pod + the claim latency ("Starting from warm pool
+        # (claimed in Xs)"); warming carries the pool's replenish
+        # progress ("Warming pool replenishing (k/n ready)"). Same
+        # merge-patch discipline as capacityPending.
+        warm_block: dict | None = None
+        if claimed:
+            warm_block = {"claimed": True}
+            wpod = (warm or {}).get("pod")
+            if wpod is not None:
+                warm_block["pod"] = name_of(wpod)
+            claimed_in = (warm or {}).get("claimed_in")
+            if claimed_in is None:
+                claimed_in = annotations_of(nb).get(
+                    nbapi.WARM_CLAIMED_IN_ANNOTATION)
+            try:
+                warm_block["claimedInSec"] = float(claimed_in)
+            except (TypeError, ValueError):
+                pass
+        elif warm_state == "warming" and (warm or {}).get("replenishing"):
+            warm_block = {"replenishing": warm["replenishing"]}
         status = {
             "readyReplicas": ready,
             "containerState": container_state,
@@ -1825,6 +2006,10 @@ class NotebookReconciler:
                 **({"capacityPending": True} if capacity_pending else
                    ({"capacityPending": None}
                     if deep_get(nb, "status", "tpu", "capacityPending")
+                    else {})),
+                **({"warmPool": warm_block} if warm_block is not None else
+                   ({"warmPool": None}
+                    if deep_get(nb, "status", "tpu", "warmPool") is not None
                     else {})),
             },
         }
@@ -1880,11 +2065,12 @@ class NotebookReconciler:
             chips=0 if stopped else (ms.num_chips if ms else 0),
         )
         await self._record_timeline(nb, ms, sched_status, mig_status,
-                                    ready=ready, want_hosts=want_hosts)
+                                    ready=ready, want_hosts=want_hosts,
+                                    warm=warm_state or "")
 
     async def _record_timeline(self, nb: dict, ms, sched_status,
                                mig_status, *, ready: int,
-                               want_hosts: int) -> None:
+                               want_hosts: int, warm: str = "") -> None:
         """Fold this reconcile's derived state into the durable lifecycle
         timeline (runtime/timeline.py) and, on a NEW Ready transition,
         score the startup episode against the time-to-ready SLO. One
@@ -1898,12 +2084,28 @@ class NotebookReconciler:
             mig_state=mig.get("state"),
             stopped=nbapi.is_stopped(nb),
             ready=ready, want_hosts=want_hosts,
-            reclaimed=sched.get("reclaimed", ""))
+            reclaimed=sched.get("reclaimed", ""),
+            warm=warm)
         reason = (sched.get("reclaimed") or sched.get("reason")
                   or mig.get("reason") or "")
         shape = (f"{ms.num_slices}x{ms.slice.accelerator.name}:"
                  f"{ms.slice.topology_str}" if ms else "")
         key = (namespace_of(nb), name_of(nb))
+        if warm == "claimed" and state == timeline_mod.READY:
+            # The claim is its own transition (ISSUE 14): a warm pod is
+            # often Ready within the claiming reconcile, which would
+            # otherwise journal straight to Ready — and the episode
+            # could no longer attribute warm vs cold starts. Record
+            # Claimed first; dedup in record() keeps later reconciles
+            # from repeating it.
+            prior = self._timeline.entries(
+                key, annotations=annotations_of(nb))
+            if not prior or prior[-1]["state"] not in (
+                    timeline_mod.CLAIMED, timeline_mod.READY):
+                await self._timeline.record(
+                    key, timeline_mod.CLAIMED, at=self._now(),
+                    reason="warm-pool", trace_id=current_trace_id(),
+                    shape=shape, annotations=annotations_of(nb))
         entries = await self._timeline.record(
             key, state, at=self._now(), reason=reason,
             trace_id=current_trace_id(), shape=shape,
@@ -2157,11 +2359,12 @@ def event_to_notebook(event: dict) -> list[tuple]:
 
 
 _SCHEDULER_FROM_ENV = object()  # sentinel: build from KFTPU_* env vars
+_WARMPOOL_FROM_ENV = object()   # sentinel: build from KFTPU_WARM_POOLS
 
 
 def setup_notebook_controller(
     mgr: Manager, options: NotebookOptions | None = None,
-    *, scheduler=_SCHEDULER_FROM_ENV,
+    *, scheduler=_SCHEDULER_FROM_ENV, warmpool=_WARMPOOL_FROM_ENV,
 ) -> NotebookReconciler:
     rec = NotebookReconciler(mgr.kube, options, registry=mgr.registry)
     # Durable lifecycle timelines + SLO feeds (runtime/{timeline,slo}.py)
@@ -2183,6 +2386,27 @@ def setup_notebook_controller(
         else:
             scheduler = None
     rec._scheduler = scheduler
+    if warmpool is _WARMPOOL_FROM_ENV:
+        # Warm pod pools (ISSUE 14): no KFTPU_WARM_POOLS spec (and no
+        # ConfigMap source) means no manager at all — the claim gate is
+        # a None check and the cold path is byte-for-byte untouched.
+        from kubeflow_tpu.cmd.envconfig import warm_pool_options
+        from kubeflow_tpu.controllers.warmpool import WarmPoolManager
+
+        wp_opts = warm_pool_options()
+        warmpool = (WarmPoolManager(mgr.kube, wp_opts,
+                                    registry=mgr.registry)
+                    if wp_opts.enabled else None)
+    rec._warmpool = warmpool
+    if warmpool is not None:
+        # One chip ledger: every warm slot holds a scheduler reservation
+        # (the first preemption victim), and the scheduler's teardown
+        # callback routes cannibalized slots back to the replenisher.
+        warmpool.scheduler = rec._scheduler
+        if rec._scheduler is not None:
+            rec._scheduler.on_warm_reclaimed(warmpool.note_reclaimed)
+        mgr.warmpool = warmpool
+        mgr.add_background(warmpool.run_replenisher)
     owned_kinds = ["StatefulSet", "Service"] + (
         ["VirtualService"] if rec.opts.use_istio else [])
     mgr.add_controller(

@@ -59,6 +59,14 @@ RESTORING = "Restoring"
 PREEMPTED = "Preempted"
 RECLAIMED = "Reclaimed"        # re-queued after spot reclaim / defrag
 STOPPED = "Stopped"
+# Warm pod pools (ISSUE 14): Claimed = this startup adopted a pre-warmed
+# pod (the episode's Claimed→Ready gap is the warm path's whole cost);
+# Warming = a matching pool existed but was EMPTY, so the cold path ran
+# while the pool replenished — the miss that cost this episode the warm
+# start. An episode containing either transition attributes its
+# time-to-ready to the warm (or missed-warm) path from the journal alone.
+CLAIMED = "Claimed"
+WARMING = "Warming"
 
 # States that END a startup episode: time-to-ready measures from the
 # first entry AFTER the latest of these to the Ready transition.
@@ -97,10 +105,14 @@ def max_entries(environ=os.environ) -> int:
 
 def derive_lifecycle(*, sched_state: str | None, mig_state: str | None,
                      stopped: bool, ready: int, want_hosts: int,
-                     reclaimed: str = "") -> str:
+                     reclaimed: str = "", warm: str = "") -> str:
     """The object's lifecycle state as a pure function of what
     ``_update_status`` already derived. Priority order mirrors the JWA
-    status machine: park/preempt verdicts over queueing over readiness."""
+    status machine: park/preempt verdicts over queueing over readiness.
+    ``warm`` is the warm-pool verdict ("claimed" = a pre-warmed pod was
+    adopted this episode, "warming" = a matching pool was empty and the
+    cold path ran) — it refines the pre-Ready states only; Ready and
+    every park/queue verdict outrank it."""
     if stopped:
         if mig_state == "Parked":
             return PARKED
@@ -116,8 +128,12 @@ def derive_lifecycle(*, sched_state: str | None, mig_state: str | None,
         return PREEMPTED
     if ready and want_hosts and ready >= want_hosts:
         return READY
+    if warm == "claimed":
+        return CLAIMED
     if mig_state == "Restoring":
         return RESTORING
+    if warm == "warming":
+        return WARMING
     if sched_state == "Admitted":
         return ADMITTED
     return CREATING

@@ -404,6 +404,25 @@ class PolicyQueue:
                             draining_by_pool[pool] = \
                                 draining_by_pool.get(pool, 0) + n
                 continue  # never re-pick a draining gang as a victim
+            if alloc.workload == "warmpool":
+                # Warm-pool chips are a RESERVE by contract (ISSUE 14):
+                # the reservation exists precisely so the scheduler can
+                # cannibalize it under pressure — tier -1 makes every
+                # warm slot a victim before any idle or lower-priority
+                # REAL gang, and schedule() releases it instantly (a
+                # warm pod holds no state worth a checkpoint drain).
+                if (alloc.accelerator.lower(),
+                        alloc.topology.lower()) != shape:
+                    continue
+                warm_reclaimable = sum(
+                    n for pool, n in alloc.placements.items()
+                    if pool in matching)
+                if warm_reclaimable == 0:
+                    continue
+                candidates.append((-1, 0.0, alloc.priority,
+                                   -alloc.admitted_at, alloc.key,
+                                   "warm-pool", warm_reclaimable, alloc))
+                continue
             if alloc.workload != "notebook":
                 # Workload-class guard (kubeflow_tpu/serving): a serving
                 # replica has no activity probe — "no kernels" must not
@@ -480,13 +499,19 @@ class PolicyQueue:
         admitted: list[Admitted] = []
         preempted: list[Preemption] = []
         drains: list[Preemption] = []
+        # Shapes whose warm-pool reserve was released THIS pass for a
+        # requester still waiting on real drains: held for the whole
+        # pass (across re-rank iterations), or a lower-ranked same-shape
+        # gang would backfill onto the freed reserve and leave the
+        # requester short — forcing a second real-gang drain later.
+        warm_held: set = set()
         progressed = True
         while progressed and self.pending:
             progressed = False
             # Shapes a starved gang has reserved this scan: backfill of
             # the SAME shape must not jump it, but gangs for disjoint
             # pools take nothing it is waiting for and admit freely.
-            blocked: set = set()
+            blocked: set = set(warm_held)
             for req in self._ordered_pending(now):
                 shape = (req.accelerator.lower(), req.topology.lower())
                 if shape in blocked:
@@ -495,6 +520,23 @@ class PolicyQueue:
                                        req.num_slices)
                 if plan is None and self.config.enable_preemption:
                     victims = self._find_victims(req, now)
+                    if victims is not None:
+                        # Warm-pool reservations release INSTANTLY even
+                        # in deferred mode: a warm pod has nothing to
+                        # checkpoint, and the whole point of the reserve
+                        # is that a real gang takes its chips in the
+                        # same pass (ISSUE 14).
+                        instant = [(a, r) for a, r in victims
+                                   if a.workload == "warmpool"]
+                        rest = [(a, r) for a, r in victims
+                                if a.workload != "warmpool"]
+                        for alloc, reason in instant:
+                            self.ledger.release(alloc.key)
+                            preempted.append(Preemption(
+                                key=alloc.key, reason=reason,
+                                for_key=req.key, chips=alloc.chips))
+                    else:
+                        instant, rest = [], []
                     if victims is not None and self.config.deferred_preemption:
                         # Drain, don't kill: mark the victims draining
                         # (chips stay booked — the fleet must not admit
@@ -505,13 +547,30 @@ class PolicyQueue:
                         # deadline) and releases the victims for real.
                         # An empty list = enough capacity already
                         # draining for this shape; just keep waiting.
-                        for alloc, reason in victims:
+                        for alloc, reason in rest:
                             alloc.draining = True
                             drains.append(Preemption(
                                 key=alloc.key, reason=reason,
                                 for_key=req.key, chips=alloc.chips))
+                        if instant and not rest:
+                            # The reserve alone covered the ask — admit
+                            # in this pass, like immediate preemption.
+                            plan = self.ledger.fit(
+                                req.accelerator, req.topology,
+                                req.num_slices)
+                        elif instant:
+                            # Warm chips freed NOW for a requester that
+                            # must still wait on real drains: hold the
+                            # shape's door for the rest of this PASS
+                            # (warm_held survives re-rank iterations).
+                            # Future passes before the drains finalize
+                            # keep a bounded window; _find_victims picks
+                            # warm slots first in any follow-up search,
+                            # so a real gang is still never preferred.
+                            blocked.add(shape)
+                            warm_held.add(shape)
                     elif victims:
-                        for alloc, reason in victims:
+                        for alloc, reason in rest:
                             self.ledger.release(alloc.key)
                             preempted.append(Preemption(
                                 key=alloc.key, reason=reason,

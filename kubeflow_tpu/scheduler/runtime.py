@@ -47,19 +47,26 @@ from kubeflow_tpu.runtime import slo
 from kubeflow_tpu.runtime.tracing import current_trace_id, span
 from kubeflow_tpu.migration import protocol as migration
 from kubeflow_tpu.scheduler import elastic
-from kubeflow_tpu.scheduler.fleet import Fleet
+from kubeflow_tpu.scheduler.fleet import Allocation, Fleet
 from kubeflow_tpu.scheduler.policy import (
     GangRequest,
     PolicyConfig,
     PolicyQueue,
     Preemption,
 )
+from kubeflow_tpu.tpu.topology import TopologyError, TpuSlice
 
 log = logging.getLogger(__name__)
 
 # Priority classes from a CR annotation; plain integers are accepted too.
 PRIORITY_ANNOTATION = nbapi.PRIORITY_ANNOTATION
 PRIORITY_CLASSES = {"low": -100, "normal": 0, "high": 100, "critical": 200}
+
+# Warm-pool slot reservations (ISSUE 14) sit below every user priority
+# class: the reserve exists to be cannibalized, and the tier -1 victim
+# ordering in policy._find_victims makes the intent structural, not just
+# a number.
+WARM_POOL_PRIORITY = -1000
 
 FLEET_CONFIGMAP_KEY = "fleet"
 _CONFIGMAP_RETRY_SECONDS = 30.0
@@ -232,6 +239,14 @@ class TpuFleetScheduler:
         # identical to PR 5–8 — until a serving controller registers.
         self._serving_keys: set = set()
         self._serving_cbs: list = []
+        # Warm pod pools (ISSUE 14, controllers/warmpool.py): slot
+        # reservation keys admitted through warm_reserve(). Their chips
+        # are a low-priority reclaimable reserve — the FIRST preemption
+        # victims, released instantly (nothing to checkpoint), with the
+        # teardown routed to the pool manager's async callbacks instead
+        # of any Notebook CR patch (no CR exists under these keys).
+        self._warmpool_keys: set = set()
+        self._warm_cbs: list = []
         # key → "Queued"|"Admitted" (last surfaced state, for transition
         # events); key → preemption reason for stopped victims; key →
         # reason for victims whose stop patch FAILED and must be retried
@@ -857,6 +872,77 @@ class TpuFleetScheduler:
         self._refresh_gauges()
         self._serving_keys.discard(key)
 
+    # ---- warm pod pools (ISSUE 14, controllers/warmpool.py) ----------------------
+
+    def on_warm_reclaimed(self, cb) -> None:
+        """Register the warm-pool manager's teardown callback:
+        ``await cb(slot_key)`` whenever a slot's reservation is
+        cannibalized (arbitration preemption or spot reclaim)."""
+        self._warm_cbs.append(cb)
+
+    async def warm_reserve(self, key: tuple, *, namespace: str,
+                           accelerator: str, topology: str) -> bool:
+        """Book ONE warm slot's chips in the ledger as a low-priority
+        reclaimable reservation. Never queues — pool replenishment is
+        opportunistic: no free capacity means no warm pod (the pool
+        rebuilds when pressure clears). Idempotent per key. Returns
+        False when the slot cannot be backed right now; True also while
+        no fleet is known (pass-through, like every admission)."""
+        if not await self._ensure_fleet():
+            return True
+        key = tuple(key)
+        if self.policy.is_admitted(key):
+            self._warmpool_keys.add(key)
+            return True
+        try:
+            shape = TpuSlice.parse(accelerator, topology)
+        except TopologyError:
+            return False
+        plan = self.policy.ledger.fit(accelerator, topology, 1)
+        if plan is None:
+            return False
+        self.policy.ledger.admit(Allocation(
+            key=key, namespace=namespace or "",
+            accelerator=accelerator, topology=topology,
+            num_slices=1, chips=shape.num_chips, placements=plan,
+            priority=WARM_POOL_PRIORITY, admitted_at=self._now(),
+            # Epoch-old activity: among warm slots themselves, the
+            # victim sort's idle ranking is moot (tier -1 already
+            # outranks everything); this just keeps debug rows honest —
+            # a warm slot is never "active".
+            last_active_at=0.0,
+            workload="warmpool",
+        ))
+        self.policy.gen += 1
+        self._warmpool_keys.add(key)
+        self._refresh_gauges()
+        return True
+
+    async def warm_release(self, key: tuple) -> None:
+        """Give a warm slot's chips back (claim consumed the slot, spec
+        shrink, pool teardown) and let waiters arbitrate for them."""
+        key = tuple(key)
+        self._warmpool_keys.discard(key)
+        if not self.active:
+            return
+        if self.policy.release(key) is not None:
+            now = self._now()
+            with span("schedule", key=f"{key[0]}/{key[1]}", release=True,
+                      workload="warmpool"):
+                result = self._arbitrate(now)
+                self._last_pass_gen = self.policy.gen
+                self._last_pass_at = now
+            await self._apply(result, now)
+            self._refresh_gauges()
+
+    async def _notify_warm_reclaimed(self, key: tuple) -> None:
+        for cb in self._warm_cbs:
+            try:
+                await cb(key)
+            except Exception:
+                log.exception("warm-pool reclaim callback failed for %s",
+                              key)
+
     # ---- decision application ---------------------------------------------------
 
     async def _apply(self, result, now: float,
@@ -922,6 +1008,14 @@ class TpuFleetScheduler:
         the victim's next reconcile (``_retry_stop``) — the chips are
         gone from the ledger either way, so the victim MUST park or the
         fleet physically overcommits."""
+        if p.key in self._warmpool_keys:
+            # A cannibalized warm-pool reservation: no CR to stop and
+            # nothing to checkpoint — the chips are already free; hand
+            # the slot to the pool manager for (deferred) pod teardown.
+            self._warmpool_keys.discard(p.key)
+            self.m_preemptions.labels(reason=p.reason).inc()
+            await self._notify_warm_reclaimed(p.key)
+            return
         ns, name = p.key
         self._preempted[p.key] = p.reason
         self.m_preemptions.labels(reason=p.reason).inc()
@@ -1461,6 +1555,19 @@ class TpuFleetScheduler:
                 continue  # drained; waiting for the signal to clear
             for alloc in victims:
                 if alloc.key in self._draining:
+                    continue
+                if alloc.workload == "warmpool" \
+                        or alloc.key in self._warmpool_keys:
+                    # Warm slots on revoked spot capacity: release the
+                    # reservation and tear the pod down — a warm pod
+                    # must not sit on a dying node, and it holds no
+                    # state worth the drain protocol.
+                    with span("reclaim", pool=pool_name,
+                              victim=f"{alloc.key[0]}/{alloc.key[1]}",
+                              workload="warmpool"):
+                        self.policy.release(alloc.key)
+                        self._warmpool_keys.discard(alloc.key)
+                        await self._notify_warm_reclaimed(alloc.key)
                     continue
                 if alloc.key in self._serving_keys \
                         or isvcapi.parse_replica_key(alloc.key) is not None:

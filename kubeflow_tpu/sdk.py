@@ -63,6 +63,7 @@ __all__ = [
     "start_profiler_server",
     "suspend",
     "trace",
+    "warm_idle",
 ]
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -274,6 +275,91 @@ def resume(*, environ=os.environ, patcher=None) -> None:
     and restores from its checkpoint hint."""
     patcher = patcher or _identity_patcher(environ)
     patcher({SUSPEND_ANNOTATION: None})
+
+
+# ---- warm pod pools (ISSUE 14, controllers/warmpool.py) ------------------------
+
+# Env contract of the warm-idle shim (docs/operations.md "Warm pools &
+# cold-start"). WARM_IDLE_ENV is also stamped by the pool controller's
+# slot template (controllers/warmpool.py keeps a matching constant).
+WARM_IDLE_ENV = "KFTPU_WARM_IDLE"
+WARM_CLAIM_FILE_ENV = "KFTPU_WARM_CLAIM_FILE"
+# Downward-API volume path the pool pod template mounts: pod annotations
+# as `key="value"` lines, updated live — how the claim annotation reaches
+# the shim without any apiserver credential.
+DEFAULT_CLAIM_FILE = "/etc/podinfo/annotations"
+
+
+def _read_downward_claim(path: str) -> str | None:
+    """Parse the downward-API annotations file for the warm-claim
+    annotation (``key="escaped value"`` lines)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    for line in text.splitlines():
+        k, sep, v = line.partition("=")
+        if not sep or k.strip() != keys.TPU_WARM_CLAIM:
+            continue
+        v = v.strip()
+        if len(v) >= 2 and v.startswith('"') and v.endswith('"'):
+            v = v[1:-1].encode().decode("unicode_escape")
+        return v or None
+    return None
+
+
+def warm_idle(*, environ=os.environ, poll_seconds: float = 1.0,
+              fetch_claim=None, init_devices: bool = True,
+              max_wait: float | None = None, _sleep=time.sleep) -> str | None:
+    """Hold a warm-pool pod fully started until it is claimed.
+
+    This is what makes a warm pod actually WARM: the persistent compile
+    cache is enabled and seeded from the image's fingerprint manifest
+    (``utils/compilecache.seed_cache``), ``jax`` is imported and the
+    device client attached — so a claimed pod has already paid the
+    interpreter, import, backend-attach, and (seeded) compile phases of
+    the cold-start waterfall. Then it parks, polling the downward-API
+    annotations file for the claim annotation the claim protocol stamps
+    (:data:`kubeflow_tpu.api.keys.TPU_WARM_CLAIM`). Returns the claim
+    value (``"<ns>/<name>/<nonce>"``) — the shim then execs the real
+    notebook server with the injected env — or None when ``max_wait``
+    expires (tests; production pods wait forever)."""
+    from kubeflow_tpu.utils import compilecache
+
+    cache_dir = compilecache.enable_persistent_cache()
+    seeded = compilecache.seed_cache(cache_dir=cache_dir)
+    _log.info(
+        "warm idle: compile cache %s ready=%s (seeded %d, skipped %d)",
+        cache_dir, seeded["ready"], seeded["seeded"], seeded["skipped"])
+    if init_devices:
+        try:
+            import jax
+
+            jax.devices()  # force the backend/device-client attach
+        except Exception:  # noqa: BLE001 — a warm pod without devices is
+            # still warm for interpreter+imports; claiming it beats cold.
+            _log.warning("warm idle: jax device init failed; staying warm "
+                         "for interpreter/imports only", exc_info=True)
+    if fetch_claim is None:
+        path = environ.get(WARM_CLAIM_FILE_ENV) or DEFAULT_CLAIM_FILE
+
+        def fetch_claim(path=path):
+            return _read_downward_claim(path)
+
+    t0 = time.monotonic()
+    while True:
+        try:
+            claim = fetch_claim()
+        except Exception:  # noqa: BLE001 — a flaky read must not kill the
+            # warm pod; the next poll retries.
+            _log.debug("warm-idle claim poll failed", exc_info=True)
+            claim = None
+        if claim:
+            return claim
+        if max_wait is not None and time.monotonic() - t0 >= max_wait:
+            return None
+        _sleep(poll_seconds)
 
 
 class MaintenanceWatcher:
@@ -575,10 +661,16 @@ class CheckpointGuard:
 def _main() -> None:
     """``python -m kubeflow_tpu.sdk`` — print this worker's slice identity
     as one JSON line (the in-pod debugging companion to
-    ``python -m kubeflow_tpu.probe``)."""
+    ``python -m kubeflow_tpu.probe``). ``--warm-idle`` runs the warm-pool
+    hold loop instead (the pool controller's slot pod command)."""
     import dataclasses
     import json
+    import sys
 
+    if "--warm-idle" in sys.argv[1:]:
+        claim = warm_idle()
+        print(json.dumps({"claimed": claim}))
+        return
     print(json.dumps(dataclasses.asdict(SliceInfo.from_env())))
 
 

@@ -27,10 +27,15 @@ import random
 import time
 from dataclasses import dataclass, field
 
+from kubeflow_tpu.api import keys
 from kubeflow_tpu.api import notebook as nbapi
 from kubeflow_tpu.controllers.notebook import (
     NotebookOptions,
     setup_notebook_controller,
+)
+from kubeflow_tpu.controllers.warmpool import (
+    WarmPoolManager,
+    WarmPoolOptions,
 )
 from kubeflow_tpu.migration import protocol as migration
 from kubeflow_tpu.runtime import timeline as timeline_mod
@@ -70,8 +75,16 @@ class SoakConfig:
     storm_seconds: float = 0.8
     # Served through a fleet ConfigMap (a DYNAMIC source) so the elastic
     # scale-up grant action can actually grow it mid-soak; pool-spot is
-    # reclaim-aware spot capacity.
-    fleet: str = "pool-a=v5e:4x4:2,pool-spot=v5e:4x4:2:spot"
+    # reclaim-aware spot capacity; pool-small hosts the warm-eligible
+    # single-host 2x2 gangs (ISSUE 14).
+    fleet: str = ("pool-a=v5e:4x4:2,pool-spot=v5e:4x4:2:spot,"
+                  "pool-small=v5e:2x2:4")
+    # Warm pod pools under the storm (ISSUE 14): a small pool in team-0
+    # plus warm-eligible 2x2 notebooks drives claims through the fault
+    # storm; check_invariants asserts no pod is claimed by two Notebooks
+    # and the pool converges back to spec after kills/reclaims.
+    warm_pools: str = "team-0/warm-img:latest@v5e:2x2:2"
+    warm_image: str = "warm-img:latest"
     fault_rate: float = 0.12
     watch_reset_rate: float = 0.04
     stale_list_rate: float = 0.15
@@ -131,7 +144,8 @@ class SoakReport:
 
 
 async def check_invariants(kube: FakeKube, mgr: Manager,
-                           sched: TpuFleetScheduler) -> list[str]:
+                           sched: TpuFleetScheduler,
+                           warmpool=None) -> list[str]:
     """The global truths every convergence must restore; returns human-
     readable violations (empty = healthy). Reads the store and in-memory
     scheduler state directly — no fault plan should be active."""
@@ -242,6 +256,50 @@ async def check_invariants(kube: FakeKube, mgr: Manager,
             problems.append(
                 f"Queued gang {key} owns scaled-up StatefulSets {hot}")
 
+    # Warm-pool invariants (ISSUE 14). (a) No pod claimed by two
+    # Notebooks: the CAS claim protocol must hold through every fault
+    # storm and manager kill — two CRs whose warm-claimed annotations
+    # name the same pod would mean the protocol double-adopted.
+    claimed_by: dict[tuple, list] = {}
+    for nb in notebooks:
+        pod_name = annotations_of(nb).get(nbapi.WARM_CLAIMED_ANNOTATION)
+        if pod_name:
+            claimed_by.setdefault(
+                (namespace_of(nb), pod_name), []).append(name_of(nb))
+    for (ns, pod_name), owners in sorted(claimed_by.items()):
+        if len(owners) > 1:
+            problems.append(
+                f"pod {ns}/{pod_name} claimed by two Notebooks: "
+                f"{sorted(owners)}")
+            continue
+        pod = await kube.get_or_none("Pod", pod_name, ns)
+        if pod is None:
+            problems.append(
+                f"{ns}/{owners[0]}: warm-claimed pod {pod_name} is gone "
+                "but the claim annotation survived convergence")
+        elif not (annotations_of(pod).get(keys.TPU_WARM_CLAIM) or ""
+                  ).startswith(f"{ns}/{owners[0]}/"):
+            problems.append(
+                f"{ns}/{owners[0]}: claimed pod {pod_name} carries a "
+                "foreign (or no) claim annotation: "
+                f"{annotations_of(pod).get(keys.TPU_WARM_CLAIM)!r}")
+    # (b) Pool size converges back to spec after kills/claims/reclaims —
+    # below-target is only legitimate while the shape has NO free
+    # capacity (the scheduler legitimately cannibalized the reserve).
+    if warmpool is not None and warmpool.active and sched.active:
+        for pool in warmpool.pools:
+            ready = len(await warmpool._claimable_pods(pool))
+            if ready >= pool.size:
+                continue
+            free = sum(
+                max(sched.policy.ledger.free_slices(p), 0)
+                for p in sched.policy.fleet.matching(
+                    pool.accelerator, pool.topology))
+            if free > 0:
+                problems.append(
+                    f"warm pool {pool.slug} not converged: {ready} ready "
+                    f"< target {pool.size} with {free} free "
+                    f"{pool.accelerator}:{pool.topology} slice(s)")
     for name, queue in mgr._queues.items():
         info = queue.debug_info()
         if info["ready"] or info["in_flight"] or info["dirty"]:
@@ -264,6 +322,7 @@ class ChaosSoak:
         self.report = SoakReport(seed=config.seed)
         self.mgr: Manager | None = None
         self.sched: TpuFleetScheduler | None = None
+        self.warmpool: WarmPoolManager | None = None
         self._nb_names: list[tuple] = []
         self._created = 0
         # Live fleet spec (the ConfigMap's data["fleet"]); scale-up
@@ -305,7 +364,20 @@ class ChaosSoak:
             ),
             registry=mgr.registry,
         )
-        setup_notebook_controller(mgr, NotebookOptions(), scheduler=sched)
+        # Warm pod pools ride the storm too (ISSUE 14): claims, pool
+        # kills, and scheduler cannibalization all replay per seed; a
+        # REBUILT manager's fresh pool manager must adopt the running
+        # slots (and their CAS state) from the API alone.
+        warmpool = (WarmPoolManager(
+            self.kube,
+            WarmPoolOptions(
+                spec=self.cfg.warm_pools,
+                controller_namespace=self.cfg.controller_namespace,
+                replenish_seconds=0.05),
+            registry=mgr.registry)
+            if self.cfg.warm_pools else None)
+        setup_notebook_controller(mgr, NotebookOptions(), scheduler=sched,
+                                  warmpool=warmpool)
         # Soak-speed clocks: tiny workqueue backoff and informer resync so
         # a seeded run converges in seconds, not production minutes.
         for q in mgr._queues.values():
@@ -314,7 +386,7 @@ class ChaosSoak:
         for inf in mgr.informers.values():
             inf.resync_backoff = 0.02
             inf.resync_backoff_max = 0.2
-        self.mgr, self.sched = mgr, sched
+        self.mgr, self.sched, self.warmpool = mgr, sched, warmpool
 
     async def _start(self) -> None:
         self._build_stack()
@@ -352,7 +424,14 @@ class ChaosSoak:
     async def _create_notebook(self, ns: str) -> None:
         name = f"soak-{self._created}"
         self._created += 1
-        nb = nbapi.new(name, ns, accelerator="v5e", topology="4x4")
+        if self.rng.random() < 0.4:
+            # Warm-eligible shape/image (single-host 2x2 on the warm
+            # pool's image): in team-0 these drive claims through the
+            # storm; elsewhere they prove claims stay namespace-local.
+            nb = nbapi.new(name, ns, image=self.cfg.warm_image,
+                           accelerator="v5e", topology="2x2")
+        else:
+            nb = nbapi.new(name, ns, accelerator="v5e", topology="4x4")
         prio = self.rng.choice(["low", "normal", "normal", "high"])
         nb["metadata"].setdefault("annotations", {})[
             nbapi.PRIORITY_ANNOTATION] = prio
@@ -648,7 +727,7 @@ class ChaosSoak:
                         f"faults active (permanently wedged): {key}"]
                     return problems
             problems = await check_invariants(self.kube, self.mgr,
-                                              self.sched)
+                                              self.sched, self.warmpool)
             if not problems:
                 return []
             await asyncio.sleep(0.05)

@@ -40,6 +40,8 @@ class PodSimulator:
         kube: FakeKube,
         *,
         start_latency: float = 0.0,
+        image_pull_latency: float = 0.0,
+        runtime_start_latency: float = 0.0,
         failure_injector=None,
     ):
         """``failure_injector(pod) -> None | "fail" | "crash" | "crash:<ctr>"
@@ -52,9 +54,21 @@ class PodSimulator:
         "disrupt" brings the pod up healthy but stamped with a
         DisruptionTarget=True condition (default reason
         PreemptionByScheduler) — a spot preemption / node drain in
-        flight, containers still running."""
+        flight, containers still running.
+
+        Cold-start latency model (ISSUE 14): real pod starts are
+        dominated by two costs a reconcile-speed sim hides —
+        ``image_pull_latency`` (paid ONCE per (node, image): kubelet's
+        image cache makes later pulls free, which is exactly what warm
+        pools and image streaming exploit) and ``runtime_start_latency``
+        (paid by EVERY fresh pod: interpreter + imports + device-client
+        attach). A warm-pool CLAIM creates no pod, so it pays neither —
+        the asymmetry ``bench.py coldstart`` measures."""
         self.kube = kube
         self.start_latency = start_latency
+        self.image_pull_latency = image_pull_latency
+        self.runtime_start_latency = runtime_start_latency
+        self._pulled_images: set[tuple] = set()
         self.failure_injector = failure_injector
         self._tasks: list[asyncio.Task] = []
         # Strong refs: asyncio holds tasks weakly; un-referenced _run_pod
@@ -292,8 +306,18 @@ class PodSimulator:
                 delay = min(delay * 2, 0.5)
 
     async def _run_pod(self, pod: dict) -> None:
-        if self.start_latency:
-            await asyncio.sleep(self.start_latency)
+        delay = self.start_latency
+        if self.image_pull_latency or self.runtime_start_latency:
+            image = (deep_get(pod, "spec", "containers", default=[{}])
+                     or [{}])[0].get("image", "")
+            node = deep_get(pod, "spec", "nodeName") or ""
+            if self.image_pull_latency:
+                if (node, image) not in self._pulled_images:
+                    self._pulled_images.add((node, image))
+                    delay += self.image_pull_latency
+            delay += self.runtime_start_latency
+        if delay:
+            await asyncio.sleep(delay)
         ns, name = namespace_of(pod), name_of(pod)
         fault = self.failure_injector(pod) if self.failure_injector else None
         if fault == "fail":

@@ -1,4 +1,4 @@
-"""Persistent XLA compilation cache wiring.
+"""Persistent XLA compilation cache wiring + warm-pool cache seeding.
 
 Cold start on TPU is compile-dominated (measured: ~12 s AOT compile +
 ~15 s jitted init for the bench model, docs/perf.md). JAX ships a
@@ -13,6 +13,18 @@ where that directory lives:
   semantics keep the PVC; SURVEY.md §5 checkpoint/resume). Exported by
   the jupyter-jax image (images/jupyter-jax/Dockerfile).
 - **bench.py / local runs**: a repo-local ``.jax_cache/`` (gitignored).
+- **Warm-pool pods** (ISSUE 14): the SDK warm-idle loop calls
+  :func:`seed_cache` before parking, copying common program fingerprints
+  from ``$KFTPU_COMPILE_CACHE_SEED_DIR`` (baked into the image or mounted
+  from a shared volume) into the live cache dir — the first user step in
+  a claimed pod then pays a disk read, not an XLA compile.
+
+Failure semantics (ISSUE 14 satellite): cache-dir setup failures used to
+be silent in the in-pod path — they are now logged ONCE per directory,
+counted in ``compile_cache_setup_failures_total``, and surfaced through
+:func:`cache_dir_ready`, the flag the seeder and readiness probes assert
+on. Cache effectiveness is observable through :func:`note_compile`'s
+hit/miss counters (an unchanged entry count across a compile = a hit).
 
 No reference counterpart: the reference's images have no accelerator
 runtime to cache for (its CUDA images pay framework JIT costs elsewhere).
@@ -20,10 +32,46 @@ runtime to cache for (its CUDA images pay framework JIT costs elsewhere).
 
 from __future__ import annotations
 
+import json
+import logging
 import os
+import shutil
+
+log = logging.getLogger(__name__)
 
 ENV_VAR = "KFTPU_COMPILE_CACHE_DIR"
+SEED_DIR_ENV = "KFTPU_COMPILE_CACHE_SEED_DIR"
 DEFAULT_IMAGE_DIR = "~/.cache/jax_compile"
+
+# Optional manifest file inside a seed dir: a JSON list of entry file
+# names to copy (a subset pin). Absent → every regular file seeds.
+SEED_MANIFEST = "manifest.json"
+
+# Module-level counters (the in-pod path must not require the metrics
+# registry); mirrored into Prometheus lazily when the registry imports.
+_counters = {"setup_failures": 0, "hits": 0, "misses": 0, "seeded": 0}
+_setup_failed_dirs: set[str] = set()
+
+
+def _prom_inc(name: str, help_: str) -> None:
+    """Best-effort Prometheus mirror — the warm-idle loop and probes run
+    in pods that may not serve /metrics; the module counters stay the
+    source of truth either way."""
+    try:
+        from kubeflow_tpu.runtime.metrics import global_registry
+
+        global_registry.counter(name, help_).inc()
+    except Exception:  # kftpu: ignore[exception-swallow] metrics are a mirror; the module counter above already recorded the event
+        pass
+
+
+def setup_failures_total() -> int:
+    return _counters["setup_failures"]
+
+
+def cache_stats() -> dict:
+    """Snapshot of the module counters (probes / bench attribution)."""
+    return dict(_counters)
 
 
 def default_cache_dir() -> str:
@@ -39,17 +87,45 @@ def cache_entries(cache_dir: str | None = None) -> int:
         return 0
 
 
+def cache_dir_ready(cache_dir: str | None = None) -> bool:
+    """Is the cache directory usable (exists and writable)? The flag the
+    warm-pool seeder and readiness probes assert on before promising a
+    warm compile phase."""
+    d = os.path.abspath(cache_dir or default_cache_dir())
+    return os.path.isdir(d) and os.access(d, os.W_OK)
+
+
 def enable_persistent_cache(cache_dir: str | None = None) -> str:
     """Point JAX's persistent compilation cache at ``cache_dir``.
 
     Idempotent; creates the directory. Must run before the first
     compilation (config flips after a compile don't retro-cache it).
     Returns the resolved directory.
-    """
+
+    A directory that cannot be created/written (read-only image fs,
+    broken PVC mount) no longer fails silently — or fatally: it is
+    logged once, counted in ``compile_cache_setup_failures_total``, and
+    the jax config is left untouched (compiles run uncached rather than
+    erroring per-compile against a dead dir). ``cache_dir_ready``
+    reports the outcome."""
+    d = os.path.abspath(cache_dir or default_cache_dir())
+    try:
+        os.makedirs(d, exist_ok=True)
+        if not os.access(d, os.W_OK):
+            raise OSError(f"{d} is not writable")
+    except OSError as e:
+        _counters["setup_failures"] += 1
+        _prom_inc("compile_cache_setup_failures_total",
+                  "Compile-cache directory setup failures")
+        if d not in _setup_failed_dirs:
+            _setup_failed_dirs.add(d)
+            log.error(
+                "compile cache dir %s unusable (%s): compiles will run "
+                "UNCACHED — cold-start compile savings are off until the "
+                "mount/permissions are fixed", d, e)
+        return d
     import jax
 
-    d = os.path.abspath(cache_dir or default_cache_dir())
-    os.makedirs(d, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", d)
     # Cache everything: the default 1 s floor skips the many small
     # programs (init, host transfers) whose compiles still add up through
@@ -57,3 +133,70 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     return d
+
+
+def seed_cache(seed_dir: str | None = None,
+               cache_dir: str | None = None) -> dict:
+    """Pre-populate the compile cache from a manifest of common program
+    fingerprints (ISSUE 14): copy every entry in ``seed_dir`` (default
+    ``$KFTPU_COMPILE_CACHE_SEED_DIR``) into the live cache dir, skipping
+    entries already present — first user steps in a warm-pool pod then
+    hit the cache instead of paying an XLA compile. A ``manifest.json``
+    (JSON list of file names) inside the seed dir pins the subset;
+    absent, every regular file seeds.
+
+    Returns ``{"seeded": n, "skipped": n, "ready": bool}``; a missing/
+    unconfigured seed dir is a clean no-op (``seeded=0``), a broken
+    CACHE dir is reported via ``ready=False`` (and was already counted
+    by :func:`enable_persistent_cache`)."""
+    src = seed_dir or os.environ.get(SEED_DIR_ENV)
+    dst = os.path.abspath(cache_dir or default_cache_dir())
+    out = {"seeded": 0, "skipped": 0, "ready": cache_dir_ready(dst)}
+    if not src or not os.path.isdir(src) or not out["ready"]:
+        return out
+    names = None
+    manifest = os.path.join(src, SEED_MANIFEST)
+    if os.path.isfile(manifest):
+        try:
+            with open(manifest, encoding="utf-8") as fh:
+                listed = json.load(fh)
+            if isinstance(listed, list):
+                names = {str(n) for n in listed}
+        except (OSError, ValueError):
+            log.warning("unreadable seed manifest %s; seeding every "
+                        "entry in %s", manifest, src)
+    try:
+        entries = [e for e in os.scandir(src)
+                   if e.is_file() and e.name != SEED_MANIFEST
+                   and (names is None or e.name in names)]
+    except OSError:
+        return out
+    for entry in entries:
+        target = os.path.join(dst, entry.name)
+        if os.path.exists(target):
+            out["skipped"] += 1
+            continue
+        try:
+            shutil.copyfile(entry.path, target)
+        except OSError:
+            out["ready"] = cache_dir_ready(dst)
+            continue
+        out["seeded"] += 1
+    _counters["seeded"] += out["seeded"]
+    return out
+
+
+def note_compile(entries_before: int, entries_after: int) -> str:
+    """Classify one compile against the cache and count it: an unchanged
+    entry count means the executable came FROM the cache (hit); a grown
+    count means XLA compiled and the result was written (miss — warm for
+    next time). Surfaced per phase by the bench's fresh-process probe."""
+    if entries_after <= entries_before:
+        _counters["hits"] += 1
+        _prom_inc("compile_cache_hits_total",
+                  "Compiles served from the persistent cache")
+        return "hit"
+    _counters["misses"] += 1
+    _prom_inc("compile_cache_misses_total",
+              "Compiles that missed the persistent cache")
+    return "miss"

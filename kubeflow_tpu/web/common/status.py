@@ -224,6 +224,32 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
             "Waiting for TPU capacity (queued ProvisioningRequest)",
         )
 
+    # Warm pod pools (ISSUE 14, controllers/warmpool.py): a claimed
+    # notebook starting up says HOW it is starting (the warm path is the
+    # product's headline — surface it); a pool caught empty says why the
+    # cold path ran and how close the pool is to refilled. Both only
+    # matter pre-Ready — a Running server falls through to the normal
+    # Ready message.
+    warm_pool = deep_get(notebook, "status", "tpu", "warmPool",
+                         default={}) or {}
+    if warm_pool.get("claimed") and ready < want_hosts \
+            and nbapi.STOP_ANNOTATION not in annotations:
+        claimed_in = warm_pool.get("claimedInSec")
+        return Status(
+            WAITING,
+            "Starting from warm pool"
+            + (f" (claimed in {claimed_in:g}s)"
+               if isinstance(claimed_in, (int, float)) else ""),
+        )
+    repl = warm_pool.get("replenishing") or {}
+    if repl and ready < want_hosts \
+            and nbapi.STOP_ANNOTATION not in annotations:
+        return Status(
+            WAITING,
+            f"Warming pool replenishing ({repl.get('ready', 0)}/"
+            f"{repl.get('size', 0)} ready); starting cold",
+        )
+
     # Brand-new CR: show a benign waiting message for the first seconds.
     if not container_state and not conditions and _age_seconds(notebook) <= 10:
         return Status(WAITING, "Waiting for StatefulSet to create the underlying Pod.")

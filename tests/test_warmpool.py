@@ -1,0 +1,750 @@
+"""Warm pod pools: claim protocol, ledger reservations, cold fallback
+(ISSUE 14; kubeflow_tpu/controllers/warmpool.py).
+
+Covers the tentpole's contracts — CAS claim races, empty-pool fallback,
+reservation-first preemption — plus the satellites: compile-cache
+seeding + failure counters, the SDK warm-idle loop, the JWA status
+messages, and the Warming/Claimed timeline states.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from kubeflow_tpu.api import keys
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.controllers.warmpool import (
+    WarmPoolConfigError,
+    WarmPoolManager,
+    WarmPoolOptions,
+    WarmPoolSpec,
+    parse_warm_pools,
+)
+from kubeflow_tpu.runtime import timeline as timeline_mod
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import Registry
+from kubeflow_tpu.runtime.objects import (
+    annotations_of,
+    deep_get,
+    fmt_iso,
+    get_meta,
+    name_of,
+)
+from kubeflow_tpu.scheduler import SchedulerOptions, TpuFleetScheduler
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+
+
+# ---- spec parsing --------------------------------------------------------------
+
+
+def test_parse_warm_pools_grammar():
+    pools = parse_warm_pools(
+        "img:v1@v5e:2x2:3, team-a/registry.io/repo/img:v2@v5e:1x1:1",
+        default_namespace="kubeflow-tpu")
+    assert pools[0] == WarmPoolSpec("kubeflow-tpu", "img:v1", "v5e",
+                                    "2x2", 3)
+    assert pools[1].namespace == "team-a"
+    assert pools[1].image == "registry.io/repo/img:v2"
+    assert parse_warm_pools("", default_namespace="x") == ()
+
+
+def test_parse_warm_pools_rejects_garbage():
+    with pytest.raises(WarmPoolConfigError):
+        parse_warm_pools("img@v5e:2x2", default_namespace="x")
+    with pytest.raises(WarmPoolConfigError):
+        parse_warm_pools("img@v5e:2x2:abc", default_namespace="x")
+    with pytest.raises(WarmPoolConfigError):
+        parse_warm_pools("img@nope:2x2:1", default_namespace="x")
+    # duplicate (ns, image, shape)
+    with pytest.raises(WarmPoolConfigError):
+        parse_warm_pools("img@v5e:2x2:1,img@v5e:2x2:2",
+                         default_namespace="x")
+
+
+def test_parse_warm_pools_rejects_multi_host_shapes():
+    # A warm pod IS the slice — 4x4 on v5e needs 4 hosts.
+    with pytest.raises(WarmPoolConfigError) as e:
+        parse_warm_pools("img@v5e:4x4:1", default_namespace="x")
+    assert "single-host" in str(e.value)
+
+
+def test_pool_slug_is_deterministic_and_dns_safe():
+    a = WarmPoolSpec("ns", "registry.io/team/jupyter-jax:v9", "v5e",
+                     "2x2", 1)
+    b = WarmPoolSpec("ns", "registry.io/team/jupyter-jax:v9", "v5e",
+                     "2x2", 4)
+    assert a.slug == b.slug  # size never changes slot naming
+    assert a.slug.startswith("warm-jupyter-jax-")
+    assert all(c.isalnum() or c == "-" for c in a.slug)
+
+
+# ---- shared stack --------------------------------------------------------------
+
+
+class Stack:
+    def __init__(self, *, fleet="pool-a=v5e:2x2:6",
+                 warm="ns/img:latest@v5e:2x2:2", migration=False,
+                 pull=0.0, start=0.0):
+        self.kube = FakeKube()
+        register_all(self.kube)
+        self.mgr = Manager(self.kube, registry=Registry())
+        self.sched = TpuFleetScheduler(
+            self.kube,
+            SchedulerOptions(fleet_spec=fleet, enable_migration=migration,
+                             drain_grace_seconds=1.0),
+            registry=self.mgr.registry) if fleet else None
+        self.warmpool = WarmPoolManager(
+            self.kube,
+            WarmPoolOptions(spec=warm, replenish_seconds=0.05),
+            registry=self.mgr.registry) if warm else None
+        setup_notebook_controller(self.mgr, NotebookOptions(),
+                                  scheduler=self.sched,
+                                  warmpool=self.warmpool)
+        self.sim = PodSimulator(self.kube, image_pull_latency=pull,
+                                runtime_start_latency=start)
+
+    async def __aenter__(self):
+        await self.mgr.start()
+        await self.sim.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        if self.warmpool is not None:
+            self.warmpool.stop()
+        await self.sim.stop()
+        await self.mgr.stop()
+        self.kube.close_watches()
+
+    async def pool_ready(self, count, timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = await self.warmpool.debug_info()
+            if info["pools"] and info["pools"][0]["ready"] >= count:
+                return True
+            await asyncio.sleep(0.02)
+        return False
+
+    async def ready(self, name, ns="ns", timeout=20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            nb = await self.kube.get("Notebook", name, ns)
+            if deep_get(nb, "status", "readyReplicas", default=0):
+                return nb
+            await asyncio.sleep(0.01)
+        raise AssertionError(f"{name} never became Ready")
+
+
+def warm_nb(name, ns="ns", image="img:latest"):
+    return nbapi.new(name, ns, image=image, accelerator="v5e",
+                     topology="2x2")
+
+
+# ---- claim end to end ----------------------------------------------------------
+
+
+async def test_claim_end_to_end_and_attribution():
+    async with Stack() as s:
+        assert await s.pool_ready(2)
+        await s.kube.create("Notebook", warm_nb("nb"))
+        nb = await s.ready("nb")
+        await s.mgr.wait_idle(timeout=10)
+        nb = await s.kube.get("Notebook", "nb", "ns")
+        ann = annotations_of(nb)
+        pod_name = ann.get(nbapi.WARM_CLAIMED_ANNOTATION)
+        assert pod_name
+        # No slice StatefulSet was created — the adopted pod IS the slice.
+        assert await s.kube.get_or_none("StatefulSet", "nb", "ns") is None
+        pod = await s.kube.get("Pod", pod_name, "ns")
+        labels = pod["metadata"]["labels"]
+        assert labels[nbapi.NOTEBOOK_NAME_LABEL] == "nb"
+        assert labels["statefulset"] == "nb"
+        assert labels["statefulset.kubernetes.io/pod-name"] == "nb-0"
+        # The claim is its own timeline transition (warm-vs-cold episode
+        # attribution) and the CAS mark names this notebook.
+        states = [e["state"] for e in timeline_mod.decode(ann)]
+        assert timeline_mod.CLAIMED in states
+        assert states[-1] == timeline_mod.READY
+        assert (annotations_of(pod).get(keys.TPU_WARM_CLAIM) or "") \
+            .startswith("ns/nb/")
+        # Ownership: GC cascades with the CR.
+        refs = pod["metadata"]["ownerReferences"]
+        assert [r["kind"] for r in refs] == ["Notebook"]
+        # Env injection: NB_PREFIX for this notebook.
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["NB_PREFIX"] == "/notebook/ns/nb"
+        # Pool replenished back to target after the claim.
+        assert await s.pool_ready(2)
+        assert s.sched.policy.ledger.violations == 0
+
+
+async def test_claim_race_one_winner_per_pod():
+    """Two notebooks claim concurrently against a ONE-pod pool: exactly
+    one adopts it; the other falls back cold (STS created)."""
+    async with Stack(warm="ns/img:latest@v5e:2x2:1") as s:
+        assert await s.pool_ready(1)
+        await asyncio.gather(
+            s.kube.create("Notebook", warm_nb("race-a")),
+            s.kube.create("Notebook", warm_nb("race-b")),
+        )
+        await s.ready("race-a")
+        await s.ready("race-b")
+        await s.mgr.wait_idle(timeout=10)
+        claimed = []
+        for name in ("race-a", "race-b"):
+            nb = await s.kube.get("Notebook", name, "ns")
+            pod = annotations_of(nb).get(nbapi.WARM_CLAIMED_ANNOTATION)
+            if pod:
+                claimed.append((name, pod))
+        # At most one claimer per pod — and with a 1-pod pool, at most
+        # one claim total (the replenisher may refill mid-race, so 2
+        # claims of DIFFERENT pods are legitimate).
+        pods = [p for _, p in claimed]
+        assert len(pods) == len(set(pods))
+        # Everyone is Ready either way, and nothing double-adopted.
+        assert s.sched.policy.ledger.violations == 0
+
+
+async def test_empty_pool_falls_back_cold():
+    """A matching pool with zero warm pods: the cold path runs THIS
+    reconcile (no wedge), and the miss is surfaced as replenishing."""
+    async with Stack(fleet="pool-a=v5e:2x2:2",
+                     warm="ns/img:latest@v5e:2x2:2") as s:
+        # Fleet of 2 slices, pool wants 2: let the pool fill, then eat
+        # ALL capacity with two notebooks — claims + fallback both run.
+        assert await s.pool_ready(2)
+        await s.kube.create("Notebook", warm_nb("eat-1"))
+        await s.kube.create("Notebook", warm_nb("eat-2"))
+        await s.ready("eat-1")
+        await s.ready("eat-2")
+        # Pool is now empty AND unfillable (0 free slices). A third
+        # notebook queues (no capacity) — stop one to free a slice; the
+        # third then starts COLD (pool empty) rather than wedging.
+        await s.kube.create("Notebook", warm_nb("third"))
+        await s.kube.patch(
+            "Notebook", "eat-1",
+            {"metadata": {"annotations": {
+                nbapi.STOP_ANNOTATION: fmt_iso(time.time())}}}, "ns")
+        nb = await s.ready("third")
+        assert annotations_of(nb).get(nbapi.WARM_CLAIMED_ANNOTATION) \
+            is None
+        # Cold path proof: the slice StatefulSet exists.
+        assert await s.kube.get_or_none("StatefulSet", "third", "ns") \
+            is not None
+        assert s.sched.policy.ledger.violations == 0
+
+
+async def test_lost_claimed_pod_falls_back_cold():
+    async with Stack() as s:
+        assert await s.pool_ready(2)
+        await s.kube.create("Notebook", warm_nb("nb"))
+        nb = await s.ready("nb")
+        pod_name = annotations_of(
+            await s.kube.get("Notebook", "nb", "ns")
+        ).get(nbapi.WARM_CLAIMED_ANNOTATION)
+        await s.kube.delete("Pod", pod_name, "ns")
+        # The controller clears the claim and rebuilds cold.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            sts = await s.kube.get_or_none("StatefulSet", "nb", "ns")
+            nb = await s.kube.get("Notebook", "nb", "ns")
+            if sts is not None and annotations_of(nb).get(
+                    nbapi.WARM_CLAIMED_ANNOTATION) is None:
+                break
+            await asyncio.sleep(0.02)
+        assert await s.kube.get_or_none("StatefulSet", "nb", "ns") \
+            is not None
+        await s.ready("nb")
+
+
+async def test_stop_deletes_claimed_pod_and_restart_claims_fresh():
+    async with Stack() as s:
+        assert await s.pool_ready(2)
+        await s.kube.create("Notebook", warm_nb("nb"))
+        await s.ready("nb")
+        first = annotations_of(
+            await s.kube.get("Notebook", "nb", "ns")
+        ).get(nbapi.WARM_CLAIMED_ANNOTATION)
+        await s.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {
+                nbapi.STOP_ANNOTATION: fmt_iso(time.time())}}}, "ns")
+        await s.mgr.wait_idle(timeout=10)
+        assert await s.kube.get_or_none("Pod", first, "ns") is None
+        nb = await s.kube.get("Notebook", "nb", "ns")
+        assert annotations_of(nb).get(nbapi.WARM_CLAIMED_ANNOTATION) \
+            is None
+        await s.kube.patch(
+            "Notebook", "nb",
+            {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: None}}},
+            "ns")
+        nb = await s.ready("nb")
+        second = annotations_of(nb).get(nbapi.WARM_CLAIMED_ANNOTATION)
+        assert second and second != first
+
+
+async def test_stop_with_stale_unadopted_claim_leaves_pool_pod_alone():
+    """A stale claim INTENT (the hand-off never completed, and the
+    rollback patch was also lost) names a pod this notebook never
+    adopted — by now it may be ANOTHER notebook's live server. Stopping
+    the stale claimer must clear the intent WITHOUT deleting the pod:
+    only a pod carrying OUR identity labels is ours to kill."""
+    async with Stack() as s:
+        assert await s.pool_ready(2)
+        # "other" legitimately claims a pod out of the pool.
+        await s.kube.create("Notebook", warm_nb("other"))
+        await s.ready("other")
+        await s.mgr.wait_idle(timeout=10)
+        victim = annotations_of(
+            await s.kube.get("Notebook", "other", "ns")
+        ).get(nbapi.WARM_CLAIMED_ANNOTATION)
+        assert victim
+        # "stale" carries an intent for that same pod (the interrupted
+        # hand-off's leftover) and is stopped.
+        nb = warm_nb("stale")
+        nb["metadata"].setdefault("annotations", {}).update({
+            nbapi.WARM_CLAIMED_ANNOTATION: victim,
+            nbapi.STOP_ANNOTATION: fmt_iso(time.time()),
+        })
+        await s.kube.create("Notebook", nb)
+        await s.mgr.wait_idle(timeout=10)
+        nb = await s.kube.get("Notebook", "stale", "ns")
+        assert annotations_of(nb).get(nbapi.WARM_CLAIMED_ANNOTATION) \
+            is None
+        # other's adopted pod survives the stale claimer's stop.
+        pod = await s.kube.get_or_none("Pod", victim, "ns")
+        assert pod is not None
+        assert (get_meta(pod).get("labels") or {}).get(
+            nbapi.NOTEBOOK_NAME_LABEL) == "other"
+
+
+async def test_claim_not_blocked_after_slot_pod_name_reuse():
+    """claim() hands the guard to the durable claim annotation once the
+    adoption lands: after the adopted pod dies and the pool drains to
+    zero, the replenisher legitimately reuses slot p0 — a leaked local
+    claimed mark would make the reborn pod unclaimable forever
+    (permanent cold fallback on a size-1 pool)."""
+    kube = FakeKube()
+    register_all(kube)
+    wp = WarmPoolManager(
+        kube, WarmPoolOptions(spec="ns/img:latest@v5e:2x2:1",
+                              replenish_seconds=0.05),
+        registry=Registry())
+    sim = PodSimulator(kube)
+    await sim.start()
+    try:
+        async def fill():
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                await wp.replenish()
+                pods = await wp._claimable_pods(wp.pools[0])
+                if pods:
+                    return pods[0]
+                await asyncio.sleep(0.02)
+            raise AssertionError("pool never filled")
+
+        first = await fill()
+        ms = nbapi.multi_slice_of(warm_nb("a"))
+        await kube.create("Notebook", warm_nb("a"))
+        adopted = await wp.claim(await kube.get("Notebook", "a", "ns"), ms)
+        assert adopted is not None
+        # The adopted pod dies with its notebook; the pool is empty and
+        # slot p0's pod name is free again.
+        await kube.delete("Pod", name_of(adopted), "ns")
+        reborn = await fill()
+        assert name_of(reborn) == name_of(first)
+        await kube.create("Notebook", warm_nb("b"))
+        assert await wp.claim(
+            await kube.get("Notebook", "b", "ns"), ms) is not None
+    finally:
+        await sim.stop()
+        kube.close_watches()
+
+
+async def test_removed_pool_slots_torn_down_across_restart():
+    """Slots of a pool dropped from the spec while the manager was DOWN
+    are discovered from their pool label and torn down — an in-memory
+    diff of previous replenish passes knows nothing about them, and
+    their pods would otherwise squat on chips forever with no ledger
+    reservation."""
+    kube = FakeKube()
+    register_all(kube)
+    sim = PodSimulator(kube)
+    await sim.start()
+    try:
+        old = WarmPoolManager(
+            kube, WarmPoolOptions(spec="ns/img-old:v1@v5e:2x2:2",
+                                  replenish_seconds=0.05),
+            registry=Registry())
+        for _ in range(200):
+            await old.replenish()
+            if len(await old._slots(old.pools[0])) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        old_slug = old.pools[0].slug
+        # "Restart": a fresh manager with a different spec and no memory
+        # of the old pool.
+        new = WarmPoolManager(
+            kube, WarmPoolOptions(spec="ns/img-new:v2@v5e:2x2:1",
+                                  replenish_seconds=0.05),
+            registry=Registry())
+        await new.replenish()
+        stale = await kube.list(
+            "StatefulSet", "ns",
+            label_selector={"matchLabels": {
+                keys.TPU_WARM_POOL_LABEL: old_slug}})
+        assert stale == []
+    finally:
+        await sim.stop()
+        kube.close_watches()
+
+
+# ---- ledger reservations + preemption ------------------------------------------
+
+
+async def test_warm_reservations_register_with_ledger():
+    async with Stack(fleet="pool-a=v5e:2x2:4") as s:
+        assert await s.pool_ready(2)
+        warm_allocs = [a for a in
+                       s.sched.policy.ledger.allocations.values()
+                       if a.workload == "warmpool"]
+        assert len(warm_allocs) == 2
+        assert all(a.chips == 4 for a in warm_allocs)
+
+
+async def test_reservation_preempted_before_any_real_gang():
+    """Acceptance criterion: under pressure the scheduler reclaims
+    warm-pool chips FIRST — instantly, before any real gang is drained
+    or preempted — even with migration (deferred preemption) on."""
+    async with Stack(fleet="pool-a=v5e:2x2:3",
+                     warm="ns/img:latest@v5e:2x2:1",
+                     migration=True) as s:
+        assert await s.pool_ready(1)
+        # Two real gangs take the other 2 slices; mark them idle so they
+        # WOULD be preemptible — the warm slot must still die first.
+        for name in ("real-1", "real-2"):
+            await s.kube.create("Notebook", warm_nb(
+                name, image="other:latest"))
+            await s.ready(name)
+        await s.kube.patch(
+            "Notebook", "real-1",
+            {"metadata": {"annotations": {
+                nbapi.LAST_ACTIVITY_ANNOTATION: fmt_iso(
+                    time.time() - 7200)}}}, "ns")
+        real_before = {k for k, a in
+                       s.sched.policy.ledger.allocations.items()
+                       if a.workload == "notebook"}
+        # Fleet full (2 real + 1 warm slot). A third real gang arrives:
+        # its chips must come from the warm reserve, same pass, no drain.
+        await s.kube.create("Notebook", warm_nb(
+            "real-3", image="other:latest"))
+        await s.ready("real-3")
+        allocs = s.sched.policy.ledger.allocations
+        assert all(k in allocs for k in real_before)
+        assert not any(a.draining for a in allocs.values())
+        assert int(s.warmpool.m_reclaimed.labels().value) >= 1
+        assert s.sched.policy.ledger.violations == 0
+        # Pool cannot refill (0 free) — and that is NOT an invariant
+        # violation; pressure legitimately ate the reserve.
+        info = await s.warmpool.debug_info()
+        assert info["pools"][0]["ready"] == 0
+
+
+async def test_pool_shrinks_and_grows_with_spec():
+    """Replenisher convergence: spec shrink tears down excess slots and
+    releases their reservations."""
+    kube = FakeKube()
+    register_all(kube)
+    reg = Registry()
+    sched = TpuFleetScheduler(
+        kube, SchedulerOptions(fleet_spec="pool-a=v5e:2x2:4"),
+        registry=reg)
+    wp = WarmPoolManager(
+        kube, WarmPoolOptions(spec="ns/img:latest@v5e:2x2:3",
+                              replenish_seconds=0.05),
+        scheduler=sched, registry=reg)
+    sim = PodSimulator(kube)
+    await sim.start()
+    try:
+        for _ in range(100):
+            await wp.replenish()
+            if len(await wp._slots(wp.pools[0])) >= 3:
+                break
+            await asyncio.sleep(0.02)
+        assert len(await wp._slots(wp.pools[0])) == 3
+        wp._pools = (WarmPoolSpec("ns", "img:latest", "v5e", "2x2", 1),)
+        await wp.replenish()
+        assert len(await wp._slots(wp.pools[0])) == 1
+        warm_allocs = [a for a in sched.policy.ledger.allocations.values()
+                       if a.workload == "warmpool"]
+        assert len(warm_allocs) == 1
+    finally:
+        await sim.stop()
+        kube.close_watches()
+
+
+async def test_slot_indices_never_collide_with_adopted_pods():
+    """Every slot claimed before a single replenish tick (burst /
+    restart-while-claimed): the adopted pods keep the old slot POD
+    names, so the rebuilt slots must take fresh indices — reusing p0
+    would create a StatefulSet whose pod name is already taken and
+    wedge the pool at 0 ready forever."""
+    kube = FakeKube()
+    register_all(kube)
+    reg = Registry()
+    wp = WarmPoolManager(
+        kube, WarmPoolOptions(spec="ns/img:latest@v5e:2x2:2",
+                              replenish_seconds=0.05),
+        registry=reg)
+    sim = PodSimulator(kube)
+    await sim.start()
+    try:
+        for _ in range(200):
+            await wp.replenish()
+            if len(await wp._claimable_pods(wp.pools[0])) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        ms = nbapi.multi_slice_of(warm_nb("a"))
+        for name in ("a", "b"):
+            await kube.create("Notebook", warm_nb(name))
+            nb = await kube.get("Notebook", name, "ns")
+            assert await wp.claim(nb, ms) is not None
+        # Both slots consumed; their pods live on under p0-0/p1-0.
+        assert await wp._slots(wp.pools[0]) == []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            await wp.replenish()
+            if len(await wp._claimable_pods(wp.pools[0])) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        fresh = sorted(name_of(p)
+                       for p in await wp._claimable_pods(wp.pools[0]))
+        assert len(fresh) == 2, fresh
+        adopted = {f"{wp.pools[0].slug}-p0-0", f"{wp.pools[0].slug}-p1-0"}
+        assert not (set(fresh) & adopted), fresh
+    finally:
+        await sim.stop()
+        kube.close_watches()
+
+
+# ---- JWA messages --------------------------------------------------------------
+
+
+def test_jwa_starting_from_warm_pool_message():
+    from kubeflow_tpu.web.common.status import process_status
+
+    nb = warm_nb("nb")
+    nb["metadata"]["creationTimestamp"] = "2026-01-01T00:00:00Z"
+    nb["status"] = {
+        "readyReplicas": 0,
+        "tpu": {"hosts": 1, "warmPool": {"claimed": True,
+                                         "claimedInSec": 1.5}},
+    }
+    s = process_status(nb)
+    assert s.phase == "waiting"
+    assert s.message == "Starting from warm pool (claimed in 1.5s)"
+    # Ready outranks the warm message.
+    nb["status"]["readyReplicas"] = 1
+    nb["status"]["containerState"] = {"running": {}}
+    nb["status"]["conditions"] = [{"type": "Running", "status": "True"}]
+    assert process_status(nb).phase == "ready"
+
+
+def test_jwa_warming_pool_replenishing_message():
+    from kubeflow_tpu.web.common.status import process_status
+
+    nb = warm_nb("nb")
+    nb["metadata"]["creationTimestamp"] = "2026-01-01T00:00:00Z"
+    nb["status"] = {
+        "readyReplicas": 0,
+        "tpu": {"hosts": 1,
+                "warmPool": {"replenishing": {"ready": 1, "size": 4}}},
+    }
+    s = process_status(nb)
+    assert s.phase == "waiting"
+    assert s.message == \
+        "Warming pool replenishing (1/4 ready); starting cold"
+
+
+# ---- timeline states -----------------------------------------------------------
+
+
+def test_derive_lifecycle_warm_states():
+    base = dict(sched_state="Admitted", mig_state=None, stopped=False,
+                ready=0, want_hosts=1)
+    assert timeline_mod.derive_lifecycle(**base) == timeline_mod.ADMITTED
+    assert timeline_mod.derive_lifecycle(**base, warm="claimed") \
+        == timeline_mod.CLAIMED
+    assert timeline_mod.derive_lifecycle(**base, warm="warming") \
+        == timeline_mod.WARMING
+    # Ready and park verdicts outrank the warm refinement.
+    assert timeline_mod.derive_lifecycle(
+        **{**base, "ready": 1}, warm="claimed") == timeline_mod.READY
+    assert timeline_mod.derive_lifecycle(
+        **{**base, "stopped": True}, warm="claimed") \
+        == timeline_mod.STOPPED
+
+
+# ---- compile-cache satellite ---------------------------------------------------
+
+
+def test_compilecache_setup_failure_counted_and_flagged(tmp_path):
+    from kubeflow_tpu.utils import compilecache
+
+    before = compilecache.setup_failures_total()
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")          # a FILE where the dir's parent
+    target = blocker / "cache"       # should be → makedirs raises
+    d = compilecache.enable_persistent_cache(str(target))
+    assert d == str(target)
+    assert compilecache.setup_failures_total() == before + 1
+    assert compilecache.cache_dir_ready(str(target)) is False
+    ok = tmp_path / "ok"
+    assert compilecache.cache_dir_ready(str(ok)) is False
+    ok.mkdir()
+    assert compilecache.cache_dir_ready(str(ok)) is True
+
+
+def test_compilecache_seed_and_hit_miss_counters(tmp_path):
+    from kubeflow_tpu.utils import compilecache
+
+    seed = tmp_path / "seed"
+    cache = tmp_path / "cache"
+    seed.mkdir()
+    cache.mkdir()
+    (seed / "prog-a").write_bytes(b"xla-a")
+    (seed / "prog-b").write_bytes(b"xla-b")
+    (cache / "prog-b").write_bytes(b"already")
+    out = compilecache.seed_cache(str(seed), str(cache))
+    assert out == {"seeded": 1, "skipped": 1, "ready": True}
+    assert (cache / "prog-a").read_bytes() == b"xla-a"
+    assert (cache / "prog-b").read_bytes() == b"already"  # never clobber
+    # manifest.json pins the subset
+    cache2 = tmp_path / "cache2"
+    cache2.mkdir()
+    (seed / "manifest.json").write_text('["prog-a"]')
+    out = compilecache.seed_cache(str(seed), str(cache2))
+    assert out["seeded"] == 1 and not (cache2 / "prog-b").exists()
+    # unconfigured seed dir is a clean no-op
+    assert compilecache.seed_cache(None, str(cache2))["seeded"] == 0
+    # hit/miss classification off entry counts
+    stats0 = compilecache.cache_stats()
+    assert compilecache.note_compile(3, 3) == "hit"
+    assert compilecache.note_compile(3, 4) == "miss"
+    stats1 = compilecache.cache_stats()
+    assert stats1["hits"] == stats0["hits"] + 1
+    assert stats1["misses"] == stats0["misses"] + 1
+
+
+# ---- SDK warm-idle loop --------------------------------------------------------
+
+
+@pytest.fixture
+def jax_cache_config_guard():
+    """warm_idle flips jax's persistent-cache config at a tmp dir; put
+    it back so later compiling tests don't write into a deleted path."""
+    import jax
+
+    saved = {
+        "dir": jax.config.jax_compilation_cache_dir,
+        "min_secs": jax.config.jax_persistent_cache_min_compile_time_secs,
+        "min_bytes": jax.config.jax_persistent_cache_min_entry_size_bytes,
+    }
+    yield
+    jax.config.update("jax_compilation_cache_dir", saved["dir"])
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", saved["min_secs"])
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes", saved["min_bytes"])
+
+
+def test_sdk_warm_idle_returns_claim(tmp_path, monkeypatch,
+                                     jax_cache_config_guard):
+    from kubeflow_tpu import sdk
+    from kubeflow_tpu.utils import compilecache
+
+    monkeypatch.setenv(compilecache.ENV_VAR, str(tmp_path / "cache"))
+    seen = {"polls": 0}
+
+    def fetch_claim():
+        seen["polls"] += 1
+        return "ns/nb/7" if seen["polls"] >= 3 else None
+
+    claim = sdk.warm_idle(fetch_claim=fetch_claim, init_devices=False,
+                          poll_seconds=0.0, _sleep=lambda _t: None)
+    assert claim == "ns/nb/7"
+    assert seen["polls"] == 3
+    # max_wait bounds an unclaimed park (tests/probes).
+    assert sdk.warm_idle(fetch_claim=lambda: None, init_devices=False,
+                         poll_seconds=0.0, max_wait=0.0,
+                         _sleep=lambda _t: None) is None
+
+
+def test_sdk_downward_claim_file_parse(tmp_path, monkeypatch,
+                                       jax_cache_config_guard):
+    from kubeflow_tpu import sdk
+    from kubeflow_tpu.utils import compilecache
+
+    monkeypatch.setenv(compilecache.ENV_VAR, str(tmp_path / "cache"))
+    f = tmp_path / "annotations"
+    f.write_text('other.io/k="v"\n'
+                 f'{keys.TPU_WARM_CLAIM}="ns/nb/42"\n')
+    monkeypatch.setenv(sdk.WARM_CLAIM_FILE_ENV, str(f))
+    claim = sdk.warm_idle(init_devices=False, poll_seconds=0.0,
+                          max_wait=10.0, _sleep=lambda _t: None)
+    assert claim == "ns/nb/42"
+    assert sdk._read_downward_claim(str(tmp_path / "missing")) is None
+
+
+# ---- static-analysis fixtures (warm-pool-contract) -----------------------------
+
+
+def test_warm_pool_contract_pass_fires_on_bare_relabel(tmp_path):
+    import textwrap
+
+    from ci.analysis.core import load_project, run_passes
+
+    # A claim() that skips the CAS and a gate that re-labels directly.
+    (tmp_path / "kubeflow_tpu/controllers").mkdir(parents=True)
+    (tmp_path / "kubeflow_tpu/controllers/warmpool.py").write_text(
+        textwrap.dedent("""\
+        class WarmPoolManager:
+            async def claim(self, nb, ms):
+                pod = await self._pick()
+                return await self._adopt(nb, pod)
+
+            async def _adopt(self, nb, pod):
+                return pod
+
+            async def _replenish_pool(self, pool):
+                pass
+        """))
+    project = load_project(
+        root=str(tmp_path),
+        paths=["kubeflow_tpu/controllers/warmpool.py"])
+    report = run_passes(project, select={"warm-pool"})
+    rules = [f.rule for f in report.findings]
+    assert "warm-pool-contract" in rules
+    messages = " ".join(f.message for f in report.findings)
+    assert "_cas_claim" in messages      # CAS gone
+    assert "_reserve" in messages        # ledger registration gone
+
+
+def test_warm_pool_contract_pass_clean_on_real_tree():
+    from ci.analysis.core import load_project, run_passes
+
+    project = load_project(paths=[
+        "kubeflow_tpu/controllers/warmpool.py",
+        "kubeflow_tpu/controllers/notebook.py",
+        "kubeflow_tpu/scheduler/runtime.py",
+        "kubeflow_tpu/scheduler/policy.py",
+    ])
+    report = run_passes(project, select={"warm-pool"})
+    assert [f.rule for f in report.findings] == []
