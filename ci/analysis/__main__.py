@@ -19,6 +19,7 @@ from ci.analysis.core import (
     load_baseline,
     load_project,
     run_passes,
+    to_sarif,
     write_baseline,
 )
 
@@ -44,6 +45,17 @@ def main(argv: list[str] | None = None) -> int:
                         help="run only these passes / rule ids")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
+    parser.add_argument("--sarif", metavar="FILE",
+                        help="write SARIF 2.1.0 (github/codeql-action/"
+                             "upload-sarif annotates PR diffs with it)")
+    parser.add_argument("--shared-state-report", metavar="FILE",
+                        help="write the singleton shared-state inventory "
+                             "JSON (the pre-sharding audit artifact)")
+    parser.add_argument("--timings", action="store_true",
+                        help="print per-pass wall time")
+    parser.add_argument("--max-seconds", type=float, metavar="N",
+                        help="exit 1 if the passes took longer than N "
+                             "seconds (the CI runtime gate)")
     args = parser.parse_args(argv)
 
     import ci.analysis.passes  # noqa: F401 — register before listing
@@ -85,6 +97,23 @@ def main(argv: list[str] | None = None) -> int:
         with open(args.json, "w", encoding="utf-8") as fh:
             json.dump(report.to_json(), fh, indent=2)
             fh.write("\n")
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(to_sarif(report), fh, indent=2)
+            fh.write("\n")
+    if args.shared_state_report:
+        from ci.analysis.passes.awaitrace import shared_state_inventory
+
+        with open(args.shared_state_report, "w", encoding="utf-8") as fh:
+            json.dump(shared_state_inventory(project), fh, indent=2)
+            fh.write("\n")
+
+    total_sec = sum(report.timings.values())
+    if args.timings:
+        for name, sec in sorted(report.timings.items(),
+                                key=lambda kv: -kv[1]):
+            print(f"ci.analysis: timing {name}: {sec:.3f}s")
+        print(f"ci.analysis: timing TOTAL: {total_sec:.3f}s")
 
     for f in report.findings:
         print(f"ci.analysis: {f.render()}", file=sys.stderr)
@@ -97,6 +126,13 @@ def main(argv: list[str] | None = None) -> int:
                f"{len(report.suppressed)} suppression(s), "
                f"{len(report.baselined)} baselined")
     print(summary, file=sys.stderr if live else sys.stdout)
+    if args.max_seconds is not None and total_sec > args.max_seconds:
+        print(f"ci.analysis: runtime gate FAILED: passes took "
+              f"{total_sec:.1f}s > {args.max_seconds:.1f}s budget — a "
+              "pass re-walking the tree instead of sharing the parsed "
+              "Project/callgraph is the usual culprit "
+              "(docs/static-analysis.md)", file=sys.stderr)
+        return 1
     return 1 if live else 0
 
 

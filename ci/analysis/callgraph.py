@@ -1,0 +1,908 @@
+"""Interprocedural layer: symbol table, call graph, effect summaries.
+
+PR 12's passes are deliberately intraprocedural — per-file AST visitors
+that cannot see that two controllers patch the same ``keys.py``
+annotation, that a read-modify-write of ``self._pools`` spans an
+``await``, or that a swallowed ``ApiError`` three calls below a
+reconciler breaks the PR 7 errors-re-raise-into-backoff contract. This
+module is the shared substrate the ISSUE 15 pass families consume:
+
+- a **symbol table** (:class:`ProjectIndex`): every top-level function /
+  class method in the scan set, per-module import aliases, and per-class
+  ``self.<attr> = ProjectClass(...)`` attribute types;
+- a **call graph** with same-package resolution: bare names, ``from X
+  import f``, ``module.f(...)``, ``self.m()``/``cls.m()`` (walking
+  project-resolvable base classes), and ``self.attr.m()`` through the
+  attribute-type map. Unresolvable calls are *recorded*, never guessed —
+  passes treat them conservatively (a function with an unresolved caller
+  is never assumed lock-held; reachability only ever under-approximates
+  "safe", not "flagged");
+- a **key registry**: ``api/keys.py`` constants plus the project-wide
+  alias fixpoint (``nbapi.DRAIN_REQUESTED_ANNOTATION`` →
+  ``NOTEBOOK_DRAIN_REQUESTED``), so a pass can resolve any expression to
+  the canonical wire-contract key it names;
+- per-function **effect summaries**: annotation keys written (dict-
+  literal patch shapes, subscript stores, ``pop``/``setdefault``) and
+  read, ``self.*`` attribute reads/mutations in source order with the
+  ``await``s crossed between them, ``asyncio``-lock regions, and every
+  ``except`` handler's surface behavior (raises / returns a value /
+  calls / assigns) for the raise-path contract.
+
+Everything is computed once per :class:`~ci.analysis.core.Project` and
+memoized on it (``get_index``), so the four ISSUE 15 passes — and any
+later one — share one parse and one graph instead of re-walking the
+tree per pass (the <30 s CI runtime gate depends on this).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ci.analysis.core import Project, SourceFile, call_name
+
+KEYS_MODULE = "kubeflow_tpu/api/keys.py"
+
+# Mutating container methods: calling one of these on ``self.X`` is a
+# write to the shared attribute, not a read.
+MUTATORS = {
+    "append", "add", "discard", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "extend", "insert", "appendleft",
+}
+# Context managers that suspend/guard: an ``async with`` whose
+# expression names one of these (or anything lock-ish) marks the region.
+_LOCKISH = ("lock", "sem", "mutex")
+
+
+def _path_candidates(dotted: str) -> tuple[str, str]:
+    base = dotted.replace(".", "/")
+    return (base + ".py", base + "/__init__.py")
+
+
+def _mentions_lockish(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and any(tok in name.lower() for tok in _LOCKISH):
+            return True
+    return False
+
+
+# ---- per-function facts ------------------------------------------------------
+
+
+@dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    name: str                   # trailing callee name (render aid)
+    line: int
+    callee: str | None          # resolved qual, or None (unresolved)
+    in_lock: bool               # inside an `async with <lock>` region
+
+
+@dataclass
+class AttrEvent:
+    """One source-ordered touch of a ``self.<attr>``: ``read``,
+    ``mutate``, or a suspension point (``await``, attr='')."""
+
+    kind: str                   # "read" | "mutate" | "await"
+    attr: str
+    line: int
+    col: int
+    in_lock: bool
+    lock_region: int            # innermost async-lock region id (0 = none)
+    loops: tuple[int, ...]      # enclosing loop ids, outermost first
+
+
+@dataclass
+class CatchInfo:
+    """One ``except`` handler's surface behavior."""
+
+    types: tuple[str, ...]      # caught class names; () = bare except
+    line: int
+    has_raise: bool
+    has_return: bool            # return WITH a value (sentinel contract)
+    has_call: bool              # logs / counters / events
+    has_assign: bool            # stated fallback value
+
+
+@dataclass
+class KeyWrite:
+    const: str                  # canonical keys.py constant name
+    line: int
+    delete: bool                # explicit `: None` merge-patch delete
+
+
+@dataclass
+class FunctionInfo:
+    qual: str                   # "path::Class.name" or "path::name"
+    path: str
+    name: str
+    cls: str | None
+    node: ast.AST
+    is_async: bool
+    line: int
+    calls: list[CallSite] = field(default_factory=list)
+    attr_events: list[AttrEvent] = field(default_factory=list)
+    catches: list[CatchInfo] = field(default_factory=list)
+    key_writes: list[KeyWrite] = field(default_factory=list)
+    key_reads: set = field(default_factory=set)
+    has_unresolved_calls: bool = False
+    loops_with_await: set = field(default_factory=set)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    bases: list[str]            # raw base expressions, dotted-rendered
+    methods: dict = field(default_factory=dict)     # name → FunctionInfo
+    # attr → class qual ("path::Class") from `self.attr = ProjectClass(...)`
+    attr_types: dict = field(default_factory=dict)
+    # attrs assigned a mutable container in __init__ ({}, [], set(), ...)
+    container_attrs: set = field(default_factory=set)
+
+
+# ---- the index ---------------------------------------------------------------
+
+
+class ProjectIndex:
+    """Symbol table + call graph + key registry for one Project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        # path → {alias → dotted module} and {name → (module, orig_name)}
+        self.module_imports: dict[str, dict[str, str]] = {}
+        self.from_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        # path → {fn name → FunctionInfo} (top-level defs only)
+        self.functions: dict[str, dict[str, FunctionInfo]] = {}
+        # path → {class name → ClassInfo}
+        self.classes: dict[str, dict[str, ClassInfo]] = {}
+        # qual → FunctionInfo (every known function incl. methods)
+        self.by_qual: dict[str, FunctionInfo] = {}
+        # keys.py: constant name → key string
+        self.key_consts: dict[str, str] = {}
+        # path → {module-level local name → canonical key const}
+        self.key_aliases: dict[str, dict[str, str]] = {}
+        # callee qual → list[(caller qual, CallSite)]
+        self.callers: dict[str, list[tuple[str, CallSite]]] = {}
+        # functions whose IDENTITY escapes — referenced as a value
+        # (callback registration, `self._cb = self._m` aliasing) rather
+        # than called. Their real call sites are unknowable, so lock
+        # propagation must never vouch for them.
+        self.value_refs: set[str] = set()
+        self._build()
+
+    # ---- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        for sf in self.project.files:
+            if sf.tree is None:
+                continue
+            self._index_imports(sf)
+            self._index_defs(sf)
+        self._load_key_consts()
+        self._resolve_key_aliases()
+        for sf in self.project.files:
+            if sf.tree is None:
+                continue
+            self._summarize_file(sf)
+        for fn in self.by_qual.values():
+            for site in fn.calls:
+                if site.callee is not None:
+                    self.callers.setdefault(site.callee, []).append(
+                        (fn.qual, site))
+
+    def _index_imports(self, sf: SourceFile) -> None:
+        mods: dict[str, str] = {}
+        froms: dict[str, tuple[str, str]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mods[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    local = a.asname or a.name
+                    # `from pkg import mod` is a module alias when
+                    # pkg.mod is a scanned file, a symbol import otherwise.
+                    sub = f"{node.module}.{a.name}"
+                    if self._project_path(sub) is not None:
+                        mods[local] = sub
+                    else:
+                        froms[local] = (node.module, a.name)
+        self.module_imports[sf.path] = mods
+        self.from_imports[sf.path] = froms
+
+    def _project_path(self, dotted: str) -> str | None:
+        for cand in _path_candidates(dotted):
+            if self.project.get(cand) is not None:
+                return cand
+        return None
+
+    def _index_defs(self, sf: SourceFile) -> None:
+        fns: dict[str, FunctionInfo] = {}
+        classes: dict[str, ClassInfo] = {}
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FunctionInfo(
+                    qual=f"{sf.path}::{node.name}", path=sf.path,
+                    name=node.name, cls=None, node=node,
+                    is_async=isinstance(node, ast.AsyncFunctionDef),
+                    line=node.lineno)
+                fns[node.name] = info
+                self.by_qual[info.qual] = info
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    name=node.name, path=sf.path,
+                    bases=[_dotted(b) for b in node.bases])
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        info = FunctionInfo(
+                            qual=f"{sf.path}::{node.name}.{item.name}",
+                            path=sf.path, name=item.name, cls=node.name,
+                            node=item,
+                            is_async=isinstance(item, ast.AsyncFunctionDef),
+                            line=item.lineno)
+                        ci.methods[item.name] = info
+                        self.by_qual[info.qual] = info
+                classes[node.name] = ci
+        self.functions[sf.path] = fns
+        self.classes[sf.path] = classes
+
+    def _load_key_consts(self) -> None:
+        sf = self.project.get(KEYS_MODULE)
+        if sf is None or sf.tree is None:
+            return
+        for node in sf.tree.body:
+            target, value = _module_assign(node)
+            if target and isinstance(value, ast.Constant) \
+                    and isinstance(value.value, str):
+                self.key_consts[target] = value.value
+
+    def _resolve_key_aliases(self) -> None:
+        """Fixpoint over module-level ``LOCAL = <key ref>`` re-export
+        chains (keys.py → api/notebook.py → scheduler/runtime.py, ...)."""
+        for sf in self.project.files:
+            self.key_aliases.setdefault(sf.path, {})
+        changed = True
+        while changed:
+            changed = False
+            for sf in self.project.files:
+                if sf.tree is None:
+                    continue
+                aliases = self.key_aliases[sf.path]
+                for node in sf.tree.body:
+                    target, value = _module_assign(node)
+                    if not target or target in aliases or value is None:
+                        continue
+                    const = self.resolve_key(sf.path, value)
+                    if const is not None:
+                        aliases[target] = const
+                        changed = True
+
+    # ---- key resolution ------------------------------------------------------
+
+    def resolve_key(self, path: str, node: ast.expr) -> str | None:
+        """Canonical keys.py constant named by ``node`` in ``path``'s
+        namespace, or None. Handles ``keys.NOTEBOOK_X``, re-export
+        attributes (``nbapi.DRAIN_REQUESTED_ANNOTATION``), ``from m
+        import CONST``, and module-local aliases."""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name):
+            mod = self.module_imports.get(path, {}).get(node.value.id)
+            if mod is not None:
+                target = self._project_path(mod)
+                if target == KEYS_MODULE:
+                    return node.attr if node.attr in self.key_consts \
+                        else None
+                if target is not None:
+                    return self.key_aliases.get(target, {}).get(node.attr)
+            return None
+        if isinstance(node, ast.Name):
+            local = self.key_aliases.get(path, {}).get(node.id)
+            if local is not None:
+                return local
+            if path == KEYS_MODULE and node.id in self.key_consts:
+                return node.id
+            imp = self.from_imports.get(path, {}).get(node.id)
+            if imp is not None:
+                target = self._project_path(imp[0])
+                if target == KEYS_MODULE:
+                    return imp[1] if imp[1] in self.key_consts else None
+                if target is not None:
+                    return self.key_aliases.get(target, {}).get(imp[1])
+        return None
+
+    # ---- call resolution -----------------------------------------------------
+
+    def _resolve_call(self, path: str, cls: ClassInfo | None,
+                      call: ast.Call) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self._resolve_bare(path, func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id in ("self", "cls") and cls is not None:
+                m = self._resolve_method(path, cls, func.attr)
+                if m is not None:
+                    return m
+                return None
+            mod = self.module_imports.get(path, {}).get(recv.id)
+            if mod is not None:
+                target = self._project_path(mod)
+                if target is not None:
+                    fn = self.functions.get(target, {}).get(func.attr)
+                    return fn.qual if fn is not None else None
+            return None
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id in ("self", "cls") and cls is not None:
+            # self.attr.m() through the attribute-type map
+            target_cls = cls.attr_types.get(recv.attr)
+            if target_cls is not None:
+                tpath, _, tname = target_cls.partition("::")
+                ci = self.classes.get(tpath, {}).get(tname)
+                if ci is not None:
+                    return self._resolve_method(tpath, ci, func.attr)
+        return None
+
+    def _resolve_bare(self, path: str, name: str) -> str | None:
+        fn = self.functions.get(path, {}).get(name)
+        if fn is not None:
+            return fn.qual
+        imp = self.from_imports.get(path, {}).get(name)
+        if imp is not None:
+            target = self._project_path(imp[0])
+            if target is not None:
+                tfn = self.functions.get(target, {}).get(imp[1])
+                if tfn is not None:
+                    return tfn.qual
+                # constructor call: edge to __init__ when it exists
+                ci = self.classes.get(target, {}).get(imp[1])
+                if ci is not None and "__init__" in ci.methods:
+                    return ci.methods["__init__"].qual
+        ci = self.classes.get(path, {}).get(name)
+        if ci is not None and "__init__" in ci.methods:
+            return ci.methods["__init__"].qual
+        return None
+
+    def _resolve_method(self, path: str, cls: ClassInfo,
+                        name: str, _depth: int = 0) -> str | None:
+        if name in cls.methods:
+            return cls.methods[name].qual
+        if _depth > 5:
+            return None
+        for base in cls.bases:
+            bci = self._resolve_class_ref(path, base)
+            if bci is not None:
+                found = self._resolve_method(bci.path, bci, name,
+                                             _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def _resolve_class_ref(self, path: str, ref: str) -> ClassInfo | None:
+        """A dotted class reference (``Base``, ``mod.Base``) to its
+        ClassInfo, same-package only."""
+        head, _, tail = ref.partition(".")
+        if not tail:
+            ci = self.classes.get(path, {}).get(ref)
+            if ci is not None:
+                return ci
+            imp = self.from_imports.get(path, {}).get(ref)
+            if imp is not None:
+                target = self._project_path(imp[0])
+                if target is not None:
+                    return self.classes.get(target, {}).get(imp[1])
+            return None
+        mod = self.module_imports.get(path, {}).get(head)
+        if mod is not None:
+            target = self._project_path(mod)
+            if target is not None:
+                return self.classes.get(target, {}).get(tail)
+        return None
+
+    def resolve_class_name(self, path: str,
+                           node: ast.expr) -> ClassInfo | None:
+        """``ClassName(...)``-callee → ClassInfo, for attr typing."""
+        return self._resolve_class_ref(path, _dotted(node))
+
+    # ---- summaries -----------------------------------------------------------
+
+    def _summarize_file(self, sf: SourceFile) -> None:
+        # attribute types + container attrs first (methods need them)
+        for ci in self.classes.get(sf.path, {}).values():
+            for m in ci.methods.values():
+                self._collect_attr_types(sf.path, ci, m.node)
+        for fn in self.functions.get(sf.path, {}).values():
+            self._summarize_function(sf, fn, None)
+        for ci in self.classes.get(sf.path, {}).values():
+            for m in ci.methods.values():
+                self._summarize_function(sf, m, ci)
+        # module-level code (template constants with key writes)
+        mod_fn = FunctionInfo(
+            qual=f"{sf.path}::<module>", path=sf.path, name="<module>",
+            cls=None, node=sf.tree, is_async=False, line=1)
+        self._collect_keys_shallow(sf, mod_fn)
+        self.by_qual[mod_fn.qual] = mod_fn
+
+    def _collect_attr_types(self, path: str, ci: ClassInfo,
+                            fn_node: ast.AST) -> None:
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t, v = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                t, v = node.target, node.value
+            else:
+                continue
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+                ci.container_attrs.add(t.attr)
+            elif isinstance(v, ast.Call):
+                cn = call_name(v)
+                if cn in ("dict", "list", "set", "defaultdict",
+                          "OrderedDict", "deque", "Counter"):
+                    ci.container_attrs.add(t.attr)
+                else:
+                    target = self.resolve_class_name(path, v.func)
+                    if target is not None:
+                        ci.attr_types[t.attr] = \
+                            f"{target.path}::{target.name}"
+
+    def _collect_keys_shallow(self, sf: SourceFile,
+                              fn: FunctionInfo) -> None:
+        """Key writes in module-level statements only (skip defs —
+        those get their own summaries)."""
+        for stmt in getattr(sf.tree, "body", []):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for node in ast.walk(stmt):
+                self._note_key_usage(sf.path, node, fn)
+
+    def _summarize_function(self, sf: SourceFile, fn: FunctionInfo,
+                            ci: ClassInfo | None) -> None:
+        collector = _BodyCollector(self, sf.path, ci)
+        for stmt in fn.node.body:
+            collector.visit_stmt(stmt)
+        fn.calls = collector.calls
+        # Collection order IS execution order (the collector visits
+        # assignment values before targets, awaits where they suspend);
+        # a positional re-sort would put a same-line store ahead of the
+        # await inside its value and hide the inline-await RMW.
+        fn.attr_events = collector.events
+        fn.catches = collector.catches
+        fn.key_writes = collector.key_writes
+        fn.key_reads = collector.key_reads
+        fn.has_unresolved_calls = collector.unresolved
+        fn.loops_with_await = collector.loops_with_await
+        # Escape analysis: a `self.<method>` read (not a call) or a
+        # bare-name load that resolves to a known function means its
+        # identity left through a callback/alias — unknown call sites.
+        if ci is not None:
+            for e in collector.events:
+                if e.kind == "read" and e.attr in ci.methods:
+                    self.value_refs.add(ci.methods[e.attr].qual)
+        for name in collector.name_loads:
+            qual = self._resolve_bare(sf.path, name)
+            if qual is not None:
+                self.value_refs.add(qual)
+
+    def _note_key_usage(self, path: str, node: ast.AST,
+                        fn: FunctionInfo) -> None:
+        """Dict-literal / subscript / method-call shaped reads+writes of
+        canonical keys (shared by module-level and in-function walks)."""
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if k is None:
+                    continue
+                const = self.resolve_key(path, k)
+                if const is not None:
+                    fn.key_writes.append(KeyWrite(
+                        const=const, line=k.lineno,
+                        delete=isinstance(v, ast.Constant)
+                        and v.value is None))
+        elif isinstance(node, ast.Subscript):
+            const = self.resolve_key(path, node.slice)
+            if const is None:
+                return
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                fn.key_writes.append(KeyWrite(
+                    const=const, line=node.lineno,
+                    delete=isinstance(node.ctx, ast.Del)))
+            else:
+                fn.key_reads.add(const)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) and node.args:
+            const = self.resolve_key(path, node.args[0])
+            if const is None:
+                return
+            if node.func.attr in ("pop", "setdefault", "__delitem__"):
+                fn.key_writes.append(KeyWrite(
+                    const=const, line=node.lineno,
+                    delete=node.func.attr == "pop"))
+            elif node.func.attr in ("get", "__contains__"):
+                fn.key_reads.add(const)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            const = self.resolve_key(path, node.left)
+            if const is not None:
+                fn.key_reads.add(const)
+
+    # ---- graph queries -------------------------------------------------------
+
+    def transitive_callers(self, qual: str) -> set[str]:
+        """Every function from which ``qual`` is reachable (excluding
+        itself unless it is in a cycle)."""
+        seen: set[str] = set()
+        frontier = [qual]
+        while frontier:
+            cur = frontier.pop()
+            for caller, _site in self.callers.get(cur, ()):
+                if caller not in seen:
+                    seen.add(caller)
+                    frontier.append(caller)
+        return seen
+
+    def reachable_from(self, quals) -> set[str]:
+        """Every function reachable from the given entry quals
+        (including the entries themselves)."""
+        seen: set[str] = set(quals)
+        frontier = list(quals)
+        while frontier:
+            fn = self.by_qual.get(frontier.pop())
+            if fn is None:
+                continue
+            for site in fn.calls:
+                if site.callee is not None and site.callee not in seen:
+                    seen.add(site.callee)
+                    frontier.append(site.callee)
+        return seen
+
+    def runs_on_loop(self) -> set[str]:
+        """Async-ness propagated along edges: every function reachable
+        from any ``async def`` — i.e. code that (absent explicit
+        threading) executes on the shared event loop."""
+        entries = [q for q, fn in self.by_qual.items() if fn.is_async]
+        return self.reachable_from(entries)
+
+    def always_called_under_lock(self, qual: str) -> bool:
+        """Conservative lock propagation: True only when the function
+        has at least one known caller, every known call edge is inside
+        an async-lock region (or a caller that itself qualifies), and
+        the function's identity never escapes as a value — a callback
+        registration or `self._cb = self._m` alias means call sites
+        exist the graph cannot see, so it disqualifies outright."""
+        return self._locked(qual, set())
+
+    def _locked(self, qual: str, visiting: set) -> bool:
+        if qual in self.value_refs:
+            return False  # aliased/registered: unknowable call sites
+        if qual in visiting:
+            return True  # cycle: judged by the other paths in
+        sites = self.callers.get(qual, [])
+        if not sites:
+            return False
+        visiting = visiting | {qual}
+        for caller, site in sites:
+            if site.in_lock:
+                continue
+            if not self._locked(caller, visiting):
+                return False
+        return True
+
+
+def _module_assign(node: ast.stmt) -> tuple[str | None, ast.expr | None]:
+    if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+            and isinstance(node.targets[0], ast.Name):
+        return node.targets[0].id, node.value
+    if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+        return node.target.id, node.value
+    return None, None
+
+
+def _dotted(node: ast.expr) -> str:
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+class _BodyCollector:
+    """Source-ordered walk of ONE function body (nested defs excluded —
+    they run later, off this activation) collecting calls, self-attr
+    events, awaits, lock regions, catches, and key usage."""
+
+    def __init__(self, index: ProjectIndex, path: str,
+                 cls: ClassInfo | None):
+        self.index = index
+        self.path = path
+        self.cls = cls
+        self.calls: list[CallSite] = []
+        self.events: list[AttrEvent] = []
+        self.catches: list[CatchInfo] = []
+        self.name_loads: set[str] = set()
+        self.key_writes: list[KeyWrite] = []
+        self.key_reads: set = set()
+        self.unresolved = False
+        self._lock_depth = 0
+        self._lock_region = 0
+        self._lock_region_seq = 0
+        self._loop_stack: list[int] = []
+        self._loop_seq = 0
+        self._loops_with_await: set[int] = set()
+        # key-usage sink shared with _note_key_usage (which takes a
+        # FunctionInfo-shaped holder)
+        self._fn = FunctionInfo(qual="", path=path, name="", cls=None,
+                                node=None, is_async=False, line=0)
+        self._fn.key_writes = self.key_writes
+        self._fn.key_reads = self.key_reads
+
+    # -- statement dispatch ----------------------------------------------------
+
+    def visit_stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            lockish = isinstance(node, ast.AsyncWith) and any(
+                _mentions_lockish(i.context_expr) for i in node.items)
+            for item in node.items:
+                self._visit_expr(item.context_expr)
+                if isinstance(node, ast.AsyncWith):
+                    self._suspend(item.context_expr)
+            if lockish:
+                self._lock_depth += 1
+                outer_region = self._lock_region
+                self._lock_region_seq += 1
+                self._lock_region = self._lock_region_seq
+            for stmt in node.body:
+                self.visit_stmt(stmt)
+            if lockish:
+                self._lock_depth -= 1
+                self._lock_region = outer_region
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            self._loop_seq += 1
+            loop_id = self._loop_seq
+            if isinstance(node, ast.AsyncFor):
+                self._loops_with_await.add(loop_id)
+            if isinstance(node, ast.While):
+                # A While's test re-evaluates every iteration (unlike a
+                # For's iter, which runs once before the first pass), so
+                # reads in the condition belong INSIDE the loop for
+                # cross-iteration RMW purposes: `while self._pending:`
+                # followed by an await in the body is the same race as
+                # reading self._pending in the body.
+                self._loop_stack.append(loop_id)
+                self._visit_expr(node.test)
+            else:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, ast.expr):
+                        self._visit_expr(child)
+                self._loop_stack.append(loop_id)
+            if isinstance(node, ast.AsyncFor):
+                # Recorded WITH the loop id on the stack: the async-for
+                # is this loop's per-iteration suspension, and the
+                # loop-variant RMW diagnostic reads its line.
+                self._suspend(node)
+            for stmt in node.body:
+                self.visit_stmt(stmt)
+            self._loop_stack.pop()
+            for stmt in node.orelse:
+                self.visit_stmt(stmt)
+            return
+        if isinstance(node, ast.Try):
+            for stmt in node.body:
+                self.visit_stmt(stmt)
+            for handler in node.handlers:
+                self.catches.append(_catch_info(handler))
+                for stmt in handler.body:
+                    self.visit_stmt(stmt)
+            for stmt in node.orelse + node.finalbody:
+                self.visit_stmt(stmt)
+            return
+        if isinstance(node, ast.If):
+            self._visit_expr(node.test)
+            for stmt in node.body + node.orelse:
+                self.visit_stmt(stmt)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            # Value BEFORE target — execution order. `self._x[k] =
+            # await f()` suspends before the store; visiting targets
+            # first would record mutate-then-await and hide the RMW
+            # from the await-race pass. An augmented self-attr target
+            # also READS first (`self._n += await f()` is a full
+            # read-await-mutate).
+            if isinstance(node, ast.AugAssign):
+                t = node.target
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    self._event("read", t.attr, t)
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute) \
+                        and isinstance(t.value.value, ast.Name) \
+                        and t.value.value.id == "self":
+                    self._event("read", t.value.attr, t)
+            if node.value is not None:
+                self._visit_expr(node.value)
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                self._visit_expr(t)
+            return
+        # leaf statements: walk expressions
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+            elif isinstance(child, ast.stmt):
+                self.visit_stmt(child)
+
+    # -- expression walk -------------------------------------------------------
+
+    def _visit_expr(self, node: ast.expr) -> None:
+        if isinstance(node, (ast.Lambda, ast.GeneratorExp)):
+            return
+        if isinstance(node, ast.Await):
+            self._visit_expr(node.value)
+            self._suspend(node)
+            return
+        self.index._note_key_usage(self.path, node, self._fn)
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Attribute) \
+                and isinstance(node.value.value, ast.Name) \
+                and node.value.value.id == "self":
+            # `self.X[k] = v` is a pure mutate of X — visiting the inner
+            # Attribute would also record a phantom read and pair every
+            # store with unrelated later mutations.
+            self._note_attr(node)
+            self._visit_expr(node.slice)
+            return
+        if isinstance(node, ast.Call):
+            self._note_call(node)
+            # The func receiver of self-shaped calls is handled in
+            # _note_call: `self.m(...)` must not read as an attr touch
+            # of `m`, and `self.X.m(...)` already produced X's event.
+            func = node.func
+            skip_func = (
+                # A bare callee name is CALL position, not a value
+                # reference — it must not feed the escape analysis.
+                isinstance(func, ast.Name)
+                or (isinstance(func, ast.Attribute)
+                    and ((isinstance(func.value, ast.Name)
+                          and func.value.id in ("self", "cls"))
+                         or (isinstance(func.value, ast.Attribute)
+                             and isinstance(func.value.value, ast.Name)
+                             and func.value.value.id == "self"))))
+            if not skip_func:
+                self._visit_expr(func)
+            for arg in node.args:
+                self._visit_expr(arg)
+            for kw in node.keywords:
+                self._visit_expr(kw.value)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            self.name_loads.add(node.id)
+        self._note_attr(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child)
+            elif isinstance(child, (ast.comprehension, ast.keyword)):
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.expr):
+                        self._visit_expr(sub)
+
+    def _suspend(self, node: ast.AST) -> None:
+        for loop_id in self._loop_stack:
+            self._loops_with_await.add(loop_id)
+        self.events.append(AttrEvent(
+            kind="await", attr="", line=node.lineno,
+            col=getattr(node, "col_offset", 0),
+            in_lock=self._lock_depth > 0,
+            lock_region=self._lock_region,
+            loops=tuple(self._loop_stack)))
+
+    def _note_call(self, node: ast.Call) -> None:
+        callee = self.index._resolve_call(self.path, self.cls, node)
+        if callee is None:
+            self.unresolved = True
+        self.calls.append(CallSite(
+            name=call_name(node), line=node.lineno, callee=callee,
+            in_lock=self._lock_depth > 0))
+        # self.X.mutator(...) is a write to X; self.X.other() a read
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Attribute) \
+                and isinstance(func.value.value, ast.Name) \
+                and func.value.value.id == "self":
+            self._event("mutate" if func.attr in MUTATORS else "read",
+                        func.value.attr, func.value)
+
+    def _note_attr(self, node: ast.expr) -> None:
+        # plain self.X loads/stores (not the receiver of self.m(...) —
+        # that shape never reaches here with Attribute ctx semantics:
+        # we record it in _note_call and the Load below is harmless)
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                self._event("mutate", node.attr, node)
+            else:
+                self._event("read", node.attr, node)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Attribute) \
+                and isinstance(node.value.value, ast.Name) \
+                and node.value.value.id == "self":
+            self._event("mutate", node.value.attr, node)
+
+    def _event(self, kind: str, attr: str, node: ast.AST) -> None:
+        self.events.append(AttrEvent(
+            kind=kind, attr=attr, line=node.lineno,
+            col=getattr(node, "col_offset", 0),
+            in_lock=self._lock_depth > 0,
+            lock_region=self._lock_region,
+            loops=tuple(self._loop_stack)))
+
+    @property
+    def loops_with_await(self) -> set[int]:
+        return self._loops_with_await
+
+
+def _catch_info(handler: ast.ExceptHandler) -> CatchInfo:
+    t = handler.type
+    if t is None:
+        types: tuple[str, ...] = ()
+    else:
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        names = []
+        for e in elts:
+            if isinstance(e, ast.Name):
+                names.append(e.id)
+            elif isinstance(e, ast.Attribute):
+                names.append(e.attr)
+        types = tuple(names)
+    has_raise = has_return = has_call = has_assign = False
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            has_raise = True
+        elif isinstance(node, ast.Return) and node.value is not None:
+            has_return = True
+        elif isinstance(node, ast.Call):
+            has_call = True
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                               ast.NamedExpr)):
+            has_assign = True
+    return CatchInfo(types=types, line=handler.lineno, has_raise=has_raise,
+                     has_return=has_return, has_call=has_call,
+                     has_assign=has_assign)
+
+
+def get_index(project: Project) -> ProjectIndex:
+    """The memoized ProjectIndex for this Project — built once, shared
+    by every pass (the analysis-runtime guardrail depends on this)."""
+    idx = getattr(project, "_interprocedural_index", None)
+    if idx is None:
+        idx = ProjectIndex(project)
+        project._interprocedural_index = idx
+    return idx

@@ -138,9 +138,15 @@ class SourceFile:
 
     def docstring_linenos(self) -> set[int]:
         """Lines covered by module/class/function docstrings — prose, not
-        code; the literal-registry passes skip them."""
+        code; the literal-registry passes skip them. Memoized: several
+        passes ask per file, and the answer never changes after load
+        (part of the parse-once runtime guardrail, ISSUE 15)."""
+        cached = getattr(self, "_docstring_linenos", None)
+        if cached is not None:
+            return cached
         covered: set[int] = set()
         if self.tree is None:
+            self._docstring_linenos = covered
             return covered
         for node in ast.walk(self.tree):
             if not isinstance(node, (ast.Module, ast.ClassDef,
@@ -152,6 +158,7 @@ class SourceFile:
                     and isinstance(body[0].value.value, str):
                 doc = body[0].value
                 covered.update(range(doc.lineno, (doc.end_lineno or doc.lineno) + 1))
+        self._docstring_linenos = covered
         return covered
 
 
@@ -245,6 +252,9 @@ class Report:
     findings: list[Finding]                 # live, unsuppressed, unbaselined
     suppressed: list[tuple[Finding, Suppression]]
     baselined: list[Finding]
+    # pass name → wall seconds for this run (--timings; the <30 s CI
+    # runtime gate reads the sum)
+    timings: dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
         return {
@@ -258,6 +268,8 @@ class Report:
                 "suppressed": len(self.suppressed),
                 "baselined": len(self.baselined),
             },
+            "timings_sec": {k: round(v, 4)
+                            for k, v in sorted(self.timings.items())},
         }
 
 
@@ -266,16 +278,21 @@ def run_passes(project: Project, select: set[str] | None = None,
     """Run every registered pass (or the ``select``ed ones), apply
     per-line suppressions, then the baseline filter, and finally flag
     bad/unused ignores."""
+    import time
+
     import ci.analysis.passes  # noqa: F401 — registers on import
 
     raw: list[Finding] = []
     ran_rules: set[str] = set()
+    timings: dict[str, float] = {}
     for p in REGISTRY.values():
         if select and p.name not in select \
                 and not (select & set(p.rules)):
             continue
         ran_rules.update(p.rules)
+        t0 = time.perf_counter()
         raw.extend(p.fn(project))
+        timings[p.name] = time.perf_counter() - t0
     for sf in project.files:
         if sf.parse_error is not None:
             raw.append(Finding(
@@ -328,7 +345,54 @@ def run_passes(project: Project, select: set[str] | None = None,
                 still_live.append(f)
         live = still_live
     live.sort(key=lambda f: (f.path, f.line, f.rule))
-    return Report(findings=live, suppressed=suppressed, baselined=baselined)
+    return Report(findings=live, suppressed=suppressed,
+                  baselined=baselined, timings=timings)
+
+
+def to_sarif(report: Report) -> dict:
+    """SARIF 2.1.0 for ``github/codeql-action/upload-sarif`` — findings
+    annotate PR diffs in the Files-changed view instead of living only
+    in a build-artifact JSON. Live findings only: suppressed/baselined
+    entries are deliberate states, not review comments."""
+    rules_seen: dict[str, dict] = {}
+    results = []
+    pass_of = all_rules()
+    for f in report.findings:
+        if f.rule not in rules_seen:
+            owner = pass_of.get(f.rule)
+            rules_seen[f.rule] = {
+                "id": f.rule,
+                "shortDescription": {"text": f.rule},
+                "helpUri": "https://github.com/kubeflow/kubeflow/blob/"
+                           "master/docs/static-analysis.md",
+                "properties": ({"pass": owner} if owner else {}),
+            }
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "%SRCROOT%"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ci.analysis",
+                "informationUri": "docs/static-analysis.md",
+                "rules": sorted(rules_seen.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "results": results,
+        }],
+    }
 
 
 def load_baseline(path: str) -> set[str]:

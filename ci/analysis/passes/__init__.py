@@ -8,11 +8,15 @@ shipping a ``--baseline`` file (docs/static-analysis.md).
 """
 
 from ci.analysis.passes import (  # noqa: F401
+    awaitrace,
     blocking,
     contracts,
     coroutines,
     envknobs,
     keys,
+    ownership,
+    patchshape,
+    raisepath,
     sloreg,
     swallow,
     warmpool,

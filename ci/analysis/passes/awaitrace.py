@@ -1,0 +1,245 @@
+"""``await-race``: read-modify-write across a suspension point.
+
+The control plane's concurrency model is cooperative: state is only
+consistent *between* awaits. Every long-lived singleton (Manager, the
+fleet scheduler, the warm-pool manager, the elastic intent book, the
+informer caches) is shared by multiple tasks — two reconcile workers
+per controller, background loops, scheduler callbacks — so a method
+that reads ``self._pools``, awaits an API round trip, and then writes
+``self._pools`` back has a hole exactly one interleaving wide: the kind
+of bug the chaos soak reproduces once a week and a reviewer never sees.
+Sharding the control plane (ROADMAP) multiplies the interleavings, so
+this pass turns the hand-audit into a ratchet:
+
+- flagged: inside an ``async`` method of a registered singleton class,
+  a read of a shared mutable ``self.<attr>`` followed by an ``await``
+  followed by a mutation of the same attr (straight-line), or a loop
+  containing an await plus both a read and a mutation of the attr (the
+  across-iterations variant — ``for k in list(self._m): ...await...;
+  self._m[k]`` races a concurrent ``pop``);
+- guarded: both ends inside the same ``async with <lock>`` region, or
+  the whole function provably called only under such a region (lock
+  acquisition tracked through the call graph; an unresolved caller
+  disqualifies — conservatism never assumes safety);
+- the **shared-state inventory** (``--shared-state-report``) emits the
+  full map — owner module, attribute, mutation sites, await-crossing
+  sites, guarding lock — as a CI artifact: the literal work-list for
+  the sharding PR (anything in it either moves behind a shard lease or
+  gets a lock).
+
+Per-key serialization (a workqueue key's reconciles never overlap) can
+make a same-key RMW safe in practice; such sites carry a reasoned
+suppression rather than weakening the rule — the suppression inventory
+IS part of the audit.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.analysis.core import Finding, Project, analysis_pass
+from ci.analysis.callgraph import FunctionInfo, get_index
+
+RULE = "await-race"
+
+# (module path, class name): the long-lived singletons shared across
+# tasks. Fixture trees place lookalike files at these paths.
+SINGLETONS = (
+    ("kubeflow_tpu/runtime/manager.py", "Manager"),
+    ("kubeflow_tpu/scheduler/runtime.py", "TpuFleetScheduler"),
+    ("kubeflow_tpu/controllers/warmpool.py", "WarmPoolManager"),
+    ("kubeflow_tpu/scheduler/elastic.py", "IntentBook"),
+    ("kubeflow_tpu/runtime/informer.py", "Informer"),
+    ("kubeflow_tpu/runtime/queue.py", "RateLimitedQueue"),
+    ("kubeflow_tpu/runtime/timeline.py", "TimelineRecorder"),
+    ("kubeflow_tpu/serving/controller.py", "InferenceServiceReconciler"),
+)
+
+
+def _shared_attrs(ci) -> dict[str, str]:
+    """attr → "container"|"scalar": every attribute some method (other
+    than __init__) mutates, plus every container attr from __init__."""
+    attrs: dict[str, str] = {}
+    for name in ci.container_attrs:
+        attrs[name] = "container"
+    for mname, m in ci.methods.items():
+        if mname == "__init__":
+            continue
+        for e in m.attr_events:
+            if e.kind == "mutate" and e.attr not in attrs:
+                attrs[e.attr] = "scalar"
+    return attrs
+
+
+def _rmw_sites(fn: FunctionInfo, shared: dict[str, str]):
+    """(attr, read_line, await_line, mutate_line) candidates in one
+    function — straight-line and loop variants, lock-region aware."""
+    out = []
+    seen_attrs = set()
+    events = fn.attr_events
+    # straight-line: read(X) ... await ... mutate(X)
+    for i, mut in enumerate(events):
+        if mut.kind != "mutate" or mut.attr not in shared:
+            continue
+        if mut.attr in seen_attrs:
+            continue
+        for j in range(i):
+            rd = events[j]
+            if rd.kind != "read" or rd.attr != mut.attr:
+                continue
+            for k in range(j + 1, i):
+                aw = events[k]
+                if aw.kind != "await":
+                    continue
+                same_region = (rd.lock_region and
+                               rd.lock_region == mut.lock_region
+                               and aw.lock_region == rd.lock_region)
+                if not same_region:
+                    seen_attrs.add(mut.attr)
+                    out.append((mut.attr, rd.line, aw.line, mut.line))
+                    break
+            if mut.attr in seen_attrs:
+                break
+    # loop variant: an await-containing loop with both a read and a
+    # mutation of X in its body — iteration N+1's read races iteration
+    # N's await window regardless of textual order.
+    for loop_id in fn.loops_with_await:
+        per_attr: dict[str, dict[str, list]] = {}
+        for e in events:
+            if loop_id not in e.loops:
+                continue
+            if e.kind in ("read", "mutate") and e.attr in shared:
+                per_attr.setdefault(e.attr, {"read": [], "mutate": []})[
+                    e.kind].append(e)
+        await_line = next((e.line for e in events
+                           if e.kind == "await" and loop_id in e.loops),
+                          0)
+        for attr, evs in per_attr.items():
+            if attr in seen_attrs or not evs["read"] or not evs["mutate"]:
+                continue
+            regions = {e.lock_region
+                       for e in evs["read"] + evs["mutate"]}
+            if len(regions) == 1 and 0 not in regions:
+                continue        # whole body under one lock region
+            mut = evs["mutate"][0]
+            seen_attrs.add(attr)
+            out.append((attr, evs["read"][0].line, await_line, mut.line))
+    return out
+
+
+def _lock_attr_of(ci) -> str | None:
+    for name in sorted(ci.container_attrs | set(ci.attr_types)):
+        if "lock" in name.lower():
+            return name
+    # common shape: self._lock = asyncio.Lock() — a scalar-looking attr
+    for mname, m in ci.methods.items():
+        if mname != "__init__":
+            continue
+        for node in ast.walk(m.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and "lock" in t.attr.lower():
+                    return t.attr
+    return None
+
+
+def _iter_singletons(project: Project, idx):
+    for path, cls_name in SINGLETONS:
+        ci = idx.classes.get(path, {}).get(cls_name)
+        if ci is not None:
+            yield path, ci
+
+
+@analysis_pass(
+    "await-race", (RULE,),
+    "read-modify-write of shared singleton state across an await "
+    "without an asyncio lock (lock acquisition tracked through the "
+    "call graph)")
+def check_await_race(project: Project):
+    idx = get_index(project)
+    for path, ci in _iter_singletons(project, idx):
+        shared = _shared_attrs(ci)
+        if not shared:
+            continue
+        for mname, fn in ci.methods.items():
+            if mname == "__init__" or not fn.attr_events:
+                continue
+            on_loop = fn.is_async
+            if not on_loop:
+                continue
+            if idx.always_called_under_lock(fn.qual):
+                continue
+            for attr, r, a, m in _rmw_sites(fn, shared):
+                yield Finding(
+                    rule=RULE, path=path, line=m,
+                    message=f"{ci.name}.{mname} reads self.{attr} "
+                            f"(line {r}), awaits (line {a}), then "
+                            f"mutates it (line {m}) — a concurrent task "
+                            "can interleave in the await window; guard "
+                            "both ends with one `async with` lock, "
+                            "re-validate after the await, or suppress "
+                            "with the serialization argument stated")
+
+
+# ---- the shared-state inventory (--shared-state-report) ----------------------
+
+
+def shared_state_inventory(project: Project) -> dict:
+    """Machine-readable map of every singleton's shared mutable state —
+    the pre-sharding audit artifact (docs/static-analysis.md). Schema:
+
+    ``{"classes": [{"class", "module", "attrs": [{"attr", "kind",
+    "mutation_sites": [{"function", "line"}], "await_crossing_sites":
+    [{"function", "read_line", "await_line", "mutate_line"}],
+    "readers": [...], "guarding_lock": str|null}]}]}``
+    """
+    idx = get_index(project)
+    classes = []
+    for path, ci in _iter_singletons(project, idx):
+        shared = _shared_attrs(ci)
+        lock_attr = _lock_attr_of(ci)
+        # One O(events²) RMW scan per method, bucketed by attribute.
+        crossings_by_attr: dict[str, list] = {}
+        for mname, fn in ci.methods.items():
+            if mname == "__init__" or not fn.is_async:
+                continue
+            for attr, r, aw, m in _rmw_sites(fn, shared):
+                crossings_by_attr.setdefault(attr, []).append({
+                    "function": mname, "read_line": r,
+                    "await_line": aw, "mutate_line": m})
+        attrs = []
+        for attr in sorted(shared):
+            mutations, readers = [], set()
+            all_locked = True
+            for mname, fn in ci.methods.items():
+                for e in fn.attr_events:
+                    if e.attr != attr:
+                        continue
+                    if e.kind == "mutate":
+                        if mname != "__init__":
+                            mutations.append(
+                                {"function": mname, "line": e.line})
+                            locked = bool(e.lock_region) or \
+                                idx.always_called_under_lock(fn.qual)
+                            all_locked = all_locked and locked
+                    elif e.kind == "read":
+                        readers.add(mname)
+            attrs.append({
+                "attr": attr,
+                "kind": shared[attr],
+                "mutation_sites": mutations,
+                "await_crossing_sites": crossings_by_attr.get(attr, []),
+                "readers": sorted(readers),
+                "guarding_lock": (
+                    lock_attr if mutations and all_locked else None),
+            })
+        classes.append({
+            "class": ci.name,
+            "module": path,
+            "lock_attr": lock_attr,
+            "attrs": attrs,
+        })
+    return {"classes": classes}

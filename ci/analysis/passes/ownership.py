@@ -1,0 +1,176 @@
+"""``annotation-ownership``: single-writer discipline for the wire keys.
+
+The ROADMAP's sharding refactor moves controller state across processes,
+and the one thing N active-active managers must never do is fight over a
+durable CR annotation: the ``timeline`` journal has ONE writer by design
+(PR 13), the ``warm-claim`` CAS is only safe because exactly one
+subsystem stamps it (PR 14), and the scheduler's ``admitted-at``/
+``preempted`` family is the ledger's durable shadow. This pass proves
+the discipline statically, so the sharding PR inherits invariants
+instead of hoping for them:
+
+- ``api/keys.py`` declares ``OWNERS``: every key constant maps to the
+  set of module prefixes allowed to *write* it (appear in a merge-patch
+  dict key position, a subscript store, ``pop``/``setdefault``). The
+  declaration is itself checked for completeness — a new key without an
+  owner entry is a finding, as is an entry naming no constant.
+- Writes are attributed **interprocedurally**: a patch-shape helper
+  (``migration/protocol.py`` builders) writes on behalf of every module
+  that can reach it through the call graph, so hiding a write behind a
+  helper changes nothing. A write is a violation when the writing
+  function's own module — or any module from which it is reachable —
+  is not in the key's owner set.
+- ``kubeflow_tpu/testing/`` is exempt: harnesses (chaos, podsim) play
+  the SDK's and the kubelet's roles by design; the OWNERS map stays an
+  honest map of *production* writers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.analysis.core import Finding, Project, analysis_pass
+from ci.analysis.callgraph import KEYS_MODULE, get_index
+
+RULE = "annotation-ownership"
+
+TESTING_PREFIX = "kubeflow_tpu/testing/"
+
+
+def _module_matches(path: str, prefix: str) -> bool:
+    base = prefix.rstrip("/")
+    return path == base or path == base + ".py" \
+        or path.startswith(base + "/")
+
+
+def _load_owners(keys_sf) -> tuple[dict[str, tuple[str, ...]] | None,
+                                   list[tuple[int, str]]]:
+    """Parse the OWNERS literal: {CONST_NAME: (prefix, ...)}. Returns
+    (owners-or-None, [(line, problem)])."""
+    problems: list[tuple[int, str]] = []
+    owners_node = None
+    # module-level `_SHARED = ("prefix", ...)` tuples referenced by name
+    # inside OWNERS (the drain protocol's multi-writer set is declared
+    # once, not seven times)
+    tuple_aliases: dict[str, ast.expr] = {}
+    for node in keys_sf.tree.body:
+        target = value = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            target, value = node.target.id, node.value
+        if target == "OWNERS":
+            owners_node = value
+        elif target and isinstance(value, (ast.Tuple, ast.Set, ast.List)):
+            tuple_aliases[target] = value
+    if owners_node is None:
+        return None, problems
+    if not isinstance(owners_node, ast.Dict):
+        problems.append((owners_node.lineno,
+                         "OWNERS must be a literal dict"))
+        return {}, problems
+    owners: dict[str, tuple[str, ...]] = {}
+    for k, v in zip(owners_node.keys, owners_node.values):
+        if not isinstance(k, ast.Name):
+            problems.append((
+                (k or owners_node).lineno,
+                "OWNERS keys must be bare constant NAMES (a typo then "
+                "fails at import, not silently here)"))
+            continue
+        prefixes: list[str] = []
+        if isinstance(v, ast.Name) and v.id in tuple_aliases:
+            v = tuple_aliases[v.id]
+        if isinstance(v, (ast.Tuple, ast.Set, ast.List)):
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    prefixes.append(e.value)
+                else:
+                    problems.append((e.lineno, f"OWNERS[{k.id}] entries "
+                                     "must be string module prefixes"))
+        else:
+            problems.append((v.lineno, f"OWNERS[{k.id}] must be a "
+                             "tuple/set of module prefixes"))
+        if not prefixes:
+            problems.append((k.lineno, f"OWNERS[{k.id}] declares no "
+                             "owner module"))
+        bad = [p for p in prefixes if not p.startswith("kubeflow_tpu")]
+        for p in bad:
+            problems.append((k.lineno, f"OWNERS[{k.id}] prefix {p!r} is "
+                             "outside kubeflow_tpu/"))
+        owners[k.id] = tuple(prefixes)
+    return owners, problems
+
+
+@analysis_pass(
+    "annotation-ownership", (RULE,),
+    "every keys.py annotation key has a declared OWNERS set and no "
+    "write site is reachable from a non-owner module (interprocedural)")
+def check_ownership(project: Project):
+    keys_sf = project.get(KEYS_MODULE)
+    if keys_sf is None or keys_sf.tree is None:
+        if project.full_tree:
+            anchor = project.files[0].path if project.files else KEYS_MODULE
+            yield Finding(
+                rule=RULE, path=anchor, line=1,
+                message=f"{KEYS_MODULE} is missing — the ownership map "
+                        "has no registry to check against")
+        return
+    idx = get_index(project)
+    owners, problems = _load_owners(keys_sf)
+    if owners is None:
+        if project.full_tree:
+            yield Finding(
+                rule=RULE, path=keys_sf.path, line=1,
+                message="keys.py declares no OWNERS map — every "
+                        "annotation key needs a declared single-writer "
+                        "set before state can shard across managers")
+        return
+    for line, problem in problems:
+        yield Finding(rule=RULE, path=keys_sf.path, line=line,
+                      message=problem)
+    # completeness both ways
+    for const in sorted(idx.key_consts):
+        if const not in owners:
+            yield Finding(
+                rule=RULE, path=keys_sf.path, line=1,
+                message=f"key constant {const} has no OWNERS entry — "
+                        "declare which module(s) may write it")
+    for const in sorted(owners):
+        if const not in idx.key_consts:
+            yield Finding(
+                rule=RULE, path=keys_sf.path, line=1,
+                message=f"OWNERS names {const}, which is not a key "
+                        "constant in this module — stale entry")
+
+    # interprocedural write attribution
+    for fn in idx.by_qual.values():
+        if not fn.key_writes or fn.path == KEYS_MODULE:
+            continue
+        if fn.path.startswith(TESTING_PREFIX):
+            continue
+        reaching = {fn.path}
+        for caller in idx.transitive_callers(fn.qual):
+            cpath = caller.split("::", 1)[0]
+            if not cpath.startswith(TESTING_PREFIX):
+                reaching.add(cpath)
+        for write in fn.key_writes:
+            prefixes = owners.get(write.const)
+            if prefixes is None:
+                continue        # completeness finding already covers it
+            offenders = sorted(
+                mod for mod in reaching
+                if not any(_module_matches(mod, p) for p in prefixes))
+            if not offenders:
+                continue
+            via = "" if offenders == [fn.path] else (
+                f" (reached via the call graph from "
+                f"{', '.join(m for m in offenders if m != fn.path)})")
+            yield Finding(
+                rule=RULE, path=fn.path, line=write.line,
+                message=f"write of {write.const} by non-owner module(s) "
+                        f"{', '.join(offenders)}{via} — owners are "
+                        f"{', '.join(prefixes)}; route the write through "
+                        "an owner or extend OWNERS in api/keys.py with "
+                        "a comment saying why")

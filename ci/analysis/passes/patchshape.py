@@ -1,0 +1,112 @@
+"""``patch-shape``: merge-patch deletes are explicit ``None``s.
+
+Kubernetes strategic/merge patches have one sharp edge this codebase
+keeps cutting itself on (the delete-discipline bug PR 14's claim gate
+fixed by hand): **omitting** a key from a merge-patch dict leaves the
+old value on the object — only an explicit ``key: None`` deletes it. So
+a function that stamps ``{K1: v1, K2: v2}`` down one branch and
+``{K1: v1}`` down the other is almost always wrong: the second branch
+*looks* like it clears K2 but actually preserves whatever stale value a
+previous reconcile wrote.
+
+Flagged: within one function, an ``if``/``else`` (or a conditional
+expression spliced into a dict) whose two sides both build annotation
+patches sharing at least one ``keys.py`` constant, where a key set to a
+value on one side is entirely absent from the other — **unless** the
+function also explicitly ``None``-deletes that key somewhere (the
+rollback-patch idiom), in which case the absence is deliberate
+staging, not reliance on omission.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.analysis.core import Finding, Project, analysis_pass
+from ci.analysis.callgraph import KEYS_MODULE, get_index
+
+RULE = "patch-shape"
+
+
+def _patch_dicts(idx, path: str, root: ast.AST):
+    """Every dict literal under ``root`` carrying ≥1 resolvable key
+    const in key position → [(node, {const: is_none_value})]."""
+    out = []
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Dict):
+            continue
+        consts: dict[str, bool] = {}
+        for k, v in zip(node.keys, node.values):
+            if k is None:
+                continue
+            const = idx.resolve_key(path, k)
+            if const is not None:
+                consts[const] = (isinstance(v, ast.Constant)
+                                 and v.value is None)
+        if consts:
+            out.append((node, consts))
+    return out
+
+
+def _side_keys(idx, path: str, nodes) -> dict[str, bool]:
+    merged: dict[str, bool] = {}
+    for root in nodes:
+        for _node, consts in _patch_dicts(idx, path, root):
+            merged.update(consts)
+    return merged
+
+
+def _function_deletes(idx, path: str, fn_node: ast.AST) -> set[str]:
+    deletes = set()
+    for _node, consts in _patch_dicts(idx, path, fn_node):
+        deletes.update(c for c, is_none in consts.items() if is_none)
+    return deletes
+
+
+def _branch_pairs(fn_node: ast.AST):
+    """(lineno, body-stmts, orelse-stmts) for every if/else, plus
+    conditional expressions' (body, orelse) arms."""
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.If) and node.orelse:
+            yield node.lineno, node.body, node.orelse
+        elif isinstance(node, ast.IfExp):
+            yield node.lineno, [node.body], [node.orelse]
+
+
+@analysis_pass(
+    "patch-shape", (RULE,),
+    "a merge-patch branch that sets an annotation the sibling branch "
+    "silently omits (key-absence is not a delete; None is)")
+def check_patch_shape(project: Project):
+    idx = get_index(project)
+    for qual, fn in idx.by_qual.items():
+        if fn.name == "<module>" or fn.path == KEYS_MODULE \
+                or fn.path.startswith("kubeflow_tpu/testing/"):
+            continue
+        if not fn.key_writes:
+            continue
+        deletes = _function_deletes(idx, fn.path, fn.node)
+        reported: set[tuple[int, str]] = set()
+        for line, body, orelse in _branch_pairs(fn.node):
+            a = _side_keys(idx, fn.path, body)
+            b = _side_keys(idx, fn.path, orelse)
+            if not a or not b or not (set(a) & set(b)):
+                continue
+            for side_set, side_other, where in ((a, b, "else"),
+                                                (b, a, "if")):
+                for const, is_none in sorted(side_set.items()):
+                    if is_none or const in side_other:
+                        continue
+                    if const in deletes:
+                        continue    # explicitly None-deleted elsewhere
+                    if (line, const) in reported:
+                        continue
+                    reported.add((line, const))
+                    yield Finding(
+                        rule=RULE, path=fn.path, line=line,
+                        message=f"{fn.name}: one branch of this "
+                                f"conditional patches {const} while the "
+                                f"{where} branch omits it — merge-patch "
+                                "omission KEEPS the old value; if the "
+                                "other branch means 'cleared', patch "
+                                f"{const}: None explicitly")

@@ -1,0 +1,112 @@
+"""``raise-path``: errors on reconcile paths must surface.
+
+PR 7's contract: a reconcile that fails must RAISE so the workqueue's
+backoff (and eventually the poison-pill quarantine) owns the retry —
+a swallowed ``ApiError`` three calls below the reconciler leaves the CR
+silently stale until an unrelated event. The original ``swallow`` pass
+checks *broad* catches per-file; this pass generalizes the contract to
+the whole call graph:
+
+- from each entry point (every ``reconcile``, the manager worker, the
+  scheduler's public admission/release surface, the warm-pool claim and
+  replenish loops), walk every reachable function;
+- every reachable ``except`` that catches the **ApiError family** (or
+  broad ``Exception``/bare) must either re-raise, return a value (the
+  sentinel-the-caller-converts contract: ``_stop_victim`` returns False
+  and the caller raises), assign a stated fallback, or make some call —
+  a counter bump, a log line, an event — that leaves a trace;
+- a handler that does *none* of those is a silent drop on a reconcile
+  path: a finding.
+
+Audited best-effort sinks are exempt by design, not per-site:
+``runtime/events.py`` (EventRecorder — best-effort BY CONTRACT, drops
+counted in ``events_emit_failures_total``) and ``runtime/aiotasks.py``
+(``reap()`` — the one blessed teardown swallow, PR 12).
+"""
+
+from __future__ import annotations
+
+from ci.analysis.core import Finding, Project, analysis_pass
+from ci.analysis.callgraph import get_index
+
+RULE = "raise-path"
+
+# The errors the contract is about: the API client's family plus the
+# broad catches that would eat it. NotFound/AlreadyExists caught ALONE
+# are deliberately exempt: `except NotFound: pass` around a delete (or
+# AlreadyExists around a create) asserts the desired state already
+# holds — idempotency, not a swallow.
+API_FAMILY = {
+    "ApiError", "Conflict", "ServerTimeout",
+    "TooManyRequests", "Exception", "BaseException",
+}
+
+# Audited best-effort sinks: swallowing here is the module's contract.
+SINK_FILES = (
+    "kubeflow_tpu/runtime/events.py",
+    "kubeflow_tpu/runtime/aiotasks.py",
+)
+
+# Entry points: (path, function-name-or-None). None = every def named
+# `reconcile` in the file. Paths absent from a scratch scan are skipped.
+ENTRY_SPECS = (
+    (None, "reconcile"),                       # every reconciler
+    ("kubeflow_tpu/runtime/manager.py", "_worker"),
+    ("kubeflow_tpu/scheduler/runtime.py", "admission"),
+    ("kubeflow_tpu/scheduler/runtime.py", "release"),
+    ("kubeflow_tpu/scheduler/runtime.py", "serving_admission"),
+    ("kubeflow_tpu/scheduler/runtime.py", "serving_release"),
+    ("kubeflow_tpu/scheduler/runtime.py", "warm_reserve"),
+    ("kubeflow_tpu/scheduler/runtime.py", "warm_release"),
+    ("kubeflow_tpu/controllers/warmpool.py", "claim"),
+    ("kubeflow_tpu/controllers/warmpool.py", "replenish"),
+)
+
+
+def entry_quals(idx) -> list[str]:
+    out = []
+    for qual, fn in idx.by_qual.items():
+        for path, name in ENTRY_SPECS:
+            if fn.name != name:
+                continue
+            if path is None or fn.path == path:
+                out.append(qual)
+                break
+    return out
+
+
+@analysis_pass(
+    "raise-path", (RULE,),
+    "ApiError/broad catches reachable from reconciler entry points must "
+    "re-raise, return a sentinel, log/count, or sit in an audited sink")
+def check_raise_path(project: Project):
+    idx = get_index(project)
+    entries = entry_quals(idx)
+    if not entries:
+        return
+    reachable = idx.reachable_from(entries)
+    seen_lines: set[tuple[str, int]] = set()
+    for qual in sorted(reachable):
+        fn = idx.by_qual.get(qual)
+        if fn is None or fn.path in SINK_FILES \
+                or fn.path.startswith("kubeflow_tpu/testing/"):
+            continue
+        for catch in fn.catches:
+            caught = set(catch.types) if catch.types else {"Exception"}
+            if not caught & API_FAMILY:
+                continue
+            if catch.has_raise or catch.has_return or catch.has_call \
+                    or catch.has_assign:
+                continue
+            if (fn.path, catch.line) in seen_lines:
+                continue        # one finding even if multiply reachable
+            seen_lines.add((fn.path, catch.line))
+            family = ", ".join(sorted(caught & API_FAMILY))
+            yield Finding(
+                rule=RULE, path=fn.path, line=catch.line,
+                message=f"silent `except {family}` in {fn.name}, "
+                        "reachable from a reconciler entry point — the "
+                        "PR 7 contract says errors re-raise into "
+                        "workqueue backoff; re-raise, return a sentinel "
+                        "the caller converts, or leave a trace (counter/"
+                        "log) and say why best-effort is correct here")

@@ -97,6 +97,11 @@ def workflow_tests() -> dict:
         "jobs": {
             "pytest": {
                 "runs-on": "ubuntu-latest",
+                # The SARIF upload needs security-events: write; without
+                # an explicit grant the default read-only GITHUB_TOKEN
+                # (and every fork PR) fails the step and reddens the job.
+                "permissions": {"contents": "read",
+                                "security-events": "write"},
                 "strategy": {"matrix": {"python": ["3.11", "3.12"]}},
                 "steps": [
                     checkout(),
@@ -105,16 +110,35 @@ def workflow_tests() -> dict:
                     run(None, PIP_INSTALL),
                     run("Lint: controllers register reconcile phases with the tracer",
                         "python ci/check_tracing.py"),
-                    run("Static analysis (AST): async-safety, registry "
-                        "drift, contract passes — exit 1 on findings "
-                        "(docs/static-analysis.md)",
-                        "python -m ci.analysis --json analysis-findings.json"),
-                    {"name": "Upload static-analysis findings JSON",
+                    run("Static analysis (AST + interprocedural): "
+                        "async-safety, registry drift, contract passes, "
+                        "annotation ownership, await-race, raise-path — "
+                        "exit 1 on findings or if the run exceeds the "
+                        "30 s runtime budget (docs/static-analysis.md)",
+                        "python -m ci.analysis"
+                        " --json analysis-findings.json"
+                        " --sarif analysis.sarif"
+                        " --shared-state-report shared-state-report.json"
+                        " --timings --max-seconds 30"),
+                    {"name": "Upload static-analysis findings JSON + "
+                             "shared-state inventory (the pre-sharding "
+                             "audit artifact)",
                      "if": "always()",
                      "uses": "actions/upload-artifact@v4",
                      "with": {"name": "static-analysis-findings-${{ matrix.python }}",
-                              "path": "analysis-findings.json",
+                              "path": "analysis-findings.json\n"
+                                      "shared-state-report.json",
                               "if-no-files-found": "ignore"}},
+                    {"name": "Upload SARIF so findings annotate the PR "
+                             "diff",
+                     "if": "always() && matrix.python == '3.12'",
+                     "uses": "github/codeql-action/upload-sarif@v3",
+                     # Fork PR tokens can't write security events even
+                     # with the job grant — annotations are progressive
+                     # enhancement, never a red X on the suite.
+                     "continue-on-error": True,
+                     "with": {"sarif_file": "analysis.sarif",
+                              "category": "ci-analysis"}},
                     run("Fleet-scheduler smoke bench (gang admission, fairness, "
                         "idle preemption)",
                         "python bench.py scheduler_scale --smoke",

@@ -117,3 +117,144 @@ SERVING_PARK_CHECKPOINT_STEP = "serving.kubeflow.org/parked-checkpoint-step"
 SERVING_PARK_CHECKPOINT_FOR = "serving.kubeflow.org/parked-checkpoint-for"
 SERVING_FLEX_POOL_PREFIX = "serving.kubeflow.org/flex-pool-r"
 SERVING_PRIORITY = "serving.kubeflow.org/priority"
+
+# ---- ownership (ISSUE 15: the shard-safety audit) ----------------------------
+#
+# ``OWNERS`` declares, for EVERY key above, the module prefixes allowed
+# to WRITE it (a key const in merge-patch dict-key position, a subscript
+# store, ``pop``/``setdefault``). The ``annotation-ownership`` analysis
+# pass (ci/analysis/passes/ownership.py) enforces it interprocedurally:
+# a write is attributed to its own module AND to every module that can
+# reach it through the project call graph, so hiding a write behind a
+# patch-shape helper changes nothing. This is the single-writer
+# discipline the active-active sharding refactor (ROADMAP) inherits:
+# before state moves across processes, who may stamp each durable
+# annotation is a checked declaration, not tribal knowledge.
+#
+# Conventions:
+# - a prefix names a module ("kubeflow_tpu/sdk") or a subtree
+#   ("kubeflow_tpu/scheduler/");
+# - ``kubeflow_tpu/testing/`` is always exempt (harnesses play the SDK
+#   and the kubelet by design);
+# - read access is never restricted — reads are the point of a wire
+#   contract;
+# - keys with no in-tree production writer (user-stamped via the web
+#   apps, or written by out-of-cluster actors) still declare the
+#   subsystem that WOULD own the write, so a future in-tree writer
+#   lands as a reviewed OWNERS edit, not silent drift.
+#
+# Keys are dict keys by constant NAME reference: a typo here is a
+# NameError at import, never a silently-unchecked entry.
+
+# The drain/checkpoint handshake is multi-writer BY PROTOCOL: the pure
+# patch shapes live in migration/protocol.py and are stamped by the
+# scheduler (preemption/elastic drains), the notebook controller
+# (suspend/park/restore hygiene), the culler (cull drains), and the
+# in-pod SDK (checkpoint acks).
+_DRAIN_PROTOCOL_OWNERS = (
+    "kubeflow_tpu/migration/",
+    "kubeflow_tpu/scheduler/",
+    "kubeflow_tpu/controllers/notebook",
+    "kubeflow_tpu/controllers/culling",
+    "kubeflow_tpu/sdk",
+)
+# API group/version strings are wire FORMAT, not mutable state — they
+# appear in apiVersion values, never in a patch key position. Anyone
+# may mention them.
+_WIRE_FORMAT = ("kubeflow_tpu/",)
+# The JWA backend is the user's pen: creation-time annotations.
+_JWA = ("kubeflow_tpu/web/",)
+
+OWNERS: dict[str, tuple[str, ...]] = {
+    GROUP: _WIRE_FORMAT,
+    API_V1: _WIRE_FORMAT,
+    API_V1BETA1: _WIRE_FORMAT,
+    API_V1ALPHA1: _WIRE_FORMAT,
+    TENSORBOARD_API_V1ALPHA1: _WIRE_FORMAT,
+    NOTEBOOKS_API_PATH_PREFIX: _WIRE_FORMAT,
+    # Workload classing: stamped at admission (defaulting webhook) and
+    # by the serving controller's replica templates.
+    WORKLOAD_CLASS_LABEL: ("kubeflow_tpu/api/inferenceservice",
+                           "kubeflow_tpu/serving/",
+                           "kubeflow_tpu/webhooks/"),
+    # Culling owns the activity clock exclusively (the scheduler and JWA
+    # only read it).
+    NOTEBOOK_LAST_ACTIVITY: ("kubeflow_tpu/controllers/culling",),
+    NOTEBOOK_LAST_ACTIVITY_CHECK_TIMESTAMP: (
+        "kubeflow_tpu/controllers/culling",),
+    NOTEBOOK_HTTP_REWRITE_URI: _JWA,
+    NOTEBOOK_HTTP_HEADERS_REQUEST_SET: _JWA,
+    NOTEBOOK_SERVER_TYPE: _JWA,
+    NOTEBOOK_CREATOR: _JWA,
+    NOTEBOOK_LAST_IMAGE_SELECTION: _JWA,
+    NOTEBOOK_RESTART: _JWA,                      # user intent via JWA
+    NOTEBOOK_UPDATE_PENDING: ("kubeflow_tpu/webhooks/notebook",),
+    NOTEBOOK_MAINTENANCE_PENDING: ("kubeflow_tpu/controllers/notebook",),
+    NOTEBOOK_INJECT_AUTH_PROXY: _JWA,            # user intent via JWA
+    NOTEBOOK_SLICE_RESTART_ATTEMPTS: (
+        "kubeflow_tpu/controllers/notebook",),
+    NOTEBOOK_SLICE_RESTART_AT: ("kubeflow_tpu/controllers/notebook",),
+    # Scheduler verdict family: the fleet scheduler is the single
+    # writer; the controller and culler only read. PRIORITY is user
+    # intent (JWA).
+    NOTEBOOK_PRIORITY: _JWA,
+    NOTEBOOK_ADMITTED_AT: ("kubeflow_tpu/scheduler/",),
+    NOTEBOOK_PREEMPTED: ("kubeflow_tpu/scheduler/",),
+    NOTEBOOK_FLEX_POOL: ("kubeflow_tpu/scheduler/",),
+    NOTEBOOK_DRAIN_REQUESTED: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_DRAIN_REASON: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINTING_AT: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINTED_AT: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINTED_FOR: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINT_PATH: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINT_STEP: _DRAIN_PROTOCOL_OWNERS,
+    # Suspend is user/SDK intent; the controller reads it and parks.
+    NOTEBOOK_SUSPEND: ("kubeflow_tpu/sdk", "kubeflow_tpu/web/"),
+    # PR 13: ONE writer by design — the TimelineRecorder flush (driven
+    # from the notebook reconciler's _update_status).
+    NOTEBOOK_TIMELINE: ("kubeflow_tpu/runtime/timeline",),
+    # Warm-claim verdict on the CR: stamped by the pool manager's adopt,
+    # cleared by the controller's claim gate (stop/edit/off hygiene).
+    NOTEBOOK_WARM_CLAIMED: ("kubeflow_tpu/controllers/warmpool",
+                            "kubeflow_tpu/controllers/notebook"),
+    NOTEBOOK_WARM_CLAIMED_AT: ("kubeflow_tpu/controllers/warmpool",
+                               "kubeflow_tpu/controllers/notebook"),
+    NOTEBOOK_WARM_CLAIMED_IN: ("kubeflow_tpu/controllers/warmpool",
+                               "kubeflow_tpu/controllers/notebook"),
+    # Pod-template TPU wiring: template authors (controllers building
+    # slice/warm/replica StatefulSets) and the per-ordinal admission
+    # webhook.
+    TPU_ACCELERATOR: ("kubeflow_tpu/controllers/",
+                      "kubeflow_tpu/serving/", "kubeflow_tpu/webhooks/"),
+    TPU_TOPOLOGY: ("kubeflow_tpu/controllers/",
+                   "kubeflow_tpu/serving/", "kubeflow_tpu/webhooks/"),
+    TPU_SLICE_ID: ("kubeflow_tpu/controllers/",
+                   "kubeflow_tpu/serving/", "kubeflow_tpu/webhooks/"),
+    TPU_NUM_SLICES: ("kubeflow_tpu/controllers/",
+                     "kubeflow_tpu/serving/", "kubeflow_tpu/webhooks/"),
+    TPU_SLICE_LABEL: ("kubeflow_tpu/controllers/",
+                      "kubeflow_tpu/serving/", "kubeflow_tpu/webhooks/"),
+    TPU_SCALE_UP_ACCELERATOR: ("kubeflow_tpu/scheduler/",),
+    TPU_SCALE_UP_TOPOLOGY: ("kubeflow_tpu/scheduler/",),
+    # The CAS claim annotation and pool label: the warm-pool manager is
+    # the only door (warm-pool-contract pass); the SDK only READS the
+    # claim through the downward API.
+    TPU_WARM_POOL_LABEL: ("kubeflow_tpu/controllers/warmpool",),
+    TPU_WARM_CLAIM: ("kubeflow_tpu/controllers/warmpool",),
+    # Serving contract: the controller owns park/identity; the load
+    # annotations are gateway-stamped (out of tree) and the park
+    # checkpoints are acked by the engine side — the serving subsystem
+    # would own any in-tree writer.
+    SERVING_SERVICE_LABEL: ("kubeflow_tpu/serving/",),
+    SERVING_REPLICA_STS_LABEL: ("kubeflow_tpu/serving/",),
+    SERVING_OBSERVED_RATE: ("kubeflow_tpu/serving/",),
+    SERVING_OBSERVED_INFLIGHT: ("kubeflow_tpu/serving/",),
+    SERVING_LAST_REQUEST_AT: ("kubeflow_tpu/serving/",),
+    SERVING_PARK_REQUESTED: ("kubeflow_tpu/serving/",),
+    SERVING_PARKED_AT: ("kubeflow_tpu/serving/",),
+    SERVING_PARK_CHECKPOINT_PATH: ("kubeflow_tpu/serving/",),
+    SERVING_PARK_CHECKPOINT_STEP: ("kubeflow_tpu/serving/",),
+    SERVING_PARK_CHECKPOINT_FOR: ("kubeflow_tpu/serving/",),
+    SERVING_FLEX_POOL_PREFIX: ("kubeflow_tpu/serving/",),
+    SERVING_PRIORITY: ("kubeflow_tpu/serving/",),
+}

@@ -274,8 +274,9 @@ class CullingReconciler:
                         "Notebook", name,
                         {"metadata": {"annotations": patch_annotations}}, ns,
                     )
-                except ApiError:
-                    pass
+                except ApiError as exc:
+                    log.debug("activity-stamp patch for %s/%s failed "
+                              "(next probe re-stamps): %s", ns, name, exc)
         return requeue
 
     async def _cull_stop(self, nb: dict, name: str, ns: str, now: float,
@@ -332,8 +333,10 @@ class CullingReconciler:
                                 nbapi.LAST_ACTIVITY_ANNOTATION:
                                     _fmt_time(now),
                             }}}, ns)
-                    except ApiError:
-                        pass
+                    except ApiError as exc:
+                        log.debug("cull-drain cancel patch for %s/%s "
+                                  "failed (re-probed next pass): %s",
+                                  ns, name, exc)
                     else:
                         await self.recorder.event(
                             nb, "Normal", "CullDrainCancelled",

@@ -534,7 +534,10 @@ class NotebookReconciler:
                     label_selector={
                         "matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name}},
                 )
-            except ApiError:
+            except ApiError as exc:
+                log.debug("queued-slice park LIST for %s/%s failed "
+                          "(retried on the queued requeue): %s",
+                          ns, name, exc)
                 return
         for sts in owned:
             if (deep_get(sts, "spec", "replicas") or 0) > 0:
@@ -542,8 +545,10 @@ class NotebookReconciler:
                     await self.kube.patch(
                         "StatefulSet", name_of(sts),
                         {"spec": {"replicas": 0}}, ns)
-                except (NotFound, ApiError):
-                    pass
+                except (NotFound, ApiError) as exc:
+                    log.debug("queued-slice scale-to-0 of %s failed "
+                              "(retried on the queued requeue): %s",
+                              name_of(sts), exc)
 
     async def _holds_reservation(self, nb: dict) -> bool:
         """Does this notebook hold a live GKE ProvisioningRequest?
@@ -707,8 +712,10 @@ class NotebookReconciler:
                 if adopted:
                     try:
                         await self.kube.delete("Pod", claimed_name, ns)
-                    except (NotFound, ApiError):
-                        pass
+                    except (NotFound, ApiError) as exc:
+                        log.debug("adopted-pod delete %s on stop failed "
+                                  "(GC owner cascade also covers it): "
+                                  "%s", claimed_name, exc)
                 await self.kube.patch(
                     "Notebook", name,
                     {"metadata": {"annotations": clear}}, ns)
@@ -734,8 +741,10 @@ class NotebookReconciler:
                 # reconcile already creates the slice StatefulSets.
                 try:
                     await self.kube.delete("Pod", claimed_name, ns)
-                except (NotFound, ApiError):
-                    pass
+                except (NotFound, ApiError) as exc:
+                    log.debug("broken claimed-pod delete %s failed "
+                              "(cold fallback proceeds regardless): %s",
+                              claimed_name, exc)
                 await self.kube.patch(
                     "Notebook", name,
                     {"metadata": {"annotations": clear}}, ns)
@@ -1616,7 +1625,9 @@ class NotebookReconciler:
                     label_selector={
                         "matchLabels": {nbapi.NOTEBOOK_NAME_LABEL: name}},
                 )
-            except ApiError:
+            except ApiError as exc:
+                log.debug("slice-GC LIST for %s/%s failed (retried "
+                          "next reconcile): %s", ns, name, exc)
                 return
         for sts in owned:
             if name_of(sts) not in expected:
@@ -1836,7 +1847,10 @@ class NotebookReconciler:
         else:
             try:
                 events = await self.kube.list("Event", ns)
-            except ApiError:
+            except ApiError as exc:
+                log.debug("event-mirror LIST for %s/%s failed (mirror "
+                          "catches up next reconcile): %s", ns, name,
+                          exc)
                 return
         seen = self._mirrored.setdefault((ns, name), {})
         for ev in events:
@@ -2053,8 +2067,13 @@ class NotebookReconciler:
                 # conflict-storm test) left the CR's status stale until
                 # the next unrelated event.
                 raise
-            except ApiError:
-                pass
+            except ApiError as exc:
+                # Non-conflict write failures stay best-effort (the 409
+                # path above re-raises): status refreshes on the next
+                # event, and failing the whole reconcile for a status
+                # tail write would churn healthy children.
+                log.debug("status write for %s/%s failed: %s", ns, name,
+                          exc)
         stopped = nbapi.is_stopped(nb)
         self._set_gauge_contribution(
             ns, name,

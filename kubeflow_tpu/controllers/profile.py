@@ -384,8 +384,9 @@ class ProfileReconciler:
                 "Profile", name_of(profile),
                 {"status": {"conditions": conditions}}, subresource="status",
             )
-        except ApiError:
-            pass
+        except ApiError as exc:
+            log.debug("Profile condition write for %s failed (re-set "
+                      "next reconcile): %s", name_of(profile), exc)
 
 
 def _role_binding(ns: str, name: str, cluster_role: str, subject: dict) -> dict:

@@ -369,8 +369,10 @@ class WarmPoolManager:
                                 {"metadata": {"annotations": {
                                     keys.TPU_WARM_CLAIM: None}}},
                                 pool.namespace)
-                        except ApiError:
-                            pass
+                        except ApiError as exc:
+                            log.debug("CAS rollback for pod %s failed "
+                                      "(stale-claim healer finishes "
+                                      "it): %s", name_of(pod), exc)
                         continue
                     # The durable claim annotation (never cleared after a
                     # successful hand-off) guards from here; keeping the
@@ -492,8 +494,10 @@ class WarmPoolManager:
                         nbapi.WARM_CLAIMED_AT_ANNOTATION: None,
                         nbapi.WARM_CLAIMED_IN_ANNOTATION: None,
                     }}}, ns)
-            except ApiError:
-                pass  # the gate's ownership validation self-heals this
+            except ApiError as exc:
+                # the gate's ownership validation self-heals this
+                log.debug("claim-intent rollback for %s/%s failed: %s",
+                          ns, name, exc)
             raise
         # (c) consume the slot — every step best-effort.
         if slot_ref is not None:
@@ -502,8 +506,10 @@ class WarmPoolManager:
             try:
                 await self.kube.delete("StatefulSet", slot_ref["name"],
                                        pool.namespace)
-            except (NotFound, ApiError):
-                pass
+            except (NotFound, ApiError) as exc:
+                log.debug("slot consume delete %s failed (replenisher "
+                          "heals interrupted claims): %s",
+                          slot_ref["name"], exc)
             await self._release_reservation(slot_key)
         try:
             fresh = await self.kube.get_or_none("Pod", pod_name,
@@ -619,13 +625,21 @@ class WarmPoolManager:
         reconcile hot path."""
         self._running = True
         while self._running:
+            # Clear BEFORE the pass, not after: a claim or reclaim that
+            # sets the wake DURING replenish() (its awaits interleave
+            # with the reconcile tasks) must survive into the wait below
+            # — clearing afterwards would erase the signal and delay the
+            # top-up by a full replenish interval (the lost-wakeup shape
+            # the await-race pass flags; regression test
+            # test_wake_during_replenish_pass_is_not_lost).
+            # kftpu: ignore[await-race] clear-before-work ordering: a set() landing during replenish() survives into the wait below by construction
+            self._wake.clear()
             try:
                 await self.replenish()
             except asyncio.CancelledError:
                 raise
             except Exception:
                 log.exception("warm-pool replenish pass failed; retrying")
-            self._wake.clear()
             try:
                 await asyncio.wait_for(
                     self._wake.wait(),
@@ -685,6 +699,26 @@ class WarmPoolManager:
                 # squat on chips the ledger no longer reserves.
                 await self._delete_slot(pool, name_of(sts))
                 continue
+            # The slot list is a pre-reserve snapshot: a claim can
+            # consume this slot (delete the STS, release its
+            # reservation) while _reserve's round trips are in flight,
+            # and re-reserving AFTER the claim's release would book a
+            # ghost allocation no later pass ever frees — the pool
+            # permanently under-fills by one slot (chips held for a
+            # slot that no longer exists). Re-validate and release.
+            # (regression test test_claim_racing_replenish_leaves_no_
+            # ghost_reservation)
+            try:
+                fresh = await self.kube.get_or_none(
+                    "StatefulSet", name_of(sts), pool.namespace)
+            except ApiError as exc:
+                log.debug("slot liveness re-check for %s failed; "
+                          "keeping it this pass: %s", name_of(sts), exc)
+                fresh = sts
+            if fresh is None:
+                await self._release_reservation(
+                    (pool.namespace, name_of(sts)))
+                continue
             kept.append(sts)
         index = self._next_index(slots, await self._pool_pods(pool))
         while len(kept) < pool.size:
@@ -737,7 +771,9 @@ class WarmPoolManager:
                 label_selector={"matchExpressions": [
                     {"key": keys.TPU_WARM_POOL_LABEL,
                      "operator": "Exists"}]})
-        except ApiError:
+        except ApiError as exc:
+            log.debug("orphan-slot sweep LIST failed (retried next "
+                      "pass): %s", exc)
             return
         for sts in labeled:
             ns = namespace_of(sts)
@@ -752,8 +788,10 @@ class WarmPoolManager:
         ns = namespace or (pool.namespace if pool else None)
         try:
             await self.kube.delete("StatefulSet", slot_name, ns)
-        except (NotFound, ApiError):
-            pass
+        except (NotFound, ApiError) as exc:
+            log.debug("slot teardown delete %s failed (reservation "
+                      "still released; orphan sweep retries): %s",
+                      slot_name, exc)
         await self._release_reservation((ns, slot_name))
 
     async def _slot_claim_interrupted(self, pool: WarmPoolSpec,

@@ -274,6 +274,7 @@ class Informer:
     async def _run(self) -> None:
         while True:
             try:
+                # kftpu: ignore[await-race] the single _run task is this counter's only writer; debug_info only reads it
                 self._relists += 1
                 if self._relists_total is not None:
                     self._relists_total.labels(kind=self.kind).inc()
@@ -282,6 +283,7 @@ class Informer:
                 )
                 # A successful list resets the failure streak — backoff
                 # escalation is for CONSECUTIVE failures only.
+                # kftpu: ignore[await-race] the single _run task is this attr's only writer; debug_info only reads it
                 self._consecutive_failures = 0
                 self._current_backoff = self.resync_backoff
                 self._last_sync = time.monotonic()

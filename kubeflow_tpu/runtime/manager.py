@@ -344,8 +344,11 @@ class Manager:
                 await self.kube.patch(
                     ctrl.kind, name, {"status": {"conditions": conditions}},
                     ns, subresource="status")
-            except ApiError:
-                pass
+            except ApiError as exc:
+                log.debug("Degraded condition write for %s %s failed "
+                          "(the quarantine itself holds; the Event "
+                          "below still announces it): %s", ctrl.kind,
+                          key, exc)
             await self.events.event(
                 obj, "Warning", "ReconcileQuarantined", message)
 

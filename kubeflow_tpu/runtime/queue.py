@@ -219,7 +219,9 @@ class RateLimitedQueue:
                 # ready_at — honoring backoff set after the stale push).
                 if key not in self._queued or ready_at != self._earliest.get(key):
                     continue
+                # kftpu: ignore[await-race] no suspension between the fresh heap pop, the staleness re-check and this discard — racing workers pop distinct entries
                 self._queued.discard(key)
+                # kftpu: ignore[await-race] same atomic pop-to-mutate window as the discard above
                 self._earliest.pop(key, None)
                 # Time past eligibility only — ready_at already folds in
                 # any intentional delay (coalesce/backoff/requeue_after).
@@ -227,6 +229,7 @@ class RateLimitedQueue:
                 self._in_flight.add(key)
                 return key
             timeout = (self._queue[0][0] - now) if self._queue else None
+            # kftpu: ignore[await-race] no suspension between the queue-state read and this clear — add()'s set() can only interleave inside the awaited wait
             self._event.clear()
             try:
                 await asyncio.wait_for(self._event.wait(), timeout)

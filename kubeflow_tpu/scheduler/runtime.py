@@ -25,6 +25,7 @@ which is also what the ``KFTPU_SCHEDULER=off`` kill switch restores.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from dataclasses import dataclass, field, replace
@@ -284,6 +285,14 @@ class TpuFleetScheduler:
         # O(queue) scans — same rationale as the arbitration debounce).
         self._last_elastic_gen = -1
         self._last_elastic_at = float("-inf")
+        # Serializes the elastic post-pass: IntentBook.sync computes an
+        # IntentSync delta and the CR mirror then applies it over many
+        # await round trips — two reconcile workers interleaving there
+        # could apply STALE deltas (one task creating a ProvisioningRequest
+        # the other's sync just withdrew → an orphan CR only the throttled
+        # janitor ever collects). The await-race pass tracks acquisition
+        # through the call graph.
+        self._elastic_lock = asyncio.Lock()
         # pool name → {"since": t, "nodes": set}: in-progress spot
         # reclaims. While an entry exists the pool is marked unavailable
         # in the ledger (sells nothing); the entry clears when the
@@ -560,8 +569,10 @@ class TpuFleetScheduler:
                             {"metadata": {"annotations":
                                           migration.clear_drain_patch()}},
                             key[0])
-                    except ApiError:
-                        pass
+                    except ApiError as exc:
+                        log.debug("stale drain-mark clear for %s/%s "
+                                  "failed (retried next pass): %s",
+                                  key[0], key[1], exc)
                 return Admission("Admitted")
             self._preempted.pop(key, None)  # resubmission clears the verdict
             if nbapi.PREEMPTED_ANNOTATION in annotations_of(nb):
@@ -576,8 +587,10 @@ class TpuFleetScheduler:
                         "Notebook", key[1],
                         {"metadata": {"annotations": {
                             nbapi.PREEMPTED_ANNOTATION: None}}}, key[0])
-                except ApiError:
-                    pass
+                except ApiError as exc:
+                    log.debug("stale Preempted clear for %s/%s failed "
+                              "(release() re-guards on the live queue "
+                              "entry): %s", key[0], key[1], exc)
             req = self._request_of(nb, ms, now)
             credit = self._requeue_credit.get(key)
             if credit is not None:
@@ -696,8 +709,11 @@ class TpuFleetScheduler:
                         "Notebook", key[1],
                         {"metadata": {"annotations": {
                             nbapi.PREEMPTED_ANNOTATION: None}}}, key[0])
-                except ApiError:
-                    pass
+                except ApiError as exc:
+                    log.debug("durable Preempted clear for %s/%s after "
+                              "a user stop failed (stale verdict may "
+                              "survive one restart): %s",
+                              key[0], key[1], exc)
             else:
                 try:
                     await self.kube.patch(
@@ -706,6 +722,7 @@ class TpuFleetScheduler:
                             nbapi.STOP_ANNOTATION: None,
                             nbapi.PREEMPTED_ANNOTATION: None,
                         }}}, key[0])
+                    # kftpu: ignore[await-race] release() runs only from this key's own reconcile (per-key workqueue serialization); the pop races no one
                     self._auto_resume.pop(key, None)
                     self._enqueue(key)
                 except ApiError:
@@ -1101,8 +1118,10 @@ class TpuFleetScheduler:
                                       drain.annotation
                                       or f"preempt:{drain.reason}",
                                       drain.requested_at)}}, key[0])
-            except ApiError:
-                pass
+            except ApiError as exc:
+                log.debug("drain-request re-stamp for %s/%s failed "
+                          "(grace fallback still fires): %s",
+                          key[0], key[1], exc)
         elif migration.drain_acked(ann):
             return await self._finalize_drain(key, nb, checkpointed=True,
                                               now=now)
@@ -1197,6 +1216,7 @@ class TpuFleetScheduler:
             if nb is None:
                 # CR gone mid-drain: nothing to stop; free the chips and
                 # let the waiters arbitrate.
+                # kftpu: ignore[await-race] re-validated after every await: the loop re-checks `key in self._draining` per iteration and every pop carries a default
                 self._draining.pop(key, None)
                 self._auto_resume.pop(key, None)
                 if self.policy.release(key) is not None:
@@ -1223,8 +1243,10 @@ class TpuFleetScheduler:
                                           drain.annotation
                                           or f"preempt:{drain.reason}",
                                           drain.requested_at)}}, key[0])
-                except ApiError:
-                    pass
+                except ApiError as exc:
+                    log.debug("drain-request sweep re-stamp for %s/%s "
+                              "failed (grace fallback still fires): %s",
+                              key[0], key[1], exc)
 
     # ---- elastic fleet (kubeflow_tpu/scheduler/elastic.py) ----------------------
 
@@ -1268,11 +1290,20 @@ class TpuFleetScheduler:
                 and now - self._last_elastic_at
                 < self.options.queued_requeue_seconds):
             return
-        self._last_elastic_gen = self.policy.gen
-        self._last_elastic_at = now
-        await self._sync_intents(now)
-        await self._maybe_defrag(now)
-        await self._evict_idle_borrowers(now)
+        async with self._elastic_lock:
+            # Re-check under the lock: the pass that held it ahead of us
+            # may have just done this generation's work.
+            if (self.policy.gen == self._last_elastic_gen
+                    and now - self._last_elastic_at
+                    < self.options.queued_requeue_seconds):
+                return
+            # kftpu: ignore[await-race] double-checked locking: the debounce pair is re-read under _elastic_lock right above before this write
+            self._last_elastic_gen = self.policy.gen
+            # kftpu: ignore[await-race] written with its pair under _elastic_lock after the re-check above
+            self._last_elastic_at = now
+            await self._sync_intents(now)
+            await self._maybe_defrag(now)
+            await self._evict_idle_borrowers(now)
 
     async def _evict_idle_borrowers(self, now: float) -> None:
         """Idle preemption at host granularity: a queued flexible gang
@@ -1316,8 +1347,10 @@ class TpuFleetScheduler:
                     await self.kube.create(
                         "ProvisioningRequest",
                         intent.to_provisioning_request(ns), ns)
-                except ApiError:
-                    pass  # best-effort mirror; the book is the truth
+                except ApiError as exc:
+                    # best-effort mirror; the book is the truth
+                    log.debug("scale-up intent CR create %s failed: %s",
+                              intent.name, exc)
                 for key in intent.for_keys:
                     nb = await self._get_notebook(key)
                     if nb is not None:
@@ -1346,14 +1379,18 @@ class TpuFleetScheduler:
                     try:
                         await self.kube.delete("ProvisioningRequest",
                                                intent.name, ns)
-                    except (NotFound, ApiError):
-                        pass
+                    except (NotFound, ApiError) as exc:
+                        log.debug("denied-intent CR delete %s failed "
+                                  "(recreate below may 409): %s",
+                                  intent.name, exc)
                     try:
                         await self.kube.create(
                             "ProvisioningRequest",
                             intent.to_provisioning_request(ns), ns)
-                    except ApiError:
-                        pass
+                    except ApiError as exc:
+                        log.debug("denied-intent CR recreate %s failed "
+                                  "(re-asserted on the next TTL): %s",
+                                  intent.name, exc)
         for intent in events.updated:
             # Keep the CR mirror honest about the current ask size.
             try:
@@ -1361,16 +1398,20 @@ class TpuFleetScheduler:
                     "ProvisioningRequest", intent.name,
                     {"spec": intent.to_provisioning_request(ns)["spec"]},
                     ns)
-            except (NotFound, ApiError):
-                pass  # denial probe / TTL renewal recreate it
+            except (NotFound, ApiError) as exc:
+                # denial probe / TTL renewal recreate it
+                log.debug("scale-up intent CR resize %s failed: %s",
+                          intent.name, exc)
         for intent, reason in events.withdrawn:
             with span("scale_up", event=reason, name=intent.name):
                 self.m_scale_up_events.labels(event=reason).inc()
                 try:
                     await self.kube.delete("ProvisioningRequest",
                                            intent.name, ns)
-                except (NotFound, ApiError):
-                    pass
+                except (NotFound, ApiError) as exc:
+                    log.debug("withdrawn-intent CR delete %s failed "
+                              "(janitor sweeps strays): %s",
+                              intent.name, exc)
         if book.intents:
             await self._probe_intent_denials(now)
         elif now >= getattr(self, "_intent_gc_next", 0.0):
@@ -1394,8 +1435,10 @@ class TpuFleetScheduler:
                 try:
                     await self.kube.delete("ProvisioningRequest",
                                            name_of(pr), ns)
-                except (NotFound, ApiError):
-                    pass
+                except (NotFound, ApiError) as exc:
+                    log.debug("stray-intent janitor delete %s failed "
+                              "(retried next sweep): %s",
+                              name_of(pr), exc)
         self.m_scale_up.set(len(book.intents))
 
     async def _probe_intent_denials(self, now: float) -> None:
@@ -1415,7 +1458,10 @@ class TpuFleetScheduler:
             try:
                 pr = await self.kube.get_or_none(
                     "ProvisioningRequest", intent.name, ns)
-            except ApiError:
+            except ApiError as exc:
+                log.debug("denial probe for intent %s failed (retried "
+                          "on the next probe throttle): %s",
+                          intent.name, exc)
                 continue
             conditions = deep_get(pr or {}, "status", "conditions",
                                   default=[]) or []
@@ -1536,7 +1582,14 @@ class TpuFleetScheduler:
         if not self._spot_reclaims:
             return
         for pool_name in list(self._spot_reclaims):
-            episode = self._spot_reclaims[pool_name]
+            # Re-validate after the drains awaited below: a concurrent
+            # sweep (admission and serving_admission both run this) can
+            # finish an episode and pop it while this task is awaiting a
+            # drain request — the stale snapshot key would KeyError and
+            # fail the whole reconcile into backoff.
+            episode = self._spot_reclaims.get(pool_name)
+            if episode is None:
+                continue
             victims = elastic.reclaimable(self.policy.ledger, pool_name)
             drains_out = not any(d.for_key == ("pool", pool_name)
                                  for d in self._draining.values())
@@ -1546,6 +1599,7 @@ class TpuFleetScheduler:
                 # Episode over: the pool left the fleet, or the
                 # revocation signal cleared with every resident drained.
                 # Re-open what remains of the pool.
+                # kftpu: ignore[await-race] re-validated after every await: the loop re-reads the episode via .get() per iteration (regression test test_concurrent_spot_sweep_survives_episode_removal) and the pop carries a default
                 self._spot_reclaims.pop(pool_name, None)
                 if pool_name in self.policy.ledger.unavailable:
                     self.policy.ledger.unavailable.discard(pool_name)
@@ -1566,6 +1620,7 @@ class TpuFleetScheduler:
                               victim=f"{alloc.key[0]}/{alloc.key[1]}",
                               workload="warmpool"):
                         self.policy.release(alloc.key)
+                        # kftpu: ignore[await-race] discard is idempotent and membership is re-derived per victim from the fresh ledger snapshot
                         self._warmpool_keys.discard(alloc.key)
                         await self._notify_warm_reclaimed(alloc.key)
                     continue
@@ -1684,6 +1739,7 @@ class TpuFleetScheduler:
             raise ApiError(
                 f"preemption stop patch for {key[0]}/{key[1]} failed; "
                 "retrying with backoff")
+        # kftpu: ignore[await-race] _retry_stop runs only from this key's own reconcile (per-key workqueue serialization); the pop races no one
         self._stop_pending.pop(key, None)
         return Admission("Preempted", reason=reason)
 
@@ -1709,8 +1765,10 @@ class TpuFleetScheduler:
                     nbapi.FLEX_POOL_ANNOTATION: flex_pool,
                     **migration.clear_drain_patch(),
                 }}}, namespace_of(nb))
-        except ApiError:
-            pass  # best-effort; the in-memory admitted_at still ranks
+        except ApiError as exc:
+            # best-effort; the in-memory admitted_at still ranks, and
+            # the holder's next reconcile self-heals the stamp
+            log.debug("admitted-at stamp for %s failed: %s", key, exc)
 
     async def _get_notebook(self, key: tuple) -> dict | None:
         ns, name = key

@@ -296,8 +296,10 @@ class InferenceServiceReconciler:
                                 {"metadata": {"annotations": {
                                     isvcapi.PARKED_AT_ANNOTATION: None,
                                 }}}, ns)
-                        except ApiError:
-                            pass
+                        except ApiError as exc:
+                            log.debug("parked-at clear for %s/%s after "
+                                      "warm restore failed (re-cleared "
+                                      "next pass): %s", ns, name, exc)
                         await self._event(
                             isvc, "Normal", "WarmRestored",
                             f"Scale-from-zero: replica 0 restoring from "
@@ -321,8 +323,9 @@ class InferenceServiceReconciler:
                     "InferenceService", name,
                     {"metadata": {"annotations": {
                         isvcapi.PARKED_AT_ANNOTATION: None}}}, ns)
-            except ApiError:
-                pass
+            except ApiError as exc:
+                log.debug("stale parked-at clear for %s/%s failed "
+                          "(re-cleared next pass): %s", ns, name, exc)
         await self._sync_flex_markers(isvc, desired)
         recorded = self._high_water.get(skey, 0)
         if desired > recorded and recorded:
@@ -338,6 +341,7 @@ class InferenceServiceReconciler:
         if desired < prev_high:
             await self._release_from(skey, desired, high=prev_high)
             await self._gc_replicas(isvc, ms, desired, prev_high)
+        # kftpu: ignore[await-race] _scale_to runs only from this service's own reconcile (per-key workqueue serialization); skey entries race no one
         self._high_water[skey] = desired
         return admitted, queued
 
@@ -441,6 +445,7 @@ class InferenceServiceReconciler:
             await self._release_from(skey, 0)
             # Everything is released: the next scale-from-zero is an
             # up-from-nothing, not a scale-down from the old count.
+            # kftpu: ignore[await-race] _park_all runs only from this service's own reconcile (per-key workqueue serialization)
             self._high_water[skey] = 0
             self.m_parks.inc()
             self.m_scale_events.labels(direction="zero").inc()
@@ -450,8 +455,10 @@ class InferenceServiceReconciler:
                     {"metadata": {"annotations": {
                         isvcapi.PARK_REQUESTED_ANNOTATION: None,
                         isvcapi.PARKED_AT_ANNOTATION: fmt_iso(now)}}}, ns)
-            except ApiError:
-                pass  # the replicas are parked; re-stamp next pass
+            except ApiError as exc:
+                # the replicas are parked; re-stamp next pass
+                log.debug("park stamp for %s/%s failed: %s", ns, name,
+                          exc)
         step = isvcapi.parked_checkpoint(annotations_of(isvc))
         await self._event(
             isvc, "Normal", "Parked",
@@ -477,8 +484,9 @@ class InferenceServiceReconciler:
                         await self.kube.patch(
                             "StatefulSet", sts_name,
                             {"spec": {"replicas": 0}}, ns)
-            except (NotFound, ApiError):
-                pass
+            except (NotFound, ApiError) as exc:
+                log.debug("replica park of %s failed (re-parked next "
+                          "pass): %s", sts_name, exc)
 
     async def _cancel_park(self, isvc: dict, ns: str, name: str, *,
                            parked: bool, now: float) -> None:
@@ -496,8 +504,9 @@ class InferenceServiceReconciler:
                 "InferenceService", name,
                 {"metadata": {"annotations": {
                     isvcapi.PARK_REQUESTED_ANNOTATION: None}}}, ns)
-        except ApiError:
-            pass
+        except ApiError as exc:
+            log.debug("park-request withdrawal for %s/%s failed "
+                      "(re-tried while demand holds): %s", ns, name, exc)
 
     # ---- releases / GC -----------------------------------------------------------
 
@@ -568,8 +577,10 @@ class InferenceServiceReconciler:
                 await self.kube.patch(
                     "InferenceService", name,
                     {"metadata": {"annotations": patch}}, ns)
-            except ApiError:
-                pass  # best-effort durable marker; re-synced next pass
+            except ApiError as exc:
+                # best-effort durable marker; re-synced next pass
+                log.debug("flex-marker sync for %s failed: %s", name,
+                          exc)
 
     async def _gc_replicas(self, isvc: dict, ms, desired: int,
                            prev_high: int) -> None:
@@ -822,10 +833,12 @@ class InferenceServiceReconciler:
             await self.kube.patch(
                 "InferenceService", name, {"status": status}, ns,
                 subresource="status")
+            # kftpu: ignore[await-race] per-service dedup cache written only from this key's own reconcile; worst case is one redundant status write
             self._last_status[skey] = {
                 k: v for k, v in status.items() if k != "conditions"}
-        except (NotFound, ApiError):
-            pass
+        except (NotFound, ApiError) as exc:
+            log.debug("serving status write for %s failed (refreshed "
+                      "next reconcile): %s", skey, exc)
 
     # ---- plumbing ----------------------------------------------------------------
 

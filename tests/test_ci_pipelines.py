@@ -51,10 +51,32 @@ def test_rendered_yaml_parses_with_invariants():
     analysis_step = next(
         s for s in steps if "python -m ci.analysis" in s.get("run", ""))
     assert "--json" in analysis_step["run"]
+    # ISSUE 15: the interprocedural layer's CI surface — SARIF so
+    # findings annotate the PR diff, the shared-state inventory (the
+    # pre-sharding audit artifact), and the <30 s runtime gate.
+    assert "--sarif analysis.sarif" in analysis_step["run"]
+    assert "--shared-state-report shared-state-report.json" \
+        in analysis_step["run"]
+    assert "--timings" in analysis_step["run"]
+    assert "--max-seconds 30" in analysis_step["run"]
     upload = next(s for s in steps
                   if s.get("uses", "").startswith("actions/upload-artifact"))
     assert upload["if"] == "always()"
     assert "analysis-findings.json" in upload["with"]["path"]
+    assert "shared-state-report.json" in upload["with"]["path"]
+    sarif_upload = next(
+        s for s in steps
+        if s.get("uses", "").startswith("github/codeql-action/upload-sarif"))
+    # always(): a FAILING analysis run is exactly when the annotations
+    # matter; one matrix leg only so the PR isn't double-annotated.
+    assert sarif_upload["if"].startswith("always()")
+    assert sarif_upload["with"]["sarif_file"] == "analysis.sarif"
+    # The upload needs an explicit security-events grant (default token
+    # is read-only), and fork-PR tokens can never write security events
+    # — the step must not redden the suite there.
+    assert tests_wf["jobs"]["pytest"]["permissions"][
+        "security-events"] == "write"
+    assert sarif_upload["continue-on-error"] is True
 
     kind_wf = docs["kind-integration.yaml"]
     kind_steps = kind_wf["jobs"]["kind"]["steps"]

@@ -1056,3 +1056,87 @@ async def test_fault_plan_spot_reclaim_schedule_is_deterministic():
             for p in ("cheap", "cheap", "other", "cheap")]
     assert hits == [False, True, False, False]
     assert plan.injected["spot_reclaim"] == 1
+
+
+# ---- ISSUE 15 regression tests: await-race true positives ----------------------
+
+
+async def test_concurrent_spot_sweep_survives_episode_removal():
+    """Two tasks run `_sweep_spot_reclaims` concurrently (admission and
+    serving_admission both drive it). The sweep awaits mid-loop — warm
+    teardown notifications, drain requests — and a concurrent sweep can
+    finish an episode and pop it in exactly that window. The pre-fix
+    code re-read `self._spot_reclaims[pool_name]` from a stale snapshot
+    of the keys and KeyError'd, failing the whole reconcile into
+    backoff (found by the await-race pass)."""
+    kube = FakeKube()
+    sched = TpuFleetScheduler(
+        kube,
+        SchedulerOptions(
+            fleet_spec="hot=v5e:2x2:1:spot,cold=v5e:2x2:1:spot"),
+        registry=Registry())
+    # One warm slot resident per pool: processing "hot" then awaits its
+    # teardown notification — the concurrency window.
+    assert await sched.warm_reserve(("ns", "slot-0"), namespace="ns",
+                                    accelerator="v5e", topology="2x2")
+    assert await sched.warm_reserve(("ns", "slot-1"), namespace="ns",
+                                    accelerator="v5e", topology="2x2")
+    allocs = sched.policy.ledger.allocations
+    hot_key = next(k for k, a in allocs.items() if "hot" in a.placements)
+    sched.note_spot_reclaim("hot", node="n0")
+    sched.note_spot_reclaim("cold", node="n1")
+
+    async def concurrent_sweep_finishes_cold(key):
+        # The other task's sweep completes cold's episode while this
+        # one is awaiting hot's warm-teardown notification.
+        sched._spot_reclaims.pop("cold", None)
+        sched.policy.ledger.unavailable.discard("cold")
+
+    sched.on_warm_reclaimed(concurrent_sweep_finishes_cold)
+    # Pre-fix: KeyError("cold") out of the sweep; post-fix it completes.
+    await sched._sweep_spot_reclaims(sched._now())
+    assert hot_key not in sched.policy.ledger.allocations
+    assert "cold" not in sched._spot_reclaims
+    assert "hot" in sched._spot_reclaims      # signal n0 still standing
+    kube.close_watches()
+
+
+async def test_concurrent_elastic_post_passes_serialize():
+    """Two reconcile workers entering the elastic post-pass with
+    different generations must SERIALIZE: IntentBook.sync computes a
+    delta and the CR mirror applies it over many await round trips —
+    interleaved passes apply stale deltas (an orphan ProvisioningRequest
+    only the throttled janitor ever collects). Pre-fix there was no
+    `_elastic_lock` and the second worker ran concurrently with the
+    first's in-flight sync (found by the await-race pass)."""
+    kube = FakeKube()
+    sched = TpuFleetScheduler(
+        kube, SchedulerOptions(fleet_spec="a=v5e:2x2:1",
+                               enable_elastic=True,
+                               queued_requeue_seconds=60.0),
+        registry=Registry())
+    running = 0
+    overlap = []
+
+    async def sync_stub(now):
+        nonlocal running
+        running += 1
+        overlap.append(running)
+        await asyncio.sleep(0.05)
+        running -= 1
+
+    async def noop(now):
+        pass
+
+    sched._sync_intents = sync_stub
+    sched._maybe_defrag = noop
+    sched._evict_idle_borrowers = noop
+    sched.policy.gen += 1
+    t1 = asyncio.create_task(sched._elastic_post(sched._now()))
+    await asyncio.sleep(0.01)        # t1 is inside its sync now
+    sched.policy.gen += 1            # an admission lands mid-sync
+    t2 = asyncio.create_task(sched._elastic_post(sched._now()))
+    await asyncio.gather(t1, t2)
+    # Both generations synced — but strictly one at a time.
+    assert overlap == [1, 1], overlap
+    kube.close_watches()

@@ -748,3 +748,98 @@ def test_warm_pool_contract_pass_clean_on_real_tree():
     ])
     report = run_passes(project, select={"warm-pool"})
     assert [f.rule for f in report.findings] == []
+
+
+# ---- ISSUE 15 regression tests: await-race true positives ----------------------
+
+
+async def test_wake_during_replenish_pass_is_not_lost():
+    """The replenisher's lost-wakeup bug (found by the await-race pass):
+    it cleared `_wake` AFTER `replenish()`, so a claim or reclaim whose
+    `_wake.set()` landed DURING the pass (its awaits interleave with
+    reconcile tasks) was erased, and the top-up slept a full replenish
+    interval instead of running immediately. With a wake landing
+    mid-pass, the next pass must start right away — not 30 s later."""
+    kube = FakeKube()
+    register_all(kube)
+    wp = WarmPoolManager(
+        kube, WarmPoolOptions(spec="ns/img:latest@v5e:2x2:1",
+                              replenish_seconds=30.0),
+        registry=Registry())
+    passes = []
+    orig = wp.replenish
+
+    async def instrumented():
+        passes.append(time.monotonic())
+        await orig()
+        if len(passes) == 1:
+            # A claim/reclaim signal lands while the pass is still
+            # finishing — in the pre-fix ordering the clear() that
+            # followed erased exactly this.
+            wp._wake.set()
+
+    wp.replenish = instrumented
+    task = asyncio.create_task(wp.run_replenisher())
+    try:
+        deadline = time.monotonic() + 3.0
+        while len(passes) < 2 and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        assert len(passes) >= 2, (
+            "wake set during the replenish pass was lost — the next "
+            "pass waited out the full replenish interval")
+    finally:
+        wp.stop()
+        await asyncio.wait_for(task, timeout=2)
+        kube.close_watches()
+
+
+async def test_claim_racing_replenish_leaves_no_ghost_reservation():
+    """The replenisher's ghost-reservation bug (found by the await-race
+    pass): `_replenish_pool` iterated a pre-reserve snapshot of the slot
+    list, so a claim that consumed a slot (deleted the STS, released its
+    reservation) while `_reserve`'s round trips were in flight left the
+    re-booked reservation attached to a slot that no longer exists —
+    chips held forever for nothing, the pool permanently under-filled.
+    After the fix the pass re-validates slot liveness after the reserve
+    and releases the ghost."""
+    kube = FakeKube()
+    register_all(kube)
+    sched = TpuFleetScheduler(
+        kube, SchedulerOptions(fleet_spec="pool-a=v5e:2x2:2"),
+        registry=Registry())
+    wp = WarmPoolManager(
+        kube, WarmPoolOptions(spec="ns/img:latest@v5e:2x2:1",
+                              replenish_seconds=999.0),
+        scheduler=sched, registry=Registry())
+    try:
+        await wp.replenish()            # slot p0 + its ledger reservation
+        slots = await wp._slots(wp.pools[0])
+        assert len(slots) == 1
+        slot = name_of(slots[0])
+        orig_reserve = wp._reserve
+        raced = []
+
+        async def racing_reserve(pool, slot_name):
+            if slot_name == slot and not raced:
+                raced.append(slot_name)
+                # The claim consumes the slot while this reserve's round
+                # trips are in flight: STS gone, reservation released —
+                # the original reserve below then re-books it (the ghost).
+                await kube.delete("StatefulSet", slot, pool.namespace)
+                await sched.warm_release((pool.namespace, slot))
+            return await orig_reserve(pool, slot_name)
+
+        wp._reserve = racing_reserve
+        await wp.replenish()
+        assert raced
+        # Every warm reservation must back a slot that actually exists.
+        for key, alloc in sched.policy.ledger.allocations.items():
+            if alloc.workload != "warmpool":
+                continue
+            ns, slot_name = key
+            assert await kube.get_or_none(
+                "StatefulSet", slot_name, ns) is not None, (
+                f"ghost warm reservation for consumed slot {key} — "
+                "chips booked for a slot no pass will ever free")
+    finally:
+        kube.close_watches()
