@@ -1348,6 +1348,232 @@ def chaos_soak(smoke: bool = False) -> dict:
     }
 
 
+def _ckpt_bench_tree(step: int, leaf_elems: int):
+    """Deterministic per-step training state: the fault-storm verifier
+    regenerates this to check a restore bit-exactly."""
+    import numpy as np
+
+    base = np.arange(leaf_elems, dtype=np.float32)
+    return {
+        "step": np.int64(step),
+        "params": {"w": base + step, "b": np.full(64, step, np.float32)},
+        "opt": {"m": base * 0.5 + step, "v": base * 0.25},
+    }
+
+
+def checkpoint_fabric(smoke: bool = False) -> dict:
+    """`bench.py checkpoint_fabric [--smoke]` — the checkpoint-fabric
+    acceptance gate (ISSUE 16). Four gates, all chip-free (tmp dirs +
+    a simulated object-store RTT):
+
+    1. snapshot-then-ack: `save_async` must return (the drain-ack
+       point) ≥3× faster than a synchronous save-and-wait drain;
+    2. delta < full: an incremental save of mostly-unchanged state
+       must upload fewer bytes than its full predecessor;
+    3. tiered restore: a staging-tier restore must beat the same
+       restore served from the (RTT-taxed) remote tier;
+    4. fault storm: under seeded crash-mid-upload / torn-manifest /
+       stale-staging / read-corruption injection, every restore must
+       return the last *committed* step bit-exactly — zero partial or
+       wrong-step restores (detected-and-refused manifests are the
+       fabric working, not a violation).
+    """
+    import random
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from kubeflow_tpu.checkpoint import (
+        CheckpointFabric,
+        CheckpointIntegrityError,
+    )
+    from kubeflow_tpu.runtime.metrics import Registry
+
+    leaf_elems = 1 << 12 if smoke else 1 << 14
+    reps = 3 if smoke else 5
+    op_delay = 0.002          # simulated per-op object-store round trip
+    chunk_bytes = 8 << 10     # ~8 chunks per leaf → RTT cost is visible
+
+    class _StormFaults:
+        """Seeded probabilistic storage faults (same knobs the chaos
+        soak's FaultPlan probes)."""
+
+        def __init__(self, seed: int):
+            self.rng = random.Random(seed)
+            self.injected: dict[str, int] = {}
+
+        def _roll(self, name: str, p: float) -> bool:
+            if self.rng.random() < p:
+                self.injected[name] = self.injected.get(name, 0) + 1
+                return True
+            return False
+
+        def should_crash_upload(self):
+            return self._roll("crash_upload", 0.01)
+
+        def should_fail_upload(self):
+            return self._roll("fail_upload", 0.02)
+
+        def should_tear_manifest(self, tier):
+            return self._roll("torn_manifest", 0.15)
+
+        def should_corrupt_read(self, tier):
+            return self._roll("corrupt_read", 0.05)
+
+        def should_skip_staging_commit(self):
+            return self._roll("stale_staging", 0.3)
+
+    root = tempfile.mkdtemp(prefix="ckpt-fabric-bench-")
+    try:
+        # -- gate 1: snapshot-ack vs synchronous drain --------------------
+        ack_times, sync_times = [], []
+        with CheckpointFabric(
+                os.path.join(root, "latency", "remote"),
+                staging_dir=os.path.join(root, "latency", "staging"),
+                chunk_bytes=chunk_bytes, full_interval=1,
+                remote_op_delay=op_delay, registry=Registry()) as fab:
+            step = 0
+            for _ in range(reps):
+                step += 1
+                t0 = time.perf_counter()
+                handle = fab.save_async(step, _ckpt_bench_tree(
+                    step, leaf_elems))
+                ack_times.append(time.perf_counter() - t0)
+                handle.result(60)     # drain the queue between trials
+                step += 1
+                t0 = time.perf_counter()
+                fab.save_async(step, _ckpt_bench_tree(
+                    step, leaf_elems)).result(60)
+                sync_times.append(time.perf_counter() - t0)
+        ack_ms = _median_sorted(sorted(ack_times)) * 1e3
+        sync_ms = _median_sorted(sorted(sync_times)) * 1e3
+        ack_speedup = sync_ms / max(ack_ms, 1e-9)
+
+        # -- gate 2: delta saves upload fewer bytes than full -------------
+        with CheckpointFabric(
+                os.path.join(root, "delta", "remote"),
+                chunk_bytes=chunk_bytes, full_interval=100,
+                registry=Registry()) as fab:
+            tree = _ckpt_bench_tree(1, leaf_elems)
+            h_full = fab.save_async(1, tree)
+            # Step advances; the big leaves stay put — the common shape
+            # of a between-steps checkpoint cadence.
+            tree2 = dict(tree, step=np.int64(2))
+            h_delta = fab.save_async(2, tree2)
+            h_full.result(60), h_delta.result(60)
+        full_bytes, delta_bytes = h_full.bytes_written, h_delta.bytes_written
+
+        # -- gate 3: staging restore beats remote restore -----------------
+        staging_times, remote_times = [], []
+        with CheckpointFabric(
+                os.path.join(root, "tiers", "remote"),
+                staging_dir=os.path.join(root, "tiers", "staging"),
+                chunk_bytes=chunk_bytes, remote_op_delay=op_delay,
+                registry=Registry()) as fab:
+            fab.save_async(1, _ckpt_bench_tree(1, leaf_elems)).result(60)
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fab.restore()
+                staging_times.append(time.perf_counter() - t0)
+            assert fab.last_restore["tier"] == "staging"
+            shutil.rmtree(fab.staging._chunk_dir)
+            os.makedirs(fab.staging._chunk_dir)
+            fab.staging._lru.clear()
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fab.restore()
+                remote_times.append(time.perf_counter() - t0)
+            assert fab.last_restore["tier"] == "remote"
+        staging_ms = _median_sorted(sorted(staging_times)) * 1e3
+        remote_ms = _median_sorted(sorted(remote_times)) * 1e3
+
+        # -- gate 4: fault storm — committed-step invariant ---------------
+        storm_steps = 20 if smoke else 60
+        faults = _StormFaults(seed=16)
+        violations: list[str] = []
+        commits = 0
+        restores = 0
+        reg = Registry()
+        with CheckpointFabric(
+                os.path.join(root, "storm", "remote"),
+                staging_dir=os.path.join(root, "storm", "staging"),
+                chunk_bytes=chunk_bytes, full_interval=4,
+                upload_retries=2, backoff_seconds=0.001,
+                faults=faults, registry=reg) as fab:
+            for step in range(1, storm_steps + 1):
+                fab.save_async(step, _ckpt_bench_tree(step, leaf_elems))
+                if step % 5 != 0:
+                    continue
+                fab.wait()           # settle so "committed" is stable
+                committed = fab.latest_step()
+                try:
+                    tree = fab.restore()
+                except FileNotFoundError:
+                    if committed is not None:
+                        violations.append(
+                            f"step {step}: committed={committed} but "
+                            f"restore found nothing")
+                    continue
+                except CheckpointIntegrityError:
+                    # Legal only when every candidate was torn/corrupt;
+                    # fallback exhaustion is detected refusal, not a
+                    # partial restore.
+                    continue
+                restores += 1
+                got = int(tree["step"])
+                if got != committed and not fab.last_restore["fallback"]:
+                    violations.append(
+                        f"step {step}: restored {got}, committed "
+                        f"{committed}, no fallback flagged")
+                want = _ckpt_bench_tree(got, leaf_elems)
+                for key in ("params", "opt"):
+                    for leaf, arr in want[key].items():
+                        if not np.array_equal(tree[key][leaf], arr):
+                            violations.append(
+                                f"step {step}: leaf {key}/{leaf} of "
+                                f"restored step {got} is a partial")
+            fab.wait()
+            final_committed = fab.latest_step()
+            commits = sum(1 for _ in fab.all_steps())
+            orphans = (fab.remote.orphaned_tmp_files()
+                       + fab.staging.orphaned_tmp_files())
+        if final_committed is None:
+            violations.append("fault storm ended with nothing committed")
+        if orphans:
+            violations.append(f"orphaned tmp files after close: {orphans}")
+
+        gates = {
+            "ack_speedup_ge_3x": ack_speedup >= 3.0,
+            "delta_lt_full_bytes": 0 < delta_bytes < full_bytes,
+            "staging_beats_remote": staging_ms < remote_ms,
+            "storm_zero_integrity_violations": not violations,
+        }
+        return {
+            "metric": "checkpoint_fabric",
+            "smoke": smoke,
+            "ack_ms": round(ack_ms, 3),
+            "sync_drain_ms": round(sync_ms, 3),
+            "ack_speedup": round(ack_speedup, 2),
+            "full_bytes": full_bytes,
+            "delta_bytes": delta_bytes,
+            "staging_restore_ms": round(staging_ms, 3),
+            "remote_restore_ms": round(remote_ms, 3),
+            "storm": {
+                "steps": storm_steps,
+                "restores_verified": restores,
+                "final_committed": final_committed,
+                "manifests_retained": commits,
+                "injected": dict(sorted(faults.injected.items())),
+                "violations": violations,
+            },
+            "gates": gates,
+            "pass": all(gates.values()),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _load_bench_artifact(path: str) -> dict | None:
     """A BENCH_r0x.json is either the raw bench JSON or a driver wrapper
     whose ``tail`` holds the JSON line (and sometimes a ``parsed``
@@ -2702,6 +2928,15 @@ if __name__ == "__main__":
         print(json.dumps(result))
         # CI gate: any invariant violation, wedged key, or a poison pill
         # that fails to quarantine/resume must fail the step.
+        if not result["pass"]:
+            sys.exit(1)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "checkpoint_fabric":
+        result = checkpoint_fabric(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(result))
+        # CI gate: snapshot-ack must beat a synchronous drain ≥3×, a
+        # delta must upload fewer bytes than its full, staging restore
+        # must beat remote, and the fault storm must end with zero
+        # partial/wrong-step restores.
         if not result["pass"]:
             sys.exit(1)
     elif len(sys.argv) >= 2 and sys.argv[1] == "coldstart":

@@ -31,7 +31,7 @@ from ci.analysis.core import (
 RULES = (
     "contract-tracing", "contract-apply-set", "contract-scheduler",
     "contract-migration", "contract-quarantine", "contract-elastic",
-    "contract-serving",
+    "contract-serving", "contract-checkpoint",
 )
 
 CONTROLLERS_DIR = "kubeflow_tpu/controllers"
@@ -54,6 +54,8 @@ QUEUE_FILE = "kubeflow_tpu/runtime/queue.py"
 SERVING_CONTROLLER = "kubeflow_tpu/serving/controller.py"
 SERVING_ENGINE = "kubeflow_tpu/serving/engine.py"
 SERVING_PHASES = ("autoscale", "warm_restore", "park")
+CHECKPOINT_FABRIC = "kubeflow_tpu/checkpoint/fabric.py"
+SDK_FILE = "kubeflow_tpu/sdk.py"
 
 
 # ---- AST query helpers -------------------------------------------------------
@@ -453,6 +455,96 @@ def _check_serving(project: Project) -> list[Finding]:
     return findings
 
 
+def _check_checkpoint(project: Project) -> list[Finding]:
+    """ISSUE 16: no drain path bypasses the checkpoint fabric. The
+    guard acks at snapshot and reports the durable commit; the
+    scheduler releases the restore guarantee only on the commit mark
+    (or explicitly falls back dirty) — losing any link reopens the
+    window where an acked-but-unuploaded checkpoint is treated as
+    durable."""
+    fab = project.get(CHECKPOINT_FABRIC)
+    if fab is None or fab.tree is None:
+        return _missing(project, CHECKPOINT_FABRIC,
+                        "the async checkpoint fabric (snapshot-then-ack, "
+                        "tiered restore) is the drain path's durability "
+                        "layer (ISSUE 16)", "contract-checkpoint")
+    findings = []
+    for needed in ("save_async", "SaveHandle", "restore"):
+        if not has_identifier(fab.tree, needed):
+            findings.append(Finding(
+                rule="contract-checkpoint", path=fab.path, line=1,
+                message=f"`{needed}` is gone from the fabric — the "
+                        "snapshot-then-ack surface the SDK guard drains "
+                        "through lost a capability"))
+    sdk = project.get(SDK_FILE)
+    if sdk is None or sdk.tree is None:
+        findings.extend(_missing(
+            project, SDK_FILE,
+            "the SDK guard owns the drain-save route",
+            "contract-checkpoint"))
+    else:
+        drain = find_def(sdk.tree, "_drain_save")
+        if drain is None:
+            findings.append(Finding(
+                rule="contract-checkpoint", path=sdk.path, line=1,
+                message="_drain_save is gone — the guard has no single "
+                        "choke point routing drains into the fabric"))
+        else:
+            if not calls_to(drain, "save_async"):
+                findings.append(Finding(
+                    rule="contract-checkpoint", path=sdk.path,
+                    line=drain.lineno,
+                    message="_drain_save no longer calls save_async — "
+                            "fabric drains would block the ack on the "
+                            "full upload (snapshot-then-ack regression)"))
+            if not calls_to(drain, "_try_ack"):
+                findings.append(Finding(
+                    rule="contract-checkpoint", path=sdk.path,
+                    line=drain.lineno,
+                    message="_drain_save no longer acks through _try_ack "
+                            "— the scheduler would never see the "
+                            "checkpoint and every drain would grace out"))
+        if not has_identifier(sdk.tree, "_try_commit_mark"):
+            findings.append(Finding(
+                rule="contract-checkpoint", path=sdk.path, line=1,
+                message="the guard no longer reports the durable commit "
+                        "(_try_commit_mark) — an acked snapshot would "
+                        "pass for a committed checkpoint forever"))
+    rt = project.get(SCHEDULER_RUNTIME)
+    if rt is not None and rt.tree is not None:
+        sweep = find_def(rt.tree, "_sweep_commits")
+        if sweep is None:
+            findings.append(Finding(
+                rule="contract-checkpoint", path=rt.path, line=1,
+                message="_sweep_commits is gone — acked-but-uncommitted "
+                        "drains would hold their restore guarantee open "
+                        "forever instead of falling back dirty"))
+        else:
+            if not (has_identifier(sweep, "m_drain_fallback")
+                    or calls_to(sweep, "inc")):
+                findings.append(Finding(
+                    rule="contract-checkpoint", path=rt.path,
+                    line=sweep.lineno,
+                    message="the commit-grace expiry no longer counts "
+                            "drain_fallback — silent loss of the "
+                            "acked-but-uncommitted signal"))
+            if not has_identifier(sweep, "mark_commit_dirty_patch"):
+                findings.append(Finding(
+                    rule="contract-checkpoint", path=rt.path,
+                    line=sweep.lineno,
+                    message="the commit-grace expiry no longer marks the "
+                            "checkpoint dirty — restore would trust a "
+                            "checkpoint whose upload never finished"))
+        if not calls_to(rt.tree, "checkpoint_committed"):
+            findings.append(Finding(
+                rule="contract-checkpoint", path=rt.path, line=1,
+                message="the scheduler never consults "
+                        "checkpoint_committed — the restore guarantee "
+                        "would be released on the ack, not the durable "
+                        "commit"))
+    return findings
+
+
 def _has_workload_guard(tree: ast.AST) -> bool:
     """A ``workload != "notebook"``-shaped compare (either operand
     order) — the victim-search exclusion for serving allocations."""
@@ -476,9 +568,10 @@ def _has_workload_guard(tree: ast.AST) -> bool:
 
 @analysis_pass(
     "contracts", RULES,
-    "architectural invariants from PRs 3-11: tracing phases, apply_set "
+    "architectural invariants from PRs 3-16: tracing phases, apply_set "
     "stages, scheduler gate, migration drains, quarantine observability, "
-    "elastic reclaim-safety, serving park protocol")
+    "elastic reclaim-safety, serving park protocol, checkpoint-fabric "
+    "drain routing")
 def check_contracts(project: Project):
     yield from _check_controllers(project)
     if project.full_tree:
@@ -487,3 +580,4 @@ def check_contracts(project: Project):
         yield from _check_quarantine(project)
         yield from _check_elastic(project)
         yield from _check_serving(project)
+        yield from _check_checkpoint(project)

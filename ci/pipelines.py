@@ -169,6 +169,13 @@ def workflow_tests() -> dict:
                         "gate failure)",
                         "python bench.py slo_overhead --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("Checkpoint-fabric smoke bench (snapshot-ack ≥3x "
+                        "faster than sync drain, delta < full bytes, "
+                        "staging restore beats remote, zero integrity "
+                        "violations under fault storm; exit 1 on gate "
+                        "failure)",
+                        "python bench.py checkpoint_fabric --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Cold-start smoke bench (warm-pool claim ≥3x "
                         "faster than cold in podsim, pool replenish + "
                         "reserve-first preemption, coldstart-canary "

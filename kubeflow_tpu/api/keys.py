@@ -70,6 +70,23 @@ NOTEBOOK_CHECKPOINT_PATH = "notebooks.kubeflow.org/checkpoint-path"
 NOTEBOOK_CHECKPOINT_STEP = "notebooks.kubeflow.org/checkpoint-step"
 NOTEBOOK_SUSPEND = "notebooks.kubeflow.org/suspend"
 
+# Checkpoint fabric (ISSUE 16) — the commit half of snapshot-then-ack:
+# checkpointed-at marks the snapshot ack (chips can free), committed-at
+# marks the durable upload landing. committed-for echoes the raw
+# drain-requested value (same clock-skew-immune echo as checkpointed-for);
+# commit-dirty records a hard stop that caught the upload still in
+# flight; upload-progress is the JWA-facing "k/N chunks"; restore-tier
+# records which tier served the last restore (staging vs remote).
+NOTEBOOK_CHECKPOINT_COMMITTED_AT = \
+    "notebooks.kubeflow.org/checkpoint-committed-at"
+NOTEBOOK_CHECKPOINT_COMMITTED_FOR = \
+    "notebooks.kubeflow.org/checkpoint-committed-for"
+NOTEBOOK_CHECKPOINT_COMMIT_DIRTY = \
+    "notebooks.kubeflow.org/checkpoint-commit-dirty"
+NOTEBOOK_CHECKPOINT_PROGRESS = \
+    "notebooks.kubeflow.org/checkpoint-upload-progress"
+NOTEBOOK_RESTORE_TIER = "notebooks.kubeflow.org/restore-tier"
+
 # Durable lifecycle timeline (PR 13, runtime/timeline.py): the compact
 # capped journal of lifecycle transitions that survives manager restarts.
 NOTEBOOK_TIMELINE = "notebooks.kubeflow.org/timeline"
@@ -208,6 +225,11 @@ OWNERS: dict[str, tuple[str, ...]] = {
     NOTEBOOK_CHECKPOINTED_FOR: _DRAIN_PROTOCOL_OWNERS,
     NOTEBOOK_CHECKPOINT_PATH: _DRAIN_PROTOCOL_OWNERS,
     NOTEBOOK_CHECKPOINT_STEP: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINT_COMMITTED_AT: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINT_COMMITTED_FOR: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINT_COMMIT_DIRTY: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_CHECKPOINT_PROGRESS: _DRAIN_PROTOCOL_OWNERS,
+    NOTEBOOK_RESTORE_TIER: _DRAIN_PROTOCOL_OWNERS,
     # Suspend is user/SDK intent; the controller reads it and parks.
     NOTEBOOK_SUSPEND: ("kubeflow_tpu/sdk", "kubeflow_tpu/web/"),
     # PR 13: ONE writer by design — the TimelineRecorder flush (driven

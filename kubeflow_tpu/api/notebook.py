@@ -124,6 +124,16 @@ CHECKPOINTED_FOR_ANNOTATION = keys.NOTEBOOK_CHECKPOINTED_FOR
 #   (KFTPU_RESTORE_CHECKPOINT_PATH / KFTPU_RESTORE_STEP) on re-admission.
 CHECKPOINT_PATH_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_PATH
 CHECKPOINT_STEP_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_STEP
+# - the checkpoint fabric's commit half (ISSUE 16): checkpointed-at is
+#   the snapshot ack (drain can finalize), committed-at is the durable
+#   upload landing; committed-for echoes the drain-requested value the
+#   commit answers; commit-dirty marks a hard stop that interrupted the
+#   upload; upload-progress ("k/N") and restore-tier feed JWA status.
+CHECKPOINT_COMMITTED_AT_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_COMMITTED_AT
+CHECKPOINT_COMMITTED_FOR_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_COMMITTED_FOR
+CHECKPOINT_COMMIT_DIRTY_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_COMMIT_DIRTY
+CHECKPOINT_PROGRESS_ANNOTATION = keys.NOTEBOOK_CHECKPOINT_PROGRESS
+RESTORE_TIER_ANNOTATION = keys.NOTEBOOK_RESTORE_TIER
 # - user-facing suspend/resume: present → drain-then-park; removed →
 #   un-park and restore. Set by kubectl/JWA or sdk.suspend().
 SUSPEND_ANNOTATION = keys.NOTEBOOK_SUSPEND

@@ -1,0 +1,40 @@
+"""Checkpoint fabric: crash-safe async multi-tier checkpoints.
+
+Two save paths share one package:
+
+- :class:`CheckpointManager` — the Orbax wrapper for in-notebook
+  training loops (PVC or ``gs://`` paths, sharded restore);
+- :class:`CheckpointFabric` — the drain-path fabric: snapshot-then-ack
+  (``save_async``), content-hashed chunks with an atomic manifest
+  commit, and tiered restore (host-local staging → object store) with
+  integrity fallback to the previous committed step.
+
+The :class:`kubeflow_tpu.sdk.CheckpointGuard` speaks to either: with a
+fabric it acks the drain at snapshot and reports the durable commit via
+the migration protocol's ``checkpoint-committed-at`` mark; with a plain
+manager it falls back to the synchronous save-then-ack path.
+"""
+
+from .fabric import (
+    CheckpointFabric,
+    CheckpointIntegrityError,
+    SaveHandle,
+)
+from .manager import CheckpointManager
+from .store import (
+    ChunkCorruptionError,
+    DirectoryTier,
+    StagingTier,
+    TornManifestError,
+)
+
+__all__ = [
+    "CheckpointFabric",
+    "CheckpointIntegrityError",
+    "CheckpointManager",
+    "ChunkCorruptionError",
+    "DirectoryTier",
+    "SaveHandle",
+    "StagingTier",
+    "TornManifestError",
+]

@@ -110,6 +110,10 @@ def scheduler_options():
         # gets it from here.
         enable_migration=migration.migration_enabled(),
         drain_grace_seconds=migration.drain_grace_seconds(),
+        # Checkpoint fabric (KFTPU_COMMIT_GRACE, defaults to the drain
+        # grace): how long the post-ack background upload may run before
+        # the park is marked commit-dirty.
+        commit_grace_seconds=migration.commit_grace_seconds(),
         # Elastic fleet (KFTPU_ELASTIC, default on): scale-up intents,
         # flex placement, spot reclaim, defrag. =off restores PR 5–7
         # scheduler behavior byte-for-byte; KFTPU_DEFRAG=off disables

@@ -2269,6 +2269,21 @@ def _migration_status_block(nb: dict, *, ready: int,
     checkpointed = annotations.get(nbapi.CHECKPOINTED_AT_ANNOTATION)
     if checkpointed:
         block["checkpointedAt"] = checkpointed
+    # Checkpoint fabric (ISSUE 16): the ack only promises a host-side
+    # snapshot — surface the durable-commit trio so JWA can distinguish
+    # "uploading (k/N chunks)" from committed, and flag a park whose
+    # upload never landed.
+    committed = annotations.get(nbapi.CHECKPOINT_COMMITTED_AT_ANNOTATION)
+    if committed:
+        block["committedAt"] = committed
+    if migration.commit_dirty(annotations):
+        block["commitDirty"] = True
+    progress = migration.upload_progress(annotations)
+    if progress is not None:
+        block["uploadProgress"] = f"{progress[0]}/{progress[1]}"
+    tier = migration.restore_tier(annotations)
+    if tier:
+        block["restoreTier"] = tier
     reason = migration.drain_reason(annotations)
     if reason:
         block["reason"] = reason

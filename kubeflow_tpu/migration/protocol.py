@@ -58,6 +58,7 @@ RESTORE_STEP_ENV = "KFTPU_RESTORE_STEP"
 MIGRATION_ENV = "KFTPU_MIGRATION"
 CULL_DRAIN_ENV = "KFTPU_CULL_DRAIN"
 DRAIN_GRACE_ENV = "KFTPU_DRAIN_GRACE"
+COMMIT_GRACE_ENV = "KFTPU_COMMIT_GRACE"
 
 
 def migration_enabled(environ=os.environ) -> bool:
@@ -87,6 +88,19 @@ def drain_grace_seconds(environ=os.environ) -> float:
     except ValueError:
         return DEFAULT_DRAIN_GRACE_SECONDS
     return value if value > 0 else DEFAULT_DRAIN_GRACE_SECONDS
+
+
+def commit_grace_seconds(environ=os.environ) -> float:
+    """``KFTPU_COMMIT_GRACE`` — seconds after the snapshot ack the
+    background upload may take before the park is marked commit-dirty
+    and the drain counted as a fallback. Defaults to the drain grace:
+    the upload gets the same patience the snapshot did."""
+    raw = environ.get(COMMIT_GRACE_ENV)
+    try:
+        value = float(raw) if raw is not None else 0.0
+    except ValueError:
+        value = 0.0
+    return value if value > 0 else drain_grace_seconds(environ)
 
 
 # ---- annotation readers --------------------------------------------------------
@@ -154,6 +168,49 @@ def drain_expired(annotations: dict, now: float, grace: float) -> bool:
         not drain_acked(annotations)
 
 
+def checkpoint_committed(annotations: dict) -> bool:
+    """Has the checkpoint fabric durably committed the checkpoint for
+    the CURRENT drain? Same echo discipline as :func:`drain_acked`: the
+    commit's ``checkpoint-committed-for`` must carry the raw
+    drain-requested value it answers, so a surviving commit mark from a
+    previous park can never satisfy a new drain. With the drain marks
+    already cleared (post-park), any committed-at mark counts — the
+    commit outliving the drain is exactly the success case."""
+    committed_raw = annotations.get(
+        nbapi.CHECKPOINT_COMMITTED_AT_ANNOTATION)
+    if not committed_raw:
+        return False
+    requested_raw = annotations.get(nbapi.DRAIN_REQUESTED_ANNOTATION)
+    if not requested_raw:
+        return True
+    echo = annotations.get(nbapi.CHECKPOINT_COMMITTED_FOR_ANNOTATION)
+    return echo == requested_raw
+
+
+def commit_dirty(annotations: dict) -> bool:
+    """True when a hard stop caught the upload still in flight — the
+    durable 'this park's checkpoint may be stale' marker."""
+    return bool(annotations.get(nbapi.CHECKPOINT_COMMIT_DIRTY_ANNOTATION))
+
+
+def upload_progress(annotations: dict) -> tuple[int, int] | None:
+    """(chunks done, chunks total) of the in-flight upload, or None."""
+    raw = annotations.get(nbapi.CHECKPOINT_PROGRESS_ANNOTATION) or ""
+    head, sep, tail = raw.partition("/")
+    if not sep:
+        return None
+    try:
+        done, total = int(head), int(tail)
+    except ValueError:
+        return None
+    return (done, total) if total > 0 and 0 <= done <= total else None
+
+
+def restore_tier(annotations: dict) -> str:
+    """Which tier served the last restore ("staging" / "remote" / "")."""
+    return annotations.get(nbapi.RESTORE_TIER_ANNOTATION) or ""
+
+
 def restore_hint(annotations: dict) -> tuple[str, int | None] | None:
     """(checkpoint path, step) to restore from, or None. The path alone
     is enough (CheckpointManager.restore defaults to the latest step);
@@ -206,6 +263,13 @@ def request_drain_patch(reason: str, now: float) -> dict:
         nbapi.DRAIN_REQUESTED_ANNOTATION: fmt_iso(now),
         nbapi.DRAIN_REASON_ANNOTATION: reason,
         nbapi.CHECKPOINTING_AT_ANNOTATION: None,
+        # A new drain cycle starts with a clean commit slate: the
+        # previous cycle's commit/dirty/progress marks must not satisfy
+        # or confuse this cycle's commit wait.
+        nbapi.CHECKPOINT_COMMITTED_AT_ANNOTATION: None,
+        nbapi.CHECKPOINT_COMMITTED_FOR_ANNOTATION: None,
+        nbapi.CHECKPOINT_COMMIT_DIRTY_ANNOTATION: None,
+        nbapi.CHECKPOINT_PROGRESS_ANNOTATION: None,
     }
 
 
@@ -230,6 +294,45 @@ def ack_patch(path: str, step: int, now: float,
     return patch
 
 
+def commit_patch(now: float, *, for_request: str | None = None) -> dict:
+    """The fabric's durable-commit mark, stamped by the SDK when the
+    background uploader lands the manifest + pointer. Distinct from
+    :func:`ack_patch` (the snapshot ack) — the scheduler frees chips on
+    the ack but only hard-releases the restore guarantee on this.
+    Clears the in-flight progress mark."""
+    patch = {
+        nbapi.CHECKPOINT_COMMITTED_AT_ANNOTATION: fmt_iso(now),
+        nbapi.CHECKPOINT_COMMIT_DIRTY_ANNOTATION: None,
+        nbapi.CHECKPOINT_PROGRESS_ANNOTATION: None,
+    }
+    if for_request is not None:
+        patch[nbapi.CHECKPOINT_COMMITTED_FOR_ANNOTATION] = for_request
+    return patch
+
+
+def progress_patch(done: int, total: int) -> dict:
+    """Upload progress ("k/N" chunks) for JWA's parked-uncommitted
+    status message."""
+    return {nbapi.CHECKPOINT_PROGRESS_ANNOTATION: f"{done}/{total}"}
+
+
+def mark_commit_dirty_patch(now: float) -> dict:
+    """Hard stop caught the upload in flight: the checkpoint annotations
+    still point at the last *committed* step, but this cycle's upload
+    never landed — mark the park dirty so status and restore policy can
+    say so. Stamped by the drain finalizer alongside the fallback."""
+    return {
+        nbapi.CHECKPOINT_COMMIT_DIRTY_ANNOTATION: fmt_iso(now),
+        nbapi.CHECKPOINT_PROGRESS_ANNOTATION: None,
+    }
+
+
+def restore_tier_patch(tier: str) -> dict:
+    """Record which tier served a restore ("staging" / "remote") for
+    JWA's restore-path status message; empty clears the mark."""
+    return {nbapi.RESTORE_TIER_ANNOTATION: tier or None}
+
+
 def clear_drain_patch(*, keep_checkpoint: bool = True,
                       keep_reason: bool = False) -> dict:
     """Drop the drain marks (re-admission, cancel, or hard-stop
@@ -251,5 +354,9 @@ def clear_drain_patch(*, keep_checkpoint: bool = True,
             nbapi.CHECKPOINTED_AT_ANNOTATION: None,
             nbapi.CHECKPOINT_PATH_ANNOTATION: None,
             nbapi.CHECKPOINT_STEP_ANNOTATION: None,
+            nbapi.CHECKPOINT_COMMITTED_AT_ANNOTATION: None,
+            nbapi.CHECKPOINT_COMMITTED_FOR_ANNOTATION: None,
+            nbapi.CHECKPOINT_COMMIT_DIRTY_ANNOTATION: None,
+            nbapi.CHECKPOINT_PROGRESS_ANNOTATION: None,
         })
     return patch

@@ -67,6 +67,15 @@ SLI_SPECS = (
     ("reconcile_latency", "KFTPU_SLO_RECONCILE_LATENCY",
      1.0, 0.999,
      "reconcile wall time per workqueue key across every controller"),
+    ("checkpoint_commit", "KFTPU_SLO_CHECKPOINT_COMMIT",
+     60.0, 0.99,
+     "checkpoint snapshot-ack to durable commit (the background upload "
+     "the drain SLI deliberately excludes; commit-grace timeouts count "
+     "as bad events even when the grace is below the objective)"),
+    ("restore", "KFTPU_SLO_RESTORE",
+     30.0, 0.99,
+     "checkpoint restore wall time through the tier fallthrough "
+     "(staging or object store), including integrity-fallback reads"),
 )
 
 # Multi-window set: the short window catches a fast burn the moment it

@@ -150,6 +150,20 @@ class _Drain:
 
 
 @dataclass
+class _CommitWait:
+    """Post-park commit watch (checkpoint fabric, ISSUE 16): the drain
+    acked at snapshot and the chips are already free, but the restore
+    guarantee is only hard-released when the background upload durably
+    commits — or the commit grace expires and the park is marked
+    commit-dirty (the drain then counts as a fallback, not a clean
+    drain)."""
+
+    reason: str
+    requested_at: float        # drain request — the commit SLI's t0
+    deadline: float            # requested_at-anchored commit grace
+
+
+@dataclass
 class SchedulerOptions:
     """Env contract (cmd/envconfig.py scheduler_options)."""
 
@@ -178,6 +192,11 @@ class SchedulerOptions:
     # on) is what turns it on.
     enable_migration: bool = False
     drain_grace_seconds: float = migration.DEFAULT_DRAIN_GRACE_SECONDS
+    # Checkpoint fabric (ISSUE 16): how long after the snapshot ack the
+    # background upload may run before the park is marked commit-dirty
+    # and the drain counted as a fallback (KFTPU_COMMIT_GRACE; defaults
+    # to the drain grace via cmd/envconfig.py).
+    commit_grace_seconds: float = migration.DEFAULT_DRAIN_GRACE_SECONDS
     # Elastic fleet (kubeflow_tpu/scheduler/elastic.py): scale-up
     # intents, flex (host-borrowing) placement, spot reclaim, defrag.
     # The DATACLASS default is off — bare construction keeps PR 5–7
@@ -260,6 +279,7 @@ class TpuFleetScheduler:
         # holds its chips while it checkpoints; finalized on ack or when
         # the grace deadline fires.
         self._draining: dict[tuple, _Drain] = {}
+        self._commit_waits: dict[tuple, _CommitWait] = {}
         self._fleet_next_try = 0.0
         # Debounce for full arbitration passes (see Admission below).
         self._last_pass_gen = -1
@@ -1148,6 +1168,21 @@ class TpuFleetScheduler:
             with span("checkpoint_ack", key=f"{key[0]}/{key[1]}",
                       waited=round(now - drain.requested_at, 3)):
                 self.m_drain.observe(now - drain.requested_at)
+            # Snapshot-then-ack (checkpoint fabric): the ack frees the
+            # chips, but the durable upload may still be in flight —
+            # watch for the commit mark until the commit grace expires,
+            # at which point the park is marked dirty and the drain
+            # counted as a fallback after all (satellite: an acked drain
+            # whose upload never landed is NOT a clean drain).
+            ann_now = annotations_of(nb) if nb is not None else {}
+            if migration.checkpoint_committed(ann_now):
+                slo.observe("checkpoint_commit", now - drain.requested_at,
+                            key=key, trace_id=current_trace_id())
+            else:
+                self._commit_waits[key] = _CommitWait(
+                    reason=drain.reason,
+                    requested_at=drain.requested_at,
+                    deadline=now + self.options.commit_grace_seconds)
         else:
             self.m_drain_fallback.inc()
         # Drain-roundtrip SLI: ack-less grace fallbacks count as bad
@@ -1201,6 +1236,56 @@ class TpuFleetScheduler:
                     f"without a checkpoint ({drain.reason} preemption)")
         return Admission("Preempted", reason=drain.reason)
 
+    async def _sweep_commits(self, now: float) -> None:
+        """Advance every post-park commit watch: a commit mark closes it
+        with a good ``checkpoint_commit`` SLI event; an expired commit
+        grace marks the park commit-dirty, counts the drain as a
+        fallback, and records the full elapsed time as a bad event.
+        Runs with the drain sweep on every admission/release pass."""
+        for key, wait in list(self._commit_waits.items()):
+            nb = await self._get_notebook(key)
+            if self._commit_waits.get(key) is not wait:
+                continue  # resolved by a concurrent sweep in the await
+            ann = annotations_of(nb) if nb is not None else {}
+            if nb is not None and migration.checkpoint_committed(ann):
+                # kftpu: ignore[await-race] re-validated after the await: the identity check above skips watches a concurrent sweep already resolved
+                self._commit_waits.pop(key, None)
+                with span("checkpoint_commit", key=f"{key[0]}/{key[1]}",
+                          waited=round(now - wait.requested_at, 3)):
+                    slo.observe("checkpoint_commit",
+                                now - wait.requested_at,
+                                key=key, trace_id=current_trace_id())
+                continue
+            if now < wait.deadline:
+                continue
+            self._commit_waits.pop(key, None)
+            self.m_drain_fallback.inc()
+            # A commit that never landed is a bad event by definition —
+            # a short KFTPU_COMMIT_GRACE must not let the timeout slip
+            # under the SLI objective and count as a fast commit.
+            slo.observe("checkpoint_commit",
+                        max(now - wait.requested_at,
+                            slo.objective_for("checkpoint_commit")[0]
+                            + 0.001),
+                        key=key, trace_id=current_trace_id())
+            if nb is None:
+                continue
+            try:
+                await self.kube.patch(
+                    "Notebook", key[1],
+                    {"metadata": {"annotations":
+                                  migration.mark_commit_dirty_patch(now)}},
+                    key[0])
+            except ApiError as exc:
+                log.warning("commit-dirty patch for %s/%s failed: %s",
+                            key[0], key[1], exc)
+            await self._event(
+                nb, "Warning", "CheckpointCommitTimeout",
+                f"Checkpoint upload did not commit within "
+                f"{self.options.commit_grace_seconds:.0f}s of the drain "
+                f"request; parked checkpoint marked dirty "
+                f"({wait.reason})")
+
     async def _sweep_drains(self, now: float, skip: tuple | None = None) \
             -> None:
         """Advance every in-flight drain that is not being handled inline
@@ -1208,6 +1293,7 @@ class TpuFleetScheduler:
         re-patch victims whose request annotation never landed. Runs on
         every admission/release pass, so a waiter's safety-net requeue is
         enough to guarantee deadlines fire."""
+        await self._sweep_commits(now)
         for key in list(self._draining):
             if key == skip or key not in self._draining:
                 continue

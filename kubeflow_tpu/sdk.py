@@ -498,13 +498,21 @@ class CheckpointGuard:
     doesn't re-save every step.
 
     **Drain protocol** (kubeflow_tpu/migration): when the drain-requested
-    annotation appears, the guard snapshots immediately, waits for the
-    commit, and **acks** by patching the checkpointed-at / path / step
-    annotations onto its own CR — the control plane then parks the gang
-    and, on re-admission, stamps the same path/step back into the pod env
-    as the restore hint. After the ack the loop may keep stepping; the
-    park arrives as a normal scale-to-zero. ``drained`` reports that an
-    ack was committed this session.
+    annotation appears, the guard saves immediately and **acks** by
+    patching the checkpointed-at / path / step annotations onto its own
+    CR — the control plane then parks the gang and, on re-admission,
+    stamps the same path/step back into the pod env as the restore hint.
+    With a :class:`kubeflow_tpu.checkpoint.CheckpointFabric` manager the
+    save is snapshot-then-ack: the ack goes out as soon as device arrays
+    are copied to host, the background uploader finishes during graceful
+    termination, and the durable-commit mark
+    (``checkpoint-committed-at``) lands when the manifest does — call
+    :meth:`close` (or use the guard as a context manager) so teardown
+    blocks on the commit. With a plain CheckpointManager the legacy
+    synchronous save-wait-ack runs and the ack carries the commit mark.
+    After the ack the loop may keep stepping; the park arrives as a
+    normal scale-to-zero. ``drained`` reports that an ack was committed
+    this session.
 
     **Multi-host:** an Orbax save is a collective — every process must
     save the *same* step. Per-worker watchers poll on their own clocks,
@@ -529,8 +537,21 @@ class CheckpointGuard:
         self._environ = environ
         self._patcher = patcher
         self._ack_pending_step: int | None = None
+        # Durable-commit patch that failed (flaky apiserver) — retried on
+        # sync steps and flushed by close(), like the ack retry. Holds
+        # (for_request,) so an echo-less retry is still distinguishable
+        # from "nothing pending".
+        self._commit_pending: tuple[str | None] | None = None
+        self._restore_tier_stamped = False
+        self._progress_last = 0.0
         self._warned_local_only = False
         self.drained = False
+
+    @property
+    def _fabric(self) -> bool:
+        """Snapshot-then-ack is available when the manager speaks the
+        checkpoint fabric's async surface."""
+        return hasattr(self.manager, "save_async")
 
     def _local_signals(self) -> int:
         ann = self.watcher.annotations()
@@ -578,18 +599,26 @@ class CheckpointGuard:
         signals."""
         return bool(self._signals_coordinated() & _MAINTENANCE_BIT)
 
-    def _try_ack(self, step: int) -> None:
-        """Patch the checkpoint ack onto this notebook's CR (process 0
-        only — one writer). Failure re-arms the pending ack; the next
-        sync step retries without re-saving."""
+    def _is_writer(self) -> bool:
+        """Annotation patches are process-0 only — one writer."""
         try:
             import jax
 
-            if jax.process_count() > 1 and jax.process_index() != 0:
-                self._ack_pending_step = None
-                return
-        except Exception:  # kftpu: ignore[exception-swallow] uninitialized jax backend/client ⇒ treat as single-process and fall through to the local ack path
-            pass
+            return jax.process_count() <= 1 or jax.process_index() == 0
+        except Exception:  # uninitialized jax backend ⇒ single-process
+            return True
+
+    def _try_ack(self, step: int, *, committed: bool = False) -> None:
+        """Patch the checkpoint ack onto this notebook's CR (process 0
+        only — one writer). Failure re-arms the pending ack; the next
+        sync step retries without re-saving. ``committed=True`` (the
+        synchronous legacy path, where the save is already durable when
+        the ack goes out) folds the commit mark into the same patch; the
+        fabric path stamps it separately from the uploader's commit
+        callback."""
+        if not self._is_writer():
+            self._ack_pending_step = None
+            return
         if self._patcher is None:
             try:
                 self._patcher = _identity_patcher(self._environ)
@@ -604,13 +633,47 @@ class CheckpointGuard:
         # controller — skew must not make acks invisible).
         for_request = self.watcher.annotations().get(
             DRAIN_REQUESTED_ANNOTATION)
+        now = time.time()
+        patch = _migration.ack_patch(
+            directory, step, now, for_request=for_request)
+        if committed:
+            patch.update(_migration.commit_patch(
+                now, for_request=for_request))
         try:
-            self._patcher(_migration.ack_patch(
-                directory, step, time.time(), for_request=for_request))
+            self._patcher(patch)
             self._ack_pending_step = None
         except Exception:  # noqa: BLE001 — flaky apiserver; retry later
             _log.warning("drain ack patch failed; retrying next sync step")
             self._ack_pending_step = step
+
+    def _try_commit_mark(self, for_request: str | None) -> None:
+        """Stamp the durable-commit mark (fabric uploader callback, or a
+        sync-step / close() retry after a failed stamp)."""
+        if not self._is_writer() or self._patcher is None:
+            self._commit_pending = None
+            return
+        try:
+            self._patcher(_migration.commit_patch(
+                time.time(), for_request=for_request))
+            self._commit_pending = None
+        except Exception:  # noqa: BLE001 — flaky apiserver; retry later
+            _log.warning("checkpoint commit mark failed; retrying")
+            self._commit_pending = (for_request,)
+
+    def _mark_progress(self, done: int, total: int) -> None:
+        """Best-effort, rate-limited "k/N chunks" progress mark (JWA's
+        parked-uncommitted message). Runs on the uploader thread."""
+        if not self._is_writer() or self._patcher is None:
+            return
+        now = time.monotonic()
+        if done < total and now - self._progress_last < 0.5:
+            return
+        self._progress_last = now
+        try:
+            self._patcher(_migration.progress_patch(done, total))
+        except Exception:  # noqa: BLE001 — purely a UI progress mark
+            _log.debug("upload progress mark failed (best-effort)",
+                       exc_info=True)
 
     def _mark_checkpointing(self) -> None:
         """Best-effort progress mark so the UI can say "Checkpointing…"
@@ -631,18 +694,61 @@ class CheckpointGuard:
             _log.debug("checkpointing-at progress mark failed "
                        "(best-effort)", exc_info=True)
 
+    def _drain_save(self, step: int, pytree) -> bool:
+        """One drain checkpoint. With the fabric: snapshot-then-ack —
+        ``save_async`` returns once device arrays are copied to host, the
+        ack goes out immediately, and the uploader's commit callback
+        stamps the durable-commit mark when the manifest lands (the
+        scheduler's commit wait watches for it). Without the fabric:
+        the legacy synchronous save-wait-ack, with the commit mark folded
+        into the ack (the save IS durable by then)."""
+        if not self._fabric:
+            saved = self.manager.save(step, pytree, force=True)
+            self.manager.wait()  # the ack promises a COMMITTED save
+            self._try_ack(step, committed=True)
+            return saved
+        # Echo captured NOW: the commit must answer the drain that
+        # triggered this save even if a new drain lands mid-upload.
+        for_request = self.watcher.annotations().get(
+            DRAIN_REQUESTED_ANNOTATION)
+        self.manager.save_async(
+            step, pytree,
+            on_progress=self._mark_progress,
+            on_commit=lambda _step, _secs:
+                self._try_commit_mark(for_request))
+        self._try_ack(step)  # snapshot done — ack before the upload
+        return True
+
+    def _mark_restore_tier(self) -> None:
+        """Best-effort, once: record which tier served the fabric's
+        restore ("staging" / "remote") so JWA can say "Restoring from
+        local staging tier" vs "…from object storage"."""
+        self._restore_tier_stamped = True
+        last = getattr(self.manager, "last_restore", None)
+        if not last or not last.get("tier"):
+            return
+        if not self._is_writer() or self._patcher is None:
+            return
+        try:
+            self._patcher(_migration.restore_tier_patch(last["tier"]))
+        except Exception:  # noqa: BLE001 — purely a UI mark
+            _log.debug("restore tier mark failed (best-effort)",
+                       exc_info=True)
+
     def step(self, step: int, pytree) -> bool:
         if step % self.sync_every_steps == 0:
+            if not self._restore_tier_stamped:
+                self._mark_restore_tier()
             if self._ack_pending_step is not None:
                 self._try_ack(self._ack_pending_step)
+            if self._commit_pending is not None:
+                self._try_commit_mark(self._commit_pending[0])
             signals = self._signals_coordinated()
             if signals & _DRAIN_BIT:
                 if self._drain_armed:
                     self._drain_armed = False
                     self._mark_checkpointing()
-                    saved = self.manager.save(step, pytree, force=True)
-                    self.manager.wait()  # the ack promises a COMMITTED save
-                    self._try_ack(step)
+                    saved = self._drain_save(step, pytree)
                     self.drained = True
                     return saved
             else:
@@ -656,6 +762,25 @@ class CheckpointGuard:
             else:
                 self._armed = True
         return self.manager.save(step, pytree)
+
+    def close(self) -> None:
+        """Teardown: block until any in-flight async save durably
+        commits (the fabric's close() waits on its uploader, leaving no
+        orphaned temp files), then flush a commit mark whose patch
+        failed. Safe to call twice; the graceful-termination path after
+        a park runs this so the upload outlives the ack."""
+        close = getattr(self.manager, "close", None)
+        if callable(close):
+            close()
+        if self._commit_pending is not None:
+            self._try_commit_mark(self._commit_pending[0])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 def _main() -> None:

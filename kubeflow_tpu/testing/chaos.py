@@ -14,7 +14,13 @@ global invariants every convergence must restore:
 - no orphaned or duplicate slice StatefulSets (and none for Queued gangs);
 - every drain terminal — Parked, restored, or hard-stopped — none wedged;
 - every workqueue fully drained, no key stuck at max backoff forever
-  (transient quarantines must release through the escape hatch).
+  (transient quarantines must release through the escape hatch);
+- **committed-step restore** (ISSUE 16): drain acks run REAL
+  ``CheckpointFabric`` saves against on-disk tiers while storage faults
+  (crash-mid-upload, torn manifests, read corruption, stale staging
+  pointers) blow through the storm — at every convergence each
+  notebook's restore must yield a bit-exact member of its durably
+  committed step set, never a partial.
 
 ``bench.py chaos_soak [--smoke]`` runs this over ≥5 seeds as the CI
 gate; tests/test_chaos.py replays the same seeds in tier-1.
@@ -23,12 +29,21 @@ gate; tests/test_chaos.py replays the same seeds in tier-1.
 from __future__ import annotations
 
 import asyncio
+import os
 import random
+import shutil
+import tempfile
 import time
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from kubeflow_tpu.api import keys
 from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.checkpoint import (
+    CheckpointFabric,
+    CheckpointIntegrityError,
+)
 from kubeflow_tpu.controllers.notebook import (
     NotebookOptions,
     setup_notebook_controller,
@@ -99,6 +114,18 @@ class SoakConfig:
     quarantine_after: int = 25
     drain_grace_seconds: float = 2.0
     converge_timeout: float = 30.0
+    # Checkpoint-fabric storage faults (ISSUE 16): each drain ack runs a
+    # REAL CheckpointFabric save (snapshot-then-ack, background upload)
+    # against per-notebook on-disk tiers that survive manager kills;
+    # these rates arm crash-mid-upload, torn-manifest, read-corruption,
+    # and stale-staging-pointer windows during the storm. The committed-
+    # step invariant then checks every restore at convergence. Rates are
+    # probed PER CHUNK (the fabric's saves here are ~7 chunks), so the
+    # per-save crash probability is roughly 1-(1-rate)^7.
+    crash_upload_rate: float = 0.08
+    torn_manifest_rate: float = 0.2
+    corrupt_read_rate: float = 0.15
+    stale_staging_rate: float = 0.3
 
     @property
     def controller_namespace(self) -> str:
@@ -117,6 +144,12 @@ class SoakReport:
     spot_revocations: int = 0
     scale_up_grants: int = 0
     scale_up_denials: int = 0
+    # Checkpoint fabric under the storm: durable commits the simulated
+    # SDK landed, uploads the crash fault killed, and restores the
+    # committed-step invariant verified at convergence.
+    checkpoint_commits: int = 0
+    checkpoint_crashes: int = 0
+    restores_checked: int = 0
     problems: list = field(default_factory=list)
 
     @property
@@ -135,6 +168,9 @@ class SoakReport:
             "spot_revocations": self.spot_revocations,
             "scale_up_grants": self.scale_up_grants,
             "scale_up_denials": self.scale_up_denials,
+            "checkpoint_commits": self.checkpoint_commits,
+            "checkpoint_crashes": self.checkpoint_crashes,
+            "restores_checked": self.restores_checked,
             "problems": list(self.problems),
             "ok": self.ok,
         }
@@ -329,6 +365,21 @@ class ChaosSoak:
         # grants rewrite it.
         self._fleet_spec = config.fleet
         self._spot_nodes: list[str] = []
+        # Checkpoint fabric per notebook — POD-side state rooted on disk,
+        # so it survives manager kills like a real pod's tiers would.
+        self._fabric_root = tempfile.mkdtemp(prefix="kftpu-chaos-ckpt-")
+        self._fabrics: dict[tuple, CheckpointFabric] = {}
+        self._fabric_steps: dict[tuple, int] = {}
+        # In-flight async saves: key → [(handle, step, raw drain echo)];
+        # the SDK loop polls these and stamps the commit mark. A list —
+        # rapid drain cycles can overlap uploads (the fabric serializes
+        # them, the harness must not lose one).
+        self._pending_commits: dict[tuple, list] = {}
+        # Last (step, raw) saved per key: an ack retry for the SAME
+        # drain re-patches without re-snapshotting (guard semantics).
+        self._last_save: dict[tuple, tuple] = {}
+        # What the harness KNOWS committed (the invariant's ground truth).
+        self._committed_steps: dict[tuple, set[int]] = {}
 
     # -- stack lifecycle -----------------------------------------------------
 
@@ -415,6 +466,16 @@ class ChaosSoak:
                        rate=cfg.fault_rate / 2)
         self.plan.reset_watch(rate=cfg.watch_reset_rate)
         self.plan.stale_list(rate=cfg.stale_list_rate)
+        # Storage faults ride the same storm (lifted by plan.clear()):
+        # the fabrics hold the plan itself, so these windows open and
+        # close with the API faults. What a fault LEAVES on disk (a torn
+        # manifest, partial chunks, a stale staging pointer) persists
+        # into the fault-free restore check — that durable damage is the
+        # thing the committed-step invariant interrogates.
+        self.plan.crash_upload(rate=cfg.crash_upload_rate)
+        self.plan.tear_manifest("remote", rate=cfg.torn_manifest_rate)
+        self.plan.corrupt_read(rate=cfg.corrupt_read_rate)
+        self.plan.stale_staging(rate=cfg.stale_staging_rate)
         self.kube.use_faults(self.plan)
 
     def _lift_faults(self) -> None:
@@ -628,13 +689,42 @@ class ChaosSoak:
             except ApiError:
                 pass
 
+    def _fabric_for(self, key: tuple) -> CheckpointFabric:
+        """The notebook's pod-side fabric: on-disk remote + staging tiers
+        under the soak's temp root, tiny chunks so every save is
+        multi-chunk (the crash-mid-upload window needs chunks to crash
+        between), and the soak's FaultPlan as the storage-fault hook."""
+        fab = self._fabrics.get(key)
+        if fab is None:
+            ns, name = key
+            base = os.path.join(self._fabric_root, ns, name)
+            fab = CheckpointFabric(
+                os.path.join(base, "remote"),
+                staging_dir=os.path.join(base, "staging"),
+                chunk_bytes=64, keep=4, full_interval=3,
+                upload_retries=2, backoff_seconds=0.005,
+                registry=Registry(), faults=self.plan)
+            self._fabrics[key] = fab
+        return fab
+
+    def _step_tree(self, key: tuple, step: int) -> dict:
+        """Deterministic per-(notebook, step) training state — restored
+        content is verified against a regeneration of exactly this, so a
+        partial or cross-step mix of chunks cannot pass."""
+        offset = (hash(key) & 0xFFFF) / 7.0
+        return {"w": np.arange(48.0) * (step + 1) + offset,
+                "step": np.int64(step)}
+
     async def _ack_drains(self, only: tuple | None = None) -> None:
         """The simulated in-pod SDK: answer any un-acked drain request
-        with a committed checkpoint (echoing the raw request value, as
-        CheckpointGuard does)."""
+        the way CheckpointGuard-over-the-fabric does — a REAL
+        ``save_async`` (host snapshot) then an immediate ack echoing the
+        raw request value; the background upload's commit is stamped by
+        :meth:`_poll_commits` when (and only when) it durably lands."""
         for ns, name in list(self._nb_names):
             if only is not None and (ns, name) != only:
                 continue
+            key = (ns, name)
             try:
                 nb = await self.kube.get_or_none("Notebook", name, ns)
             except ApiError:
@@ -645,19 +735,168 @@ class ChaosSoak:
             raw = ann.get(nbapi.DRAIN_REQUESTED_ANNOTATION)
             if not raw or migration.drain_acked(ann):
                 continue
+            fab = self._fabric_for(key)
+            last = self._last_save.get(key)
+            if last is not None and last[1] == raw:
+                # Ack-patch retry for the same drain: the snapshot is
+                # done, only the annotation failed — do NOT re-save.
+                step = last[0]
+            else:
+                # A previous drain's upload may still be in flight — the
+                # fabric's queue serializes saves, so snapshot-and-ack
+                # again without waiting (exactly what the guard does).
+                step = self._fabric_steps.get(key, 0) + 1
+                self._fabric_steps[key] = step
+                handle = fab.save_async(step, self._step_tree(key, step))
+                self._pending_commits.setdefault(key, []).append(
+                    (handle, step, raw))
+                self._last_save[key] = (step, raw)
             try:
                 await self.kube.patch(
                     "Notebook", name,
                     {"metadata": {"annotations": migration.ack_patch(
-                        f"/ckpt/{name}", self.rng.randrange(10_000),
+                        fab.directory, step,
                         time.time(), for_request=raw)}}, ns)
             except ApiError:
-                pass
+                pass  # the next SDK tick re-acks; the save is not redone
+
+    async def _kick_checkpoints(self) -> None:
+        """Deterministic fabric exercise, once per storm round: a burst
+        of real snapshot-then-ack saves per notebook while the storage
+        fault storm is blowing. Drains alone are rng-paced and a seed
+        can legitimately schedule almost none — which would leave the
+        committed-step invariant vacuous (zero commits, zero restores
+        checked). The tier-1 seeds assert the invariant actually ran,
+        so the exercise is unconditional, like :meth:`_kick_elastic`."""
+        keys = sorted(self._nb_names)[:3]
+        for key in keys:
+            for _ in range(3):
+                fab = self._fabric_for(key)
+                step = self._fabric_steps.get(key, 0) + 1
+                self._fabric_steps[key] = step
+                handle = fab.save_async(step, self._step_tree(key, step))
+                self._pending_commits.setdefault(key, []).append(
+                    (handle, step, None))
+        # A mid-storm restore against each fabric that already has a
+        # durable commit: the read-corruption and slow-tier faults are
+        # live HERE (the convergence-time check runs fault-free against
+        # whatever damage the storm left), so this drives the hash-
+        # verify fall-through under fire. A clean refusal is legal;
+        # whatever DOES come back must regenerate bit-exact — a torn or
+        # cross-step mix of chunks can never leak into the loop.
+        for key in keys:
+            if not self._committed_steps.get(key):
+                continue
+            fab = self._fabrics[key]
+            try:
+                tree = await asyncio.to_thread(fab.restore)
+            except (CheckpointIntegrityError, FileNotFoundError):
+                continue
+            step = int(tree["step"])
+            expect = self._step_tree(key, step)
+            if not np.array_equal(tree["w"], expect["w"]):
+                self.report.problems.append(
+                    f"{key[0]}/{key[1]}: mid-storm restore returned a "
+                    f"partial for step {step}")
+            else:
+                self.report.restores_checked += 1
+
+    async def _poll_commits(self) -> None:
+        """Resolve finished uploads: committed → stamp the durable-commit
+        mark (retrying on injected patch failures) and record the step in
+        the harness's committed set; crashed → count it and drop (that
+        step must never be restored — the invariant checks exactly
+        this)."""
+        for key, entries in list(self._pending_commits.items()):
+            for entry in list(entries):
+                handle, step, raw = entry
+                if not handle.done():
+                    continue
+                if not handle.committed:
+                    self.report.checkpoint_crashes += 1
+                    entries.remove(entry)
+                    continue
+                # The fabric's pointer advance IS the ground truth —
+                # record it now; the annotation mark below is protocol
+                # bookkeeping and must not gate the invariant's
+                # committed set (the CR may be deleted, the patch may
+                # hit injected faults).
+                if step not in self._committed_steps.setdefault(key, set()):
+                    self._committed_steps[key].add(step)
+                    self.report.checkpoint_commits += 1
+                ns, name = key
+                try:
+                    nb = await self.kube.get_or_none("Notebook", name, ns)
+                    if nb is not None:
+                        await self.kube.patch(
+                            "Notebook", name,
+                            {"metadata": {"annotations":
+                                          migration.commit_patch(
+                                              time.time(),
+                                              for_request=raw)}}, ns)
+                except ApiError:
+                    continue  # retry the mark next tick
+                entries.remove(entry)
+            if not entries:
+                self._pending_commits.pop(key, None)
 
     async def _sdk_loop(self, stop: asyncio.Event) -> None:
         while not stop.is_set():
             await self._ack_drains()
+            await self._poll_commits()
             await asyncio.sleep(0.05)
+
+    async def _check_restores(self) -> list[str]:
+        """THE checkpoint-fabric invariant (ISSUE 16): after convergence,
+        every notebook with at least one durably committed step restores
+        to a member of its committed set with bit-exact content — a
+        crash-mid-upload or torn manifest never yields a restored
+        partial; integrity damage falls back to an earlier committed
+        step, never raises a partial into the training loop. Runs
+        fault-free (the storm is lifted), against whatever damage the
+        storm left on disk."""
+        problems: list[str] = []
+        for key, committed in sorted(self._committed_steps.items()):
+            fab = self._fabrics.get(key)
+            if fab is None or not committed:
+                continue
+            await asyncio.to_thread(fab.wait)
+            try:
+                tree = await asyncio.to_thread(fab.restore)
+            except CheckpointIntegrityError:
+                # Every committed manifest torn: the fabric REFUSED to
+                # restore rather than hand back a partial — the
+                # invariant is about never restoring damage, and a
+                # clean refusal honors it.
+                continue
+            except FileNotFoundError:
+                problems.append(
+                    f"{key[0]}/{key[1]}: committed steps "
+                    f"{sorted(committed)} but no committed pointer "
+                    f"found on restore")
+                continue
+            except Exception as e:  # noqa: BLE001 — anything else leaked
+                problems.append(
+                    f"{key[0]}/{key[1]}: restore raised into the "
+                    f"training loop: {type(e).__name__}: {e}")
+                continue
+            self.report.restores_checked += 1
+            info = fab.last_restore or {}
+            step = info.get("step")
+            if step not in committed:
+                problems.append(
+                    f"{key[0]}/{key[1]}: restored step {step} is not a "
+                    f"committed step (committed: {sorted(committed)}) — "
+                    f"a partial/crashed checkpoint was restored")
+                continue
+            want = self._step_tree(key, step)
+            if not (np.array_equal(tree.get("w"), want["w"])
+                    and int(tree.get("step", -1)) == step):
+                problems.append(
+                    f"{key[0]}/{key[1]}: restored step {step} content "
+                    f"mismatch — torn or cross-step chunk mix passed "
+                    f"verification")
+        return problems
 
     # -- convergence ---------------------------------------------------------
 
@@ -751,6 +990,7 @@ class ChaosSoak:
             for round_no in range(cfg.rounds):
                 self.report.rounds += 1
                 self._arm_faults()
+                await self._kick_checkpoints()
                 t_end = time.monotonic() + cfg.storm_seconds
                 kill_at = time.monotonic() + cfg.storm_seconds * \
                     self.rng.uniform(0.3, 0.7)
@@ -772,6 +1012,9 @@ class ChaosSoak:
                     await self.mgr.start()
                 for p in await self._converge_and_check():
                     self.report.problems.append(f"round {round_no}: {p}")
+                for p in await self._check_restores():
+                    self.report.problems.append(
+                        f"round {round_no} restore: {p}")
         finally:
             sdk_stop.set()
             sdk_task.cancel()
@@ -786,6 +1029,9 @@ class ChaosSoak:
             await self.mgr.stop()
             self.kube.use_faults(None)
             self.kube.close_watches()
+            for fab in self._fabrics.values():
+                await asyncio.to_thread(fab.close)
+            shutil.rmtree(self._fabric_root, ignore_errors=True)
         return self.report
 
 

@@ -162,6 +162,38 @@ class _WatchResetRule:
         return True
 
 
+class _StorageRule:
+    """One storage-fault schedule (checkpoint fabric): matches a tier
+    glob, fires after every ``every``-th probe or with probability
+    ``rate``, bounded by ``times``; ``seconds`` carries the slow-tier
+    delay."""
+
+    def __init__(self, tiers: str = "*", *, rate: float = 0.0,
+                 every: int | None = None, times: int | None = None,
+                 seconds: float = 0.0):
+        self.tiers = tiers
+        self.rate = rate
+        self.every = every
+        self.times = times
+        self.seconds = seconds
+        self.triggered = 0
+        self._seen = 0
+
+    def consume(self, rng: random.Random, tier: str = "") -> bool:
+        if not fnmatch.fnmatch(tier or "", self.tiers):
+            return False
+        if self.times is not None and self.triggered >= self.times:
+            return False
+        self._seen += 1
+        if self.every is not None:
+            if self._seen % self.every:
+                return False
+        elif rng.random() >= self.rate:
+            return False
+        self.triggered += 1
+        return True
+
+
 class FaultPlan:
     """Deterministic, seeded API fault schedule for :class:`FakeKube`.
 
@@ -177,6 +209,14 @@ class FaultPlan:
       snapshot of the kind (an old-resourceVersion read) — informer
       caches must self-correct on a later relist.
 
+    Storage faults (checkpoint fabric, ISSUE 16) ride the same plan:
+    the fabric duck-types its ``faults`` object against the
+    ``should_*``/``storage_delay`` probes below, so a plan armed with
+    :meth:`crash_upload` / :meth:`tear_manifest` / :meth:`corrupt_read`
+    / :meth:`slow_tier` / :meth:`stale_staging` drives the
+    crash-mid-upload, torn-manifest, read-corruption, slow-tier, and
+    stale-staging-pointer windows deterministically.
+
     All randomness comes from one ``random.Random(seed)``: the same seed
     over the same request sequence replays the same fault schedule.
     """
@@ -188,6 +228,16 @@ class FaultPlan:
         self._watch_rules: list[_WatchResetRule] = []
         self._stale_rules: list[FaultRule] = []
         self._reclaim_rules: list[_WatchResetRule] = []
+        # Storage-fault buckets (one per fabric probe). The storage RNG
+        # is separate so arming checkpoint faults never perturbs the API
+        # fault schedule of an existing seed.
+        self._storage_rng = random.Random((seed << 4) ^ 0x5EED)
+        self._crash_upload_rules: list[_StorageRule] = []
+        self._fail_upload_rules: list[_StorageRule] = []
+        self._tear_rules: list[_StorageRule] = []
+        self._corrupt_rules: list[_StorageRule] = []
+        self._slow_rules: list[_StorageRule] = []
+        self._stale_staging_rules: list[_StorageRule] = []
         # Per-error injection counts — the soak report and tests assert
         # faults actually fired.
         self.injected: dict[str, int] = defaultdict(int)
@@ -237,16 +287,129 @@ class FaultPlan:
                 return True
         return False
 
+    # ---- storage faults (checkpoint fabric) ------------------------------------
+
+    def crash_upload(self, *, rate: float = 0.0, every: int | None = None,
+                     times: int | None = None) -> _StorageRule:
+        """Kill the uploading process mid-chunk-stream: the fabric aborts
+        the upload with partial chunks in the remote tier and NO commit —
+        the chaos invariant is that such a step is never restored."""
+        rule = _StorageRule(rate=rate, every=every, times=times)
+        self._crash_upload_rules.append(rule)
+        return rule
+
+    def fail_upload(self, *, rate: float = 0.0, every: int | None = None,
+                    times: int | None = None) -> _StorageRule:
+        """Transient upload error — the fabric's bounded retry/backoff
+        must absorb it (unlike :meth:`crash_upload`, which is fatal to
+        the attempt)."""
+        rule = _StorageRule(rate=rate, every=every, times=times)
+        self._fail_upload_rules.append(rule)
+        return rule
+
+    def tear_manifest(self, tiers: str = "*", *, rate: float = 0.0,
+                      every: int | None = None,
+                      times: int | None = None) -> _StorageRule:
+        """Write a truncated manifest at the final path (non-atomic
+        backend / partial replication) — restore's self-checksum must
+        refuse it and fall back."""
+        rule = _StorageRule(tiers, rate=rate, every=every, times=times)
+        self._tear_rules.append(rule)
+        return rule
+
+    def corrupt_read(self, tiers: str = "*", *, rate: float = 0.0,
+                     every: int | None = None,
+                     times: int | None = None) -> _StorageRule:
+        """Flip bits on a chunk read — hash verification must catch it
+        (staging corruption falls through to remote; remote corruption
+        falls back a step)."""
+        rule = _StorageRule(tiers, rate=rate, every=every, times=times)
+        self._corrupt_rules.append(rule)
+        return rule
+
+    def slow_tier(self, tiers: str = "*", *, seconds: float,
+                  rate: float = 1.0, every: int | None = None,
+                  times: int | None = None) -> _StorageRule:
+        """Add per-operation latency to a tier (a degraded disk or an
+        overloaded object store)."""
+        rule = _StorageRule(tiers, rate=rate, every=every, times=times,
+                            seconds=seconds)
+        self._slow_rules.append(rule)
+        return rule
+
+    def stale_staging(self, *, rate: float = 0.0, every: int | None = None,
+                      times: int | None = None) -> _StorageRule:
+        """Silently skip the staging tier's pointer advance — restore
+        must trust the remote committed pointer, never the stale local
+        one."""
+        rule = _StorageRule(rate=rate, every=every, times=times)
+        self._stale_staging_rules.append(rule)
+        return rule
+
+    # Fabric-facing probes (duck-typed; see kubeflow_tpu/checkpoint).
+
+    def should_crash_upload(self) -> bool:
+        for rule in self._crash_upload_rules:
+            if rule.consume(self._storage_rng):
+                self.injected["storage_crash_upload"] += 1
+                return True
+        return False
+
+    def should_fail_upload(self) -> bool:
+        for rule in self._fail_upload_rules:
+            if rule.consume(self._storage_rng):
+                self.injected["storage_fail_upload"] += 1
+                return True
+        return False
+
+    def should_tear_manifest(self, tier: str) -> bool:
+        for rule in self._tear_rules:
+            if rule.consume(self._storage_rng, tier):
+                self.injected["storage_torn_manifest"] += 1
+                return True
+        return False
+
+    def should_corrupt_read(self, tier: str) -> bool:
+        for rule in self._corrupt_rules:
+            if rule.consume(self._storage_rng, tier):
+                self.injected["storage_read_corrupt"] += 1
+                return True
+        return False
+
+    def storage_delay(self, tier: str) -> float:
+        total = 0.0
+        for rule in self._slow_rules:
+            if rule.consume(self._storage_rng, tier):
+                self.injected["storage_slow_tier"] += 1
+                total += rule.seconds
+        return total
+
+    def should_skip_staging_commit(self) -> bool:
+        for rule in self._stale_staging_rules:
+            if rule.consume(self._storage_rng):
+                self.injected["storage_stale_staging"] += 1
+                return True
+        return False
+
     def clear(self) -> None:
         """Lift every fault (rules stay readable for their counters)."""
         self.rules = []
         self._watch_rules = []
         self._stale_rules = []
         self._reclaim_rules = []
+        self._crash_upload_rules = []
+        self._fail_upload_rules = []
+        self._tear_rules = []
+        self._corrupt_rules = []
+        self._slow_rules = []
+        self._stale_staging_rules = []
 
     def drop(self, rule) -> None:
         for bucket in (self.rules, self._watch_rules, self._stale_rules,
-                       self._reclaim_rules):
+                       self._reclaim_rules, self._crash_upload_rules,
+                       self._fail_upload_rules, self._tear_rules,
+                       self._corrupt_rules, self._slow_rules,
+                       self._stale_staging_rules):
             if rule in bucket:
                 bucket.remove(rule)
 
@@ -278,7 +441,10 @@ class FaultPlan:
             "seed": self.seed,
             "injected": dict(sorted(self.injected.items())),
             "active_rules": len(self.rules) + len(self._watch_rules)
-            + len(self._stale_rules) + len(self._reclaim_rules),
+            + len(self._stale_rules) + len(self._reclaim_rules)
+            + len(self._crash_upload_rules) + len(self._fail_upload_rules)
+            + len(self._tear_rules) + len(self._corrupt_rules)
+            + len(self._slow_rules) + len(self._stale_staging_rules),
         }
 
 

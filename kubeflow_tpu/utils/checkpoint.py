@@ -1,101 +1,8 @@
-"""Checkpoint/resume for in-notebook training — Orbax over PVC or GCS.
+"""Compatibility shim: the checkpoint stack moved to
+:mod:`kubeflow_tpu.checkpoint` (the fabric absorbed this module).
+Import :class:`CheckpointManager` from there; existing
+``kubeflow_tpu.utils`` imports keep working through this re-export."""
 
-The reference's checkpoint story is PVC persistence: ``$HOME`` survives
-stop/start cycles (SURVEY.md §5; base image ``01-copy-tmp-home``). This
-module completes the TPU side: a thin, opinionated wrapper over Orbax
-that handles the slice realities —
+from kubeflow_tpu.checkpoint.manager import CheckpointManager
 
-- **Multi-host**: every worker participates in the save (Orbax writes a
-  per-process shard and the coordinator commits atomically), so a
-  ``gs://`` path works from an N-host slice out of the box. A PVC path
-  works single-host (RWO volumes mount on one worker).
-- **Preemption/culling**: saves are atomic (Orbax's commit protocol), so
-  a slice culled or restarted mid-save resumes from the last complete
-  step; ``restore_latest`` finds it.
-- **Sharding-aware restore**: pass an ``abstract`` pytree (from
-  ``jax.eval_shape`` + shardings) and arrays come back placed on the
-  mesh, not gathered to host.
-
-Usage in a notebook::
-
-    mgr = CheckpointManager("gs://bucket/run7", keep=3)
-    step = mgr.latest_step()
-    if step is not None:
-        params = mgr.restore(step, abstract=jax.eval_shape(init, key))
-    ...
-    mgr.save(step, params)          # every worker calls this
-"""
-
-from __future__ import annotations
-
-import os
-from typing import Any
-
-
-class CheckpointManager:
-    """Orbax CheckpointManager with slice-friendly defaults."""
-
-    def __init__(self, directory: str, *, keep: int = 3,
-                 save_interval_steps: int = 1):
-        import orbax.checkpoint as ocp
-
-        self._ocp = ocp
-        # Local paths must be absolute for Orbax; bucket schemes pass
-        # through (gs:// via tensorstore).
-        if "://" not in directory:
-            directory = os.path.abspath(directory)
-        self.directory = directory
-        self.manager = ocp.CheckpointManager(
-            directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep,
-                save_interval_steps=save_interval_steps,
-                # keep the step directories atomic-committed; partial
-                # writes from a culled slice are invisible to restore.
-                enable_async_checkpointing=True,
-            ),
-        )
-
-    # ---- save ----------------------------------------------------------------
-
-    def save(self, step: int, pytree: Any, *, force: bool = False) -> bool:
-        """Save (async). Every process of a multi-host slice must call
-        this with its shard of the (possibly sharded) pytree."""
-        return self.manager.save(
-            step,
-            args=self._ocp.args.StandardSave(pytree),
-            force=force,
-        )
-
-    def wait(self) -> None:
-        """Block until in-flight async saves committed (call before exit)."""
-        self.manager.wait_until_finished()
-
-    # ---- restore -------------------------------------------------------------
-
-    def latest_step(self) -> int | None:
-        return self.manager.latest_step()
-
-    def restore(self, step: int | None = None, *, abstract: Any = None) -> Any:
-        """Restore ``step`` (default latest). With ``abstract`` (a pytree
-        of ShapeDtypeStruct, e.g. from ``jax.eval_shape``, optionally
-        carrying ``sharding``), arrays restore sharded onto the mesh."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        if abstract is not None:
-            args = self._ocp.args.StandardRestore(abstract)
-        else:
-            args = self._ocp.args.StandardRestore()
-        return self.manager.restore(step, args=args)
-
-    def close(self) -> None:
-        self.wait()
-        self.manager.close()
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
+__all__ = ["CheckpointManager"]

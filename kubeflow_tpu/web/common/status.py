@@ -258,11 +258,27 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
         if ready == 0:
             if mig.get("state") == "Parked":
                 step = mig.get("checkpointStep")
-                return Status(
-                    STOPPED,
-                    f"Suspended (checkpoint @ step {step})"
-                    if step is not None else "Suspended (checkpoint saved)",
-                )
+                base = (f"Suspended (checkpoint @ step {step})"
+                        if step is not None
+                        else "Suspended (checkpoint saved)")
+                # Checkpoint fabric: the park happened at the snapshot
+                # ack — say so while the durable upload is still in
+                # flight, and flag a park whose upload never landed
+                # (restore may fall back to an older committed step).
+                if mig.get("commitDirty"):
+                    return Status(
+                        WARNING,
+                        base + " — checkpoint upload did not complete; "
+                        "restore may use an older committed step",
+                    )
+                if (mig.get("uploadProgress")
+                        and not mig.get("committedAt")):
+                    return Status(
+                        STOPPED,
+                        base + f" — checkpoint uploading "
+                        f"({mig['uploadProgress']} chunks)",
+                    )
+                return Status(STOPPED, base)
             return Status(STOPPED, "No Pods are currently running for this Notebook Server.")
         return Status(WAITING, "Notebook Server is stopping.")
 
@@ -274,9 +290,15 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
     # partial-readiness message below.
     if mig.get("state") == "Restoring" and ready < want_hosts:
         step = mig.get("checkpointStep")
+        # Checkpoint fabric: name the tier that served the restore —
+        # a staging hit is the fast path, object storage the fallback.
+        tier = mig.get("restoreTier")
+        source = {"staging": "Restoring from local staging tier",
+                  "remote": "Restoring from object storage"}.get(
+                      tier, "Restoring from checkpoint")
         return Status(
             WAITING,
-            "Restoring from checkpoint"
+            source
             + (f" (step {step})" if step is not None else "")
             + f" ({ready}/{want_hosts} workers ready)",
         )

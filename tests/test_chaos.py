@@ -34,6 +34,16 @@ async def _assert_soak(seed: int) -> tuple:
     # The storm actually stormed — a soak that injected nothing proves
     # nothing.
     assert sum(d["injected"].values()) > 0
+    # Checkpoint fabric (ISSUE 16): the committed-step invariant must
+    # have actually run — real fabric saves durably committed under the
+    # storage-fault storm, and every checked restore came back a member
+    # of the committed set with bit-exact content (a vacuous pass with
+    # zero commits would prove nothing). The deterministic per-round
+    # _kick_checkpoints burst guarantees this for every seed.
+    assert d["checkpoint_commits"] > 0
+    assert d["restores_checked"] > 0
+    assert sum(v for k, v in d["injected"].items()
+               if k.startswith("storage_")) > 0
     return d, soak
 
 

@@ -1,0 +1,357 @@
+"""Checkpoint fabric unit tests: the content-addressed store, the
+atomic manifest commit, tiered restore, and the async fabric's
+integrity fallback (ISSUE 16).
+
+These run against real directories (tmp_path) — the store IS the
+durable format, so the tests assert on bytes-on-disk behaviour, not
+mocks.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.checkpoint import (
+    CheckpointFabric,
+    CheckpointIntegrityError,
+    ChunkCorruptionError,
+    DirectoryTier,
+    StagingTier,
+    TornManifestError,
+)
+from kubeflow_tpu.checkpoint.store import (
+    chunk_hash,
+    decode_manifest,
+    encode_manifest,
+    split_chunks,
+)
+from kubeflow_tpu.runtime.metrics import Registry
+
+
+# ---- fault stubs (duck-typed, like FaultPlan's storage hooks) ------------------
+
+
+class _Faults:
+    """Programmable storage faults: each knob fires for its first N
+    probes, then stays quiet."""
+
+    def __init__(self, *, tear=0, corrupt=0, crash=0, fail=0,
+                 skip_staging_commit=0):
+        self.tear = tear
+        self.corrupt = corrupt
+        self.crash = crash
+        self.fail = fail
+        self.skip_staging_commit = skip_staging_commit
+
+    def _take(self, attr) -> bool:
+        left = getattr(self, attr)
+        if left > 0:
+            setattr(self, attr, left - 1)
+            return True
+        return False
+
+    def should_tear_manifest(self, tier):
+        return self._take("tear")
+
+    def should_corrupt_read(self, tier):
+        return self._take("corrupt")
+
+    def should_crash_upload(self):
+        return self._take("crash")
+
+    def should_fail_upload(self):
+        return self._take("fail")
+
+    def should_skip_staging_commit(self):
+        return self._take("skip_staging_commit")
+
+
+def _tree(step: int):
+    return {
+        "step": step,
+        "w": np.arange(64, dtype=np.float32) + step,
+        "opt": {"m": np.zeros(16, dtype=np.float32),
+                "v": np.ones(16, dtype=np.float32)},
+    }
+
+
+def _fabric(tmp_path, *, staging=True, faults=None, **kw):
+    kw.setdefault("chunk_bytes", 64)
+    kw.setdefault("registry", Registry())
+    return CheckpointFabric(
+        str(tmp_path / "remote"),
+        staging_dir=str(tmp_path / "staging") if staging else None,
+        faults=faults, **kw)
+
+
+# ---- manifest codec ------------------------------------------------------------
+
+
+def test_manifest_roundtrip_is_bit_exact():
+    m = {"step": 7, "kind": "full",
+         "leaves": [{"key": "/w", "dtype": "float32", "shape": [4],
+                     "chunks": ["ab", "cd"]}],
+         "tree": {"__leaf__": 0}}
+    assert decode_manifest(encode_manifest(m)) == m
+
+
+def test_truncated_manifest_is_refused():
+    raw = encode_manifest({"step": 1, "leaves": [], "tree": {}})
+    with pytest.raises(TornManifestError):
+        decode_manifest(raw[: len(raw) // 2])
+
+
+def test_bitflipped_manifest_is_refused():
+    raw = bytearray(encode_manifest({"step": 1, "leaves": [], "tree": {}}))
+    # Flip a digit inside the step value — still valid JSON, wrong body.
+    idx = raw.index(b'"step":1') + len(b'"step":')
+    raw[idx] = ord("2")
+    with pytest.raises(TornManifestError, match="checksum"):
+        decode_manifest(bytes(raw))
+
+
+def test_non_object_manifest_is_refused():
+    with pytest.raises(TornManifestError):
+        decode_manifest(b"[1,2,3]")
+
+
+def test_split_chunks_covers_every_byte():
+    data = os.urandom(1000)
+    pieces = split_chunks(data, 256)
+    assert b"".join(pieces) == data
+    assert all(len(p) <= 256 for p in pieces)
+    assert chunk_hash(data) == chunk_hash(b"".join(pieces))
+
+
+# ---- DirectoryTier -------------------------------------------------------------
+
+
+def test_put_chunk_is_idempotent_second_write_is_free(tmp_path):
+    tier = DirectoryTier(str(tmp_path))
+    data = b"x" * 100
+    digest = chunk_hash(data)
+    assert tier.put_chunk(digest, data) == 100
+    assert tier.put_chunk(digest, data) == 0  # the delta path
+    assert tier.get_chunk(digest) == data
+    assert tier.orphaned_tmp_files() == []
+
+
+def test_get_chunk_detects_bit_rot_on_disk(tmp_path):
+    tier = DirectoryTier(str(tmp_path))
+    data = b"y" * 100
+    digest = chunk_hash(data)
+    tier.put_chunk(digest, data)
+    with open(tier._chunk_path(digest), "r+b") as fh:
+        fh.write(b"Z")
+    with pytest.raises(ChunkCorruptionError):
+        tier.get_chunk(digest)
+
+
+def test_commit_pointer_two_phase_advance(tmp_path):
+    tier = DirectoryTier(str(tmp_path))
+    assert tier.committed_step() is None
+    tier.commit(3)
+    assert tier.committed_step() == 3
+    tier.commit(5)
+    assert tier.committed_step() == 5
+    assert tier.orphaned_tmp_files() == []
+
+
+def test_torn_manifest_fault_lands_truncated_bytes(tmp_path):
+    tier = DirectoryTier(str(tmp_path), faults=_Faults(tear=1))
+    tier.put_manifest(1, {"step": 1, "leaves": [], "tree": {}})
+    with pytest.raises(TornManifestError):
+        tier.get_manifest(1)
+    # The fault fired once; the rewrite lands intact.
+    tier.put_manifest(1, {"step": 1, "leaves": [], "tree": {}})
+    assert tier.get_manifest(1)["step"] == 1
+
+
+def test_gc_keeps_live_chunks_only(tmp_path):
+    tier = DirectoryTier(str(tmp_path))
+    live = chunk_hash(b"live")
+    dead = chunk_hash(b"dead")
+    tier.put_chunk(live, b"live")
+    tier.put_chunk(dead, b"dead")
+    assert tier.gc({live}) == 4
+    assert tier.has_chunk(live)
+    assert not tier.has_chunk(dead)
+
+
+# ---- StagingTier ---------------------------------------------------------------
+
+
+def test_staging_evicts_lru_by_bytes_touch_on_read(tmp_path):
+    tier = StagingTier(str(tmp_path), max_bytes=350)
+    chunks = {}
+    for name in ("a", "b", "c"):
+        data = name.encode() * 100
+        chunks[name] = chunk_hash(data)
+        tier.put_chunk(chunks[name], data)
+    # Touch "a" so "b" becomes the LRU victim.
+    tier.get_chunk(chunks["a"])
+    tier.put_chunk(chunk_hash(b"d" * 100), b"d" * 100)
+    assert tier.has_chunk(chunks["a"])
+    assert not tier.has_chunk(chunks["b"])
+    assert tier.has_chunk(chunks["c"])
+
+
+def test_stale_staging_fault_freezes_local_pointer(tmp_path):
+    tier = StagingTier(str(tmp_path), faults=_Faults(skip_staging_commit=1))
+    tier.commit(1)  # silently dropped by the fault
+    assert tier.committed_step() is None
+    tier.commit(2)
+    assert tier.committed_step() == 2
+
+
+# ---- CheckpointFabric ----------------------------------------------------------
+
+
+def test_delta_save_writes_less_than_full(tmp_path):
+    reg = Registry()
+    with _fabric(tmp_path, registry=reg, full_interval=100) as fab:
+        h1 = fab.save_async(1, _tree(0))
+        h2 = fab.save_async(2, _tree(0))  # identical leaves → pure delta
+        assert h1.result(10) and h2.result(10)
+    assert h1.bytes_written > 0
+    assert h2.bytes_written < h1.bytes_written
+    text = reg.expose()
+    assert 'tpu_checkpoint_commits_total{kind="full"} 1' in text
+    assert 'tpu_checkpoint_commits_total{kind="delta"} 1' in text
+    assert fab.remote.orphaned_tmp_files() == []
+    assert fab.staging.orphaned_tmp_files() == []
+
+
+def test_restore_unknown_step_names_available_steps(tmp_path):
+    with _fabric(tmp_path) as fab:
+        fab.save_async(3, _tree(3)).result(10)
+        fab.save_async(6, _tree(6)).result(10)
+        with pytest.raises(FileNotFoundError) as exc:
+            fab.restore(step=99)
+    assert "step 99" in str(exc.value)
+    assert "available steps: [3, 6]" in str(exc.value)
+
+
+def test_restore_with_nothing_committed_is_clean_error(tmp_path):
+    with _fabric(tmp_path) as fab:
+        with pytest.raises(FileNotFoundError, match="no committed"):
+            fab.restore()
+
+
+def test_restore_serves_from_staging_then_falls_through(tmp_path):
+    with _fabric(tmp_path) as fab:
+        fab.save_async(1, _tree(1)).result(10)
+        tree = fab.restore()
+        assert fab.last_restore["tier"] == "staging"
+        np.testing.assert_array_equal(tree["w"], _tree(1)["w"])
+        # Wipe the staging chunks: restore must fall through to remote.
+        for digest in list(fab.staging._lru):
+            os.remove(fab.staging._chunk_path(digest))
+            fab.staging._lru.pop(digest)
+        tree = fab.restore()
+        assert fab.last_restore["tier"] == "remote"
+        np.testing.assert_array_equal(tree["w"], _tree(1)["w"])
+
+
+def test_stale_staging_pointer_never_beats_remote(tmp_path):
+    faults = _Faults(skip_staging_commit=100)
+    with _fabric(tmp_path, faults=faults) as fab:
+        fab.save_async(1, _tree(1)).result(10)
+        fab.save_async(2, _tree(2)).result(10)
+        assert fab.staging.committed_step() is None  # local pointer stale
+        assert fab.latest_step() == 2                # remote is authority
+        tree = fab.restore()
+    assert int(tree["step"]) == 2
+    np.testing.assert_array_equal(tree["w"], _tree(2)["w"])
+
+
+def test_torn_manifest_falls_back_to_previous_committed_step(tmp_path):
+    reg = Registry()
+    with _fabric(tmp_path, staging=False, registry=reg,
+                 full_interval=1) as fab:
+        fab.save_async(1, _tree(1)).result(10)
+        fab.save_async(2, _tree(2)).result(10)
+        # Tear the committed step's manifest on disk after the fact.
+        path = fab.remote._manifest_path(2)
+        raw = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(raw[: len(raw) // 2])
+        tree = fab.restore()
+        assert int(tree["step"]) == 1
+        assert fab.last_restore["fallback"] is True
+        assert fab.last_restore["step"] == 1
+    assert "tpu_checkpoint_integrity_failures_total 1" in reg.expose()
+
+
+def test_corrupt_chunks_everywhere_exhaust_fallback(tmp_path):
+    reg = Registry()
+    with _fabric(tmp_path, staging=False, registry=reg) as fab:
+        fab.save_async(1, _tree(1)).result(10)
+        for digest in os.listdir(fab.remote._chunk_dir):
+            with open(fab.remote._chunk_path(digest), "r+b") as fh:
+                fh.write(b"\xff")
+        with pytest.raises(CheckpointIntegrityError):
+            fab.restore()
+
+
+def test_crash_mid_upload_never_commits_next_save_does(tmp_path):
+    with _fabric(tmp_path, faults=_Faults(crash=1)) as fab:
+        h1 = fab.save_async(1, _tree(1))
+        assert h1.result(10) is False
+        assert h1.error is not None
+        assert fab.latest_step() is None  # nothing committed
+        h2 = fab.save_async(2, _tree(2))
+        assert h2.result(10) is True
+        assert fab.latest_step() == 2
+        tree = fab.restore()
+    assert int(tree["step"]) == 2
+
+
+def test_transient_upload_failures_retry_to_commit(tmp_path):
+    faults = _Faults(fail=2)
+    with _fabric(tmp_path, faults=faults, upload_retries=3,
+                 backoff_seconds=0.001) as fab:
+        h = fab.save_async(1, _tree(1))
+        assert h.result(10) is True
+        assert fab.latest_step() == 1
+    assert faults.fail == 0  # both injected failures were consumed
+
+
+def test_retention_drops_old_manifests_keeps_committed(tmp_path):
+    with _fabric(tmp_path, staging=False, keep=2) as fab:
+        for step in (1, 2, 3, 4):
+            fab.save_async(step, _tree(step)).result(10)
+        assert fab.all_steps() == [3, 4]
+        assert fab.latest_step() == 4
+        tree = fab.restore()
+    assert int(tree["step"]) == 4
+
+
+def test_restore_roundtrips_nested_containers(tmp_path):
+    state = {"params": [np.arange(8.0), (np.ones(3), np.int64(7))],
+             "scale": np.float32(0.5)}
+    with _fabric(tmp_path) as fab:
+        fab.save_async(1, state).result(10)
+        out = fab.restore()
+    assert isinstance(out["params"], list)
+    assert isinstance(out["params"][1], tuple)
+    np.testing.assert_array_equal(out["params"][0], state["params"][0])
+    np.testing.assert_array_equal(out["params"][1][0], np.ones(3))
+    assert int(out["params"][1][1]) == 7
+    assert float(out["scale"]) == 0.5
+
+
+def test_manager_restore_unknown_step_names_available(tmp_path):
+    ocp = pytest.importorskip("orbax.checkpoint")  # noqa: F841
+    from kubeflow_tpu.checkpoint import CheckpointManager
+
+    with CheckpointManager(str(tmp_path / "orbax"), keep=2) as mgr:
+        mgr.save(1, {"w": np.arange(4.0)})
+        mgr.wait()
+        with pytest.raises(FileNotFoundError) as exc:
+            mgr.restore(step=7)
+    assert "step 7" in str(exc.value)
+    assert "available steps: [1]" in str(exc.value)

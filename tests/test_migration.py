@@ -796,3 +796,203 @@ async def test_watch_reset_mid_drain_still_finalizes():
         # No grace-deadline fallback: the ack was recovered, not lost.
         assert h.mgr.registry._metrics[
             "tpu_scheduler_drain_fallback_total"].labels().value == 0
+
+
+# ---- checkpoint fabric: post-park commit watch (ISSUE 16) ----------------------
+
+
+async def _drain_ack_park(h, *, committed: bool = False):
+    """Drive victim → drain request → SDK ack → park, optionally folding
+    the durable-commit mark into the ack (the legacy synchronous save
+    path commits before acking; the fabric path acks at snapshot)."""
+    await h.make_idle_holder("victim")
+    await h.kube.create("Notebook", {
+        **nbapi.new("urgent", "ns", accelerator="v5e", topology="4x4"),
+        "metadata": {"name": "urgent", "namespace": "ns",
+                     "annotations": {nbapi.PRIORITY_ANNOTATION: "high"}},
+    })
+
+    async def drain_requested():
+        ann = await h.annotations("victim")
+        return migration.drain_requested_at(ann) is not None
+    await h.wait_for(drain_requested, "drain request on the victim")
+    raw = (await h.annotations("victim"))[nbapi.DRAIN_REQUESTED_ANNOTATION]
+    ack = migration.ack_patch("/home/jovyan/ckpt/victim", 700, time.time(),
+                              for_request=raw)
+    if committed:
+        ack.update(migration.commit_patch(time.time(), for_request=raw))
+    await h.kube.patch("Notebook", "victim",
+                       {"metadata": {"annotations": ack}}, "ns")
+
+    async def victim_parked():
+        ann = await h.annotations("victim")
+        return nbapi.STOP_ANNOTATION in ann
+    await h.wait_for(victim_parked, "victim parked after ack")
+    await h.settle(rounds=2)
+
+
+async def test_post_park_commit_mark_closes_the_commit_watch():
+    """Snapshot-then-ack: the ack parks the victim while the background
+    upload is still in flight, so the scheduler keeps a commit watch
+    open — the restore guarantee is hard-released only when the durable
+    commit mark lands, which closes the watch with a good
+    checkpoint_commit SLI event and no fallback count."""
+    async with Harness() as h:
+        await _drain_ack_park(h)
+        assert ("ns", "victim") in h.sched._commit_waits
+
+        # The uploader's commit lands (post-park: the drain keys are
+        # cleared, so the bare committed-at mark is authoritative).
+        await h.kube.patch(
+            "Notebook", "victim",
+            {"metadata": {"annotations": migration.commit_patch(
+                time.time())}}, "ns")
+
+        # The sweep closes the watch once the informer view catches up.
+        async def watch_closed():
+            await h.sched._sweep_commits(time.time())
+            return ("ns", "victim") not in h.sched._commit_waits
+        await h.wait_for(watch_closed, "commit watch closed")
+        good, bad = h.mgr.slo.counts("checkpoint_commit", "5m")
+        assert (good, bad) == (1, 0)
+        assert h.sched.m_drain_fallback.labels().value == 0
+        ann = await h.annotations("victim")
+        assert nbapi.CHECKPOINT_COMMIT_DIRTY_ANNOTATION not in ann
+
+
+async def test_acked_but_uncommitted_drain_is_a_fallback():
+    """Satellite: an acked drain whose upload never durably lands is NOT
+    a clean drain. When the commit grace expires the park is marked
+    commit-dirty, the drain counts in tpu_scheduler_drain_fallback_total,
+    the checkpoint_commit SLI takes a bad event, and a
+    CheckpointCommitTimeout warning is recorded."""
+    async with Harness(options=SchedulerOptions(
+            queued_requeue_seconds=0.05,
+            idle_preempt_after_seconds=0.2,
+            enable_migration=True,
+            drain_grace_seconds=15.0,
+            commit_grace_seconds=0.2)) as h:
+        await _drain_ack_park(h)
+        assert ("ns", "victim") in h.sched._commit_waits
+
+        # No commit ever lands; fire the sweep past the deadline.
+        await h.sched._sweep_commits(time.time() + 1.0)
+        assert ("ns", "victim") not in h.sched._commit_waits
+        assert h.sched.m_drain_fallback.labels().value == 1
+        good, bad = h.mgr.slo.counts("checkpoint_commit", "5m")
+        assert (good, bad) == (0, 1)
+        ann = await h.annotations("victim")
+        assert nbapi.CHECKPOINT_COMMIT_DIRTY_ANNOTATION in ann
+        events = await h.kube.list("Event", "ns")
+        assert any(e.get("reason") == "CheckpointCommitTimeout"
+                   for e in events)
+        # The park itself survives: the snapshot still exists on the
+        # pod side, only the durable copy is suspect.
+        assert nbapi.STOP_ANNOTATION in ann
+        assert ann.get(nbapi.CHECKPOINT_STEP_ANNOTATION) == "700"
+
+
+async def test_committed_ack_opens_no_commit_watch():
+    """The synchronous save path (no fabric) commits before acking — the
+    commit mark rides the ack patch, the SLI is observed at finalize
+    time, and no post-park watch is opened."""
+    async with Harness() as h:
+        await _drain_ack_park(h, committed=True)
+        assert ("ns", "victim") not in h.sched._commit_waits
+        good, bad = h.mgr.slo.counts("checkpoint_commit", "5m")
+        assert (good, bad) == (1, 0)
+        assert h.sched.m_drain_fallback.labels().value == 0
+
+
+# ---- checkpoint fabric: JWA status surface (ISSUE 16) --------------------------
+
+
+def test_process_status_parked_uploading_shows_chunk_progress():
+    st = process_status({
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "annotations": {nbapi.STOP_ANNOTATION: "t"}},
+        "status": {"migration": {"state": "Parked", "checkpointStep": 9,
+                                 "uploadProgress": "3/7"},
+                   "readyReplicas": 0},
+    })
+    assert st.phase == "stopped"
+    assert st.message == ("Suspended (checkpoint @ step 9) — "
+                          "checkpoint uploading (3/7 chunks)")
+
+
+def test_process_status_parked_committed_drops_upload_note():
+    st = process_status({
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "annotations": {nbapi.STOP_ANNOTATION: "t"}},
+        "status": {"migration": {"state": "Parked", "checkpointStep": 9,
+                                 "committedAt": "t2"},
+                   "readyReplicas": 0},
+    })
+    assert st.message == "Suspended (checkpoint @ step 9)"
+
+
+def test_process_status_parked_commit_dirty_warns():
+    st = process_status({
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "annotations": {nbapi.STOP_ANNOTATION: "t"}},
+        "status": {"migration": {"state": "Parked", "checkpointStep": 9,
+                                 "commitDirty": True},
+                   "readyReplicas": 0},
+    })
+    assert st.phase == "warning"
+    assert "checkpoint upload did not complete" in st.message
+    assert "older committed step" in st.message
+
+
+def test_process_status_restoring_names_the_tier():
+    def nb(tier):
+        return {
+            "metadata": {"name": "nb", "namespace": "ns"},
+            "status": {"migration": {"state": "Restoring",
+                                     "checkpointStep": 9,
+                                     "restoreTier": tier},
+                       "readyReplicas": 1, "tpu": {"hosts": 4},
+                       "containerState": {"running": {}}},
+        }
+    st = process_status(nb("staging"))
+    assert "Restoring from local staging tier (step 9)" in st.message
+    st = process_status(nb("remote"))
+    assert "Restoring from object storage (step 9)" in st.message
+    # Unknown/absent tier keeps the generic message.
+    st = process_status(nb(None))
+    assert "Restoring from checkpoint (step 9)" in st.message
+
+
+def test_migration_status_block_carries_commit_fields():
+    from kubeflow_tpu.controllers.notebook import _migration_status_block
+
+    now = fmt_iso(time.time())
+    nb = {
+        "metadata": {"name": "nb", "namespace": "ns", "annotations": {
+            nbapi.STOP_ANNOTATION: now,
+            nbapi.DRAIN_REASON_ANNOTATION: "preempt:idle",
+            nbapi.CHECKPOINT_PATH_ANNOTATION: "/ckpt",
+            nbapi.CHECKPOINT_STEP_ANNOTATION: "9",
+            nbapi.CHECKPOINTED_AT_ANNOTATION: now,
+            nbapi.CHECKPOINT_PROGRESS_ANNOTATION: "3/7",
+        }},
+        "status": {},
+    }
+    block = _migration_status_block(nb, ready=0, want_hosts=2)
+    assert block["state"] == "Parked"
+    assert block["uploadProgress"] == "3/7"
+    assert "committedAt" not in block
+    assert "commitDirty" not in block
+
+    ann = nb["metadata"]["annotations"]
+    ann[nbapi.CHECKPOINT_COMMITTED_AT_ANNOTATION] = now
+    del ann[nbapi.CHECKPOINT_PROGRESS_ANNOTATION]
+    ann[nbapi.RESTORE_TIER_ANNOTATION] = "staging"
+    block = _migration_status_block(nb, ready=0, want_hosts=2)
+    assert block["committedAt"] == now
+    assert block["restoreTier"] == "staging"
+    assert "uploadProgress" not in block
+
+    ann[nbapi.CHECKPOINT_COMMIT_DIRTY_ANNOTATION] = now
+    block = _migration_status_block(nb, ready=0, want_hosts=2)
+    assert block["commitDirty"] is True

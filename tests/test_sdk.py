@@ -587,3 +587,215 @@ def test_watcher_annotations_shares_rate_limit(monkeypatch):
     assert w.annotations() == {"a": "1", MAINTENANCE_ANNOTATION: "n"}
     assert w.check() == "n"
     assert len(calls) == 1  # one fetch served both reads
+
+
+# ---- checkpoint fabric: snapshot-then-ack guard path (ISSUE 16) ----------------
+
+
+class FakeFabricManager(FakeManager):
+    """Models checkpoint.CheckpointFabric's async surface: save_async
+    snapshots synchronously and returns; the commit callback fires when
+    the test calls commit() — the upload is 'in flight' in between."""
+
+    def __init__(self):
+        super().__init__(interval=1000)
+        self.directory = "/ckpt/fabric"
+        self.async_saves = []
+        self._callbacks = []
+        self.closed = 0
+
+    def save_async(self, step, pytree, *, on_progress=None, on_commit=None):
+        self.async_saves.append(step)
+        self._callbacks.append((step, on_progress, on_commit))
+
+    def commit(self):
+        """Land every in-flight upload (progress then commit)."""
+        for step, on_progress, on_commit in self._callbacks:
+            if on_progress is not None:
+                on_progress(3, 3)
+            if on_commit is not None:
+                on_commit(step, 0.01)
+        self._callbacks = []
+
+    def close(self):
+        self.closed += 1
+        self.commit()
+
+
+def _fabric_guard(ann, patcher):
+    return sdk.CheckpointGuard(
+        FakeFabricManager(), make_watcher(ann, interval=0.0),
+        sync_every_steps=1, patcher=patcher)
+
+
+def test_fabric_drain_acks_at_snapshot_commits_later(monkeypatch):
+    """Snapshot-then-ack: the ack leaves before the upload lands and
+    carries NO commit mark; the uploader's callback stamps the durable
+    commit echoing the drain request it answered."""
+    from kubeflow_tpu.api.notebook import (
+        CHECKPOINT_COMMITTED_AT_ANNOTATION,
+        CHECKPOINT_COMMITTED_FOR_ANNOTATION,
+        CHECKPOINT_PROGRESS_ANNOTATION,
+        CHECKPOINTED_AT_ANNOTATION,
+        DRAIN_REQUESTED_ANNOTATION,
+    )
+
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    ann: dict = {}
+    patches = []
+
+    def patcher(annotations):
+        patches.append(dict(annotations))
+        for k, v in annotations.items():
+            ann.pop(k, None) if v is None else ann.__setitem__(k, v)
+
+    guard = _fabric_guard(ann, patcher)
+    _drain_request(ann)
+    raw = ann[DRAIN_REQUESTED_ANNOTATION]
+    clock.t = 1.0
+    assert guard.step(2, {}) is True
+    mgr = guard.manager
+    assert mgr.async_saves == [2]
+    assert mgr.waits == 0, "snapshot-then-ack must not block on the upload"
+    ack = [p for p in patches if CHECKPOINTED_AT_ANNOTATION in p][-1]
+    assert CHECKPOINT_COMMITTED_AT_ANNOTATION not in ack
+    assert CHECKPOINT_COMMITTED_AT_ANNOTATION not in ann
+
+    mgr.commit()
+    assert CHECKPOINT_COMMITTED_AT_ANNOTATION in ann
+    assert ann[CHECKPOINT_COMMITTED_FOR_ANNOTATION] == raw
+    # The final progress mark was cleared by the commit patch.
+    assert CHECKPOINT_PROGRESS_ANNOTATION not in ann
+
+
+def test_fabric_ack_retry_does_not_resnapshot(monkeypatch):
+    """A failed ack patch re-arms the ack only: the next sync step
+    retries the annotation, never save_async — the snapshot already
+    exists and a second one would fork the step."""
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    ann: dict = {}
+    state = {"fail": True}
+    patches = []
+
+    def patcher(annotations):
+        if state["fail"]:
+            raise OSError("apiserver flake")
+        patches.append(dict(annotations))
+        for k, v in annotations.items():
+            ann.pop(k, None) if v is None else ann.__setitem__(k, v)
+
+    guard = _fabric_guard(ann, patcher)
+    _drain_request(ann)
+    clock.t = 1.0
+    assert guard.step(2, {}) is True          # snapshot ok, ack failed
+    assert guard.manager.async_saves == [2]
+    state["fail"] = False
+    clock.t = 2.0
+    guard.step(3, {})                         # retries the ACK only
+    assert patches, "ack was not retried"
+    assert guard.manager.async_saves == [2], \
+        "ack retry must not re-snapshot"
+
+
+def test_fabric_failed_commit_mark_flushed_by_close(monkeypatch):
+    """The uploader's commit callback hits a flaky apiserver: the mark
+    goes pending and close() — after blocking on the manager's close,
+    which drains the upload queue — flushes it, so a parked notebook
+    never stays visibly uncommitted when the upload in fact landed."""
+    from kubeflow_tpu.api.notebook import (
+        CHECKPOINT_COMMITTED_AT_ANNOTATION,
+        CHECKPOINTED_AT_ANNOTATION,
+    )
+
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    ann: dict = {}
+    state = {"fail_commit": False}
+
+    def patcher(annotations):
+        if (state["fail_commit"]
+                and CHECKPOINT_COMMITTED_AT_ANNOTATION in annotations):
+            raise OSError("apiserver flake")
+        for k, v in annotations.items():
+            ann.pop(k, None) if v is None else ann.__setitem__(k, v)
+
+    guard = _fabric_guard(ann, patcher)
+    _drain_request(ann)
+    clock.t = 1.0
+    state["fail_commit"] = True
+    assert guard.step(2, {}) is True
+    guard.manager.commit()                    # mark patch fails → pending
+    assert CHECKPOINTED_AT_ANNOTATION in ann
+    assert CHECKPOINT_COMMITTED_AT_ANNOTATION not in ann
+    assert guard._commit_pending is not None
+
+    state["fail_commit"] = False
+    with guard:                               # __exit__ → close()
+        pass
+    assert guard.manager.closed == 1
+    assert CHECKPOINT_COMMITTED_AT_ANNOTATION in ann
+    assert guard._commit_pending is None
+
+
+def test_guard_close_over_real_fabric_leaves_no_orphans(tmp_path):
+    """End-to-end over the REAL fabric: drain → snapshot-ack → close()
+    blocks until the background upload commits — the committed pointer
+    exists, the manifest round-trips, and no temp files are orphaned
+    anywhere under either tier."""
+    import numpy as np
+
+    from kubeflow_tpu.checkpoint import CheckpointFabric
+    from kubeflow_tpu.runtime.metrics import Registry
+
+    ann: dict = {}
+
+    def patcher(annotations):
+        for k, v in annotations.items():
+            ann.pop(k, None) if v is None else ann.__setitem__(k, v)
+
+    fab = CheckpointFabric(
+        str(tmp_path / "remote"), staging_dir=str(tmp_path / "staging"),
+        chunk_bytes=64, remote_op_delay=0.01, registry=Registry())
+    with sdk.CheckpointGuard(fab, make_watcher(ann, interval=0.0),
+                             sync_every_steps=1, patcher=patcher) as guard:
+        _drain_request(ann)
+        assert guard.step(2, {"w": np.arange(32.0)}) is True
+    # close() returned → the upload durably landed.
+    assert fab.latest_step() == 2
+    restored = fab.restore()
+    assert np.array_equal(restored["w"], np.arange(32.0))
+    assert fab.remote.orphaned_tmp_files() == []
+    assert fab.staging.orphaned_tmp_files() == []
+    from kubeflow_tpu.api.notebook import CHECKPOINT_COMMITTED_AT_ANNOTATION
+    assert CHECKPOINT_COMMITTED_AT_ANNOTATION in ann
+
+
+def test_guard_stamps_restore_tier_once(monkeypatch):
+    """A fabric whose last restore came from staging gets the tier
+    stamped on the first sync step — once, best-effort — so JWA can say
+    which tier served the restore."""
+    from kubeflow_tpu.api.notebook import RESTORE_TIER_ANNOTATION
+
+    clock = FakeClock()
+    monkeypatch.setattr(sdk.time, "monotonic", clock)
+    ann: dict = {}
+    patches = []
+
+    def patcher(annotations):
+        patches.append(dict(annotations))
+        for k, v in annotations.items():
+            ann.pop(k, None) if v is None else ann.__setitem__(k, v)
+
+    mgr = FakeFabricManager()
+    mgr.last_restore = {"step": 7, "tier": "staging", "seconds": 0.01,
+                        "fallback": False}
+    guard = sdk.CheckpointGuard(
+        mgr, make_watcher(ann, interval=0.0), sync_every_steps=1,
+        patcher=patcher)
+    guard.step(1, {})
+    assert ann[RESTORE_TIER_ANNOTATION] == "staging"
+    marks = [p for p in patches if RESTORE_TIER_ANNOTATION in p]
+    guard.step(2, {})
+    assert [p for p in patches if RESTORE_TIER_ANNOTATION in p] == marks

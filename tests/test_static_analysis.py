@@ -612,9 +612,15 @@ def test_analyzer_clean_over_real_tree():
         assert sup.reason
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
     ratchet = {
-        "no-blocking-in-async": 1,      # engine.py worker-thread sleep
-        "exception-swallow": 5,
-        "await-race": 16,
+        # engine.py worker-thread sleep + the checkpoint fabric's
+        # uploader-thread backoff and tier op-delay/fault-delay sleeps
+        # (PR 16) — all run on the ckpt-uploader thread or via
+        # asyncio.to_thread, never the event loop
+        "no-blocking-in-async": 4,
+        "exception-swallow": 4,
+        # +1 (PR 16): _sweep_commits' pop, re-validated by identity
+        # after the await
+        "await-race": 17,
     }
     unexpected = set(by_rule) - set(ratchet)
     assert not unexpected, (
@@ -624,7 +630,7 @@ def test_analyzer_clean_over_real_tree():
         assert by_rule.get(rule, 0) <= cap, (
             f"{rule}: {by_rule.get(rule, 0)} suppressions > ratchet "
             f"{cap} — fix the finding instead of suppressing")
-    assert len(report.suppressed) <= 22
+    assert len(report.suppressed) <= 25
 
 
 def test_cli_clean_over_real_tree_writes_json(tmp_path, capsys):
