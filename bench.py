@@ -1314,13 +1314,17 @@ def chaos_soak(smoke: bool = False) -> dict:
     zero permanently-wedged keys. Separately, a deliberately poisoned CR
     must quarantine within the retry budget, surface the Degraded
     condition + Warning Event + /debug/queue row, and resume on the next
-    spec edit. Chip-free: FakeKube + podsim + the real manager/
-    controller/scheduler stack; the same seeds replay in tier-1
+    spec edit. The sharded control plane rides the same gate (ISSUE 17):
+    one shard of N is crash-killed mid-flight and survivors must absorb
+    its keyspace — zero dropped queued keys, timeline continuity and
+    ledger invariants intact. Chip-free: FakeKube + podsim + the real
+    manager/controller/scheduler stack; the same seeds replay in tier-1
     (tests/test_chaos.py)."""
     from kubeflow_tpu.testing.chaos import (
         SoakConfig,
         poison_scenario,
         run_soak,
+        shard_kill_scenario,
     )
 
     seeds = list(range(2)) if smoke else list(range(5))
@@ -1333,7 +1337,10 @@ def chaos_soak(smoke: bool = False) -> dict:
         )))
         reports.append(report.to_dict())
     poison = asyncio.run(poison_scenario(seed=0))
+    shard_kill = asyncio.run(shard_kill_scenario(
+        seed=0, replicas=3 if smoke else 4))
     ok = all(r["ok"] for r in reports) and poison.get("pass", False) \
+        and shard_kill.get("pass", False) \
         and all(r["manager_restarts"] >= 3 for r in reports)
     return {
         "metric": "chaos_soak",
@@ -1341,9 +1348,295 @@ def chaos_soak(smoke: bool = False) -> dict:
         "seeds": seeds,
         "soaks": reports,
         "poison": poison,
+        "shard_kill": shard_kill,
         "total_injected": {
             k: sum(r["injected"].get(k, 0) for r in reports)
             for k in sorted({k for r in reports for k in r["injected"]})},
+        "pass": ok,
+    }
+
+
+CPS_SHARDS = 4
+# Per-REPLICA client budget (client-go rest.Config QPS analog). The
+# active-active win is aggregate budget: one event loop gains no CPU
+# from more in-process replicas, but each replica carries its own
+# request budget — exactly how N real pods each carry their own rate
+# limiter against the apiserver.
+CPS_QPS_PER_REPLICA = 250.0
+
+
+def control_plane_scale(smoke: bool = False) -> dict:
+    """`bench.py control_plane_scale [--smoke]` — the sharded active-
+    active control plane at 10k-CR scale (ISSUE 17).
+
+    Phase A races the SAME multi-namespace notebook load through one
+    budgeted manager replica (N=1, unsharded) and through N=4 replicas
+    (namespace-hash shard leases, filtered informers, per-shard
+    workqueues); an unbudgeted N=1 run is included as the CPU-bound
+    reference. CI gate: N=4 must STRICTLY beat N=1 on notebooks/s.
+
+    Phase B drives 10k+ CRs with churn (annotation patches plus
+    delete/recreate) through the N=4 ring, crash-kills one replica
+    mid-flight, and reports per-shard fairness (ready-latency p50
+    spread), failover time, and aggregate notebooks/s. Every surviving
+    CR — including keys queued on the dead replica — must converge:
+    zero dropped keys."""
+    from kubeflow_tpu.api import notebook as nbapi
+    from kubeflow_tpu.controllers.notebook import (
+        NotebookOptions,
+        setup_notebook_controller,
+    )
+    from kubeflow_tpu.runtime.flowcontrol import BudgetedClient, FlowControl
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.metrics import Registry
+    from kubeflow_tpu.runtime.objects import deep_get
+    from kubeflow_tpu.runtime.sharding import ShardRing, shard_of
+    from kubeflow_tpu.testing.fakekube import FakeKube
+    from kubeflow_tpu.testing.loadtest import run_load_test
+    from kubeflow_tpu.testing.podsim import PodSimulator
+    from kubeflow_tpu.webhooks import register_all
+
+    shards = CPS_SHARDS
+    qps = CPS_QPS_PER_REPLICA
+    phase_a_count = 240 if smoke else 1000
+    phase_b_count = 1200 if smoke else 10240
+    nss = [f"team-{i:02d}" for i in range(16)]
+    # Smoke gets soak-speed clocks (sub-second failover, fast CI). The
+    # full 10k run saturates the event loop for minutes at a time, and a
+    # 0.6s lease flaps under that lag — the victim would own nothing by
+    # kill time and the failover measurement would be vacuous. 3s/0.6s
+    # keeps the production lease:renew ratio while tolerating multi-
+    # second loop stalls.
+    lease_seconds, renew_seconds = (0.6, 0.15) if smoke else (3.0, 0.6)
+
+    class Stack:
+        """N in-process replicas over ONE FakeKube: each with its own
+        registry, shard ring, and client budget — the unit under test is
+        the sharding protocol + budget scaling, not process isolation."""
+
+        def __init__(self, replicas: int, *, budget: bool = True):
+            self.replicas = replicas
+            self.kube = FakeKube()
+            register_all(self.kube)
+            self.sim = PodSimulator(self.kube)
+            self.mgrs, self.rings = [], []
+            self._dead: set[int] = set()
+            for r in range(replicas):
+                reg = Registry()
+                # The create burst starves the event loop and early lease
+                # expiries scramble the spread; the claim protocol hands a
+                # scrambled shard back to its live preferred owner within a
+                # couple of ticks, so the victim holds its slice by kill
+                # time — while the DEAD victim's shard, absorbed after the
+                # kill, is never churned back into an unowned window.
+                ring = (ShardRing(
+                    self.kube, shards=shards, replica=r, replicas=replicas,
+                    lease_seconds=lease_seconds,
+                    renew_seconds=renew_seconds,
+                    registry=reg)
+                    if replicas > 1 else None)
+                client = (BudgetedClient(self.kube, FlowControl(max_qps=qps))
+                          if budget else self.kube)
+                mgr = Manager(client, registry=reg, shard_ring=ring)
+                setup_notebook_controller(mgr, NotebookOptions(),
+                                          scheduler=None)
+                for q in mgr._queues.values():
+                    q.base_delay = 0.002
+                    q.max_delay = 0.05
+                for inf in mgr.informers.values():
+                    inf.resync_backoff = 0.02
+                    inf.resync_backoff_max = 0.2
+                self.mgrs.append(mgr)
+                self.rings.append(ring)
+
+        async def start(self):
+            for r in range(self.replicas):
+                if self.rings[r] is not None:
+                    await self.rings[r].start()
+                await self.mgrs[r].start()
+            await self.sim.start()
+
+        async def kill(self, r: int):
+            """Crash semantics: leases left to expire, queues die."""
+            if self.rings[r] is not None:
+                await self.rings[r].kill()
+            await self.mgrs[r].stop()
+            self._dead.add(r)
+
+        async def stop(self):
+            await self.sim.stop()
+            for r in range(self.replicas):
+                if r in self._dead:
+                    continue
+                await self.mgrs[r].stop()
+                if self.rings[r] is not None:
+                    await self.rings[r].stop()
+            self.kube.close_watches()
+
+    async def equal_load(replicas: int, *, budget: bool = True) -> dict:
+        stack = Stack(replicas, budget=budget)
+        await stack.start()
+        try:
+            report = await run_load_test(
+                stack.kube, count=phase_a_count, namespaces=nss,
+                accelerator="v5e", topology="2x2",
+                timeout=300.0, poll_interval=0.05)
+            d = report.to_dict()
+            d["replicas"] = replicas
+            d["budgeted"] = budget
+            d["rate_nb_per_sec"] = (
+                round(report.ready / report.wall_seconds, 2)
+                if report.wall_seconds else 0.0)
+            return d
+        finally:
+            await stack.stop()
+
+    async def scale_10k() -> dict:
+        stack = Stack(shards)
+        await stack.start()
+        out: dict = {"replicas": shards}
+        try:
+            t0 = time.perf_counter()
+            keyed = [(nss[i % len(nss)], f"cr-{i}")
+                     for i in range(phase_b_count)]
+            for ns, name in keyed:
+                await stack.kube.create("Notebook", nbapi.new(
+                    name, ns, accelerator="v5e", topology="2x2"))
+            out["create_wall_seconds"] = round(time.perf_counter() - t0, 2)
+
+            # Churn while reconciles are in flight: spec edits re-enqueue
+            # live keys, deletes + recreates exercise tombstone handling
+            # under load.
+            churn_patch = keyed[::20]
+            for ns, name in churn_patch:
+                await stack.kube.patch(
+                    "Notebook", name,
+                    {"metadata": {"annotations": {"bench/churn": "1"}}}, ns)
+            churn_delete = keyed[7::50]
+            for ns, name in churn_delete:
+                try:
+                    await stack.kube.delete("Notebook", name, ns)
+                except Exception:
+                    pass
+            deleted = set(churn_delete)
+            recreated = []
+            for i, (ns, _name) in enumerate(churn_delete):
+                await stack.kube.create("Notebook", nbapi.new(
+                    f"rc-{i}", ns, accelerator="v5e", topology="2x2"))
+                recreated.append((ns, f"rc-{i}"))
+            want = [k for k in keyed if k not in deleted] + recreated
+
+            victim = shards - 1  # never the arbiter (shard 0 → replica 0)
+            ready_at: dict[tuple, float] = {}
+            pending = set(want)
+            killed = False
+            kill_t = None
+            absorb_seconds = None
+            victim_shards: set[int] = set()
+            deadline = time.perf_counter() + (240.0 if smoke else 900.0)
+            while pending and time.perf_counter() < deadline:
+                for ns in nss:
+                    for nb in await stack.kube.list(
+                            "Notebook", ns, copy=False):
+                        k = (ns, nb["metadata"]["name"])
+                        if k not in pending:
+                            continue
+                        hosts = deep_get(
+                            nb, "status", "tpu", "hosts", default=1) or 1
+                        got = deep_get(
+                            nb, "status", "readyReplicas", default=0) or 0
+                        if got >= hosts:
+                            ready_at[k] = time.perf_counter() - t0
+                pending -= set(ready_at)
+                if not killed and len(ready_at) >= 0.4 * len(want) \
+                        and stack.rings[victim].owned:
+                    # Only a victim that actually holds shards makes the
+                    # failover measurement mean anything.
+                    victim_shards = set(stack.rings[victim].owned)
+                    kill_t = time.perf_counter()
+                    await stack.kill(victim)
+                    killed = True
+                if killed and absorb_seconds is None:
+                    held: set[int] = set()
+                    for r in range(shards):
+                        if r != victim:
+                            held |= stack.rings[r].owned
+                    if victim_shards <= held:
+                        absorb_seconds = time.perf_counter() - kill_t
+                await asyncio.sleep(0.05)
+            wall = time.perf_counter() - t0
+
+            per_shard: dict[int, list] = {s: [] for s in range(shards)}
+            for (ns, _name), t_ready in ready_at.items():
+                per_shard[shard_of(ns, shards)].append(t_ready)
+            shard_stats = {}
+            p50s = []
+            for s, lats in sorted(per_shard.items()):
+                lats.sort()
+                p50 = lats[len(lats) // 2] if lats else None
+                shard_stats[str(s)] = {
+                    "ready": len(lats),
+                    "p50_ready_sec": round(p50, 3) if p50 else None,
+                }
+                if p50:
+                    p50s.append(p50)
+            out.update({
+                "created": len(keyed),
+                "churn_patched": len(churn_patch),
+                "churn_deleted": len(churn_delete),
+                "recreated": len(recreated),
+                "expected": len(want),
+                "converged": len(ready_at),
+                "dropped_keys": len(want) - len(ready_at),
+                "wall_seconds": round(wall, 2),
+                "rate_nb_per_sec": (round(len(ready_at) / wall, 2)
+                                    if wall else 0.0),
+                "victim_replica": victim,
+                "victim_shards": sorted(victim_shards),
+                "killed": killed,
+                "failover_seconds": (round(absorb_seconds, 3)
+                                     if absorb_seconds is not None else None),
+                "per_shard": shard_stats,
+                # max/min of per-shard p50 ready latency: 1.0 = perfectly
+                # fair; the victim's shards legitimately read worse (they
+                # lived through the failover).
+                "fairness_p50_spread": (
+                    round(max(p50s) / min(p50s), 3)
+                    if p50s and min(p50s) > 0 else None),
+            })
+            return out
+        finally:
+            await stack.stop()
+
+    n1 = asyncio.run(equal_load(1))
+    n4 = asyncio.run(equal_load(shards))
+    reference = asyncio.run(equal_load(1, budget=False))
+    b = asyncio.run(scale_10k())
+    sharded_beats = (
+        n1["ready"] == phase_a_count
+        and n4["ready"] == phase_a_count
+        and n4["rate_nb_per_sec"] > n1["rate_nb_per_sec"])
+    ok = bool(
+        sharded_beats
+        and b["killed"]
+        and b["victim_shards"]
+        and b["failover_seconds"] is not None
+        and b["dropped_keys"] == 0
+        and (smoke or b["created"] >= 10000))
+    return {
+        "metric": "control_plane_scale",
+        "smoke": smoke,
+        "shards": shards,
+        "qps_budget_per_replica": qps,
+        "equal_load": {
+            "n1": n1,
+            "n4": n4,
+            "n1_unbudgeted_reference": reference,
+            "speedup": (round(
+                n4["rate_nb_per_sec"] / n1["rate_nb_per_sec"], 2)
+                if n1["rate_nb_per_sec"] else None),
+        },
+        "scale_10k": b,
         "pass": ok,
     }
 
@@ -2928,6 +3221,15 @@ if __name__ == "__main__":
         print(json.dumps(result))
         # CI gate: any invariant violation, wedged key, or a poison pill
         # that fails to quarantine/resume must fail the step.
+        if not result["pass"]:
+            sys.exit(1)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "control_plane_scale":
+        result = control_plane_scale(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(result))
+        # CI gate (ISSUE 17): N=4 sharded replicas must strictly beat
+        # N=1 on notebooks/s under equal per-replica budgets, and the
+        # 10k-CR churn run must converge every key through a mid-flight
+        # shard kill (zero dropped keys, failover measured).
         if not result["pass"]:
             sys.exit(1)
     elif len(sys.argv) >= 2 and sys.argv[1] == "checkpoint_fabric":

@@ -53,6 +53,9 @@ SINGLETONS = (
     ("kubeflow_tpu/runtime/queue.py", "RateLimitedQueue"),
     ("kubeflow_tpu/runtime/timeline.py", "TimelineRecorder"),
     ("kubeflow_tpu/serving/controller.py", "InferenceServiceReconciler"),
+    ("kubeflow_tpu/runtime/sharding.py", "ShardRing"),
+    ("kubeflow_tpu/runtime/leaderelection.py", "LeaderElector"),
+    ("kubeflow_tpu/runtime/flowcontrol.py", "FlowControl"),
 )
 
 

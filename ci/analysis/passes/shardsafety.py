@@ -1,0 +1,206 @@
+"""``shard-safety``: the PR 15 shared-state audit turned into a ratchet.
+
+The sharded active-active control plane (ISSUE 17) is only correct
+while every piece of cross-task shared state has a declared owner and a
+stated shard-safety story: module-level singletons are per-PROCESS (N
+replicas each get their own — fine for caches and metrics, split-brain
+for anything authoritative), and an await-crossing shared attribute is
+exactly the window where another shard's callback can interleave. The
+hand-audit found them once; this pass makes the list self-maintaining:
+
+- every **module-level mutable singleton** in ``kubeflow_tpu/`` (a
+  class instantiation or mutable container bound at module scope) must
+  appear in the declaration registry ``ci/analysis/shard_safety.json``
+  with an ``owner`` and a ``shard_safety`` rationale;
+- every **await-crossing shared attribute** of a registered singleton
+  class (the ``await-race`` inventory, suppressed sites included —
+  a concurrency suppression argues interleaving safety, the declaration
+  argues REPLICATION safety, and they are different claims) must be
+  declared the same way;
+- a declaration matching nothing is ``stale-shard-safety-entry`` and a
+  declaration with an empty owner/rationale is
+  ``incomplete-shard-safety-entry`` — the registry can neither rot nor
+  rubber-stamp.
+
+``kubeflow_tpu/testing/`` is exempt (harnesses are single-process by
+construction), mirroring the annotation-ownership pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from ci.analysis.core import Finding, Project, analysis_pass
+from ci.analysis.callgraph import get_index
+from ci.analysis.passes.awaitrace import (
+    _iter_singletons,
+    _rmw_sites,
+    _shared_attrs,
+)
+
+RULE_SINGLETON = "undeclared-module-singleton"
+RULE_CROSSING = "undeclared-await-crossing"
+RULE_STALE = "stale-shard-safety-entry"
+RULE_INCOMPLETE = "incomplete-shard-safety-entry"
+
+REGISTRY_PATH = "ci/analysis/shard_safety.json"
+TESTING_PREFIX = "kubeflow_tpu/testing/"
+
+# Mutable-container constructors: a module-level binding of one of these
+# is shared state no matter how innocent the name looks.
+MUTABLE_BUILTINS = frozenset({
+    "dict", "list", "set", "bytearray", "defaultdict", "deque",
+    "OrderedDict", "Counter", "ChainMap", "WeakValueDictionary",
+    "WeakKeyDictionary", "Queue", "LifoQueue", "PriorityQueue",
+})
+# Capitalized calls that do NOT build a stateful instance: typing
+# machinery, frozen/value types, path objects.
+SAFE_CONSTRUCTORS = frozenset({
+    "TypeVar", "ParamSpec", "TypeVarTuple", "NamedTuple", "NewType",
+    "Path", "PurePath", "PurePosixPath", "Fraction", "Decimal",
+    "Enum", "IntEnum", "Flag", "IntFlag",
+})
+
+
+def _call_name(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _module_singletons(sf):
+    """(name, line, what) for each module-level mutable binding."""
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            name, value = node.target.id, node.value
+        else:
+            continue
+        if name.startswith("__"):
+            continue  # __all__ and friends
+        if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+            yield name, node.lineno, "mutable container literal"
+        elif isinstance(value, ast.Call):
+            called = _call_name(value)
+            if called is None:
+                continue
+            if called in MUTABLE_BUILTINS:
+                yield name, node.lineno, f"{called}() container"
+            elif called[:1].isupper() and called not in SAFE_CONSTRUCTORS:
+                yield name, node.lineno, f"{called}(...) instance"
+
+
+def _load_registry(project: Project) -> tuple[dict, dict, str | None]:
+    """(singleton entries, crossing entries, parse problem)."""
+    path = os.path.join(project.root, REGISTRY_PATH)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return {}, {}, None  # fixture trees: empty registry, all findings
+    except (OSError, json.JSONDecodeError) as exc:
+        return {}, {}, str(exc)
+    singles = data.get("module_singletons") or {}
+    crossings = data.get("await_crossings") or {}
+    if not isinstance(singles, dict) or not isinstance(crossings, dict):
+        return {}, {}, "module_singletons/await_crossings must be objects"
+    return singles, crossings, None
+
+
+def _complete(entry) -> bool:
+    return (isinstance(entry, dict)
+            and str(entry.get("owner") or "").strip() != ""
+            and str(entry.get("shard_safety") or "").strip() != "")
+
+
+@analysis_pass(
+    "shard-safety",
+    (RULE_SINGLETON, RULE_CROSSING, RULE_STALE, RULE_INCOMPLETE),
+    "module-level singletons and await-crossing shared attributes must "
+    "carry an owner + shard-safety declaration in "
+    "ci/analysis/shard_safety.json (the sharding audit as a ratchet)")
+def check_shard_safety(project: Project):
+    singles, crossings, problem = _load_registry(project)
+    if problem is not None:
+        yield Finding(rule=RULE_STALE, path=REGISTRY_PATH, line=1,
+                      message=f"shard-safety registry unreadable: {problem}")
+        return
+
+    seen_singletons: set[str] = set()
+    for sf in project.files:
+        if sf.tree is None or not sf.path.startswith("kubeflow_tpu/") \
+                or sf.path.startswith(TESTING_PREFIX):
+            continue
+        for name, line, what in _module_singletons(sf):
+            key = f"{sf.path}:{name}"
+            seen_singletons.add(key)
+            entry = singles.get(key)
+            if entry is None:
+                yield Finding(
+                    rule=RULE_SINGLETON, path=sf.path, line=line,
+                    message=f"module-level singleton `{name}` ({what}) has "
+                            "no shard-safety declaration — N active-active "
+                            "replicas each instantiate it; add "
+                            f'"{key}" to {REGISTRY_PATH} with its owner '
+                            "and why per-process state is correct (or why "
+                            "it must move behind the shard ring)")
+            elif not _complete(entry):
+                yield Finding(
+                    rule=RULE_INCOMPLETE, path=sf.path, line=line,
+                    message=f"shard-safety entry for `{key}` is missing a "
+                            "non-empty owner/shard_safety rationale")
+
+    idx = get_index(project)
+    seen_crossings: set[str] = set()
+    for path, ci in _iter_singletons(project, idx):
+        shared = _shared_attrs(ci)
+        if not shared:
+            continue
+        for mname, fn in ci.methods.items():
+            if mname == "__init__" or not fn.is_async:
+                continue
+            for attr, _r, _aw, mline in _rmw_sites(fn, shared):
+                key = f"{ci.name}.{attr}"
+                entry = crossings.get(key)
+                if key in seen_crossings and entry is not None:
+                    continue
+                seen_crossings.add(key)
+                if entry is None:
+                    yield Finding(
+                        rule=RULE_CROSSING, path=path, line=mline,
+                        message=f"{ci.name}.{mname} crosses an await while "
+                                f"mutating shared `self.{attr}` and "
+                                f'`"{key}"` has no shard-safety '
+                                f"declaration in {REGISTRY_PATH} — state "
+                                "an owner and whether the attribute is "
+                                "shard-local, arbiter-only, or "
+                                "lease-fenced")
+                elif not _complete(entry):
+                    yield Finding(
+                        rule=RULE_INCOMPLETE, path=path, line=mline,
+                        message=f"shard-safety entry for `{key}` is "
+                                "missing a non-empty owner/shard_safety "
+                                "rationale")
+
+    # Stale entries only gate on the full-tree scan: a subset scan
+    # legitimately fails to observe most of the registry.
+    if project.full_tree:
+        for key in sorted(set(singles) - seen_singletons):
+            yield Finding(
+                rule=RULE_STALE, path=REGISTRY_PATH, line=1,
+                message=f"module_singletons entry `{key}` matches no "
+                        "module-level singleton — delete it")
+        for key in sorted(set(crossings) - seen_crossings):
+            yield Finding(
+                rule=RULE_STALE, path=REGISTRY_PATH, line=1,
+                message=f"await_crossings entry `{key}` matches no "
+                        "await-crossing shared attribute — delete it")

@@ -182,6 +182,13 @@ def workflow_tests() -> dict:
                         "repo-regression gate; exit 1 on gate failure)",
                         "python bench.py coldstart --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("Sharded control-plane smoke bench (N=4 "
+                        "active-active beats N=1 on equal per-replica "
+                        "client budget, replica-kill failover measured "
+                        "with zero dropped keys; exit 1 on gate "
+                        "failure)",
+                        "python bench.py control_plane_scale --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

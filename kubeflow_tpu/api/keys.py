@@ -135,6 +135,15 @@ SERVING_PARK_CHECKPOINT_FOR = "serving.kubeflow.org/parked-checkpoint-for"
 SERVING_FLEX_POOL_PREFIX = "serving.kubeflow.org/flex-pool-r"
 SERVING_PRIORITY = "serving.kubeflow.org/priority"
 
+# ---- sharding.kubeflow.org: shard ring rebalance protocol (ISSUE 17) ---------
+#
+# Stamped on a shard's Lease (metadata.annotations) by a replica whose
+# PREFERRED shard is held by someone else: ``"<identity> <micro-stamp>"``.
+# The holder honors a claim younger than lease_seconds by releasing the
+# shard (demand-driven handback); a stale claim — its stamper died —
+# is ignored, so rebalance never churns toward a dead replica.
+SHARD_PREFERRED_CLAIM = "sharding.kubeflow.org/preferred-claim"
+
 # ---- ownership (ISSUE 15: the shard-safety audit) ----------------------------
 #
 # ``OWNERS`` declares, for EVERY key above, the module prefixes allowed
@@ -279,4 +288,5 @@ OWNERS: dict[str, tuple[str, ...]] = {
     SERVING_PARK_CHECKPOINT_FOR: ("kubeflow_tpu/serving/",),
     SERVING_FLEX_POOL_PREFIX: ("kubeflow_tpu/serving/",),
     SERVING_PRIORITY: ("kubeflow_tpu/serving/",),
+    SHARD_PREFERRED_CLAIM: ("kubeflow_tpu/runtime/sharding",),
 }

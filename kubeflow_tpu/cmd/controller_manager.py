@@ -126,7 +126,23 @@ def build_manager_app(mgr=None) -> web.Application:
             # budget remaining, health verdict, and the worst offenders
             # with exemplar trace ids (join them against /debug/traces).
             mgr.slo.refresh()
-            return web.json_response({"slo": mgr.slo.debug_info()})
+            payload = {"slo": mgr.slo.debug_info()}
+            # Lease observability: who holds what, and how often it has
+            # changed hands — the "is shard ownership stable" question
+            # answered next to the SLO verdict it explains.
+            elector = getattr(mgr, "elector", None)
+            if elector is not None:
+                payload["leader_election"] = {
+                    "lease": elector.lease_name,
+                    "identity": elector.identity,
+                    "is_leader": elector.is_leader,
+                    "transitions": elector.transitions,
+                }
+            ring_info = mgr.debug_sharding() \
+                if hasattr(mgr, "debug_sharding") else None
+            if ring_info is not None:
+                payload["shard_ring"] = ring_info
+            return web.json_response(payload)
 
         async def debug_timeline(request):
             ns = request.match_info["ns"]
@@ -202,7 +218,28 @@ async def amain() -> None:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     kube = HttpKube()
-    mgr = Manager(kube, namespace=os.environ.get("WATCH_NAMESPACE") or None)
+    # Sharded active-active control plane (docs/operations.md): with
+    # KFTPU_SHARDS > 1, this replica joins the shard lease ring and only
+    # reconciles the keyspace slices it holds. Replica identity comes
+    # from the StatefulSet ordinal (KFTPU_SHARD_REPLICA) so the preferred
+    # spread is stable across restarts.
+    ring = None
+    shards, shard_replica, shard_replicas, handback = \
+        envconfig.shard_ring_config()
+    if shards > 1:
+        from kubeflow_tpu.runtime.sharding import ShardRing
+
+        ring = ShardRing(
+            kube,
+            shards=shards,
+            replica=shard_replica,
+            replicas=shard_replicas,
+            identity=os.environ.get("POD_NAME") or None,
+            namespace=envconfig.controller_namespace(),
+            handback_ticks=handback,
+        )
+    mgr = Manager(kube, namespace=os.environ.get("WATCH_NAMESPACE") or None,
+                  shard_ring=ring)
     setup_notebook_controller(mgr, envconfig.notebook_options())
     culling = envconfig.culling_options()
     if culling.enable_culling:
@@ -234,6 +271,15 @@ async def amain() -> None:
         )
         log.info("waiting for leader election as %s", elector.identity)
         await elector.acquire()
+    mgr.elector = elector  # /debug/slo lease observability
+    if ring is not None:
+        # The scheduler (if any) arbitrates only while this replica holds
+        # the arbiter shard — one global chip ledger, N reconciling shards.
+        if getattr(mgr, "scheduler", None) is not None:
+            mgr.scheduler.attach_ring(ring)
+        await ring.start()
+        log.info("shard ring joined as %s: %d shard(s), owns %s",
+                 ring.identity, ring.shards, sorted(ring.owned))
     await mgr.start()
     log.info("controller manager started (%d controllers)", len(mgr.controllers))
     try:
@@ -246,6 +292,10 @@ async def amain() -> None:
         await asyncio.Event().wait()  # run forever
     finally:
         await mgr.stop()
+        if ring is not None:
+            # Graceful departure: release every shard lease so survivors
+            # absorb the keyspace without waiting out lease expiry.
+            await ring.stop()
         if elector is not None:
             await elector.release()
         await health.cleanup()

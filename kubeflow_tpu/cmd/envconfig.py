@@ -135,6 +135,25 @@ def scheduler_options():
     )
 
 
+def shard_ring_config() -> tuple[int, int, int, int]:
+    """Sharded control plane env contract (docs/operations.md "Sharded
+    control plane"): (shards, replica, replicas, handback_ticks).
+    KFTPU_SHARDS=1 — the default — keeps the single-writer
+    leader-elected control plane byte-for-byte; KFTPU_SHARD_REPLICA is
+    the StatefulSet ordinal so the preferred shard spread is stable
+    across restarts. A restarted replica reclaims its slice via the
+    demand-driven claim protocol (runtime/sharding.py), so the periodic
+    KFTPU_SHARD_HANDBACK_TICKS release is off by default — timer-based
+    handback churns absorbed shards through unowned windows even when
+    the preferred owner is dead and nobody can take them."""
+    return (
+        int(env_float("KFTPU_SHARDS", 1)),
+        int(env_float("KFTPU_SHARD_REPLICA", 0)),
+        int(env_float("KFTPU_SHARD_REPLICAS", 1)),
+        int(env_float("KFTPU_SHARD_HANDBACK_TICKS", 0)),
+    )
+
+
 def warm_pool_options():
     """Warm pod pools env contract (docs/operations.md "Warm pools &
     cold-start"). No KFTPU_WARM_POOLS spec and no ConfigMap source means

@@ -33,6 +33,8 @@ import asyncio
 import contextlib
 import os
 
+from kubeflow_tpu.runtime.errors import NotFound
+
 WRITE_VERBS = frozenset(
     {"create", "update", "update_status", "patch", "delete"})
 READ_VERBS = frozenset({"get", "list"})
@@ -41,11 +43,16 @@ READS_ENV = "KUBE_CLIENT_MAX_READS"
 WRITES_ENV = "KUBE_CLIENT_MAX_WRITES"
 EVENTS_ENV = "KUBE_CLIENT_EVENT_LANE"
 EVENT_PATIENCE_ENV = "KUBE_CLIENT_EVENT_PATIENCE"
+QPS_ENV = "KUBE_CLIENT_MAX_QPS"
 
 DEFAULT_MAX_READS = 16
 DEFAULT_MAX_WRITES = 8
 DEFAULT_EVENT_LANE = 1
 DEFAULT_EVENT_PATIENCE_SEC = 1.0
+# client-go's rest.Config defaults QPS=20/Burst=30, i.e. burst = 1.5×QPS.
+# Off (None) by default here: lanes bound concurrency already, and the
+# QPS bucket is the per-REPLICA budget knob for sharded deployments.
+QPS_BURST_FACTOR = 1.5
 
 
 def _env_int(name: str, default: int) -> int:
@@ -71,6 +78,7 @@ class FlowControl:
         max_writes: int | None = None,
         event_lane: int | None = None,
         event_patience: float | None = None,
+        max_qps: float | None = None,
     ):
         # Explicit 0 is clamped to 1, not silently replaced by the env
         # default — a lane can be narrowed to serial, never to "off".
@@ -83,6 +91,18 @@ class FlowControl:
         self.event_patience = (
             event_patience if event_patience is not None
             else _env_float(EVENT_PATIENCE_ENV, DEFAULT_EVENT_PATIENCE_SEC))
+        # client-go-style request rate cap (QPS + burst bucket), applied
+        # to read/write lanes before lane admission. None = unlimited
+        # (the historical behavior); the env knob lets a deployment cap
+        # every replica uniformly.
+        if max_qps is None:
+            env_qps = _env_float(QPS_ENV, 0.0)
+            max_qps = env_qps if env_qps > 0 else None
+        self.max_qps = max_qps
+        self._qps_burst = (max(1.0, max_qps * QPS_BURST_FACTOR)
+                           if max_qps else 0.0)
+        self._qps_tokens = self._qps_burst
+        self._qps_refill_at: float | None = None
         self._read_sem = asyncio.Semaphore(self.max_reads)
         self._write_sem = asyncio.Semaphore(self.max_writes)
         self._event_sem = asyncio.Semaphore(self.event_lane)
@@ -101,8 +121,28 @@ class FlowControl:
             return "read"
         return None  # watch / pod_logs: long-lived or out of scope
 
+    async def _pace(self) -> None:
+        """Token-bucket pacing: take one token (going negative reserves a
+        future slot, which keeps waiters FIFO-fair) and sleep out the
+        deficit. Watches and the event lane are exempt — streams are
+        long-lived, and events already yield to writes by design."""
+        if not self.max_qps:
+            return
+        loop = asyncio.get_running_loop()
+        now = loop.time()
+        if self._qps_refill_at is not None:
+            self._qps_tokens = min(
+                self._qps_burst,
+                self._qps_tokens + (now - self._qps_refill_at) * self.max_qps)
+        self._qps_refill_at = now
+        self._qps_tokens -= 1.0
+        if self._qps_tokens < 0:
+            await asyncio.sleep(-self._qps_tokens / self.max_qps)
+
     async def acquire(self, verb: str, kind: str | None = None) -> str | None:
         lane = self.lane_of(verb, kind)
+        if lane in ("read", "write"):
+            await self._pace()
         if lane == "read":
             await self._read_sem.acquire()
         elif lane == "write":
@@ -160,7 +200,51 @@ class FlowControl:
     def debug_info(self) -> dict:
         return {
             "limits": {"read": self.max_reads, "write": self.max_writes,
-                       "event": self.event_lane},
+                       "event": self.event_lane, "qps": self.max_qps},
             "writes_busy": self._writes_busy,
             "admitted": dict(self.admitted),
         }
+
+
+class BudgetedClient:
+    """A per-replica client facade: the SAME apiserver handle, its own
+    FlowControl budget — the in-process equivalent of each manager
+    replica carrying its own client-go rate limiter. Sharded deployments
+    wrap every replica's kube in one of these so the aggregate request
+    budget scales with replica count (that scaling IS the active-active
+    throughput win; one event loop gains no CPU from more replicas).
+
+    Rate-limited verbs pass through ``flow``; everything else (watch,
+    pod_logs, test conveniences) delegates untouched. ``get_or_none``
+    is reimplemented on the wrapped ``get`` so it pays for its read.
+    """
+
+    _PACED = ("get", "list", "list_with_rv", "create", "update",
+              "patch", "delete")
+
+    def __init__(self, kube, flow: FlowControl):
+        self._kube = kube
+        self.flow = flow
+        for verb in self._PACED:
+            if hasattr(kube, verb):
+                setattr(self, verb, self._wrap(verb))
+
+    def _wrap(self, verb: str):
+        inner = getattr(self._kube, verb)
+        lane_verb = verb if verb != "list_with_rv" else "list"
+
+        async def call(*args, **kwargs):
+            kind = args[0] if args else kwargs.get("kind")
+            async with self.flow.slot(lane_verb, kind):
+                return await inner(*args, **kwargs)
+
+        return call
+
+    async def get_or_none(self, kind, name, namespace=None):
+        try:
+            return await self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def __getattr__(self, name):
+        return getattr(self._kube, name)

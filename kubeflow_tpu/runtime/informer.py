@@ -72,6 +72,7 @@ class Informer:
         kind: str,
         namespace: str | None = None,
         label_selector: str | dict | None = None,
+        field_selector=None,
         resync_backoff: float = 1.0,
         resync_backoff_max: float = 30.0,
         registry=None,
@@ -80,6 +81,12 @@ class Informer:
         self.kind = kind
         self.namespace = namespace
         self.label_selector = label_selector
+        # Sharded (filtered) informer: a predicate threaded into every
+        # list AND watch — the client only ever sees its slice of the
+        # keyspace. A predicate reading live state (ShardRing ownership)
+        # makes the filter follow rebalances without informer restarts;
+        # refill() closes the gap for objects with no event in flight.
+        self.field_selector = field_selector
         # Relist storm control: ``resync_backoff`` is the BASE delay (a
         # cleanly-closed watch relists after it); consecutive list/watch
         # FAILURES escalate exponentially toward ``resync_backoff_max``
@@ -111,6 +118,7 @@ class Informer:
         # per-informer /debug/informers view without a registry scrape.
         self._index_stats: dict[str, list[int]] = {}
         self._relists = 0
+        self._refills = 0
         self._lookups = (
             registry.counter(
                 "informer_index_lookups_total",
@@ -220,6 +228,46 @@ class Informer:
     def items(self) -> list[dict]:
         return list(self.cache.values())
 
+    def _selector_kwargs(self) -> dict:
+        # Built conditionally so clients without filtered-watch support
+        # (HttpKube today) keep their unchanged call signature.
+        return ({"field_selector": self.field_selector}
+                if self.field_selector is not None else {})
+
+    def _admit(self, obj: dict) -> bool:
+        """Live re-check of a callable field selector at CACHE-APPLY time.
+        List snapshots and queued watch events cross awaits; with a
+        shard-filter selector the ownership they were filtered under can
+        be stale by the time they land — applying a pre-loss snapshot
+        would re-cache a foreign object, and refill() (cache-miss based)
+        would then never re-surface it on a later regain."""
+        fs = self.field_selector
+        return not callable(fs) or fs(obj)
+
+    async def refill(self) -> int:
+        """Additive relist: list under the CURRENT field selector and
+        dispatch ADDED for keys missing from the cache. Never deletes —
+        a list snapshot racing the live watch must not retract objects
+        the watch already delivered. This is the shard-absorption path:
+        after a replica acquires a shard, refill() surfaces every object
+        of the new keyspace that has no organic event in flight, and the
+        primary handlers enqueue them."""
+        objs, _rv = await self.kube.list_with_rv(
+            self.kind, self.namespace, self.label_selector,
+            **self._selector_kwargs())
+        self._refills += 1
+        added = 0
+        for obj in objs:
+            if not self._admit(obj):
+                continue  # shard lost while the list was in flight
+            key = key_of(obj)
+            if key in self.cache:
+                continue
+            self._apply_delta("ADDED", key, obj)
+            self._dispatch("ADDED", obj)
+            added += 1
+        return added
+
     def debug_info(self) -> dict:
         """JSON-shaped snapshot for the /debug/informers endpoint."""
         sync_age = (
@@ -237,8 +285,10 @@ class Informer:
                 str(self.label_selector) if self.label_selector else None
             ),
             "synced": self._synced.is_set(),
+            "filtered": self.field_selector is not None,
             "objects": len(self.cache),
             "relists": self._relists,
+            "refills": self._refills,
             # Storm-control state: a flapping watch shows up as a failure
             # streak + growing backoff + an aging last sync, instead of a
             # fixed-cadence LIST hammer.
@@ -278,8 +328,10 @@ class Informer:
                 self._relists += 1
                 if self._relists_total is not None:
                     self._relists_total.labels(kind=self.kind).inc()
+                refills_at_list = self._refills
                 objs, rv = await self.kube.list_with_rv(
-                    self.kind, self.namespace, self.label_selector
+                    self.kind, self.namespace, self.label_selector,
+                    **self._selector_kwargs()
                 )
                 # A successful list resets the failure streak — backoff
                 # escalation is for CONSECUTIVE failures only.
@@ -289,11 +341,17 @@ class Informer:
                 self._last_sync = time.monotonic()
                 if self._sync_age is not None:
                     self._sync_age.labels(kind=self.kind).set(0.0)
-                fresh = {key_of(o): o for o in objs}
-                for key, obj in list(self.cache.items()):
-                    if key not in fresh:
-                        self._apply_delta("DELETED", key, obj)
-                        self._dispatch("DELETED", obj)
+                fresh = {key_of(o): o for o in objs if self._admit(o)}
+                # The deletion sweep trusts the snapshot's completeness;
+                # a refill() that interleaved with the list (shard
+                # absorbed mid-await) added keys the stale snapshot never
+                # saw — sweeping now would evict them with no event ever
+                # coming back. Skip one round; the next relist re-syncs.
+                if self._refills == refills_at_list:
+                    for key, obj in list(self.cache.items()):
+                        if key not in fresh:
+                            self._apply_delta("DELETED", key, obj)
+                            self._dispatch("DELETED", obj)
                 for key, obj in fresh.items():
                     existed = key in self.cache
                     self._apply_delta("MODIFIED" if existed else "ADDED", key, obj)
@@ -308,7 +366,10 @@ class Informer:
                     self.label_selector,
                     send_initial=False,
                     resource_version=rv,
+                    **self._selector_kwargs(),
                 ):
+                    if event != "DELETED" and not self._admit(obj):
+                        continue  # ownership moved while the event queued
                     self._apply_delta(event, (namespace_of(obj), name_of(obj)), obj)
                     self._dispatch(event, obj)
                 # Watch closed cleanly → relist after the base backoff,

@@ -125,7 +125,8 @@ class Controller:
 class Manager:
     def __init__(self, kube, *, registry: Registry | None = None,
                  namespace: str | None = None,
-                 quarantine_after: int | None = None):
+                 quarantine_after: int | None = None,
+                 shard_ring=None):
         self.kube = kube
         self.namespace = namespace
         self.registry = registry or global_registry
@@ -133,6 +134,22 @@ class Manager:
         self.informers: dict[tuple[str, str | None], Informer] = {}
         self._queues: dict[str, RateLimitedQueue] = {}
         self._tasks: list[asyncio.Task] = []
+        # Active-active sharding (runtime/sharding.py): when a ring is
+        # attached, this replica only caches, enqueues and reconciles
+        # keys of the shards it holds. Three fences, outermost first:
+        # filtered informers (the field selector below keeps unowned
+        # objects out of the cache entirely), handler-side key checks
+        # (for events that arrive while ownership shifts), and the
+        # dequeue-side re-check in _worker (the last line against
+        # processing a key whose shard was lost while it sat queued).
+        self.shard_ring = shard_ring
+        if shard_ring is not None:
+            shard_ring.on_acquire(self._on_shard_acquired)
+            shard_ring.on_lose(self._on_shard_lost)
+        self._fenced_total = self.registry.counter(
+            "controller_shard_fenced_total",
+            "Dequeued keys skipped because their shard is not owned",
+            ["controller"])
         # Poison-pill quarantine budget (KFTPU_QUARANTINE_AFTER): a key
         # failing this many reconciles in a row is dead-lettered instead
         # of retrying at max backoff forever.
@@ -177,6 +194,14 @@ class Manager:
             ["controller"],
         )
 
+    def _owns(self, key) -> bool:
+        return self.shard_ring is None or self.shard_ring.owns_key(key)
+
+    def _shard_filter(self, obj: dict) -> bool:
+        """Informer field selector: cache only owned shards' objects.
+        Reads LIVE ring state so the filter follows rebalances."""
+        return self.shard_ring.owns_namespace(namespace_of(obj))
+
     def informer_for(
         self, kind: str, label_selector: str | dict | None = None
     ) -> Informer:
@@ -184,7 +209,10 @@ class Manager:
         if key not in self.informers:
             self.informers[key] = Informer(
                 self.kube, kind, namespace=self.namespace,
-                label_selector=label_selector, registry=self.registry,
+                label_selector=label_selector,
+                field_selector=(self._shard_filter
+                                if self.shard_ring is not None else None),
+                registry=self.registry,
             )
         return self.informers[key]
 
@@ -199,6 +227,8 @@ class Manager:
 
         def primary_handler(event: str, obj: dict) -> None:
             key = (namespace_of(obj), name_of(obj))
+            if not self._owns(key):
+                return
             if event == "DELETED":
                 # Failure-counter hygiene: the backoff/quarantine state
                 # dies with the object (an unbounded dict would otherwise
@@ -226,7 +256,9 @@ class Manager:
         def owner_handler(_event: str, obj: dict) -> None:
             ref = controller_of(obj)
             if ref and ref.get("kind") == ctrl.kind:
-                queue.add((namespace_of(obj), ref["name"]))
+                key = (namespace_of(obj), ref["name"])
+                if self._owns(key):
+                    queue.add(key)
 
         for child_kind in ctrl.owns:
             child_inf = self.informer_for(child_kind)
@@ -241,7 +273,8 @@ class Manager:
 
             def mapped_handler(_event: str, obj: dict, _map=watch.map_fn) -> None:
                 for key in _map(obj) or []:
-                    queue.add(tuple(key))
+                    if self._owns(tuple(key)):
+                        queue.add(tuple(key))
 
             inf.add_handler(mapped_handler)
 
@@ -294,6 +327,62 @@ class Manager:
                     return
             await asyncio.sleep(0.01)
         raise TimeoutError("manager queues did not drain")
+
+    # ---- shard rebalance ---------------------------------------------------------
+
+    def _on_shard_acquired(self, shard: int) -> None:
+        """Ring callback (sync): absorb the new shard's keyspace. The
+        filtered watches already pass its events (the field selector
+        reads live ring state); the refill surfaces every object with no
+        event in flight, and the primary handlers enqueue them."""
+        self._tasks.append(asyncio.create_task(
+            self._absorb_shard(shard), name=f"absorb-shard-{shard}"))
+
+    async def _absorb_shard(self, shard: int) -> None:
+        for informer in list(self.informers.values()):
+            try:
+                added = await informer.refill()
+                if added:
+                    log.info("shard %d absorb: %s refill surfaced %d "
+                             "object(s)", shard, informer.kind, added)
+            except Exception:
+                log.exception("shard %d absorb refill failed for %s",
+                              shard, informer.kind)
+
+    def _on_shard_lost(self, shard: int) -> None:
+        """Ring callback (sync): evict the lost shard's keys from every
+        workqueue AND informer cache before the new owner can start
+        reconciling them. The cache eviction is load-bearing for
+        re-acquisition, not just memory hygiene: ``refill()`` is an
+        additive relist that only surfaces cache-MISSING objects, so a
+        replica that loses and later regains the same shard would
+        otherwise refill nothing — its stale cache still holds the
+        keyspace whose queued keys the purge below just dropped."""
+        from kubeflow_tpu.runtime.sharding import shard_of
+
+        shards = self.shard_ring.shards
+
+        def lost(key) -> bool:
+            return shard_of(key[0], shards) == shard
+
+        for name, queue in self._queues.items():
+            purged = queue.purge(lost)
+            if purged:
+                log.info("shard %d lost: purged %d queued key(s) from %s",
+                         shard, purged, name)
+        for informer in self.informers.values():
+            evicted = [key for key in informer.cache if lost(key)]
+            for ns, obj_name in evicted:
+                informer.evict(obj_name, ns)
+            if evicted:
+                log.info("shard %d lost: evicted %d cached %s object(s)",
+                         shard, len(evicted), informer.kind)
+
+    def debug_sharding(self) -> dict | None:
+        """Ring + fence state for /debug — None when unsharded."""
+        if self.shard_ring is None:
+            return None
+        return self.shard_ring.debug_info()
 
     # ---- poison-pill quarantine ------------------------------------------------
 
@@ -405,6 +494,16 @@ class Manager:
             key = await queue.get()
             if key is None:
                 return
+            if not self._owns(key):
+                # Shard fence: ownership moved while the key sat queued.
+                # Drop it — the new owner's absorb refill re-discovers it
+                # — and drop its failure state with it (the streak belongs
+                # to the keyspace's new owner now, starting fresh).
+                queue.forget(key)
+                queue.done(key)
+                self._fenced_total.labels(controller=ctrl.name).inc()
+                await asyncio.sleep(0)
+                continue
             queue_wait = queue.take_wait(key)
             self._queue_depth.labels(controller=ctrl.name).set(len(queue))
             t0 = time.perf_counter()

@@ -20,17 +20,37 @@ def fmt_iso(ts: float) -> str:
     return time.strftime(ISO_FORMAT, time.gmtime(ts))
 
 
+def fmt_iso_micro(ts: float) -> str:
+    """metav1.MicroTime — microsecond RFC3339, the real precision of the
+    Lease ``renewTime`` field. Leader election MUST use this: rounding a
+    renew stamp down a whole second makes a fresh sub-second lease read
+    as already expired, and mutual exclusion collapses (every candidate
+    acquires)."""
+    micros = int(round(ts * 1_000_000))
+    secs, frac = divmod(micros, 1_000_000)
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(secs)) \
+        + f".{frac:06d}Z"
+
+
 def now_iso() -> str:
     return fmt_iso(time.time())
 
 
 def parse_iso(value: str) -> float | None:
-    for fmt in (ISO_FORMAT, "%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%S.%fz"):
-        try:
-            return calendar.timegm(time.strptime(value, fmt))
-        except ValueError:
-            continue
-    return None
+    # Fractional seconds are split off and re-added: strptime's %f parses
+    # them but struct_time cannot carry them, so the old %f formats were
+    # silently truncating MicroTime stamps to whole seconds.
+    frac = 0.0
+    if "." in value:
+        head, _, tail = value.partition(".")
+        digits = tail.rstrip("Zz")
+        if digits.isdigit():
+            frac = float(f"0.{digits}")
+            value = head + "Z"
+    try:
+        return calendar.timegm(time.strptime(value, ISO_FORMAT)) + frac
+    except ValueError:
+        return None
 
 
 def new_object(

@@ -196,6 +196,29 @@ class RateLimitedQueue:
     def quarantined_keys(self) -> list[Hashable]:
         return list(self._quarantined)
 
+    def purge(self, predicate) -> int:
+        """Drop every queued/backoff/quarantine record whose key matches
+        ``predicate`` — the shard-rebalance eviction: when this replica
+        loses a shard, that shard's keys must leave the queue NOW (the
+        new owner re-discovers them via its refill; a worker here
+        dequeuing one later would race the new owner's reconcile).
+        In-flight keys are not touched — the worker's dequeue fence
+        drops them on done(). Returns the number of queued keys purged;
+        stale heap entries are left to get()'s staleness check."""
+        purged = 0
+        for key in [k for k in self._queued if predicate(k)]:
+            self._queued.discard(key)
+            self._earliest.pop(key, None)
+            purged += 1
+        for key in [k for k in list(self._failures) if predicate(k)]:
+            self._failures.pop(key, None)
+            self._poison_streak.pop(key, None)
+        for key in [k for k in list(self._quarantined) if predicate(k)]:
+            self._quarantined.pop(key, None)
+        for key in [k for k in self._dirty if predicate(k)]:
+            self._dirty.discard(key)
+        return purged
+
     def is_quarantined(self, key: Hashable) -> bool:
         return key in self._quarantined
 

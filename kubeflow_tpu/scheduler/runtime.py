@@ -247,6 +247,7 @@ class TpuFleetScheduler:
         self._now = time.time
         self._node_informer = None          # set by setup wiring
         self._nb_informer = None
+        self._ring = None                   # set by attach_ring (sharded)
         self._enqueue_cbs: list = []
         # Serving workload class (kubeflow_tpu/serving): replica gang
         # keys admitted through serving_admission(). Their side effects
@@ -389,6 +390,28 @@ class TpuFleetScheduler:
         have changed (admitted, or its capacity reclaimed)."""
         self._serving_cbs.append(cb)
 
+    def attach_ring(self, ring) -> None:
+        """Arbiter election for sharded active-active deployments
+        (runtime/sharding.py): the chip ledger stays GLOBALLY consistent
+        by running arbitration only on the replica holding the arbiter
+        shard (shard 0). A scheduler attached to a non-arbiter ring is
+        dormant — ``_ensure_fleet`` refuses to activate, so its whole
+        surface is transparent pass-through and none of its background
+        sweeps (drains, spot reclaims, elastic intents) can fight the
+        real arbiter's. In-process harnesses (bench, chaos) give every
+        replica's controllers the ARBITER's scheduler instance — the
+        per-shard workqueues feeding one elected arbiter; on arbiter
+        failover a fresh scheduler rebuilds its ledger from the API via
+        the ``running=True`` re-seat path, exactly the controller-restart
+        semantics the chaos soak already exercises."""
+        self._ring = ring
+
+    @property
+    def arbiter(self) -> bool:
+        """True when this replica may arbitrate (unsharded, or holding
+        the arbiter shard)."""
+        return self._ring is None or self._ring.is_arbiter
+
     def _enqueue(self, key: tuple) -> None:
         cbs = (self._serving_cbs if key in self._serving_keys
                else self._enqueue_cbs)
@@ -422,6 +445,12 @@ class TpuFleetScheduler:
         the queue; ``KFTPU_SCHEDULER=off`` is the deliberate off switch.
         On a shrink, pools already over capacity simply stop fitting new
         gangs and drain as holders release."""
+        if not self.arbiter:
+            # Dormant standby: no fleet, so every admission passes
+            # through untouched and no sweep mutates shared state. The
+            # moment the ring hands this replica the arbiter shard, the
+            # next admission activates it here.
+            return False
         opts = self.options
         dynamic = opts.fleet_spec == "auto" or (
             not opts.fleet_spec and opts.fleet_configmap)
@@ -1904,6 +1933,7 @@ class TpuFleetScheduler:
         now = self._now()
         info = self.policy.debug_info(now)
         info["active"] = self.active
+        info["arbiter"] = self.arbiter
         info["fleet_source"] = (
             "explicit" if self.options.fleet_spec
             and self.options.fleet_spec != "auto"

@@ -24,6 +24,11 @@ global invariants every convergence must restore:
 
 ``bench.py chaos_soak [--smoke]`` runs this over ≥5 seeds as the CI
 gate; tests/test_chaos.py replays the same seeds in tier-1.
+
+``shard_kill_scenario`` (ISSUE 17) extends the soak to the sharded
+active-active control plane: N replicas over one apiserver, one crash-
+killed mid-flight, survivors absorbing its keyspace with zero dropped
+queued keys and every global invariant intact.
 """
 
 from __future__ import annotations
@@ -1133,4 +1138,230 @@ async def poison_scenario(seed: int = 0, *, quarantine_after: int = 6) -> dict:
         await sim.stop()
         await mgr.stop()
         kube.use_faults(None)
+        kube.close_watches()
+
+
+# ---- shard-kill scenario -------------------------------------------------------
+
+
+async def shard_kill_scenario(
+    seed: int = 0,
+    *,
+    shards: int = 4,
+    replicas: int = 3,
+    notebooks_per_namespace: int = 2,
+    lease_seconds: float = 0.6,
+    renew_seconds: float = 0.15,
+    converge_timeout: float = 30.0,
+) -> dict:
+    """Kill one shard of N mid-flight (ISSUE 17): N manager replicas run
+    active-active over one FakeKube, each reconciling only the namespace-
+    hash shards whose leases it holds. A non-arbiter replica is crash-
+    killed — leases left to expire, its queued keys dying with its
+    workqueues — the moment fresh work lands on its keyspace. Survivors
+    must absorb the orphaned shards within ~lease expiry plus the two-
+    tick orphan confirmation, converge EVERY notebook including the ones
+    created just before the kill (zero dropped queued keys), and restore
+    the global invariants (ledger, timeline continuity, drained queues)
+    with shard ownership still disjoint.
+
+    The arbiter replica (preferred owner of shard 0) is never the
+    victim: the shared scheduler instance stands in for "per-shard
+    admission queues feeding one elected arbiter", and arbiter failover
+    is controller-restart semantics the main soak already exercises.
+
+    Deterministic end to end (lease protocol + FakeKube, no fault RNG);
+    ``seed`` tags the report so the CI matrix stays uniform.
+    """
+    from kubeflow_tpu.runtime.sharding import (
+        ARBITER_SHARD,
+        ShardRing,
+        shard_of,
+    )
+
+    if replicas < 2 or shards < 2:
+        raise ValueError("shard-kill needs >= 2 replicas and >= 2 shards")
+    kube = FakeKube()
+    register_all(kube)
+    await kube.create("ConfigMap", {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "kftpu-fleet", "namespace": "kubeflow-tpu"},
+        "data": {"fleet": "pool-a=v5e:2x2:64"},
+    })
+
+    # Enough namespaces that every shard owns at least two — the victim's
+    # keyspace must be non-trivial for the absorption to prove anything.
+    by_shard: dict[int, list] = {s: [] for s in range(shards)}
+    namespaces: list[str] = []
+    i = 0
+    while any(len(v) < 2 for v in by_shard.values()):
+        ns = f"team-{i}"
+        i += 1
+        by_shard[shard_of(ns, shards)].append(ns)
+        namespaces.append(ns)
+        if i > 64 * shards:  # crc32 would have to be badly broken
+            raise RuntimeError("could not cover every shard with namespaces")
+
+    # ONE scheduler for the whole fleet: the in-process arbiter seam
+    # (scheduler/runtime.py attach_ring) — every replica's reconcilers
+    # feed it, only the arbiter-shard holder's ring activates it.
+    sched = TpuFleetScheduler(
+        kube,
+        SchedulerOptions(
+            queued_requeue_seconds=0.5,
+            fleet_configmap="kftpu-fleet",
+            controller_namespace="kubeflow-tpu",
+            fleet_refresh_seconds=0.05,
+        ),
+        registry=Registry(),
+    )
+
+    rings: list[ShardRing] = []
+    mgrs: list[Manager] = []
+    for r in range(replicas):
+        reg = Registry()
+        ring = ShardRing(
+            kube, shards=shards, replica=r, replicas=replicas,
+            lease_seconds=lease_seconds, renew_seconds=renew_seconds,
+            registry=reg)
+        mgr = Manager(kube, registry=reg, shard_ring=ring)
+        setup_notebook_controller(mgr, NotebookOptions(), scheduler=sched)
+        for q in mgr._queues.values():
+            q.base_delay = 0.002
+            q.max_delay = 0.05
+        for inf in mgr.informers.values():
+            inf.resync_backoff = 0.02
+            inf.resync_backoff_max = 0.2
+        rings.append(ring)
+        mgrs.append(mgr)
+    arbiter_replica = ARBITER_SHARD % replicas
+    victim = (replicas - 1 if replicas - 1 != arbiter_replica
+              else replicas - 2)
+    # setup wiring leaves sched._nb_informer pointing at the LAST
+    # manager's (filtered) cache; pin it to the arbiter's so the shared
+    # scheduler never reads through a dead replica's stopped informer.
+    sched._nb_informer = mgrs[arbiter_replica].informer_for("Notebook")
+    sched.attach_ring(rings[arbiter_replica])
+
+    sim = PodSimulator(kube)
+    out: dict = {
+        "seed": seed,
+        "shards": shards,
+        "replicas": replicas,
+        "namespaces": len(namespaces),
+        "victim_replica": victim,
+    }
+    stopped: set[int] = set()
+    try:
+        for r in range(replicas):
+            await rings[r].start()
+            await mgrs[r].start()
+        await sim.start()
+
+        names: list[tuple] = []
+        for ns in namespaces:
+            for j in range(notebooks_per_namespace):
+                name = f"nb-{j}"
+                await kube.create("Notebook", nbapi.new(
+                    name, ns, accelerator="v5e", topology="2x2"))
+                names.append((ns, name))
+        out["notebooks"] = len(names)
+
+        async def wait_ready(want_keys, timeout: float) -> set:
+            pending = set(want_keys)
+            deadline = time.monotonic() + timeout
+            while pending and time.monotonic() < deadline:
+                for ns, name in sorted(pending):
+                    nb = await kube.get_or_none("Notebook", name, ns)
+                    if nb is None:
+                        continue
+                    want = deep_get(
+                        nb, "status", "tpu", "hosts", default=1) or 1
+                    got = deep_get(
+                        nb, "status", "readyReplicas", default=0) or 0
+                    if got >= want:
+                        pending.discard((ns, name))
+                await asyncio.sleep(0.02)
+            return pending
+
+        not_ready = await wait_ready(names, converge_timeout)
+        out["pre_kill_ready"] = len(names) - len(not_ready)
+        out["pre_kill_converged"] = not not_ready
+
+        victim_shards = set(rings[victim].owned)
+        victim_namespaces = [
+            ns for ns in namespaces
+            if shard_of(ns, shards) in victim_shards]
+        out["victim_shards"] = sorted(victim_shards)
+        out["victim_namespaces"] = len(victim_namespaces)
+
+        # Fresh keys on the victim's keyspace, then an immediate crash:
+        # these land in the victim's workqueues (watch delta → enqueue)
+        # and die with them. Zero-dropped-keys means every one still
+        # converges, re-discovered by the absorbing survivor's
+        # refill-on-acquire and live-predicate filtered watch.
+        post_keys: list[tuple] = []
+        for ns in victim_namespaces:
+            await kube.create("Notebook", nbapi.new(
+                "post-kill", ns, accelerator="v5e", topology="2x2"))
+            post_keys.append((ns, "post-kill"))
+        out["post_kill_created"] = len(post_keys)
+
+        t_kill = time.monotonic()
+        await rings[victim].kill()  # crash: no lease release, no fencing
+        await mgrs[victim].stop()   # workers die mid-flight, queues lost
+        stopped.add(victim)
+
+        survivors = [r for r in range(replicas) if r != victim]
+        deadline = (time.monotonic() + lease_seconds
+                    + 20 * renew_seconds + 5)
+        absorbed = False
+        while time.monotonic() < deadline:
+            held: set[int] = set()
+            for r in survivors:
+                held |= rings[r].owned
+            if victim_shards <= held:
+                absorbed = True
+                break
+            await asyncio.sleep(renew_seconds / 2)
+        out["absorbed"] = absorbed
+        out["failover_seconds"] = round(time.monotonic() - t_kill, 3)
+
+        still_pending = await wait_ready(
+            names + post_keys, converge_timeout)
+        out["dropped_keys"] = sorted(
+            f"{ns}/{name}" for ns, name in still_pending)
+        out["all_ready_after_kill"] = not still_pending
+
+        for r in survivors:
+            await mgrs[r].wait_idle(timeout=15)
+
+        owned_sets = [set(rings[r].owned) for r in survivors]
+        union: set[int] = set().union(*owned_sets)
+        disjoint = sum(len(s) for s in owned_sets) == len(union)
+        out["ownership_disjoint"] = disjoint
+        out["all_shards_owned"] = union == set(range(shards))
+
+        problems: list[str] = []
+        for r in survivors:
+            for p in await check_invariants(kube, mgrs[r], sched, None):
+                problems.append(f"replica {r}: {p}")
+        out["invariant_problems"] = problems
+
+        out["pass"] = bool(
+            out.get("pre_kill_converged")
+            and victim_shards
+            and post_keys
+            and absorbed
+            and out.get("all_ready_after_kill")
+            and disjoint
+            and out.get("all_shards_owned")
+            and not problems)
+        return out
+    finally:
+        await sim.stop()
+        for r in range(replicas):
+            if r not in stopped:
+                await mgrs[r].stop()
+                await rings[r].stop()
         kube.close_watches()

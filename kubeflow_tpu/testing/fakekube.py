@@ -57,16 +57,29 @@ Validator = Callable[[dict, dict], Awaitable[None] | None]
 
 
 class _Watch:
-    def __init__(self, kind: str, namespace: str | None, selector: dict | None):
+    def __init__(self, kind: str, namespace: str | None, selector: dict | None,
+                 field_selector: Callable[[dict], bool] | None = None):
         self.kind = kind
         self.namespace = namespace
         self.selector = selector
+        self.field_selector = field_selector
         self.queue: asyncio.Queue[tuple[str, dict] | None] = asyncio.Queue()
 
     def wants(self, obj: dict) -> bool:
         if self.namespace and namespace_of(obj) != self.namespace:
             return False
-        return matches_selector(get_meta(obj).get("labels"), self.selector)
+        if not matches_selector(get_meta(obj).get("labels"), self.selector):
+            return False
+        if self.field_selector is not None:
+            # Same contract as list(): the predicate runs against the LIVE
+            # store dict and must be pure. A predicate that reads mutable
+            # external state (a shard ring) re-evaluates per event — that
+            # is the point: filtered informers follow ownership changes.
+            try:
+                return bool(self.field_selector(obj))
+            except Exception:
+                return False
+        return True
 
 
 def _injected_error(error: str) -> ApiError:
@@ -959,24 +972,24 @@ class FakeKube:
         namespace: str | None = None,
         label_selector: str | dict | None = None,
         *,
+        field_selector: Callable[[dict], bool] | None = None,
         send_initial: bool = True,
         resource_version: str | None = None,
     ) -> AsyncIterator[tuple[str, dict]]:
         """Watch registration is EAGER (at call time, not first iteration) so a
         synchronous list→watch sequence observes every event — the in-memory
         equivalent of resourceVersion continuity (``resource_version`` is
-        accepted and ignored)."""
+        accepted and ignored). ``field_selector`` mirrors list(): a pure
+        predicate over the live store dict, re-evaluated per event."""
         selector = (
             parse_label_selector(label_selector)
             if isinstance(label_selector, str)
             else label_selector
         )
-        w = _Watch(kind, namespace, selector)
+        w = _Watch(kind, namespace, selector, field_selector)
         if send_initial:
             for obj in self._bucket(kind).values():
-                if namespace and namespace_of(obj) != namespace:
-                    continue
-                if matches_selector(get_meta(obj).get("labels"), selector):
+                if w.wants(obj):
                     w.queue.put_nowait(("ADDED", deepcopy(obj)))
         self._watches.append(w)
         return self._drain_watch(w)

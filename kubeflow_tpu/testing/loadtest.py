@@ -37,18 +37,24 @@ async def run_load_test(
     *,
     count: int = 50,
     namespace: str = "loadtest",
+    namespaces: list[str] | None = None,
     accelerator: str | None = None,
     topology: str | None = None,
     timeout: float = 120.0,
     cleanup: bool = True,
     poll_interval: float = 0.05,
 ) -> LoadTestReport:
+    """``namespaces`` spreads the CRs round-robin across several
+    namespaces — required to load every shard of a namespace-hash
+    sharded control plane (a single namespace hashes to ONE shard and
+    would benchmark one replica no matter how many are running)."""
+    nss = list(namespaces) if namespaces else [namespace]
     t0 = time.perf_counter()
-    names = [f"load-{i}" for i in range(count)]
-    for name in names:
+    keyed = [(nss[i % len(nss)], f"load-{i}") for i in range(count)]
+    for ns, name in keyed:
         await kube.create(
             "Notebook",
-            nbapi.new(name, namespace, accelerator=accelerator, topology=topology),
+            nbapi.new(name, ns, accelerator=accelerator, topology=topology),
         )
 
     from kubeflow_tpu.testing.fakekube import FakeKube
@@ -57,36 +63,36 @@ async def run_load_test(
     # (HttpKube keeps the standard signature).
     list_kwargs = {"copy": False} if isinstance(kube, FakeKube) else {}
 
-    ready_at: dict[str, float] = {}
-    failed: dict[str, str] = {}
-    wanted = set(names)
+    ready_at: dict[tuple, float] = {}
+    failed: dict[tuple, str] = {}
+    wanted = set(keyed)
     deadline = t0 + timeout
     while len(ready_at) + len(failed) < count and time.perf_counter() < deadline:
-        # One list per poll pass (NOT a GET per notebook: against a real
-        # apiserver the serialized round-trips would skew the very spawn
-        # latencies being measured).
-        listed = {
-            name: nb
-            for nb in await kube.list("Notebook", namespace,
-                                       **list_kwargs)
-            if (name := nb["metadata"]["name"]) in wanted
-        }
-        for name in names:
-            if name in ready_at or name in failed:
+        # One list per namespace per poll pass (NOT a GET per notebook:
+        # against a real apiserver the serialized round-trips would skew
+        # the very spawn latencies being measured).
+        listed: dict[tuple, dict] = {}
+        for ns in nss:
+            for nb in await kube.list("Notebook", ns, **list_kwargs):
+                key = (ns, nb["metadata"]["name"])
+                if key in wanted:
+                    listed[key] = nb
+        for key in keyed:
+            if key in ready_at or key in failed:
                 continue
-            nb = listed.get(name)
+            nb = listed.get(key)
             if nb is None:
-                failed[name] = f"{name}: disappeared"
+                failed[key] = f"{key[0]}/{key[1]}: disappeared"
                 continue
             want = deep_get(nb, "status", "tpu", "hosts", default=1) or 1
             if (deep_get(nb, "status", "readyReplicas", default=0) or 0) >= want:
-                ready_at[name] = time.perf_counter() - t0
+                ready_at[key] = time.perf_counter() - t0
         await asyncio.sleep(poll_interval)
 
     wall = time.perf_counter() - t0
-    for name in names:  # pending-at-deadline notebooks are failures too
-        if name not in ready_at and name not in failed:
-            failed[name] = f"{name}: not ready within {timeout}s"
+    for key in keyed:  # pending-at-deadline notebooks are failures too
+        if key not in ready_at and key not in failed:
+            failed[key] = f"{key[0]}/{key[1]}: not ready within {timeout}s"
     failures = list(failed.values())
     latencies = sorted(ready_at.values())
 
@@ -98,9 +104,9 @@ async def run_load_test(
         return latencies[rank - 1]
 
     if cleanup:
-        for name in names:
+        for ns, name in keyed:
             try:
-                await kube.delete("Notebook", name, namespace)
+                await kube.delete("Notebook", name, ns)
             except ApiError:  # NotFound included — it subclasses ApiError
                 pass  # cleanup is best-effort; the report already exists
 

@@ -1,0 +1,538 @@
+"""Sharded active-active control plane (ISSUE 17).
+
+Three layers, mirroring the subsystem:
+
+- **ring protocol**: deterministic key→shard hashing, preferred-spread
+  convergence, two-tick orphan absorption, graceful release vs crash,
+  periodic and demand-driven (claim) handback, clock skew,
+  renew-failure backoff — all driven by manual ``tick()`` with a fake
+  clock, no sleeps;
+- **manager fencing**: filtered informer caches, dequeue fences, queue
+  purge on shard loss, refill on shard gain — and the end-to-end
+  no-dual-processing check (two replicas over one apiserver, disjoint
+  write sets);
+- **client budget**: the per-replica QPS token bucket that makes N
+  replicas worth N budgets.
+"""
+
+import asyncio
+import time
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.api.keys import SHARD_PREFERRED_CLAIM
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    setup_notebook_controller,
+)
+from kubeflow_tpu.runtime.errors import ApiError
+from kubeflow_tpu.runtime.flowcontrol import BudgetedClient, FlowControl
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import Registry
+from kubeflow_tpu.runtime.sharding import ARBITER_SHARD, ShardRing, shard_of
+from kubeflow_tpu.testing.fakekube import FakeKube
+from kubeflow_tpu.testing.podsim import PodSimulator
+from kubeflow_tpu.webhooks import register_all
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_ring(kube, replica, *, replicas=2, shards=4, clock=None, **kw):
+    return ShardRing(
+        kube, shards=shards, replica=replica, replicas=replicas,
+        lease_seconds=10.0, renew_seconds=1.0, clock=clock,
+        registry=Registry(), **kw)
+
+
+def namespace_on_shard(shard: int, shards: int = 4) -> str:
+    for i in range(10_000):
+        ns = f"team-{i}"
+        if shard_of(ns, shards) == shard:
+            return ns
+    raise AssertionError(f"no namespace hashes to shard {shard}")
+
+
+# ---- hashing ----------------------------------------------------------------
+
+
+def test_shard_of_deterministic_and_cluster_scope_pinned():
+    assert shard_of("team-a", 4) == shard_of("team-a", 4)
+    assert all(0 <= shard_of(f"ns-{i}", 4) < 4 for i in range(64))
+    # Every shard is reachable — crc32 spreads real namespace names.
+    assert {shard_of(f"team-{i}", 4) for i in range(64)} == {0, 1, 2, 3}
+    # Cluster-scoped keys (no namespace) pin to the arbiter shard.
+    assert shard_of(None, 4) == ARBITER_SHARD
+    assert shard_of("", 4) == ARBITER_SHARD
+    # Degenerate single-shard ring short-circuits.
+    assert shard_of("anything", 1) == 0
+
+
+# ---- ring protocol ----------------------------------------------------------
+
+
+async def test_preferred_spread_is_disjoint_and_stable():
+    kube, clock = FakeKube(), FakeClock()
+    r0 = make_ring(kube, 0, clock=clock)
+    r1 = make_ring(kube, 1, clock=clock)
+    await r0.tick()
+    await r1.tick()
+    assert r0.owned == {0, 2}
+    assert r1.owned == {1, 3}
+    assert r0.is_arbiter and not r1.is_arbiter
+    # Healthy fleet: further ticks renew, never churn.
+    transitions = (r0.transitions, r1.transitions)
+    for _ in range(3):
+        clock.t += 1
+        await r0.tick()
+        await r1.tick()
+    assert (r0.transitions, r1.transitions) == transitions
+    assert r0.owned == {0, 2} and r1.owned == {1, 3}
+
+
+async def test_dead_replica_absorbed_after_expiry_plus_two_ticks():
+    kube, clock = FakeKube(), FakeClock()
+    r0 = make_ring(kube, 0, clock=clock)
+    r1 = make_ring(kube, 1, clock=clock)
+    await r0.tick()
+    await r1.tick()
+
+    # r1 stops ticking (crash without any lease write). While its leases
+    # are fresh, the survivor must NOT touch them.
+    await r0.tick()
+    assert r0.owned == {0, 2}
+
+    clock.t += 11  # past lease_seconds: r1's leases expire
+    await r0.tick()  # first orphan observation — still hands-off
+    assert r0.owned == {0, 2}
+    await r0.tick()  # second consecutive observation confirms
+    assert r0.owned == {0, 1, 2, 3}
+    assert r0.is_arbiter
+
+
+async def test_graceful_stop_releases_leases_for_fast_absorption():
+    kube, clock = FakeKube(), FakeClock()
+    r0 = make_ring(kube, 0, clock=clock)
+    r1 = make_ring(kube, 1, clock=clock)
+    await r0.tick()
+    await r1.tick()
+    lost = []
+    r1.on_lose(lost.append)
+
+    await r1.stop(release=True)
+    assert r1.owned == frozenset()
+    assert sorted(lost) == [1, 3]  # fencing fired on the departing side
+    lease = await kube.get("Lease", "kubeflow-tpu-shard-1", "kubeflow-tpu")
+    assert lease["spec"]["holderIdentity"] == ""
+
+    # NO clock advance needed: released leases are orphans immediately,
+    # so the survivor absorbs after the usual two-tick confirmation.
+    await r0.tick()
+    await r0.tick()
+    assert r0.owned == {0, 1, 2, 3}
+
+
+async def test_kill_is_a_crash_leases_left_to_expire():
+    kube, clock = FakeKube(), FakeClock()
+    r1 = make_ring(kube, 1, clock=clock)
+    await r1.start()
+    try:
+        assert r1.owned == {1, 3}
+        await r1.kill()
+        # A SIGKILL writes nothing: leases still held, local state frozen.
+        lease = await kube.get(
+            "Lease", "kubeflow-tpu-shard-1", "kubeflow-tpu")
+        assert lease["spec"]["holderIdentity"] == r1.identity
+        assert r1.owned == {1, 3}
+
+        r0 = make_ring(kube, 0, clock=clock)
+        await r0.tick()
+        await r0.tick()
+        assert r0.owned == {0, 2}  # victim's leases still fresh
+        clock.t += 11
+        await r0.tick()
+        await r0.tick()
+        assert r0.owned == {0, 1, 2, 3}
+    finally:
+        await r1.kill()
+
+
+async def test_handback_returns_absorbed_shard_to_restarted_owner():
+    kube, clock = FakeKube(), FakeClock()
+    r0 = make_ring(kube, 0, clock=clock, handback_ticks=2)
+    await r0.tick()  # preferred slice + first orphan look at 1 and 3
+    await r0.tick()  # second consecutive orphan look: absorb
+    assert r0.owned == {0, 1, 2, 3}  # absorbed the never-started fleet
+
+    await r0.tick()  # countdown 2 → 1 on shards 1 and 3
+    assert r0.owned == {0, 1, 2, 3}
+    await r0.tick()  # countdown hits 0: voluntary release
+    assert r0.owned == {0, 2}
+
+    # The restarted preferred owner reclaims its slice eagerly.
+    r1 = make_ring(kube, 1, clock=clock)
+    await r1.tick()
+    assert r1.owned == {1, 3}
+    assert r0.owned.isdisjoint(r1.owned)
+
+
+async def test_claim_handback_rebalances_to_live_restarted_owner():
+    kube, clock = FakeKube(), FakeClock()
+    r0 = make_ring(kube, 0, clock=clock)
+    await r0.tick()
+    await r0.tick()  # two orphan looks at 1/3: absorb the absent fleet
+    assert r0.owned == {0, 1, 2, 3}
+
+    # No claimant → the absorbed shards are KEPT, tick after tick: no
+    # periodic release churning the keyspace through unowned windows.
+    transitions = r0.transitions
+    for _ in range(5):
+        clock.t += 1
+        await r0.tick()
+    assert r0.owned == {0, 1, 2, 3}
+    assert r0.transitions == transitions
+
+    # The preferred owner comes back: its first tick can't acquire (the
+    # leases are freshly held) so it stamps a claim on each.
+    r1 = make_ring(kube, 1, clock=clock)
+    await r1.tick()
+    assert r1.owned == frozenset()
+    lease = await kube.get("Lease", "kubeflow-tpu-shard-1", "kubeflow-tpu")
+    assert r1.identity in lease["metadata"]["annotations"][
+        SHARD_PREFERRED_CLAIM]
+
+    # Holder's next renew honors the fresh claim; claimant acquires on
+    # its following tick. Rebalance in ~2 renew intervals, no expiry.
+    await r0.tick()
+    assert r0.owned == {0, 2}
+    await r1.tick()
+    assert r1.owned == {1, 3}
+    assert r0.owned.isdisjoint(r1.owned)
+
+
+async def test_stale_claim_from_dead_claimant_is_ignored():
+    kube, clock = FakeKube(), FakeClock()
+    r0 = make_ring(kube, 0, clock=clock)
+    await r0.tick()
+    await r0.tick()
+    assert r0.owned == {0, 1, 2, 3}
+
+    # A claimant stamps once, then dies without ever acquiring.
+    r1 = make_ring(kube, 1, clock=clock)
+    await r1.tick()
+
+    # Within lease_seconds the claim is live — the holder would hand the
+    # shard back. Past it, the claim is stale (its stamper stopped
+    # refreshing) and MUST be ignored, or the shard would be released
+    # into a void every time the dead claimant's annotation is re-read.
+    clock.t += 11
+    for _ in range(3):
+        await r0.tick()
+    assert r0.owned == {0, 1, 2, 3}
+
+
+async def test_clock_skew_takeover_never_dual_owns_past_one_tick():
+    kube = FakeKube()
+    clock_a, clock_b = FakeClock(1000.0), FakeClock(1012.0)  # b ahead
+    r0 = make_ring(kube, 0, shards=1, clock=clock_a)
+    r1 = make_ring(kube, 1, shards=1, clock=clock_b)
+    await r0.tick()
+    assert r0.owned == {0}
+
+    # By b's skewed clock the lease is already expired: two orphan
+    # observations, then the steal.
+    await r1.tick()
+    await r1.tick()
+    assert r1.owned == {0}
+
+    # The slow-clocked old owner sees a FOREIGN fresh holder on its next
+    # renew — an immediate, unconditional drop (no renew-failure grace).
+    lost = []
+    r0.on_lose(lost.append)
+    await r0.tick()
+    assert r0.owned == frozenset()
+    assert lost == [0]
+    assert r1.owned == {0}
+
+
+async def test_renew_failure_backoff_survives_blips_drops_at_budget():
+    kube, clock = FakeKube(), FakeClock()
+    r0 = make_ring(kube, 0, replicas=1, shards=1, clock=clock)
+    await r0.tick()
+    assert r0.owned == {0}
+
+    failing = {"on": False}
+    orig_update = kube.update
+
+    async def flaky_update(kind, obj, *a, **kw):
+        if failing["on"] and kind == "Lease":
+            raise ApiError("apiserver blip")
+        return await orig_update(kind, obj, *a, **kw)
+
+    kube.update = flaky_update
+    try:
+        # Transient: failures * renew_seconds < lease_seconds keeps the
+        # shard (the lease is still fresh; nobody else can take it).
+        failing["on"] = True
+        for _ in range(3):
+            await r0.tick()
+        assert r0.owned == {0}
+
+        # Recovery resets the failure streak.
+        failing["on"] = False
+        await r0.tick()
+        assert r0.owned == {0}
+
+        # Sustained failure exhausts the budget (lease/renew = 10 ticks):
+        # the ring must assume the lease is gone and fence itself.
+        failing["on"] = True
+        for _ in range(10):
+            await r0.tick()
+        assert r0.owned == frozenset()
+    finally:
+        kube.update = orig_update
+
+
+async def test_restart_flapping_converges_without_dual_ownership():
+    kube, clock = FakeKube(), FakeClock()
+    r0 = make_ring(kube, 0, clock=clock)
+    await r0.tick()
+    for _ in range(3):  # replica 1 crash-loops
+        r1 = make_ring(kube, 1, clock=clock)
+        await r1.tick()
+        await r0.tick()  # sees the foreign holder: orphan streak resets
+        assert r0.owned.isdisjoint(r1.owned)
+        assert r1.owned == {1, 3}
+        await r1.stop(release=True)
+        await r0.tick()
+        assert r0.owned == {0, 2}  # one tick: orphans not yet confirmed
+    # After the flapping stops, the survivor absorbs normally.
+    await r0.tick()
+    assert r0.owned == {0, 1, 2, 3}
+
+
+# ---- manager fencing --------------------------------------------------------
+
+
+class RecordingClient:
+    """Per-replica write recorder: which namespaces did THIS replica
+    mutate? Disjoint write sets across replicas == no dual processing."""
+
+    def __init__(self, kube, wrote: set):
+        self._kube = kube
+        self._wrote = wrote
+        for verb in ("create", "update", "update_status", "patch", "delete"):
+            if hasattr(kube, verb):
+                setattr(self, verb, self._wrap(verb))
+
+    def _wrap(self, verb):
+        inner = getattr(self._kube, verb)
+
+        async def call(*args, **kwargs):
+            obj = args[1] if len(args) > 1 else None
+            ns = None
+            if isinstance(obj, dict):
+                ns = obj.get("metadata", {}).get("namespace")
+            elif verb in ("patch", "delete", "update_status"):
+                ns = args[3] if len(args) > 3 else kwargs.get("namespace")
+            if ns:
+                self._wrote.add(ns)
+            return await inner(*args, **kwargs)
+
+        return call
+
+    def __getattr__(self, name):
+        return getattr(self._kube, name)
+
+
+def _fast_queues(mgr):
+    for q in mgr._queues.values():
+        q.base_delay = 0.002
+        q.max_delay = 0.05
+
+
+async def test_two_replicas_split_keyspace_with_disjoint_writes():
+    kube = FakeKube()
+    register_all(kube)
+    sim = PodSimulator(kube)
+    wrote = [set(), set()]
+    mgrs, rings = [], []
+    for r in range(2):
+        ring = make_ring(kube, r)
+        mgr = Manager(RecordingClient(kube, wrote[r]),
+                      registry=Registry(), shard_ring=ring)
+        setup_notebook_controller(mgr, NotebookOptions())
+        _fast_queues(mgr)
+        mgrs.append(mgr)
+        rings.append(ring)
+    for ring in rings:
+        await ring.start()
+    for mgr in mgrs:
+        await mgr.start()
+    await sim.start()
+    try:
+        namespaces = [namespace_on_shard(s) for s in range(4)]
+        for ns in namespaces:
+            await kube.create(
+                "Notebook",
+                nbapi.new("nb", ns, accelerator="v5e", topology="2x2"))
+
+        async def all_ready():
+            for ns in namespaces:
+                nb = await kube.get_or_none("Notebook", "nb", ns)
+                want = (nb or {}).get("status", {}).get(
+                    "tpu", {}).get("hosts", 1) or 1
+                got = (nb or {}).get("status", {}).get("readyReplicas", 0)
+                if (got or 0) < want:
+                    return False
+            return True
+
+        deadline = time.perf_counter() + 30
+        while not await all_ready():
+            assert time.perf_counter() < deadline, "notebooks never ready"
+            await asyncio.sleep(0.05)
+
+        # Filtered informers: each replica caches ONLY its keyspace.
+        for r, mgr in enumerate(mgrs):
+            cached_ns = {k[0] for k in
+                         mgr.informers[("Notebook", None)].cache}
+            assert cached_ns, f"replica {r} cached nothing"
+            for ns in cached_ns:
+                assert rings[r].owns_namespace(ns)
+
+        # No dual processing: the replicas' write sets are disjoint and
+        # together cover every namespace.
+        assert wrote[0].isdisjoint(wrote[1])
+        assert set(namespaces) <= (wrote[0] | wrote[1])
+    finally:
+        await sim.stop()
+        for mgr in mgrs:
+            await mgr.stop()
+        for ring in rings:
+            await ring.stop()
+        kube.close_watches()
+
+
+async def test_rebalance_purges_lost_keys_and_refills_gained_shard():
+    kube, clock = FakeKube(), FakeClock()
+    register_all(kube)
+    sim = PodSimulator(kube)
+    ring = ShardRing(kube, shards=2, replica=0, replicas=2,
+                     lease_seconds=10.0, renew_seconds=1.0, clock=clock,
+                     registry=Registry())
+    mgr = Manager(kube, registry=Registry(), shard_ring=ring)
+    setup_notebook_controller(mgr, NotebookOptions())
+    _fast_queues(mgr)
+    ns_owned = namespace_on_shard(0, shards=2)
+    ns_foreign = namespace_on_shard(1, shards=2)
+    await ring.tick()  # manual maintenance only — no background loop
+    assert ring.owned == {0}
+    await mgr.start()
+    await sim.start()
+    try:
+        for ns in (ns_owned, ns_foreign):
+            await kube.create(
+                "Notebook",
+                nbapi.new("nb", ns, accelerator="v5e", topology="2x2"))
+
+        async def ready(ns):
+            nb = await kube.get_or_none("Notebook", "nb", ns)
+            want = (nb or {}).get("status", {}).get(
+                "tpu", {}).get("hosts", 1) or 1
+            return ((nb or {}).get("status", {}).get(
+                "readyReplicas", 0) or 0) >= want
+
+        deadline = time.perf_counter() + 30
+        while not await ready(ns_owned):
+            assert time.perf_counter() < deadline
+            await asyncio.sleep(0.05)
+        # The foreign shard's notebook was never touched: the filtered
+        # informer kept it out of cache, so no reconcile, no StatefulSet.
+        assert (ns_foreign, "nb") not in mgr.informers[("Notebook", None)].cache
+        assert await kube.list("StatefulSet", ns_foreign) == []
+
+        # Dequeue fence: a foreign key smuggled straight into the queue
+        # is dropped by the worker, never reconciled.
+        fenced = mgr._fenced_total.labels(controller="notebook")
+        before = fenced.value
+        mgr.enqueue("notebook", (ns_foreign, "nb"))
+        deadline = time.perf_counter() + 10
+        while fenced.value == before:
+            assert time.perf_counter() < deadline, "fence never fired"
+            await asyncio.sleep(0.02)
+        assert await kube.list("StatefulSet", ns_foreign) == []
+
+        # Rebalance IN: absorbing shard 1 refills the informer, which
+        # enqueues the foreign notebook and reconciles it to ready.
+        await ring.tick()
+        await ring.tick()  # two-tick orphan confirmation
+        assert ring.owned == {0, 1}
+        deadline = time.perf_counter() + 30
+        while not await ready(ns_foreign):
+            assert time.perf_counter() < deadline
+            await asyncio.sleep(0.05)
+
+        # Rebalance OUT: losing a shard purges its queued keys before the
+        # new owner can see the lease freed.
+        q = mgr._queues["notebook"]
+        mgr.enqueue("notebook", (ns_foreign, "pending-key"))
+        assert any(k[0] == ns_foreign for k in q._queued)
+        ring._drop(1)
+        assert not any(k[0] == ns_foreign for k in q._queued)
+
+        # ...and evicts the shard's objects from the informer caches.
+        # Load-bearing for RE-acquisition, not just memory hygiene:
+        # refill() only surfaces cache-MISSING objects, so a replica
+        # that loses and regains the same shard with a stale cache
+        # would refill nothing — the keyspace would be silently dead.
+        assert (ns_foreign, "nb") \
+            not in mgr.informers[("Notebook", None)].cache
+        await ring._electors[1].release()
+
+        # Break the foreign notebook's child while the shard is unowned;
+        # only a refill-driven reconcile on the regain can repair it
+        # (the filtered watch never saw the deletion).
+        for sts in await kube.list("StatefulSet", ns_foreign):
+            await kube.delete(
+                "StatefulSet", sts["metadata"]["name"], ns_foreign)
+        await ring.tick()
+        await ring.tick()  # orphan confirmed: regain
+        assert ring.owned == {0, 1}
+        deadline = time.perf_counter() + 30
+        while not await kube.list("StatefulSet", ns_foreign):
+            assert time.perf_counter() < deadline, \
+                "regained shard never refilled its keyspace"
+            await asyncio.sleep(0.05)
+    finally:
+        await sim.stop()
+        await mgr.stop()
+        await ring.stop()
+        kube.close_watches()
+
+
+# ---- client budget ----------------------------------------------------------
+
+
+async def test_budgeted_client_paces_reads_to_qps():
+    kube = FakeKube()
+    flow = FlowControl(max_qps=50.0)  # burst = 75 tokens
+    client = BudgetedClient(kube, flow)
+    t0 = time.perf_counter()
+    for _ in range(120):
+        await client.list("Notebook", "ns")
+    elapsed = time.perf_counter() - t0
+    # 120 requests against 75 burst tokens leaves ~45 paced at 50/s.
+    assert elapsed >= 0.7, f"QPS budget not enforced ({elapsed:.3f}s)"
+    assert flow.admitted["read"] == 120
+
+
+async def test_unbudgeted_flowcontrol_does_not_pace():
+    flow = FlowControl()  # max_qps None — pacing off entirely
+    t0 = time.perf_counter()
+    for _ in range(200):
+        await flow._pace()
+    assert time.perf_counter() - t0 < 0.5

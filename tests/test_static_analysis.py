@@ -620,7 +620,14 @@ def test_analyzer_clean_over_real_tree():
         "exception-swallow": 4,
         # +1 (PR 16): _sweep_commits' pop, re-validated by identity
         # after the await
-        "await-race": 17,
+        # +9 (ISSUE 17): the lease/shard-ring protocol sites — the
+        # Lease update IS the CAS (resourceVersion conflict is the
+        # re-validation, server-side) in leaderelection.try_acquire/
+        # release and sharding._stamp_claim; the ring's per-shard
+        # counters and _task/_renew_task are single-maintenance-task
+        # state with cancel-first shutdown. All nine also carry
+        # shard-safety declarations in ci/analysis/shard_safety.json.
+        "await-race": 26,
     }
     unexpected = set(by_rule) - set(ratchet)
     assert not unexpected, (
@@ -630,7 +637,7 @@ def test_analyzer_clean_over_real_tree():
         assert by_rule.get(rule, 0) <= cap, (
             f"{rule}: {by_rule.get(rule, 0)} suppressions > ratchet "
             f"{cap} — fix the finding instead of suppressing")
-    assert len(report.suppressed) <= 25
+    assert len(report.suppressed) <= 34
 
 
 def test_cli_clean_over_real_tree_writes_json(tmp_path, capsys):
@@ -1596,3 +1603,103 @@ def test_await_race_async_for_diagnostic_names_the_loop_line(tmp_path):
         """}, select={"await-race"})
     assert [f.rule for f in report.findings] == ["await-race"]
     assert "(line 0)" not in report.findings[0].message
+
+
+# ---- shard-safety ------------------------------------------------------------
+
+
+def test_shard_safety_undeclared_module_singletons(tmp_path):
+    _, report = ipa(tmp_path, {"kubeflow_tpu/runtime/caches.py": """\
+        CACHE = {}
+        REGISTRY = MetricsRegistry()
+        """}, select={"shard-safety"})
+    assert rules_of(report) == ["undeclared-module-singleton"] * 2
+    assert "kubeflow_tpu/runtime/caches.py:CACHE" in report.findings[0].message
+
+
+def test_shard_safety_constants_and_testing_harnesses_stay_quiet(tmp_path):
+    _, report = ipa(tmp_path, {
+        "kubeflow_tpu/runtime/consts.py": """\
+            from pathlib import Path
+            from typing import TypeVar
+            __all__ = ["T", "ROOT", "NAMES"]
+            T = TypeVar("T")
+            ROOT = Path("/etc/kftpu")
+            NAMES = frozenset({"a", "b"})
+            LIMIT = 3
+            """,
+        # Harnesses are single-process by construction: exempt.
+        "kubeflow_tpu/testing/harness.py": "STATE = {}\n",
+    }, select={"shard-safety"})
+    assert report.findings == []
+
+
+def test_shard_safety_declared_entry_quiet_incomplete_flagged(tmp_path):
+    src = {"kubeflow_tpu/runtime/caches.py": "CACHE = {}\n"}
+    declared = dict(src)
+    declared["ci/analysis/shard_safety.json"] = """\
+        {"module_singletons": {
+            "kubeflow_tpu/runtime/caches.py:CACHE":
+                {"owner": "runtime",
+                 "shard_safety": "per-process read-through cache"}}}
+        """
+    _, report = ipa(tmp_path, declared, select={"shard-safety"})
+    assert report.findings == []
+
+    hollow = dict(src)
+    hollow["ci/analysis/shard_safety.json"] = """\
+        {"module_singletons": {
+            "kubeflow_tpu/runtime/caches.py:CACHE":
+                {"owner": "", "shard_safety": "  "}}}
+        """
+    _, report = ipa(tmp_path, hollow, select={"shard-safety"})
+    assert rules_of(report) == ["incomplete-shard-safety-entry"]
+
+
+def test_shard_safety_await_crossing_needs_declaration(tmp_path):
+    src = {MANAGER_PATH: """\
+        class Manager:
+            def __init__(self):
+                self._inflight = {}
+            async def reconcile(self, key):
+                n = self._inflight.get(key, 0)
+                await self.api(key)
+                self._inflight[key] = n + 1
+            async def api(self, key):
+                pass
+        """}
+    _, report = ipa(tmp_path, src, select={"shard-safety"})
+    assert rules_of(report) == ["undeclared-await-crossing"]
+    assert '"Manager._inflight"' in report.findings[0].message
+
+    declared = dict(src)
+    declared["ci/analysis/shard_safety.json"] = """\
+        {"await_crossings": {
+            "Manager._inflight":
+                {"owner": "runtime",
+                 "shard_safety": "shard-local; keys fenced at dequeue"}}}
+        """
+    _, report = ipa(tmp_path, declared, select={"shard-safety"})
+    assert report.findings == []
+
+
+def test_shard_safety_stale_entries_fail_the_full_tree_scan(tmp_path):
+    _, report = ipa(tmp_path, {
+        "kubeflow_tpu/runtime/empty.py": "LIMIT = 3\n",
+        "ci/analysis/shard_safety.json": """\
+            {"module_singletons": {"kubeflow_tpu/gone.py:CACHE":
+                {"owner": "x", "shard_safety": "y"}},
+             "await_crossings": {"Ghost._attr":
+                {"owner": "x", "shard_safety": "y"}}}
+            """,
+    }, select={"shard-safety"})
+    assert rules_of(report) == ["stale-shard-safety-entry"] * 2
+
+
+def test_shard_safety_unreadable_registry_is_a_finding(tmp_path):
+    _, report = ipa(tmp_path, {
+        "kubeflow_tpu/runtime/empty.py": "LIMIT = 3\n",
+        "ci/analysis/shard_safety.json": "{not json",
+    }, select={"shard-safety"})
+    assert rules_of(report) == ["stale-shard-safety-entry"]
+    assert "unreadable" in report.findings[0].message
