@@ -20,5 +20,6 @@ from ci.analysis.passes import (  # noqa: F401
     shardsafety,
     sloreg,
     swallow,
+    telemetry,
     warmpool,
 )

@@ -1,0 +1,239 @@
+"""``telemetry-contract``: the step-telemetry wire surface cannot drift.
+
+Three drift classes this pass kills (ISSUE 18):
+
+- **writer drift**: the telemetry annotation
+  (``keys.NOTEBOOK_TPU_TELEMETRY``) is a single-writer journal like the
+  timeline (PR 13) — the SDK-side publisher is the ONE module that
+  patches it; everything else (controller fold, JWA message, efficiency
+  ledger) reads. The OWNERS entry in ``api/keys.py`` must pin exactly
+  the publisher module; widening it is a reviewed contract change, not
+  silent drift. (``annotation-ownership`` then enforces the pinned set
+  interprocedurally — this pass guards the *declaration*.)
+- **section-vocabulary drift**: collective-overlap attribution and
+  profiler traces rely on the timed-section names in
+  ``telemetry/sections.py``'s ``SECTION_SPECS`` being a closed, literal
+  vocabulary. Every ``sections.collective(...)`` call site must name a
+  registered literal (a computed name would defeat both the static
+  check and the trace labels), the registry entries themselves must be
+  pure 3-tuple literals, and a registered section nobody issues is a
+  stale entry lying to the docs.
+- **knob drift**: every ``KFTPU_TELEMETRY_*`` env knob appearing in the
+  package must be documented in ``docs/operations.md`` — the telemetry
+  runbook is where an operator goes when a training loop publishes
+  nothing, and an undocumented kill switch might as well not exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ci.analysis.core import (
+    Finding,
+    Project,
+    analysis_pass,
+    call_name,
+    str_const,
+)
+
+RULE_WRITER = "telemetry-single-writer"
+RULE_SECTIONS = "telemetry-sections"
+RULE_DOCS = "telemetry-knob-docs"
+
+KEYS_MODULE = "kubeflow_tpu/api/keys.py"
+SECTIONS_MODULE = "kubeflow_tpu/telemetry/sections.py"
+DOCS = os.path.join("docs", "operations.md")
+
+TELEMETRY_KEY_CONST = "NOTEBOOK_TPU_TELEMETRY"
+PUBLISHER_PREFIX = "kubeflow_tpu/telemetry/publisher"
+
+KNOB_RE = re.compile(r"^KFTPU_TELEMETRY[A-Z0-9_]*$")
+
+
+def _owners_entry(tree: ast.AST, const: str) -> tuple[int, list | None]:
+    """(line, prefixes) for OWNERS[const]; prefixes None when absent or
+    not a literal tuple of strings."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "OWNERS"
+                   for t in targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return node.value.lineno, None
+        for k, v in zip(node.value.keys, node.value.values):
+            if isinstance(k, ast.Name) and k.id == const:
+                if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                    prefixes = [str_const(e) for e in v.elts]
+                    if all(p is not None for p in prefixes):
+                        return k.lineno, prefixes
+                return k.lineno, None
+        return node.value.lineno, None
+    return 1, None
+
+
+def _section_specs(tree: ast.AST) -> tuple[int, dict[str, int] | None]:
+    """(line, {name: line}) from the SECTION_SPECS literal, or None when
+    the registry is missing / not a pure tuple-of-3-tuple-literals."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "SECTION_SPECS"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            return node.value.lineno, None
+        names: dict[str, int] = {}
+        for entry in node.value.elts:
+            if not isinstance(entry, (ast.Tuple, ast.List)) \
+                    or len(entry.elts) != 3 \
+                    or any(str_const(e) is None for e in entry.elts):
+                return entry.lineno, None
+            names[str_const(entry.elts[0])] = entry.lineno
+        return node.value.lineno, names
+    return 1, None
+
+
+@analysis_pass(
+    "telemetry-contract", (RULE_WRITER, RULE_SECTIONS, RULE_DOCS),
+    "the telemetry annotation's OWNERS entry pins the one publisher "
+    "module, every sections.collective() call site names a registered "
+    "literal from SECTION_SPECS, and every KFTPU_TELEMETRY_* knob is "
+    "documented in docs/operations.md")
+def check_telemetry_contract(project: Project):
+    if not project.full_tree:
+        # Whole-tree contract: registry, owners map, and docs coverage
+        # cannot be judged from a single-file scan.
+        return
+
+    # ---- single-writer declaration ----------------------------------------
+    keys_sf = project.get(KEYS_MODULE)
+    if keys_sf is None or keys_sf.tree is None:
+        yield Finding(
+            rule=RULE_WRITER, path=KEYS_MODULE, line=1,
+            message="api/keys.py missing or unparsable — the telemetry "
+                    "annotation key and its OWNERS pin live there")
+    else:
+        has_const = any(
+            isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == TELEMETRY_KEY_CONST
+                for t in n.targets)
+            for n in ast.walk(keys_sf.tree))
+        if not has_const:
+            yield Finding(
+                rule=RULE_WRITER, path=keys_sf.path, line=1,
+                message=f"{TELEMETRY_KEY_CONST} is not declared in "
+                        "api/keys.py — the telemetry export rides that "
+                        "annotation; the key constant is its contract")
+        line, prefixes = _owners_entry(keys_sf.tree, TELEMETRY_KEY_CONST)
+        if prefixes is None:
+            yield Finding(
+                rule=RULE_WRITER, path=keys_sf.path, line=line,
+                message=f"OWNERS[{TELEMETRY_KEY_CONST}] missing or not a "
+                        "literal tuple of module prefixes — the telemetry "
+                        "annotation needs its single writer declared")
+        elif prefixes != [PUBLISHER_PREFIX]:
+            yield Finding(
+                rule=RULE_WRITER, path=keys_sf.path, line=line,
+                message=f"OWNERS[{TELEMETRY_KEY_CONST}] is "
+                        f"{tuple(prefixes)!r} — the telemetry annotation "
+                        "has exactly ONE writer by design, "
+                        f"({PUBLISHER_PREFIX!r},); controller fold, JWA "
+                        "and scheduler are readers. Widening the set is "
+                        "a telemetry-contract change: update this pass "
+                        "alongside a design note, not just OWNERS")
+
+    # ---- section vocabulary -----------------------------------------------
+    sections_sf = project.get(SECTIONS_MODULE)
+    registered: dict[str, int] = {}
+    if sections_sf is None or sections_sf.tree is None:
+        yield Finding(
+            rule=RULE_SECTIONS, path=SECTIONS_MODULE, line=1,
+            message="telemetry/sections.py missing or unparsable — the "
+                    "timed-section registry lives there")
+    else:
+        line, names = _section_specs(sections_sf.tree)
+        if names is None:
+            yield Finding(
+                rule=RULE_SECTIONS, path=sections_sf.path, line=line,
+                message="SECTION_SPECS must be a module-level tuple of "
+                        "(name, module, description) STRING-LITERAL "
+                        "3-tuples — this pass and the profiler docs read "
+                        "the vocabulary from the AST")
+        else:
+            registered = names
+
+    used: set[str] = set()
+    for sf in project.files:
+        if sf.tree is None or sf.path == SECTIONS_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) \
+                    or call_name(node) != "collective" or not node.args:
+                continue
+            # Only the telemetry helper: bare collective(...) or
+            # sections.collective(...) / telemetry.sections.collective.
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                recv = func.value
+                recv_name = recv.attr if isinstance(recv, ast.Attribute) \
+                    else recv.id if isinstance(recv, ast.Name) else None
+                if recv_name != "sections":
+                    continue
+            name = str_const(node.args[0])
+            if name is None:
+                yield Finding(
+                    rule=RULE_SECTIONS, path=sf.path, line=node.lineno,
+                    message="sections.collective() called with a "
+                            "non-literal section name — names must be "
+                            "registered literals from SECTION_SPECS so "
+                            "trace labels and overlap attribution have a "
+                            "closed vocabulary")
+                continue
+            used.add(name)
+            if registered and name not in registered:
+                yield Finding(
+                    rule=RULE_SECTIONS, path=sf.path, line=node.lineno,
+                    message=f"sections.collective({name!r}) — not a "
+                            "registered section; add a (name, module, "
+                            "description) entry to telemetry/sections.py "
+                            "SECTION_SPECS")
+    for name in sorted(set(registered) - used):
+        yield Finding(
+            rule=RULE_SECTIONS, path=SECTIONS_MODULE,
+            line=registered[name],
+            message=f"registered section {name!r} has no "
+                    "sections.collective() call site — stale registry "
+                    "entry; delete it or wire the collective through it")
+
+    # ---- knob docs --------------------------------------------------------
+    docs_path = os.path.join(project.root, DOCS)
+    docs_text = (open(docs_path, encoding="utf-8").read()
+                 if os.path.exists(docs_path) else "")
+    documented = set(re.findall(r"KFTPU_TELEMETRY[A-Z0-9_]*", docs_text))
+    seen: set[str] = set()
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        docstrings = sf.docstring_linenos()
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and KNOB_RE.match(node.value)):
+                continue
+            if node.lineno in docstrings or node.value in seen:
+                continue
+            seen.add(node.value)
+            if node.value not in documented:
+                yield Finding(
+                    rule=RULE_DOCS, path=sf.path, line=node.lineno,
+                    message=f"telemetry knob {node.value!r} is not in "
+                            "docs/operations.md — add a row to the "
+                            "\"Training telemetry & profiler traces\" "
+                            "runbook's knob table")

@@ -189,6 +189,20 @@ def workflow_tests() -> dict:
                         "failure)",
                         "python bench.py control_plane_scale --smoke",
                         env=VIRTUAL_MESH_ENV),
+                    run("Multichip telemetry smoke bench (all four model "
+                        "families through the step profiler on the "
+                        "8-device mesh: per-family MFU + serialize-mode "
+                        "overlap attribution, ring+ulysses long context, "
+                        "cold-start recheck, warn-only MFU canary; exit "
+                        "1 when a family row lacks numbers)",
+                        "python bench.py multichip --smoke",
+                        env=VIRTUAL_MESH_ENV),
+                    run("Telemetry overhead gate (paired A/B trials: "
+                        "step profiler + publisher on vs off must cost "
+                        "<5% of training-step time; exit 1 on gate "
+                        "failure)",
+                        "python bench.py telemetry_overhead --smoke",
+                        env=VIRTUAL_MESH_ENV),
                     run("Unit + control-plane integration (8-device virtual mesh)",
                         "python -m pytest tests/ -q", env=VIRTUAL_MESH_ENV),
                     run("Multi-chip dryrun (GSPMD shardings on virtual devices)",

@@ -91,6 +91,13 @@ NOTEBOOK_RESTORE_TIER = "notebooks.kubeflow.org/restore-tier"
 # capped journal of lifecycle transitions that survives manager restarts.
 NOTEBOOK_TIMELINE = "notebooks.kubeflow.org/timeline"
 
+# Step-level training telemetry (ISSUE 18, telemetry/publisher.py): the
+# compact capped rolling-window summary the SDK publishes from inside
+# the training loop — step/MFU/overlap/HBM — read by the controller
+# status fold, JWA, and the scheduler's efficiency ledger. Single
+# writer: telemetry/publisher.py.
+NOTEBOOK_TPU_TELEMETRY = "notebooks.kubeflow.org/tpu-telemetry"
+
 # Warm pod pools (ISSUE 14, controllers/warmpool.py): the claim verdict
 # stamped on a Notebook that adopted a pre-warmed pod instead of paying
 # the cold pod+runtime start — pod name, when, and how long the claim
@@ -244,6 +251,10 @@ OWNERS: dict[str, tuple[str, ...]] = {
     # PR 13: ONE writer by design — the TimelineRecorder flush (driven
     # from the notebook reconciler's _update_status).
     NOTEBOOK_TIMELINE: ("kubeflow_tpu/runtime/timeline",),
+    # ISSUE 18: ONE writer by design — the SDK-side TelemetryPublisher;
+    # controller/JWA/scheduler only read. The telemetry-contract pass
+    # additionally pins this write-set to exactly the publisher module.
+    NOTEBOOK_TPU_TELEMETRY: ("kubeflow_tpu/telemetry/publisher",),
     # Warm-claim verdict on the CR: stamped by the pool manager's adopt,
     # cleared by the controller's claim gate (stop/edit/off hygiene).
     NOTEBOOK_WARM_CLAIMED: ("kubeflow_tpu/controllers/warmpool",

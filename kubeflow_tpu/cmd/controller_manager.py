@@ -57,6 +57,10 @@ def build_manager_app(mgr=None) -> web.Application:
     - ``/debug/warmpool`` (when warm pools are configured) — per-pool
       target/ready/slot counts and the slots pending teardown after a
       scheduler reclaim.
+    - ``/debug/telemetry`` (when the notebook controller is wired) —
+      every notebook's latest decoded training-telemetry entry (family,
+      step, MFU, overlap, publish seq) with live staleness, from the
+      controller's fold of the capped telemetry annotation.
     """
     app = web.Application()
 
@@ -188,6 +192,15 @@ def build_manager_app(mgr=None) -> web.Application:
             app.router.add_get("/debug/scheduler", debug_scheduler)
             app.router.add_get("/debug/scheduler/explain/{ns}/{name}",
                                debug_scheduler_explain)
+
+        if getattr(mgr, "telemetry", None) is not None:
+            async def debug_telemetry(_request):
+                # Latest decoded telemetry entry per notebook — the
+                # fleet-wide "who trains at what MFU" page next to the
+                # per-family gauges on /metrics.
+                return web.json_response({"telemetry": mgr.telemetry()})
+
+            app.router.add_get("/debug/telemetry", debug_telemetry)
 
         if getattr(mgr, "warmpool", None) is not None:
             async def debug_warmpool(_request):

@@ -84,6 +84,7 @@ from kubeflow_tpu.runtime import slo
 from kubeflow_tpu.runtime import timeline as timeline_mod
 from kubeflow_tpu.runtime.tracing import current_trace_id, span
 from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.telemetry import publisher as telemetry_pub
 from kubeflow_tpu.tpu.topology import JAX_COORDINATOR_PORT, TpuSlice
 
 log = logging.getLogger(__name__)
@@ -292,6 +293,13 @@ class NotebookReconciler:
         # coming up (the scale bench's biggest remaining scan).
         self._gauge_contrib: dict[tuple, tuple[int, int]] = {}
         self._ns_totals: dict[str | None, list[int]] = {}
+        # Training telemetry fold (ISSUE 18): latest decoded annotation
+        # entry per key (the /debug/telemetry data source) and the last
+        # publish seq fed downstream — the SLO engine, the Prometheus
+        # mirror, and the scheduler's efficiency ledger each consume one
+        # observation per publish, not one per reconcile.
+        self._telemetry: dict[tuple, dict] = {}
+        self._telemetry_seq: dict[tuple, int] = {}
         registry = registry or global_registry
         # Metric names match the reference (pkg/metrics/metrics.go:14-62) so
         # dashboards/alerts carry over.
@@ -322,6 +330,8 @@ class NotebookReconciler:
         if nb is None or get_meta(nb).get("deletionTimestamp"):
             self._mirrored.pop((namespace, name), None)
             self._last_status.pop((namespace, name), None)
+            self._telemetry.pop((namespace, name), None)
+            self._telemetry_seq.pop((namespace, name), None)
             if self._timeline is not None:
                 self._timeline.forget((namespace, name))
             # The namespace's running/chip gauges must drop the deleted
@@ -2004,6 +2014,7 @@ class NotebookReconciler:
                 pass
         elif warm_state == "warming" and (warm or {}).get("replenishing"):
             warm_block = {"replenishing": warm["replenishing"]}
+        telemetry_block = self._fold_telemetry(nb, (ns, name))
         status = {
             "readyReplicas": ready,
             "containerState": container_state,
@@ -2024,6 +2035,11 @@ class NotebookReconciler:
                 **({"warmPool": warm_block} if warm_block is not None else
                    ({"warmPool": None}
                     if deep_get(nb, "status", "tpu", "warmPool") is not None
+                    else {})),
+                **({"telemetry": telemetry_block}
+                   if telemetry_block is not None else
+                   ({"telemetry": None}
+                    if deep_get(nb, "status", "tpu", "telemetry") is not None
                     else {})),
             },
         }
@@ -2134,6 +2150,66 @@ class NotebookReconciler:
             if ttr is not None:
                 slo.observe("notebook_time_to_ready", ttr, key=key,
                             trace_id=current_trace_id())
+
+    def _fold_telemetry(self, nb: dict, key: tuple) -> dict | None:
+        """Decode the SDK's telemetry annotation into the
+        ``status.tpu.telemetry`` block and fan the window out — once per
+        publish seq — to the SLO engine (``training_step``), the
+        manager's Prometheus mirror, and the scheduler's efficiency
+        ledger. Returns None (delete the block) when the annotation is
+        absent or corrupt; a STALE entry keeps the block with
+        ``stale: true`` so JWA can degrade its message rather than
+        silently showing week-old MFU as live."""
+        entry = telemetry_pub.decode(annotations_of(nb))
+        if entry is None:
+            self._telemetry.pop(key, None)
+            return None
+        now = self._now()
+        stale = telemetry_pub.is_stale(entry, now)
+        self._telemetry[key] = entry
+        if not stale and entry["seq"] > self._telemetry_seq.get(key, 0):
+            self._telemetry_seq[key] = entry["seq"]
+            step_sec = entry.get("step_sec")
+            if step_sec is not None:
+                slo.observe("training_step", float(step_sec), key=key,
+                            trace_id=current_trace_id())
+            telemetry_pub.publish_metrics(entry)
+            if self._scheduler is not None:
+                self._scheduler.note_telemetry(
+                    key, entry.get("family") or "unknown",
+                    entry.get("mfu"))
+        block = {
+            "family": entry.get("family") or "unknown",
+            "step": entry.get("step", 0),
+            "at": entry.get("at"),
+            "seq": entry.get("seq"),
+        }
+        for wire, status_key in (("mfu", "mfu"), ("step_sec", "stepSec"),
+                                 ("overlap", "overlap"),
+                                 ("tok_s", "tokensPerSec"),
+                                 ("compile_sec", "compileSec"),
+                                 ("hbm", "hbmBytes"), ("basis", "basis")):
+            if entry.get(wire) is not None:
+                block[status_key] = entry[wire]
+        if stale:
+            block["stale"] = True
+        return block
+
+    def telemetry_debug_info(self) -> dict:
+        """The ``/debug/telemetry`` payload: every notebook's latest
+        decoded telemetry entry with live staleness."""
+        now = self._now()
+        return {
+            "stale_after_seconds": telemetry_pub.stale_after_seconds(),
+            "notebooks": {
+                f"{ns}/{name}": {
+                    **entry,
+                    "stale": telemetry_pub.is_stale(entry, now),
+                    "age_sec": round(now - float(entry.get("at", 0.0)), 1),
+                }
+                for (ns, name), entry in sorted(self._telemetry.items())
+            },
+        }
 
     def _set_gauge_contribution(
         self, ns: str | None, name: str, running: int, chips: int
@@ -2404,6 +2480,9 @@ def setup_notebook_controller(
     # Durable lifecycle timelines + SLO feeds (runtime/{timeline,slo}.py)
     # ride the manager's shared recorder/engine.
     rec._timeline = getattr(mgr, "timeline", None)
+    # /debug/telemetry data source (cmd/controller_manager.py): the
+    # reconciler's per-notebook fold of the telemetry annotation.
+    mgr.telemetry = rec.telemetry_debug_info
     if scheduler is _SCHEDULER_FROM_ENV:
         # KFTPU_SCHEDULER=off is the kill switch (ISSUE 5): the capacity
         # stage then runs exactly the pre-scheduler gate. On (default),

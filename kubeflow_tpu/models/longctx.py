@@ -20,7 +20,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models.burnin import _rmsnorm
 from kubeflow_tpu.parallel.ring import ring_attention
-from kubeflow_tpu.parallel.ulysses import ulysses_attention
+from kubeflow_tpu.parallel.ulysses import (
+    ring_ulysses_attention,
+    ulysses_attention,
+)
 
 # Sequence-parallel attention strategies (SURVEY.md: "ring attention or
 # all-to-all sequence/context parallelism" are both first-class). Ring
@@ -35,6 +38,14 @@ ATTENTION_STRATEGIES = {
     "ring_flash": partial(ring_attention, block_impl="flash"),
     "ulysses": ulysses_attention,
     "ulysses_flash": partial(ulysses_attention, block_impl="flash"),
+    # 2-D sequence parallelism: ulysses gathers contiguous ring blocks
+    # inside each all-to-all group, ring hops K/V between groups — use
+    # with ``seq_axis`` set to the ``(ring_axis, uly_axis)`` tuple and a
+    # mesh carrying both axes. Scales context past either alone (the
+    # multichip bench's ≥32k composition).
+    "ring_ulysses": ring_ulysses_attention,
+    "ring_ulysses_flash": partial(ring_ulysses_attention,
+                                  block_impl="flash"),
 }
 
 

@@ -36,13 +36,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models.burnin import _rmsnorm
 from kubeflow_tpu.ops.flash_attention import flash_attention
+from kubeflow_tpu.parallel.mesh import shard_map_compat
 from kubeflow_tpu.parallel.pipeline import pipeline_apply, pipeline_spans
 from kubeflow_tpu.parallel.ring import reference_causal_attention
-
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
 
 
 @dataclass(frozen=True)
@@ -242,8 +238,27 @@ def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
         idx = jax.lax.axis_index(stage_axis)
         return jnp.where(idx == n_stages - 1, nll, 0.0) / dup
 
+    rules = param_sharding_rules(cfg, m)
+    # Pre-vma jax has no varying-axes transpose to insert the cotangent
+    # psums (and check_rep is disabled by shard_map_compat), so the
+    # data/model grad reduction must be explicit there: each leaf reduces
+    # over exactly the axes its spec leaves replicated — the same set the
+    # vma machinery would have used.
+    has_vma = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+
+    def reduce_grads(grads):
+        def reduce_leaf(g, spec):
+            used = {a for part in spec if part is not None
+                    for a in (part if isinstance(part, tuple) else (part,))}
+            axes = tuple(a for a in mesh_axes if a not in used)
+            return jax.lax.psum(g, axes) if axes else g
+
+        return jax.tree.map(reduce_leaf, grads, rules)
+
     def local_step(params, tokens):
         loss, grads = jax.value_and_grad(local_loss)(params, tokens)
+        if not has_vma:
+            grads = reduce_grads(grads)
         new = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
         # Only the loss *value* still needs reducing (it is per-device:
         # nonzero on the last stage's shards only, prescaled by 1/dup).
@@ -254,9 +269,8 @@ def make_train_step(cfg: PipelinedConfig, mesh: Mesh, lr: float = 1e-3,
             loss = jax.lax.psum(loss, m)
         return new, loss
 
-    rules = param_sharding_rules(cfg, m)
     tok_spec = P(data_axis if has_data else None, None)
-    return shard_map(
+    return shard_map_compat(
         local_step,
         mesh=mesh,
         in_specs=(rules, tok_spec),

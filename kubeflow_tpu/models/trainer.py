@@ -186,6 +186,8 @@ def fit(
     save_every: int = 100,
     on_step: Callable | None = None,
     skip_batches: bool = True,
+    profiler=None,
+    publisher=None,
 ) -> dict:
     """Run ``step_fn`` until ``state["step"] == steps``, checkpointing.
 
@@ -204,14 +206,29 @@ def fit(
         loader.skip(int(state["step"]))
         batches = data.global_batches(data.prefetch(iter(loader)), ...)
         trainer.fit(state, batches, ..., skip_batches=False)
+
+    Telemetry: pass a :class:`kubeflow_tpu.telemetry.StepProfiler` as
+    ``profiler`` to record per-step wall time (the first step is kept as
+    the compile-inclusive sample; every window boundary blocks on the
+    loss so queued async work drains into a measured step), and a
+    :class:`kubeflow_tpu.telemetry.TelemetryPublisher` as ``publisher``
+    to export rolling-window summaries (rate-limited in-loop, forced
+    flush at the end). Both are no-ops when ``KFTPU_TELEMETRY`` is off.
     """
+    import time as _time
     from itertools import islice
 
     start = int(state["step"])
     if start and skip_batches:
         batches = islice(batches, start, None)
     for i in range(start, steps):
+        t0 = _time.perf_counter() if profiler is not None else 0.0
         state, loss = step_fn(state, next(batches))
+        if profiler is not None:
+            profiler.observe(i + 1, _time.perf_counter() - t0,
+                             sync_value=loss)
+            if publisher is not None:
+                publisher.publish(profiler.summary())
         if on_step is not None:
             on_step(i + 1, float(loss))
         if checkpoints is not None and (i + 1) % save_every == 0:
@@ -221,4 +238,8 @@ def fit(
             checkpoints.save(i + 1, state)
     if checkpoints is not None:
         checkpoints.wait()
+    if profiler is not None:
+        profiler.note_hbm()
+        if publisher is not None:
+            publisher.publish(profiler.summary(), force=True)
     return state

@@ -27,6 +27,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Renamed upstream (TPUCompilerParams -> CompilerParams); support both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 _NEG_BIG = -1e30
 # Swept on a real v5e chip (sync via value fetch — block_until_ready is
 # unreliable through remote relays): 1024/1024 (capped at seq) beat XLA's
@@ -100,11 +104,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to((m_scr[:, :1] + jnp.log(l)).T, (8, lse_ref.shape[2]))
 
 
+def _supports_sds_vma() -> bool:
+    import inspect
+
+    try:
+        return "vma" in inspect.signature(jax.ShapeDtypeStruct).parameters
+    except (TypeError, ValueError):  # pragma: no cover - C-level signature
+        return False
+
+
+_HAS_SDS_VMA = _supports_sds_vma()
+
+
 def _sds(shape, dtype, vma):
     """ShapeDtypeStruct with varying-axes metadata when running inside
     shard_map (jax's manual-mode type checking requires it on pallas
-    outputs); plain struct otherwise."""
-    if vma is None:
+    outputs); plain struct otherwise — including on pre-vma jax, which
+    has no metadata to carry."""
+    if vma is None or not _HAS_SDS_VMA:
         return jax.ShapeDtypeStruct(shape, dtype)
     return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
 
@@ -136,7 +153,7 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -246,7 +263,7 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret,
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         ),
         out_shape=_sds((bh, s, d), q.dtype, vma),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -281,7 +298,7 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret,
             _sds((bh, sk, d), k.dtype, vma),
             _sds((bh, sk, d), v.dtype, vma),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -456,7 +473,7 @@ def flash_attention_partial(q, k, v, q_offset, k_offset, *,
             _sds((bh, 8, s), jnp.float32, vma or ()),
             _sds((bh, 8, s), jnp.float32, vma or ()),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

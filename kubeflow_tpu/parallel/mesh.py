@@ -56,3 +56,32 @@ def make_mesh(devices=None, plan: MeshPlan | None = None) -> Mesh:
         raise ValueError(f"plan {plan} does not cover {len(devices)} devices")
     grid = np.asarray(devices).reshape(plan.data, plan.model)
     return Mesh(grid, axis_names=("data", "model"))
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``shard_map`` across the jax API generations this stack meets.
+
+    New jax exposes ``jax.shard_map`` with the varying-axes type system
+    (``check_vma``); pre-vma jax (< 0.5) ships
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep``, whose
+    static replication inference is too conservative for scan/custom-VJP
+    bodies (it fails outright on the pipelined schedule), so there the
+    check is disabled — runtime semantics are identical, only the static
+    replication audit is skipped.
+    """
+    import inspect
+
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - pre-0.6 namespace
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    params = inspect.signature(_shard_map).parameters
+    kwargs = {}
+    if "check_vma" in params:
+        if not check_vma:
+            kwargs["check_vma"] = False
+    elif "check_rep" in params:
+        kwargs["check_rep"] = False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -28,6 +28,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.telemetry import sections
+
 
 def router_slots(logits, n_experts: int, capacity: int, k: int = 1):
     """Top-k routing as per-choice slot assignments.
@@ -242,8 +244,10 @@ def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
     # copies (~0.3 ms/layer at bench shapes); multi-shard meshes (the
     # 8-device dryrun gate) always take it.
     if p_e > 1:
-        slots = jax.lax.all_to_all(
-            slots, axis_name, split_axis=0, concat_axis=1, tiled=True
+        slots = sections.collective(
+            "moe_dispatch_all_to_all", jax.lax.all_to_all,
+            slots, axis_name=axis_name, split_axis=0, concat_axis=1,
+            tiled=True,
         )
 
     h = jnp.einsum("ecd,edf->ecf", slots, expert_w1.astype(x.dtype))
@@ -252,8 +256,10 @@ def moe_ffn_local(x, router_w, expert_w1, expert_w2, axis_name: str,
 
     # a2a #2: route results back to their data shards.
     if p_e > 1:
-        out = jax.lax.all_to_all(
-            out, axis_name, split_axis=1, concat_axis=0, tiled=True
+        out = sections.collective(
+            "moe_combine_all_to_all", jax.lax.all_to_all,
+            out, axis_name=axis_name, split_axis=1, concat_axis=0,
+            tiled=True,
         )
     # Sparse combine: one gather of every token's k slot rows, scaled by
     # the (renormalized) gates; dropped tokens contribute zeros and ride
@@ -271,10 +277,7 @@ def moe_ffn(x, router_w, expert_w1, expert_w2, mesh,
     axes; experts sharded over ``expert_axis``. Returns ``(y, aux)``."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from kubeflow_tpu.parallel.mesh import shard_map_compat
 
     batch_axes = tuple(mesh.axis_names)
 
@@ -288,7 +291,7 @@ def moe_ffn(x, router_w, expert_w1, expert_w2, mesh,
             aux, tuple(mesh.axis_names)
         )
 
-    return shard_map(
+    return shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(

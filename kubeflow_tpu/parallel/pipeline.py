@@ -27,6 +27,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.telemetry import sections
+
 
 def _mark_varying(t, axes):
     """Mark ``t`` device-varying over ``axes`` (skipping any it already
@@ -41,7 +43,10 @@ def _mark_varying(t, axes):
         return t
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(t, need, to="varying")
-    return jax.lax.pvary(t, need)  # pragma: no cover - pre-pcast jax
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(t, need)
+    # Pre-vma jax (< 0.5): no varying-axes type system, nothing to mark.
+    return t
 
 
 def stage_ring_perm(n_stages: int) -> list[tuple[int, int]]:
@@ -145,8 +150,10 @@ def pipeline_apply(stage_fn, stage_params, x_micro, *, n_stages: int,
         h = jnp.where(idx == 0, inject, state)
         out = stage_fn(stage_params, h)
         # Hop AFTER the compute so XLA overlaps the collective-permute with
-        # the next tick's stage_fn.
-        state = jax.lax.ppermute(out, axis_name, perm)
+        # the next tick's stage_fn. Registered section: attributable in
+        # profiler traces, serializable for the overlap A/B.
+        state = sections.collective("pipeline_stage_hop", jax.lax.ppermute,
+                                    out, axis_name=axis_name, perm=perm)
         return state, out
 
     # Per-tick outputs ride ``ys``: the last stage finishes microbatch m at

@@ -21,6 +21,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.telemetry import sections
+
 _NEG_BIG = -1e30  # not -inf: keeps the online-softmax max finite pre-first-hit
 
 
@@ -87,9 +89,13 @@ def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None,
         ).astype(jnp.float32)
 
         # Rotate K/V to the next device; AFTER the matmul so XLA can overlap
-        # the collective-permute with the next iteration's compute.
-        k_t = jax.lax.ppermute(k_t, axis_name, perm)
-        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        # the collective-permute with the next iteration's compute. The
+        # registered section makes the hop attributable in profiler traces
+        # and serializable for the overlap A/B (telemetry/sections.py).
+        k_t = sections.collective("ring_kv_hop", jax.lax.ppermute,
+                                  k_t, axis_name=axis_name, perm=perm)
+        v_t = sections.collective("ring_kv_hop", jax.lax.ppermute,
+                                  v_t, axis_name=axis_name, perm=perm)
         return (k_t, v_t, m_new, l, o)
 
     _, _, m, l, o = jax.lax.fori_loop(0, n_shards, body, (k, v, m, l, o))
@@ -103,7 +109,12 @@ def ring_attention_local(q, k, v, axis_name: str, mesh_axes=None,
 def _mark_varying(t, axes):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(t, tuple(axes), to="varying")
-    return jax.lax.pvary(t, tuple(axes))  # pragma: no cover - pre-pcast jax
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(t, tuple(axes))
+    # Pre-vma jax (< 0.5): shard_map has no varying-axes type system, so
+    # carries need no marking — fresh arrays already unify with the loop
+    # body's outputs.
+    return t
 
 
 def _ring_flash_fwd_loop(q, k, v, axis_name, vary_axes):
@@ -135,8 +146,10 @@ def _ring_flash_fwd_loop(q, k, v, axis_name, vary_axes):
             o * corr.transpose(0, 2, 1)[..., None]
             + o_blk.astype(jnp.float32) * corr_blk.transpose(0, 2, 1)[..., None]
         )
-        k_t = jax.lax.ppermute(k_t, axis_name, perm)
-        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        k_t = sections.collective("ring_flash_kv_hop", jax.lax.ppermute,
+                                  k_t, axis_name=axis_name, perm=perm)
+        v_t = sections.collective("ring_flash_kv_hop", jax.lax.ppermute,
+                                  v_t, axis_name=axis_name, perm=perm)
         return (k_t, v_t, m_new, l, o)
 
     _, _, m, l, o = jax.lax.fori_loop(0, n_shards, body, (k, v, m, l, o))
@@ -190,10 +203,14 @@ def _ring_flash_vjp_bwd(axis_name, vary_axes, res, do):
         dq = dq + dq_p.astype(jnp.float32)
         dk_t = dk_t + dk_p.astype(jnp.float32)
         dv_t = dv_t + dv_p.astype(jnp.float32)
-        k_t = jax.lax.ppermute(k_t, axis_name, perm)
-        v_t = jax.lax.ppermute(v_t, axis_name, perm)
-        dk_t = jax.lax.ppermute(dk_t, axis_name, perm)
-        dv_t = jax.lax.ppermute(dv_t, axis_name, perm)
+        k_t = sections.collective("ring_flash_grad_hop", jax.lax.ppermute,
+                                  k_t, axis_name=axis_name, perm=perm)
+        v_t = sections.collective("ring_flash_grad_hop", jax.lax.ppermute,
+                                  v_t, axis_name=axis_name, perm=perm)
+        dk_t = sections.collective("ring_flash_grad_hop", jax.lax.ppermute,
+                                   dk_t, axis_name=axis_name, perm=perm)
+        dv_t = sections.collective("ring_flash_grad_hop", jax.lax.ppermute,
+                                   dv_t, axis_name=axis_name, perm=perm)
         return (k_t, v_t, dk_t, dv_t, dq)
 
     _, _, dk, dv, dq = jax.lax.fori_loop(
@@ -215,10 +232,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
     ring long-context training never materializes block logits in HBM."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from kubeflow_tpu.parallel.mesh import shard_map_compat
 
     data_axes = tuple(n for n in mesh.axis_names if n != axis_name)
     batch_spec = data_axes[0] if len(data_axes) == 1 else (data_axes or None)
@@ -227,14 +241,13 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
     # offsets are device-varying, which jax's manual-mode varying-axes
     # analysis can't express through interpret-mode slicing yet (the error
     # message itself prescribes this workaround; numerics are unaffected).
-    kwargs = {"check_vma": False} if block_impl == "flash" else {}
-    return shard_map(
+    return shard_map_compat(
         partial(ring_attention_local, axis_name=axis_name,
                 mesh_axes=tuple(mesh.axis_names), block_impl=block_impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        **kwargs,
+        check_vma=block_impl != "flash",
     )(q, k, v)
 
 

@@ -28,6 +28,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.telemetry import sections
+
 
 def _largest_divisor_block(s: int, cap: int = 1024) -> int:
     """Largest tileable block ≤ ``cap`` that divides ``s`` — the flash
@@ -52,10 +54,13 @@ def _largest_divisor_block(s: int, cap: int = 1024) -> int:
 
 def _a2a(x, axis_name: str, scatter_dim: int, gather_dim: int):
     """all_to_all with the manual-mode convention used inside shard_map:
-    scatter ``scatter_dim`` across the axis, concatenate ``gather_dim``."""
-    return jax.lax.all_to_all(
-        x, axis_name, split_axis=scatter_dim, concat_axis=gather_dim,
-        tiled=True,
+    scatter ``scatter_dim`` across the axis, concatenate ``gather_dim``.
+    Issued through the registered telemetry section so both directions of
+    the heads<->sequence exchange are attributable/serializable."""
+    return sections.collective(
+        "ulysses_all_to_all", jax.lax.all_to_all,
+        x, axis_name=axis_name, split_axis=scatter_dim,
+        concat_axis=gather_dim, tiled=True,
     )
 
 
@@ -125,18 +130,85 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "seq",
     are chosen from the gathered sequence's divisors, so any S works."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from kubeflow_tpu.parallel.mesh import shard_map_compat
 
     data_axes = tuple(n for n in mesh.axis_names if n != axis_name)
     batch_spec = data_axes[0] if len(data_axes) == 1 else (data_axes or None)
     spec = P(batch_spec if data_axes else None, axis_name, None, None)
-    return shard_map(
+    return shard_map_compat(
         partial(ulysses_attention_local, axis_name=axis_name,
                 block_impl=block_impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+    )(q, k, v)
+
+
+# ------------------------------------------------ ring x ulysses composition
+
+
+def ring_ulysses_attention_local(q, k, v, ring_axis: str, uly_axis: str,
+                                 mesh_axes=None, block_impl: str = "xla"):
+    """Per-shard causal attention over a 2-D sequence mesh — the USP-style
+    composition of both strategies. Call inside ``shard_map`` with the
+    sequence sharded over ``(ring_axis, uly_axis)`` (ring-major):
+
+    1. Ulysses all-to-all over ``uly_axis``: heads scatter, sequence
+       gathers — device ``(r, u)`` ends up holding the *contiguous* ring
+       block ``r`` (``S/P_ring`` tokens) for its ``H/P_uly`` heads. The
+       ring-major token layout is what makes the gather contiguous, so
+       ring block indices stay meaningful global positions.
+    2. Ring attention over ``ring_axis`` on the gathered blocks — exact
+       causal block masking, K/V hops between ring neighbors only.
+    3. All-to-all back: sequence scatters, heads gather.
+
+    The composition extends long-context scaling past either strategy
+    alone: ring's per-chip memory O((S/P_ring)²) and hop count P_ring
+    stay fixed while the ulysses axis multiplies total sequence capacity
+    by P_uly at the cost of two all-to-alls (which are O(1) rounds).
+    Requires ``heads % P_uly == 0``.
+    """
+    p_uly = jax.lax.psum(1, uly_axis)
+    h = q.shape[2]
+    if h % p_uly:
+        raise ValueError(
+            f"ring+ulysses needs heads % ulysses shards == 0, "
+            f"got {h} heads / {p_uly} shards"
+        )
+    from kubeflow_tpu.parallel.ring import ring_attention_local
+
+    # [b, S/(Pr*Pu), H, d] -> [b, S/Pr, H/Pu, d]
+    q, k, v = (_a2a(t, uly_axis, 2, 1) for t in (q, k, v))
+    out = ring_attention_local(q, k, v, axis_name=ring_axis,
+                               mesh_axes=mesh_axes, block_impl=block_impl)
+    # [b, S/Pr, H/Pu, d] -> [b, S/(Pr*Pu), H, d]
+    return _a2a(out, uly_axis, 1, 2)
+
+
+def ring_ulysses_attention(q, k, v, mesh, axis_name=("seq_ring", "seq_uly"),
+                           block_impl: str = "xla"):
+    """GSPMD entrypoint for the composed strategy: ``axis_name`` is the
+    PAIR ``(ring_axis, uly_axis)`` and q/k/v ``[batch, seq, heads,
+    head_dim]`` have their sequence dim sharded over both axes
+    (ring-major, i.e. ``P(..., (ring_axis, uly_axis), ...)``) — which is
+    exactly what ``longctx.shard_inputs`` produces when handed the tuple
+    as its ``seq_axis``. Other mesh axes shard batch."""
+    from jax.sharding import PartitionSpec as P
+
+    from kubeflow_tpu.parallel.mesh import shard_map_compat
+
+    ring_axis, uly_axis = axis_name
+    data_axes = tuple(n for n in mesh.axis_names
+                      if n not in (ring_axis, uly_axis))
+    batch_spec = data_axes[0] if len(data_axes) == 1 else (data_axes or None)
+    spec = P(batch_spec if data_axes else None,
+             (ring_axis, uly_axis), None, None)
+    return shard_map_compat(
+        partial(ring_ulysses_attention_local, ring_axis=ring_axis,
+                uly_axis=uly_axis, mesh_axes=tuple(mesh.axis_names),
+                block_impl=block_impl),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=block_impl != "flash",
     )(q, k, v)

@@ -76,6 +76,11 @@ SLI_SPECS = (
      30.0, 0.99,
      "checkpoint restore wall time through the tier fallthrough "
      "(staging or object store), including integrity-fallback reads"),
+    ("training_step", "KFTPU_SLO_TRAINING_STEP",
+     1.0, 0.99,
+     "rolling-window p50 training-step wall time from the telemetry "
+     "annotation, fed once per new publish seq by the notebook "
+     "controller's status fold"),
 )
 
 # Multi-window set: the short window catches a fast burn the moment it

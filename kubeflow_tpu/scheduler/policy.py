@@ -33,6 +33,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 
 from kubeflow_tpu.scheduler.fleet import Allocation, ChipLedger, Fleet
+from kubeflow_tpu.telemetry.ledger import EfficiencyLedger
+
+
+def _eff_key(key: tuple) -> str:
+    """Gang key as the efficiency ledger's string key (its rows appear in
+    JSON debug payloads, where tuple keys can't)."""
+    return "/".join(str(p) for p in key)
 
 
 @dataclass(frozen=True)
@@ -118,6 +125,10 @@ class PolicyQueue:
     config: PolicyConfig = field(default_factory=PolicyConfig)
     ledger: ChipLedger = None  # type: ignore[assignment]
     pending: dict = field(default_factory=dict)   # key → GangRequest
+    # Per-family x shape MFU history (ISSUE 18): fed from the telemetry
+    # annotation by the runtime, consumed ONLY as a tie-break inside the
+    # idle victim tier and by explain/debug_info.
+    efficiency: EfficiencyLedger = field(default_factory=EfficiencyLedger)
     # Bumped on every state change (submit/release/touch/admission/
     # preemption/reclaim): the runtime skips redundant full arbitration
     # passes — each queued notebook's safety-net requeue would otherwise
@@ -174,9 +185,18 @@ class PolicyQueue:
         and/or its allocation (stopped/deleted while running)."""
         dropped = self.pending.pop(key, None)
         alloc = self.ledger.release(key)
+        self.efficiency.forget(_eff_key(key))
         if dropped is not None or alloc is not None:
             self.gen += 1
         return alloc
+
+    def note_efficiency(self, key: tuple, family: str, shape: str,
+                        mfu) -> None:
+        """Feed one telemetry window (deduplicated by annotation seq at
+        the caller). Deliberately no ``gen`` bump: efficiency only
+        reorders victims *within* the idle tier, so it never makes a new
+        admission possible and must not trigger re-arbitration churn."""
+        self.efficiency.note(_eff_key(key), family, shape, mfu)
 
     def touch(self, key: tuple, last_active_at: float | None) -> None:
         """Refresh a holder's idle signal (culling's last-activity)."""
@@ -381,9 +401,12 @@ class PolicyQueue:
         holders only by strictly higher BASE priority — aging boosts
         where a gang sorts in the queue, never whom it may kill (an
         equal-priority gang that waited long enough must not stop-
-        annotate a busy peer). Most-idle first, then lowest priority,
-        then youngest admission (LIFO), so the decision is deterministic
-        and the cheapest work dies first."""
+        annotate a busy peer). Within the idle tier, gangs the
+        efficiency ledger flags persistently-low-MFU rank first (ISSUE
+        18's placement signal — strictly a tie-break inside tier 0:
+        serving/busy/priority protections all sort ahead of it); then
+        most-idle, lowest priority, youngest admission (LIFO), so the
+        decision is deterministic and the cheapest work dies first."""
         cfg = self.config
         shape = (req.accelerator.lower(), req.topology.lower())
         matching = {p.name
@@ -419,7 +442,7 @@ class PolicyQueue:
                     if pool in matching)
                 if warm_reclaimable == 0:
                     continue
-                candidates.append((-1, 0.0, alloc.priority,
+                candidates.append((-1, 0, 0.0, alloc.priority,
                                    -alloc.admitted_at, alloc.key,
                                    "warm-pool", warm_reclaimable, alloc))
                 continue
@@ -451,14 +474,19 @@ class PolicyQueue:
             idle = (last is not None
                     and now - last >= cfg.idle_preempt_after_seconds)
             if idle:
-                candidates.append((0, -(now - last),
+                # Efficiency tie-break INSIDE tier 0 only: a persistently
+                # low-MFU idle gang is the preferred reclaim, but the
+                # signal can never promote a candidate across tiers.
+                eff = 0 if self.efficiency.persistently_low(
+                    _eff_key(alloc.key)) else 1
+                candidates.append((0, eff, -(now - last),
                                    alloc.priority, -alloc.admitted_at,
                                    alloc.key, "idle", reclaimable, alloc))
             elif alloc.priority < req.priority:
-                candidates.append((1, 0.0, alloc.priority,
+                candidates.append((1, 0, 0.0, alloc.priority,
                                    -alloc.admitted_at, alloc.key,
                                    "priority", reclaimable, alloc))
-        candidates.sort(key=lambda c: c[:5])
+        candidates.sort(key=lambda c: c[:6])
         # Per-pool simulation, not one aggregate sum: an overcommitted
         # pool's NEGATIVE free space (restart reclaim / fleet shrink)
         # must neither mask reclaimable capacity on healthy pools (the
@@ -693,6 +721,7 @@ class PolicyQueue:
             "ns_chips": dict(sorted(self.ledger.ns_chips.items())),
             "violations": self.ledger.violations,
             "overcommitted": self.overcommitted,
+            "efficiency": self.efficiency.debug_info(),
         }
 
     def schedule_preview(self, now: float) -> list:
@@ -711,7 +740,8 @@ class PolicyQueue:
         key = tuple(key)
         alloc = self.ledger.allocations.get(key)
         if alloc is not None:
-            return {
+            eff = self.efficiency.explain(_eff_key(key))
+            out = {
                 "state": "Draining" if alloc.draining else "Admitted",
                 "chips": alloc.chips,
                 "slices": alloc.num_slices,
@@ -721,7 +751,14 @@ class PolicyQueue:
                 "admitted_at": alloc.admitted_at,
                 "forced_overcommit": alloc.forced,
                 "workload": alloc.workload,
+                "efficiency": eff,
             }
+            if eff and eff.get("expected_mfu") is not None:
+                out["efficiency"]["note"] = (
+                    f"family {eff['family']} historically achieves "
+                    f"{eff['expected_mfu']:.1%} MFU on {eff['shape']} "
+                    f"({eff['family_samples']} window(s))")
+            return out
         req = self.pending.get(key)
         if req is None:
             return {"state": "Unknown",

@@ -1927,6 +1927,20 @@ class TpuFleetScheduler:
             self.m_borrowed.labels(pool=pool).set(hosts)
         self._gauge_borrow_pools = set(borrowed)
 
+    def note_telemetry(self, key: tuple, family: str, mfu) -> None:
+        """Feed the efficiency ledger one telemetry window (the notebook
+        controller dedups on the annotation's publish seq before calling
+        this). Shape is derived from the gang's own allocation
+        (accelerator:topology) so the family prior keys match the shapes
+        explain/queue reports; keys the ledger doesn't hold are ignored
+        — telemetry from a gang mid-release carries no signal."""
+        key = tuple(key)
+        alloc = self.policy.ledger.allocations.get(key)
+        if alloc is None:
+            return
+        shape = f"{alloc.accelerator}:{alloc.topology}"
+        self.policy.note_efficiency(key, family, shape, mfu)
+
     # ---- introspection ----------------------------------------------------------
 
     def debug_info(self) -> dict:

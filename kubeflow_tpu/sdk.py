@@ -58,10 +58,12 @@ __all__ = [
     "CheckpointManager",
     "MaintenanceWatcher",
     "SliceInfo",
+    "capture_profile",
     "initialize_distributed",
     "resume",
     "start_profiler_server",
     "suspend",
+    "telemetry_publisher",
     "trace",
     "warm_idle",
 ]
@@ -187,6 +189,51 @@ def trace(logdir: str):
     import jax
 
     return jax.profiler.trace(logdir)
+
+
+# Where capture_profile() writes when the caller doesn't say: the same
+# path the notebook images mount for TensorBoard logs, so a Tensorboard
+# CR with spec.profilerPlugin: true over the shared PVC/GCS prefix picks
+# the trace up with no extra wiring (controllers/tensorboard.py).
+TELEMETRY_LOGDIR_ENV = "KFTPU_TELEMETRY_LOGDIR"
+DEFAULT_TRACE_LOGDIR = "/home/jovyan/logs"
+
+
+def capture_profile(logdir: str | None = None, *, environ=os.environ):
+    """Context manager dumping a ``jax.profiler`` trace where the
+    Tensorboard CR can serve it — :func:`trace` with the logdir resolved
+    from ``KFTPU_TELEMETRY_LOGDIR`` (controller-injectable) and falling
+    back to the images' TensorBoard log mount::
+
+        with sdk.capture_profile():
+            params, loss = train_step(params, batch)
+            loss.block_until_ready()
+
+    Point a ``Tensorboard`` CR with ``spec.profilerPlugin: true`` at the
+    same PVC/GCS path to browse the trace (docs/operations.md "Training
+    telemetry & profiler traces")."""
+    if logdir is None:
+        logdir = environ.get(TELEMETRY_LOGDIR_ENV) or DEFAULT_TRACE_LOGDIR
+    return trace(logdir)
+
+
+def telemetry_publisher(*, environ=os.environ, patcher=None, registry=None):
+    """Build a :class:`kubeflow_tpu.telemetry.TelemetryPublisher` writing
+    to this notebook's own CR (the write half mirrors the drain-ack
+    transport: stdlib-only, ServiceAccount-credentialed). Pass the result
+    as ``trainer.fit(..., publisher=...)`` next to a ``StepProfiler``.
+    Raises ValueError outside the controller's env unless ``patcher`` is
+    given (tests inject a recorder taking the full merge-patch body)."""
+    from kubeflow_tpu.telemetry import TelemetryPublisher
+
+    if patcher is None:
+        annotations_patcher = _identity_patcher(environ)
+
+        def patcher(body: dict) -> None:
+            annotations_patcher(
+                (body.get("metadata") or {}).get("annotations") or {})
+
+    return TelemetryPublisher(patcher, registry=registry, environ=environ)
 
 
 def _in_cluster_fetch(namespace: str, name: str):

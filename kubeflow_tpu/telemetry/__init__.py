@@ -1,0 +1,70 @@
+"""Step-level training telemetry: profiler -> annotation -> scheduler.
+
+The control plane became observable in PRs 3/13 (flight recorder, SLO
+engine); this package makes the *workload* observable. A low-overhead
+per-step recorder (:mod:`profiler`) runs inside the training loop,
+summarizes rolling windows (never raw streams) into achieved MFU,
+compile-vs-run split, collective-overlap attribution (:mod:`sections`)
+and HBM high-water; a single writer (:mod:`publisher`) exports the
+summary as a compact capped annotation plus Prometheus series; and the
+fleet scheduler folds the numbers into a per-family x shape efficiency
+ledger (:mod:`ledger`) so placement finally sees how well a gang uses
+its chips.
+
+Master switch is ``KFTPU_TELEMETRY`` (default on — the recorder is
+cheap enough to leave always-on; ``bench.py telemetry_overhead`` gates
+the paired A/B cost < 5%). ``set_enabled`` is the in-process override
+the overhead bench flips between trials, mirroring
+``runtime/timeline.py``.
+"""
+
+from __future__ import annotations
+
+import os
+
+TELEMETRY_ENABLED_ENV = "KFTPU_TELEMETRY"
+
+_DISABLED_VALUES = ("off", "false", "0", "no", "disabled")
+
+# In-process override for paired A/B benches (timeline/slo idiom):
+# None -> follow the env var; True/False -> forced.
+_enabled_override: bool | None = None
+
+
+def telemetry_enabled(environ=os.environ) -> bool:
+    """Default-on parse of the master switch (timeline semantics)."""
+    raw = environ.get(TELEMETRY_ENABLED_ENV)
+    if raw is None:
+        return True
+    return raw.strip().lower() not in _DISABLED_VALUES
+
+
+def set_enabled(on: bool | None) -> None:
+    """Force telemetry on/off in-process (``None`` restores the env)."""
+    global _enabled_override
+    _enabled_override = on
+
+
+def is_enabled(environ=os.environ) -> bool:
+    if _enabled_override is not None:
+        return _enabled_override
+    return telemetry_enabled(environ)
+
+
+from kubeflow_tpu.telemetry.ledger import EfficiencyLedger  # noqa: E402
+from kubeflow_tpu.telemetry.profiler import (  # noqa: E402
+    StepProfiler,
+    overlap_fraction,
+)
+from kubeflow_tpu.telemetry.publisher import TelemetryPublisher  # noqa: E402
+
+__all__ = [
+    "EfficiencyLedger",
+    "StepProfiler",
+    "TELEMETRY_ENABLED_ENV",
+    "TelemetryPublisher",
+    "is_enabled",
+    "overlap_fraction",
+    "set_enabled",
+    "telemetry_enabled",
+]

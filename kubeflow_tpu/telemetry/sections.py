@@ -1,0 +1,121 @@
+"""Registered timed sections around the parallel-stack collectives.
+
+Host-side timers cannot see inside a jitted step, so collective-overlap
+attribution works the only way that is honest under XLA's scheduler:
+every collective in ``parallel/{ring,ulysses,pipeline,moe}.py`` is
+issued through :func:`collective`, which (a) wraps the op in a
+``jax.named_scope`` whose name is a **registered literal** from
+``SECTION_SPECS`` (the ``telemetry-contract`` analysis pass rejects
+unregistered or non-literal names, so profiler traces and docs can rely
+on the vocabulary), and (b) in *serialize mode* fences the op with
+``jax.lax.optimization_barrier`` on both sides, forcing every collective
+to complete before dependent compute may start.
+
+The paired measurement — the same step compiled once normally and once
+serialized — yields the overlap attribution number::
+
+    overlap_fraction = clamp((t_serialized - t_overlapped) / t_serialized)
+
+i.e. the fraction of serialized step time that XLA's schedule hides by
+overlapping comms with compute. Serialize mode is a *trace-time* flag:
+flip it with :func:`set_serialize_collectives` before building/compiling
+the step function (``bench.py multichip`` compiles each arm fresh).
+"""
+
+from __future__ import annotations
+
+import jax
+
+# (name, module, description) — pure literals; the telemetry-contract
+# pass reads this tuple from the AST and every ``collective(...)`` call
+# site must name one of these.
+SECTION_SPECS = (
+    ("ring_kv_hop", "kubeflow_tpu/parallel/ring",
+     "K/V block ppermute to the next ring neighbor (xla block impl)"),
+    ("ring_flash_kv_hop", "kubeflow_tpu/parallel/ring",
+     "K/V block ppermute in the flash-kernel ring forward"),
+    ("ring_flash_grad_hop", "kubeflow_tpu/parallel/ring",
+     "K/V + dK/dV accumulator ppermute in the flash ring backward"),
+    ("ulysses_all_to_all", "kubeflow_tpu/parallel/ulysses",
+     "heads<->sequence all_to_all (both directions of the exchange)"),
+    ("pipeline_stage_hop", "kubeflow_tpu/parallel/pipeline",
+     "microbatch activation ppermute to the next pipeline stage"),
+    ("moe_dispatch_all_to_all", "kubeflow_tpu/parallel/moe",
+     "token-slot all_to_all scattering tokens to their experts"),
+    ("moe_combine_all_to_all", "kubeflow_tpu/parallel/moe",
+     "expert-output all_to_all returning tokens to their home shard"),
+)
+
+SECTION_NAMES = frozenset(spec[0] for spec in SECTION_SPECS)
+
+_serialize = False
+
+
+def _barrier_tree(tree):
+    """optimization_barrier over a pytree, skipping non-differentiable
+    leaves (float0 cotangents for integer operands have no barrier
+    lowering)."""
+    from jax.dtypes import float0
+
+    return jax.tree.map(
+        lambda t: t if getattr(t, "dtype", None) == float0
+        else jax.lax.optimization_barrier(t),
+        tree,
+    )
+
+
+@jax.custom_vjp
+def _fence(tree):
+    return _barrier_tree(tree)
+
+
+def _fence_fwd(tree):
+    return _barrier_tree(tree), None
+
+
+def _fence_bwd(_, cotangents):
+    # Fence the cotangents too: the backward pass runs the TRANSPOSED
+    # collective (all_to_all ↔ all_to_all, ppermute ↔ inverse ppermute),
+    # and serialize mode must stop XLA from overlapping that one as well
+    # — plus optimization_barrier has no differentiation rule of its own
+    # (jax ≤ 0.4.x), so the custom VJP is what makes serialize-mode steps
+    # trainable at all.
+    return (_barrier_tree(cotangents),)
+
+
+_fence.defvjp(_fence_fwd, _fence_bwd)
+
+
+def set_serialize_collectives(on: bool) -> None:
+    """Trace-time switch: fence registered collectives with optimization
+    barriers so comms cannot overlap compute. Only affects functions
+    *traced* while on — recompile the step for each arm of the A/B."""
+    global _serialize
+    _serialize = bool(on)
+
+
+def serialize_collectives() -> bool:
+    return _serialize
+
+
+def collective(name: str, op, *operands, **kwargs):
+    """Issue collective ``op(*operands, **kwargs)`` inside the registered
+    timed section ``name``.
+
+    ``name`` must be a literal from ``SECTION_SPECS`` (enforced both here
+    at trace time and statically by the telemetry-contract pass). The
+    named scope shows up in XLA profiler traces (``kftpu.<name>``) so
+    ``sdk.capture_profile`` dumps attribute comm time to these labels.
+    """
+    if name not in SECTION_NAMES:
+        raise ValueError(
+            f"unregistered telemetry section {name!r}; add it to "
+            f"telemetry/sections.py SECTION_SPECS"
+        )
+    with jax.named_scope("kftpu." + name):
+        if _serialize:
+            operands = _fence(operands)
+        out = op(*operands, **kwargs)
+        if _serialize:
+            out = _fence(out)
+    return out

@@ -322,6 +322,32 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
                 "Running — a configuration change was blocked while the "
                 "server is running; stop it and re-apply the change",
             )
+        # Training telemetry (ISSUE 18, controllers/notebook.py folds the
+        # SDK's annotation into status.tpu.telemetry): a Running server
+        # that is mid-training says so, with the achieved MFU when the
+        # profiler knew its FLOPs basis. A STALE entry (publisher gone
+        # quiet past KFTPU_TELEMETRY_STALE_SECONDS) must not present
+        # week-old MFU as live — degrade to saying the telemetry is
+        # stale instead.
+        telem = deep_get(notebook, "status", "tpu", "telemetry",
+                         default={}) or {}
+        if telem.get("step"):
+            workers = (f" ({ready}/{want_hosts} TPU workers)"
+                       if want_hosts > 1 else "")
+            if telem.get("stale"):
+                return Status(
+                    READY,
+                    f"Running{workers} — training telemetry stale "
+                    f"(last step {telem['step']})",
+                )
+            mfu = telem.get("mfu")
+            mfu_part = (f", {mfu:.0%} MFU"
+                        if isinstance(mfu, (int, float)) else "")
+            return Status(
+                READY,
+                f"Running{workers} — Training: step {telem['step']}"
+                f"{mfu_part} ({telem.get('family') or 'unknown'})",
+            )
         if want_hosts > 1:
             return Status(READY, f"Running ({ready}/{want_hosts} TPU workers)")
         return Status(READY, "Running")

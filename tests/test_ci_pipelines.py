@@ -46,6 +46,13 @@ def test_rendered_yaml_parses_with_invariants():
     assert any("dryrun_multichip" in s.get("run", "") for s in steps)
     assert any("make -C native" in s.get("run", "") for s in steps)
     assert any("ci/check_tracing.py" in s.get("run", "") for s in steps)
+    # ISSUE 18: the multichip telemetry gate (per-family MFU + overlap
+    # numbers, not ok=true) and the <5% always-on profiler overhead gate
+    # both run as smoke steps in the suite.
+    assert any("bench.py multichip --smoke" in s.get("run", "")
+               for s in steps)
+    assert any("bench.py telemetry_overhead --smoke" in s.get("run", "")
+               for s in steps)
     # The AST static-analysis gate (ISSUE 12): runs before the suite,
     # exit 1 on findings, findings JSON uploaded as a build artifact.
     analysis_step = next(

@@ -1703,3 +1703,119 @@ def test_shard_safety_unreadable_registry_is_a_finding(tmp_path):
     }, select={"shard-safety"})
     assert rules_of(report) == ["stale-shard-safety-entry"]
     assert "unreadable" in report.findings[0].message
+
+
+# ---- telemetry-contract (whole-tree) -----------------------------------------
+
+
+def _telemetry_tree(tmp_path, *, keys_src=None, sections_src=None,
+                    caller_src=None, docs=None, select=None):
+    (tmp_path / "kubeflow_tpu" / "api").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "kubeflow_tpu" / "telemetry").mkdir(parents=True,
+                                                    exist_ok=True)
+    (tmp_path / "kubeflow_tpu" / "api" / "keys.py").write_text(
+        keys_src if keys_src is not None else (
+            'NOTEBOOK_TPU_TELEMETRY = "notebooks.kubeflow.org/tpu-telemetry"\n'
+            'OWNERS = {\n'
+            '    NOTEBOOK_TPU_TELEMETRY: ("kubeflow_tpu/telemetry/publisher",),\n'
+            '}\n'))
+    (tmp_path / "kubeflow_tpu" / "telemetry" / "sections.py").write_text(
+        sections_src if sections_src is not None else (
+            'SECTION_SPECS = (\n'
+            '    ("ring_kv_hop", "kubeflow_tpu/parallel/ring", "kv hop"),\n'
+            ')\n'))
+    (tmp_path / "kubeflow_tpu" / "caller.py").write_text(
+        caller_src if caller_src is not None else (
+            'from kubeflow_tpu.telemetry import sections\n'
+            'def f(x):\n'
+            '    return sections.collective("ring_kv_hop", lambda t: t, x)\n'))
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "operations.md").write_text(
+        docs if docs is not None else "telemetry runbook\n")
+    project = load_project(root=str(tmp_path), paths=["kubeflow_tpu"])
+    assert project.full_tree
+    return run_passes(project, select=select or {"telemetry-contract"})
+
+
+def test_telemetry_contract_clean_tree(tmp_path):
+    assert _telemetry_tree(tmp_path).findings == []
+
+
+def test_telemetry_widened_owners_is_writer_drift(tmp_path):
+    report = _telemetry_tree(tmp_path, keys_src=(
+        'NOTEBOOK_TPU_TELEMETRY = "notebooks.kubeflow.org/tpu-telemetry"\n'
+        'OWNERS = {\n'
+        '    NOTEBOOK_TPU_TELEMETRY: (\n'
+        '        "kubeflow_tpu/telemetry/publisher",\n'
+        '        "kubeflow_tpu/controllers/notebook",\n'
+        '    ),\n'
+        '}\n'))
+    assert rules_of(report) == ["telemetry-single-writer"]
+    assert "exactly ONE writer" in report.findings[0].message
+
+
+def test_telemetry_missing_key_constant_flagged(tmp_path):
+    report = _telemetry_tree(tmp_path, keys_src="OWNERS = {}\n")
+    assert set(rules_of(report)) == {"telemetry-single-writer"}
+    assert len(report.findings) == 2  # constant missing + OWNERS pin missing
+
+
+def test_telemetry_unregistered_and_nonliteral_sections_flagged(tmp_path):
+    report = _telemetry_tree(tmp_path, caller_src=(
+        'from kubeflow_tpu.telemetry import sections\n'
+        'def f(x, name):\n'
+        '    a = sections.collective("made_up_hop", lambda t: t, x)\n'
+        '    return sections.collective(name, lambda t: t, a)\n'))
+    msgs = [f.message for f in report.findings]
+    assert any("'made_up_hop'" in m and "not a registered" in m
+               for m in msgs)
+    assert any("non-literal section name" in m for m in msgs)
+    # ring_kv_hop now has no call site -> stale registry entry too.
+    assert any("stale registry entry" in m for m in msgs)
+    assert all(f.rule == "telemetry-sections" for f in report.findings)
+
+
+def test_telemetry_unrelated_collective_helper_stays_quiet(tmp_path):
+    """A collective() method on some other receiver (e.g. an MPI-ish
+    client) is not the telemetry helper — no findings from it."""
+    report = _telemetry_tree(tmp_path, caller_src=(
+        'from kubeflow_tpu.telemetry import sections\n'
+        'def f(x, comm, name):\n'
+        '    comm.collective(name, x)\n'
+        '    return sections.collective("ring_kv_hop", lambda t: t, x)\n'))
+    assert report.findings == []
+
+
+def test_telemetry_computed_registry_rejected(tmp_path):
+    report = _telemetry_tree(tmp_path, sections_src=(
+        'NAME = "ring_kv_hop"\n'
+        'SECTION_SPECS = (\n'
+        '    (NAME, "kubeflow_tpu/parallel/ring", "kv hop"),\n'
+        ')\n'))
+    assert any("STRING-LITERAL" in f.message for f in report.findings)
+
+
+def test_telemetry_undocumented_knob_flagged_and_docs_row_clears(tmp_path):
+    caller = (
+        'import os\n'
+        'CUSTOM_ENV = "KFTPU_TELEMETRY_CUSTOM"\n'
+        'from kubeflow_tpu.telemetry import sections\n'
+        'def f(x):\n'
+        '    return sections.collective("ring_kv_hop", lambda t: t, x)\n')
+    report = _telemetry_tree(tmp_path, caller_src=caller)
+    assert rules_of(report) == ["telemetry-knob-docs"]
+    assert "KFTPU_TELEMETRY_CUSTOM" in report.findings[0].message
+    clean = _telemetry_tree(
+        tmp_path, caller_src=caller,
+        docs="| `KFTPU_TELEMETRY_CUSTOM` | unset | documented |\n")
+    assert clean.findings == []
+
+
+def test_telemetry_suppression_escape_hatch(tmp_path):
+    report = _telemetry_tree(tmp_path, caller_src=(
+        'from kubeflow_tpu.telemetry import sections\n'
+        'def f(x, name):\n'
+        '    a = sections.collective(name, lambda t: t, x)'
+        '  # kftpu: ignore[telemetry-sections] trace-replay tool feeds recorded names\n'
+        '    return sections.collective("ring_kv_hop", lambda t: t, a)\n'))
+    assert report.findings == []

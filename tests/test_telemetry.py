@@ -1,0 +1,611 @@
+"""Step-level training telemetry (ISSUE 18): profiler math, section
+fencing, the capped single-writer annotation, the efficiency ledger, and
+— the load-bearing regression — the scheduler placement signal staying a
+tie-break strictly inside the idle victim tier.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu import telemetry
+from kubeflow_tpu.telemetry import sections
+from kubeflow_tpu.telemetry.ledger import EfficiencyLedger
+from kubeflow_tpu.telemetry.profiler import (
+    StepProfiler,
+    overlap_fraction,
+    window_steps,
+)
+from kubeflow_tpu.telemetry import publisher as pub
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---- profiler ----------------------------------------------------------------
+
+
+def test_profiler_first_step_is_compile_not_window():
+    """The compile-inclusive first step stays out of the rolling window:
+    p50/MFU reflect steady state, compile_sec is the first-step excess."""
+    prof = StepProfiler("fam", flops_per_step=1e12, peak_flops=2e12,
+                        window=8, sync_every=100, environ={})
+    prof.observe(1, 10.0)           # tracing + compile
+    assert prof.steps == 0 and prof.step_p50_sec() is None
+    for i in range(4):
+        prof.observe(2 + i, 0.5)
+    s = prof.summary()
+    assert s["steps_measured"] == 4
+    assert s["step_p50_sec"] == pytest.approx(0.5)
+    assert s["first_step_sec"] == pytest.approx(10.0)
+    assert s["compile_sec"] == pytest.approx(9.5)
+    # 1e12 FLOPs / 0.5 s = 2e12 FLOP/s achieved on a 2e12 peak -> MFU 1.0
+    assert s["mfu"] == pytest.approx(1.0)
+    assert s["achieved_tflops"] == pytest.approx(2.0)
+    assert s["mfu_basis"] == "accelerator"
+
+
+def test_profiler_start_stop_uses_injected_clock():
+    clock = FakeClock()
+    prof = StepProfiler("fam", window=4, sync_every=100, clock=clock,
+                        environ={})
+    for dt in (1.0, 0.25, 0.35, 0.15):
+        prof.start()
+        clock.advance(dt)
+        prof.stop()
+    # First (1.0 s) excluded; p50 of [0.25, 0.35, 0.15] = 0.25.
+    assert prof.step_p50_sec() == pytest.approx(0.25)
+    assert prof.last_step == 4
+
+
+def test_profiler_disabled_records_nothing():
+    prof = StepProfiler("fam", window=4, environ={"KFTPU_TELEMETRY": "0"})
+    prof.start()
+    prof.observe(1, 1.0)
+    prof.observe(2, 1.0)
+    assert prof.steps == 0 and prof.first_step_sec is None
+    assert prof.summary()["step_p50_sec"] is None
+
+
+def test_profiler_in_process_override_beats_env():
+    prof = StepProfiler("fam", window=4, environ={"KFTPU_TELEMETRY": "0"})
+    telemetry.set_enabled(True)
+    try:
+        prof.observe(1, 1.0)
+        prof.observe(2, 0.5)
+        assert prof.first_step_sec == pytest.approx(1.0)
+        assert prof.steps == 1
+    finally:
+        telemetry.set_enabled(None)
+
+
+def test_profiler_sync_boundary_drains_into_measured_step():
+    """Every sync_every-th step blocks on the sync value and the drain
+    time lands in that step's wall time, not nowhere."""
+    clock = FakeClock()
+    prof = StepProfiler("fam", window=8, sync_every=2, clock=clock,
+                        environ={})
+    x = jnp.zeros(())
+    # steps==0 boundary: sync happens (costs nothing on a ready array).
+    prof.observe(1, 1.0, sync_value=x)
+    prof.observe(2, 0.5, sync_value=x)       # steps==1: no boundary
+    prof.observe(3, 0.5, sync_value=x)       # steps==2: boundary again
+    assert prof.steps == 2
+    assert prof.summary()["step_p50_sec"] == pytest.approx(0.5)
+
+
+def test_window_steps_parse_and_floor():
+    assert window_steps({}) == 32
+    assert window_steps({"KFTPU_TELEMETRY_WINDOW": "7"}) == 7
+    assert window_steps({"KFTPU_TELEMETRY_WINDOW": "1"}) == 2   # floor
+    assert window_steps({"KFTPU_TELEMETRY_WINDOW": "junk"}) == 32
+
+
+def test_overlap_fraction_math_and_clamps():
+    assert overlap_fraction(0.6, 1.0) == pytest.approx(0.4)
+    assert overlap_fraction(1.2, 1.0) == 0.0    # noise: overlapped slower
+    assert overlap_fraction(0.5, 0.0) == 0.0    # degenerate serialized
+    prof = StepProfiler("fam", environ={})
+    prof.note_overlap(1.7, 2.0)
+    assert prof.overlap == 1.0                  # clamped
+    assert prof.serialized_step_sec == pytest.approx(2.0)
+
+
+# ---- sections ----------------------------------------------------------------
+
+
+def test_collective_rejects_unregistered_name():
+    with pytest.raises(ValueError, match="unregistered telemetry section"):
+        sections.collective("made_up_section", lambda x: x, jnp.ones(3))
+
+
+def test_serialize_mode_step_is_differentiable():
+    """The custom-VJP fence: optimization_barrier has no differentiation
+    rule of its own (jax <= 0.4.x), so grad through a serialize-mode
+    collective is exactly what broke before the _fence wrapper. The
+    fenced function must produce the same value AND the same gradient as
+    the unfenced one."""
+    x = jnp.arange(4.0)
+
+    def loss(v):
+        y = sections.collective("ulysses_all_to_all", lambda t: t * 3.0, v)
+        return jnp.sum(y ** 2)
+
+    base_val, base_grad = jax.value_and_grad(loss)(x)
+    sections.set_serialize_collectives(True)
+    try:
+        ser_val, ser_grad = jax.jit(jax.value_and_grad(loss))(x)
+    finally:
+        sections.set_serialize_collectives(False)
+    assert jnp.allclose(base_val, ser_val)
+    assert jnp.allclose(base_grad, ser_grad)
+
+
+def test_serialize_fence_skips_integer_operands():
+    """Integer operands ride through the fence (float0 cotangents in the
+    VJP have no barrier lowering — the fence must not choke on them)."""
+    ints = jnp.arange(4)
+
+    def f(i, w):
+        y = sections.collective(
+            "moe_dispatch_all_to_all",
+            lambda idx, weights: weights[idx], i, w)
+        return jnp.sum(y)
+
+    w = jnp.arange(4.0) * 2.0
+    sections.set_serialize_collectives(True)
+    try:
+        val, grad = jax.value_and_grad(f, argnums=1)(ints, w)
+    finally:
+        sections.set_serialize_collectives(False)
+    assert float(val) == pytest.approx(float(jnp.sum(w)))
+    assert jnp.allclose(grad, jnp.ones(4))
+
+
+def test_section_registry_is_closed_and_sorted_by_module():
+    # The docs and the analysis pass read this vocabulary; a structural
+    # drift here should fail loudly in tier-1, not just in CI analysis.
+    assert len(sections.SECTION_SPECS) == len(sections.SECTION_NAMES)
+    for name, module, desc in sections.SECTION_SPECS:
+        assert name and module.startswith("kubeflow_tpu/parallel/")
+        assert desc
+
+
+# ---- publisher ---------------------------------------------------------------
+
+
+def _summary(**over):
+    base = {
+        "family": "moe", "step": 120, "mfu": 0.4321, "step_p50_sec": 0.0123,
+        "overlap_fraction": 0.41, "mfu_basis": "accelerator",
+        "tokens_per_sec": 81000.0, "compile_sec": 8.2,
+        "hbm_high_water_bytes": 123456789,
+    }
+    base.update(over)
+    return base
+
+
+def test_encode_caps_by_dropping_optional_fields_never_torn_json():
+    full = pub.encode(_summary(), seq=1, at=1000.0, cap=4096)
+    entry = json.loads(full)
+    assert entry["mfu"] == 0.4321 and entry["hbm"] == 123456789
+
+    tight = pub.encode(_summary(), seq=1, at=1000.0, cap=len(full) - 1)
+    small = json.loads(tight)                 # still valid JSON
+    assert "hbm" not in small                 # optional fields drop front-first
+    for k in ("v", "seq", "at", "family", "step", "mfu", "step_sec"):
+        assert k in small
+
+    minimal = pub.encode(_summary(), seq=1, at=1000.0, cap=1)
+    core = json.loads(minimal)                # every optional field gone
+    assert set(core) <= {"v", "seq", "at", "family", "step", "mfu",
+                         "step_sec"}
+
+
+def test_decode_roundtrip_and_corruption_degrades_to_none():
+    payload = pub.encode(_summary(), seq=7, at=1234.5)
+    entry = pub.decode({pub.TELEMETRY_ANNOTATION: payload})
+    assert entry["seq"] == 7 and entry["at"] == pytest.approx(1234.5)
+    assert entry["family"] == "moe" and entry["step"] == 120
+
+    assert pub.decode(None) is None
+    assert pub.decode({}) is None
+    assert pub.decode({pub.TELEMETRY_ANNOTATION: "{not json"}) is None
+    assert pub.decode({pub.TELEMETRY_ANNOTATION: "[1,2]"}) is None
+    assert pub.decode({pub.TELEMETRY_ANNOTATION: '{"seq": 1}'}) is None
+    assert pub.decode(
+        {pub.TELEMETRY_ANNOTATION: '{"at": "yesterday"}'}) is None
+
+
+def test_is_stale_window():
+    entry = {"at": 100.0}
+    assert not pub.is_stale(entry, 150.0, stale_after=120.0)
+    assert pub.is_stale(entry, 221.0, stale_after=120.0)
+
+
+def test_publisher_rate_limit_force_and_failure_counting():
+    clock = FakeClock()
+    patches = []
+    p = pub.TelemetryPublisher(patches.append, min_interval=30.0,
+                               now_fn=lambda: 1000.0, clock=clock)
+    assert p.publish(_summary()) is True
+    assert p.publish(_summary()) is False     # inside the window
+    assert p.publish(_summary(), force=True) is True
+    clock.advance(31.0)
+    assert p.publish(_summary()) is True
+    assert len(patches) == 3
+    body = patches[0]["metadata"]["annotations"][pub.TELEMETRY_ANNOTATION]
+    assert json.loads(body)["family"] == "moe"
+    # seq increments per attempted patch (rate-limited skips don't
+    # consume one) so readers can dedup by seq.
+    assert [json.loads(b["metadata"]["annotations"]
+                       [pub.TELEMETRY_ANNOTATION])["seq"]
+            for b in patches] == [1, 2, 3]
+
+    def boom(body):
+        raise RuntimeError("api server down")
+
+    clock.advance(31.0)
+    failing = pub.TelemetryPublisher(boom, min_interval=0.0, clock=clock)
+    assert failing.publish(_summary()) is False
+    assert failing.errors == 1 and "api server down" in failing.last_error
+
+
+def test_publish_metrics_gauges_labeled_by_family():
+    from kubeflow_tpu.runtime.metrics import Registry
+
+    reg = Registry()
+    pub.publish_metrics(_summary(), reg)
+    text = reg.expose()
+    assert 'tpu_training_mfu{family="moe"} 0.4321' in text
+    assert 'tpu_training_step_seconds{family="moe"}' in text
+    assert 'tpu_training_overlap_fraction{family="moe"}' in text
+    assert 'tpu_training_hbm_bytes{family="moe"}' in text
+    # Decoded-annotation (short-key) dicts feed the same fold.
+    reg2 = Registry()
+    pub.publish_metrics({"family": "vision", "mfu": 0.1,
+                         "step_sec": 0.5, "overlap": 0.2}, reg2)
+    assert 'tpu_training_step_seconds{family="vision"} 0.5' in reg2.expose()
+
+
+# ---- efficiency ledger -------------------------------------------------------
+
+
+def test_ledger_ewma_and_expected_mfu():
+    led = EfficiencyLedger(low_mfu=0.25, samples_needed=3)
+    led.note("ns/a", "moe", "v5e:4x4", 0.5)
+    assert led.gang_mfu("ns/a") == pytest.approx(0.5)
+    led.note("ns/a", "moe", "v5e:4x4", 0.1)
+    assert led.gang_mfu("ns/a") == pytest.approx(0.7 * 0.5 + 0.3 * 0.1)
+    assert led.expected_mfu("moe", "v5e:4x4") == led.gang_mfu("ns/a")
+    assert led.expected_mfu("moe", "v5p:2x2x1") is None
+
+
+def test_ledger_persistently_low_needs_samples_and_threshold():
+    led = EfficiencyLedger(low_mfu=0.25, samples_needed=3)
+    led.note("ns/slow", "moe", "v5e:4x4", 0.05)
+    led.note("ns/slow", "moe", "v5e:4x4", 0.05)
+    assert not led.persistently_low("ns/slow")   # not enough windows
+    led.note("ns/slow", "moe", "v5e:4x4", 0.05)
+    assert led.persistently_low("ns/slow")
+    led.note("ns/fast", "moe", "v5e:4x4", 0.9)
+    led.note("ns/fast", "moe", "v5e:4x4", 0.9)
+    led.note("ns/fast", "moe", "v5e:4x4", 0.9)
+    assert not led.persistently_low("ns/fast")
+    # None MFU (unknown basis) registers the sighting but never counts
+    # toward "persistently low".
+    led.note("ns/blind", "vision", "v5e:4x4", None)
+    led.note("ns/blind", "vision", "v5e:4x4", None)
+    led.note("ns/blind", "vision", "v5e:4x4", None)
+    assert not led.persistently_low("ns/blind")
+    assert led.explain("ns/blind")["gang_samples"] == 0
+
+
+def test_ledger_forget_drops_gang_but_keeps_family_prior():
+    led = EfficiencyLedger(low_mfu=0.25, samples_needed=1)
+    led.note("ns/a", "moe", "v5e:4x4", 0.6)
+    led.forget("ns/a")
+    assert led.gang_mfu("ns/a") is None
+    assert not led.persistently_low("ns/a")
+    # The family x shape placement prior survives the gang.
+    assert led.expected_mfu("moe", "v5e:4x4") == pytest.approx(0.6)
+    assert led.explain("ns/a") is None
+
+
+def test_ledger_explain_shape():
+    led = EfficiencyLedger(low_mfu=0.25, samples_needed=1)
+    led.note("ns/a", "moe", "v5e:4x4", 0.1)
+    exp = led.explain("ns/a")
+    assert exp["persistently_low"] is True
+    assert exp["family"] == "moe" and exp["shape"] == "v5e:4x4"
+    assert exp["expected_mfu"] == pytest.approx(0.1)
+    info = led.debug_info()
+    assert info["gangs"]["ns/a"]["persistently_low"] is True
+    assert info["families"]["moe@v5e:4x4"]["samples"] == 1
+
+
+# ---- scheduler placement signal (the regression the issue demands) -----------
+
+
+def _sched_req(key, ns, *, slices=1, priority=0, at=0.0,
+               workload="notebook"):
+    from kubeflow_tpu.scheduler import GangRequest
+    from kubeflow_tpu.tpu.topology import TpuSlice
+
+    chips = TpuSlice.parse("v5e", "4x4").num_chips * slices
+    return GangRequest(key=key, namespace=ns, accelerator="v5e",
+                       topology="4x4", num_slices=slices, chips=chips,
+                       priority=priority, submitted_at=at,
+                       workload=workload)
+
+
+def _feed_low_mfu(q, key, n=5):
+    for _ in range(n):
+        q.note_efficiency(key, "moe", "v5e:4x4", 0.01)
+
+
+def test_low_mfu_gang_preferred_among_idle_victims():
+    """Two equally idle, equal-priority holders: the persistently-low-MFU
+    one dies first. Pure tie-break — same tier, same protections."""
+    from kubeflow_tpu.scheduler import Fleet, PolicyConfig, PolicyQueue
+
+    cfg = PolicyConfig(idle_preempt_after_seconds=100.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"), config=cfg)
+    q.submit(_sched_req(("ns", "slow"), "ns"))
+    q.submit(_sched_req(("ns", "fast"), "ns", at=0.5))
+    q.schedule(1.0)
+    # Make "slow" MORE attractive on every other idle-tier key (less
+    # idle, younger) so only the efficiency rank can pick it first.
+    q.touch(("ns", "slow"), 10.0)
+    q.touch(("ns", "fast"), 0.0)
+    _feed_low_mfu(q, ("ns", "slow"))
+    q.note_efficiency(("ns", "fast"), "moe", "v5e:4x4", 0.9)
+    q.submit(_sched_req(("hi", "urgent"), "hi", at=200.0))
+    r = q.schedule(200.0)
+    assert [p.key for p in r.preempted] == [("ns", "slow")]
+    assert r.preempted[0].reason == "idle"
+    assert [a.key for a in r.admitted] == [("hi", "urgent")]
+    q.ledger.assert_consistent()
+
+
+def test_low_mfu_never_outranks_busy_or_priority_protection():
+    """THE acceptance regression: a persistently-low-MFU gang that is
+    BUSY (or higher-priority) keeps every protection — the signal can
+    never promote a victim across tiers."""
+    from kubeflow_tpu.scheduler import Fleet, PolicyConfig, PolicyQueue
+
+    cfg = PolicyConfig(idle_preempt_after_seconds=100.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"), config=cfg)
+    q.submit(_sched_req(("ns", "slowbusy"), "ns", priority=50))
+    q.schedule(0.0)
+    _feed_low_mfu(q, ("ns", "slowbusy"))
+    q.touch(("ns", "slowbusy"), 199.0)        # recently active -> busy
+    # Same priority: terrible MFU buys the waiter nothing.
+    q.submit(_sched_req(("b", "peer"), "b", priority=50, at=200.0))
+    r = q.schedule(200.0)
+    assert r.preempted == [] and r.admitted == []
+    # Still busy eons later (touch refreshed): even a HIGHER-priority
+    # waiter only wins via the priority tier, with reason "priority" —
+    # the MFU signal never converts busy into idle.
+    q.touch(("ns", "slowbusy"), 1e6 - 1.0)
+    q.submit(_sched_req(("c", "boss"), "c", priority=100, at=1e6))
+    r2 = q.schedule(1e6)
+    assert [p.key for p in r2.preempted] == [("ns", "slowbusy")]
+    assert r2.preempted[0].reason == "priority"
+
+
+def test_low_mfu_idle_gang_dies_before_high_mfu_even_if_high_is_idler():
+    """Efficiency rank sorts ABOVE idle-duration inside tier 0: the
+    low-MFU gang is preferred even when the high-MFU one has idled far
+    longer (which the pre-signal order would have killed first)."""
+    from kubeflow_tpu.scheduler import Fleet, PolicyConfig, PolicyQueue
+
+    cfg = PolicyConfig(idle_preempt_after_seconds=10.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"), config=cfg)
+    q.submit(_sched_req(("ns", "ancient-idler"), "ns"))
+    q.submit(_sched_req(("ns", "slow"), "ns", at=0.5))
+    q.schedule(1.0)
+    q.touch(("ns", "ancient-idler"), 1.0)     # idle for ~999 s
+    q.touch(("ns", "slow"), 950.0)            # idle for ~50 s
+    _feed_low_mfu(q, ("ns", "slow"))
+    q.submit(_sched_req(("hi", "urgent"), "hi", at=1000.0))
+    r = q.schedule(1000.0)
+    assert [p.key for p in r.preempted] == [("ns", "slow")]
+
+
+def test_low_mfu_serving_workload_is_never_a_victim():
+    """Workload-class guard outranks the signal: a serving replica with
+    rock-bottom MFU still cannot be preempted."""
+    from kubeflow_tpu.scheduler import Fleet, PolicyConfig, PolicyQueue
+
+    cfg = PolicyConfig(idle_preempt_after_seconds=1.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"), config=cfg)
+    q.submit(_sched_req(("srv", "replica"), "srv", workload="serving"))
+    q.schedule(0.0)
+    q.touch(("srv", "replica"), 0.0)          # idle by the culling signal
+    _feed_low_mfu(q, ("srv", "replica"))
+    q.submit(_sched_req(("hi", "urgent"), "hi", priority=100, at=500.0))
+    r = q.schedule(500.0)
+    assert r.preempted == [] and r.admitted == []
+
+
+def test_release_forgets_gang_efficiency_and_explain_carries_signal():
+    from kubeflow_tpu.scheduler import Fleet, PolicyConfig, PolicyQueue
+
+    cfg = PolicyConfig(idle_preempt_after_seconds=100.0)
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:2"), config=cfg)
+    q.submit(_sched_req(("ns", "a"), "ns"))
+    q.schedule(0.0)
+    _feed_low_mfu(q, ("ns", "a"))
+    exp = q.explain(("ns", "a"), 1.0)
+    assert exp["efficiency"]["persistently_low"] is True
+    assert "historically achieves" in exp["efficiency"]["note"]
+    assert q.debug_info(1.0)["efficiency"]["gangs"]["ns/a"][
+        "persistently_low"]
+    q.release(("ns", "a"))
+    assert "ns/a" not in q.debug_info(2.0)["efficiency"]["gangs"]
+    # The family prior survives for placement explains of future gangs.
+    assert q.efficiency.expected_mfu("moe", "v5e:4x4") is not None
+
+
+def test_note_efficiency_does_not_bump_gen():
+    """No re-arbitration churn: feeding telemetry windows must not look
+    like a fleet event to the reconcile loop."""
+    from kubeflow_tpu.scheduler import Fleet, PolicyQueue
+
+    q = PolicyQueue(fleet=Fleet.parse("a=v5e:4x4:1"))
+    q.submit(_sched_req(("ns", "a"), "ns"))
+    q.schedule(0.0)
+    gen = q.gen
+    _feed_low_mfu(q, ("ns", "a"))
+    assert q.gen == gen
+
+
+# ---- JWA status message (backend, pure) --------------------------------------
+
+
+def _running_nb(telem):
+    from kubeflow_tpu.api import notebook as nbapi
+
+    nb = nbapi.new("x", "ns")
+    nb["metadata"]["creationTimestamp"] = "2020-01-01T00:00:00Z"
+    nb["status"] = {
+        "readyReplicas": 1,
+        "containerState": {"running": {"startedAt": "2020-01-01T00:00:01Z"}},
+        "tpu": {"hosts": 1, **({"telemetry": telem} if telem else {})},
+    }
+    return nb
+
+
+def test_jwa_message_shows_training_step_and_mfu():
+    from kubeflow_tpu.web.common.status import process_status
+
+    s = process_status(_running_nb(
+        {"family": "moe", "step": 1200, "mfu": 0.57, "seq": 3}))
+    assert s.phase == "ready"
+    assert s.message == "Running — Training: step 1200, 57% MFU (moe)"
+    # Unknown MFU basis: no vacuous percentage, family still named.
+    s2 = process_status(_running_nb({"family": "vision", "step": 7}))
+    assert s2.message == "Running — Training: step 7 (vision)"
+    # No telemetry block at all: plain Running.
+    assert process_status(_running_nb(None)).message == "Running"
+
+
+def test_jwa_message_degrades_when_telemetry_stale():
+    from kubeflow_tpu.web.common.status import process_status
+
+    s = process_status(_running_nb(
+        {"family": "moe", "step": 1200, "mfu": 0.57, "stale": True}))
+    assert s.phase == "ready"
+    assert "telemetry stale" in s.message
+    assert "last step 1200" in s.message
+    assert "MFU" not in s.message    # never present frozen numbers as live
+
+
+# ---- controller fold + /debug/telemetry (end to end over FakeKube) -----------
+
+
+async def test_controller_folds_annotation_and_serves_debug_telemetry():
+    """The export path end to end: the publisher's annotation is decoded
+    into status.tpu.telemetry, fed once per seq to the training_step SLI
+    + Prometheus + the scheduler's efficiency ledger, and served fleet-
+    wide at /debug/telemetry; a stale entry degrades instead of lying."""
+    import asyncio
+    import time
+
+    from kubeflow_tpu.api import notebook as nbapi
+    from kubeflow_tpu.controllers.notebook import setup_notebook_controller
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.objects import deep_get
+    from kubeflow_tpu.scheduler import (
+        Fleet,
+        SchedulerOptions,
+        TpuFleetScheduler,
+    )
+    from kubeflow_tpu.testing.fakekube import FakeKube
+    from kubeflow_tpu.webhooks import register_all
+
+    kube = FakeKube()
+    register_all(kube)
+    mgr = Manager(kube)
+    sched = TpuFleetScheduler(
+        kube, SchedulerOptions(queued_requeue_seconds=0.05),
+        fleet=Fleet.parse("pool-a=v5e:4x4:1"), registry=mgr.registry)
+    setup_notebook_controller(mgr, scheduler=sched)
+    await mgr.start()
+    try:
+        await kube.create("Notebook", nbapi.new(
+            "train", "ns", accelerator="v5e", topology="4x4"))
+        await mgr.wait_idle(timeout=20)
+        await asyncio.sleep(0.05)
+        await mgr.wait_idle(timeout=20)
+
+        summary = _summary(step_p50_sec=0.012)
+        payload = pub.encode(summary, seq=1, at=time.time())
+        await kube.patch("Notebook", "train", {
+            "metadata": {"annotations": {pub.TELEMETRY_ANNOTATION: payload}}
+        }, "ns")
+        for _ in range(4):
+            await mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.05)
+
+        nb = await kube.get("Notebook", "train", "ns")
+        telem = deep_get(nb, "status", "tpu", "telemetry")
+        assert telem["family"] == "moe" and telem["step"] == 120
+        assert telem["mfu"] == pytest.approx(0.4321)
+        assert telem["stepSec"] == pytest.approx(0.012)
+        assert "stale" not in telem
+
+        # /debug/telemetry payload (the route serves mgr.telemetry()).
+        debug = mgr.telemetry()
+        row = debug["notebooks"]["ns/train"]
+        assert row["seq"] == 1 and row["stale"] is False
+
+        # Prometheus mirror + the scheduler's efficiency ledger both saw
+        # the window exactly once (deduped by seq).
+        from kubeflow_tpu.runtime.metrics import global_registry
+        assert 'tpu_training_mfu{family="moe"}' in global_registry.expose()
+        key = ("ns", "train")
+        assert sched.policy.efficiency.gang_mfu("ns/train") == \
+            pytest.approx(0.4321)
+        exp = sched.policy.explain(key, time.time())
+        assert exp["efficiency"]["family"] == "moe"
+        assert exp["efficiency"]["shape"] == "v5e:4x4"
+
+        # Re-reconcile with the SAME seq: the ledger must not double-feed.
+        await kube.patch("Notebook", "train",
+                         {"metadata": {"labels": {"touch": "1"}}}, "ns")
+        for _ in range(3):
+            await mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.05)
+        assert exp["efficiency"]["gang_samples"] == 1
+
+        # A stale publish (old `at`) keeps the block but degrades it.
+        stale_payload = pub.encode(summary, seq=2, at=time.time() - 1e6)
+        await kube.patch("Notebook", "train", {
+            "metadata": {"annotations":
+                         {pub.TELEMETRY_ANNOTATION: stale_payload}}
+        }, "ns")
+        for _ in range(3):
+            await mgr.wait_idle(timeout=20)
+            await asyncio.sleep(0.05)
+        nb = await kube.get("Notebook", "train", "ns")
+        telem = deep_get(nb, "status", "tpu", "telemetry")
+        assert telem["stale"] is True
+        # The stale window never reaches the ledger.
+        assert sched.policy.efficiency.explain("ns/train")[
+            "gang_samples"] == 1
+    finally:
+        await mgr.stop()
+        kube.close_watches()
