@@ -3092,16 +3092,21 @@ def elastic_fleet(smoke: bool = False) -> dict:
 
 def inference_serving(smoke: bool = False) -> dict:
     """`bench.py inference_serving [--smoke]` — the serving workload
-    class acceptance gate (ISSUE 11). Two halves:
+    class acceptance gate (ISSUE 11, grown to the v2 engine in ISSUE
+    19). Two halves:
 
-    - **data plane** (in-process JAX): the continuous-batching serving
-      engine under a seeded, trace-driven OPEN-LOOP load generator —
-      arrivals never wait for completions, so overload shows up as p99
-      queueing, like production. Reports tokens/sec, p50/p99 latency,
-      batch occupancy, and the scale-from-zero story's core numbers:
-      cold start (init + compile) vs warm restore of a parked standby
-      (device transfer through the retained compiled fn). Gates on the
-      warm restore being measurably faster.
+    - **data plane** (in-process JAX): the serving engine v2 (paged
+      KV-cache + chunked prefill + multi-model warm standbys) under a
+      seeded, trace-driven OPEN-LOOP load generator at **10× the PR 11
+      trace rate** — arrivals never wait for completions, so overload
+      shows up as p99 queueing, like production. Gates on: every
+      request completing at the 10× rate; zero KV-block accounting
+      violations under a seeded fault storm AND a tiny-pool pressure
+      serve (backpressure = queue wait, never OOM, never oversell);
+      chunked prefill keeping decode p99 no worse than the
+      head-of-line run-to-completion baseline (paired trials on the
+      same long-prompt trace); a warm model swap ≥3× faster than cold
+      init+compile; and the PR 11 warm-vs-cold park gate.
     - **control plane** (FakeKube + podsim + the real manager/scheduler/
       serving-controller stack): an InferenceService scales 0 → N → 0 →
       1 against the SAME chip ledger as contending notebook gangs.
@@ -3133,13 +3138,142 @@ def inference_serving(smoke: bool = False) -> dict:
         ServingOptions,
         setup_serving_controller,
     )
-    from kubeflow_tpu.serving.engine import ServingEngine
-    from kubeflow_tpu.serving.loadgen import burst_trace
+    from kubeflow_tpu.serving.engine import (
+        EngineOptions,
+        Request,
+        ServingEngine,
+    )
+    from kubeflow_tpu.serving.kvcache import KVBlockPool
+    from kubeflow_tpu.serving.loadgen import Phase, burst_trace, generate_trace
     from kubeflow_tpu.testing.fakekube import FakeKube
     from kubeflow_tpu.testing.podsim import PodSimulator
     from kubeflow_tpu.webhooks import register_all
 
     # ---- data plane -----------------------------------------------------------
+
+    # PR 11's burst rate was 40 req/s; the v2 acceptance bar is ≥10×.
+    PR11_BURST_RATE = 40.0
+    V2_BURST_RATE = 400.0
+
+    small_cfg = BurninConfig(vocab=128, d_model=64, n_heads=2, n_layers=1,
+                             d_ff=128, seq_len=64)
+
+    def kv_fault_storm() -> dict:
+        """Seeded adversarial op stream straight at the block pool:
+        admits, releases, double-releases, unknown-rid releases and
+        oversized admits, interleaved in random order. The pool must
+        reject (never oversell), stay internally consistent, and end
+        with zero accounting violations."""
+        import random as _random
+
+        pool = KVBlockPool(32, block_size=8)
+        rng = _random.Random(31)
+        live: list = []
+        ops = 600 if smoke else 3000
+        for i in range(ops):
+            roll = rng.random()
+            if roll < 0.50:
+                table = pool.admit(i, rng.randint(0, 64),
+                                   rng.randint(1, 16))
+                if table is not None:
+                    live.append(i)
+            elif roll < 0.75 and live:
+                pool.release(live.pop(rng.randrange(len(live))))
+            elif roll < 0.90:
+                # Hostile: double-release / release of a rid the pool
+                # never admitted. Must be an idempotent no-op.
+                pool.release(rng.randint(-ops, ops))
+            else:
+                # Hostile: worst-case need larger than the whole pool.
+                pool.admit(-i - 1, 10_000, 10_000)
+            if i % 50 == 0:
+                pool.assert_consistent()
+        for rid in live:
+            pool.release(rid)
+        pool.assert_consistent()
+        return {
+            "ops": ops,
+            "rejections": pool.rejections,
+            "violations": pool.violations,
+            "leaked_blocks": pool.used_blocks,
+        }
+
+    def kv_pressure_serve() -> dict:
+        """A pool far too small for the offered burst: admission must
+        backpressure into queue wait — every request still completes,
+        rejections are counted, and the accounting never oversells."""
+        engine = ServingEngine(
+            small_cfg, max_batch=4, use_mesh=False,
+            options=EngineOptions(kv_blocks=6, kv_block_size=8))
+        engine.cold_start(seed=0)
+        trace = generate_trace(
+            [Phase(0.3, 200.0)], seed=21, tokens_out=10, tokens_jitter=4)
+        report = engine.serve(trace)
+        engine.kv.assert_consistent()
+        return {
+            "requests": len(trace),
+            "completed": len(report.completions),
+            "kv_blocks": engine.kv.total_blocks,
+            "rejections": engine.kv.rejections,
+            "violations": engine.kv.violations,
+            "peak_pressure": round(report.kv_peak_pressure, 3),
+            "p99_queue_wait_sec": round(sorted(
+                c.queue_wait for c in report.completions)[
+                    max(0, int(0.99 * len(report.completions)) - 1)], 4),
+        }
+
+    def chunked_vs_hol() -> dict:
+        """Paired trials on the SAME long-prompt collision: a batch of
+        decode requests is mid-flight when a very long prompt lands on
+        the prefill lane. Head-of-line runs the prefill to completion
+        — every admitted decode freezes for the full chunk count —
+        while chunked prefill interleaves one chunk per decode
+        iteration. Decode service p99 (started → finished; queue wait
+        is shared fate under either policy) must stay bounded."""
+        import random as _random
+
+        opts = dict(kv_blocks=1024, kv_block_size=16, prefill_chunk=32)
+        eng_chunked = ServingEngine(
+            small_cfg, max_batch=4, use_mesh=False,
+            options=EngineOptions(chunked_prefill=True, **opts))
+        eng_hol = ServingEngine(
+            small_cfg, max_batch=4, use_mesh=False,
+            options=EngineOptions(chunked_prefill=False, **opts))
+        eng_chunked.cold_start(seed=0)
+        eng_hol.cold_start(seed=0)
+        pairs = []
+        for k in range(2 if smoke else 3):
+            rng = _random.Random(41 + k)
+            # Three decodes admitted at t=0, the long prompt right
+            # behind them (FIFO admits the decodes first), stragglers
+            # arriving while the prefill is in flight.
+            trace = sorted(
+                [Request(rid=i, arrival=0.0,
+                         tokens_out=rng.randint(48, 80))
+                 for i in range(3)]
+                + [Request(rid=3, arrival=0.0, tokens_out=4,
+                           prompt_tokens=32 * rng.randint(80, 120))]
+                + [Request(rid=4 + j, arrival=0.005 * (1 + j),
+                           tokens_out=rng.randint(24, 48))
+                   for j in range(2)],
+                key=lambda r: (r.arrival, r.rid))
+            # Alternate order across pairs so machine drift cancels.
+            first, second = ((eng_chunked, eng_hol) if k % 2 == 0
+                             else (eng_hol, eng_chunked))
+            r1 = first.serve(trace)
+            r2 = second.serve(trace)
+            rc, rh = (r1, r2) if first is eng_chunked else (r2, r1)
+            pairs.append({
+                "chunked_decode_p99": round(
+                    rc.decode_service_percentile(0.99), 4),
+                "hol_decode_p99": round(
+                    rh.decode_service_percentile(0.99), 4),
+                "prefill_chunks": rc.prefill_chunks,
+            })
+        wins = sum(1 for p in pairs
+                   if p["chunked_decode_p99"]
+                   <= p["hol_decode_p99"] * 1.05)
+        return {"pairs": pairs, "wins": wins, "trials": len(pairs)}
 
     def data_plane() -> dict:
         engine = ServingEngine(
@@ -3147,13 +3281,33 @@ def inference_serving(smoke: bool = False) -> dict:
                          d_ff=512, seq_len=128),
             max_batch=8)
         cold_sec = engine.cold_start(seed=0)
+
+        # Multi-model multiplexing: two more models behind the same
+        # replica. Cold-load both once (init + compile, measured), then
+        # swap back to the default — a warm swap off the host-resident
+        # standby through the retained compiled fns. The ≥3× gate is
+        # the reason warm standbys exist.
+        engine.register_model("alt-a")
+        engine.register_model("alt-b")
+        engine.use_model("alt-a")
+        engine.use_model("alt-b")       # LRU-demotes "default" to host
+        engine.use_model("default")     # warm swap back
+        cold_model_sec = max(engine.models.entry("alt-a").cold_init_sec,
+                             engine.models.entry("alt-b").cold_init_sec)
+        warm_swap_sec = engine.models.entry("default").warm_swap_sec
+
+        # The headline trace: 10× PR 11's rates, with a prompt mix and
+        # a weighted model mix riding the same seeded open loop.
         trace = burst_trace(
-            seed=11, warm_rate=4.0, burst_rate=40.0,
-            warm_sec=0.5 if smoke else 1.5,
-            burst_sec=0.5 if smoke else 2.0,
-            cool_sec=0.25 if smoke else 0.5,
-            tokens_out=8, tokens_jitter=4)
+            seed=11, warm_rate=40.0, burst_rate=V2_BURST_RATE,
+            warm_sec=0.25 if smoke else 1.0,
+            burst_sec=0.25 if smoke else 1.0,
+            cool_sec=0.1 if smoke else 0.5,
+            tokens_out=8, tokens_jitter=4,
+            long_prompt_frac=0.05, long_prompt_tokens=96,
+            models={"default": 18, "alt-a": 1, "alt-b": 1})
         report = engine.serve(trace)
+        engine.kv.assert_consistent()
         ckpt = engine.park()
         warm_sec = engine.warm_restore()
         # Serve again off the restored standby: the restore must yield a
@@ -3169,11 +3323,27 @@ def inference_serving(smoke: bool = False) -> dict:
             "p99_latency_sec": round(report.latency_percentile(0.99), 4),
             "batch_occupancy": round(report.batch_occupancy, 2),
             "decode_steps": report.steps,
+            "prefill_chunks": report.prefill_chunks,
+            "model_swaps": report.model_swaps,
+            "kv_peak_pressure": round(report.kv_peak_pressure, 3),
+            "kv_violations": engine.kv.violations,
+            "trace_burst_rate": V2_BURST_RATE,
+            "rate_multiplier_vs_pr11": round(
+                V2_BURST_RATE / PR11_BURST_RATE, 1),
             "cold_start_sec": round(cold_sec, 4),
             "warm_restore_sec": round(warm_sec, 4),
             "warm_speedup": round(cold_sec / max(warm_sec, 1e-9), 1),
             "parked_checkpoint": ckpt,
             "replay_completed": len(replay.completions),
+            "model_swap": {
+                "cold_init_sec": round(cold_model_sec, 4),
+                "warm_swap_sec": round(warm_swap_sec, 4),
+                "warm_vs_cold": round(
+                    cold_model_sec / max(warm_swap_sec, 1e-9), 1),
+            },
+            "kv_fault_storm": kv_fault_storm(),
+            "kv_pressure": kv_pressure_serve(),
+            "chunked_prefill": chunked_vs_hol(),
         }
 
     # ---- control plane --------------------------------------------------------
@@ -3405,6 +3575,20 @@ def inference_serving(smoke: bool = False) -> dict:
         dp["completed"] == dp["requests"]
         and dp["replay_completed"] > 0
         and dp["warm_restore_sec"] < dp["cold_start_sec"]
+        # ---- serving engine v2 gates (ISSUE 19) ----
+        and dp["rate_multiplier_vs_pr11"] >= 10.0
+        and dp["kv_violations"] == 0
+        and dp["kv_fault_storm"]["violations"] == 0
+        and dp["kv_fault_storm"]["leaked_blocks"] == 0
+        and dp["kv_fault_storm"]["rejections"] > 0
+        and dp["kv_pressure"]["completed"] == dp["kv_pressure"]["requests"]
+        and dp["kv_pressure"]["violations"] == 0
+        and dp["kv_pressure"]["rejections"] > 0
+        and dp["chunked_prefill"]["wins"] * 2
+        > dp["chunked_prefill"]["trials"]
+        and dp["model_swap"]["cold_init_sec"]
+        >= 3.0 * dp["model_swap"]["warm_swap_sec"]
+        and dp["model_swaps"] >= 1
         and cp["idle_notebook_drains"] >= 1
         and cp["contender_queued_during_burst"]
         and cp["parked"]

@@ -17,6 +17,7 @@ from ci.analysis.passes import (  # noqa: F401
     ownership,
     patchshape,
     raisepath,
+    servingv2,
     shardsafety,
     sloreg,
     swallow,

@@ -1,0 +1,148 @@
+"""``serving-engine-v2``: admission and swap have exactly one door (ISSUE 19).
+
+The serving data plane's two safety properties are both "everything
+routes through the choke point" contracts, which makes them exactly the
+kind of thing a refactor erodes silently:
+
+- **No KV bypass**: a request reaches a prefill or decode lane only
+  through :meth:`ServingEngine._admit_next`, which gates the grant on
+  ``KVBlockPool.admit`` (the worst-case all-or-nothing reservation).
+  A second ``admit`` call site — or a hand-built ``BlockTable`` — is a
+  lane allocation that skips cache-pressure admission: the exact path
+  back to mid-decode OOM the paged cache exists to kill.
+- **No swap bypass**: the engine changes models only through
+  ``ModelRegistry.activate`` (via ``_activate_model``), the single door
+  that keeps the warm-standby accounting honest (host-resident weights,
+  cached compiled fns, LRU device budget). A direct ``init_params``
+  outside the registry's cold loader is a cold start the swap metrics
+  and the ≥3× warm-swap bench gate can't see.
+
+The pool itself must keep its invariant surface: ``admit`` /
+``release`` / ``assert_consistent`` and the
+``tpu_serving_kv_blocks_{used,total}`` gauges the runbook alerts on.
+"""
+
+from __future__ import annotations
+
+from ci.analysis.core import Finding, Project, analysis_pass
+from ci.analysis.passes.contracts import (
+    calls_to,
+    find_def,
+    has_identifier,
+    has_str_literal,
+)
+
+RULE = "serving-engine-v2"
+
+ENGINE_FILE = "kubeflow_tpu/serving/engine.py"
+KVCACHE_FILE = "kubeflow_tpu/serving/kvcache.py"
+
+
+def _missing(project: Project, relpath: str, why: str) -> list[Finding]:
+    if not project.full_tree:
+        return []
+    anchor = project.files[0].path if project.files else relpath
+    return [Finding(rule=RULE, path=anchor, line=1,
+                    message=f"{relpath}: missing — {why}")]
+
+
+@analysis_pass(
+    "servingv2", (RULE,),
+    "serving lane grants must route through the KV block allocator's "
+    "admission (no BlockTable bypass) and model swaps through the "
+    "warm-standby registry's activate (no bare init_params)")
+def check_serving_v2(project: Project):
+    kv = project.get(KVCACHE_FILE)
+    if kv is None or kv.tree is None:
+        yield from _missing(project, KVCACHE_FILE,
+                            "the paged KV-cache owns lane admission "
+                            "(ISSUE 19)")
+    else:
+        for needed in ("admit", "release", "assert_consistent"):
+            if find_def(kv.tree, needed) is None:
+                yield Finding(
+                    rule=RULE, path=kv.path, line=1,
+                    message=f"KVBlockPool.{needed} is gone — the block "
+                            "pool lost its admission/accounting surface")
+        for gauge in ("tpu_serving_kv_blocks_used",
+                      "tpu_serving_kv_blocks_total"):
+            if not has_str_literal(kv.tree, gauge):
+                yield Finding(
+                    rule=RULE, path=kv.path, line=1,
+                    message=f"the `{gauge}` gauge is gone — KV pressure "
+                            "is invisible to the runbook's alerts")
+
+    eng = project.get(ENGINE_FILE)
+    if eng is None or eng.tree is None:
+        yield from _missing(project, ENGINE_FILE,
+                            "the serving engine hosts the admission and "
+                            "swap choke points (ISSUE 19)")
+        return
+    admit_def = find_def(eng.tree, "_admit_next")
+    admits_everywhere = calls_to(eng.tree, "admit")
+    if admit_def is None or not calls_to(admit_def, "admit"):
+        yield Finding(
+            rule=RULE, path=eng.path,
+            line=admit_def.lineno if admit_def else 1,
+            message="_admit_next no longer gates lane grants on "
+                    "KVBlockPool.admit — requests reach batch slots "
+                    "without a worst-case KV reservation")
+    elif len(admits_everywhere) != len(calls_to(admit_def, "admit")):
+        extra = [c for c in admits_everywhere
+                 if c not in calls_to(admit_def, "admit")]
+        yield Finding(
+            rule=RULE, path=eng.path, line=extra[0].lineno,
+            message="a lane allocation calls the block allocator "
+                    "outside _admit_next — admission decisions must "
+                    "have exactly one door so cache pressure cannot "
+                    "be bypassed")
+    if calls_to(eng.tree, "BlockTable"):
+        yield Finding(
+            rule=RULE, path=eng.path,
+            line=calls_to(eng.tree, "BlockTable")[0].lineno,
+            message="the engine hand-builds a BlockTable — blocks must "
+                    "come from KVBlockPool.admit or the pool's "
+                    "accounting (and the no-oversell invariant) is "
+                    "fiction")
+    if not calls_to(eng.tree, "release"):
+        yield Finding(
+            rule=RULE, path=eng.path, line=1,
+            message="the engine never releases KV blocks — finished "
+                    "requests would leak the pool empty")
+
+    swap_def = find_def(eng.tree, "_activate_model")
+    activates = calls_to(eng.tree, "activate")
+    in_swap = calls_to(swap_def, "activate") if swap_def else []
+    if swap_def is None or not in_swap:
+        yield Finding(
+            rule=RULE, path=eng.path,
+            line=swap_def.lineno if swap_def else 1,
+            message="_activate_model no longer routes through "
+                    "ModelRegistry.activate — model swaps bypass the "
+                    "warm-standby registry")
+    elif len(activates) != len(in_swap):
+        extra = [c for c in activates if c not in in_swap]
+        yield Finding(
+            rule=RULE, path=eng.path, line=extra[0].lineno,
+            message="a model swap calls activate outside "
+                    "_activate_model — the engine's swap path must "
+                    "have exactly one door")
+    registry_def = find_def(eng.tree, "activate")
+    if registry_def is None or not has_identifier(registry_def,
+                                                  "host_params"):
+        yield Finding(
+            rule=RULE, path=eng.path,
+            line=registry_def.lineno if registry_def else 1,
+            message="ModelRegistry.activate lost the warm-standby path "
+                    "(host_params) — every swap would be a cold "
+                    "init+compile and the ≥3× warm-swap gate is dead")
+    cold_def = find_def(eng.tree, "_load_cold")
+    inits = calls_to(eng.tree, "init_params")
+    in_cold = calls_to(cold_def, "init_params") if cold_def else []
+    if inits and len(inits) != len(in_cold):
+        extra = [c for c in inits if c not in in_cold]
+        yield Finding(
+            rule=RULE, path=eng.path, line=extra[0].lineno,
+            message="the engine cold-initializes weights outside "
+                    "ModelRegistry._load_cold — a model load the "
+                    "registry (and the swap metrics) cannot see")

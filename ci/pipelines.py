@@ -157,10 +157,14 @@ def workflow_tests() -> dict:
                         "1 on gate failure)",
                         "python bench.py elastic_fleet --smoke",
                         env=VIRTUAL_MESH_ENV),
-                    run("Inference-serving smoke bench (open-loop "
-                        "tokens/sec + p99, warm standby vs cold start, "
-                        "serving/notebook admission collision; exit 1 "
-                        "on gate failure)",
+                    run("Inference-serving smoke bench (serving engine "
+                        "v2: open-loop tokens/sec + p99 at 10x the PR "
+                        "11 trace rate, paged KV-cache accounting under "
+                        "a seeded fault storm, chunked-prefill vs "
+                        "head-of-line paired trials, warm model swap "
+                        ">=3x cold init+compile, warm standby vs cold "
+                        "start, serving/notebook admission collision; "
+                        "exit 1 on gate failure)",
                         "python bench.py inference_serving --smoke",
                         env=VIRTUAL_MESH_ENV),
                     run("SLO-engine overhead gate (paired A/B trials: "

@@ -95,8 +95,39 @@ FLEX_POOL_ANNOTATION_PREFIX = keys.SERVING_FLEX_POOL_PREFIX
 # protocol, never the other way around).
 PRIORITY_ANNOTATION = keys.SERVING_PRIORITY
 
+# Serving engine v2 (ISSUE 19) data-plane surfaces: KV-cache shortfall,
+# in-flight model swap (+ warm/cold kind), and the per-model observed
+# rate breakdown — stamped by the gateway from the engine's debug
+# payload, read by the controller's status fold and the autoscaler.
+KV_BLOCKS_SHORT_ANNOTATION = keys.SERVING_KV_BLOCKS_SHORT
+MODEL_SWAP_ANNOTATION = keys.SERVING_MODEL_SWAP
+MODEL_SWAP_WARM_ANNOTATION = keys.SERVING_MODEL_SWAP_WARM
+MODEL_RATE_ANNOTATION_PREFIX = keys.SERVING_MODEL_RATE_PREFIX
+
 SERVICE_PORT = 80
 DEFAULT_CONTAINER_PORT = 8000
+
+
+def model_rates(annotations: dict) -> dict:
+    """Parse the per-model observed-rate annotations
+    (``model-rate-<model>: <req/s>``) into ``{model: rate}`` — the
+    multiplexing load breakdown. Unparseable values are dropped, not
+    raised: load annotations are gateway-stamped wire data."""
+    rates: dict = {}
+    prefix = MODEL_RATE_ANNOTATION_PREFIX
+    for key, raw in (annotations or {}).items():
+        if not key.startswith(prefix):
+            continue
+        model = key[len(prefix):]
+        if not model:
+            continue
+        try:
+            value = float(raw)
+        except (TypeError, ValueError):
+            continue
+        if value >= 0:
+            rates[model] = value
+    return rates
 
 
 def new(

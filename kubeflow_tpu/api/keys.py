@@ -142,6 +142,20 @@ SERVING_PARK_CHECKPOINT_FOR = "serving.kubeflow.org/parked-checkpoint-for"
 SERVING_FLEX_POOL_PREFIX = "serving.kubeflow.org/flex-pool-r"
 SERVING_PRIORITY = "serving.kubeflow.org/priority"
 
+# Serving engine v2 (ISSUE 19): data-plane pressure + multiplexing
+# surfaces. ``kv-blocks-short`` is the head-of-queue KV-cache shortfall
+# the gateway stamps from the engine's debug payload (the k the JWA
+# renders as "Queued behind KV-cache pressure (k blocks short)").
+# ``model-swap`` carries the model id mid-swap and ``model-swap-warm``
+# whether it comes from a warm standby (device transfer) or a cold
+# init+compile. ``model-rate-<model>`` is the per-model observed
+# request rate — the multiplexing load breakdown the autoscaler sums
+# when the aggregate rate annotation is missing and the JWA shows.
+SERVING_KV_BLOCKS_SHORT = "serving.kubeflow.org/kv-blocks-short"
+SERVING_MODEL_SWAP = "serving.kubeflow.org/model-swap"
+SERVING_MODEL_SWAP_WARM = "serving.kubeflow.org/model-swap-warm"
+SERVING_MODEL_RATE_PREFIX = "serving.kubeflow.org/model-rate-"
+
 # ---- sharding.kubeflow.org: shard ring rebalance protocol (ISSUE 17) ---------
 #
 # Stamped on a shard's Lease (metadata.annotations) by a replica whose
@@ -299,5 +313,9 @@ OWNERS: dict[str, tuple[str, ...]] = {
     SERVING_PARK_CHECKPOINT_FOR: ("kubeflow_tpu/serving/",),
     SERVING_FLEX_POOL_PREFIX: ("kubeflow_tpu/serving/",),
     SERVING_PRIORITY: ("kubeflow_tpu/serving/",),
+    SERVING_KV_BLOCKS_SHORT: ("kubeflow_tpu/serving/",),
+    SERVING_MODEL_SWAP: ("kubeflow_tpu/serving/",),
+    SERVING_MODEL_SWAP_WARM: ("kubeflow_tpu/serving/",),
+    SERVING_MODEL_RATE_PREFIX: ("kubeflow_tpu/serving/",),
     SHARD_PREFERRED_CLAIM: ("kubeflow_tpu/runtime/sharding",),
 }

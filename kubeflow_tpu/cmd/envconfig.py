@@ -200,6 +200,29 @@ def serving_options():
         default_idle_window=env_float("KFTPU_SERVING_IDLE_WINDOW", 300.0),
         default_stabilization=env_float(
             "KFTPU_SERVING_STABILIZATION", 60.0),
+        # SLO-driven autoscaling kill switch: off = the raw
+        # rate/concurrency policy byte-for-byte, even with KFTPU_SLO on.
+        slo_autoscale=env_bool("KFTPU_SERVING_SLO_AUTOSCALE", True),
+    )
+
+
+def serving_engine_options():
+    """Serving data-plane (engine v2) env contract — the paged
+    KV-cache pool, chunked-prefill lane, and model-multiplex knobs
+    (docs/operations.md "Serving engine v2"). KFTPU_SERVING_KV_BLOCKS=0
+    (the default) sizes the pool from max_batch × seq_len."""
+    from kubeflow_tpu.serving.engine import EngineOptions
+
+    kv_blocks = int(env_float("KFTPU_SERVING_KV_BLOCKS", 0))
+    return EngineOptions(
+        kv_blocks=kv_blocks if kv_blocks > 0 else None,
+        kv_block_size=max(1, int(env_float(
+            "KFTPU_SERVING_KV_BLOCK_SIZE", 16))),
+        prefill_chunk=max(1, int(env_float(
+            "KFTPU_SERVING_PREFILL_CHUNK", 32))),
+        chunked_prefill=env_bool("KFTPU_SERVING_CHUNKED_PREFILL", True),
+        max_resident_models=max(1, int(env_float(
+            "KFTPU_SERVING_MAX_MODELS", 2))),
     )
 
 

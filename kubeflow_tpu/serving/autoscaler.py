@@ -19,6 +19,14 @@ property-test it under seeded random traffic without an event loop:
   ``scale_to_zero_after_seconds`` — an idle *window*, not an idle
   sample. A service that has never seen a request idles from
   ``created_at``.
+- **SLO-driven scaling (v2, ISSUE 19)**: when the SLO engine is on,
+  ``Signals.burn_rate`` carries the ``serving_latency`` error-budget
+  burn rate and the policy scales to protect the *objective*, not a
+  proxy: critical burn forces an aggressive step-up, warning burn adds
+  a replica, any burn above budget blocks scale-down. With
+  ``burn_rate=None`` (``KFTPU_SLO`` off) every code path below is
+  byte-for-byte the raw rate/concurrency policy — the kill-switch test
+  pins that.
 
 The ledger is deliberately not consulted here: the fleet scheduler owns
 chips. The autoscaler says how many replicas the service *wants*; each
@@ -46,6 +54,12 @@ class AutoscalerConfig:
     # Scale-down hold: the recommendation may only drop once it has been
     # below the current count for this long.
     scale_down_stabilization_seconds: float = 60.0
+    # SLO-driven scaling thresholds over the serving_latency error
+    # budget — literals deliberately mirror runtime/slo.py's paging
+    # calibration (CRITICAL_BURN / WARNING_BURN) without importing it:
+    # this module stays pure and dependency-free for property tests.
+    burn_critical: float = 14.4
+    burn_warning: float = 6.0
 
     def __post_init__(self):
         if self.min_replicas < 0:
@@ -63,6 +77,10 @@ class Signals:
     rate: float = 0.0              # requests/sec (EWMA)
     inflight: float = 0.0          # concurrent requests right now
     last_request_at: float | None = None   # epoch seconds; None = never
+    # serving_latency error-budget burn rate from the SLO engine's fast
+    # window, or None when KFTPU_SLO is off. None keeps the decision
+    # function byte-for-byte the raw rate/concurrency policy.
+    burn_rate: float | None = None
 
 
 @dataclass
@@ -91,6 +109,26 @@ def _demand(cfg: AutoscalerConfig, signals: Signals) -> int:
     return int(math.ceil(need - 1e-9)) if need > 0 else 0
 
 
+def _slo_demand(cfg: AutoscalerConfig, signals: Signals,
+                current: int) -> int | None:
+    """SLO-driven demand overlay: how many replicas the burn rate says
+    we need, or ``None`` when the SLO signal is absent or the budget is
+    healthy (burn <= 1 means the objective is being met — the raw
+    policy decides alone, including scale-down)."""
+    burn = signals.burn_rate
+    if burn is None or burn <= 1.0:
+        return None
+    if burn >= cfg.burn_critical:
+        # Paging-grade burn: step up hard (+50%, at least one replica)
+        # — waiting for the rate signal to catch up is how p99 SLOs die.
+        return current + max(1, math.ceil(current * 0.5))
+    if burn >= cfg.burn_warning:
+        return current + 1
+    # Budget burning but below warning: hold the line — never scale
+    # down while the objective is losing ground.
+    return current
+
+
 def desired_replicas(cfg: AutoscalerConfig, signals: Signals,
                      current: int, now: float,
                      state: AutoscalerState | None = None) -> Decision:
@@ -98,13 +136,16 @@ def desired_replicas(cfg: AutoscalerConfig, signals: Signals,
     state); mutates only ``state`` (the trailing window)."""
     state = state if state is not None else AutoscalerState(created_at=now)
     raw = _demand(cfg, signals)
+    slo_need = _slo_demand(cfg, signals, current)
+    demand = raw if slo_need is None else max(raw, slo_need)
+    slo_driven = demand > raw      # the SLO overlay raised the ask
     floor = cfg.min_replicas
     # Any live demand keeps at least one replica even at min_replicas=0
     # — scale-to-zero is the stricter gate below, never a side effect of
     # a rate rounding to zero replicas.
-    if raw > 0:
+    if demand > 0:
         floor = max(floor, 1)
-    bounded = max(floor, min(cfg.max_replicas, max(raw, floor)))
+    bounded = max(floor, min(cfg.max_replicas, max(demand, floor)))
 
     # Trailing-window stabilization: remember this sample, drop expired
     # ones, and never scale below the window's high-water mark.
@@ -115,9 +156,20 @@ def desired_replicas(cfg: AutoscalerConfig, signals: Signals,
 
     if bounded >= current:
         if bounded > current:
-            return Decision(bounded, raw, "scale-up: demand "
-                            f"{raw} replica(s)")
-        return Decision(current, raw, "steady")
+            if slo_driven:
+                # Stable strings (no live burn number): these land in
+                # status under write-elision, same as the hold reasons.
+                kind = ("critical"
+                        if signals.burn_rate >= cfg.burn_critical
+                        else "warning")
+                return Decision(bounded, demand, "scale-up: "
+                                f"serving_latency burn-rate {kind} (SLO)")
+            return Decision(bounded, demand, "scale-up: demand "
+                            f"{demand} replica(s)")
+        if slo_driven and raw < current:
+            return Decision(current, demand,
+                            "hold: serving_latency burn above budget (SLO)")
+        return Decision(current, demand, "steady")
 
     # Candidate scale-down. Zero is gated separately and harder.
     target = max(bounded, min(hold, current))
@@ -125,7 +177,7 @@ def desired_replicas(cfg: AutoscalerConfig, signals: Signals,
         last = signals.last_request_at
         idle_since = last if last is not None else state.created_at
         if signals.inflight > 0 or signals.rate > 0:
-            return Decision(max(current, 1), raw,
+            return Decision(max(current, 1), demand,
                             "hold: live traffic blocks scale-to-zero")
         if now - idle_since < cfg.scale_to_zero_after_seconds:
             # Reason strings land in status and must stay STABLE while
@@ -133,15 +185,15 @@ def desired_replicas(cfg: AutoscalerConfig, signals: Signals,
             # would defeat the controller's status write-elision and
             # patch the CR every pass for the whole idle window.
             return Decision(max(current if current > 0 else 1,
-                                max(floor, 1)), raw,
+                                max(floor, 1)), demand,
                             "hold: inside the scale-to-zero idle window "
                             f"({cfg.scale_to_zero_after_seconds:.0f}s)")
-        return Decision(0, raw, "scale-to-zero: idle past the window")
+        return Decision(0, demand, "scale-to-zero: idle past the window")
     if target < current:
-        return Decision(target, raw,
+        return Decision(target, demand,
                         f"scale-down (stabilized over "
                         f"{cfg.scale_down_stabilization_seconds:.0f}s)")
-    return Decision(current, raw, "hold: stabilization window")
+    return Decision(current, demand, "hold: stabilization window")
 
 
 def config_from_spec(scaling: dict, *,

@@ -35,6 +35,7 @@ from typing import Callable
 from kubeflow_tpu.api import keys
 from kubeflow_tpu.api import inferenceservice as isvcapi
 from kubeflow_tpu.migration import protocol as migration
+from kubeflow_tpu.runtime import slo
 from kubeflow_tpu.runtime.apply import ApplyCache, informer_reader, reconcile_child
 from kubeflow_tpu.runtime.errors import ApiError, Invalid, NotFound
 from kubeflow_tpu.runtime.events import EventRecorder
@@ -96,6 +97,11 @@ class ServingOptions:
     default_target_rate: float = 8.0
     default_idle_window: float = 300.0
     default_stabilization: float = 60.0
+    # SLO-driven autoscaling (ISSUE 19): feed the serving_latency
+    # burn rate into the autoscaler when the SLO engine is installed
+    # and enabled. Kill switch: off restores the raw rate/concurrency
+    # policy byte-for-byte (KFTPU_SERVING_SLO_AUTOSCALE).
+    slo_autoscale: bool = True
 
 
 class InferenceServiceReconciler:
@@ -178,13 +184,21 @@ class InferenceServiceReconciler:
             default_target_rate=self.opts.default_target_rate,
             default_idle_window=self.opts.default_idle_window,
             default_stabilization=self.opts.default_stabilization)
+        rate = _safe_float(annotations.get(
+            isvcapi.OBSERVED_RATE_ANNOTATION))
+        per_model = isvcapi.model_rates(annotations)
+        if per_model:
+            # The multiplexing breakdown is also a load signal: a
+            # gateway that only stamps per-model rates still scales the
+            # service (and a stale aggregate never UNDER-counts it).
+            rate = max(rate, sum(per_model.values()))
         signals = Signals(
-            rate=_safe_float(annotations.get(
-                isvcapi.OBSERVED_RATE_ANNOTATION)),
+            rate=rate,
             inflight=_safe_float(annotations.get(
                 isvcapi.OBSERVED_INFLIGHT_ANNOTATION)),
             last_request_at=parse_iso(annotations.get(
-                isvcapi.LAST_REQUEST_AT_ANNOTATION) or ""))
+                isvcapi.LAST_REQUEST_AT_ANNOTATION) or ""),
+            burn_rate=self._serving_burn_rate())
         state = self._states.get(skey)
         if state is None:
             created = parse_iso(
@@ -235,6 +249,23 @@ class InferenceServiceReconciler:
                 isvc, ms, desired=desired, admitted=admitted,
                 queued=queued, decision=decision, parked=parked)
         return soonest(requeue, park_requeue)
+
+    def _serving_burn_rate(self) -> float | None:
+        """The serving_latency error-budget burn rate from the process
+        SLO engine's fast window, or None when SLO-driven autoscaling
+        is off (kill switch) or no enabled engine is installed — None
+        keeps the autoscaler byte-for-byte the raw-signal policy."""
+        if not self.opts.slo_autoscale:
+            return None
+        engine = slo.current()
+        if engine is None or not engine.enabled:
+            return None
+        try:
+            # The engine's own clock, not ours: observations were
+            # stamped on it, and the two can differ under test clocks.
+            return engine.burn_rate("serving_latency", "5m")
+        except KeyError:
+            return None
 
     # ---- scale up / steady -------------------------------------------------------
 
@@ -804,6 +835,28 @@ class InferenceServiceReconciler:
                 "path": ckpt[0],
                 **({"step": ckpt[1]} if ckpt[1] is not None else {}),
             }
+        # Engine-v2 data-plane surfaces (ISSUE 19), folded from the
+        # gateway-stamped annotations so the JWA reads one place: the
+        # KV-cache shortfall behind the head of the queue, an in-flight
+        # model swap (warm standby vs cold load), and the per-model
+        # load breakdown of a multiplexing replica.
+        ann = annotations_of(isvc)
+        short = int(_safe_float(ann.get(
+            isvcapi.KV_BLOCKS_SHORT_ANNOTATION)))
+        if short > 0:
+            status["serving"]["kvPressure"] = {"blocksShort": short}
+        swapping = (ann.get(isvcapi.MODEL_SWAP_ANNOTATION) or "").strip()
+        if swapping:
+            warm_raw = (ann.get(isvcapi.MODEL_SWAP_WARM_ANNOTATION)
+                        or "").strip().lower()
+            status["serving"]["modelSwap"] = {
+                "model": swapping,
+                "warm": warm_raw in ("1", "true", "yes", "on"),
+            }
+        per_model = isvcapi.model_rates(ann)
+        if per_model:
+            status["serving"]["models"] = {
+                m: round(r, 3) for m, r in sorted(per_model.items())}
         # A successful reconcile clears a manager-stamped quarantine
         # verdict (runtime/manager.py Degraded condition) — without the
         # flip, a released quarantine would show "Reconciliation

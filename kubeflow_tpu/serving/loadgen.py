@@ -11,6 +11,21 @@ A trace is a list of phases, each an (duration, rate) pair; arrivals
 inside a phase are Poisson (exponential gaps) at that rate. The default
 ``burst_trace`` is the scale-from-zero story: silence → burst → cool —
 exactly the shape that exercises park, warm restore, and scale-down.
+
+v2 (ISSUE 19) grows two seeded dimensions so the paged-KV +
+prefill/decode + multi-model engine is drive-able under the same open
+loop:
+
+- **Prompt lengths**: ``prompt_tokens``/``prompt_jitter`` give every
+  request a prompt, and ``long_prompt_frac``/``long_prompt_tokens``
+  mix in a heavy tail (the bimodal short/long mixture that exercises
+  chunked prefill vs head-of-line).
+- **Model ids**: ``models`` is a weighted ``{model_id: weight}``
+  distribution stamped per request (what the gateway would route on).
+
+Both default OFF, and the generator draws from the RNG **only when a
+dimension is enabled** — so an existing seed produces the exact same
+trace it did before this PR (determinism-by-seed is tested both ways).
 """
 
 from __future__ import annotations
@@ -18,7 +33,7 @@ from __future__ import annotations
 import random
 from dataclasses import dataclass
 
-from kubeflow_tpu.serving.engine import Request
+from kubeflow_tpu.serving.engine import DEFAULT_MODEL, Request
 
 
 @dataclass(frozen=True)
@@ -29,12 +44,22 @@ class Phase:
 
 def generate_trace(phases: list, *, seed: int = 0,
                    tokens_out: int = 8,
-                   tokens_jitter: int = 0) -> list:
+                   tokens_jitter: int = 0,
+                   prompt_tokens: int = 0,
+                   prompt_jitter: int = 0,
+                   long_prompt_frac: float = 0.0,
+                   long_prompt_tokens: int = 0,
+                   models: dict | None = None) -> list:
     """Phases → arrival-sorted ``Request`` list. ``tokens_jitter`` adds
     uniform spread around ``tokens_out`` (continuous batching only pays
     off when request lengths differ — a jitter of 0 degenerates to
-    static batching)."""
+    static batching). ``prompt_tokens``/``long_prompt_*`` shape the
+    prefill load; ``models`` weights the model-id mix."""
     rng = random.Random(seed)
+    model_ids, model_weights = (), ()
+    if models:
+        model_ids = tuple(sorted(models))
+        model_weights = tuple(models[m] for m in model_ids)
     requests: list = []
     t = 0.0
     rid = 0
@@ -52,7 +77,18 @@ def generate_trace(phases: list, *, seed: int = 0,
             if tokens_jitter:
                 toks = max(1, tokens_out + rng.randint(-tokens_jitter,
                                                        tokens_jitter))
-            requests.append(Request(rid=rid, arrival=t, tokens_out=toks))
+            prompt = prompt_tokens
+            if long_prompt_frac and rng.random() < long_prompt_frac:
+                prompt = long_prompt_tokens
+            if prompt and prompt_jitter:
+                prompt = max(1, prompt + rng.randint(-prompt_jitter,
+                                                     prompt_jitter))
+            model = DEFAULT_MODEL
+            if model_ids:
+                model = rng.choices(model_ids, weights=model_weights)[0]
+            requests.append(Request(rid=rid, arrival=t, tokens_out=toks,
+                                    prompt_tokens=max(0, prompt),
+                                    model=model))
             rid += 1
     return requests
 
@@ -60,12 +96,16 @@ def generate_trace(phases: list, *, seed: int = 0,
 def burst_trace(*, seed: int = 0, warm_rate: float = 2.0,
                 burst_rate: float = 20.0, warm_sec: float = 2.0,
                 burst_sec: float = 3.0, cool_sec: float = 1.0,
-                tokens_out: int = 8, tokens_jitter: int = 4) -> list:
-    """The canonical bench trace: a trickle, a burst, a cool-down."""
+                tokens_out: int = 8, tokens_jitter: int = 4,
+                **dims) -> list:
+    """The canonical bench trace: a trickle, a burst, a cool-down.
+    Extra keyword dimensions (prompt/model mixes) pass through to
+    :func:`generate_trace`."""
     return generate_trace(
         [Phase(warm_sec, warm_rate), Phase(burst_sec, burst_rate),
          Phase(cool_sec, warm_rate / 2)],
-        seed=seed, tokens_out=tokens_out, tokens_jitter=tokens_jitter)
+        seed=seed, tokens_out=tokens_out, tokens_jitter=tokens_jitter,
+        **dims)
 
 
 def observed_rate(requests: list, now: float, *,
@@ -75,3 +115,17 @@ def observed_rate(requests: list, now: float, *,
     lo = now - window
     n = sum(1 for r in requests if lo < r.arrival <= now)
     return n / window if window > 0 else 0.0
+
+
+def model_load(requests: list, now: float, *,
+               window: float = 1.0) -> dict:
+    """Per-model trailing-window rates at trace time ``now`` — what the
+    gateway stamps into the per-model load annotations the autoscaler
+    and JWA read (the multiplexing signal)."""
+    lo = now - window
+    counts: dict = {}
+    for r in requests:
+        if lo < r.arrival <= now:
+            model = getattr(r, "model", DEFAULT_MODEL)
+            counts[model] = counts.get(model, 0) + 1
+    return {m: n / window for m, n in counts.items()} if window > 0 else {}

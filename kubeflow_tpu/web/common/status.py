@@ -421,6 +421,26 @@ def process_serving_status(isvc: dict) -> Status:
             + "; the first request restores it")
     if state == "Parking":
         return Status(WAITING, "Idle — checkpointing before scale-to-zero…")
+    # Engine-v2 data-plane conditions (ISSUE 19) outrank the steady
+    # states below: a Ready service that is swapping models or queueing
+    # requests behind KV-cache pressure should say so, not "Serving".
+    swap = serving.get("modelSwap") or {}
+    if swap.get("model"):
+        if swap.get("warm"):
+            return Status(
+                WAITING,
+                f"Swapping model {swap['model']} "
+                "(warm standby, weights resident)")
+        return Status(
+            WAITING,
+            f"Swapping model {swap['model']} (cold: init + compile)")
+    kv = serving.get("kvPressure") or {}
+    blocks_short = kv.get("blocksShort") or 0
+    if blocks_short > 0:
+        return Status(
+            WAITING,
+            f"Queued behind KV-cache pressure ({blocks_short} "
+            "blocks short)")
     if state == "Queued":
         return Status(
             WAITING,

@@ -69,3 +69,30 @@ def test_notebook_options_env_round3(monkeypatch):
 
     monkeypatch.setenv("MAINTENANCE_TAINTS", "")
     assert envconfig.notebook_options().maintenance_taints == ()
+
+
+def test_serving_engine_options_env_knobs(monkeypatch):
+    """ISSUE 19: the KFTPU_SERVING_* engine knobs parse into
+    EngineOptions, and KFTPU_SERVING_SLO_AUTOSCALE gates the
+    burn-rate autoscaler input (default on)."""
+    opts = envconfig.serving_engine_options()
+    assert opts.kv_blocks is None          # auto-sized from the model
+    assert opts.kv_block_size == 16
+    assert opts.prefill_chunk == 32
+    assert opts.chunked_prefill is True
+    assert opts.max_resident_models == 2
+    assert envconfig.serving_options().slo_autoscale is True
+
+    monkeypatch.setenv("KFTPU_SERVING_KV_BLOCKS", "128")
+    monkeypatch.setenv("KFTPU_SERVING_KV_BLOCK_SIZE", "8")
+    monkeypatch.setenv("KFTPU_SERVING_PREFILL_CHUNK", "64")
+    monkeypatch.setenv("KFTPU_SERVING_CHUNKED_PREFILL", "false")
+    monkeypatch.setenv("KFTPU_SERVING_MAX_MODELS", "4")
+    monkeypatch.setenv("KFTPU_SERVING_SLO_AUTOSCALE", "false")
+    opts = envconfig.serving_engine_options()
+    assert opts.kv_blocks == 128
+    assert opts.kv_block_size == 8
+    assert opts.prefill_chunk == 64
+    assert opts.chunked_prefill is False
+    assert opts.max_resident_models == 4
+    assert envconfig.serving_options().slo_autoscale is False

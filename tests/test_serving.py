@@ -602,3 +602,153 @@ def test_replica_key_roundtrip():
     assert isvcapi.replica_sts_name("svc", 1) == "svc-r1"
     assert isvcapi.replica_sts_name("svc", 1, slice_id=2,
                                     num_slices=4) == "svc-r1-s2"
+
+
+# ---- serving engine v2 surfaces (ISSUE 19) -----------------------------------
+
+
+def test_loadgen_dims_off_matches_v1_reference():
+    """With the prompt/model dimensions disabled, generate_trace must
+    reproduce the PR 11 generator draw-for-draw — existing seeds (and
+    every recorded bench trace) stay byte-identical."""
+    import random as _random
+
+    from kubeflow_tpu.serving.loadgen import Phase, generate_trace
+
+    phases = [Phase(0.5, 4.0), Phase(0.5, 40.0), Phase(0.2, 2.0)]
+    trace = generate_trace(phases, seed=11, tokens_out=8, tokens_jitter=4)
+
+    rng = _random.Random(11)           # the v1 algorithm, inlined
+    expect, t, rid = [], 0.0, 0
+    for ph in phases:
+        end = t + ph.duration
+        if ph.rate <= 0:
+            t = end
+            continue
+        while True:
+            t += rng.expovariate(ph.rate)
+            if t >= end:
+                t = end
+                break
+            toks = max(1, 8 + rng.randint(-4, 4))
+            expect.append((rid, t, toks))
+            rid += 1
+    assert [(r.rid, r.arrival, r.tokens_out) for r in trace] == expect
+    assert all(r.prompt_tokens == 0 for r in trace)
+    assert len({r.model for r in trace}) == 1
+
+
+def test_loadgen_prompt_and_model_dims_are_seed_deterministic():
+    from kubeflow_tpu.serving.loadgen import Phase, generate_trace
+
+    kw = dict(seed=7, tokens_out=8, tokens_jitter=4, prompt_tokens=16,
+              prompt_jitter=8, long_prompt_frac=0.2,
+              long_prompt_tokens=96, models={"a": 3, "b": 1})
+    t1 = generate_trace([Phase(1.0, 30.0)], **kw)
+    t2 = generate_trace([Phase(1.0, 30.0)], **kw)
+    assert t1 == t2
+    assert {r.model for r in t1} <= {"a", "b"}
+    assert any(r.prompt_tokens >= 88 for r in t1)    # the long tail
+    assert generate_trace([Phase(1.0, 30.0)],
+                          **{**kw, "seed": 8}) != t1
+
+
+def test_loadgen_model_load_windowed_rates():
+    from kubeflow_tpu.serving.engine import Request
+    from kubeflow_tpu.serving.loadgen import model_load
+
+    reqs = [Request(rid=0, arrival=0.2, model="a"),
+            Request(rid=1, arrival=0.6, model="a"),
+            Request(rid=2, arrival=0.9, model="b"),
+            Request(rid=3, arrival=2.0, model="b")]
+    load = model_load(reqs, 1.0, window=1.0)
+    assert load == {"a": 2.0, "b": 1.0}
+
+
+def test_process_serving_status_v2_messages():
+    # KV pressure: queued behind the block pool, with the shortfall.
+    s = process_serving_status(_isvc_with(
+        "Ready", admittedReplicas=1, ready=1,
+        kvPressure={"blocksShort": 3}))
+    assert s.phase == "waiting"
+    assert s.message == "Queued behind KV-cache pressure (3 blocks short)"
+    # Model swap, warm standby vs cold load.
+    s = process_serving_status(_isvc_with(
+        "Ready", admittedReplicas=1, ready=1,
+        modelSwap={"model": "chat-7b", "warm": True}))
+    assert s.message == \
+        "Swapping model chat-7b (warm standby, weights resident)"
+    s = process_serving_status(_isvc_with(
+        "Queued", queuedReplicas=1,
+        modelSwap={"model": "chat-7b", "warm": False}))
+    assert s.message == "Swapping model chat-7b (cold: init + compile)"
+    # Park lifecycle still outranks the data-plane conditions.
+    s = process_serving_status(_isvc_with(
+        "Parking", kvPressure={"blocksShort": 9}))
+    assert "checkpoint" in s.message.lower()
+
+
+async def test_controller_folds_engine_v2_annotations_into_status():
+    async with Harness() as h:
+        await h.kube.create("InferenceService", isvcapi.new(
+            "svc", "user", accelerator="v5e", topology="2x2",
+            min_replicas=1, max_replicas=2, target_rate=8.0))
+        await h.wait_for(lambda: h.replica_admitted(0),
+                         what="replica admission")
+        await h.kube.patch(
+            "InferenceService", "svc",
+            {"metadata": {"annotations": {
+                isvcapi.KV_BLOCKS_SHORT_ANNOTATION: "4",
+                isvcapi.MODEL_SWAP_ANNOTATION: "chat-7b",
+                isvcapi.MODEL_SWAP_WARM_ANNOTATION: "true",
+                isvcapi.MODEL_RATE_ANNOTATION_PREFIX + "chat-7b": "2.5",
+                isvcapi.MODEL_RATE_ANNOTATION_PREFIX + "code-3b": "1.5",
+            }}}, "user")
+
+        deadline = time.monotonic() + 15.0
+        serving = {}
+        while time.monotonic() < deadline:
+            isvc = await h.kube.get("InferenceService", "svc", "user")
+            serving = deep_get(isvc, "status", "serving",
+                               default={}) or {}
+            if (serving.get("kvPressure") == {"blocksShort": 4}
+                    and serving.get("modelSwap") == {"model": "chat-7b",
+                                                     "warm": True}
+                    and serving.get("models") == {"chat-7b": 2.5,
+                                                  "code-3b": 1.5}):
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise AssertionError(f"v2 status never folded: {serving}")
+        assert process_serving_status(isvc).message == \
+            "Swapping model chat-7b (warm standby, weights resident)"
+
+
+def test_model_rates_parser_drops_garbage():
+    ann = {isvcapi.MODEL_RATE_ANNOTATION_PREFIX + "a": "2.5",
+           isvcapi.MODEL_RATE_ANNOTATION_PREFIX + "b": "junk",
+           isvcapi.MODEL_RATE_ANNOTATION_PREFIX + "c": "-1",
+           "serving.kubeflow.org/other": "3"}
+    assert isvcapi.model_rates(ann) == {"a": 2.5}
+
+
+async def test_controller_burn_rate_wiring_and_kill_switch():
+    """The controller feeds the autoscaler the serving_latency burn
+    rate from the installed SLO engine — and feeds None (the raw-path
+    kill switch) when KFTPU_SERVING_SLO_AUTOSCALE is off or no engine
+    is installed."""
+    from kubeflow_tpu.runtime import slo
+
+    async with Harness() as h:
+        # The manager installs the process SLO engine; with no
+        # serving_latency observations yet the burn rate is simply 0.
+        assert slo.current() is h.mgr.slo
+        assert h.serving._serving_burn_rate() == 0.0
+        # Ten observations, all busting the serving_latency threshold:
+        # the fast window's burn rate must exceed budget.
+        for _ in range(10):
+            h.mgr.slo.observe("serving_latency", 60.0)
+        burn = h.serving._serving_burn_rate()
+        assert burn is not None and burn > 1.0
+        h.serving.opts.slo_autoscale = False      # the kill switch
+        assert h.serving._serving_burn_rate() is None

@@ -186,3 +186,68 @@ def test_config_from_spec_defaults_and_garbage():
     assert cfg.max_replicas == 2
     assert cfg.target_rate_per_replica == 6.0
     assert cfg.scale_to_zero_after_seconds == 120.0
+
+
+# ---- SLO burn-rate overlay (ISSUE 19) ----------------------------------------
+
+
+def test_burn_rate_none_is_byte_identical_to_raw_policy():
+    """The kill switch: with no burn-rate signal the v2 policy must be
+    byte-for-byte the raw policy — same replicas, same reasons — across
+    seeded random traffic (the controller feeds None whenever
+    KFTPU_SERVING_SLO_AUTOSCALE is off or no SLO engine is installed).
+    A healthy budget (burn <= 1.0) must be equally invisible."""
+    rng = random.Random(17)
+    for _ in range(500):
+        sig = dict(rate=rng.uniform(0, 40),
+                   inflight=rng.uniform(0, 20),
+                   last_request_at=rng.uniform(0, 1000))
+        current = rng.randint(0, 5)
+        now = rng.uniform(0, 2000)
+        base = desired_replicas(CFG, Signals(**sig), current, now,
+                                AutoscalerState(created_at=0.0))
+        for burn in (None, 0.2, 1.0):
+            d = desired_replicas(CFG, Signals(**sig, burn_rate=burn),
+                                 current, now,
+                                 AutoscalerState(created_at=0.0))
+            assert (d.replicas, d.reason) == (base.replicas, base.reason)
+        assert "SLO" not in base.reason
+
+
+def test_critical_burn_steps_up_hard():
+    state = AutoscalerState(created_at=0.0)
+    d = desired_replicas(CFG, Signals(rate=1.0, last_request_at=10.0,
+                                      burn_rate=20.0), 2, 10.0, state)
+    assert d.replicas == 3  # 2 + max(1, ceil(2 * 0.5))
+    assert d.reason == "scale-up: serving_latency burn-rate critical (SLO)"
+    # Still clamped to max_replicas.
+    d = desired_replicas(CFG, Signals(rate=1.0, last_request_at=10.0,
+                                      burn_rate=20.0), 4, 11.0,
+                         AutoscalerState(created_at=0.0))
+    assert d.replicas == CFG.max_replicas
+
+
+def test_warning_burn_adds_one_replica():
+    d = desired_replicas(CFG, Signals(rate=1.0, last_request_at=10.0,
+                                      burn_rate=7.0), 2, 10.0,
+                         AutoscalerState(created_at=0.0))
+    assert d.replicas == 3
+    assert d.reason == "scale-up: serving_latency burn-rate warning (SLO)"
+
+
+def test_burning_budget_blocks_scale_down():
+    # Raw demand says 1 replica; a burn above budget holds at 3.
+    d = desired_replicas(CFG, Signals(rate=2.0, last_request_at=10.0,
+                                      burn_rate=3.0), 3, 10.0,
+                         AutoscalerState(created_at=0.0))
+    assert d.replicas == 3
+    assert d.reason == "hold: serving_latency burn above budget (SLO)"
+
+
+def test_raw_demand_wins_when_higher_than_slo_overlay():
+    # rate 33 → ceil(33/8) = 5 → clamped 4; warning burn asks 2+1=3.
+    d = desired_replicas(CFG, Signals(rate=33.0, last_request_at=10.0,
+                                      burn_rate=7.0), 2, 10.0,
+                         AutoscalerState(created_at=0.0))
+    assert d.replicas == 4
+    assert "SLO" not in d.reason  # the raw path drove the decision

@@ -1,0 +1,253 @@
+"""Serving engine v2 (ISSUE 19): the paged KV-cache block pool's
+accounting invariants, chunked prefill on its own lane, multi-model
+warm standbys through the registry's single swap door, and the
+park-spanning request queue.
+
+The block-pool tests are pure Python (no JAX). Engine tests run the
+real burn-in transformer on tiny configs so jit compiles stay cheap.
+"""
+
+import random
+import time
+
+import pytest
+
+from kubeflow_tpu.models.burnin import BurninConfig
+from kubeflow_tpu.runtime.metrics import Registry
+from kubeflow_tpu.serving.engine import (
+    DEFAULT_MODEL,
+    EngineOptions,
+    Request,
+    ServingEngine,
+)
+from kubeflow_tpu.serving.kvcache import (
+    BlockTable,
+    KVBlockPool,
+    KVCacheError,
+)
+from kubeflow_tpu.serving.loadgen import Phase, generate_trace
+
+TINY = BurninConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                    d_ff=64, seq_len=32)
+
+
+# ---- KV block pool -----------------------------------------------------------
+
+
+def test_blocks_needed_is_worst_case_and_at_least_one():
+    pool = KVBlockPool(8, block_size=16)
+    assert pool.blocks_needed(0, 0) == 1          # a slot is never free
+    assert pool.blocks_needed(0, 16) == 1
+    assert pool.blocks_needed(1, 16) == 2         # rounds up
+    assert pool.blocks_needed(100, 28) == 8
+
+
+def test_admit_release_roundtrip_accounting():
+    reg = Registry()
+    pool = KVBlockPool(8, block_size=16, registry=reg)
+    table = pool.admit(1, prompt_tokens=20, tokens_out=10)
+    assert isinstance(table, BlockTable)
+    assert len(table.blocks) == 2 and table.capacity_tokens == 32
+    assert pool.used_blocks == 2 and pool.free_blocks == 6
+    assert pool.pressure == pytest.approx(0.25)
+    assert reg.gauge("tpu_serving_kv_blocks_used").labels().value == 2.0
+    assert reg.gauge("tpu_serving_kv_blocks_total").labels().value == 8.0
+    freed = pool.release(1)
+    assert freed == 2 and pool.used_blocks == 0
+    assert reg.gauge("tpu_serving_kv_blocks_used").labels().value == 0.0
+    pool.assert_consistent()
+    assert pool.violations == 0
+
+
+def test_admission_is_all_or_nothing_under_pressure():
+    pool = KVBlockPool(4, block_size=16)
+    assert pool.admit(1, 40, 8) is not None       # 3 blocks
+    before = pool.free_blocks
+    assert pool.admit(2, 20, 16) is None          # needs 3, only 1 free
+    assert pool.free_blocks == before             # nothing partially taken
+    assert pool.rejections == 1
+    assert pool.blocks_short(20, 16) == 2
+    pool.release(1)
+    assert pool.admit(2, 20, 16) is not None      # backpressure, not a drop
+    pool.assert_consistent()
+    assert pool.violations == 0
+
+
+def test_double_admit_raises_same_rid():
+    pool = KVBlockPool(4, block_size=16)
+    pool.admit(7, 0, 8)
+    with pytest.raises(KVCacheError):
+        pool.admit(7, 0, 8)
+
+
+def test_release_unknown_or_double_is_idempotent_noop():
+    pool = KVBlockPool(4, block_size=16)
+    pool.admit(1, 0, 8)
+    assert pool.release(99) == 0                  # never admitted
+    assert pool.release(1) == 1
+    assert pool.release(1) == 0                   # double release
+    pool.assert_consistent()
+    assert pool.violations == 0
+
+
+def test_block_table_append_past_reservation_raises():
+    pool = KVBlockPool(4, block_size=8)
+    table = pool.admit(1, prompt_tokens=0, tokens_out=8)   # 1 block
+    table.append(8)
+    with pytest.raises(KVCacheError):
+        table.append(1)                           # past the reservation
+
+
+def test_seeded_fault_storm_never_oversells():
+    pool = KVBlockPool(16, block_size=8)
+    rng = random.Random(5)
+    live = []
+    for i in range(400):
+        roll = rng.random()
+        if roll < 0.5:
+            if pool.admit(i, rng.randint(0, 40), rng.randint(1, 12)):
+                live.append(i)
+        elif roll < 0.75 and live:
+            pool.release(live.pop(rng.randrange(len(live))))
+        elif roll < 0.9:
+            pool.release(rng.randint(-500, 500))  # hostile: unknown rid
+        else:
+            pool.admit(-i - 1, 10_000, 1)         # hostile: oversized
+        if i % 40 == 0:
+            pool.assert_consistent()
+    for rid in live:
+        pool.release(rid)
+    pool.assert_consistent()
+    assert pool.violations == 0
+    assert pool.used_blocks == 0                  # nothing leaked
+    assert pool.rejections > 0
+
+
+# ---- engine: admission, prefill, backpressure --------------------------------
+
+
+def test_serve_mixed_prompts_and_models_completes_with_clean_kv():
+    engine = ServingEngine(
+        TINY, max_batch=4, use_mesh=False,
+        options=EngineOptions(kv_block_size=8, prefill_chunk=8))
+    engine.cold_start(seed=0)
+    engine.register_model("alt")
+    trace = generate_trace(
+        [Phase(0.1, 80.0)], seed=3, tokens_out=4, tokens_jitter=2,
+        prompt_tokens=0, long_prompt_frac=0.3, long_prompt_tokens=20,
+        models={DEFAULT_MODEL: 3, "alt": 1})
+    report = engine.serve(trace)
+    assert len(report.completions) == len(trace)
+    assert report.prefill_chunks > 0
+    assert report.model_swaps >= 1
+    engine.kv.assert_consistent()
+    assert engine.kv.violations == 0
+    assert engine.kv.used_blocks == 0             # all released at finish
+    done_models = {c.model for c in report.completions}
+    assert done_models == {r.model for r in trace}
+
+
+def test_prefill_chunk_count_is_ceil_of_prompt_over_chunk():
+    engine = ServingEngine(
+        TINY, max_batch=2, use_mesh=False,
+        options=EngineOptions(kv_block_size=8, prefill_chunk=8))
+    engine.cold_start(seed=0)
+    report = engine.serve([Request(rid=0, arrival=0.0, tokens_out=2,
+                                   prompt_tokens=20)])
+    assert report.prefill_chunks == 3             # ceil(20 / 8)
+    assert report.prefill_tokens == 20
+    assert len(report.completions) == 1
+
+
+def test_kv_backpressure_is_queue_wait_never_a_drop():
+    engine = ServingEngine(
+        TINY, max_batch=4, use_mesh=False,
+        options=EngineOptions(kv_blocks=2, kv_block_size=8))
+    engine.cold_start(seed=0)
+    # Six single-block requests against a two-block pool: at most two
+    # run at once, the rest wait in the queue — but every one finishes.
+    trace = [Request(rid=i, arrival=0.0, tokens_out=6) for i in range(6)]
+    report = engine.serve(trace)
+    assert len(report.completions) == 6
+    assert report.kv_rejections > 0
+    assert engine.kv.violations == 0
+    assert max(c.queue_wait for c in report.completions) > 0.0
+
+
+def test_request_that_can_never_fit_raises_instead_of_spinning():
+    engine = ServingEngine(
+        TINY, max_batch=2, use_mesh=False,
+        options=EngineOptions(kv_blocks=2, kv_block_size=8))
+    engine.cold_start(seed=0)
+    with pytest.raises(KVCacheError):
+        engine.serve([Request(rid=0, arrival=0.0, tokens_out=64)])
+
+
+def test_serve_before_cold_start_still_raises():
+    engine = ServingEngine(TINY, max_batch=2, use_mesh=False)
+    with pytest.raises(RuntimeError):
+        engine.serve([Request(rid=0, arrival=0.0)])
+
+
+# ---- engine: park / restore spanning the queue -------------------------------
+
+
+def test_requests_queued_during_park_complete_after_restore():
+    """ISSUE 19 satellite: requests submitted while the engine is
+    parked survive the park and complete after warm restore, with
+    queue_wait spanning the parked window."""
+    engine = ServingEngine(TINY, max_batch=2, use_mesh=False)
+    engine.cold_start(seed=0)
+    engine.park()
+    assert engine.parked
+    engine.submit(Request(rid=1, arrival=0.0, tokens_out=3))
+    engine.submit(Request(rid=2, arrival=0.0, tokens_out=3))
+    time.sleep(0.08)
+    engine.warm_restore()
+    report = engine.serve([])
+    assert {c.rid for c in report.completions} == {1, 2}
+    assert min(c.queue_wait for c in report.completions) >= 0.08
+    assert engine.kv.violations == 0
+
+
+# ---- engine: model registry --------------------------------------------------
+
+
+def test_warm_standby_lru_demotes_and_swaps_back_warm():
+    engine = ServingEngine(
+        TINY, max_batch=2, use_mesh=False,
+        options=EngineOptions(max_resident_models=1))
+    engine.cold_start(seed=0)
+    engine.register_model("alt")
+    engine.use_model("alt")                       # cold: init + compile
+    alt = engine.models.entry("alt")
+    assert alt.cold_init_sec is not None
+    # With a one-model device budget, activating alt demoted default to
+    # a host-resident warm standby with its compiled fns retained.
+    default = engine.models.entry(DEFAULT_MODEL)
+    assert default.device_params is None
+    assert default.host_params is not None and default.warm
+    assert default.decode_fn is not None
+    engine.use_model(DEFAULT_MODEL)               # warm: device transfer
+    assert default.warm_swap_sec is not None
+    assert default.warm_swap_sec < alt.cold_init_sec
+    assert engine.models.swaps_cold >= 1 and engine.models.swaps_warm >= 1
+
+
+def test_use_model_while_parked_raises():
+    engine = ServingEngine(TINY, max_batch=2, use_mesh=False)
+    engine.cold_start(seed=0)
+    engine.park()
+    with pytest.raises(RuntimeError):
+        engine.use_model("other")
+
+
+def test_debug_info_exposes_kv_lanes_and_models():
+    engine = ServingEngine(TINY, max_batch=2, use_mesh=False)
+    engine.cold_start(seed=0)
+    info = engine.debug_info()
+    assert info["activeModel"] == DEFAULT_MODEL
+    assert info["kv"]["violations"] == 0
+    assert info["kv"]["totalBlocks"] == engine.kv.total_blocks
+    assert info["lanes"]["decodeSlots"] == 2
+    assert DEFAULT_MODEL in info["models"]["registered"]

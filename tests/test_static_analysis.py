@@ -459,7 +459,7 @@ def test_list_rules(capsys):
     for rule in ("no-blocking-in-async", "unawaited-coroutine",
                  "exception-swallow", "annotation-keys",
                  "env-knob-registry", "env-knob-docs", "contract-tracing",
-                 "contract-serving"):
+                 "contract-serving", "serving-engine-v2"):
         assert rule in out, rule
 
 
@@ -1819,3 +1819,121 @@ def test_telemetry_suppression_escape_hatch(tmp_path):
         '  # kftpu: ignore[telemetry-sections] trace-replay tool feeds recorded names\n'
         '    return sections.collective("ring_kv_hop", lambda t: t, a)\n'))
     assert report.findings == []
+
+
+# ---- serving-engine-v2 -------------------------------------------------------
+
+CLEAN_KVCACHE = """\
+class KVBlockPool:
+    def admit(self, rid, prompt_tokens, tokens_out):
+        used = "tpu_serving_kv_blocks_used"
+        total = "tpu_serving_kv_blocks_total"
+        return (used, total)
+
+    def release(self, rid):
+        return 0
+
+    def assert_consistent(self):
+        pass
+"""
+
+CLEAN_ENGINE = """\
+def init_params(cfg, seed):
+    return cfg
+
+
+class ModelRegistry:
+    def activate(self, model, seed=0):
+        host_params = self._entries[model].host_params
+        return host_params
+
+    def _load_cold(self, entry, seed):
+        entry.params = init_params(entry.cfg, seed)
+
+
+class ServingEngine:
+    def _admit_next(self, clock):
+        table = self.kv.admit(1, 0, 8)
+        return table
+
+    def _activate_model(self, model):
+        return self.models.activate(model)
+
+    def _finish(self, rid):
+        self.kv.release(rid)
+"""
+
+
+def _serving_v2_report(tmp_path, engine_src, kvcache_src=CLEAN_KVCACHE):
+    src = {"kubeflow_tpu/serving/engine.py": engine_src}
+    if kvcache_src is not None:
+        src["kubeflow_tpu/serving/kvcache.py"] = kvcache_src
+    for rel, text in src.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    project = load_project(root=str(tmp_path), paths=["kubeflow_tpu"],
+                           full_tree=True)
+    return run_passes(project, select={"servingv2"})
+
+
+def test_serving_v2_clean_twin_is_quiet(tmp_path):
+    report = _serving_v2_report(tmp_path, CLEAN_ENGINE)
+    assert rules_of(report) == []
+
+
+def test_serving_v2_admit_outside_admit_next_fires(tmp_path):
+    bad = CLEAN_ENGINE + """\
+
+
+class Gateway:
+    def fast_path(self):
+        return self.kv.admit(2, 0, 8)
+"""
+    report = _serving_v2_report(tmp_path, bad)
+    assert rules_of(report) == ["serving-engine-v2"]
+    assert "outside _admit_next" in report.findings[0].message
+
+
+def test_serving_v2_hand_built_block_table_fires(tmp_path):
+    bad = CLEAN_ENGINE + """\
+
+
+def sneak(rid):
+    return BlockTable(rid=rid, blocks=[0], block_size=16)
+"""
+    report = _serving_v2_report(tmp_path, bad)
+    assert rules_of(report) == ["serving-engine-v2"]
+    assert "BlockTable" in report.findings[0].message
+
+
+def test_serving_v2_bare_init_params_outside_cold_loader_fires(tmp_path):
+    bad = CLEAN_ENGINE + """\
+
+
+def hot_reload(cfg):
+    return init_params(cfg, 0)
+"""
+    report = _serving_v2_report(tmp_path, bad)
+    assert rules_of(report) == ["serving-engine-v2"]
+    assert "_load_cold" in report.findings[0].message
+
+
+def test_serving_v2_missing_kvcache_is_a_finding(tmp_path):
+    report = _serving_v2_report(tmp_path, CLEAN_ENGINE, kvcache_src=None)
+    assert "serving-engine-v2" in rules_of(report)
+    assert any("kvcache.py" in f.message for f in report.findings)
+
+
+def test_serving_v2_suppression(tmp_path):
+    bad = CLEAN_ENGINE + """\
+
+
+class Gateway:
+    def fast_path(self):
+        return self.kv.admit(2, 0, 8)  # kftpu: ignore[serving-engine-v2] probe endpoint dry-run admission
+"""
+    report = _serving_v2_report(tmp_path, bad)
+    assert rules_of(report) == []
+    assert len(report.suppressed) == 1
+    assert "dry-run" in report.suppressed[0][1].reason
